@@ -1,0 +1,11 @@
+"""nos_tpu — TPU-native dynamic partitioning, elastic quotas and capacity scheduling.
+
+A from-scratch rebuild of the capability set of nebuly-ai/nos (reference at
+/root/reference, surveyed in SURVEY.md) for Google TPUs: a cluster-scope
+partitioner carves TPU pods into ICI-valid slice topologies in real time from
+pending Pods' ``google.com/tpu`` requests; a node-local tpuagent reports and
+actuates slice state; an ICI-topology-aware scheduler plugin enforces elastic
+quotas and gang-schedules multi-host JAX jobs.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,58 @@
+"""In-memory Kubernetes-compatible substrate.
+
+The reference (nos) is built on controller-runtime and coordinates its
+components exclusively through the Kubernetes API server (SURVEY.md §5:
+annotations as the spec/status wire protocol). This package provides the
+equivalent fabric for the TPU build: typed objects, an API store with
+watch/patch/indexer semantics (our "API server" / envtest), and an
+event-driven reconciler runtime (our controller-runtime).
+"""
+
+from nos_tpu.kube.objects import (
+    Container,
+    ConfigMap,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    NodeStatus,
+    ResourceList,
+    Toleration,
+)
+from nos_tpu.kube.store import (
+    AlreadyExistsError,
+    ConflictError,
+    KubeStore,
+    NotFoundError,
+    WatchEvent,
+)
+from nos_tpu.kube.controller import Controller, Manager, Request, Result
+
+__all__ = [
+    "AlreadyExistsError",
+    "ConflictError",
+    "ConfigMap",
+    "Container",
+    "Controller",
+    "KubeStore",
+    "Manager",
+    "Node",
+    "NodeStatus",
+    "NotFoundError",
+    "PodSpec",
+    "PodStatus",
+    "ObjectMeta",
+    "OwnerReference",
+    "Pod",
+    "PodCondition",
+    "PodPhase",
+    "Request",
+    "ResourceList",
+    "Result",
+    "Toleration",
+    "WatchEvent",
+]

@@ -1,0 +1,270 @@
+"""Event-driven reconciler runtime (controller-runtime analogue).
+
+Every nos component is a set of controller-runtime reconcilers driven by
+watches (SURVEY.md §1: "event-driven controller-runtime reconcilers
+throughout"). This module provides the same model: a Controller owns a
+work queue fed by store watch events through predicates + request mappers,
+and a worker that calls ``reconcile(request)`` with requeue support.
+
+A Manager starts/stops a set of controllers against one KubeStore and — for
+tests — can block until the whole system is quiescent (``wait_idle``), which
+is what envtest's "eventually" assertions amount to.
+"""
+from __future__ import annotations
+
+import heapq
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from nos_tpu.kube.store import KubeStore, WatchEvent
+
+log = logging.getLogger("nos_tpu.kube")
+
+
+@dataclass(frozen=True)
+class Request:
+    name: str
+    namespace: str = ""
+
+    @property
+    def namespaced_name(self) -> str:
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+Predicate = Callable[[WatchEvent], bool]
+Mapper = Callable[[WatchEvent], Sequence[Request]]
+
+
+def default_mapper(event: WatchEvent) -> Sequence[Request]:
+    return [Request(name=event.object.metadata.name, namespace=event.object.metadata.namespace)]
+
+
+@dataclass
+class Watch:
+    kind: str
+    predicate: Optional[Predicate] = None
+    mapper: Mapper = default_mapper
+
+
+class _WorkQueue:
+    """Deduplicating work queue with delayed re-adds.
+
+    Mirrors client-go's rate-limiting workqueue semantics: an item present in
+    the queue is not added twice; an item being processed when re-added is
+    re-queued after processing finishes.
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queue: List[Request] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._delayed: List[Tuple[float, int, Request]] = []
+        self._seq = 0
+        self._shutdown = False
+
+    def add(self, req: Request) -> None:
+        with self._cond:
+            if req in self._dirty:
+                return
+            self._dirty.add(req)
+            if req not in self._processing:
+                self._queue.append(req)
+            self._cond.notify()
+
+    def add_after(self, req: Request, delay: float) -> None:
+        if delay <= 0:
+            self.add(req)
+            return
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, req))
+            self._cond.notify()
+
+    def _promote_due(self) -> None:
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, req = heapq.heappop(self._delayed)
+            if req not in self._dirty:
+                self._dirty.add(req)
+                if req not in self._processing:
+                    self._queue.append(req)
+
+    def get(self, timeout: float = 0.2) -> Optional[Request]:
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while True:
+                self._promote_due()
+                if self._queue:
+                    req = self._queue.pop(0)
+                    self._dirty.discard(req)
+                    self._processing.add(req)
+                    return req
+                if self._shutdown:
+                    return None
+                wait = deadline - time.monotonic()
+                if self._delayed:
+                    wait = min(wait, self._delayed[0][0] - time.monotonic())
+                if wait <= 0:
+                    return None
+                self._cond.wait(wait)
+
+    def done(self, req: Request) -> None:
+        with self._cond:
+            self._processing.discard(req)
+            if req in self._dirty:
+                self._queue.append(req)
+                self._cond.notify()
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._queue and not self._processing and not self._dirty
+
+
+class Controller:
+    """One reconciler + its watches, running on two threads (event pump and
+    worker), like a controller-runtime controller with MaxConcurrentReconciles=1
+    (the reference's node controller raises this to 10 —
+    gpupartitioner/node_controller.go; a single worker is enough in-process).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        store: KubeStore,
+        reconciler: Callable[[Request], Optional[Result]],
+        watches: Sequence[Watch],
+    ) -> None:
+        self.name = name
+        self.store = store
+        self.reconciler = reconciler
+        self.watches = list(watches)
+        self.queue = _WorkQueue()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._event_queue: Optional["queue.Queue[WatchEvent]"] = None
+
+    # -- event pump -----------------------------------------------------
+
+    def _dispatch(self, event: WatchEvent) -> None:
+        for w in self.watches:
+            if w.kind != event.kind:
+                continue
+            if w.predicate is not None and not w.predicate(event):
+                continue
+            for req in w.mapper(event):
+                self.queue.add(req)
+
+    def _pump(self) -> None:
+        assert self._event_queue is not None
+        while not self._stop.is_set():
+            try:
+                event = self._event_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._dispatch(event)
+            except Exception:  # pragma: no cover - defensive
+                log.exception("[%s] dispatch failed", self.name)
+
+    # -- worker ---------------------------------------------------------
+
+    def _work(self) -> None:
+        while not self._stop.is_set():
+            req = self.queue.get(timeout=0.2)
+            if req is None:
+                continue
+            try:
+                result = self.reconciler(req)
+            except Exception:
+                log.exception("[%s] reconcile %s failed; requeuing", self.name, req.namespaced_name)
+                result = Result(requeue=True, requeue_after=0.05)
+            finally:
+                self.queue.done(req)
+            if result and result.requeue_after > 0:
+                self.queue.add_after(req, result.requeue_after)
+            elif result and result.requeue:
+                self.queue.add(req)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        kinds = {w.kind for w in self.watches}
+        self._event_queue = self.store.watch(kinds)
+        for target, label in ((self._pump, "pump"), (self._work, "work")):
+            t = threading.Thread(target=target, name=f"{self.name}-{label}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        if self._event_queue is not None:
+            self.store.stop_watch(self._event_queue)
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def idle(self) -> bool:
+        eq = self._event_queue
+        return (eq is None or eq.empty()) and self.queue.idle()
+
+
+@dataclass
+class Manager:
+    """Holds the store and a set of controllers (one per nos binary's manager)."""
+
+    store: KubeStore = field(default_factory=KubeStore)
+    controllers: List[Controller] = field(default_factory=list)
+    _runnables: List[Callable[[], None]] = field(default_factory=list)
+    _stoppables: List[Callable[[], None]] = field(default_factory=list)
+
+    def add(self, controller: Controller) -> None:
+        self.controllers.append(controller)
+
+    def add_runnable(self, start: Callable[[], None], stop: Callable[[], None]) -> None:
+        self._runnables.append(start)
+        self._stoppables.append(stop)
+
+    def start(self) -> None:
+        for c in self.controllers:
+            c.start()
+        for r in self._runnables:
+            r()
+
+    def stop(self) -> None:
+        for s in self._stoppables:
+            s()
+        for c in self.controllers:
+            c.stop()
+
+    def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
+        """Block until every controller's queues are empty and stay empty for
+        ``settle`` seconds (reconcile cascades included). Test helper standing
+        in for envtest's Eventually()."""
+        deadline = time.monotonic() + timeout
+        idle_since: Optional[float] = None
+        while time.monotonic() < deadline:
+            if all(c.idle() for c in self.controllers):
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since >= settle:
+                    return True
+            else:
+                idle_since = None
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,160 @@
+"""Typed Kubernetes-shaped objects.
+
+These are the nouns every component of the suite speaks: Pods carry resource
+requests (``google.com/tpu``, sliced resources like
+``google.com/tpu-slice-2x2``); Nodes carry capacity plus the spec/status
+annotation protocol; ConfigMaps carry device-plugin configuration.
+
+The reference uses the real k8s core/v1 types via client-go; here the subset
+the suite actually touches is modeled natively (resource requests, phases,
+labels/annotations, owner refs, priorities) so the whole control loop runs
+in-process and under pytest.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Resource quantities. Chips/slices are integers; memory resources are floats
+# (GB). A plain dict keeps arithmetic helpers in nos_tpu/util/resources.py.
+ResourceList = Dict[str, float]
+
+_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+
+
+def _new_uid() -> str:
+    with _uid_lock:
+        return f"uid-{next(_uid_counter)}"
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str = ""
+    controller: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = field(default_factory=_new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    resource_version: int = 0
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    # requests/limits: resource name -> quantity
+    requests: ResourceList = field(default_factory=dict)
+    limits: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    priority: int = 0
+    priority_class_name: str = ""
+    tolerations: List[Toleration] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodStatus:
+    phase: str = PodPhase.PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+
+    @property
+    def namespaced_name(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def deepcopy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+    def is_owned_by_kind(self, kind: str) -> bool:
+        return any(o.kind == kind for o in self.metadata.owner_references)
+
+    def unschedulable(self) -> bool:
+        """True when the scheduler reported PodScheduled=False/Unschedulable.
+
+        Mirrors the pending∧unschedulable predicate feeding the partitioner
+        batch (reference pkg/util/pod/pod.go:25-33).
+        """
+        for c in self.status.conditions:
+            if c.type == "PodScheduled" and c.status == "False" and c.reason == "Unschedulable":
+                return True
+        return False
+
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: NodeStatus = field(default_factory=NodeStatus)
+    kind: str = "Node"
+
+    def deepcopy(self) -> "Node":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    kind: str = "ConfigMap"
+
+    def deepcopy(self) -> "ConfigMap":
+        return copy.deepcopy(self)

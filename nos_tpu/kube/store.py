@@ -1,0 +1,213 @@
+"""The in-memory API store: the suite's single coordination point.
+
+nos components "communicate only through the Kubernetes API server (node
+annotations/labels, CRDs, ConfigMaps)" (SURVEY.md §1). KubeStore provides
+that contract in-process: CRUD with resource versions, merge-patch helpers,
+label/field selection, registered field indexers (reference
+cmd/gpupartitioner/gpupartitioner.go:270-292 registers status.phase and
+spec.nodeName indexers), and fan-out watch subscriptions that drive the
+reconciler runtime.
+
+Objects are deep-copied on write and on read — mutating a returned object
+never mutates the store, exactly like talking to a real API server.
+"""
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class AlreadyExistsError(ValueError):
+    pass
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Any
+
+    @property
+    def kind(self) -> str:
+        return self.object.kind
+
+
+def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
+    return (kind, namespace or "", name)
+
+
+class KubeStore:
+    """Thread-safe object store with watch + indexer semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: Dict[Tuple[str, str, str], Any] = {}
+        self._rv = 0
+        self._watchers: List[Tuple[Optional[set], "queue.Queue[WatchEvent]"]] = []
+        # (kind, index_name) -> fn(obj) -> list of index values
+        self._indexers: Dict[Tuple[str, str], Callable[[Any], List[str]]] = {}
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k in self._objects:
+                raise AlreadyExistsError(f"{k} already exists")
+            self._rv += 1
+            stored = copy.deepcopy(obj)
+            stored.metadata.resource_version = self._rv
+            self._objects[k] = stored
+            out = copy.deepcopy(stored)
+        self._notify(WatchEvent(ADDED, copy.deepcopy(stored)))
+        return out
+
+    def get(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._objects[k])
+
+    def try_get(self, kind: str, name: str, namespace: str = "") -> Optional[Any]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def update(self, obj: Any, check_version: bool = False) -> Any:
+        with self._lock:
+            k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if k not in self._objects:
+                raise NotFoundError(f"{k} not found")
+            if check_version and self._objects[k].metadata.resource_version != obj.metadata.resource_version:
+                raise ConflictError(f"{k}: resource version conflict")
+            self._rv += 1
+            stored = copy.deepcopy(obj)
+            stored.metadata.resource_version = self._rv
+            self._objects[k] = stored
+            out = copy.deepcopy(stored)
+        self._notify(WatchEvent(MODIFIED, copy.deepcopy(stored)))
+        return out
+
+    def delete(self, kind: str, name: str, namespace: str = "") -> Any:
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            stored = self._objects.pop(k)
+        self._notify(WatchEvent(DELETED, copy.deepcopy(stored)))
+        return stored
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        filter_fn: Optional[Callable[[Any], bool]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for (k_kind, k_ns, _), obj in self._objects.items():
+                if k_kind != kind:
+                    continue
+                if namespace is not None and k_ns != namespace:
+                    continue
+                if label_selector and not all(
+                    obj.metadata.labels.get(lk) == lv for lk, lv in label_selector.items()
+                ):
+                    continue
+                if filter_fn and not filter_fn(obj):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    # ------------------------------------------------------------- patching
+
+    def patch_merge(self, kind: str, name: str, namespace: str, mutate: Callable[[Any], None]) -> Any:
+        """Read-modify-write under the store lock — the analogue of a merge
+        patch (client.Patch in controller-runtime)."""
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            obj = copy.deepcopy(self._objects[k])
+            mutate(obj)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[k] = obj
+            stored = copy.deepcopy(obj)
+        self._notify(WatchEvent(MODIFIED, stored))
+        return copy.deepcopy(stored)
+
+    def patch_annotations(self, kind: str, name: str, namespace: str, annotations: Dict[str, Optional[str]]) -> Any:
+        def mutate(obj: Any) -> None:
+            for ak, av in annotations.items():
+                if av is None:
+                    obj.metadata.annotations.pop(ak, None)
+                else:
+                    obj.metadata.annotations[ak] = av
+
+        return self.patch_merge(kind, name, namespace, mutate)
+
+    def patch_labels(self, kind: str, name: str, namespace: str, labels: Dict[str, Optional[str]]) -> Any:
+        def mutate(obj: Any) -> None:
+            for lk, lv in labels.items():
+                if lv is None:
+                    obj.metadata.labels.pop(lk, None)
+                else:
+                    obj.metadata.labels[lk] = lv
+
+        return self.patch_merge(kind, name, namespace, mutate)
+
+    # ------------------------------------------------------------- indexers
+
+    def add_indexer(self, kind: str, index_name: str, fn: Callable[[Any], List[str]]) -> None:
+        self._indexers[(kind, index_name)] = fn
+
+    def list_by_index(self, kind: str, index_name: str, value: str) -> List[Any]:
+        fn = self._indexers.get((kind, index_name))
+        if fn is None:
+            raise KeyError(f"no indexer {index_name!r} for kind {kind!r}")
+        return self.list(kind, filter_fn=lambda o: value in fn(o))
+
+    # ---------------------------------------------------------------- watch
+
+    def watch(self, kinds: Optional[Iterable[str]] = None) -> "queue.Queue[WatchEvent]":
+        """Subscribe to events for the given kinds (None = all). Existing
+        objects are replayed as ADDED events first (informer list+watch)."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        kind_set = set(kinds) if kinds is not None else None
+        with self._lock:
+            for (k_kind, _, _), obj in sorted(self._objects.items()):
+                if kind_set is None or k_kind in kind_set:
+                    q.put(WatchEvent(ADDED, copy.deepcopy(obj)))
+            self._watchers.append((kind_set, q))
+        return q
+
+    def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
+        with self._lock:
+            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+
+    def _notify(self, event: WatchEvent) -> None:
+        with self._lock:
+            watchers = list(self._watchers)
+        for kind_set, q in watchers:
+            if kind_set is None or event.kind in kind_set:
+                q.put(event)

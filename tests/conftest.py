@@ -1,0 +1,15 @@
+"""Test bootstrap: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors the reference's test strategy of running everything without real
+hardware (nos runs NVML-free via mocks + envtest; we run TPU-free via a
+virtual CPU mesh). See SURVEY.md §4.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,207 @@
+import queue
+
+import pytest
+
+from nos_tpu.kube import (
+    AlreadyExistsError,
+    Container,
+    Controller,
+    KubeStore,
+    Manager,
+    Node,
+    NotFoundError,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    Request,
+    Result,
+)
+from nos_tpu.kube.controller import Watch
+from nos_tpu.kube.objects import PodCondition
+from nos_tpu.kube.store import ADDED, DELETED, MODIFIED
+
+
+def make_pod(name, ns="default", phase=PodPhase.PENDING, node="", requests=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests=requests or {})], node_name=node),
+    )
+
+
+class TestCrud:
+    def test_create_get_roundtrip_is_isolated(self):
+        s = KubeStore()
+        pod = make_pod("p1")
+        s.create(pod)
+        got = s.get("Pod", "p1", "default")
+        got.metadata.labels["x"] = "y"
+        assert s.get("Pod", "p1", "default").metadata.labels == {}
+
+    def test_create_duplicate_raises(self):
+        s = KubeStore()
+        s.create(make_pod("p1"))
+        with pytest.raises(AlreadyExistsError):
+            s.create(make_pod("p1"))
+
+    def test_get_missing_raises(self):
+        s = KubeStore()
+        with pytest.raises(NotFoundError):
+            s.get("Pod", "nope", "default")
+
+    def test_update_bumps_resource_version(self):
+        s = KubeStore()
+        created = s.create(make_pod("p1"))
+        created.status.phase = PodPhase.RUNNING
+        updated = s.update(created)
+        assert updated.metadata.resource_version > created.metadata.resource_version
+
+    def test_delete(self):
+        s = KubeStore()
+        s.create(make_pod("p1"))
+        s.delete("Pod", "p1", "default")
+        assert s.try_get("Pod", "p1", "default") is None
+
+    def test_list_with_label_selector_and_namespace(self):
+        s = KubeStore()
+        p = make_pod("p1", ns="a")
+        p.metadata.labels["team"] = "x"
+        s.create(p)
+        s.create(make_pod("p2", ns="a"))
+        s.create(make_pod("p3", ns="b"))
+        assert len(s.list("Pod")) == 3
+        assert len(s.list("Pod", namespace="a")) == 2
+        assert [o.metadata.name for o in s.list("Pod", label_selector={"team": "x"})] == ["p1"]
+
+
+class TestPatch:
+    def test_patch_annotations_set_and_remove(self):
+        s = KubeStore()
+        s.create(Node(metadata=ObjectMeta(name="n1", annotations={"old": "1"})))
+        s.patch_annotations("Node", "n1", "", {"new": "2", "old": None})
+        got = s.get("Node", "n1")
+        assert got.metadata.annotations == {"new": "2"}
+
+    def test_patch_merge_read_modify_write(self):
+        s = KubeStore()
+        s.create(make_pod("p1"))
+
+        def mutate(pod):
+            pod.status.phase = PodPhase.RUNNING
+
+        s.patch_merge("Pod", "p1", "default", mutate)
+        assert s.get("Pod", "p1", "default").status.phase == PodPhase.RUNNING
+
+
+class TestIndexers:
+    def test_list_by_index(self):
+        s = KubeStore()
+        s.add_indexer("Pod", "status.phase", lambda p: [p.status.phase])
+        s.add_indexer("Pod", "spec.nodeName", lambda p: [p.spec.node_name])
+        s.create(make_pod("p1"))
+        running = make_pod("p2", node="n1")
+        running.status.phase = PodPhase.RUNNING
+        s.create(running)
+        assert [p.metadata.name for p in s.list_by_index("Pod", "status.phase", "Pending")] == ["p1"]
+        assert [p.metadata.name for p in s.list_by_index("Pod", "spec.nodeName", "n1")] == ["p2"]
+
+
+class TestWatch:
+    def test_watch_replays_existing_then_streams(self):
+        s = KubeStore()
+        s.create(make_pod("p1"))
+        q = s.watch({"Pod"})
+        ev = q.get(timeout=1)
+        assert (ev.type, ev.object.metadata.name) == (ADDED, "p1")
+        s.create(make_pod("p2"))
+        assert q.get(timeout=1).type == ADDED
+        s.delete("Pod", "p2", "default")
+        assert q.get(timeout=1).type == DELETED
+
+    def test_watch_filters_kinds(self):
+        s = KubeStore()
+        q = s.watch({"Node"})
+        s.create(make_pod("p1"))
+        s.create(Node(metadata=ObjectMeta(name="n1")))
+        ev = q.get(timeout=1)
+        assert ev.object.kind == "Node"
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.05)
+
+
+class TestController:
+    def test_reconcile_driven_by_watch_events(self):
+        s = KubeStore()
+        seen = []
+
+        def reconcile(req: Request):
+            seen.append(req.name)
+            return Result()
+
+        c = Controller("test", s, reconcile, [Watch(kind="Pod")])
+        mgr = Manager(store=s)
+        mgr.add(c)
+        mgr.start()
+        try:
+            s.create(make_pod("p1"))
+            assert mgr.wait_idle(timeout=5)
+            assert "p1" in seen
+        finally:
+            mgr.stop()
+
+    def test_predicate_filters_events(self):
+        s = KubeStore()
+        seen = []
+
+        def reconcile(req: Request):
+            seen.append(req.name)
+            return None
+
+        only_modified = Watch(kind="Pod", predicate=lambda e: e.type == MODIFIED)
+        c = Controller("test", s, reconcile, [only_modified])
+        mgr = Manager(store=s)
+        mgr.add(c)
+        mgr.start()
+        try:
+            pod = s.create(make_pod("p1"))
+            assert mgr.wait_idle(timeout=5)
+            assert seen == []
+            pod.status.phase = PodPhase.RUNNING
+            s.update(pod)
+            assert mgr.wait_idle(timeout=5)
+            assert seen == ["p1"]
+        finally:
+            mgr.stop()
+
+    def test_requeue_after(self):
+        s = KubeStore()
+        calls = []
+
+        def reconcile(req: Request):
+            calls.append(req.name)
+            if len(calls) < 3:
+                return Result(requeue_after=0.01)
+            return Result()
+
+        c = Controller("test", s, reconcile, [Watch(kind="Pod")])
+        c.start()
+        try:
+            s.create(make_pod("p1"))
+            import time
+
+            deadline = time.monotonic() + 5
+            while len(calls) < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(calls) >= 3
+        finally:
+            c.stop()
+
+
+class TestPodHelpers:
+    def test_unschedulable_condition(self):
+        pod = make_pod("p")
+        assert not pod.unschedulable()
+        pod.status.conditions.append(
+            PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+        )
+        assert pod.unschedulable()
