@@ -1,0 +1,5 @@
+"""API surface: CRD-equivalent types, annotation protocol, labels, configs.
+
+Mirror of the reference's pkg/api/nos.nebuly.com (SURVEY.md §2.6), extended
+with the TPU partitioning mode.
+"""

@@ -1,0 +1,19 @@
+from nos_tpu.api.v1alpha1 import annotations, constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import (
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+    ElasticQuota,
+    ElasticQuotaSpec,
+    ElasticQuotaStatus,
+)
+
+__all__ = [
+    "annotations",
+    "constants",
+    "labels",
+    "CompositeElasticQuota",
+    "CompositeElasticQuotaSpec",
+    "ElasticQuota",
+    "ElasticQuotaSpec",
+    "ElasticQuotaStatus",
+]

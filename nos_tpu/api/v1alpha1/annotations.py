@@ -1,0 +1,149 @@
+"""The node-annotation wire protocol: desired vs reported slice state.
+
+This is the heart of the architecture (SURVEY.md §7: "desired vs reported
+state as node annotations + plan-id handshake"). The control plane writes
+*spec* annotations describing the slice geometry each TPU board should have;
+the node-local tpuagent writes *status* annotations describing what actually
+exists, plus the id of the last plan it observed. Reference
+pkg/api/nos.nebuly.com/v1alpha1/annotations.go:21-58 and
+pkg/gpu/annotation.go:29-101.
+
+Format (TPU mode):
+  nos.nebuly.com/spec-tpu-<board>-<topology> = "<quantity>"
+  nos.nebuly.com/status-tpu-<board>-<topology>-<free|used> = "<quantity>"
+  nos.nebuly.com/spec-partitioning-plan   = "<plan-id>"
+  nos.nebuly.com/status-partitioning-plan = "<plan-id>"
+"""
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+PREFIX = "nos.nebuly.com/"
+SPEC_PARTITIONING_PLAN = PREFIX + "spec-partitioning-plan"
+STATUS_PARTITIONING_PLAN = PREFIX + "status-partitioning-plan"
+
+_SPEC_RE = re.compile(r"^nos\.nebuly\.com/spec-tpu-(\d+)-(\d+x\d+(?:x\d+)?)$")
+_STATUS_RE = re.compile(
+    r"^nos\.nebuly\.com/status-tpu-(\d+)-(\d+x\d+(?:x\d+)?)-(free|used)$"
+)
+
+STATUS_FREE = "free"
+STATUS_USED = "used"
+
+
+@dataclass(frozen=True)
+class SpecAnnotation:
+    board_index: int
+    profile: str  # topology string, e.g. "2x2"
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return f"{PREFIX}spec-tpu-{self.board_index}-{self.profile}"
+
+
+@dataclass(frozen=True)
+class StatusAnnotation:
+    board_index: int
+    profile: str
+    status: str  # free | used
+    quantity: int
+
+    @property
+    def key(self) -> str:
+        return f"{PREFIX}status-tpu-{self.board_index}-{self.profile}-{self.status}"
+
+
+def parse_node_annotations(
+    annotations: Dict[str, str],
+) -> Tuple[List[SpecAnnotation], List[StatusAnnotation]]:
+    """Parse the spec/status slice annotations off a node's annotation map.
+
+    Malformed quantities are skipped (a real API server cannot enforce the
+    value format), matching the tolerant parsing of reference
+    pkg/gpu/annotation.go:29-101.
+    """
+    spec: List[SpecAnnotation] = []
+    status: List[StatusAnnotation] = []
+    for key, value in annotations.items():
+        m = _SPEC_RE.match(key)
+        if m:
+            qty = _parse_quantity(value)
+            if qty is not None:
+                spec.append(SpecAnnotation(int(m.group(1)), m.group(2), qty))
+            continue
+        m = _STATUS_RE.match(key)
+        if m:
+            qty = _parse_quantity(value)
+            if qty is not None:
+                status.append(
+                    StatusAnnotation(int(m.group(1)), m.group(2), m.group(3), qty)
+                )
+    return spec, status
+
+
+def _parse_quantity(value: str) -> "int | None":
+    """Slice counts must be positive integers; anything else is malformed."""
+    try:
+        qty = int(value)
+    except ValueError:
+        return None
+    return qty if qty > 0 else None
+
+
+def spec_from_geometries(geometries: Dict[int, Dict[str, int]]) -> Dict[str, str]:
+    """Board-index → geometry map rendered as spec annotations."""
+    out: Dict[str, str] = {}
+    for board, geometry in geometries.items():
+        for profile, qty in geometry.items():
+            if qty > 0:
+                out[SpecAnnotation(board, profile, qty).key] = str(qty)
+    return out
+
+
+def status_from_devices(
+    free: Dict[int, Dict[str, int]], used: Dict[int, Dict[str, int]]
+) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for source, label in ((free, STATUS_FREE), (used, STATUS_USED)):
+        for board, geometry in source.items():
+            for profile, qty in geometry.items():
+                if qty > 0:
+                    out[StatusAnnotation(board, profile, label, qty).key] = str(qty)
+    return out
+
+
+def _aggregate(entries) -> Dict[int, Dict[str, int]]:
+    out: Dict[int, Dict[str, int]] = defaultdict(dict)
+    for s in entries:
+        out[s.board_index][s.profile] = out[s.board_index].get(s.profile, 0) + s.quantity
+    return dict(out)
+
+
+def spec_geometries(spec: List[SpecAnnotation]) -> Dict[int, Dict[str, int]]:
+    return _aggregate(spec)
+
+
+def status_geometries(status: List[StatusAnnotation]) -> Dict[int, Dict[str, int]]:
+    """Total (free+used) geometry per board from status annotations."""
+    return _aggregate(status)
+
+
+def spec_matches_status(
+    spec: List[SpecAnnotation], status: List[StatusAnnotation]
+) -> bool:
+    """True when reported total geometry equals desired geometry
+    (reference internal/controllers/migagent/actuator.go:93-97)."""
+    return spec_geometries(spec) == status_geometries(status)
+
+
+def strip_spec_annotations(annotations: Dict[str, str]) -> Dict[str, None]:
+    """Removal patch for all existing spec slice annotations."""
+    return {k: None for k in annotations if _SPEC_RE.match(k)}
+
+
+def strip_status_annotations(annotations: Dict[str, str]) -> Dict[str, None]:
+    return {k: None for k in annotations if _STATUS_RE.match(k)}

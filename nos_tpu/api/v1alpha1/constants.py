@@ -1,0 +1,53 @@
+"""Resource names and shared constants.
+
+Parity with reference pkg/constant/constants.go:36-107 and
+pkg/api/nos.nebuly.com/v1alpha1/constants.go:25-27, transposed to TPUs.
+"""
+import re
+
+# The native TPU chip resource exposed by the TPU device plugin.
+RESOURCE_TPU = "google.com/tpu"
+
+# Sliced TPU resources carved by the partitioner, e.g.
+# google.com/tpu-slice-2x2 (4 chips), google.com/tpu-slice-1x1 (1 chip).
+# Analogue of nvidia.com/mig-1g.10gb (MIG) / nvidia.com/gpu-10gb (MPS).
+RESOURCE_TPU_SLICE_PREFIX = "google.com/tpu-slice-"
+RESOURCE_TPU_SLICE_REGEX = re.compile(r"^google\.com/tpu-slice-(\d+x\d+(?:x\d+)?)$")
+
+# Aggregate custom resource used by ElasticQuota so quotas can be expressed
+# in chips regardless of which sliced resource a pod requests. Analogue of
+# nos.nebuly.com/gpu-memory (reference v1alpha1/constants.go:25-27).
+RESOURCE_TPU_CHIPS = "nos.nebuly.com/tpu-chips"
+
+# Reference-parity NVIDIA names (kept so MIG/MPS parity modes and the
+# resource calculator can recognize them; reference pkg/constant/constants.go).
+RESOURCE_NVIDIA_GPU = "nvidia.com/gpu"
+RESOURCE_NVIDIA_MIG_PREFIX = "nvidia.com/mig-"
+RESOURCE_NVIDIA_SLICE_REGEX = re.compile(r"^nvidia\.com/gpu-(\d+)gb$")
+RESOURCE_GPU_MEMORY = "nos.nebuly.com/gpu-memory"
+DEFAULT_NVIDIA_GPU_RESOURCE_MEMORY_GB = 16
+
+# Scheduler / controller names.
+SCHEDULER_NAME = "nos-scheduler"
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# Indexer keys (reference cmd/gpupartitioner/gpupartitioner.go:270-292).
+INDEX_POD_PHASE = "status.phase"
+INDEX_POD_NODE = "spec.nodeName"
+INDEX_EQ_NAMESPACE = "spec.namespaces"
+
+
+def is_tpu_slice_resource(name: str) -> bool:
+    return RESOURCE_TPU_SLICE_REGEX.match(name) is not None
+
+
+def tpu_slice_topology(resource_name: str) -> str:
+    """'google.com/tpu-slice-2x2' -> '2x2'; raises ValueError otherwise."""
+    m = RESOURCE_TPU_SLICE_REGEX.match(resource_name)
+    if m is None:
+        raise ValueError(f"{resource_name!r} is not a TPU slice resource")
+    return m.group(1)
+
+
+def tpu_slice_resource(topology: str) -> str:
+    return RESOURCE_TPU_SLICE_PREFIX + topology

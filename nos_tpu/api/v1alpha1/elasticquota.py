@@ -1,0 +1,47 @@
+"""ElasticQuota / CompositeElasticQuota CRD-equivalent types.
+
+Reference pkg/api/nos.nebuly.com/v1alpha1/elasticquota_types.go:30-71 and
+compositeelasticquota_types.go:29-66. `min` is guaranteed quota, `max` is the
+borrowing ceiling; namespaces may exceed `min` by borrowing unused quota from
+others, and those over-quota pods are preemptible (SURVEY.md §1 item 2).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from nos_tpu.kube.objects import ObjectMeta, ResourceList
+
+
+@dataclass
+class ElasticQuotaSpec:
+    min: ResourceList = field(default_factory=dict)
+    max: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuotaStatus:
+    used: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class ElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ElasticQuotaSpec = field(default_factory=ElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+    kind: str = "ElasticQuota"
+
+
+@dataclass
+class CompositeElasticQuotaSpec:
+    namespaces: List[str] = field(default_factory=list)
+    min: ResourceList = field(default_factory=dict)
+    max: ResourceList = field(default_factory=dict)
+
+
+@dataclass
+class CompositeElasticQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CompositeElasticQuotaSpec = field(default_factory=CompositeElasticQuotaSpec)
+    status: ElasticQuotaStatus = field(default_factory=ElasticQuotaStatus)
+    kind: str = "CompositeElasticQuota"

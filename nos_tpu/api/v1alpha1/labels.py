@@ -1,0 +1,42 @@
+"""Well-known labels.
+
+Parity with reference pkg/api/nos.nebuly.com/v1alpha1/labels.go:19-24, plus
+the GKE TPU node labels that replace NVIDIA GPU-feature-discovery labels
+(reference pkg/gpu/util.go:19-63 reads GFD labels; we read GKE TPU labels).
+"""
+
+# The opt-in switch: nodes labeled with this are managed by the partitioner.
+# Values: "tpu" (this build's native mode), "mig", "mps" (reference parity).
+PARTITIONING_LABEL = "nos.nebuly.com/gpu-partitioning"
+
+# Pod capacity classification written by the ElasticQuota reconciler
+# (reference internal/controllers/elasticquota/elasticquota.go:48-62).
+CAPACITY_LABEL = "nos.nebuly.com/capacity"
+CAPACITY_IN_QUOTA = "in-quota"
+CAPACITY_OVER_QUOTA = "over-quota"
+
+# GKE TPU node labels (the TPU analogue of NVIDIA GFD labels).
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+# Device-plugin config selection label flipped by the MPS-style actuation
+# path (reference internal/partitioning/mps/partitioner.go:102-110 flips
+# nvidia.com/device-plugin.config; the TPU device plugin uses its own key).
+TPU_DEVICE_PLUGIN_CONFIG_LABEL = "google.com/tpu-device-plugin.config"
+
+
+class PartitioningKind:
+    TPU = "tpu"
+    MIG = "mig"
+    MPS = "mps"
+
+    ALL = (TPU, MIG, MPS)
+
+
+def partitioning_kind(node) -> str:
+    """Partitioning kind from the node opt-in label, '' if unmanaged.
+
+    Reference pkg/gpu/partitioning.go:87-135.
+    """
+    value = node.metadata.labels.get(PARTITIONING_LABEL, "")
+    return value if value in PartitioningKind.ALL else ""

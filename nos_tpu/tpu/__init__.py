@@ -1,0 +1,45 @@
+"""TPU device domain model.
+
+The TPU analogue of the reference's pkg/gpu + pkg/gpu/mig + pkg/gpu/slicing
+(SURVEY.md §2.5): slice profiles are ICI topologies (1x1, 2x2, 2x4, 2x2x1…)
+instead of MIG profiles; the allowed-geometry tables of known_configs.go are
+*computed* by exactly tiling a board's chip grid with ICI-valid sub-slices
+rather than hard-coded; nodes are modeled from GKE TPU labels instead of
+NVIDIA GFD labels.
+"""
+
+from nos_tpu.tpu.topology import Topology
+from nos_tpu.tpu.geometry import (
+    Geometry,
+    geometry_add,
+    geometry_chips,
+    geometry_fits,
+    geometry_subtract,
+)
+from nos_tpu.tpu.known import (
+    AcceleratorSpec,
+    KNOWN_ACCELERATORS,
+    allowed_geometries,
+    board_layout,
+    profile_for_chips,
+    set_known_geometries,
+)
+from nos_tpu.tpu.board import TpuBoard
+from nos_tpu.tpu.node import TpuNode
+
+__all__ = [
+    "AcceleratorSpec",
+    "Geometry",
+    "KNOWN_ACCELERATORS",
+    "Topology",
+    "TpuBoard",
+    "TpuNode",
+    "allowed_geometries",
+    "board_layout",
+    "geometry_add",
+    "geometry_chips",
+    "geometry_fits",
+    "geometry_subtract",
+    "profile_for_chips",
+    "set_known_geometries",
+]

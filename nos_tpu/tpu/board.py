@@ -1,0 +1,121 @@
+"""TpuBoard: one host-local TPU chip grid and its slice geometry.
+
+The analogue of the reference's ``mig.GPU`` (pkg/gpu/mig/gpu.go:27-259):
+tracks used/free slices and searches the allowed geometries for one that
+serves lacking slice profiles without destroying used slices
+(UpdateGeometryFor, gpu.go:158-212). Init picks the fewest-slices geometry
+(gpu.go:118-127) — for TPUs that is the whole-board slice.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from nos_tpu.tpu.geometry import (
+    Geometry,
+    geometry_add,
+    geometry_chips,
+    geometry_fits,
+    geometry_subtract,
+)
+from nos_tpu.tpu.known import KNOWN_ACCELERATORS, allowed_geometries
+
+
+class TpuBoard:
+    def __init__(
+        self,
+        index: int,
+        accelerator: str,
+        used: Optional[Geometry] = None,
+        free: Optional[Geometry] = None,
+        board_topology: Optional[str] = None,
+    ) -> None:
+        if accelerator not in KNOWN_ACCELERATORS:
+            raise ValueError(f"unknown TPU accelerator {accelerator!r}")
+        self.index = index
+        self.accelerator = accelerator
+        # Undersized hosts (4-chip v5e workers of a multi-host podslice) carry
+        # a smaller board than the generation default.
+        self.board_topology = board_topology or KNOWN_ACCELERATORS[accelerator].board_topology
+        self.used: Geometry = dict(used or {})
+        self.free: Geometry = dict(free or {})
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def geometry(self) -> Geometry:
+        return geometry_add(self.used, self.free)
+
+    @property
+    def chips(self) -> int:
+        from nos_tpu.tpu.topology import Topology
+
+        return Topology(self.board_topology).chips
+
+    @property
+    def used_chips(self) -> int:
+        return geometry_chips(self.used)
+
+    @property
+    def free_chips(self) -> int:
+        return geometry_chips(self.free)
+
+    def has_free_capacity(self) -> bool:
+        """Free slices exist, or spare chips could be (re)carved into some."""
+        if self.free:
+            return True
+        return self.used_chips < self.chips
+
+    def clone(self) -> "TpuBoard":
+        return copy.deepcopy(self)
+
+    # ---------------------------------------------------------- mutation
+
+    def init_geometry(self) -> bool:
+        """Apply the fewest-slices allowed geometry to a virgin board."""
+        if self.geometry:
+            return False
+        geometries = allowed_geometries(self.accelerator, self.board_topology)
+        if not geometries:
+            return False
+        self.free = dict(geometries[0])
+        return True
+
+    def allocate(self, profile: str, quantity: int = 1) -> bool:
+        if self.free.get(profile, 0) < quantity:
+            return False
+        self.free[profile] -= quantity
+        if self.free[profile] == 0:
+            del self.free[profile]
+        self.used[profile] = self.used.get(profile, 0) + quantity
+        return True
+
+    def update_geometry_for(self, lacking: Geometry) -> bool:
+        """Re-carve free chips to serve `lacking`, never touching used slices.
+
+        Scans allowed geometries, keeps only those that still contain every
+        used slice, and picks the one providing the most lacking slices
+        (ties → fewest total slices, i.e. least fragmentation). Returns True
+        iff the geometry changed. Reference pkg/gpu/mig/gpu.go:158-212.
+        """
+        wanted = {p: n for p, n in lacking.items() if n > 0}
+        if not wanted:
+            return False
+
+        def provided(geometry: Geometry) -> int:
+            free_after = geometry_subtract(geometry, self.used)
+            return sum(min(free_after.get(p, 0), n) for p, n in wanted.items())
+
+        current_score = sum(min(self.free.get(p, 0), n) for p, n in wanted.items())
+        best: Optional[Geometry] = None
+        best_score = current_score
+        for candidate in allowed_geometries(self.accelerator, self.board_topology):
+            if not geometry_fits(candidate, self.used):
+                continue
+            score = provided(candidate)
+            if score > best_score:
+                best, best_score = candidate, score
+        if best is None:
+            return False
+        self.free = geometry_subtract(best, self.used)
+        return True

@@ -1,0 +1,36 @@
+"""Geometry: a multiset of slice profiles on one board.
+
+Equivalent of the reference's ``gpu.Geometry = map[Slice]int``
+(pkg/gpu/partitioning.go:28-143). Profiles are topology strings ("2x2").
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from nos_tpu.tpu.topology import Topology
+
+Geometry = Dict[str, int]
+
+
+def geometry_chips(g: Geometry) -> int:
+    return sum(Topology(p).chips * n for p, n in g.items())
+
+
+def geometry_add(a: Geometry, b: Geometry) -> Geometry:
+    out = dict(a)
+    for p, n in b.items():
+        out[p] = out.get(p, 0) + n
+    return {p: n for p, n in out.items() if n != 0}
+
+
+def geometry_subtract(a: Geometry, b: Geometry) -> Geometry:
+    """a - b; negative counts are kept (caller checks with geometry_fits)."""
+    out = dict(a)
+    for p, n in b.items():
+        out[p] = out.get(p, 0) - n
+    return {p: n for p, n in out.items() if n != 0}
+
+
+def geometry_fits(container: Geometry, content: Geometry) -> bool:
+    """True when `container` has at least `content` of every profile."""
+    return all(container.get(p, 0) >= n for p, n in content.items())

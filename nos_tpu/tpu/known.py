@@ -1,0 +1,150 @@
+"""Known TPU accelerator generations and their slice search spaces.
+
+Analogue of the reference's known MIG geometry tables
+(pkg/gpu/mig/known_configs.go:24-185: A30 / A100-40GB / A100-80GB) with the
+same override hook (`SetKnownGeometries`, loaded from a YAML file at
+cmd/gpupartitioner/gpupartitioner.go:370-380). Here the per-generation data
+is the *board topology* + *allowed slice shapes*; allowed geometries are
+derived by exact tiling (nos_tpu/tpu/topology.py) and can still be
+overridden wholesale for exotic deployments.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu.tpu.geometry import Geometry
+from nos_tpu.tpu.topology import Topology, enumerate_tilings
+
+
+@dataclass(frozen=True)
+class AcceleratorSpec:
+    """One TPU generation as seen from a single host.
+
+    `board_topology` is the chip grid local to one host/board (the unit the
+    partitioner can re-carve without node-pool operations); `slice_shapes`
+    are the ICI-valid sub-slice topologies the device plugin can expose.
+    """
+
+    name: str  # value of cloud.google.com/gke-tpu-accelerator
+    board_topology: str
+    slice_shapes: Tuple[str, ...]
+
+    @property
+    def board_chips(self) -> int:
+        return Topology(self.board_topology).chips
+
+
+# GKE accelerator label values → per-host slicing capability.
+KNOWN_ACCELERATORS: Dict[str, AcceleratorSpec] = {
+    # v5e: 8 chips per host laid out 2x4; single-host slice configs
+    # 1x1 (1 chip), 1x2 (2), 2x2 (4), 2x4 (8).
+    "tpu-v5-lite-podslice": AcceleratorSpec(
+        name="tpu-v5-lite-podslice",
+        board_topology="2x4",
+        slice_shapes=("1x1", "1x2", "2x2", "2x4"),
+    ),
+    # v5e single-host device nodes (ct5l): 4 chips, 2x2.
+    "tpu-v5-lite-device": AcceleratorSpec(
+        name="tpu-v5-lite-device",
+        board_topology="2x2",
+        slice_shapes=("1x1", "1x2", "2x2"),
+    ),
+    # v4: 4 chips per host (2x2x1 local cube face).
+    "tpu-v4-podslice": AcceleratorSpec(
+        name="tpu-v4-podslice",
+        board_topology="2x2x1",
+        slice_shapes=("1x1x1", "1x2x1", "2x2x1"),
+    ),
+    # v5p: 4 chips per host.
+    "tpu-v5p-slice": AcceleratorSpec(
+        name="tpu-v5p-slice",
+        board_topology="2x2x1",
+        slice_shapes=("1x1x1", "1x2x1", "2x2x1"),
+    ),
+    # v6e (Trillium): 8 chips per host, 2x4, same slice configs as v5e.
+    "tpu-v6e-slice": AcceleratorSpec(
+        name="tpu-v6e-slice",
+        board_topology="2x4",
+        slice_shapes=("1x1", "1x2", "2x2", "2x4"),
+    ),
+}
+
+# Optional wholesale override (config-file analogue of KnownMigGeometriesFile).
+_geometry_overrides: Dict[str, List[Geometry]] = {}
+
+
+def set_known_geometries(overrides: Optional[Dict[str, List[Geometry]]]) -> None:
+    """Replace the computed allowed-geometry list for given accelerators.
+
+    Reference mig.SetKnownGeometries (pkg/gpu/mig/known_configs.go:144-150).
+    Pass None to clear all overrides.
+    """
+    global _geometry_overrides
+    _geometry_overrides = dict(overrides) if overrides else {}
+
+
+def allowed_geometries(accelerator: str, board_topology: Optional[str] = None) -> List[Geometry]:
+    """All ICI-valid slice geometries for one board of `accelerator`,
+    ordered fewest-slices-first. Unknown accelerators yield [].
+
+    `board_topology` overrides the generation's default board shape for
+    undersized hosts (e.g. a 4-chip v5e host is a 2x2 board, not 2x4).
+    File-based geometry overrides apply only to the default board shape.
+    """
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    if spec is None:
+        return []
+    board = board_topology or spec.board_topology
+    if board == spec.board_topology and accelerator in _geometry_overrides:
+        return [dict(g) for g in _geometry_overrides[accelerator]]
+    return [dict(g) for g in enumerate_tilings(board, spec.slice_shapes)]
+
+
+def board_layout(accelerator: str, capacity_chips: int) -> List[str]:
+    """Board topologies modeling a node that exposes `capacity_chips` chips.
+
+    A node advertising a multiple of the generation's board size gets that
+    many full boards; an undersized remainder (multi-host podslice workers,
+    smaller machine types) gets a board of the exact-size slice shape. A
+    capacity no combination models (or 0 — device plugin not registered
+    yet) yields [] so the planner never carves phantom chips.
+    """
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    if spec is None or capacity_chips <= 0:
+        return []
+    layouts: List[str] = []
+    remaining = capacity_chips
+    while remaining >= spec.board_chips:
+        layouts.append(spec.board_topology)
+        remaining -= spec.board_chips
+    if remaining > 0:
+        exact = [
+            s
+            for s in spec.slice_shapes
+            if Topology(s).chips == remaining
+        ]
+        if not exact:
+            return []
+        # Largest-area shapes are equal here; pick deterministic first.
+        layouts.append(sorted(exact)[0])
+    return layouts
+
+
+def profile_for_chips(chips: int, accelerator: str) -> Optional[str]:
+    """Smallest slice profile of `accelerator` with ≥ `chips` chips.
+
+    This is how plain ``google.com/tpu: N`` requests are normalized to slice
+    requests at the planner/scheduler boundary (the reference equivalent is
+    users requesting nvidia.com/mig-Ng.Mgb directly; TPU UX per BASELINE is
+    chip counts)."""
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    if spec is None:
+        return None
+    candidates = sorted(
+        (Topology(s) for s in spec.slice_shapes), key=lambda t: (t.chips, str(t))
+    )
+    for t in candidates:
+        if t.chips >= chips:
+            return str(t)
+    return None

@@ -1,0 +1,177 @@
+"""TpuNode: a partitionable node modeled from GKE labels + status annotations.
+
+The analogue of the reference's ``mig.Node`` (pkg/gpu/mig/node.go:26-222):
+built from the Node object's GKE TPU labels (accelerator/topology — replacing
+NVIDIA GFD labels) plus the status annotations the tpuagent reported; it
+implements the PartitionableNode protocol the partitioning engine drives
+(UpdateGeometryFor / Geometry / AddPod / HasFreeCapacity / Clone) and can
+recompute the node's scalar resources after a geometry change
+(node.go:173-195) for scheduler simulation.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import Node, Pod, ResourceList
+from nos_tpu.tpu.board import TpuBoard
+from nos_tpu.tpu.geometry import Geometry, geometry_add
+from nos_tpu.tpu.known import KNOWN_ACCELERATORS, board_layout
+from nos_tpu.util import resources as res
+
+
+class TpuNode:
+    def __init__(self, node: Node) -> None:
+        self.name = node.metadata.name
+        self.node = node.deepcopy()
+        self.accelerator = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+        self.boards: List[TpuBoard] = []
+        # False when status annotations reference boards this node cannot
+        # have (stale agent state, mid-resize): the planner must neither
+        # carve nor place on a node whose reported state it cannot model.
+        self.consistent = True
+        if self.accelerator in KNOWN_ACCELERATORS:
+            self._build_boards(node)
+
+    # ------------------------------------------------------------- build
+
+    def _build_boards(self, node: Node) -> None:
+        capacity_chips = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        layouts = board_layout(self.accelerator, capacity_chips)
+        if not layouts:
+            # Device plugin not registered yet (capacity 0) or capacity no
+            # board combination models: expose nothing rather than carve
+            # phantom chips.
+            return
+
+        _, status = annot.parse_node_annotations(node.metadata.annotations)
+        free_by_board: Dict[int, Geometry] = {}
+        used_by_board: Dict[int, Geometry] = {}
+        for s in status:
+            if s.board_index >= len(layouts):
+                self.consistent = False
+                continue
+            target = free_by_board if s.status == annot.STATUS_FREE else used_by_board
+            board = target.setdefault(s.board_index, {})
+            board[s.profile] = board.get(s.profile, 0) + s.quantity
+
+        for i, topology in enumerate(layouts):
+            self.boards.append(
+                TpuBoard(
+                    index=i,
+                    accelerator=self.accelerator,
+                    used=used_by_board.get(i, {}),
+                    free=free_by_board.get(i, {}),
+                    board_topology=topology,
+                )
+            )
+
+    # ----------------------------------------------------------- queries
+
+    @property
+    def is_tpu_node(self) -> bool:
+        return bool(self.boards)
+
+    def geometry(self) -> Dict[int, Geometry]:
+        """Board index → total geometry (used+free)."""
+        return {b.index: b.geometry for b in self.boards}
+
+    def has_free_capacity(self) -> bool:
+        if not self.consistent:
+            return False
+        return any(b.has_free_capacity() for b in self.boards)
+
+    def free_slices(self) -> Geometry:
+        out: Geometry = {}
+        for b in self.boards:
+            out = geometry_add(out, b.free)
+        return out
+
+    def clone(self) -> "TpuNode":
+        return copy.deepcopy(self)
+
+    # ---------------------------------------------------------- mutation
+
+    def update_geometry_for(self, lacking_slices: ResourceList) -> bool:
+        """Try to re-carve boards so the cluster lacks fewer of
+        `lacking_slices` (a ResourceList of slice resources). Boards are
+        visited in order, each serving whatever is still lacking after its
+        predecessors (reference pkg/gpu/mig/node.go:145-171)."""
+        if not self.consistent:
+            return False
+        remaining: Geometry = {}
+        for name, qty in lacking_slices.items():
+            if constants.is_tpu_slice_resource(name):
+                remaining[constants.tpu_slice_topology(name)] = int(qty)
+        if not remaining:
+            return False
+        changed = False
+        for board in self.boards:
+            if not remaining:
+                break
+            if board.update_geometry_for(remaining):
+                changed = True
+            for profile in list(remaining):
+                remaining[profile] -= board.free.get(profile, 0)
+                if remaining[profile] <= 0:
+                    del remaining[profile]
+        return changed
+
+    def add_pod(self, pod: Pod) -> bool:
+        """Consume free slices for the pod's (normalized) TPU request.
+        Returns False — leaving the node untouched — when it does not fit."""
+        request = res.normalize_tpu_request(res.compute_pod_request(pod), self.accelerator)
+        if int(request.get(constants.RESOURCE_TPU, 0)) > 0:
+            # Normalization left a plain-chip request: no single-board profile
+            # holds it, so this node cannot serve it by carving (that is the
+            # multi-host gang-scheduling path, not slice allocation).
+            return False
+        needed: Geometry = {}
+        for name, qty in request.items():
+            if constants.is_tpu_slice_resource(name):
+                needed[constants.tpu_slice_topology(name)] = int(qty)
+        if not needed:
+            return True
+        plan: List[tuple] = []
+        free = {b.index: dict(b.free) for b in self.boards}
+        for profile, qty in needed.items():
+            for _ in range(qty):
+                placed = False
+                for b in self.boards:
+                    if free[b.index].get(profile, 0) > 0:
+                        free[b.index][profile] -= 1
+                        plan.append((b, profile))
+                        placed = True
+                        break
+                if not placed:
+                    return False
+        for board, profile in plan:
+            board.allocate(profile)
+        return True
+
+    # ------------------------------------------------------- projections
+
+    def scalar_resources(self) -> ResourceList:
+        """Slice resources this node's current geometry exposes — what the
+        device plugin would advertise, used to refresh allocatable in
+        scheduler simulation (reference node.go:173-195)."""
+        out: ResourceList = {}
+        for b in self.boards:
+            for profile, qty in b.geometry.items():
+                name = constants.tpu_slice_resource(profile)
+                out[name] = out.get(name, 0) + qty
+        return out
+
+    def to_sim_node(self) -> Node:
+        """Node object with allocatable rewritten to the current geometry,
+        for feeding the in-process scheduler framework."""
+        node = self.node.deepcopy()
+        alloc = {
+            k: v
+            for k, v in node.status.allocatable.items()
+            if not constants.is_tpu_slice_resource(k) and k != constants.RESOURCE_TPU
+        }
+        node.status.allocatable = res.sum_resources(alloc, self.scalar_resources())
+        return node

@@ -1,0 +1,129 @@
+"""ICI topology math: parsing, chip counts, and exact slice tilings.
+
+The MIG analogue is the hard-coded allowed-geometry table per GPU model
+(reference pkg/gpu/mig/known_configs.go:24-185). TPU slice validity is
+geometric — a sub-slice must be a contiguous axis-aligned block of the
+board's chip grid so its ICI links stay internal — so instead of tables we
+*enumerate exact tilings* of the board topology by the generation's allowed
+slice shapes. The result plays the same role (the search space of
+``UpdateGeometryFor``) but is provably ICI-valid and extends to any
+topology without new tables.
+"""
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+from typing import Dict, FrozenSet, List, Sequence, Tuple
+
+
+class Topology:
+    """An ICI topology like '2x4' (v5e) or '2x2x1' (v4/v5p)."""
+
+    __slots__ = ("dims",)
+
+    def __init__(self, spec: "str | Tuple[int, ...]") -> None:
+        if isinstance(spec, str):
+            try:
+                dims = tuple(int(d) for d in spec.split("x"))
+            except ValueError as e:
+                raise ValueError(f"invalid topology {spec!r}") from e
+        else:
+            dims = tuple(spec)
+        if not dims or any(d < 1 for d in dims):
+            raise ValueError(f"invalid topology {spec!r}")
+        self.dims = dims
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def __str__(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    def __repr__(self) -> str:
+        return f"Topology({str(self)!r})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Topology) and self.dims == other.dims
+
+    def __hash__(self) -> int:
+        return hash(self.dims)
+
+    def orientations(self) -> List[Tuple[int, ...]]:
+        """Distinct axis permutations (a 1x2 slice may lie along either axis)."""
+        return sorted(set(itertools.permutations(self.dims)))
+
+
+def _cells(dims: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+    return list(itertools.product(*(range(d) for d in dims)))
+
+
+def _placements_at(
+    dims: Tuple[int, ...], anchor: Tuple[int, ...], shape: Tuple[int, ...]
+) -> "FrozenSet[Tuple[int, ...]] | None":
+    """Cells covered by `shape` anchored (min corner) at `anchor`, or None if
+    it overflows the grid."""
+    for a, s, d in zip(anchor, shape, dims):
+        if a + s > d:
+            return None
+    ranges = [range(a, a + s) for a, s in zip(anchor, shape)]
+    return frozenset(itertools.product(*ranges))
+
+
+@lru_cache(maxsize=None)
+def enumerate_tilings(
+    host: str, shapes: Tuple[str, ...]
+) -> Tuple[Dict[str, int], ...]:
+    """All distinct multisets of `shapes` that exactly tile `host`.
+
+    Returns a tuple of geometries (profile string → count). Grids are tiny
+    (≤16 cells for any single host), so backtracking over the first empty
+    cell is instant. Orientation variants of a shape count as the same
+    profile (a 1x2 slice is a 1x2 slice however it lies).
+    """
+    host_t = Topology(host)
+    dims = host_t.dims
+    shape_ts = [Topology(s) for s in shapes]
+    for s in shape_ts:
+        if s.rank != host_t.rank:
+            raise ValueError(
+                f"shape {s} rank {s.rank} != host {host_t} rank {host_t.rank}"
+            )
+
+    all_cells = _cells(dims)
+    results: Dict[Tuple[Tuple[str, int], ...], Dict[str, int]] = {}
+
+    def solve(uncovered: FrozenSet[Tuple[int, ...]], counts: Dict[str, int]) -> None:
+        if not uncovered:
+            key = tuple(sorted(counts.items()))
+            results[key] = dict(counts)
+            return
+        # Anchor on the lexicographically-first uncovered cell: every tiling
+        # covers it exactly once, so this enumerates each tiling once per
+        # distinct placement (geometry-level dedup happens via `results`).
+        anchor = min(uncovered)
+        for shape_t in shape_ts:
+            name = str(shape_t)
+            for orient in shape_t.orientations():
+                covered = _placements_at(dims, anchor, orient)
+                if covered is None or not covered <= uncovered:
+                    continue
+                counts[name] = counts.get(name, 0) + 1
+                solve(uncovered - covered, counts)
+                counts[name] -= 1
+                if counts[name] == 0:
+                    del counts[name]
+
+    solve(frozenset(all_cells), {})
+    # Stable order: fewest slices first (biggest profiles preferred), then name.
+    ordered = sorted(
+        results.values(), key=lambda g: (sum(g.values()), sorted(g.items()))
+    )
+    return tuple(ordered)

@@ -1,0 +1,96 @@
+"""Resource arithmetic over ResourceList maps.
+
+Reference pkg/resource/resource.go:30-146 (Sum/Subtract/Abs; pod request =
+Σcontainers ⊔ max(initContainers)) and pkg/gpu/util/resource.go:28-86 (the
+ResourceCalculator that injects the synthetic aggregate resource so quotas
+can be expressed in one unit — GPU-memory GB there, TPU chips here).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.tpu.known import profile_for_chips
+from nos_tpu.tpu.topology import Topology
+
+
+def sum_resources(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def subtract_resources(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) - v
+    return out
+
+
+def max_resources(a: ResourceList, b: ResourceList) -> ResourceList:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = max(out.get(k, 0), v)
+    return out
+
+
+def fits(available: ResourceList, request: ResourceList) -> bool:
+    return all(available.get(k, 0) >= v for k, v in request.items())
+
+
+def nonzero(r: ResourceList) -> ResourceList:
+    return {k: v for k, v in r.items() if v != 0}
+
+
+def compute_pod_request(pod: Pod) -> ResourceList:
+    """Effective pod request: Σ(containers) ⊔ max(initContainers).
+
+    Reference pkg/resource/resource.go ComputePodRequest."""
+    total: ResourceList = {}
+    for c in pod.spec.containers:
+        total = sum_resources(total, c.requests)
+    for c in pod.spec.init_containers:
+        total = max_resources(total, c.requests)
+    return total
+
+
+def tpu_chips_in(request: ResourceList) -> int:
+    """Total TPU chips a request amounts to, across plain-chip and sliced
+    resources. The aggregate-resource math behind nos.nebuly.com/tpu-chips
+    (analogue of reference pkg/gpu/util/resource.go:60-86)."""
+    chips = int(request.get(constants.RESOURCE_TPU, 0))
+    for name, qty in request.items():
+        if constants.is_tpu_slice_resource(name):
+            chips += Topology(constants.tpu_slice_topology(name)).chips * int(qty)
+    return chips
+
+
+def with_aggregate_tpu_chips(request: ResourceList) -> ResourceList:
+    """Inject nos.nebuly.com/tpu-chips so quota checks see one chip unit."""
+    chips = tpu_chips_in(request)
+    if chips == 0:
+        return dict(request)
+    out = dict(request)
+    out[constants.RESOURCE_TPU_CHIPS] = chips
+    return out
+
+
+def normalize_tpu_request(request: ResourceList, accelerator: str) -> ResourceList:
+    """Rewrite a plain ``google.com/tpu: N`` request as one slice request of
+    the smallest profile holding N chips. Slice requests pass through.
+
+    Returns the request unchanged when N exceeds every single-board profile
+    (multi-host case — handled by gang scheduling, not board carving)."""
+    plain = int(request.get(constants.RESOURCE_TPU, 0))
+    if plain <= 0:
+        return dict(request)
+    profile = profile_for_chips(plain, accelerator)
+    if profile is None:
+        return dict(request)
+    out = dict(request)
+    del out[constants.RESOURCE_TPU]
+    slice_resource = constants.tpu_slice_resource(profile)
+    out[slice_resource] = out.get(slice_resource, 0) + 1
+    return out

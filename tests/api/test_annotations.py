@@ -1,0 +1,78 @@
+from nos_tpu.api.v1alpha1 import annotations as annot
+
+
+class TestParse:
+    def test_roundtrip_spec(self):
+        ann = annot.spec_from_geometries({0: {"2x2": 2}, 1: {"1x1": 4}})
+        spec, status = annot.parse_node_annotations(ann)
+        assert status == []
+        assert annot.spec_geometries(spec) == {0: {"2x2": 2}, 1: {"1x1": 4}}
+
+    def test_roundtrip_status(self):
+        ann = annot.status_from_devices(
+            free={0: {"2x2": 1}}, used={0: {"2x2": 1, "1x1": 2}}
+        )
+        spec, status = annot.parse_node_annotations(ann)
+        assert spec == []
+        assert annot.status_geometries(status) == {0: {"2x2": 2, "1x1": 2}}
+
+    def test_malformed_values_skipped(self):
+        ann = {
+            "nos.nebuly.com/spec-tpu-0-2x2": "nope",
+            "nos.nebuly.com/spec-tpu-0-1x1": "3",
+            "unrelated/annotation": "1",
+        }
+        spec, _ = annot.parse_node_annotations(ann)
+        assert [(s.profile, s.quantity) for s in spec] == [("1x1", 3)]
+
+    def test_3d_profiles(self):
+        ann = annot.spec_from_geometries({0: {"2x2x1": 1}})
+        spec, _ = annot.parse_node_annotations(ann)
+        assert spec[0].profile == "2x2x1"
+
+    def test_zero_quantities_omitted(self):
+        assert annot.spec_from_geometries({0: {"2x2": 0}}) == {}
+
+
+class TestSpecMatchesStatus:
+    def test_match_ignores_free_used_split(self):
+        spec_ann = annot.spec_from_geometries({0: {"2x2": 2}})
+        status_ann = annot.status_from_devices(
+            free={0: {"2x2": 1}}, used={0: {"2x2": 1}}
+        )
+        spec, _ = annot.parse_node_annotations(spec_ann)
+        _, status = annot.parse_node_annotations(status_ann)
+        assert annot.spec_matches_status(spec, status)
+
+    def test_mismatch(self):
+        spec, _ = annot.parse_node_annotations(
+            annot.spec_from_geometries({0: {"2x4": 1}})
+        )
+        _, status = annot.parse_node_annotations(
+            annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        )
+        assert not annot.spec_matches_status(spec, status)
+
+
+class TestStrip:
+    def test_strip_spec_only(self):
+        ann = {
+            **annot.spec_from_geometries({0: {"2x2": 1}}),
+            **annot.status_from_devices(free={0: {"2x2": 1}}, used={}),
+            annot.SPEC_PARTITIONING_PLAN: "123",
+        }
+        removal = annot.strip_spec_annotations(ann)
+        assert list(removal.values()) == [None]
+        assert "nos.nebuly.com/spec-tpu-0-2x2" in removal
+        assert annot.SPEC_PARTITIONING_PLAN not in removal
+
+
+class TestQuantityValidation:
+    def test_negative_and_zero_quantities_skipped(self):
+        ann = {
+            "nos.nebuly.com/status-tpu-0-2x2-free": "-1",
+            "nos.nebuly.com/status-tpu-0-1x1-used": "0",
+            "nos.nebuly.com/status-tpu-0-1x2-free": "2",
+        }
+        _, status = annot.parse_node_annotations(ann)
+        assert [(s.profile, s.quantity) for s in status] == [("1x2", 2)]

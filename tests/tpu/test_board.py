@@ -1,0 +1,116 @@
+import pytest
+
+from nos_tpu.tpu.board import TpuBoard
+from nos_tpu.tpu.known import allowed_geometries, set_known_geometries
+
+
+V5E = "tpu-v5-lite-podslice"
+
+
+@pytest.fixture(autouse=True)
+def clear_overrides():
+    yield
+    set_known_geometries(None)
+
+
+class TestInitGeometry:
+    def test_virgin_board_gets_fewest_slices_geometry(self):
+        b = TpuBoard(0, V5E)
+        assert b.init_geometry()
+        assert b.geometry == {"2x4": 1}
+        assert b.free == {"2x4": 1}
+
+    def test_non_virgin_board_untouched(self):
+        b = TpuBoard(0, V5E, free={"2x2": 2})
+        assert not b.init_geometry()
+        assert b.geometry == {"2x2": 2}
+
+    def test_unknown_accelerator_rejected(self):
+        with pytest.raises(ValueError):
+            TpuBoard(0, "tpu-v99")
+
+
+class TestAllocate:
+    def test_allocate_moves_free_to_used(self):
+        b = TpuBoard(0, V5E, free={"2x2": 2})
+        assert b.allocate("2x2")
+        assert b.used == {"2x2": 1}
+        assert b.free == {"2x2": 1}
+
+    def test_allocate_insufficient(self):
+        b = TpuBoard(0, V5E, free={"2x2": 1})
+        assert not b.allocate("2x2", 2)
+        assert b.used == {}
+
+
+class TestUpdateGeometryFor:
+    def test_virgin_board_carved_for_lacking(self):
+        b = TpuBoard(0, V5E)
+        assert b.update_geometry_for({"2x2": 2})
+        assert b.free == {"2x2": 2}
+
+    def test_respects_used_slices(self):
+        b = TpuBoard(0, V5E, used={"2x2": 1})
+        assert b.update_geometry_for({"1x1": 4})
+        # used 2x2 preserved; remaining 4 chips re-carved into 1x1s
+        assert b.used == {"2x2": 1}
+        assert b.free == {"1x1": 4}
+
+    def test_fully_used_board_cannot_change(self):
+        b = TpuBoard(0, V5E, used={"2x4": 1})
+        assert not b.update_geometry_for({"1x1": 1})
+        assert b.geometry == {"2x4": 1}
+
+    def test_no_improvement_returns_false(self):
+        b = TpuBoard(0, V5E, free={"1x1": 8})
+        assert not b.update_geometry_for({"1x1": 2})
+        assert b.free == {"1x1": 8}
+
+    def test_prefers_least_fragmentation_on_ties(self):
+        b = TpuBoard(0, V5E)
+        assert b.update_geometry_for({"2x2": 1})
+        # {2x2:2} and {2x2:1,1x1:4} both provide one 2x2; fewest slices wins.
+        assert b.free == {"2x2": 2}
+
+    def test_empty_lacking_is_noop(self):
+        b = TpuBoard(0, V5E)
+        assert not b.update_geometry_for({})
+
+    def test_mixed_profiles(self):
+        b = TpuBoard(0, V5E)
+        assert b.update_geometry_for({"2x2": 1, "1x1": 4})
+        assert b.free == {"2x2": 1, "1x1": 4}
+
+    def test_geometry_override_limits_search(self):
+        set_known_geometries({V5E: [{"2x4": 1}, {"1x1": 8}]})
+        b = TpuBoard(0, V5E)
+        assert b.update_geometry_for({"2x2": 1}) is False
+        assert b.update_geometry_for({"1x1": 1})
+        assert b.free == {"1x1": 8}
+
+
+class TestCapacity:
+    def test_has_free_capacity_with_free_slices(self):
+        assert TpuBoard(0, V5E, free={"1x1": 1}).has_free_capacity()
+
+    def test_has_free_capacity_virgin(self):
+        assert TpuBoard(0, V5E).has_free_capacity()
+
+    def test_no_free_capacity_fully_used(self):
+        assert not TpuBoard(0, V5E, used={"2x4": 1}).has_free_capacity()
+
+    def test_chip_accounting(self):
+        b = TpuBoard(0, V5E, used={"2x2": 1}, free={"1x2": 2})
+        assert b.used_chips == 4
+        assert b.free_chips == 4
+        assert b.chips == 8
+
+
+class TestAllowedGeometries:
+    def test_unknown_accelerator_empty(self):
+        assert allowed_geometries("nope") == []
+
+    def test_returned_geometries_are_copies(self):
+        g = allowed_geometries(V5E)[0]
+        g["2x4"] = 99
+        assert allowed_geometries(V5E)[0] == {"2x4": 1}

@@ -1,0 +1,197 @@
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from nos_tpu.tpu.node import TpuNode
+
+V5E = "tpu-v5-lite-podslice"
+
+
+def make_tpu_node(
+    name="n1", accelerator=V5E, chips=8, annotations=None, extra_alloc=None
+):
+    alloc = {constants.RESOURCE_TPU: chips, "cpu": 8, "memory": 128}
+    alloc.update(extra_alloc or {})
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                labels.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+                labels.PARTITIONING_LABEL: "tpu",
+            },
+            annotations=annotations or {},
+        ),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def make_pod(name, requests, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests=requests)]),
+    )
+
+
+class TestBuild:
+    def test_non_tpu_node(self):
+        node = Node(metadata=ObjectMeta(name="plain"))
+        t = TpuNode(node)
+        assert not t.is_tpu_node
+        assert t.boards == []
+
+    def test_virgin_tpu_node_one_board(self):
+        t = TpuNode(make_tpu_node())
+        assert t.is_tpu_node
+        assert len(t.boards) == 1
+        assert t.boards[0].geometry == {}
+
+    def test_multi_board_node(self):
+        t = TpuNode(make_tpu_node(chips=16))
+        assert len(t.boards) == 2
+
+    def test_geometry_from_status_annotations(self):
+        ann = annot.status_from_devices(
+            free={0: {"2x2": 1}}, used={0: {"2x2": 1}}
+        )
+        t = TpuNode(make_tpu_node(annotations=ann))
+        assert t.boards[0].free == {"2x2": 1}
+        assert t.boards[0].used == {"2x2": 1}
+        assert t.geometry() == {0: {"2x2": 2}}
+
+
+class TestAddPod:
+    def test_slice_request_consumes_free_slice(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        pod = make_pod("p", {constants.tpu_slice_resource("2x2"): 1})
+        assert t.add_pod(pod)
+        assert t.boards[0].used == {"2x2": 1}
+
+    def test_plain_chip_request_normalized_to_slice(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        assert t.add_pod(make_pod("p", {constants.RESOURCE_TPU: 4}))
+        assert t.boards[0].used == {"2x2": 1}
+
+    def test_chip_request_rounds_up_to_next_profile(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        # 3 chips -> smallest profile ≥ 3 = 2x2
+        assert t.add_pod(make_pod("p", {constants.RESOURCE_TPU: 3}))
+        assert t.boards[0].used == {"2x2": 1}
+
+    def test_does_not_fit_leaves_node_untouched(self):
+        ann = annot.status_from_devices(free={0: {"1x1": 1}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        assert not t.add_pod(make_pod("p", {constants.tpu_slice_resource("2x2"): 1}))
+        assert t.boards[0].used == {}
+        assert t.boards[0].free == {"1x1": 1}
+
+    def test_non_tpu_pod_always_fits(self):
+        t = TpuNode(make_tpu_node())
+        assert t.add_pod(make_pod("p", {"cpu": 2}))
+
+    def test_spreads_across_boards(self):
+        ann = annot.status_from_devices(
+            free={0: {"2x2": 1}, 1: {"2x2": 1}}, used={}
+        )
+        t = TpuNode(make_tpu_node(chips=16, annotations=ann))
+        pod = make_pod("p", {constants.tpu_slice_resource("2x2"): 2})
+        assert t.add_pod(pod)
+        assert t.boards[0].used == {"2x2": 1}
+        assert t.boards[1].used == {"2x2": 1}
+
+
+class TestUpdateGeometryFor:
+    def test_carve_virgin_node(self):
+        t = TpuNode(make_tpu_node())
+        lacking = {constants.tpu_slice_resource("2x2"): 2}
+        assert t.update_geometry_for(lacking)
+        assert t.boards[0].free == {"2x2": 2}
+
+    def test_already_satisfied_no_change(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        assert not t.update_geometry_for({constants.tpu_slice_resource("2x2"): 1})
+
+    def test_second_board_serves_remainder(self):
+        t = TpuNode(make_tpu_node(chips=16))
+        lacking = {constants.tpu_slice_resource("2x4"): 2}
+        assert t.update_geometry_for(lacking)
+        assert t.boards[0].free == {"2x4": 1}
+        assert t.boards[1].free == {"2x4": 1}
+
+    def test_ignores_non_slice_resources(self):
+        t = TpuNode(make_tpu_node())
+        assert not t.update_geometry_for({"cpu": 4})
+
+
+class TestProjections:
+    def test_scalar_resources(self):
+        ann = annot.status_from_devices(
+            free={0: {"2x2": 1, "1x1": 4}}, used={}
+        )
+        t = TpuNode(make_tpu_node(annotations=ann))
+        assert t.scalar_resources() == {
+            constants.tpu_slice_resource("2x2"): 1,
+            constants.tpu_slice_resource("1x1"): 4,
+        }
+
+    def test_to_sim_node_swaps_tpu_for_slices(self):
+        ann = annot.status_from_devices(free={0: {"2x4": 1}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        sim = t.to_sim_node()
+        assert constants.RESOURCE_TPU not in sim.status.allocatable
+        assert sim.status.allocatable[constants.tpu_slice_resource("2x4")] == 1
+        assert sim.status.allocatable["cpu"] == 8
+
+    def test_clone_is_independent(self):
+        t = TpuNode(make_tpu_node())
+        c = t.clone()
+        c.boards[0].init_geometry()
+        assert t.boards[0].geometry == {}
+
+
+class TestOversizedRequests:
+    def test_multi_host_sized_request_rejected_at_node_level(self):
+        ann = annot.status_from_devices(free={0: {"2x4": 1}}, used={})
+        t = TpuNode(make_tpu_node(annotations=ann))
+        assert not t.add_pod(make_pod("big", {constants.RESOURCE_TPU: 16}))
+        assert t.boards[0].used == {}
+
+
+class TestBoardLayout:
+    def test_undersized_v5e_host_is_2x2_board(self):
+        t = TpuNode(make_tpu_node(chips=4))
+        assert len(t.boards) == 1
+        assert t.boards[0].board_topology == "2x2"
+        assert t.boards[0].chips == 4
+        # carving is bounded by the real 4 chips
+        assert t.update_geometry_for({constants.tpu_slice_resource("1x1"): 8})
+        assert t.boards[0].free == {"1x1": 4}
+
+    def test_zero_capacity_no_phantom_board(self):
+        t = TpuNode(make_tpu_node(chips=0))
+        assert t.boards == []
+        assert not t.is_tpu_node
+        assert not t.has_free_capacity()
+
+    def test_unmodelable_capacity_no_boards(self):
+        t = TpuNode(make_tpu_node(chips=3))
+        assert t.boards == []
+
+    def test_out_of_range_status_annotation_marks_inconsistent(self):
+        ann = annot.status_from_devices(free={}, used={1: {"2x2": 1}})
+        t = TpuNode(make_tpu_node(chips=8, annotations=ann))
+        assert not t.consistent
+        assert not t.has_free_capacity()
+        assert not t.update_geometry_for({constants.tpu_slice_resource("1x1"): 1})

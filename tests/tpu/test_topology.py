@@ -1,0 +1,86 @@
+import pytest
+
+from nos_tpu.tpu.topology import Topology, enumerate_tilings
+
+
+class TestTopology:
+    @pytest.mark.parametrize(
+        "spec,dims,chips",
+        [
+            ("1x1", (1, 1), 1),
+            ("2x4", (2, 4), 8),
+            ("2x2x1", (2, 2, 1), 4),
+            ("4x4x4", (4, 4, 4), 64),
+        ],
+    )
+    def test_parse_and_chips(self, spec, dims, chips):
+        t = Topology(spec)
+        assert t.dims == dims
+        assert t.chips == chips
+        assert str(t) == spec
+
+    @pytest.mark.parametrize("bad", ["", "x", "2x", "0x2", "ax2", "2x-1"])
+    def test_invalid_specs(self, bad):
+        with pytest.raises(ValueError):
+            Topology(bad)
+
+    def test_orientations(self):
+        assert Topology("1x2").orientations() == [(1, 2), (2, 1)]
+        assert Topology("2x2").orientations() == [(2, 2)]
+        assert len(Topology("1x2x1").orientations()) == 3
+
+
+class TestEnumerateTilings:
+    def test_v5e_board_full_search_space(self):
+        geos = enumerate_tilings("2x4", ("1x1", "1x2", "2x2", "2x4"))
+        keys = {tuple(sorted(g.items())) for g in geos}
+        # Exact multiset tilings of a 2x4 grid by 1x1/1x2 (either
+        # orientation)/2x2/2x4 rectangles.
+        expected = {
+            (("2x4", 1),),
+            (("2x2", 2),),
+            (("1x2", 2), ("2x2", 1)),
+            (("1x1", 2), ("1x2", 1), ("2x2", 1)),
+            (("1x1", 4), ("2x2", 1)),
+            (("1x2", 4),),
+            (("1x1", 2), ("1x2", 3)),
+            (("1x1", 4), ("1x2", 2)),
+            (("1x1", 6), ("1x2", 1)),
+            (("1x1", 8),),
+        }
+        assert keys == expected
+
+    def test_every_tiling_covers_all_chips(self):
+        for g in enumerate_tilings("2x4", ("1x1", "1x2", "2x2", "2x4")):
+            chips = sum(Topology(p).chips * n for p, n in g.items())
+            assert chips == 8
+
+    def test_fewest_slices_first_ordering(self):
+        geos = enumerate_tilings("2x4", ("1x1", "1x2", "2x2", "2x4"))
+        counts = [sum(g.values()) for g in geos]
+        assert counts == sorted(counts)
+        assert geos[0] == {"2x4": 1}
+
+    def test_3d_v4_board(self):
+        geos = enumerate_tilings("2x2x1", ("1x1x1", "1x2x1", "2x2x1"))
+        keys = {tuple(sorted(g.items())) for g in geos}
+        assert keys == {
+            (("2x2x1", 1),),
+            (("1x2x1", 2),),
+            (("1x1x1", 2), ("1x2x1", 1)),
+            (("1x1x1", 4),),
+        }
+
+    def test_orientation_matters_for_coverage(self):
+        # A 1x2 domino must be placeable along both axes: a 2x2 grid is
+        # tileable by two dominoes in two ways but yields ONE geometry.
+        geos = enumerate_tilings("2x2", ("1x2",))
+        assert geos == ({"1x2": 2},)
+
+    def test_rank_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            enumerate_tilings("2x4", ("1x1x1",))
+
+    def test_non_tiling_shapes_yield_nothing(self):
+        # 2x2 squares cannot exactly tile 2x3.
+        assert enumerate_tilings("2x3", ("2x2",)) == ()

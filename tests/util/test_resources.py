@@ -1,0 +1,65 @@
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+from nos_tpu.util import resources as res
+
+V5E = "tpu-v5-lite-podslice"
+
+
+class TestPodRequest:
+    def test_sum_of_containers(self):
+        pod = Pod(
+            metadata=ObjectMeta(name="p"),
+            spec=PodSpec(
+                containers=[
+                    Container(requests={"cpu": 1, constants.RESOURCE_TPU: 4}),
+                    Container(requests={"cpu": 2}),
+                ]
+            ),
+        )
+        assert res.compute_pod_request(pod) == {"cpu": 3, constants.RESOURCE_TPU: 4}
+
+    def test_init_containers_take_max(self):
+        pod = Pod(
+            metadata=ObjectMeta(name="p"),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 1})],
+                init_containers=[Container(requests={"cpu": 4, "memory": 8})],
+            ),
+        )
+        assert res.compute_pod_request(pod) == {"cpu": 4, "memory": 8}
+
+
+class TestTpuChips:
+    def test_plain_and_sliced_sum(self):
+        req = {
+            constants.RESOURCE_TPU: 2,
+            constants.tpu_slice_resource("2x2"): 1,
+            constants.tpu_slice_resource("2x2x1"): 2,
+            "cpu": 4,
+        }
+        assert res.tpu_chips_in(req) == 2 + 4 + 8
+
+    def test_aggregate_injection(self):
+        out = res.with_aggregate_tpu_chips({constants.RESOURCE_TPU: 4})
+        assert out[constants.RESOURCE_TPU_CHIPS] == 4
+
+    def test_no_tpu_no_aggregate(self):
+        assert constants.RESOURCE_TPU_CHIPS not in res.with_aggregate_tpu_chips({"cpu": 1})
+
+
+class TestNormalize:
+    def test_exact_profile(self):
+        out = res.normalize_tpu_request({constants.RESOURCE_TPU: 8}, V5E)
+        assert out == {constants.tpu_slice_resource("2x4"): 1}
+
+    def test_rounds_up(self):
+        out = res.normalize_tpu_request({constants.RESOURCE_TPU: 3}, V5E)
+        assert out == {constants.tpu_slice_resource("2x2"): 1}
+
+    def test_oversized_request_passes_through(self):
+        req = {constants.RESOURCE_TPU: 16}
+        assert res.normalize_tpu_request(req, V5E) == req
+
+    def test_slice_request_untouched(self):
+        req = {constants.tpu_slice_resource("2x2"): 2}
+        assert res.normalize_tpu_request(req, V5E) == req
