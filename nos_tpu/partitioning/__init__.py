@@ -1,0 +1,7 @@
+"""Dynamic partitioning engine.
+
+Mirror of reference internal/partitioning/ (SURVEY.md §2.2): a mode-agnostic
+core (Planner / Actuator / Snapshot / SliceTracker / ClusterState) bound to
+concrete strategies (tpu here; the reference's mig/mps actuation styles both
+fit the same Partitioner seam).
+"""

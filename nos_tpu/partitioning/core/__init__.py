@@ -1,0 +1,26 @@
+from nos_tpu.partitioning.core.partition_state import (
+    BoardPartitioning,
+    NodePartitioning,
+    PartitioningPlan,
+    PartitioningState,
+    partitioning_state_equal,
+)
+from nos_tpu.partitioning.core.state import ClusterState
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.core.tracker import SliceTracker
+from nos_tpu.partitioning.core.planner import Planner
+from nos_tpu.partitioning.core.actuator import Actuator
+
+__all__ = [
+    "Actuator",
+    "BoardPartitioning",
+    "ClusterSnapshot",
+    "ClusterState",
+    "NodePartitioning",
+    "PartitioningPlan",
+    "PartitioningState",
+    "Planner",
+    "SliceTracker",
+    "SnapshotNode",
+    "partitioning_state_equal",
+]

@@ -1,0 +1,58 @@
+"""Actuator: applies a partitioning plan through a mode-specific Partitioner.
+
+Reference internal/partitioning/core/actuator.go:39-66: diff desired vs
+current PartitioningState, skip when equal or empty, otherwise call the
+mode's Partitioner.ApplyPartitioning per changed node.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from nos_tpu.partitioning.core.partition_state import (
+    NodePartitioning,
+    PartitioningPlan,
+    PartitioningState,
+    _node_key,
+    partitioning_state_equal,
+)
+
+log = logging.getLogger("nos_tpu.partitioning")
+
+
+class Partitioner(Protocol):
+    """Mode-specific actuation seam: the reference binds it to MIG
+    (annotations → migagent) and MPS (device-plugin ConfigMap + label flip);
+    the TPU mode uses the annotation → tpuagent style."""
+
+    def apply_partitioning(
+        self, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None: ...
+
+
+class Actuator:
+    def __init__(self, partitioner: Partitioner) -> None:
+        self.partitioner = partitioner
+
+    def apply(
+        self,
+        current: PartitioningState,
+        plan: PartitioningPlan,
+    ) -> bool:
+        """Returns True when anything was actuated."""
+        desired = plan.desired_state
+        if not desired:
+            log.debug("actuator: empty desired state, skipping")
+            return False
+        if partitioning_state_equal(current, desired):
+            log.debug("actuator: desired == current, skipping")
+            return False
+        applied = False
+        for node_name, node_partitioning in sorted(desired.items()):
+            if _node_key(current.get(node_name, NodePartitioning())) == _node_key(
+                node_partitioning
+            ):
+                continue  # this node already matches
+            self.partitioner.apply_partitioning(node_name, plan.id, node_partitioning)
+            applied = True
+        return applied

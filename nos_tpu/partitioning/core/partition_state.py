@@ -1,0 +1,54 @@
+"""Partitioning state types.
+
+Reference internal/partitioning/state/partitioning.go:24-56:
+GPUPartitioning{GPUIndex, Resources} → BoardPartitioning;
+NodePartitioning{GPUs} → NodePartitioning{boards};
+PartitioningState = map[nodeName]NodePartitioning with unordered equality.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from nos_tpu.kube.objects import ResourceList
+
+
+@dataclass
+class BoardPartitioning:
+    board_index: int
+    resources: ResourceList = field(default_factory=dict)  # slice resource → qty
+
+
+@dataclass
+class NodePartitioning:
+    boards: List[BoardPartitioning] = field(default_factory=list)
+
+
+PartitioningState = Dict[str, NodePartitioning]
+
+
+@dataclass
+class PartitioningPlan:
+    desired_state: PartitioningState
+    id: str
+
+
+def _node_key(np: NodePartitioning) -> tuple:
+    return tuple(
+        sorted(
+            (b.board_index, tuple(sorted(b.resources.items())))
+            for b in np.boards
+            if b.resources
+        )
+    )
+
+
+def partitioning_state_equal(a: PartitioningState, b: PartitioningState) -> bool:
+    """Unordered equality, ignoring empty board entries."""
+    keys = set(a) | set(b)
+    for k in keys:
+        a_np = a.get(k, NodePartitioning())
+        b_np = b.get(k, NodePartitioning())
+        if _node_key(a_np) != _node_key(b_np):
+            return False
+    return True

@@ -1,0 +1,129 @@
+"""Planner: the simulate-before-actuate optimization loop.
+
+Reference internal/partitioning/core/planner.go:67-153. For each candidate
+node: fork the snapshot, re-carve geometry toward the still-lacking slices,
+simulation-schedule each pending pod against the forked node with the real
+scheduler framework (PreFilter + Filter, planner.go:178-207), and commit the
+fork only if at least one pod landed — otherwise revert. A cheap
+lacking-slices shortcut (planner.go:155-175) avoids the framework run when
+the cluster still cannot serve the pod at all.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Iterable, List
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.partitioning.core.partition_state import PartitioningState
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.core.tracker import SliceTracker
+from nos_tpu.scheduler.framework import CycleState, Framework
+from nos_tpu.util import resources as res
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.tpu.topology import Topology
+
+log = logging.getLogger("nos_tpu.partitioning")
+
+
+def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
+    """Priority first, then smallest slice request, then namespace/name
+    (reference core/util.go:34-71): high-priority pods get first pick and
+    small slices pack tighter."""
+
+    def smallest_slice_chips(pod: Pod) -> int:
+        request = res.compute_pod_request(pod)
+        chips = [
+            Topology(constants.tpu_slice_topology(name)).chips
+            for name in request
+            if constants.is_tpu_slice_resource(name)
+        ]
+        plain = int(request.get(constants.RESOURCE_TPU, 0))
+        if plain:
+            chips.append(plain)
+        return min(chips) if chips else 0
+
+    return sorted(
+        pods,
+        key=lambda p: (
+            -p.spec.priority,
+            smallest_slice_chips(p),
+            p.metadata.namespace,
+            p.metadata.name,
+        ),
+    )
+
+
+class Planner:
+    def __init__(self, framework: Framework) -> None:
+        self.framework = framework
+
+    def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
+        tracker = SliceTracker(snapshot, pending_pods)
+        if tracker.empty:
+            # Nothing is lacking — current geometry already serves every
+            # pending pod (planner.go:80-83).
+            return snapshot.partitioning_state()
+
+        candidates = sort_candidate_pods(pending_pods)
+        for node_name in snapshot.get_candidate_nodes():
+            if tracker.empty:
+                break
+            node = snapshot.get_node(node_name)
+            accelerator = getattr(node.partitionable, "accelerator", "")
+            snapshot.fork()
+            changed = node.partitionable.update_geometry_for(
+                tracker.lacking_totals(accelerator)
+            )
+            if not changed:
+                snapshot.revert()
+                continue
+            added_any = False
+            for pod in candidates:
+                if pod not in tracker:
+                    continue
+                if self._try_add_pod(snapshot, node_name, pod):
+                    tracker.remove(pod)
+                    added_any = True
+            if added_any:
+                snapshot.commit()
+                log.info("planner: node %s re-carved for pending pods", node_name)
+            else:
+                snapshot.revert()
+        return snapshot.partitioning_state()
+
+    # ------------------------------------------------------------------
+
+    def _try_add_pod(self, snapshot: ClusterSnapshot, node_name: str, pod: Pod) -> bool:
+        # Cheap shortcut: if the cluster still lacks slices for this pod,
+        # no point running the scheduler simulation (planner.go:155-175).
+        if snapshot.get_lacking_slices(pod):
+            return False
+        if not self._can_schedule(snapshot, node_name, pod):
+            return False
+        return snapshot.add_pod(node_name, pod)
+
+    def _can_schedule(self, snapshot: ClusterSnapshot, node_name: str, pod: Pod) -> bool:
+        """Run the real scheduler plugins against the forked node view
+        (planner.go:178-207) so the plan only contains placements the real
+        scheduler would accept."""
+        node = snapshot.get_node(node_name)
+        accelerator = getattr(node.partitionable, "accelerator", "")
+        sim_pod = self._simulation_pod(snapshot, pod, accelerator)
+        state = CycleState()
+        status = self.framework.run_pre_filter_plugins(state, sim_pod)
+        if not status.success:
+            return False
+        status = self.framework.run_filter_plugins(state, sim_pod, node.sim_node_info())
+        return status.success
+
+    @staticmethod
+    def _simulation_pod(snapshot: ClusterSnapshot, pod: Pod, accelerator: str) -> Pod:
+        """Pod with its TPU request normalized to the candidate node's own
+        generation, matching the slice-denominated allocatable of the
+        simulated node view."""
+        sim = pod.deepcopy()
+        for container in sim.spec.containers:
+            container.requests = snapshot.normalize_request(container.requests, accelerator)
+        for container in sim.spec.init_containers:
+            container.requests = snapshot.normalize_request(container.requests, accelerator)
+        return sim

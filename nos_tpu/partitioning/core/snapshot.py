@@ -1,0 +1,185 @@
+"""Cluster snapshot with fork/commit/revert — the planner's working copy.
+
+Reference internal/partitioning/core/snapshot.go:43-190: copy-on-write over
+map[nodeName]PartitionableNode; GetLackingSlices(pod) = pod request minus
+cluster-wide free resources; GetCandidateNodes = nodes with free capacity
+sorted by name.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.partitioning.core.partition_state import (
+    BoardPartitioning,
+    NodePartitioning,
+    PartitioningState,
+)
+from nos_tpu.scheduler.framework import NodeInfo
+from nos_tpu.tpu.known import profile_for_chips
+from nos_tpu.util import resources as res
+
+
+@dataclass
+class SnapshotNode:
+    """A partitionable node + the pods scheduled onto it."""
+
+    partitionable: object  # PartitionableNode protocol (e.g. tpu.TpuNode)
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.partitionable.name
+
+    def sim_node_info(self) -> NodeInfo:
+        """NodeInfo whose allocatable reflects the (possibly re-carved)
+        geometry — what the embedded scheduler framework filters against."""
+        return NodeInfo(node=self.partitionable.to_sim_node(), pods=list(self.pods))
+
+    def add_pod(self, pod: Pod) -> bool:
+        if not self.partitionable.add_pod(pod):
+            return False
+        self.pods.append(pod)
+        return True
+
+
+class ClusterSnapshot:
+    def __init__(self, nodes: Dict[str, SnapshotNode]) -> None:
+        self._nodes = nodes
+        self._backup: Optional[Dict[str, SnapshotNode]] = None
+
+    # ------------------------------------------------------ fork/commit
+
+    def fork(self) -> None:
+        if self._backup is not None:
+            raise RuntimeError("snapshot already forked")
+        self._backup = copy.deepcopy(self._nodes)
+
+    def commit(self) -> None:
+        self._backup = None
+
+    def revert(self) -> None:
+        if self._backup is None:
+            raise RuntimeError("snapshot not forked")
+        self._nodes = self._backup
+        self._backup = None
+
+    # --------------------------------------------------------- queries
+
+    def get_node(self, name: str) -> Optional[SnapshotNode]:
+        return self._nodes.get(name)
+
+    def get_nodes(self) -> Dict[str, SnapshotNode]:
+        return self._nodes
+
+    def accelerators(self) -> List[str]:
+        return sorted(
+            {
+                n.partitionable.accelerator
+                for n in self._nodes.values()
+                if getattr(n.partitionable, "accelerator", "")
+            }
+        )
+
+    def get_candidate_nodes(self) -> List[str]:
+        """Nodes whose geometry could still change or serve slices, sorted by
+        name for determinism (snapshot.go:119-130)."""
+        return sorted(
+            name
+            for name, node in self._nodes.items()
+            if node.partitionable.has_free_capacity()
+        )
+
+    def free_slice_resources(self) -> ResourceList:
+        """Cluster-wide free slices as a ResourceList."""
+        total: ResourceList = {}
+        for node in self._nodes.values():
+            for profile, qty in node.partitionable.free_slices().items():
+                name = constants.tpu_slice_resource(profile)
+                total[name] = total.get(name, 0) + qty
+        return total
+
+    @staticmethod
+    def is_tracked_resource(name: str) -> bool:
+        """Resources the partitioner is responsible for serving."""
+        return constants.is_tpu_slice_resource(name) or name == constants.RESOURCE_TPU
+
+    def normalize_request(
+        self, request: ResourceList, accelerator: Optional[str] = None
+    ) -> ResourceList:
+        """Normalize a plain-chip request to a slice request.
+
+        With `accelerator` (the per-candidate-node case) the node's own
+        generation decides the profile. Without it, plain chips are kept
+        plain — in a mixed-generation cluster there is no single right
+        profile, and picking one deadlocks pods against nodes of the other
+        generation."""
+        if accelerator:
+            return res.normalize_tpu_request(request, accelerator)
+        return dict(request)
+
+    def take_from_pool(self, pool: ResourceList, request: ResourceList) -> ResourceList:
+        """Serve `request`'s tracked resources from `pool` (mutating it);
+        returns what remains lacking. Plain-chip requests are served by any
+        accelerator whose matching profile still has free slices."""
+        lacking: ResourceList = {}
+        for name, qty in request.items():
+            if constants.is_tpu_slice_resource(name):
+                take = min(qty, pool.get(name, 0))
+                pool[name] = pool.get(name, 0) - take
+                if qty - take > 0:
+                    lacking[name] = qty - take
+        plain = int(request.get(constants.RESOURCE_TPU, 0))
+        if plain > 0:
+            served = False
+            for accelerator in self.accelerators():
+                profile = profile_for_chips(plain, accelerator)
+                if profile is None:
+                    continue
+                name = constants.tpu_slice_resource(profile)
+                if pool.get(name, 0) >= 1:
+                    pool[name] -= 1
+                    served = True
+                    break
+            if not served:
+                lacking[constants.RESOURCE_TPU] = plain
+        return lacking
+
+    def get_lacking_slices(self, pod: Pod) -> ResourceList:
+        """Tracked resources the pod needs beyond cluster-wide free slices
+        (snapshot.go:132-165). Only slice/chip resources count — everything
+        else is the vanilla scheduler's problem. Plain-chip lack is reported
+        as ``google.com/tpu`` since the serving profile depends on which
+        node ends up carved."""
+        request = res.compute_pod_request(pod)
+        pool = self.free_slice_resources()
+        return self.take_from_pool(pool, request)
+
+    # -------------------------------------------------------- mutation
+
+    def add_pod(self, node_name: str, pod: Pod) -> bool:
+        node = self._nodes.get(node_name)
+        if node is None:
+            return False
+        return node.add_pod(pod)
+
+    # ------------------------------------------------------ projection
+
+    def partitioning_state(self) -> PartitioningState:
+        out: PartitioningState = {}
+        for name, node in self._nodes.items():
+            boards = [
+                BoardPartitioning(
+                    board_index=index,
+                    resources={
+                        constants.tpu_slice_resource(profile): qty
+                        for profile, qty in geometry.items()
+                    },
+                )
+                for index, geometry in sorted(node.partitionable.geometry().items())
+            ]
+            out[name] = NodePartitioning(boards=boards)
+        return out

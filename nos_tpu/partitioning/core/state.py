@@ -1,0 +1,108 @@
+"""ClusterState: the partitioner's mutex-guarded cache of cluster topology.
+
+Reference internal/partitioning/state/state.go:29-222: NodeInfo per node,
+pod→node bindings, and a count of nodes per partitioning kind so controllers
+can cheaply check whether a mode is enabled at all
+(partitioner_controller.go:83 IsPartitioningEnabled).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from nos_tpu.api.v1alpha1 import labels as labels_api
+from nos_tpu.kube.objects import Node, Pod
+from nos_tpu.scheduler.framework import NodeInfo
+
+
+class ClusterState:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, NodeInfo] = {}
+        self._bindings: Dict[str, str] = {}  # "ns/name" -> node name
+        self._kind_counts: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ updates
+
+    def update_node(self, node: Node, pods: List[Pod]) -> None:
+        with self._lock:
+            old = self._nodes.get(node.metadata.name)
+            if old is not None:
+                self._remove_kind(old.node)
+            info = NodeInfo(node=node.deepcopy())
+            for pod in pods:
+                info.add_pod(pod.deepcopy())
+                self._bindings[pod.namespaced_name] = node.metadata.name
+            self._nodes[node.metadata.name] = info
+            self._add_kind(node)
+
+    def delete_node(self, node_name: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(node_name, None)
+            if info is None:
+                return
+            self._remove_kind(info.node)
+            self._bindings = {
+                k: v for k, v in self._bindings.items() if v != node_name
+            }
+
+    def update_pod_usage(self, pod: Pod) -> None:
+        """Track a pod's binding on node events (reference
+        gpupartitioner/pod_controller.go:47-112 UpdateUsage)."""
+        with self._lock:
+            key = pod.namespaced_name
+            node_name = pod.spec.node_name
+            previous = self._bindings.get(key)
+            if previous and previous != node_name and previous in self._nodes:
+                self._nodes[previous].remove_pod(pod)
+                del self._bindings[key]
+            if not node_name or node_name not in self._nodes:
+                return
+            info = self._nodes[node_name]
+            info.remove_pod(pod)  # replace stale copy
+            if pod.status.phase in ("Succeeded", "Failed"):
+                self._bindings.pop(key, None)
+                return
+            info.add_pod(pod.deepcopy())
+            self._bindings[key] = node_name
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self._lock:
+            key = pod.namespaced_name
+            node_name = self._bindings.pop(key, None)
+            if node_name and node_name in self._nodes:
+                self._nodes[node_name].remove_pod(pod)
+
+    # ------------------------------------------------------------ queries
+
+    def get_node(self, name: str) -> Optional[NodeInfo]:
+        with self._lock:
+            info = self._nodes.get(name)
+            if info is None:
+                return None
+            return NodeInfo(node=info.node.deepcopy(), pods=[p.deepcopy() for p in info.pods])
+
+    def get_nodes(self) -> Dict[str, NodeInfo]:
+        with self._lock:
+            return {
+                name: NodeInfo(
+                    node=info.node.deepcopy(), pods=[p.deepcopy() for p in info.pods]
+                )
+                for name, info in self._nodes.items()
+            }
+
+    def is_partitioning_enabled(self, kind: str) -> bool:
+        with self._lock:
+            return self._kind_counts.get(kind, 0) > 0
+
+    # ------------------------------------------------------------ helpers
+
+    def _add_kind(self, node: Node) -> None:
+        kind = labels_api.partitioning_kind(node)
+        if kind:
+            self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
+
+    def _remove_kind(self, node: Node) -> None:
+        kind = labels_api.partitioning_kind(node)
+        if kind and self._kind_counts.get(kind, 0) > 0:
+            self._kind_counts[kind] -= 1

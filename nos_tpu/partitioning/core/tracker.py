@@ -1,0 +1,67 @@
+"""SliceTracker: requested + lacking slices per pending pod.
+
+Reference internal/partitioning/core/tracker.go:26-88. Remove(pod)
+decrements as pods get placed during planning, so the planner knows when
+every lacking slice is served.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.tpu.known import profile_for_chips
+from nos_tpu.util import resources as res
+
+
+def _pod_key(pod: Pod) -> str:
+    return pod.namespaced_name
+
+
+class SliceTracker:
+    def __init__(self, snapshot, pods: Iterable[Pod]) -> None:
+        """Pods draw from ONE shared free pool, sequentially: if two pods
+        each want the single free 2x2 slice, the second one is lacking.
+        (Per-pod computation against the full pool — as in the reference —
+        lets N pods hide behind one free slice and deadlocks the planner.)
+        """
+        self._lacking: Dict[str, ResourceList] = {}
+        pool = snapshot.free_slice_resources()
+        for pod in pods:
+            lacking = snapshot.take_from_pool(pool, res.compute_pod_request(pod))
+            if lacking:
+                self._lacking[_pod_key(pod)] = lacking
+
+    @property
+    def empty(self) -> bool:
+        return not self._lacking
+
+    def __contains__(self, pod: Pod) -> bool:
+        return _pod_key(pod) in self._lacking
+
+    def pods_with_lacking_slices(self) -> List[str]:
+        return sorted(self._lacking)
+
+    def lacking_totals(self, accelerator: str = "") -> ResourceList:
+        """Aggregate lacking resources. With `accelerator`, each pod's
+        plain-chip lack is converted to that generation's slice profile
+        (per pod — two 4-chip pods are two 2x2 slices, not one 2x4), so a
+        candidate node of that generation knows what to carve."""
+        total: ResourceList = {}
+        for lacking in self._lacking.values():
+            entry = dict(lacking)
+            plain = int(entry.pop(constants.RESOURCE_TPU, 0))
+            if plain > 0 and accelerator:
+                profile = profile_for_chips(plain, accelerator)
+                if profile is not None:
+                    name = constants.tpu_slice_resource(profile)
+                    entry[name] = entry.get(name, 0) + 1
+                # None: bigger than any single-board profile — multi-host
+                # gang territory, nothing a board carve can serve.
+            elif plain > 0:
+                entry[constants.RESOURCE_TPU] = plain
+            total = res.sum_resources(total, entry)
+        return total
+
+    def remove(self, pod: Pod) -> None:
+        self._lacking.pop(_pod_key(pod), None)

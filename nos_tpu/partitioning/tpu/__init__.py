@@ -1,0 +1,5 @@
+from nos_tpu.partitioning.tpu.snapshot_taker import TpuSnapshotTaker
+from nos_tpu.partitioning.tpu.partitioner import TpuPartitioner
+from nos_tpu.partitioning.tpu.initializer import TpuNodeInitializer
+
+__all__ = ["TpuNodeInitializer", "TpuPartitioner", "TpuSnapshotTaker"]

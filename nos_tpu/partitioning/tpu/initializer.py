@@ -1,0 +1,62 @@
+"""Virgin-node initializer: first-contact geometry for new TPU nodes.
+
+Reference internal/partitioning/mig/initializer.go:36-87 + node controller
+hook (gpupartitioner/node_controller.go:89-95): a node that just opted into
+partitioning and reports no geometry gets the fewest-slices allowed
+geometry (whole-board slices for TPUs) so its resources become visible to
+the scheduler immediately.
+"""
+from __future__ import annotations
+
+import logging
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Node
+from nos_tpu.partitioning.core.partition_state import (
+    BoardPartitioning,
+    NodePartitioning,
+)
+from nos_tpu.partitioning.tpu.partitioner import TpuPartitioner
+from nos_tpu.tpu.node import TpuNode
+
+log = logging.getLogger("nos_tpu.partitioning.tpu")
+
+
+class TpuNodeInitializer:
+    def __init__(self, partitioner: TpuPartitioner, plan_id_fn) -> None:
+        self.partitioner = partitioner
+        self.plan_id_fn = plan_id_fn
+
+    def is_initialized(self, node: Node) -> bool:
+        """A node is initialized once any spec/status geometry exists
+        (reference core/util.go:76)."""
+        from nos_tpu.api.v1alpha1 import annotations as annot
+
+        spec, status = annot.parse_node_annotations(node.metadata.annotations)
+        return bool(spec or status)
+
+    def init_node_partitioning(self, node: Node) -> bool:
+        tpu_node = TpuNode(node)
+        if not tpu_node.is_tpu_node:
+            return False
+        boards = []
+        changed = False
+        for board in tpu_node.boards:
+            if board.init_geometry():
+                changed = True
+            boards.append(
+                BoardPartitioning(
+                    board_index=board.index,
+                    resources={
+                        constants.tpu_slice_resource(p): q
+                        for p, q in board.geometry.items()
+                    },
+                )
+            )
+        if not changed:
+            return False
+        self.partitioner.apply_partitioning(
+            node.metadata.name, self.plan_id_fn(), NodePartitioning(boards=boards)
+        )
+        log.info("initialized TPU node %s", node.metadata.name)
+        return True

@@ -1,0 +1,55 @@
+"""TPU partitioner: actuation = writing spec annotations + plan id to Nodes.
+
+Reference internal/partitioning/mig/partitioner.go:43-94: ApplyPartitioning
+patches the Node with nos.nebuly.com/spec-gpu-* annotations and
+spec-partitioning-plan=<plan-id>; the node-local agent picks the change up
+from its annotation watch. The TPU agent follows the same contract with
+spec-tpu-* annotations.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.partitioning.core.partition_state import NodePartitioning
+
+log = logging.getLogger("nos_tpu.partitioning.tpu")
+
+
+class TpuPartitioner:
+    def __init__(self, store: KubeStore) -> None:
+        self.store = store
+
+    def apply_partitioning(
+        self, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None:
+        geometries: Dict[int, Dict[str, int]] = {}
+        for board in partitioning.boards:
+            profile_counts: Dict[str, int] = {}
+            for resource, qty in board.resources.items():
+                if constants.is_tpu_slice_resource(resource) and qty > 0:
+                    profile = constants.tpu_slice_topology(resource)
+                    profile_counts[profile] = profile_counts.get(profile, 0) + int(qty)
+            geometries[board.board_index] = profile_counts
+
+        desired = annot.spec_from_geometries(geometries)
+        try:
+            node = self.store.get("Node", node_name)
+        except NotFoundError:
+            log.warning("apply_partitioning: node %s vanished", node_name)
+            return
+        patch: Dict[str, Optional[str]] = annot.strip_spec_annotations(
+            node.metadata.annotations
+        )
+        patch.update(desired)
+        patch[annot.SPEC_PARTITIONING_PLAN] = plan_id
+        self.store.patch_annotations("Node", node_name, "", patch)
+        log.info(
+            "apply_partitioning: node %s plan %s -> %d spec annotations",
+            node_name,
+            plan_id,
+            len(desired),
+        )

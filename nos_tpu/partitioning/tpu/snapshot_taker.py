@@ -1,0 +1,27 @@
+"""TPU snapshot taker: ClusterState → ClusterSnapshot of TPU-managed nodes.
+
+Reference internal/partitioning/mig/snapshot_taker.go:31-53 (snapshot only
+MIG-labeled nodes, building mig.Node from annotations); here nodes labeled
+``nos.nebuly.com/gpu-partitioning=tpu`` become TpuNodes.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from nos_tpu.api.v1alpha1.labels import PartitioningKind, partitioning_kind
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.core.state import ClusterState
+from nos_tpu.tpu.node import TpuNode
+
+
+class TpuSnapshotTaker:
+    def take_snapshot(self, state: ClusterState) -> ClusterSnapshot:
+        nodes: Dict[str, SnapshotNode] = {}
+        for name, info in state.get_nodes().items():
+            if partitioning_kind(info.node) != PartitioningKind.TPU:
+                continue
+            tpu_node = TpuNode(info.node, owned=True)
+            if not tpu_node.is_tpu_node:
+                continue
+            nodes[name] = SnapshotNode(partitionable=tpu_node, pods=list(info.pods))
+        return ClusterSnapshot(nodes)

@@ -1,0 +1,19 @@
+"""Scheduler framework + plugins.
+
+The reference embeds the real kube-scheduler framework twice: once in the
+`scheduler` binary (CapacityScheduling plugin, SURVEY.md §2.4) and once
+in-process inside the gpupartitioner for plan simulation
+(cmd/gpupartitioner/gpupartitioner.go:294-318). This package provides the
+same: a scheduling framework with the PreFilter/Filter/PostFilter/Reserve
+extension points, stock resource-fit filtering, and the nos plugins.
+"""
+
+from nos_tpu.scheduler.framework import (
+    CycleState,
+    Framework,
+    NodeInfo,
+    Status,
+    StatusCode,
+)
+
+__all__ = ["CycleState", "Framework", "NodeInfo", "Status", "StatusCode"]

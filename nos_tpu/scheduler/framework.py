@@ -1,0 +1,228 @@
+"""Minimal scheduler framework: the extension-point contract k8s plugins use.
+
+Shapes mirror k8s.io/kubernetes scheduler framework as used by the reference
+(pkg/scheduler/plugins/capacityscheduling/capacity_scheduling.go:92-96
+implements PreFilter, PreFilterExtensions, PostFilter, Reserve, Unreserve):
+plugins register per extension point, a CycleState dict carries data across
+points within one scheduling cycle, and Status codes signal
+Success/Unschedulable/Error.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Sequence
+
+from nos_tpu.kube.objects import Node, Pod, ResourceList
+from nos_tpu.util import resources as res
+
+
+class StatusCode:
+    SUCCESS = "Success"
+    UNSCHEDULABLE = "Unschedulable"
+    ERROR = "Error"
+
+
+@dataclass
+class Status:
+    code: str = StatusCode.SUCCESS
+    message: str = ""
+    plugin: str = ""
+
+    @property
+    def success(self) -> bool:
+        return self.code == StatusCode.SUCCESS
+
+    @staticmethod
+    def ok() -> "Status":
+        return Status()
+
+    @staticmethod
+    def unschedulable(message: str, plugin: str = "") -> "Status":
+        return Status(StatusCode.UNSCHEDULABLE, message, plugin)
+
+    @staticmethod
+    def error(message: str, plugin: str = "") -> "Status":
+        return Status(StatusCode.ERROR, message, plugin)
+
+
+class CycleState(dict):
+    """Per-scheduling-cycle scratch space shared between extension points."""
+
+
+@dataclass
+class NodeInfo:
+    """A node plus everything scheduled onto it — the framework's unit of
+    placement state (mirrors framework.NodeInfo cached by the reference's
+    ClusterState, internal/partitioning/state/state.go:29-222)."""
+
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.node.metadata.name
+
+    def requested(self) -> ResourceList:
+        total: ResourceList = {}
+        for pod in self.pods:
+            total = res.sum_resources(total, res.compute_pod_request(pod))
+        return total
+
+    def available(self) -> ResourceList:
+        return res.subtract_resources(self.node.status.allocatable, self.requested())
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods.append(pod)
+
+    def remove_pod(self, pod: Pod) -> None:
+        self.pods = [
+            p
+            for p in self.pods
+            if not (
+                p.metadata.namespace == pod.metadata.namespace
+                and p.metadata.name == pod.metadata.name
+            )
+        ]
+
+
+# ---------------------------------------------------------------- plugins
+
+
+class PreFilterPlugin(Protocol):
+    name: str
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status: ...
+
+
+class FilterPlugin(Protocol):
+    name: str
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status: ...
+
+
+class PostFilterPlugin(Protocol):
+    name: str
+
+    def post_filter(
+        self, state: CycleState, pod: Pod, filtered_nodes: Dict[str, Status]
+    ) -> Optional[str]:
+        """Attempt to make the pod schedulable (preemption); returns a
+        nominated node name or None."""
+        ...
+
+
+class ReservePlugin(Protocol):
+    name: str
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status: ...
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None: ...
+
+
+class PermitPlugin(Protocol):
+    name: str
+
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Status: ...
+
+
+class Framework:
+    """Plugin registry + per-extension-point runners."""
+
+    def __init__(
+        self,
+        pre_filter_plugins: Sequence[PreFilterPlugin] = (),
+        filter_plugins: Sequence[FilterPlugin] = (),
+        post_filter_plugins: Sequence[PostFilterPlugin] = (),
+        reserve_plugins: Sequence[ReservePlugin] = (),
+        permit_plugins: Sequence[PermitPlugin] = (),
+    ) -> None:
+        self.pre_filter_plugins = list(pre_filter_plugins)
+        self.filter_plugins = list(filter_plugins)
+        self.post_filter_plugins = list(post_filter_plugins)
+        self.reserve_plugins = list(reserve_plugins)
+        self.permit_plugins = list(permit_plugins)
+
+    def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
+        for p in self.pre_filter_plugins:
+            status = p.pre_filter(state, pod)
+            if not status.success:
+                status.plugin = status.plugin or p.name
+                return status
+        return Status.ok()
+
+    def run_filter_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        for p in self.filter_plugins:
+            status = p.filter(state, pod, node_info)
+            if not status.success:
+                status.plugin = status.plugin or p.name
+                return status
+        return Status.ok()
+
+    def run_post_filter_plugins(
+        self, state: CycleState, pod: Pod, filtered_nodes: Dict[str, Status]
+    ) -> Optional[str]:
+        for p in self.post_filter_plugins:
+            nominated = p.post_filter(state, pod, filtered_nodes)
+            if nominated:
+                return nominated
+        return None
+
+    def run_reserve_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for i, p in enumerate(self.reserve_plugins):
+            status = p.reserve(state, pod, node_name)
+            if not status.success:
+                for done in self.reserve_plugins[:i]:
+                    done.unreserve(state, pod, node_name)
+                status.plugin = status.plugin or p.name
+                return status
+        return Status.ok()
+
+    def run_unreserve_plugins(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for p in self.reserve_plugins:
+            p.unreserve(state, pod, node_name)
+
+    def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for p in self.permit_plugins:
+            status = p.permit(state, pod, node_name)
+            if not status.success:
+                status.plugin = status.plugin or p.name
+                return status
+        return Status.ok()
+
+
+class NodeResourcesFit:
+    """Stock resource-fit filter (the part of the vanilla scheduler the
+    simulation relies on: SURVEY.md §3.2 'NodeResourcesFit sees the
+    partitioned scalar resources')."""
+
+    name = "NodeResourcesFit"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        request = res.compute_pod_request(pod)
+        available = node_info.available()
+        for resource, qty in request.items():
+            if qty > available.get(resource, 0):
+                return Status.unschedulable(
+                    f"insufficient {resource}: requested {qty}, available "
+                    f"{available.get(resource, 0)}",
+                    self.name,
+                )
+        return Status.ok()
+
+
+class NodeSelectorFit:
+    """Node-selector / nodeName filter (enough of the vanilla predicates for
+    simulation fidelity)."""
+
+    name = "NodeSelector"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if pod.spec.node_name and pod.spec.node_name != node_info.name:
+            return Status.unschedulable("pod bound to a different node", self.name)
+        node_labels = node_info.node.metadata.labels
+        for key, value in pod.spec.node_selector.items():
+            if node_labels.get(key) != value:
+                return Status.unschedulable(
+                    f"node selector {key}={value} not satisfied", self.name
+                )
+        return Status.ok()

@@ -102,11 +102,18 @@ class TpuBoard:
         if not wanted:
             return False
 
+        # `wanted` is net of the cluster's existing free slices, so a board
+        # that already holds free slices of a wanted profile must aim for
+        # free + wanted of it — scoring against `wanted` alone would count
+        # its own free slices as new supply and refuse to carve.
         def provided(geometry: Geometry) -> int:
             free_after = geometry_subtract(geometry, self.used)
-            return sum(min(free_after.get(p, 0), n) for p, n in wanted.items())
+            return sum(
+                min(free_after.get(p, 0), self.free.get(p, 0) + n)
+                for p, n in wanted.items()
+            )
 
-        current_score = sum(min(self.free.get(p, 0), n) for p, n in wanted.items())
+        current_score = sum(self.free.get(p, 0) for p in wanted)
         best: Optional[Geometry] = None
         best_score = current_score
         for candidate in allowed_geometries(self.accelerator, self.board_topology):

@@ -23,9 +23,12 @@ from nos_tpu.util import resources as res
 
 
 class TpuNode:
-    def __init__(self, node: Node) -> None:
+    def __init__(self, node: Node, owned: bool = False) -> None:
+        """`owned=True` means the caller hands over a private copy (e.g. the
+        snapshot taker, whose ClusterState read already deep-copied), so the
+        defensive copy here can be skipped."""
         self.name = node.metadata.name
-        self.node = node.deepcopy()
+        self.node = node if owned else node.deepcopy()
         self.accelerator = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
         self.boards: List[TpuBoard] = []
         # False when status annotations reference boards this node cannot

@@ -1,0 +1,70 @@
+"""Shared object builders (reference pkg/test/factory/core_factory.go)."""
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodSpec,
+)
+
+V5E = "tpu-v5-lite-podslice"
+V4 = "tpu-v4-podslice"
+
+
+def build_tpu_node(
+    name="tpu-node",
+    accelerator=V5E,
+    chips=8,
+    topology="2x4",
+    annotations=None,
+    extra_alloc=None,
+    partitioning="tpu",
+):
+    alloc = {constants.RESOURCE_TPU: chips, "cpu": 8, "memory": 128}
+    alloc.update(extra_alloc or {})
+    node_labels = {
+        labels.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+        labels.GKE_TPU_TOPOLOGY_LABEL: topology,
+    }
+    if partitioning:
+        node_labels[labels.PARTITIONING_LABEL] = partitioning
+    return Node(
+        metadata=ObjectMeta(name=name, labels=node_labels, annotations=annotations or {}),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def build_node(name="node", alloc=None):
+    alloc = alloc or {"cpu": 8, "memory": 128}
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def build_pod(name, requests=None, ns="default", priority=0, phase=PodPhase.PENDING, node=""):
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[Container(requests=dict(requests or {}))],
+            priority=priority,
+            node_name=node,
+        ),
+    )
+    pod.status.phase = phase
+    return pod
+
+
+def mark_unschedulable(pod):
+    pod.status.conditions.append(
+        PodCondition(type="PodScheduled", status="False", reason="Unschedulable")
+    )
+    return pod
+
+
+def slice_res(topology):
+    return constants.tpu_slice_resource(topology)

@@ -1,0 +1,109 @@
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import (
+    Actuator,
+    BoardPartitioning,
+    NodePartitioning,
+    PartitioningPlan,
+    partitioning_state_equal,
+)
+from nos_tpu.partitioning.tpu import TpuPartitioner
+
+from tests.factory import build_tpu_node, slice_res
+
+
+class RecordingPartitioner:
+    def __init__(self):
+        self.calls = []
+
+    def apply_partitioning(self, node_name, plan_id, partitioning):
+        self.calls.append((node_name, plan_id, partitioning))
+
+
+def node_partitioning(**resources):
+    return NodePartitioning(boards=[BoardPartitioning(0, dict(resources))])
+
+
+class TestStateEquality:
+    def test_equal_ignores_board_order_and_empties(self):
+        a = {
+            "n1": NodePartitioning(
+                boards=[
+                    BoardPartitioning(1, {slice_res("1x1"): 4}),
+                    BoardPartitioning(0, {slice_res("2x2"): 1}),
+                    BoardPartitioning(2, {}),
+                ]
+            )
+        }
+        b = {
+            "n1": NodePartitioning(
+                boards=[
+                    BoardPartitioning(0, {slice_res("2x2"): 1}),
+                    BoardPartitioning(1, {slice_res("1x1"): 4}),
+                ]
+            )
+        }
+        assert partitioning_state_equal(a, b)
+
+    def test_not_equal(self):
+        a = {"n1": node_partitioning(**{slice_res("2x2"): 1})}
+        b = {"n1": node_partitioning(**{slice_res("2x2"): 2})}
+        assert not partitioning_state_equal(a, b)
+
+
+class TestActuator:
+    def test_skips_when_equal(self):
+        p = RecordingPartitioner()
+        state = {"n1": node_partitioning(**{slice_res("2x2"): 2})}
+        assert not Actuator(p).apply(state, PartitioningPlan(state, "1"))
+        assert p.calls == []
+
+    def test_skips_empty_desired(self):
+        p = RecordingPartitioner()
+        assert not Actuator(p).apply({}, PartitioningPlan({}, "1"))
+
+    def test_applies_only_changed_nodes(self):
+        p = RecordingPartitioner()
+        current = {
+            "same": node_partitioning(**{slice_res("2x2"): 2}),
+            "changed": node_partitioning(**{slice_res("2x2"): 2}),
+        }
+        desired = {
+            "same": node_partitioning(**{slice_res("2x2"): 2}),
+            "changed": node_partitioning(**{slice_res("1x1"): 8}),
+        }
+        assert Actuator(p).apply(current, PartitioningPlan(desired, "42"))
+        assert [(c[0], c[1]) for c in p.calls] == [("changed", "42")]
+
+
+class TestTpuPartitioner:
+    def test_writes_spec_annotations_and_plan_id(self):
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1"))
+        TpuPartitioner(store).apply_partitioning(
+            "n1", "123", node_partitioning(**{slice_res("2x2"): 2})
+        )
+        node = store.get("Node", "n1")
+        assert node.metadata.annotations[annot.SPEC_PARTITIONING_PLAN] == "123"
+        spec, _ = annot.parse_node_annotations(node.metadata.annotations)
+        assert annot.spec_geometries(spec) == {0: {"2x2": 2}}
+
+    def test_replaces_previous_spec(self):
+        store = KubeStore()
+        store.create(
+            build_tpu_node(
+                name="n1", annotations=annot.spec_from_geometries({0: {"2x4": 1}})
+            )
+        )
+        TpuPartitioner(store).apply_partitioning(
+            "n1", "124", node_partitioning(**{slice_res("1x1"): 8})
+        )
+        spec, _ = annot.parse_node_annotations(
+            store.get("Node", "n1").metadata.annotations
+        )
+        assert annot.spec_geometries(spec) == {0: {"1x1": 8}}
+
+    def test_missing_node_tolerated(self):
+        TpuPartitioner(KubeStore()).apply_partitioning(
+            "ghost", "1", node_partitioning(**{slice_res("1x1"): 1})
+        )

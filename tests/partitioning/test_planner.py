@@ -1,0 +1,172 @@
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.partitioning.core import ClusterSnapshot, Planner, SnapshotNode
+from nos_tpu.partitioning.core.planner import sort_candidate_pods
+from nos_tpu.scheduler.framework import (
+    Framework,
+    NodeResourcesFit,
+    NodeSelectorFit,
+    Status,
+)
+from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+def make_framework():
+    return Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+
+
+def snapshot_of(*nodes, pods_by_node=None):
+    pods_by_node = pods_by_node or {}
+    return ClusterSnapshot(
+        {
+            n.metadata.name: SnapshotNode(
+                partitionable=TpuNode(n), pods=pods_by_node.get(n.metadata.name, [])
+            )
+            for n in nodes
+        }
+    )
+
+
+class TestSortCandidatePods:
+    def test_priority_desc_then_smallest_slice(self):
+        small = build_pod("small", {slice_res("1x1"): 1})
+        big = build_pod("big", {slice_res("2x4"): 1})
+        vip = build_pod("vip", {slice_res("2x4"): 1}, priority=100)
+        assert [p.metadata.name for p in sort_candidate_pods([big, small, vip])] == [
+            "vip",
+            "small",
+            "big",
+        ]
+
+    def test_name_tiebreak(self):
+        a = build_pod("a", {slice_res("1x1"): 1})
+        b = build_pod("b", {slice_res("1x1"): 1})
+        assert [p.metadata.name for p in sort_candidate_pods([b, a])] == ["a", "b"]
+
+
+class TestPlanner:
+    def test_carves_virgin_node_for_pending_pod(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) >= 1
+        # the pod was placed in simulation
+        assert [p.metadata.name for p in snap.get_node("n1").pods] == ["p"]
+
+    def test_no_lacking_returns_current_state(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        assert {b.board_index: b.resources for b in plan["n1"].boards} == {
+            0: {slice_res("2x2"): 2}
+        }
+        # nothing was simulated-placed: geometry already served the pod
+        assert snap.get_node("n1").pods == []
+
+    def test_reverts_when_no_pod_fits(self):
+        # Node can be carved, but the pod's cpu request exceeds the node.
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {slice_res("2x2"): 1, "cpu": 999})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        assert {b.board_index: b.resources for b in plan["n1"].boards} == {0: {}}
+
+    def test_plain_chip_pod_normalized_and_placed(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {constants.RESOURCE_TPU: 8})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0] == {slice_res("2x4"): 1}
+
+    def test_multiple_pods_packed_on_one_node(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pods = [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(8)]
+        plan = Planner(make_framework()).plan(snap, [pods[0], *pods[1:]])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0] == {slice_res("1x1"): 8}
+        assert len(snap.get_node("n1").pods) == 8
+
+    def test_spreads_over_two_nodes(self):
+        snap = snapshot_of(build_tpu_node(name="n1"), build_tpu_node(name="n2"))
+        pods = [build_pod(f"p{i}", {slice_res("2x4"): 1}) for i in range(2)]
+        plan = Planner(make_framework()).plan(snap, pods)
+        for name in ("n1", "n2"):
+            geometry = {b.board_index: b.resources for b in plan[name].boards}
+            assert geometry[0] == {slice_res("2x4"): 1}
+
+    def test_high_priority_pod_wins_contention(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        low = build_pod("low", {slice_res("2x4"): 1}, priority=0)
+        high = build_pod("high", {slice_res("2x4"): 1}, priority=10)
+        Planner(make_framework()).plan(snap, [low, high])
+        assert [p.metadata.name for p in snap.get_node("n1").pods] == ["high"]
+
+    def test_used_slices_preserved(self):
+        ann = annot.status_from_devices(free={}, used={0: {"2x2": 1}})
+        running = build_pod("running", {slice_res("2x2"): 1}, node="n1")
+        snap = snapshot_of(
+            build_tpu_node(name="n1", annotations=ann),
+            pods_by_node={"n1": [running]},
+        )
+        pod = build_pod("p", {slice_res("1x1"): 2})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) == 1
+        assert geometry[0].get(slice_res("1x1"), 0) >= 2
+
+    def test_unschedulable_filter_blocks_placement(self):
+        class RejectAll:
+            name = "RejectAll"
+
+            def filter(self, state, pod, node_info):
+                return Status.unschedulable("no", self.name)
+
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        fw = Framework(filter_plugins=[RejectAll()])
+        plan = Planner(fw).plan(snap, [pod])
+        assert {b.board_index: b.resources for b in plan["n1"].boards} == {0: {}}
+        assert snap.get_node("n1").pods == []
+
+
+class TestPlannerRegressions:
+    """Deadlock scenarios found in review: shared free pool, net-lacking
+    double count, and mixed-generation normalization."""
+
+    def test_two_pods_sharing_one_free_slice_get_second_carved(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        pods = [build_pod(f"p{i}", {slice_res("2x2"): 1}) for i in range(2)]
+        plan = Planner(make_framework()).plan(snap, pods)
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) == 2
+        # p0 is served by the pre-existing free slice (the real scheduler
+        # places it); only p1 needed planning.
+        assert [p.metadata.name for p in snap.get_node("n1").pods] == ["p1"]
+
+    def test_pod_wanting_more_than_net_delta_triggers_carve(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        pod = build_pod("p", {slice_res("2x2"): 2})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) == 2
+        assert len(snap.get_node("n1").pods) == 1
+
+    def test_mixed_generation_cluster_serves_plain_chips(self):
+        from nos_tpu.api.v1alpha1 import annotations as annot_api
+        from tests.factory import V4
+        # v4 node fully used; virgin v5e node must serve the 4-chip pod.
+        full = annot_api.status_from_devices(free={}, used={0: {"2x2x1": 1}})
+        snap = snapshot_of(
+            build_tpu_node(name="v4-full", accelerator=V4, chips=4, annotations=full),
+            build_tpu_node(name="v5e-virgin"),
+        )
+        pod = build_pod("p", {constants.RESOURCE_TPU: 4})
+        plan = Planner(make_framework()).plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["v5e-virgin"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) >= 1
+        assert [p.metadata.name for p in snap.get_node("v5e-virgin").pods] == ["p"]

@@ -1,0 +1,145 @@
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.partitioning.core import ClusterSnapshot, ClusterState, SliceTracker, SnapshotNode
+from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import build_pod, build_tpu_node, build_node, slice_res
+
+
+def snapshot_of(*nodes, pods_by_node=None):
+    pods_by_node = pods_by_node or {}
+    out = {}
+    for n in nodes:
+        t = TpuNode(n)
+        out[n.metadata.name] = SnapshotNode(
+            partitionable=t, pods=pods_by_node.get(n.metadata.name, [])
+        )
+    return ClusterSnapshot(out)
+
+
+class TestForkCommitRevert:
+    def test_revert_restores_state(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        snap.fork()
+        node = snap.get_node("n1")
+        assert node.partitionable.update_geometry_for({slice_res("2x2"): 2})
+        snap.revert()
+        assert snap.get_node("n1").partitionable.geometry() == {0: {}}
+
+    def test_commit_keeps_state(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        snap.fork()
+        snap.get_node("n1").partitionable.update_geometry_for({slice_res("2x2"): 2})
+        snap.commit()
+        assert snap.get_node("n1").partitionable.geometry() == {0: {"2x2": 2}}
+
+    def test_double_fork_raises(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        snap.fork()
+        with pytest.raises(RuntimeError):
+            snap.fork()
+
+    def test_revert_without_fork_raises(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        with pytest.raises(RuntimeError):
+            snap.revert()
+
+
+class TestLackingSlices:
+    def test_lacking_when_cluster_empty(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        assert snap.get_lacking_slices(pod) == {slice_res("2x2"): 1}
+
+    def test_no_lacking_when_free_exists(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        assert snap.get_lacking_slices(pod) == {}
+
+    def test_partial_lack(self):
+        ann = annot.status_from_devices(free={0: {"1x1": 1}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        pod = build_pod("p", {slice_res("1x1"): 3})
+        assert snap.get_lacking_slices(pod) == {slice_res("1x1"): 2}
+
+    def test_plain_chip_request_stays_plain_when_uncovered(self):
+        # The serving profile depends on which node gets carved, so the
+        # cluster-level lack is expressed in chips.
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {constants.RESOURCE_TPU: 4})
+        assert snap.get_lacking_slices(pod) == {constants.RESOURCE_TPU: 4}
+
+    def test_plain_chip_request_covered_by_matching_free_profile(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        pod = build_pod("p", {constants.RESOURCE_TPU: 4})
+        assert snap.get_lacking_slices(pod) == {}
+
+    def test_free_on_other_node_counts(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        snap = snapshot_of(
+            build_tpu_node(name="n1"),
+            build_tpu_node(name="n2", annotations=ann),
+        )
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        assert snap.get_lacking_slices(pod) == {}
+
+
+class TestCandidates:
+    def test_sorted_by_name(self):
+        snap = snapshot_of(build_tpu_node(name="b"), build_tpu_node(name="a"))
+        assert snap.get_candidate_nodes() == ["a", "b"]
+
+    def test_fully_used_node_excluded(self):
+        ann = annot.status_from_devices(free={}, used={0: {"2x4": 1}})
+        snap = snapshot_of(
+            build_tpu_node(name="full", annotations=ann),
+            build_tpu_node(name="virgin"),
+        )
+        assert snap.get_candidate_nodes() == ["virgin"]
+
+
+class TestSnapshotTaker:
+    def test_only_tpu_labeled_nodes(self):
+        state = ClusterState()
+        state.update_node(build_tpu_node(name="tpu1"), [])
+        state.update_node(build_tpu_node(name="mig1", partitioning="mig"), [])
+        state.update_node(build_node(name="plain"), [])
+        snap = TpuSnapshotTaker().take_snapshot(state)
+        assert list(snap.get_nodes()) == ["tpu1"]
+
+    def test_pods_carried_into_snapshot(self):
+        state = ClusterState()
+        pod = build_pod("p", {"cpu": 1}, node="tpu1")
+        state.update_node(build_tpu_node(name="tpu1"), [pod])
+        snap = TpuSnapshotTaker().take_snapshot(state)
+        assert [p.metadata.name for p in snap.get_node("tpu1").pods] == ["p"]
+
+    def test_zero_capacity_node_skipped(self):
+        state = ClusterState()
+        state.update_node(build_tpu_node(name="empty", chips=0), [])
+        snap = TpuSnapshotTaker().take_snapshot(state)
+        assert snap.get_nodes() == {}
+
+
+class TestTracker:
+    def test_tracks_only_lacking_pods(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        snap = snapshot_of(build_tpu_node(name="n1", annotations=ann))
+        fits = build_pod("fits", {slice_res("2x2"): 1})
+        lacks = build_pod("lacks", {slice_res("2x4"): 1})
+        tracker = SliceTracker(snap, [fits, lacks])
+        assert fits not in tracker
+        assert lacks in tracker
+        assert tracker.lacking_totals() == {slice_res("2x4"): 1}
+
+    def test_remove(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        tracker = SliceTracker(snap, [pod])
+        tracker.remove(pod)
+        assert tracker.empty

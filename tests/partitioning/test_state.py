@@ -1,0 +1,93 @@
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState
+from nos_tpu.partitioning.tpu import TpuNodeInitializer, TpuPartitioner
+
+from tests.factory import build_node, build_pod, build_tpu_node
+
+
+class TestClusterState:
+    def test_partitioning_enabled_counting(self):
+        state = ClusterState()
+        assert not state.is_partitioning_enabled("tpu")
+        state.update_node(build_tpu_node(name="n1"), [])
+        assert state.is_partitioning_enabled("tpu")
+        state.delete_node("n1")
+        assert not state.is_partitioning_enabled("tpu")
+
+    def test_update_node_replaces_pods(self):
+        state = ClusterState()
+        state.update_node(build_node("n1"), [build_pod("a", node="n1")])
+        state.update_node(build_node("n1"), [build_pod("b", node="n1")])
+        assert [p.metadata.name for p in state.get_node("n1").pods] == ["b"]
+
+    def test_pod_usage_binding_and_unbinding(self):
+        state = ClusterState()
+        state.update_node(build_node("n1"), [])
+        pod = build_pod("p", {"cpu": 1}, node="n1", phase=PodPhase.RUNNING)
+        state.update_pod_usage(pod)
+        assert [p.metadata.name for p in state.get_node("n1").pods] == ["p"]
+        pod.status.phase = PodPhase.SUCCEEDED
+        state.update_pod_usage(pod)
+        assert state.get_node("n1").pods == []
+
+    def test_update_pod_usage_is_idempotent(self):
+        state = ClusterState()
+        state.update_node(build_node("n1"), [])
+        pod = build_pod("p", {"cpu": 1}, node="n1", phase=PodPhase.RUNNING)
+        state.update_pod_usage(pod)
+        state.update_pod_usage(pod)
+        assert len(state.get_node("n1").pods) == 1
+
+    def test_delete_pod(self):
+        state = ClusterState()
+        pod = build_pod("p", node="n1", phase=PodPhase.RUNNING)
+        state.update_node(build_node("n1"), [pod])
+        state.delete_pod(pod)
+        assert state.get_node("n1").pods == []
+
+    def test_unknown_node_pod_ignored(self):
+        state = ClusterState()
+        state.update_pod_usage(build_pod("p", node="ghost", phase=PodPhase.RUNNING))
+        assert state.get_nodes() == {}
+
+    def test_get_node_returns_copy(self):
+        state = ClusterState()
+        state.update_node(build_node("n1"), [])
+        info = state.get_node("n1")
+        info.node.metadata.labels["x"] = "y"
+        assert "x" not in state.get_node("n1").node.metadata.labels
+
+
+class TestInitializer:
+    def make(self, store):
+        return TpuNodeInitializer(TpuPartitioner(store), plan_id_fn=lambda: "init-1")
+
+    def test_virgin_node_initialized_with_whole_board_slice(self):
+        store = KubeStore()
+        node = build_tpu_node(name="n1")
+        store.create(node)
+        init = self.make(store)
+        assert not init.is_initialized(node)
+        assert init.init_node_partitioning(node)
+        updated = store.get("Node", "n1")
+        spec, _ = annot.parse_node_annotations(updated.metadata.annotations)
+        assert annot.spec_geometries(spec) == {0: {"2x4": 1}}
+        assert updated.metadata.annotations[annot.SPEC_PARTITIONING_PLAN] == "init-1"
+        assert init.is_initialized(updated)
+
+    def test_initialized_node_untouched(self):
+        store = KubeStore()
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        node = build_tpu_node(name="n1", annotations=ann)
+        store.create(node)
+        init = self.make(store)
+        assert init.is_initialized(node)
+        assert not init.init_node_partitioning(node)
+
+    def test_non_tpu_node_ignored(self):
+        store = KubeStore()
+        node = build_node("plain")
+        store.create(node)
+        assert not self.make(store).init_node_partitioning(node)
