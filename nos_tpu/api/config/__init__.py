@@ -1,0 +1,13 @@
+from nos_tpu.api.config.v1alpha1 import (
+    GpuPartitionerConfig,
+    OperatorConfig,
+    SchedulerConfig,
+    TpuAgentConfig,
+)
+
+__all__ = [
+    "GpuPartitionerConfig",
+    "OperatorConfig",
+    "SchedulerConfig",
+    "TpuAgentConfig",
+]

@@ -1,0 +1,75 @@
+"""Component configuration kinds.
+
+Reference pkg/api/nos.nebuly.com/config/v1alpha1/*: each binary takes one
+``--config <file>`` decoded into a typed struct with Validate()
+(cmd/gpupartitioner/gpupartitioner.go:90-101). Values mirror the helm
+defaults (values.yaml:278-285: batch window 60s timeout / 10s idle).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ManagerConfig:
+    """Shared controller-manager knobs (the ControllerManagerConfigurationSpec
+    embed: metrics/health endpoints, leader election)."""
+
+    metrics_bind_address: str = ":8080"
+    health_probe_bind_address: str = ":8081"
+    leader_election: bool = False
+
+
+@dataclass
+class GpuPartitionerConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    batch_window_timeout_seconds: float = 60.0
+    batch_window_idle_seconds: float = 10.0
+    # Known-geometries override file content: accelerator -> list of
+    # geometries (KnownMigGeometriesFile analogue).
+    known_tpu_geometries: Optional[Dict[str, List[Dict[str, int]]]] = None
+    scheduler_config_file: str = ""
+    device_plugin_config_map: str = "nos-device-plugin-config"
+    device_plugin_delay_seconds: float = 0.0
+
+    def validate(self) -> None:
+        if self.batch_window_timeout_seconds <= 0:
+            raise ConfigError("batch_window_timeout_seconds must be > 0")
+        if self.batch_window_idle_seconds < 0:
+            raise ConfigError("batch_window_idle_seconds must be >= 0")
+        if self.batch_window_idle_seconds > self.batch_window_timeout_seconds:
+            raise ConfigError("idle window cannot exceed timeout window")
+
+
+@dataclass
+class OperatorConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+
+    def validate(self) -> None:
+        pass
+
+
+@dataclass
+class TpuAgentConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    report_config_interval_seconds: float = 10.0
+
+    def validate(self) -> None:
+        if self.report_config_interval_seconds <= 0:
+            raise ConfigError("report_config_interval_seconds must be > 0")
+
+
+@dataclass
+class SchedulerConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    retry_seconds: float = 0.5
+    gang_wait_timeout_seconds: float = 30.0
+
+    def validate(self) -> None:
+        if self.retry_seconds <= 0:
+            raise ConfigError("retry_seconds must be > 0")

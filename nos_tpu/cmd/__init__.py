@@ -1,0 +1,12 @@
+"""Component entry points (reference cmd/: six binaries, SURVEY.md §2.1).
+
+Each build_* function wires one component's controllers onto a Manager
+against a KubeStore, mirroring each binary's main(). build_cluster()
+assembles the full suite in-process — the equivalent of helm-installing
+everything onto a kind cluster with the fake device plugin (BASELINE
+config #1).
+"""
+
+from nos_tpu.cmd.cluster import SimCluster, build_cluster
+
+__all__ = ["SimCluster", "build_cluster"]

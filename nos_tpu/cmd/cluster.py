@@ -1,0 +1,119 @@
+"""SimCluster: the whole suite running in-process against one store.
+
+Equivalent of helm-installing all components onto a kind cluster with the
+fake TPU device plugin (BASELINE config #1 / SURVEY.md §7 step 4): the
+operator, the partitioner, the scheduler, one tpuagent per TPU node, the
+sim kubelet, and the sim device layer.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu.api.config import (
+    GpuPartitionerConfig,
+    OperatorConfig,
+    SchedulerConfig,
+    TpuAgentConfig,
+)
+from nos_tpu.api.v1alpha1.labels import PARTITIONING_LABEL, PartitioningKind
+from nos_tpu.cmd.operator import build_operator
+from nos_tpu.cmd.partitioner import build_partitioner
+from nos_tpu.cmd.scheduler import build_scheduler
+from nos_tpu.cmd.tpuagent import build_tpuagent
+from nos_tpu.controllers.partitioner import PartitionerController
+from nos_tpu.device import (
+    SimDevicePlugin,
+    SimDevicePool,
+    SimPodResourcesClient,
+    SimTpuDeviceClient,
+    TpuClient,
+)
+from nos_tpu.kube.controller import Controller, Manager, Watch
+from nos_tpu.kube.objects import Node, PodPhase
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.scheduler import Scheduler
+from nos_tpu.sim import SimKubelet
+
+
+@dataclass
+class SimCluster:
+    manager: Manager
+    store: KubeStore
+    pool: SimDevicePool
+    partitioner: PartitionerController
+    scheduler: Scheduler
+    _agent_nodes: List[str] = field(default_factory=list)
+
+    def add_tpu_node(self, node: Node, agent_config: Optional[TpuAgentConfig] = None) -> None:
+        """Create the node and start its tpuagent (must be called before
+        manager.start() for the agent's watches to replay the node)."""
+        self.store.create(node)
+        self.start_agent(node.metadata.name, agent_config)
+
+    def start_agent(self, node_name: str, agent_config: Optional[TpuAgentConfig] = None) -> None:
+        if node_name in self._agent_nodes:
+            return
+        client = TpuClient(
+            SimTpuDeviceClient(self.pool), SimPodResourcesClient(self.store, self.pool)
+        )
+        plugin = SimDevicePlugin(self.store, self.pool)
+        build_tpuagent(
+            self.manager,
+            node_name,
+            client,
+            plugin,
+            agent_config or TpuAgentConfig(report_config_interval_seconds=0.5),
+        )
+        self._agent_nodes.append(node_name)
+
+    def start(self) -> None:
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def wait_idle(self, timeout: float = 15.0) -> bool:
+        return self.manager.wait_idle(timeout=timeout)
+
+
+def build_cluster(
+    store: Optional[KubeStore] = None,
+    partitioner_config: Optional[GpuPartitionerConfig] = None,
+    scheduler_config: Optional[SchedulerConfig] = None,
+    operator_config: Optional[OperatorConfig] = None,
+) -> SimCluster:
+    store = store or KubeStore()
+    manager = Manager(store=store)
+    build_operator(manager, operator_config)
+    partitioner = build_partitioner(
+        manager,
+        partitioner_config
+        or GpuPartitionerConfig(
+            batch_window_timeout_seconds=1.0, batch_window_idle_seconds=0.05
+        ),
+    )
+    scheduler = build_scheduler(manager, scheduler_config)
+    kubelet = SimKubelet(store)
+    manager.add(
+        Controller(
+            "sim-kubelet",
+            store,
+            kubelet.reconcile,
+            [
+                Watch(
+                    kind="Pod",
+                    predicate=lambda e: e.type != "DELETED"
+                    and e.object.status.phase == PodPhase.PENDING
+                    and bool(e.object.spec.node_name),
+                )
+            ],
+        )
+    )
+    return SimCluster(
+        manager=manager,
+        store=store,
+        pool=SimDevicePool(),
+        partitioner=partitioner,
+        scheduler=scheduler,
+    )

@@ -1,0 +1,52 @@
+"""operator: EQ/CEQ reconcilers + validating webhooks
+(reference cmd/operator/operator.go:50-126)."""
+from __future__ import annotations
+
+from nos_tpu.api.config import OperatorConfig
+from nos_tpu.controllers.elasticquota import (
+    CompositeElasticQuotaReconciler,
+    ElasticQuotaReconciler,
+    register_elasticquota_webhooks,
+)
+from nos_tpu.controllers.elasticquota.controller import pod_to_quota_requests
+from nos_tpu.kube.controller import Controller, Manager, Watch
+
+
+def build_operator(manager: Manager, config: OperatorConfig | None = None) -> None:
+    config = config or OperatorConfig()
+    config.validate()
+    store = manager.store
+    register_elasticquota_webhooks(store)
+
+    eq = ElasticQuotaReconciler(store)
+    ceq = CompositeElasticQuotaReconciler(store)
+
+    manager.add(
+        Controller(
+            "elasticquota",
+            store,
+            eq.reconcile,
+            [
+                Watch(kind="ElasticQuota"),
+                Watch(kind="Pod", mapper=lambda e: pod_to_quota_requests(store, e)),
+            ],
+        )
+    )
+    manager.add(
+        Controller(
+            "compositeelasticquota",
+            store,
+            ceq.reconcile,
+            [
+                Watch(kind="CompositeElasticQuota"),
+                Watch(
+                    kind="Pod",
+                    mapper=lambda e: [
+                        r
+                        for r in pod_to_quota_requests(store, e)
+                        if store.try_get("CompositeElasticQuota", r.name, r.namespace)
+                    ],
+                ),
+            ],
+        )
+    )

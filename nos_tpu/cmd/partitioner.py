@@ -1,0 +1,105 @@
+"""gpupartitioner: ClusterState + state controllers + the TPU mode
+controller with its embedded scheduler framework
+(reference cmd/gpupartitioner/gpupartitioner.go:72-268)."""
+from __future__ import annotations
+
+import itertools
+import time
+
+from nos_tpu.api.config import GpuPartitionerConfig
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.labels import PARTITIONING_LABEL
+from nos_tpu.controllers.partitioner import (
+    PartitionerController,
+    StateNodeController,
+    StatePodController,
+)
+from nos_tpu.kube.controller import Controller, Manager, Watch
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.partitioning.core import Actuator, ClusterState, Planner
+from nos_tpu.partitioning.tpu import (
+    TpuNodeInitializer,
+    TpuPartitioner,
+    TpuSnapshotTaker,
+)
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit, NodeSelectorFit
+from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
+from nos_tpu.tpu.known import set_known_geometries
+
+
+def register_indexers(store) -> None:
+    """Field indexers every component relies on
+    (cmd/gpupartitioner/gpupartitioner.go:270-292)."""
+    if (("Pod", constants.INDEX_POD_PHASE)) not in store._indexers:
+        store.add_indexer("Pod", constants.INDEX_POD_PHASE, lambda p: [p.status.phase])
+        store.add_indexer("Pod", constants.INDEX_POD_NODE, lambda p: [p.spec.node_name])
+
+
+def build_partitioner(
+    manager: Manager, config: GpuPartitionerConfig | None = None
+) -> PartitionerController:
+    config = config or GpuPartitionerConfig()
+    config.validate()
+    store = manager.store
+    register_indexers(store)
+    if config.known_tpu_geometries:
+        set_known_geometries(config.known_tpu_geometries)
+
+    cluster_state = ClusterState()
+    # Wall-clock ms + monotonic counter: two plans in the same millisecond
+    # must not share an id or the spec/status handshake would false-ack.
+    counter = itertools.count(1)
+    plan_id_fn = lambda: f"{int(time.time() * 1000)}-{next(counter)}"  # noqa: E731
+    tpu_partitioner = TpuPartitioner(store)
+    initializer = TpuNodeInitializer(tpu_partitioner, plan_id_fn)
+
+    # The embedded simulation framework: the same plugin set the real
+    # scheduler runs, including CapacityScheduling, so plans are never
+    # refused at scheduling time (gpupartitioner.go:294-318 + SURVEY §7
+    # "simulation fidelity").
+    capacity = CapacityScheduling(store)
+    sim_framework = Framework(
+        pre_filter_plugins=[capacity],
+        filter_plugins=[NodeResourcesFit(), NodeSelectorFit()],
+    )
+
+    controller = PartitionerController(
+        store=store,
+        cluster_state=cluster_state,
+        snapshot_taker=TpuSnapshotTaker(),
+        planner=Planner(sim_framework),
+        actuator=Actuator(tpu_partitioner),
+        kind="tpu",
+        batch_timeout_seconds=config.batch_window_timeout_seconds,
+        batch_idle_seconds=config.batch_window_idle_seconds,
+        plan_id_fn=plan_id_fn,
+    )
+
+    node_ctrl = StateNodeController(store, cluster_state, initializer=initializer)
+    pod_ctrl = StatePodController(store, cluster_state)
+
+    manager.add(
+        Controller(
+            "state-node",
+            store,
+            node_ctrl.reconcile,
+            [Watch(kind="Node", predicate=lambda e: PARTITIONING_LABEL in e.object.metadata.labels or e.type == "DELETED")],
+        )
+    )
+    manager.add(Controller("state-pod", store, pod_ctrl.reconcile, [Watch(kind="Pod")]))
+    manager.add(
+        Controller(
+            "partitioner-tpu",
+            store,
+            controller.reconcile,
+            [
+                Watch(
+                    kind="Pod",
+                    predicate=lambda e: e.type != "DELETED"
+                    and e.object.status.phase == PodPhase.PENDING,
+                )
+            ],
+        )
+    )
+    manager.add_runnable(controller.start, controller.stop)
+    return controller

@@ -1,0 +1,49 @@
+"""scheduler: the capacity/gang/topology-aware scheduler
+(reference cmd/scheduler/scheduler.go:43-59)."""
+from __future__ import annotations
+
+from nos_tpu.api.config import SchedulerConfig
+from nos_tpu.kube.controller import Controller, Manager, Request, Watch
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.scheduler.scheduler import Scheduler, new_framework
+
+
+def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> Scheduler:
+    config = config or SchedulerConfig()
+    config.validate()
+    store = manager.store
+    framework, capacity, gang = new_framework(
+        store, gang_timeout_seconds=config.gang_wait_timeout_seconds
+    )
+    scheduler = Scheduler(
+        store,
+        framework,
+        capacity=capacity,
+        gang=gang,
+        retry_seconds=config.retry_seconds,
+    )
+
+    def node_event_mapper(event):
+        # A node change (new slices advertised) can unblock any pending pod.
+        return [
+            Request(name=p.metadata.name, namespace=p.metadata.namespace)
+            for p in store.list("Pod")
+            if p.status.phase == PodPhase.PENDING and not p.spec.node_name
+        ]
+
+    manager.add(
+        Controller(
+            "scheduler",
+            store,
+            scheduler.reconcile,
+            [
+                Watch(
+                    kind="Pod",
+                    predicate=lambda e: e.type != "DELETED"
+                    and e.object.status.phase == PodPhase.PENDING,
+                ),
+                Watch(kind="Node", mapper=node_event_mapper),
+            ],
+        )
+    )
+    return scheduler

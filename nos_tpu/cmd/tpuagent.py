@@ -1,0 +1,60 @@
+"""tpuagent: per-node Reporter + Actuator daemon
+(reference cmd/migagent/migagent.go:56-199; the NODE_NAME env selects the
+node a real daemonset instance manages)."""
+from __future__ import annotations
+
+from nos_tpu.api.config import TpuAgentConfig
+from nos_tpu.controllers.tpuagent import SharedState, TpuActuator, TpuReporter
+from nos_tpu.device.client import TpuClient
+from nos_tpu.kube.controller import Controller, Manager, Request, Watch
+from nos_tpu.util.predicates import matching_name
+
+
+def build_tpuagent(
+    manager: Manager,
+    node_name: str,
+    client: TpuClient,
+    device_plugin,
+    config: TpuAgentConfig | None = None,
+) -> None:
+    config = config or TpuAgentConfig()
+    config.validate()
+    store = manager.store
+    shared = SharedState()
+    reporter = TpuReporter(
+        store,
+        client,
+        node_name,
+        shared,
+        report_interval_seconds=config.report_config_interval_seconds,
+    )
+    actuator = TpuActuator(store, client, device_plugin, node_name, shared)
+
+    def pod_on_node_mapper(event):
+        # A pod starting/finishing on this node changes device usage — the
+        # report must not wait out the full interval (the reference's
+        # NodeResourcesChanged predicate covers this via node updates; our
+        # usage source is pods, so watch them directly).
+        if event.object.spec.node_name == node_name:
+            return [Request(name=node_name)]
+        return []
+
+    manager.add(
+        Controller(
+            f"tpuagent-reporter-{node_name}",
+            store,
+            reporter.reconcile,
+            [
+                Watch(kind="Node", predicate=matching_name(node_name)),
+                Watch(kind="Pod", mapper=pod_on_node_mapper),
+            ],
+        )
+    )
+    manager.add(
+        Controller(
+            f"tpuagent-actuator-{node_name}",
+            store,
+            actuator.reconcile,
+            [Watch(kind="Node", predicate=matching_name(node_name))],
+        )
+    )

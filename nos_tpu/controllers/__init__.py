@@ -1,0 +1,1 @@
+"""Controllers: the suite's reconcilers (reference internal/controllers/)."""

@@ -1,0 +1,20 @@
+"""ElasticQuota / CompositeElasticQuota reconcilers + webhooks
+(reference internal/controllers/elasticquota/)."""
+
+from nos_tpu.controllers.elasticquota.controller import (
+    CompositeElasticQuotaReconciler,
+    ElasticQuotaReconciler,
+)
+from nos_tpu.controllers.elasticquota.webhooks import (
+    register_elasticquota_webhooks,
+    validate_composite_elastic_quota,
+    validate_elastic_quota,
+)
+
+__all__ = [
+    "CompositeElasticQuotaReconciler",
+    "ElasticQuotaReconciler",
+    "register_elasticquota_webhooks",
+    "validate_composite_elastic_quota",
+    "validate_elastic_quota",
+]

@@ -1,0 +1,133 @@
+"""Quota accounting & over-quota labeling.
+
+Reference internal/controllers/elasticquota/elasticquota_controller.go:66-189
++ elasticquota.go:38-149: on quota change or pod phase transition, list the
+namespace's running pods, walk them in deterministic order accumulating
+used quota, label each pod in-quota/over-quota (the scheduler's preemption
+victims are picked by this label), and publish status.used.
+
+CompositeElasticQuota does the same over a namespace *list* and deletes
+overlapping per-namespace quotas (compositeelasticquota_controller.go:110-137).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from nos_tpu.api.v1alpha1 import labels as labels_api
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.objects import Pod, PodPhase, ResourceList
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.util import resources as res
+
+log = logging.getLogger("nos_tpu.elasticquota")
+
+
+def sort_pods_for_quota(pods: List[Pod]) -> List[Pod]:
+    """Deterministic accounting order (reference elasticquota.go:77-104):
+    older pods first (they claimed quota first), then higher priority, then
+    smaller aggregate request, then name."""
+    return sorted(
+        pods,
+        key=lambda p: (
+            p.metadata.creation_timestamp,
+            -p.spec.priority,
+            sum(res.with_aggregate_tpu_chips(res.compute_pod_request(p)).values()),
+            p.metadata.namespace,
+            p.metadata.name,
+        ),
+    )
+
+
+def _filter_to_min(request: ResourceList, min_resources: ResourceList) -> ResourceList:
+    """Quota only tracks resources named in spec.min (elasticquota.go:64-69)."""
+    return {k: v for k, v in request.items() if k in min_resources}
+
+
+class _QuotaReconcilerBase:
+    def __init__(self, store: KubeStore) -> None:
+        self.store = store
+
+    def _running_pods(self, namespaces: List[str]) -> List[Pod]:
+        pods: List[Pod] = []
+        for ns in namespaces:
+            pods.extend(
+                p
+                for p in self.store.list("Pod", namespace=ns)
+                if p.status.phase == PodPhase.RUNNING
+            )
+        return pods
+
+    def _reconcile_quota(self, quota, namespaces: List[str]) -> None:
+        pods = sort_pods_for_quota(self._running_pods(namespaces))
+        min_resources = quota.spec.min
+        used: ResourceList = {}
+        for pod in pods:
+            request = _filter_to_min(
+                res.with_aggregate_tpu_chips(res.compute_pod_request(pod)),
+                min_resources,
+            )
+            candidate = res.sum_resources(used, request)
+            in_quota = res.fits(min_resources, candidate)
+            desired_label = (
+                labels_api.CAPACITY_IN_QUOTA if in_quota else labels_api.CAPACITY_OVER_QUOTA
+            )
+            if pod.metadata.labels.get(labels_api.CAPACITY_LABEL) != desired_label:
+                self.store.patch_labels(
+                    "Pod",
+                    pod.metadata.name,
+                    pod.metadata.namespace,
+                    {labels_api.CAPACITY_LABEL: desired_label},
+                )
+            used = candidate
+
+        if quota.status.used != used:
+            def mutate(q):
+                q.status.used = used
+
+            self.store.patch_merge(
+                quota.kind, quota.metadata.name, quota.metadata.namespace, mutate
+            )
+
+
+class ElasticQuotaReconciler(_QuotaReconcilerBase):
+    def reconcile(self, req: Request) -> Optional[Result]:
+        quota = self.store.try_get("ElasticQuota", req.name, req.namespace)
+        if quota is None:
+            return None
+        self._reconcile_quota(quota, [quota.metadata.namespace])
+        return None
+
+
+class CompositeElasticQuotaReconciler(_QuotaReconcilerBase):
+    def reconcile(self, req: Request) -> Optional[Result]:
+        quota = self.store.try_get("CompositeElasticQuota", req.name, req.namespace)
+        if quota is None:
+            return None
+        # A CEQ shadows per-namespace EQs for its namespaces: delete overlaps
+        # (compositeelasticquota_controller.go:110-137).
+        for eq in self.store.list("ElasticQuota"):
+            if eq.metadata.namespace in quota.spec.namespaces:
+                log.info(
+                    "deleting ElasticQuota %s overlapped by CompositeElasticQuota %s",
+                    eq.metadata.namespace + "/" + eq.metadata.name,
+                    quota.metadata.name,
+                )
+                self.store.delete("ElasticQuota", eq.metadata.name, eq.metadata.namespace)
+        self._reconcile_quota(quota, list(quota.spec.namespaces))
+        return None
+
+
+def pod_to_quota_requests(store: KubeStore, event) -> List[Request]:
+    """Watch mapper: a pod event maps to the quota(s) covering its namespace
+    (reference Watches mapping elasticquota_controller.go:140-164)."""
+    ns = event.object.metadata.namespace
+    out: List[Request] = []
+    for eq in store.list("ElasticQuota", namespace=ns):
+        out.append(Request(name=eq.metadata.name, namespace=ns))
+    for ceq in store.list("CompositeElasticQuota"):
+        if ns in ceq.spec.namespaces:
+            out.append(
+                Request(name=ceq.metadata.name, namespace=ceq.metadata.namespace)
+            )
+    return out

@@ -1,0 +1,55 @@
+"""Create-time validation (reference
+pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:31-97 and
+compositeelasticquota_webhook.go): at most one ElasticQuota per namespace;
+an EQ's namespace must not be covered by any CompositeElasticQuota, and
+symmetrically a CEQ cannot cover a namespace that already has an EQ covered
+by another CEQ. Additionally min ≤ max where both are set.
+"""
+from __future__ import annotations
+
+from nos_tpu.kube.store import AdmissionError, KubeStore
+from nos_tpu.util import resources as res
+
+
+def _validate_min_max(spec) -> None:
+    for name, min_qty in spec.min.items():
+        if name in spec.max and spec.max[name] < min_qty:
+            raise AdmissionError(
+                f"spec.max[{name}]={spec.max[name]} is below spec.min={min_qty}"
+            )
+
+
+def validate_elastic_quota(quota, store: KubeStore) -> None:
+    _validate_min_max(quota.spec)
+    ns = quota.metadata.namespace
+    for existing in store.list("ElasticQuota", namespace=ns):
+        if existing.metadata.name != quota.metadata.name:
+            raise AdmissionError(
+                f"namespace {ns} already has ElasticQuota {existing.metadata.name}"
+            )
+    for ceq in store.list("CompositeElasticQuota"):
+        if ns in ceq.spec.namespaces:
+            raise AdmissionError(
+                f"namespace {ns} is covered by CompositeElasticQuota "
+                f"{ceq.metadata.name}"
+            )
+
+
+def validate_composite_elastic_quota(quota, store: KubeStore) -> None:
+    _validate_min_max(quota.spec)
+    for other in store.list("CompositeElasticQuota"):
+        if other.metadata.name == quota.metadata.name and (
+            other.metadata.namespace == quota.metadata.namespace
+        ):
+            continue
+        overlap = set(other.spec.namespaces) & set(quota.spec.namespaces)
+        if overlap:
+            raise AdmissionError(
+                f"namespaces {sorted(overlap)} already covered by "
+                f"CompositeElasticQuota {other.metadata.name}"
+            )
+
+
+def register_elasticquota_webhooks(store: KubeStore) -> None:
+    store.register_admission("ElasticQuota", validate_elastic_quota)
+    store.register_admission("CompositeElasticQuota", validate_composite_elastic_quota)

@@ -1,0 +1,11 @@
+"""gpupartitioner-equivalent control plane (reference
+internal/controllers/gpupartitioner/): the mode controller batching pending
+pods into plan/actuate cycles, plus node/pod state controllers feeding
+ClusterState.
+"""
+
+from nos_tpu.controllers.partitioner.controller import PartitionerController
+from nos_tpu.controllers.partitioner.node_controller import StateNodeController
+from nos_tpu.controllers.partitioner.pod_controller import StatePodController
+
+__all__ = ["PartitionerController", "StateNodeController", "StatePodController"]

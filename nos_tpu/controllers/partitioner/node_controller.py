@@ -1,0 +1,51 @@
+"""StateNodeController: keeps ClusterState in sync and initializes virgin
+TPU nodes (reference internal/controllers/gpupartitioner/node_controller.go:60-135).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.labels import partitioning_kind
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState
+
+log = logging.getLogger("nos_tpu.partitioner")
+
+
+class StateNodeController:
+    def __init__(
+        self,
+        store: KubeStore,
+        cluster_state: ClusterState,
+        initializer=None,
+    ) -> None:
+        self.store = store
+        self.cluster_state = cluster_state
+        self.initializer = initializer
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        node = self.store.try_get("Node", req.name)
+        if node is None:
+            self.cluster_state.delete_node(req.name)
+            return None
+        # First contact with a virgin TPU node: apply the fewest-slices
+        # geometry so its resources become schedulable (node_controller.go:89-95).
+        if (
+            self.initializer is not None
+            and partitioning_kind(node) == "tpu"
+            and not self.initializer.is_initialized(node)
+        ):
+            self.initializer.init_node_partitioning(node)
+            node = self.store.get("Node", req.name)
+        pods = [
+            p
+            for p in self.store.list_by_index(
+                "Pod", constants.INDEX_POD_NODE, node.metadata.name
+            )
+            if p.status.phase in ("Pending", "Running")
+        ]
+        self.cluster_state.update_node(node, pods)
+        return None

@@ -1,0 +1,46 @@
+"""StatePodController: keeps ClusterState pod usage fresh on pod events
+(reference internal/controllers/gpupartitioner/pod_controller.go:47-112),
+lazily adding unknown nodes.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState
+
+log = logging.getLogger("nos_tpu.partitioner")
+
+
+class StatePodController:
+    def __init__(self, store: KubeStore, cluster_state: ClusterState) -> None:
+        self.store = store
+        self.cluster_state = cluster_state
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        pod = self.store.try_get("Pod", req.name, req.namespace)
+        if pod is None:
+            # Object gone: purge any stale binding we may hold.
+            from nos_tpu.kube.objects import ObjectMeta, Pod as PodObj
+
+            ghost = PodObj(metadata=ObjectMeta(name=req.name, namespace=req.namespace))
+            self.cluster_state.delete_pod(ghost)
+            return None
+        node_name = pod.spec.node_name
+        if node_name and self.cluster_state.get_node(node_name) is None:
+            node = self.store.try_get("Node", node_name)
+            if node is not None:
+                pods = [
+                    p
+                    for p in self.store.list_by_index(
+                        "Pod", constants.INDEX_POD_NODE, node_name
+                    )
+                    if p.status.phase in ("Pending", "Running")
+                ]
+                self.cluster_state.update_node(node, pods)
+                return None
+        self.cluster_state.update_pod_usage(pod)
+        return None

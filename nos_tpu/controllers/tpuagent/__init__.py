@@ -1,0 +1,14 @@
+"""tpuagent: the node-local daemon (reference internal/controllers/migagent/).
+
+Reporter publishes actual slice state as status annotations; Actuator turns
+spec annotations into device create/delete calls through the TpuClient seam
+and re-advertises resources via the device plugin. They coordinate through
+SharedState so the actuator never acts before at least one fresh report.
+"""
+
+from nos_tpu.controllers.tpuagent.plan import SlicePlan, compute_plan
+from nos_tpu.controllers.tpuagent.shared import SharedState
+from nos_tpu.controllers.tpuagent.reporter import TpuReporter
+from nos_tpu.controllers.tpuagent.actuator import TpuActuator
+
+__all__ = ["SharedState", "SlicePlan", "TpuActuator", "TpuReporter", "compute_plan"]

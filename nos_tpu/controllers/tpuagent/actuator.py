@@ -1,0 +1,77 @@
+"""TpuActuator: spec annotations → device create/delete + plugin restart.
+
+Reference internal/controllers/migagent/actuator.go:71-292: on node
+annotation change, wait for ≥1 report since last apply, parse spec vs
+status, compute the declarative plan, execute deletes then creates, and
+restart the device plugin when devices changed.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional, Protocol
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.controllers.tpuagent.plan import compute_plan
+from nos_tpu.controllers.tpuagent.shared import SharedState
+from nos_tpu.device.client import TpuClient
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.store import KubeStore, NotFoundError
+
+log = logging.getLogger("nos_tpu.tpuagent")
+
+
+class DevicePlugin(Protocol):
+    def restart(self, node_name: str) -> None: ...
+
+
+class TpuActuator:
+    def __init__(
+        self,
+        store: KubeStore,
+        client: TpuClient,
+        device_plugin: DevicePlugin,
+        node_name: str,
+        shared: SharedState,
+    ) -> None:
+        self.store = store
+        self.client = client
+        self.device_plugin = device_plugin
+        self.node_name = node_name
+        self.shared = shared
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        if req.name != self.node_name:
+            return None
+        if not self.shared.at_least_one_report_since_last_apply():
+            # Never act on device state older than the last apply
+            # (actuator.go:75-78).
+            return Result(requeue_after=0.1)
+        try:
+            node = self.store.get("Node", self.node_name)
+        except NotFoundError:
+            return None
+
+        spec, _ = annot.parse_node_annotations(node.metadata.annotations)
+        plan_id = node.metadata.annotations.get(annot.SPEC_PARTITIONING_PLAN, "")
+        devices = self.client.get_devices(self.node_name)
+        desired = annot.spec_geometries(spec)
+        plan = compute_plan(devices, desired)
+        if plan.empty:
+            self.shared.on_apply(plan_id)
+            return None
+
+        for device in plan.deletes:
+            self.client.delete_slice(self.node_name, device.device_id)
+            log.info("actuator: %s deleted %s", self.node_name, device.device_id)
+        for op in plan.creates:
+            self.client.create_slices(self.node_name, op.board_index, op.profile, op.quantity)
+            log.info(
+                "actuator: %s created %dx %s on board %d",
+                self.node_name,
+                op.quantity,
+                op.profile,
+                op.board_index,
+            )
+        self.device_plugin.restart(self.node_name)
+        self.shared.on_apply(plan_id)
+        return None

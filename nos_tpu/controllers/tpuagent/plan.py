@@ -1,0 +1,63 @@
+"""Declarative slice-plan diff (reference internal/controllers/migagent/plan/plan.go:31-92).
+
+Given the devices that exist and the spec geometries the control plane
+wants, produce delete and create operations. Deletes run before creates
+(actuator.go:152-200). Used devices are never deleted — the planner never
+plans away used slices (gpu.go UpdateGeometryFor preserves them), so a diff
+demanding it means stale state; we skip and let the level-triggered loop
+retry after the next report.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+
+
+@dataclass
+class CreateOp:
+    board_index: int
+    profile: str
+    quantity: int
+
+
+@dataclass
+class SlicePlan:
+    deletes: List[TpuSliceDevice] = field(default_factory=list)
+    creates: List[CreateOp] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.deletes and not self.creates
+
+
+def compute_plan(
+    devices: List[TpuSliceDevice], spec: Dict[int, Dict[str, int]]
+) -> SlicePlan:
+    existing: Dict[Tuple[int, str], List[TpuSliceDevice]] = {}
+    for d in devices:
+        existing.setdefault((d.board_index, d.profile), []).append(d)
+
+    plan = SlicePlan()
+    # Deletes: devices over spec quantity (or of profiles absent from spec).
+    for (board, profile), devs in sorted(existing.items()):
+        want = spec.get(board, {}).get(profile, 0)
+        excess = len(devs) - want
+        if excess <= 0:
+            continue
+        free = sorted(
+            (d for d in devs if d.status == DeviceStatus.FREE), key=lambda d: d.device_id
+        )
+        plan.deletes.extend(free[:excess])
+        # excess beyond free devices would require deleting used slices —
+        # refused; the remaining diff re-converges after pods finish.
+
+    # Creates: spec quantity beyond existing.
+    for board in sorted(spec):
+        for profile in sorted(spec[board]):
+            want = spec[board][profile]
+            have = len(existing.get((board, profile), []))
+            if want > have:
+                plan.creates.append(CreateOp(board, profile, want - have))
+    return plan

@@ -1,0 +1,90 @@
+"""TpuReporter: device state → status annotations.
+
+Reference internal/controllers/migagent/reporter.go:54-123: every report
+interval (or on node change), read actual devices and write status-*
+annotations; publish the plan id once the reported geometry matches spec,
+completing the plan handshake that ungates the control-plane partitioner
+(partitioner_controller.go:118-122, 212-232).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.device.client import TpuClient
+from nos_tpu.device.types import group_geometries
+from nos_tpu.controllers.tpuagent.shared import SharedState
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.store import KubeStore, NotFoundError
+
+log = logging.getLogger("nos_tpu.tpuagent")
+
+
+class TpuReporter:
+    def __init__(
+        self,
+        store: KubeStore,
+        client: TpuClient,
+        node_name: str,
+        shared: SharedState,
+        report_interval_seconds: float = 10.0,
+    ) -> None:
+        self.store = store
+        self.client = client
+        self.node_name = node_name
+        self.shared = shared
+        self.interval = report_interval_seconds
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        if req.name != self.node_name:
+            return None
+        try:
+            node = self.store.get("Node", self.node_name)
+        except NotFoundError:
+            return None
+
+        devices = self.client.get_devices(self.node_name)
+        grouped = group_geometries(devices)
+        desired_status = annot.status_from_devices(
+            free=grouped["free"], used=grouped["used"]
+        )
+
+        spec, _ = annot.parse_node_annotations(node.metadata.annotations)
+        spec_plan = node.metadata.annotations.get(annot.SPEC_PARTITIONING_PLAN, "")
+        total = {
+            board: geometry
+            for board, geometry in _total_geometry(grouped).items()
+            if geometry
+        }
+        if spec_plan and annot.spec_geometries(spec) == total:
+            # Devices converged to spec: acknowledge the plan, ungating the
+            # control-plane partitioner.
+            desired_status[annot.STATUS_PARTITIONING_PLAN] = spec_plan
+        else:
+            existing = node.metadata.annotations.get(annot.STATUS_PARTITIONING_PLAN)
+            if existing is not None:
+                desired_status[annot.STATUS_PARTITIONING_PLAN] = existing
+
+        current_status = {
+            k: v
+            for k, v in node.metadata.annotations.items()
+            if k.startswith(annot.PREFIX + "status-")
+        }
+        if current_status != desired_status:
+            patch = {k: None for k in current_status}
+            patch.update(desired_status)
+            self.store.patch_annotations("Node", self.node_name, "", patch)
+            log.info("reporter: %s status updated (%d devices)", self.node_name, len(devices))
+        self.shared.on_report()
+        return Result(requeue_after=self.interval)
+
+
+def _total_geometry(grouped):
+    out = {}
+    for status_map in (grouped["free"], grouped["used"]):
+        for board, geometry in status_map.items():
+            target = out.setdefault(board, {})
+            for profile, qty in geometry.items():
+                target[profile] = target.get(profile, 0) + qty
+    return out

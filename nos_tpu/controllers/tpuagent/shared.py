@@ -1,0 +1,26 @@
+"""Reporter/Actuator handshake (reference internal/controllers/migagent/shared.go:24-57):
+the actuator refuses to act unless the reporter has observed the node since
+the last apply, so it always diffs against fresh device state."""
+from __future__ import annotations
+
+import threading
+
+
+class SharedState:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._reported_since_last_apply = False
+        self.last_applied_plan_id = ""
+
+    def on_report(self) -> None:
+        with self._lock:
+            self._reported_since_last_apply = True
+
+    def on_apply(self, plan_id: str) -> None:
+        with self._lock:
+            self._reported_since_last_apply = False
+            self.last_applied_plan_id = plan_id
+
+    def at_least_one_report_since_last_apply(self) -> bool:
+        with self._lock:
+            return self._reported_since_last_apply

@@ -1,0 +1,29 @@
+"""Device-access layer: the native boundary of the suite.
+
+Reference parity (SURVEY.md §2.5, §2.8): nos touches hardware through
+exactly one native component — the CGO NVML client (pkg/gpu/nvml/client.go)
+— composed with a kubelet pod-resources gRPC client (pkg/resource/) into
+mig.Client. Here the same seam is `TpuDeviceClient` (slice enumeration and
+carve/destroy: backed by the C++ `tpuctl` library on real hosts, by
+SimDevicePool in tests and kind-style dry runs) composed with
+`PodResourcesClient` (which devices pods actually hold) into `TpuClient`.
+"""
+
+from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+from nos_tpu.device.client import TpuClient
+from nos_tpu.device.sim import (
+    SimDevicePlugin,
+    SimDevicePool,
+    SimPodResourcesClient,
+    SimTpuDeviceClient,
+)
+
+__all__ = [
+    "DeviceStatus",
+    "SimDevicePlugin",
+    "SimDevicePool",
+    "SimPodResourcesClient",
+    "SimTpuDeviceClient",
+    "TpuClient",
+    "TpuSliceDevice",
+]

@@ -1,0 +1,54 @@
+"""TpuClient: composed device view (reference pkg/gpu/mig/client.go:42-95,
+which composes nvml.Client + resource.Client)."""
+from __future__ import annotations
+
+from typing import List, Protocol
+
+from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+
+
+class TpuDeviceClient(Protocol):
+    """Carve-level access — the nvml.Client analogue
+    (pkg/gpu/nvml/interface.go:23-36). Implementations: the C++ tpuctl
+    binding on real hosts, SimTpuDeviceClient elsewhere."""
+
+    def get_slices(self, node_name: str) -> List[TpuSliceDevice]: ...
+
+    def create_slices(self, node_name: str, board_index: int, profile: str, quantity: int) -> None: ...
+
+    def delete_slice(self, node_name: str, device_id: str) -> None: ...
+
+
+class PodResourcesClient(Protocol):
+    """Which device ids pods actually hold — the kubelet pod-resources
+    analogue (pkg/resource/client.go:27-30)."""
+
+    def get_used_device_ids(self, node_name: str) -> List[str]: ...
+
+
+class TpuClient:
+    def __init__(self, device_client: TpuDeviceClient, pod_resources: PodResourcesClient) -> None:
+        self.device_client = device_client
+        self.pod_resources = pod_resources
+
+    def get_devices(self, node_name: str) -> List[TpuSliceDevice]:
+        """Carved slices with free/used status resolved."""
+        used_ids = set(self.pod_resources.get_used_device_ids(node_name))
+        out: List[TpuSliceDevice] = []
+        for device in self.device_client.get_slices(node_name):
+            status = DeviceStatus.USED if device.device_id in used_ids else DeviceStatus.FREE
+            out.append(
+                TpuSliceDevice(
+                    device_id=device.device_id,
+                    board_index=device.board_index,
+                    profile=device.profile,
+                    status=status,
+                )
+            )
+        return out
+
+    def create_slices(self, node_name: str, board_index: int, profile: str, quantity: int) -> None:
+        self.device_client.create_slices(node_name, board_index, profile, quantity)
+
+    def delete_slice(self, node_name: str, device_id: str) -> None:
+        self.device_client.delete_slice(node_name, device_id)

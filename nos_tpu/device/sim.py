@@ -1,0 +1,152 @@
+"""Simulated TPU device layer — the fake device plugin of BASELINE config #1.
+
+Plays the role real hardware + kubelet play in production: SimDevicePool is
+the per-node "silicon" (carved slices), SimPodResourcesClient derives which
+devices the scheduled pods hold, and SimDevicePlugin re-advertises the
+pool's slices into Node.status.allocatable (what a device-plugin restart
+does in the reference, pkg/gpu/client.go:51-135).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.tpu.topology import Topology
+from nos_tpu.util import resources as res
+
+
+class SimDevicePool:
+    """In-memory carved-slice registry per node (the 'hardware')."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # node -> device_id -> TpuSliceDevice (status field unused here)
+        self._slices: Dict[str, Dict[str, TpuSliceDevice]] = {}
+        self._counter = itertools.count(1)
+
+    def get(self, node_name: str) -> List[TpuSliceDevice]:
+        with self._lock:
+            return list(self._slices.get(node_name, {}).values())
+
+    def create(self, node_name: str, board_index: int, profile: str, quantity: int) -> None:
+        with self._lock:
+            node = self._slices.setdefault(node_name, {})
+            for _ in range(quantity):
+                device_id = f"tpu-{node_name}-{board_index}-{profile}-{next(self._counter)}"
+                node[device_id] = TpuSliceDevice(
+                    device_id=device_id, board_index=board_index, profile=profile
+                )
+
+    def delete(self, node_name: str, device_id: str) -> None:
+        with self._lock:
+            node = self._slices.get(node_name, {})
+            if device_id not in node:
+                raise NotFoundError(f"device {device_id} not found on {node_name}")
+            del node[device_id]
+
+    def geometry(self, node_name: str) -> Dict[int, Dict[str, int]]:
+        with self._lock:
+            out: Dict[int, Dict[str, int]] = {}
+            for device in self._slices.get(node_name, {}).values():
+                board = out.setdefault(device.board_index, {})
+                board[device.profile] = board.get(device.profile, 0) + 1
+            return out
+
+
+class SimTpuDeviceClient:
+    """TpuDeviceClient over a SimDevicePool."""
+
+    def __init__(self, pool: SimDevicePool) -> None:
+        self.pool = pool
+
+    def get_slices(self, node_name: str) -> List[TpuSliceDevice]:
+        return self.pool.get(node_name)
+
+    def create_slices(self, node_name: str, board_index: int, profile: str, quantity: int) -> None:
+        self.pool.create(node_name, board_index, profile, quantity)
+
+    def delete_slice(self, node_name: str, device_id: str) -> None:
+        self.pool.delete(node_name, device_id)
+
+
+class SimPodResourcesClient:
+    """Derives used device ids from the pods bound to the node, assigning
+    free devices of the requested profile deterministically (smallest id
+    first) — the sim stand-in for kubelet's allocation records."""
+
+    def __init__(self, store: KubeStore, pool: SimDevicePool) -> None:
+        self.store = store
+        self.pool = pool
+
+    def get_used_device_ids(self, node_name: str) -> List[str]:
+        from nos_tpu.api.v1alpha1 import labels
+
+        accelerator = ""
+        node = self.store.try_get("Node", node_name)
+        if node is not None:
+            accelerator = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+        demand: Dict[str, int] = {}
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name != node_name:
+                continue
+            if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
+                continue
+            request = res.compute_pod_request(pod)
+            if accelerator:
+                # Plain-chip pods hold carved slices (same normalization the
+                # scheduler applies when binding them).
+                request = res.normalize_tpu_request(request, accelerator)
+            for name, qty in request.items():
+                if constants.is_tpu_slice_resource(name):
+                    profile = constants.tpu_slice_topology(name)
+                    demand[profile] = demand.get(profile, 0) + int(qty)
+        used: List[str] = []
+        devices = sorted(self.pool.get(node_name), key=lambda d: d.device_id)
+        for device in devices:
+            if demand.get(device.profile, 0) > 0:
+                demand[device.profile] -= 1
+                used.append(device.device_id)
+        return used
+
+
+class SimDevicePlugin:
+    """Re-advertises the pool's carved slices on the Node object — what the
+    device-plugin restart accomplishes in the reference."""
+
+    def __init__(self, store: KubeStore, pool: SimDevicePool) -> None:
+        self.store = store
+        self.pool = pool
+
+    def restart(self, node_name: str) -> None:
+        geometry = self.pool.geometry(node_name)
+        try:
+            node = self.store.get("Node", node_name)
+        except NotFoundError:
+            return
+
+        slice_resources: Dict[str, int] = {}
+        chips_exposed = 0
+        for board in geometry.values():
+            for profile, qty in board.items():
+                name = constants.tpu_slice_resource(profile)
+                slice_resources[name] = slice_resources.get(name, 0) + qty
+                chips_exposed += Topology(profile).chips * qty
+
+        def mutate(n):
+            # Capacity stays the physical chip inventory (TpuNode derives its
+            # board layout from it); only allocatable carries the advertised
+            # scheduling view, where chips folded into slices are no longer
+            # directly requestable.
+            target = n.status.allocatable
+            total_chips = int(n.status.capacity.get(constants.RESOURCE_TPU, 0))
+            for key in [k for k in target if constants.is_tpu_slice_resource(k)]:
+                del target[key]
+            target.update(slice_resources)
+            target[constants.RESOURCE_TPU] = max(0, total_chips - chips_exposed)
+
+        self.store.patch_merge("Node", node_name, "", mutate)

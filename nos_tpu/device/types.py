@@ -1,0 +1,36 @@
+"""Device-layer types (reference pkg/resource/device.go:26-68 +
+pkg/gpu/device.go Device/DeviceList)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List
+
+
+class DeviceStatus:
+    FREE = "free"
+    USED = "used"
+
+
+@dataclass(frozen=True)
+class TpuSliceDevice:
+    """One carved TPU slice as exposed by the device plugin."""
+
+    device_id: str
+    board_index: int
+    profile: str  # topology string, e.g. "2x2"
+    status: str = DeviceStatus.FREE
+
+
+def group_geometries(
+    devices: Iterable[TpuSliceDevice],
+) -> Dict[str, Dict[int, Dict[str, int]]]:
+    """Devices → {status: {board: {profile: count}}} for annotation building
+    (reference pkg/gpu/device.go:98-120 AsStatusAnnotation)."""
+    out: Dict[str, Dict[int, Dict[str, int]]] = {
+        DeviceStatus.FREE: {},
+        DeviceStatus.USED: {},
+    }
+    for d in devices:
+        board = out[d.status].setdefault(d.board_index, {})
+        board[d.profile] = board.get(d.profile, 0) + 1
+    return out

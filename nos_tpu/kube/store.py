@@ -24,6 +24,10 @@ class NotFoundError(KeyError):
     pass
 
 
+class AdmissionError(ValueError):
+    """Raised by admission validators (the validating-webhook analogue)."""
+
+
 class AlreadyExistsError(ValueError):
     pass
 
@@ -61,6 +65,17 @@ class KubeStore:
         self._watchers: List[Tuple[Optional[set], "queue.Queue[WatchEvent]"]] = []
         # (kind, index_name) -> fn(obj) -> list of index values
         self._indexers: Dict[Tuple[str, str], Callable[[Any], List[str]]] = {}
+        # kind -> [validator(obj, store)] run before create/update commits —
+        # the validating-webhook admission seam (reference
+        # pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:31-97).
+        self._admission: Dict[str, List[Callable[[Any, "KubeStore"], None]]] = {}
+
+    def register_admission(self, kind: str, fn: Callable[[Any, "KubeStore"], None]) -> None:
+        self._admission.setdefault(kind, []).append(fn)
+
+    def _admit(self, obj: Any) -> None:
+        for fn in self._admission.get(obj.kind, []):
+            fn(obj, self)
 
     # ------------------------------------------------------------------ CRUD
 
@@ -69,6 +84,7 @@ class KubeStore:
             k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
             if k in self._objects:
                 raise AlreadyExistsError(f"{k} already exists")
+            self._admit(obj)
             self._rv += 1
             stored = copy.deepcopy(obj)
             stored.metadata.resource_version = self._rv
@@ -97,6 +113,7 @@ class KubeStore:
                 raise NotFoundError(f"{k} not found")
             if check_version and self._objects[k].metadata.resource_version != obj.metadata.resource_version:
                 raise ConflictError(f"{k}: resource version conflict")
+            self._admit(obj)
             self._rv += 1
             stored = copy.deepcopy(obj)
             stored.metadata.resource_version = self._rv
@@ -149,6 +166,7 @@ class KubeStore:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             obj = copy.deepcopy(self._objects[k])
             mutate(obj)
+            self._admit(obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[k] = obj

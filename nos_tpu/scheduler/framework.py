@@ -19,6 +19,7 @@ from nos_tpu.util import resources as res
 class StatusCode:
     SUCCESS = "Success"
     UNSCHEDULABLE = "Unschedulable"
+    WAIT = "Wait"  # Permit: hold the pod (gang scheduling)
     ERROR = "Error"
 
 
@@ -39,6 +40,10 @@ class Status:
     @staticmethod
     def unschedulable(message: str, plugin: str = "") -> "Status":
         return Status(StatusCode.UNSCHEDULABLE, message, plugin)
+
+    @staticmethod
+    def wait(message: str, plugin: str = "") -> "Status":
+        return Status(StatusCode.WAIT, message, plugin)
 
     @staticmethod
     def error(message: str, plugin: str = "") -> "Status":
@@ -63,9 +68,21 @@ class NodeInfo:
         return self.node.metadata.name
 
     def requested(self) -> ResourceList:
+        from nos_tpu.api.v1alpha1 import labels
+
+        node_labels = self.node.metadata.labels
+        accelerator = ""
+        if node_labels.get(labels.PARTITIONING_LABEL) == labels.PartitioningKind.TPU:
+            accelerator = node_labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
         total: ResourceList = {}
         for pod in self.pods:
-            total = res.sum_resources(total, res.compute_pod_request(pod))
+            request = res.compute_pod_request(pod)
+            if accelerator:
+                # Bound plain-chip pods occupy carved slices: account them in
+                # the same denomination the node advertises, or they would
+                # not deplete slice allocatable (double-booking).
+                request = res.normalize_tpu_request(request, accelerator)
+            total = res.sum_resources(total, request)
         return total
 
     def available(self) -> ResourceList:
@@ -119,6 +136,14 @@ class ReservePlugin(Protocol):
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None: ...
 
 
+class ScorePlugin(Protocol):
+    name: str
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
+        """0-100; higher is better."""
+        ...
+
+
 class PermitPlugin(Protocol):
     name: str
 
@@ -135,12 +160,14 @@ class Framework:
         post_filter_plugins: Sequence[PostFilterPlugin] = (),
         reserve_plugins: Sequence[ReservePlugin] = (),
         permit_plugins: Sequence[PermitPlugin] = (),
+        score_plugins: Sequence[ScorePlugin] = (),
     ) -> None:
         self.pre_filter_plugins = list(pre_filter_plugins)
         self.filter_plugins = list(filter_plugins)
         self.post_filter_plugins = list(post_filter_plugins)
         self.reserve_plugins = list(reserve_plugins)
         self.permit_plugins = list(permit_plugins)
+        self.score_plugins = list(score_plugins)
 
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
         for p in self.pre_filter_plugins:
@@ -181,6 +208,9 @@ class Framework:
         for p in self.reserve_plugins:
             p.unreserve(state, pod, node_name)
 
+    def run_score_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
+        return sum(p.score(state, pod, node_info) for p in self.score_plugins)
+
     def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for p in self.permit_plugins:
             status = p.permit(state, pod, node_name)
@@ -193,12 +223,32 @@ class Framework:
 class NodeResourcesFit:
     """Stock resource-fit filter (the part of the vanilla scheduler the
     simulation relies on: SURVEY.md §3.2 'NodeResourcesFit sees the
-    partitioned scalar resources')."""
+    partitioned scalar resources').
+
+    On TPU-partitioned nodes a plain ``google.com/tpu: N`` request is
+    normalized to the node generation's slice profile first: sub-host chip
+    requests are only satisfiable through carved slices (GKE exposes whole
+    hosts; slicing is this suite's job), so a virgin node's raw chip
+    allocatable must not admit partial-chip pods behind the planner's back.
+    """
 
     name = "NodeResourcesFit"
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        from nos_tpu.api.v1alpha1 import constants, labels
+
         request = res.compute_pod_request(pod)
+        node_labels = node_info.node.metadata.labels
+        if node_labels.get(labels.PARTITIONING_LABEL) == labels.PartitioningKind.TPU:
+            accelerator = node_labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+            if accelerator:
+                request = res.normalize_tpu_request(request, accelerator)
+                if request.get(constants.RESOURCE_TPU, 0) > 0:
+                    return Status.unschedulable(
+                        "TPU request exceeds any single-host slice profile "
+                        "(multi-host gang required)",
+                        self.name,
+                    )
         available = node_info.available()
         for resource, qty in request.items():
             if qty > available.get(resource, 0):

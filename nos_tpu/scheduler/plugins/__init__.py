@@ -1,0 +1,12 @@
+from nos_tpu.scheduler.plugins.capacity import CapacityScheduling, ElasticQuotaInfo, ElasticQuotaInfos
+from nos_tpu.scheduler.plugins.gang import GangScheduling, gang_of
+from nos_tpu.scheduler.plugins.topology import IciTopologyScoring
+
+__all__ = [
+    "CapacityScheduling",
+    "ElasticQuotaInfo",
+    "ElasticQuotaInfos",
+    "GangScheduling",
+    "IciTopologyScoring",
+    "gang_of",
+]

@@ -1,0 +1,291 @@
+"""CapacityScheduling: elastic-quota enforcement + fair-share preemption.
+
+Reference pkg/scheduler/plugins/capacityscheduling/capacity_scheduling.go
+(PreFilter :190-278, PostFilter/preemption :323-341 + :468-675, Reserve
+:343-369) and elasticquotainfo.go:30-361. Quota semantics:
+
+- a namespace may always use up to its guaranteed ``min``;
+- it may *borrow* beyond min up to ``max``, but only from the cluster-wide
+  pool of unused guaranteed quota (the aggregated-min check);
+- pods running beyond min are labeled over-quota by the operator and are
+  preemptible by namespaces still below their guaranteed share, where the
+  guaranteed share includes the fair redistribution of unused min:
+  guaranteed_overquota_i = floor(min_i/Σmin · Σ_j max(0, min_j - used_j))
+  (elasticquotainfo.go:81-152).
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, List, Optional, Set, Tuple
+
+from nos_tpu.api.v1alpha1 import labels as labels_api
+from nos_tpu.kube.objects import Pod, PodPhase, ResourceList
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.util import pod as podutil
+from nos_tpu.util import resources as res
+
+log = logging.getLogger("nos_tpu.scheduler.capacity")
+
+STATE_KEY = "capacity-scheduling"
+
+
+class ElasticQuotaInfo:
+    def __init__(
+        self,
+        name: str,
+        namespaces: Set[str],
+        min_resources: ResourceList,
+        max_resources: Optional[ResourceList],
+    ) -> None:
+        self.name = name
+        self.namespaces = set(namespaces)
+        self.min = dict(min_resources)
+        self.max = dict(max_resources) if max_resources else None
+        self.used: ResourceList = {}
+        self.pods: Set[str] = set()
+
+    # ------------------------------------------------------- accounting
+
+    def add_pod(self, key: str, request: ResourceList) -> None:
+        if key in self.pods:
+            return
+        self.pods.add(key)
+        self.used = res.sum_resources(self.used, request)
+
+    def remove_pod(self, key: str, request: ResourceList) -> None:
+        if key not in self.pods:
+            return
+        self.pods.discard(key)
+        self.used = res.subtract_resources(self.used, request)
+
+    # ----------------------------------------------------------- checks
+
+    def used_over_min_with(self, request: ResourceList) -> bool:
+        return any(
+            self.used.get(k, 0) + v > self.min.get(k, 0)
+            for k, v in request.items()
+            if k in self.min
+        )
+
+    def used_over_max_with(self, request: ResourceList) -> bool:
+        if self.max is None:
+            return False
+        return any(
+            self.used.get(k, 0) + v > self.max[k]
+            for k, v in request.items()
+            if k in self.max
+        )
+
+    def clone(self) -> "ElasticQuotaInfo":
+        c = ElasticQuotaInfo(self.name, self.namespaces, self.min, self.max)
+        c.used = dict(self.used)
+        c.pods = set(self.pods)
+        return c
+
+
+class ElasticQuotaInfos:
+    def __init__(self, infos: List[ElasticQuotaInfo]) -> None:
+        self._infos = {i.name: i for i in infos}
+        self._by_namespace: Dict[str, ElasticQuotaInfo] = {}
+        for info in infos:
+            for ns in info.namespaces:
+                self._by_namespace[ns] = info
+
+    def __iter__(self):
+        return iter(self._infos.values())
+
+    def get(self, name: str) -> Optional[ElasticQuotaInfo]:
+        return self._infos.get(name)
+
+    def for_namespace(self, ns: str) -> Optional[ElasticQuotaInfo]:
+        return self._by_namespace.get(ns)
+
+    def clone(self) -> "ElasticQuotaInfos":
+        return ElasticQuotaInfos([i.clone() for i in self._infos.values()])
+
+    # -------------------------------------------------- aggregate math
+
+    def aggregated_min(self, resource: str) -> float:
+        return sum(i.min.get(resource, 0) for i in self._infos.values())
+
+    def aggregated_used(self, resource: str) -> float:
+        return sum(i.used.get(resource, 0) for i in self._infos.values())
+
+    def aggregated_used_over_min_with(self, request: ResourceList) -> bool:
+        """True when serving `request` would push cluster-wide usage of any
+        quota-tracked resource beyond the sum of guaranteed minimums — i.e.
+        the borrowing pool is exhausted (capacity_scheduling.go:268-275)."""
+        for resource, qty in request.items():
+            agg_min = self.aggregated_min(resource)
+            if agg_min == 0:
+                continue
+            if self.aggregated_used(resource) + qty > agg_min:
+                return True
+        return False
+
+    def guaranteed_overquota(self, name: str, resource: str) -> float:
+        """floor(min_i/Σmin · Σ_j max(0, min_j-used_j)) — quota `name`'s fair
+        share of currently-unused guaranteed capacity
+        (elasticquotainfo.go:81-152)."""
+        info = self._infos.get(name)
+        if info is None:
+            return 0
+        agg_min = self.aggregated_min(resource)
+        if agg_min == 0:
+            return 0
+        unused = sum(
+            max(0.0, i.min.get(resource, 0) - i.used.get(resource, 0))
+            for i in self._infos.values()
+        )
+        return math.floor(info.min.get(resource, 0) / agg_min * unused)
+
+    def within_guaranteed_with(self, name: str, request: ResourceList) -> bool:
+        """used+request ≤ min + guaranteed_overquota for every requested
+        quota resource: the preemptor is entitled to this capacity."""
+        info = self._infos.get(name)
+        if info is None:
+            return False
+        for resource, qty in request.items():
+            if resource not in info.min:
+                continue
+            entitled = info.min.get(resource, 0) + self.guaranteed_overquota(name, resource)
+            if info.used.get(resource, 0) + qty > entitled:
+                return False
+        return True
+
+
+def build_quota_infos(store: KubeStore) -> ElasticQuotaInfos:
+    """Informer-bridge analogue (capacityscheduling/informer.go:57-300):
+    CEQs cover their namespace lists and shadow per-namespace EQs; usage is
+    rebuilt from pods bound to nodes."""
+    infos: List[ElasticQuotaInfo] = []
+    covered: Set[str] = set()
+    for ceq in store.list("CompositeElasticQuota"):
+        infos.append(
+            ElasticQuotaInfo(
+                name=f"ceq/{ceq.metadata.name}",
+                namespaces=set(ceq.spec.namespaces),
+                min_resources=ceq.spec.min,
+                max_resources=ceq.spec.max or None,
+            )
+        )
+        covered.update(ceq.spec.namespaces)
+    for eq in store.list("ElasticQuota"):
+        if eq.metadata.namespace in covered:
+            continue
+        infos.append(
+            ElasticQuotaInfo(
+                name=f"eq/{eq.metadata.namespace}/{eq.metadata.name}",
+                namespaces={eq.metadata.namespace},
+                min_resources=eq.spec.min,
+                max_resources=eq.spec.max or None,
+            )
+        )
+    result = ElasticQuotaInfos(infos)
+    for pod in store.list("Pod"):
+        if not pod.spec.node_name or pod.status.phase not in (
+            PodPhase.PENDING,
+            PodPhase.RUNNING,
+        ):
+            continue
+        info = result.for_namespace(pod.metadata.namespace)
+        if info is not None:
+            info.add_pod(
+                pod.namespaced_name,
+                quota_request(pod),
+            )
+    return result
+
+
+def quota_request(pod: Pod) -> ResourceList:
+    """Pod request with the aggregate chip resource injected, so quotas can
+    be expressed in nos.nebuly.com/tpu-chips (the reference injects
+    nos.nebuly.com/gpu-memory, pkg/gpu/util/resource.go:60-86)."""
+    return res.with_aggregate_tpu_chips(res.compute_pod_request(pod))
+
+
+class CapacityScheduling:
+    name = "CapacityScheduling"
+
+    def __init__(self, store: KubeStore) -> None:
+        self.store = store
+        # Reservations in flight (bound this cycle but possibly not yet
+        # re-listed): quota name -> pod key -> request.
+        self._reserved: Dict[str, Dict[str, ResourceList]] = {}
+
+    # -------------------------------------------------------- snapshot
+
+    def snapshot(self) -> ElasticQuotaInfos:
+        infos = build_quota_infos(self.store)
+        for quota_name, pods in self._reserved.items():
+            info = infos.get(quota_name)
+            if info is None:
+                continue
+            for key, request in pods.items():
+                info.add_pod(key, request)
+        return infos
+
+    # -------------------------------------------------------- prefilter
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        infos = self.snapshot()
+        state[STATE_KEY] = infos
+        return self.check_quota(pod, infos)
+
+    @staticmethod
+    def check_quota(pod: Pod, infos: ElasticQuotaInfos) -> Status:
+        """The quota admission decision, reusable against simulated infos
+        (preemption evaluates victims by re-running this)."""
+        info = infos.for_namespace(pod.metadata.namespace)
+        if info is None:
+            return Status.ok()
+        request = quota_request(pod)
+        tracked = {
+            k: v for k, v in request.items() if k in info.min or (info.max and k in info.max)
+        }
+        if not tracked:
+            return Status.ok()
+        if info.used_over_max_with(request):
+            return Status.unschedulable(
+                f"quota {info.name}: max exceeded", CapacityScheduling.name
+            )
+        if info.used_over_min_with(request) and infos.aggregated_used_over_min_with(
+            {k: v for k, v in request.items() if k in info.min}
+        ):
+            return Status.unschedulable(
+                f"quota {info.name}: cluster guaranteed pool exhausted",
+                CapacityScheduling.name,
+            )
+        return Status.ok()
+
+    # --------------------------------------------------------- reserve
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        infos = state.get(STATE_KEY) or self.snapshot()
+        info = infos.for_namespace(pod.metadata.namespace)
+        if info is not None:
+            self._reserved.setdefault(info.name, {})[pod.namespaced_name] = quota_request(pod)
+        return Status.ok()
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for pods in self._reserved.values():
+            pods.pop(pod.namespaced_name, None)
+
+    def forget(self, pod: Pod) -> None:
+        """Drop any reservation once the pod is visibly bound in the store."""
+        self.unreserve(CycleState(), pod, "")
+
+    # ------------------------------------------------------ postfilter
+
+    def post_filter(
+        self, state: CycleState, pod: Pod, filtered_nodes: Dict[str, Status]
+    ) -> Optional[str]:
+        """Preemption: find a node where evicting eligible victims makes the
+        pod schedulable; evict them and nominate the node."""
+        from nos_tpu.scheduler.preemption import Preemptor
+
+        infos: ElasticQuotaInfos = state.get(STATE_KEY) or self.snapshot()
+        preemptor = Preemptor(self.store, self, infos)
+        return preemptor.preempt(state, pod, filtered_nodes)

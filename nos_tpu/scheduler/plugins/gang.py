@@ -1,0 +1,124 @@
+"""Gang scheduling: all-or-nothing placement for multi-host JAX jobs.
+
+The reference has no gang plugin — this is the TPU-specific extension the
+build plan requires (SURVEY.md §7 step 6): a multi-host job (e.g. a JAX
+training Pod per TPU worker) must either get all its workers placed inside
+one ICI domain or none, otherwise the placed subset deadlocks chips.
+
+Implemented in the coscheduling style over the Permit extension point:
+each member reserves resources and WAITs; when the last member arrives the
+whole gang is released for binding; a forming gang that cannot complete
+within the timeout is failed and unreserved as a unit.
+
+Pods declare membership with labels:
+  nos.nebuly.com/gang       = <gang name, unique per namespace>
+  nos.nebuly.com/gang-size  = "<member count>"
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu.kube.objects import Pod, PodPhase
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.framework import CycleState, Status
+
+log = logging.getLogger("nos_tpu.scheduler.gang")
+
+GANG_NAME_LABEL = "nos.nebuly.com/gang"
+GANG_SIZE_LABEL = "nos.nebuly.com/gang-size"
+
+
+def gang_of(pod: Pod) -> Optional[Tuple[str, int]]:
+    """(gang key, size) or None. Malformed sizes mean no gang."""
+    name = pod.metadata.labels.get(GANG_NAME_LABEL)
+    if not name:
+        return None
+    try:
+        size = int(pod.metadata.labels.get(GANG_SIZE_LABEL, ""))
+    except ValueError:
+        return None
+    if size < 1:
+        return None
+    return f"{pod.metadata.namespace}/{name}", size
+
+
+@dataclass
+class _WaitingGang:
+    size: int
+    deadline: float
+    members: Dict[str, Tuple[Pod, str]] = field(default_factory=dict)  # key -> (pod, node)
+
+
+class GangScheduling:
+    name = "GangScheduling"
+
+    def __init__(self, store: KubeStore, wait_timeout_seconds: float = 30.0) -> None:
+        self.store = store
+        self.timeout = wait_timeout_seconds
+        self._lock = threading.Lock()
+        self._waiting: Dict[str, _WaitingGang] = {}
+
+    # ----------------------------------------------------------- permit
+
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        gang = gang_of(pod)
+        if gang is None:
+            return Status.ok()
+        key, size = gang
+        bound = self._bound_members(key)
+        with self._lock:
+            waiting = self._waiting.setdefault(
+                key, _WaitingGang(size=size, deadline=time.monotonic() + self.timeout)
+            )
+            waiting.members[pod.namespaced_name] = (pod, node_name)
+            arrived = len(waiting.members) + bound
+            if arrived >= size:
+                return Status.ok()
+            return Status.wait(
+                f"gang {key}: {arrived}/{size} members placed", self.name
+            )
+
+    def release(self, pod: Pod) -> List[Tuple[Pod, str]]:
+        """On a successful permit, the whole waiting gang binds together.
+        Returns the other members to bind (the permitted pod included)."""
+        gang = gang_of(pod)
+        if gang is None:
+            return []
+        key, _ = gang
+        with self._lock:
+            waiting = self._waiting.pop(key, None)
+        if waiting is None:
+            return []
+        return list(waiting.members.values())
+
+    # ---------------------------------------------------------- timeout
+
+    def expired_gangs(self) -> List[List[Tuple[Pod, str]]]:
+        """Gangs whose formation timed out: their members must be
+        unreserved and marked unschedulable as a unit."""
+        now = time.monotonic()
+        out: List[List[Tuple[Pod, str]]] = []
+        with self._lock:
+            for key in [k for k, g in self._waiting.items() if g.deadline <= now]:
+                out.append(list(self._waiting.pop(key).members.values()))
+        return out
+
+    def waiting_count(self) -> int:
+        with self._lock:
+            return sum(len(g.members) for g in self._waiting.values())
+
+    # ----------------------------------------------------------- helpers
+
+    def _bound_members(self, gang_key: str) -> int:
+        ns, name = gang_key.split("/", 1)
+        return sum(
+            1
+            for p in self.store.list("Pod", namespace=ns)
+            if p.metadata.labels.get(GANG_NAME_LABEL) == name
+            and p.spec.node_name
+            and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        )

@@ -1,0 +1,85 @@
+"""ICI topology scoring: placement quality for TPU slices.
+
+The TPU extension of the reference's plugin set (SURVEY.md §7 step 6: "keep
+a job's chips in one contiguous slice/domain"). Three signals:
+
+1. exact-fit: a node holding a free slice of exactly the requested profile
+   beats one that would strand a bigger slice;
+2. consolidation: prefer filling already-carved nodes, keeping virgin
+   boards whole for future large slices (bin packing);
+3. gang/ICI affinity: members of the same gang score higher on nodes of the
+   node pool where members already landed — multi-host slice workers share
+   a GKE node pool, which is the ICI domain boundary.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Pod, PodPhase
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.framework import CycleState, NodeInfo
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, gang_of
+from nos_tpu.tpu.topology import Topology
+from nos_tpu.util import resources as res
+
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
+
+class IciTopologyScoring:
+    name = "IciTopologyScoring"
+
+    def __init__(self, store: Optional[KubeStore] = None) -> None:
+        self.store = store
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
+        total = 0
+        request = res.compute_pod_request(pod)
+        available = node_info.available()
+
+        requested_profiles = {
+            constants.tpu_slice_topology(name): qty
+            for name, qty in request.items()
+            if constants.is_tpu_slice_resource(name)
+        }
+        if requested_profiles:
+            # 1. exact-fit: every requested profile available as-is.
+            if all(
+                available.get(constants.tpu_slice_resource(p), 0) >= qty
+                for p, qty in requested_profiles.items()
+            ):
+                total += 50
+            # 2. consolidation: fraction of the node's slice chips in use.
+            slice_chips = sum(
+                Topology(constants.tpu_slice_topology(name)).chips * int(qty)
+                for name, qty in node_info.node.status.allocatable.items()
+                if constants.is_tpu_slice_resource(name)
+            )
+            if slice_chips > 0:
+                free_chips = sum(
+                    Topology(constants.tpu_slice_topology(name)).chips * int(qty)
+                    for name, qty in available.items()
+                    if constants.is_tpu_slice_resource(name) and qty > 0
+                )
+                total += int(30 * (1 - free_chips / slice_chips))
+
+        # 3. gang/ICI affinity via shared node pool.
+        gang = gang_of(pod)
+        if gang and self.store is not None:
+            pool = node_info.node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+            if pool:
+                ns, name = gang[0].split("/", 1)
+                for member in self.store.list("Pod", namespace=ns):
+                    if (
+                        member.metadata.labels.get(GANG_NAME_LABEL) == name
+                        and member.spec.node_name
+                        and member.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+                    ):
+                        member_node = self.store.try_get("Node", member.spec.node_name)
+                        if (
+                            member_node is not None
+                            and member_node.metadata.labels.get(GKE_NODEPOOL_LABEL) == pool
+                        ):
+                            total += 20
+                            break
+        return total

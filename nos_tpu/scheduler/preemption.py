@@ -1,0 +1,155 @@
+"""Preemption evaluator (reference capacity_scheduling.go:371-675).
+
+Victim selection per elastic-quota semantics (SelectVictimsOnNode,
+:468-675): same-quota victims must have lower priority than the preemptor;
+cross-quota victims must be running over-quota (label written by the
+operator) and the preemptor must still be within its guaranteed share
+(min + fair redistribution of unused min). The reprieve loop then re-adds
+victims (highest priority first) while the pod stays feasible, minimizing
+evictions; the reference's PDB-aware reprieve (:626-674) reduces to this
+without PodDisruptionBudgets.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu.kube.objects import Pod, PodPhase
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.util import pod as podutil
+
+log = logging.getLogger("nos_tpu.scheduler.preemption")
+
+
+class Preemptor:
+    def __init__(self, store: KubeStore, plugin, infos) -> None:
+        self.store = store
+        self.plugin = plugin  # CapacityScheduling (provides .framework)
+        self.infos = infos
+
+    # ----------------------------------------------------------- entry
+
+    def preempt(
+        self, state: CycleState, pod: Pod, filtered_nodes: Dict[str, Status]
+    ) -> Optional[str]:
+        framework = getattr(self.plugin, "framework", None)
+        if framework is None:
+            return None
+        best: Optional[Tuple[str, List[Pod]]] = None
+        for node_name in sorted(filtered_nodes):
+            node_info = self._node_info(node_name)
+            if node_info is None:
+                continue
+            victims = self.select_victims_on_node(state, pod, node_info, framework)
+            if victims is None:
+                continue
+            key = (len(victims), max((v.spec.priority for v in victims), default=0))
+            if best is None or key < (
+                len(best[1]),
+                max((v.spec.priority for v in best[1]), default=0),
+            ):
+                best = (node_name, victims)
+        if best is None:
+            return None
+        node_name, victims = best
+        for victim in victims:
+            log.info(
+                "preempting %s on %s for %s",
+                victim.namespaced_name,
+                node_name,
+                pod.namespaced_name,
+            )
+            try:
+                self.store.delete("Pod", victim.metadata.name, victim.metadata.namespace)
+            except NotFoundError:
+                pass
+        return node_name
+
+    # ---------------------------------------------------------- victims
+
+    def select_victims_on_node(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo, framework
+    ) -> Optional[List[Pod]]:
+        eligible = [v for v in node_info.pods if self._eligible(pod, v)]
+        if not eligible:
+            return None
+        from nos_tpu.scheduler.plugins.capacity import CapacityScheduling, quota_request
+
+        # Feasibility is node filters AND the quota admission re-evaluated
+        # against simulated usage — a victim whose eviction only relieves
+        # quota pressure (node has headroom) must not be reprieved.
+        sim_infos = self.infos.clone()
+
+        def feasible(trial: NodeInfo) -> bool:
+            if not framework.run_filter_plugins(state, pod, trial).success:
+                return False
+            return CapacityScheduling.check_quota(pod, sim_infos).success
+
+        def evict_sim(victim: Pod) -> None:
+            v_info = sim_infos.for_namespace(victim.metadata.namespace)
+            if v_info is not None:
+                v_info.remove_pod(victim.namespaced_name, quota_request(victim))
+
+        def restore_sim(victim: Pod) -> None:
+            v_info = sim_infos.for_namespace(victim.metadata.namespace)
+            if v_info is not None:
+                v_info.add_pod(victim.namespaced_name, quota_request(victim))
+
+        trial = NodeInfo(node=node_info.node, pods=list(node_info.pods))
+        for v in eligible:
+            trial.remove_pod(v)
+            evict_sim(v)
+        if not feasible(trial):
+            return None
+        # Reprieve: re-add victims (highest priority, then newest first)
+        # while the pod stays feasible.
+        victims: List[Pod] = []
+        for v in sorted(
+            eligible,
+            key=lambda p: (-p.spec.priority, -p.metadata.creation_timestamp),
+        ):
+            trial.add_pod(v)
+            restore_sim(v)
+            if feasible(trial):
+                continue  # reprieved
+            trial.remove_pod(v)
+            evict_sim(v)
+            victims.append(v)
+        return victims if victims else None
+
+    def _eligible(self, preemptor: Pod, victim: Pod) -> bool:
+        if victim.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
+            return False
+        p_info = self.infos.for_namespace(preemptor.metadata.namespace)
+        v_info = self.infos.for_namespace(victim.metadata.namespace)
+        same_quota = (
+            p_info is not None and v_info is not None and p_info.name == v_info.name
+        ) or (p_info is None and v_info is None and
+              preemptor.metadata.namespace == victim.metadata.namespace)
+        if same_quota:
+            # Intra-quota: plain priority preemption (:468-541).
+            return victim.spec.priority < preemptor.spec.priority
+        # Cross-quota: only over-quota (borrowed) capacity is reclaimable,
+        # and only by a preemptor still entitled to guaranteed capacity.
+        if not podutil.is_over_quota(victim):
+            return False
+        if p_info is None:
+            return False
+        from nos_tpu.scheduler.plugins.capacity import quota_request
+
+        return self.infos.within_guaranteed_with(p_info.name, quota_request(preemptor))
+
+    # ----------------------------------------------------------- helpers
+
+    def _node_info(self, node_name: str) -> Optional[NodeInfo]:
+        node = self.store.try_get("Node", node_name)
+        if node is None:
+            return None
+        pods = [
+            p
+            for p in self.store.list("Pod")
+            if p.spec.node_name == node_name
+            and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        ]
+        return NodeInfo(node=node, pods=pods)

@@ -1,0 +1,248 @@
+"""The scheduler: a full scheduling cycle per pending pod.
+
+Plays the role of the reference's `scheduler` binary (cmd/scheduler/
+scheduler.go:43-59 — vanilla kube-scheduler + CapacityScheduling): watch
+pending pods, PreFilter → Filter over all nodes → Score → Reserve → Permit
+→ Bind, with PostFilter preemption when filtering leaves nothing, and
+Permit-wait for gang formation. Failure marks the pod's PodScheduled
+condition Unschedulable — exactly the signal the partitioner controller
+batches on, closing the carve-and-retry loop.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.objects import Pod, PodCondition, PodPhase
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.scheduler.framework import (
+    CycleState,
+    Framework,
+    NodeInfo,
+    NodeResourcesFit,
+    NodeSelectorFit,
+    Status,
+    StatusCode,
+)
+from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
+from nos_tpu.scheduler.plugins.gang import GangScheduling
+from nos_tpu.scheduler.plugins.topology import IciTopologyScoring
+
+log = logging.getLogger("nos_tpu.scheduler")
+
+
+def new_framework(
+    store: KubeStore, gang_timeout_seconds: float = 30.0
+) -> "tuple[Framework, CapacityScheduling, GangScheduling]":
+    """Default plugin wiring (the in-tree registry + nos plugins, reference
+    cmd/gpupartitioner/gpupartitioner.go:294-318 and cmd/scheduler)."""
+    capacity = CapacityScheduling(store)
+    gang = GangScheduling(store, wait_timeout_seconds=gang_timeout_seconds)
+    framework = Framework(
+        pre_filter_plugins=[capacity],
+        filter_plugins=[NodeResourcesFit(), NodeSelectorFit()],
+        post_filter_plugins=[capacity],
+        reserve_plugins=[capacity],
+        permit_plugins=[gang],
+        score_plugins=[IciTopologyScoring(store)],
+    )
+    capacity.framework = framework  # preemption re-runs the filters
+    return framework, capacity, gang
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: KubeStore,
+        framework: Framework,
+        capacity: Optional[CapacityScheduling] = None,
+        gang: Optional[GangScheduling] = None,
+        retry_seconds: float = 0.5,
+    ) -> None:
+        self.store = store
+        self.framework = framework
+        self.capacity = capacity
+        self.gang = gang
+        self.retry = retry_seconds
+        self.pods_scheduled = 0
+        self.schedule_latencies: List[float] = []  # per-pod, seconds
+        # Assume cache: pods reserved on a node but not yet bound (gang
+        # members waiting in Permit). Without it, concurrent cycles would
+        # stack every waiting member onto the same node.
+        self._assumed: Dict[str, tuple] = {}  # pod key -> (pod, node_name)
+
+    # --------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        self._handle_gang_timeouts()
+        pod = self.store.try_get("Pod", req.name, req.namespace)
+        if pod is None:
+            return None
+        if pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
+            if self.capacity is not None:
+                self.capacity.forget(pod)
+            return None
+        if pod.namespaced_name in self._assumed:
+            # Gang member validly waiting in Permit: its reservation holds;
+            # re-running the cycle would see its own assumed claim and
+            # falsely mark it unschedulable.
+            return Result(requeue_after=self.retry)
+        return self.schedule_one(pod)
+
+    # ------------------------------------------------------------ cycle
+
+    def schedule_one(self, pod: Pod) -> Optional[Result]:
+        start = time.monotonic()
+        state = CycleState()
+        status = self.framework.run_pre_filter_plugins(state, pod)
+        if not status.success:
+            # PreFilter rejection (e.g. quota max) still gets a preemption
+            # attempt — evicting victims may change the quota math
+            # (capacity_scheduling.go PostFilter runs on any failure).
+            filtered = {name: status for name in self._node_infos()}
+            nominated = self.framework.run_post_filter_plugins(state, pod, filtered)
+            if nominated:
+                self._set_nominated(pod, nominated)
+                return Result(requeue_after=self.retry / 2)
+            self._mark_unschedulable(pod, status.message)
+            return Result(requeue_after=self.retry)
+
+        node_infos = self._node_infos()
+        feasible: List[NodeInfo] = []
+        filtered: Dict[str, Status] = {}
+        for info in node_infos.values():
+            node_status = self.framework.run_filter_plugins(state, pod, info)
+            if node_status.success:
+                feasible.append(info)
+            else:
+                filtered[info.name] = node_status
+
+        if not feasible:
+            nominated = self.framework.run_post_filter_plugins(state, pod, filtered)
+            if nominated:
+                self._set_nominated(pod, nominated)
+                # Victims are terminating; retry shortly.
+                return Result(requeue_after=self.retry / 2)
+            self._mark_unschedulable(
+                pod, "; ".join(s.message for s in filtered.values()) or "no nodes"
+            )
+            return Result(requeue_after=self.retry)
+
+        best = max(
+            feasible,
+            key=lambda info: (self.framework.run_score_plugins(state, pod, info), info.name),
+        )
+        status = self.framework.run_reserve_plugins(state, pod, best.name)
+        if not status.success:
+            self._mark_unschedulable(pod, status.message)
+            return Result(requeue_after=self.retry)
+
+        permit = self.framework.run_permit_plugins(state, pod, best.name)
+        if permit.code == StatusCode.WAIT:
+            # Gang forming: reservation held, pod stays pending but its
+            # claim on the node must be visible to later cycles.
+            self._assumed[pod.namespaced_name] = (pod, best.name)
+            log.info("scheduler: %s waiting (%s)", pod.namespaced_name, permit.message)
+            return Result(requeue_after=self.retry)
+        if not permit.success:
+            self.framework.run_unreserve_plugins(state, pod, best.name)
+            self._mark_unschedulable(pod, permit.message)
+            return Result(requeue_after=self.retry)
+
+        # Bind — and release any gang members waiting on this quorum.
+        to_bind = [(pod, best.name)]
+        if self.gang is not None:
+            released = self.gang.release(pod)
+            if released:
+                to_bind = released
+                if all(key[0].namespaced_name != pod.namespaced_name for key in released):
+                    to_bind.append((pod, best.name))
+        for bind_pod, node_name in to_bind:
+            self._assumed.pop(bind_pod.namespaced_name, None)
+            self._bind(bind_pod, node_name)
+        self.schedule_latencies.append(time.monotonic() - start)
+        return None
+
+    # ----------------------------------------------------------- helpers
+
+    def _node_infos(self) -> Dict[str, NodeInfo]:
+        infos: Dict[str, NodeInfo] = {}
+        for node in self.store.list("Node"):
+            infos[node.metadata.name] = NodeInfo(node=node)
+        for p in self.store.list("Pod"):
+            if p.spec.node_name in infos and p.status.phase in (
+                PodPhase.PENDING,
+                PodPhase.RUNNING,
+            ):
+                infos[p.spec.node_name].add_pod(p)
+        for key, (assumed_pod, node_name) in self._assumed.items():
+            if node_name in infos and all(
+                p.namespaced_name != key for p in infos[node_name].pods
+            ):
+                infos[node_name].add_pod(assumed_pod)
+        return infos
+
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        def mutate(p):
+            p.spec.node_name = node_name
+            p.status.nominated_node_name = ""
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ]
+            p.status.conditions.append(
+                PodCondition(type="PodScheduled", status="True")
+            )
+
+        try:
+            self.store.patch_merge("Pod", pod.metadata.name, pod.metadata.namespace, mutate)
+        except NotFoundError:
+            return
+        self.pods_scheduled += 1
+        log.info("scheduler: bound %s to %s", pod.namespaced_name, node_name)
+
+    def _mark_unschedulable(self, pod: Pod, message: str) -> None:
+        if pod.unschedulable():
+            return  # already marked; avoid patch churn
+
+        def mutate(p):
+            p.status.conditions = [
+                c for c in p.status.conditions if c.type != "PodScheduled"
+            ]
+            p.status.conditions.append(
+                PodCondition(
+                    type="PodScheduled",
+                    status="False",
+                    reason="Unschedulable",
+                    message=message,
+                )
+            )
+
+        try:
+            self.store.patch_merge("Pod", pod.metadata.name, pod.metadata.namespace, mutate)
+        except NotFoundError:
+            pass
+
+    def _set_nominated(self, pod: Pod, node_name: str) -> None:
+        def mutate(p):
+            p.status.nominated_node_name = node_name
+
+        try:
+            self.store.patch_merge("Pod", pod.metadata.name, pod.metadata.namespace, mutate)
+        except NotFoundError:
+            pass
+
+    def _handle_gang_timeouts(self) -> None:
+        if self.gang is None:
+            return
+        for members in self.gang.expired_gangs():
+            for member_pod, node_name in members:
+                state = CycleState()
+                self._assumed.pop(member_pod.namespaced_name, None)
+                self.framework.run_unreserve_plugins(state, member_pod, node_name)
+                self._mark_unschedulable(member_pod, "gang formation timed out")
+                log.info(
+                    "scheduler: gang timeout, released %s", member_pod.namespaced_name
+                )

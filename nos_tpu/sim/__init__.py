@@ -1,0 +1,3 @@
+from nos_tpu.sim.kubelet import SimKubelet
+
+__all__ = ["SimKubelet"]

@@ -1,0 +1,35 @@
+"""SimKubelet: flips bound pods to Running.
+
+The reference relies on real kubelets; in the in-process cluster (tests,
+kind-style dry runs, benchmarks) this controller provides the missing
+lifecycle edge: a pod bound by the scheduler becomes Running, which in turn
+drives quota accounting and device usage reporting.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.store import KubeStore, NotFoundError
+
+
+class SimKubelet:
+    def __init__(self, store: KubeStore) -> None:
+        self.store = store
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        pod = self.store.try_get("Pod", req.name, req.namespace)
+        if pod is None:
+            return None
+        if not pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
+            return None
+
+        def mutate(p):
+            p.status.phase = PodPhase.RUNNING
+
+        try:
+            self.store.patch_merge("Pod", req.name, req.namespace, mutate)
+        except NotFoundError:
+            pass
+        return None

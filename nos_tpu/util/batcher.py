@@ -1,0 +1,80 @@
+"""Batcher: dual-timer event coalescing.
+
+Reference pkg/util/batcher.go:25-130: items accumulate in a batch that is
+released when either the *timeout window* (max total wait, started at the
+first Add) or the *idle window* (quiet period since the last Add) elapses.
+Used to coalesce pending-pod events so the planner runs once per burst
+(helm defaults: timeout 60s, idle 10s — values.yaml:278-285).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class Batcher(Generic[T]):
+    def __init__(self, timeout_seconds: float, idle_seconds: float = 0.0) -> None:
+        self.timeout = timeout_seconds
+        self.idle = idle_seconds
+        self._lock = threading.Lock()
+        self._batch: List[T] = []
+        self._first_add: float = 0.0
+        self._last_add: float = 0.0
+        self._ready: "queue.Queue[List[T]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ inputs
+
+    def add(self, item: T) -> None:
+        with self._lock:
+            now = time.monotonic()
+            if not self._batch:
+                self._first_add = now
+            self._last_add = now
+            self._batch.append(item)
+
+    def current_batch_size(self) -> int:
+        with self._lock:
+            return len(self._batch)
+
+    # ----------------------------------------------------------- outputs
+
+    def ready(self, timeout: float | None = None) -> "List[T] | None":
+        """Block until a batch is released; None on timeout/stop."""
+        try:
+            return self._ready.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        tick = min(0.01, max(self.timeout / 100.0, 0.001))
+        while not self._stop.is_set():
+            time.sleep(tick)
+            released: "List[T] | None" = None
+            with self._lock:
+                if not self._batch:
+                    continue
+                now = time.monotonic()
+                timed_out = now - self._first_add >= self.timeout
+                idle = self.idle > 0 and now - self._last_add >= self.idle
+                if timed_out or idle:
+                    released = self._batch
+                    self._batch = []
+            if released:
+                self._ready.put(released)

@@ -1,0 +1,42 @@
+"""Pod predicates (reference pkg/util/pod/pod.go:15-88)."""
+from __future__ import annotations
+
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.kube.objects import Pod, PodPhase
+
+
+def is_pending(pod: Pod) -> bool:
+    return pod.status.phase == PodPhase.PENDING
+
+
+def is_unschedulable(pod: Pod) -> bool:
+    return is_pending(pod) and pod.unschedulable()
+
+
+def is_preempting(pod: Pod) -> bool:
+    return bool(pod.status.nominated_node_name)
+
+
+def is_owned_by_daemonset(pod: Pod) -> bool:
+    return pod.is_owned_by_kind("DaemonSet")
+
+
+def is_owned_by_node(pod: Pod) -> bool:
+    return pod.is_owned_by_kind("Node")
+
+
+def extra_resources_could_help_scheduling(pod: Pod) -> bool:
+    """The partitioner batches a pod only when re-partitioning could
+    possibly make it schedulable (reference pod.go:25-33): it is pending and
+    unschedulable, not already preempting its way onto a node, and not
+    node-bound by a daemonset/static-pod owner."""
+    return (
+        is_unschedulable(pod)
+        and not is_preempting(pod)
+        and not is_owned_by_daemonset(pod)
+        and not is_owned_by_node(pod)
+    )
+
+
+def is_over_quota(pod: Pod) -> bool:
+    return pod.metadata.labels.get(labels.CAPACITY_LABEL) == labels.CAPACITY_OVER_QUOTA

@@ -1,0 +1,29 @@
+"""Watch event predicates (reference pkg/util/predicate/predicates.go)."""
+from __future__ import annotations
+
+from nos_tpu.kube.store import DELETED, WatchEvent
+
+
+def matching_name(name: str):
+    def predicate(event: WatchEvent) -> bool:
+        return event.object.metadata.name == name
+
+    return predicate
+
+
+def exclude_delete(event: WatchEvent) -> bool:
+    return event.type != DELETED
+
+
+def annotations_changed_or_added(event: WatchEvent) -> bool:
+    """Coarse stand-in for AnnotationsChangedPredicate: our store events do
+    not carry the old object, so any ADDED/MODIFIED passes; reconcilers are
+    level-triggered and tolerate spurious wakeups."""
+    return event.type != DELETED
+
+
+def and_(*predicates):
+    def predicate(event: WatchEvent) -> bool:
+        return all(p(event) for p in predicates)
+
+    return predicate

@@ -1,0 +1,152 @@
+import pytest
+
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import (
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+    ElasticQuota,
+    ElasticQuotaSpec,
+)
+from nos_tpu.controllers.elasticquota import (
+    CompositeElasticQuotaReconciler,
+    ElasticQuotaReconciler,
+    register_elasticquota_webhooks,
+)
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.store import AdmissionError, KubeStore
+
+from tests.factory import build_pod
+
+
+def make_eq(name="quota", ns="team-a", min=None, max=None):
+    return ElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=ElasticQuotaSpec(min=min or {}, max=max or {}),
+    )
+
+
+def make_ceq(name="composite", namespaces=None, min=None, max=None):
+    return CompositeElasticQuota(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=CompositeElasticQuotaSpec(
+            namespaces=namespaces or [], min=min or {}, max=max or {}
+        ),
+    )
+
+
+class TestElasticQuotaReconciler:
+    def test_used_and_labels(self):
+        store = KubeStore()
+        store.create(make_eq(min={constants.RESOURCE_TPU_CHIPS: 8}))
+        early = build_pod("early", {constants.RESOURCE_TPU: 8}, ns="team-a", phase="Running")
+        late = build_pod("late", {constants.RESOURCE_TPU: 4}, ns="team-a", phase="Running")
+        late.metadata.creation_timestamp = early.metadata.creation_timestamp + 10
+        store.create(early)
+        store.create(late)
+        ElasticQuotaReconciler(store).reconcile(Request(name="quota", namespace="team-a"))
+
+        assert (
+            store.get("Pod", "early", "team-a").metadata.labels[labels.CAPACITY_LABEL]
+            == labels.CAPACITY_IN_QUOTA
+        )
+        assert (
+            store.get("Pod", "late", "team-a").metadata.labels[labels.CAPACITY_LABEL]
+            == labels.CAPACITY_OVER_QUOTA
+        )
+        eq = store.get("ElasticQuota", "quota", "team-a")
+        assert eq.status.used == {constants.RESOURCE_TPU_CHIPS: 12}
+
+    def test_only_min_resources_tracked(self):
+        store = KubeStore()
+        store.create(make_eq(min={"cpu": 4}))
+        store.create(build_pod("p", {"cpu": 2, "memory": 64}, ns="team-a", phase="Running"))
+        ElasticQuotaReconciler(store).reconcile(Request(name="quota", namespace="team-a"))
+        assert store.get("ElasticQuota", "quota", "team-a").status.used == {"cpu": 2}
+
+    def test_pending_pods_not_counted(self):
+        store = KubeStore()
+        store.create(make_eq(min={"cpu": 4}))
+        store.create(build_pod("p", {"cpu": 2}, ns="team-a", phase="Pending"))
+        ElasticQuotaReconciler(store).reconcile(Request(name="quota", namespace="team-a"))
+        assert store.get("ElasticQuota", "quota", "team-a").status.used == {}
+
+    def test_label_flips_back_in_quota(self):
+        store = KubeStore()
+        store.create(make_eq(min={"cpu": 2}))
+        a = build_pod("a", {"cpu": 2}, ns="team-a", phase="Running")
+        b = build_pod("b", {"cpu": 2}, ns="team-a", phase="Running")
+        b.metadata.creation_timestamp = a.metadata.creation_timestamp + 5
+        store.create(a)
+        store.create(b)
+        r = ElasticQuotaReconciler(store)
+        r.reconcile(Request(name="quota", namespace="team-a"))
+        assert (
+            store.get("Pod", "b", "team-a").metadata.labels[labels.CAPACITY_LABEL]
+            == labels.CAPACITY_OVER_QUOTA
+        )
+        store.delete("Pod", "a", "team-a")
+        r.reconcile(Request(name="quota", namespace="team-a"))
+        assert (
+            store.get("Pod", "b", "team-a").metadata.labels[labels.CAPACITY_LABEL]
+            == labels.CAPACITY_IN_QUOTA
+        )
+
+
+class TestCompositeElasticQuota:
+    def test_accounts_across_namespaces(self):
+        store = KubeStore()
+        store.create(make_ceq(namespaces=["a", "b"], min={"cpu": 4}))
+        store.create(build_pod("p1", {"cpu": 2}, ns="a", phase="Running"))
+        store.create(build_pod("p2", {"cpu": 3}, ns="b", phase="Running"))
+        CompositeElasticQuotaReconciler(store).reconcile(
+            Request(name="composite", namespace="default")
+        )
+        ceq = store.get("CompositeElasticQuota", "composite", "default")
+        assert ceq.status.used == {"cpu": 5}
+        in_q = store.get("Pod", "p1", "a").metadata.labels[labels.CAPACITY_LABEL]
+        over_q = store.get("Pod", "p2", "b").metadata.labels[labels.CAPACITY_LABEL]
+        assert (in_q, over_q) == (labels.CAPACITY_IN_QUOTA, labels.CAPACITY_OVER_QUOTA)
+
+    def test_deletes_overlapping_eqs(self):
+        store = KubeStore()
+        store.create(make_eq(name="old", ns="a", min={"cpu": 1}))
+        store.create(make_ceq(namespaces=["a"], min={"cpu": 4}))
+        CompositeElasticQuotaReconciler(store).reconcile(
+            Request(name="composite", namespace="default")
+        )
+        assert store.try_get("ElasticQuota", "old", "a") is None
+
+
+class TestWebhooks:
+    def make_store(self):
+        store = KubeStore()
+        register_elasticquota_webhooks(store)
+        return store
+
+    def test_one_eq_per_namespace(self):
+        store = self.make_store()
+        store.create(make_eq(name="first"))
+        with pytest.raises(AdmissionError):
+            store.create(make_eq(name="second"))
+
+    def test_eq_rejected_in_ceq_namespace(self):
+        store = self.make_store()
+        store.create(make_ceq(namespaces=["team-a"]))
+        with pytest.raises(AdmissionError):
+            store.create(make_eq(ns="team-a"))
+
+    def test_ceq_overlap_rejected(self):
+        store = self.make_store()
+        store.create(make_ceq(name="c1", namespaces=["a", "b"]))
+        with pytest.raises(AdmissionError):
+            store.create(make_ceq(name="c2", namespaces=["b", "c"]))
+
+    def test_min_above_max_rejected(self):
+        store = self.make_store()
+        with pytest.raises(AdmissionError):
+            store.create(make_eq(min={"cpu": 4}, max={"cpu": 2}))
+
+    def test_valid_quota_admitted(self):
+        store = self.make_store()
+        store.create(make_eq(min={"cpu": 2}, max={"cpu": 4}))

@@ -1,0 +1,133 @@
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.controllers.tpuagent import (
+    SharedState,
+    TpuActuator,
+    TpuReporter,
+    compute_plan,
+)
+from nos_tpu.device import (
+    SimDevicePlugin,
+    SimDevicePool,
+    SimPodResourcesClient,
+    SimTpuDeviceClient,
+    TpuClient,
+)
+from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+def make_agent_env(node_name="n1", node=None):
+    store = KubeStore()
+    store.create(node or build_tpu_node(name=node_name))
+    pool = SimDevicePool()
+    client = TpuClient(SimTpuDeviceClient(pool), SimPodResourcesClient(store, pool))
+    plugin = SimDevicePlugin(store, pool)
+    shared = SharedState()
+    reporter = TpuReporter(store, client, node_name, shared, report_interval_seconds=10)
+    actuator = TpuActuator(store, client, plugin, node_name, shared)
+    return store, pool, client, plugin, shared, reporter, actuator
+
+
+def dev(device_id, board, profile, status=DeviceStatus.FREE):
+    return TpuSliceDevice(device_id=device_id, board_index=board, profile=profile, status=status)
+
+
+class TestComputePlan:
+    def test_create_from_scratch(self):
+        plan = compute_plan([], {0: {"2x2": 2}})
+        assert plan.deletes == []
+        assert [(c.board_index, c.profile, c.quantity) for c in plan.creates] == [(0, "2x2", 2)]
+
+    def test_delete_profiles_absent_from_spec(self):
+        plan = compute_plan([dev("d1", 0, "2x4")], {0: {"1x1": 8}})
+        assert [d.device_id for d in plan.deletes] == ["d1"]
+        assert [(c.profile, c.quantity) for c in plan.creates] == [("1x1", 8)]
+
+    def test_no_ops_when_converged(self):
+        plan = compute_plan([dev("d1", 0, "2x2"), dev("d2", 0, "2x2")], {0: {"2x2": 2}})
+        assert plan.empty
+
+    def test_used_devices_never_deleted(self):
+        plan = compute_plan([dev("d1", 0, "2x4", DeviceStatus.USED)], {0: {"1x1": 8}})
+        assert plan.deletes == []
+        # creates still requested; actuation converges after the pod leaves
+        assert [(c.profile, c.quantity) for c in plan.creates] == [("1x1", 8)]
+
+    def test_partial_excess_deletes_free_first(self):
+        devices = [
+            dev("d1", 0, "2x2", DeviceStatus.USED),
+            dev("d2", 0, "2x2", DeviceStatus.FREE),
+        ]
+        plan = compute_plan(devices, {0: {"2x2": 1}})
+        assert [d.device_id for d in plan.deletes] == ["d2"]
+
+
+class TestActuatorReporterLoop:
+    def test_spec_to_devices_to_status_handshake(self):
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        # control plane writes spec
+        store.patch_annotations(
+            "Node", "n1", "",
+            {**annot.spec_from_geometries({0: {"2x2": 2}}), annot.SPEC_PARTITIONING_PLAN: "7"},
+        )
+        # actuator gated until a report happens
+        result = actuator.reconcile(Request(name="n1"))
+        assert result is not None and result.requeue_after > 0
+        assert pool.get("n1") == []
+
+        reporter.reconcile(Request(name="n1"))  # report empty state
+        actuator.reconcile(Request(name="n1"))  # now actuates
+        assert pool.geometry("n1") == {0: {"2x2": 2}}
+
+        # device plugin re-advertised slice resources on the node
+        node = store.get("Node", "n1")
+        assert node.status.allocatable[slice_res("2x2")] == 2
+        assert node.status.allocatable["google.com/tpu"] == 0
+
+        # next report publishes status + acknowledges the plan
+        reporter.reconcile(Request(name="n1"))
+        node = store.get("Node", "n1")
+        _, status = annot.parse_node_annotations(node.metadata.annotations)
+        assert annot.status_geometries(status) == {0: {"2x2": 2}}
+        assert node.metadata.annotations[annot.STATUS_PARTITIONING_PLAN] == "7"
+
+    def test_reporter_marks_used_devices(self):
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        pool.create("n1", 0, "2x2", 2)
+        store.create(build_pod("p", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+        reporter.reconcile(Request(name="n1"))
+        _, status = annot.parse_node_annotations(store.get("Node", "n1").metadata.annotations)
+        by_status = {(s.status, s.profile): s.quantity for s in status}
+        assert by_status[("used", "2x2")] == 1
+        assert by_status[("free", "2x2")] == 1
+
+    def test_reconverge_after_spec_change(self):
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        store.patch_annotations(
+            "Node", "n1", "",
+            {**annot.spec_from_geometries({0: {"2x4": 1}}), annot.SPEC_PARTITIONING_PLAN: "1"},
+        )
+        reporter.reconcile(Request(name="n1"))
+        actuator.reconcile(Request(name="n1"))
+        assert pool.geometry("n1") == {0: {"2x4": 1}}
+        # new plan arrives: re-carve into 1x1s
+        node = store.get("Node", "n1")
+        patch = annot.strip_spec_annotations(node.metadata.annotations)
+        patch.update(annot.spec_from_geometries({0: {"1x1": 8}}))
+        patch[annot.SPEC_PARTITIONING_PLAN] = "2"
+        store.patch_annotations("Node", "n1", "", patch)
+        reporter.reconcile(Request(name="n1"))
+        actuator.reconcile(Request(name="n1"))
+        assert pool.geometry("n1") == {0: {"1x1": 8}}
+        reporter.reconcile(Request(name="n1"))
+        assert (
+            store.get("Node", "n1").metadata.annotations[annot.STATUS_PARTITIONING_PLAN]
+            == "2"
+        )
+
+    def test_actuator_ignores_other_nodes(self):
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        assert actuator.reconcile(Request(name="other")) is None

@@ -1,0 +1,156 @@
+"""End-to-end control-loop tests over the full in-process suite.
+
+The envtest analogue (SURVEY.md §4): all components run as real controllers
+against one store; assertions wait for convergence. The core scenario is
+SURVEY.md §7 step 4 / BASELINE config #1: a pending Pod requesting
+``google.com/tpu: 4`` on a virgin v5e node ends up Running on a
+freshly-carved 2x2 slice with the full annotation handshake completed.
+"""
+import time
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import ObjectMeta, PodPhase
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+@pytest.fixture
+def cluster():
+    c = build_cluster()
+    yield c
+    c.stop()
+
+
+def wait_for(predicate, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def pod_running_on(store, name, ns="default"):
+    def check():
+        pod = store.try_get("Pod", name, ns)
+        return (
+            pod is not None
+            and pod.status.phase == PodPhase.RUNNING
+            and bool(pod.spec.node_name)
+        )
+
+    return check
+
+
+class TestEndToEnd:
+    def test_pending_tpu_pod_triggers_carve_and_schedules(self, cluster):
+        cluster.add_tpu_node(build_tpu_node(name="tpu-1"))
+        cluster.start()
+        cluster.store.create(build_pod("train", {constants.RESOURCE_TPU: 4}, ns="ml"))
+
+        assert wait_for(pod_running_on(cluster.store, "train", "ml")), (
+            "pod never scheduled; node: %s"
+            % cluster.store.get("Node", "tpu-1").metadata.annotations
+        )
+        # The slice actually exists on the (simulated) silicon.
+        geometry = cluster.pool.geometry("tpu-1")
+        assert geometry[0].get("2x2", 0) >= 1
+        # Handshake completed: status plan == spec plan.
+        node = cluster.store.get("Node", "tpu-1")
+        assert (
+            node.metadata.annotations[annot.STATUS_PARTITIONING_PLAN]
+            == node.metadata.annotations[annot.SPEC_PARTITIONING_PLAN]
+        )
+        # Node advertises the slice resource.
+        assert node.status.allocatable.get(slice_res("2x2"), 0) >= 1
+
+    def test_mixed_profiles_pack_one_node(self, cluster):
+        cluster.add_tpu_node(build_tpu_node(name="tpu-1"))
+        cluster.start()
+        cluster.store.create(build_pod("big", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        cluster.store.create(build_pod("small-0", {constants.RESOURCE_TPU: 1}, ns="ml"))
+        cluster.store.create(build_pod("small-1", {constants.RESOURCE_TPU: 1}, ns="ml"))
+
+        for name in ("big", "small-0", "small-1"):
+            assert wait_for(pod_running_on(cluster.store, name, "ml")), f"{name} stuck"
+        used_chips = 4 + 1 + 1
+        assert used_chips <= 8  # all fit the single 8-chip host
+
+    def test_second_wave_recarves_freed_capacity(self, cluster):
+        cluster.add_tpu_node(build_tpu_node(name="tpu-1"))
+        cluster.start()
+        cluster.store.create(build_pod("wave1", {constants.RESOURCE_TPU: 8}, ns="ml"))
+        assert wait_for(pod_running_on(cluster.store, "wave1", "ml"))
+
+        # Job finishes; a differently-shaped wave arrives.
+        def finish(p):
+            p.status.phase = PodPhase.SUCCEEDED
+
+        cluster.store.patch_merge("Pod", "wave1", "ml", finish)
+        for i in range(2):
+            cluster.store.create(
+                build_pod(f"wave2-{i}", {constants.RESOURCE_TPU: 4}, ns="ml")
+            )
+        for i in range(2):
+            assert wait_for(
+                pod_running_on(cluster.store, f"wave2-{i}", "ml"), timeout=15
+            ), f"wave2-{i} stuck"
+        assert cluster.pool.geometry("tpu-1")[0] == {"2x2": 2}
+
+    def test_elastic_quota_labels_flow(self, cluster):
+        cluster.store.create(
+            ElasticQuota(
+                metadata=ObjectMeta(name="q", namespace="ml"),
+                spec=ElasticQuotaSpec(min={CHIPS: 4}, max={CHIPS: 8}),
+            )
+        )
+        # Borrowing draws from OTHER quotas' unused guaranteed min
+        # (reference aggregate check): an idle namespace lends its share.
+        cluster.store.create(
+            ElasticQuota(
+                metadata=ObjectMeta(name="idle-q", namespace="idle"),
+                spec=ElasticQuotaSpec(min={CHIPS: 4}),
+            )
+        )
+        cluster.add_tpu_node(build_tpu_node(name="tpu-1"))
+        cluster.start()
+        cluster.store.create(build_pod("in-q", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        assert wait_for(pod_running_on(cluster.store, "in-q", "ml"))
+        cluster.store.create(build_pod("over-q", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        assert wait_for(pod_running_on(cluster.store, "over-q", "ml"))
+
+        def labeled():
+            a = cluster.store.get("Pod", "in-q", "ml").metadata.labels.get(labels.CAPACITY_LABEL)
+            b = cluster.store.get("Pod", "over-q", "ml").metadata.labels.get(labels.CAPACITY_LABEL)
+            return a == labels.CAPACITY_IN_QUOTA and b == labels.CAPACITY_OVER_QUOTA
+
+        assert wait_for(labeled)
+        eq = cluster.store.get("ElasticQuota", "q", "ml")
+        assert eq.status.used.get(CHIPS) == 8
+
+    def test_gang_of_two_lands_together(self, cluster):
+        from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+        for i in range(2):
+            cluster.add_tpu_node(build_tpu_node(name=f"tpu-{i}"))
+        cluster.start()
+        for i in range(2):
+            pod = build_pod(f"worker-{i}", {constants.RESOURCE_TPU: 8}, ns="ml")
+            pod.metadata.labels[GANG_NAME_LABEL] = "llama"
+            pod.metadata.labels[GANG_SIZE_LABEL] = "2"
+            cluster.store.create(pod)
+        for i in range(2):
+            assert wait_for(
+                pod_running_on(cluster.store, f"worker-{i}", "ml"), timeout=15
+            ), f"worker-{i} stuck"
+        nodes = {
+            cluster.store.get("Pod", f"worker-{i}", "ml").spec.node_name for i in range(2)
+        }
+        assert nodes == {"tpu-0", "tpu-1"}

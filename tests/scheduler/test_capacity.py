@@ -1,0 +1,170 @@
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import (
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+    ElasticQuota,
+    ElasticQuotaSpec,
+)
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.framework import CycleState
+from nos_tpu.scheduler.plugins.capacity import (
+    CapacityScheduling,
+    ElasticQuotaInfo,
+    ElasticQuotaInfos,
+    build_quota_infos,
+)
+
+from tests.factory import build_pod
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def eq(ns, min=None, max=None, name="quota"):
+    return ElasticQuota(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=ElasticQuotaSpec(min=min or {}, max=max or {}),
+    )
+
+
+def info(name, ns, min=None, max=None, used=None):
+    i = ElasticQuotaInfo(name, {ns}, min or {}, max)
+    i.used = dict(used or {})
+    return i
+
+
+class TestElasticQuotaInfo:
+    def test_used_over_min_with(self):
+        i = info("a", "a", min={CHIPS: 8}, used={CHIPS: 6})
+        assert not i.used_over_min_with({CHIPS: 2})
+        assert i.used_over_min_with({CHIPS: 3})
+
+    def test_used_over_max_with(self):
+        i = info("a", "a", min={CHIPS: 4}, max={CHIPS: 8}, used={CHIPS: 6})
+        assert not i.used_over_max_with({CHIPS: 2})
+        assert i.used_over_max_with({CHIPS: 3})
+
+    def test_no_max_is_unlimited(self):
+        i = info("a", "a", min={CHIPS: 4}, used={CHIPS: 100})
+        assert not i.used_over_max_with({CHIPS: 100})
+
+    def test_add_remove_pod_idempotent(self):
+        i = info("a", "a", min={CHIPS: 8})
+        i.add_pod("ns/p", {CHIPS: 4})
+        i.add_pod("ns/p", {CHIPS: 4})
+        assert i.used == {CHIPS: 4}
+        i.remove_pod("ns/p", {CHIPS: 4})
+        i.remove_pod("ns/p", {CHIPS: 4})
+        assert i.used == {CHIPS: 0}
+
+
+class TestGuaranteedOverquota:
+    def test_fair_share_math(self):
+        # Reference elasticquotainfo.go:81-152:
+        # guaranteed_i = floor(min_i/Σmin · Σ_j max(0, min_j-used_j))
+        infos = ElasticQuotaInfos(
+            [
+                info("a", "a", min={CHIPS: 6}, used={CHIPS: 6}),
+                info("b", "b", min={CHIPS: 2}, used={CHIPS: 0}),
+                info("c", "c", min={CHIPS: 4}, used={CHIPS: 1}),
+            ]
+        )
+        # unused = 0 + 2 + 3 = 5; Σmin = 12
+        assert infos.guaranteed_overquota("a", CHIPS) == 2  # floor(6/12*5)
+        assert infos.guaranteed_overquota("b", CHIPS) == 0  # floor(2/12*5)
+        assert infos.guaranteed_overquota("c", CHIPS) == 1  # floor(4/12*5)
+
+    def test_aggregated_used_over_min(self):
+        infos = ElasticQuotaInfos(
+            [
+                info("a", "a", min={CHIPS: 4}, used={CHIPS: 4}),
+                info("b", "b", min={CHIPS: 4}, used={CHIPS: 3}),
+            ]
+        )
+        assert not infos.aggregated_used_over_min_with({CHIPS: 1})
+        assert infos.aggregated_used_over_min_with({CHIPS: 2})
+
+    def test_within_guaranteed_with(self):
+        infos = ElasticQuotaInfos(
+            [
+                info("a", "a", min={CHIPS: 4}, used={CHIPS: 2}),
+                info("b", "b", min={CHIPS: 4}, used={CHIPS: 0}),
+            ]
+        )
+        assert infos.within_guaranteed_with("a", {CHIPS: 2})
+        # beyond min but within min + floor(4/8 * unused 6) = 4+3
+        assert infos.within_guaranteed_with("a", {CHIPS: 5})
+        assert not infos.within_guaranteed_with("a", {CHIPS: 6})
+
+
+class TestBuildQuotaInfos:
+    def test_ceq_shadows_eq(self):
+        store = KubeStore()
+        store.create(eq("a", min={CHIPS: 2}))
+        store.create(
+            CompositeElasticQuota(
+                metadata=ObjectMeta(name="c", namespace="default"),
+                spec=CompositeElasticQuotaSpec(namespaces=["a", "b"], min={CHIPS: 8}),
+            )
+        )
+        infos = build_quota_infos(store)
+        assert infos.for_namespace("a").name == "ceq/c"
+        assert infos.for_namespace("b").name == "ceq/c"
+
+    def test_usage_from_bound_pods(self):
+        store = KubeStore()
+        store.create(eq("a", min={CHIPS: 8}))
+        store.create(build_pod("p", {constants.RESOURCE_TPU: 4}, ns="a", node="n1", phase="Running"))
+        store.create(build_pod("unbound", {constants.RESOURCE_TPU: 2}, ns="a"))
+        infos = build_quota_infos(store)
+        assert infos.for_namespace("a").used == {CHIPS: 4, constants.RESOURCE_TPU: 4}
+
+
+class TestPreFilter:
+    def test_no_quota_passes(self):
+        plugin = CapacityScheduling(KubeStore())
+        assert plugin.pre_filter(CycleState(), build_pod("p", {CHIPS: 4})).success
+
+    def test_max_enforced(self):
+        store = KubeStore()
+        store.create(eq("a", min={CHIPS: 4}, max={CHIPS: 8}))
+        store.create(build_pod("running", {constants.RESOURCE_TPU: 8}, ns="a", node="n", phase="Running"))
+        plugin = CapacityScheduling(store)
+        status = plugin.pre_filter(CycleState(), build_pod("p", {constants.RESOURCE_TPU: 1}, ns="a"))
+        assert not status.success
+        assert "max" in status.message
+
+    def test_borrowing_allowed_within_aggregate_min(self):
+        store = KubeStore()
+        store.create(eq("a", min={CHIPS: 4}, max={CHIPS: 16}))
+        store.create(eq("b", min={CHIPS: 8}))
+        store.create(build_pod("running", {constants.RESOURCE_TPU: 4}, ns="a", node="n", phase="Running"))
+        plugin = CapacityScheduling(store)
+        # a over min (4+4>4) but aggregate used 4+4=8 ≤ Σmin 12 -> borrow ok
+        status = plugin.pre_filter(CycleState(), build_pod("p", {constants.RESOURCE_TPU: 4}, ns="a"))
+        assert status.success
+
+    def test_borrowing_rejected_when_pool_exhausted(self):
+        store = KubeStore()
+        store.create(eq("a", min={CHIPS: 4}, max={CHIPS: 16}))
+        store.create(eq("b", min={CHIPS: 4}))
+        store.create(build_pod("ra", {constants.RESOURCE_TPU: 4}, ns="a", node="n", phase="Running"))
+        store.create(build_pod("rb", {constants.RESOURCE_TPU: 3}, ns="b", node="n", phase="Running"))
+        plugin = CapacityScheduling(store)
+        # a wants 2 over min; aggregate used 7+2=9 > Σmin 8 -> reject
+        status = plugin.pre_filter(CycleState(), build_pod("p", {constants.RESOURCE_TPU: 2}, ns="a"))
+        assert not status.success
+
+    def test_reserve_counts_until_forgotten(self):
+        store = KubeStore()
+        store.create(eq("a", min={CHIPS: 4}, max={CHIPS: 4}))
+        plugin = CapacityScheduling(store)
+        pod = build_pod("p", {constants.RESOURCE_TPU: 4}, ns="a")
+        state = CycleState()
+        assert plugin.pre_filter(state, pod).success
+        plugin.reserve(state, pod, "n1")
+        # second pod exceeds max because of the in-flight reservation
+        second = build_pod("q", {constants.RESOURCE_TPU: 1}, ns="a")
+        assert not plugin.pre_filter(CycleState(), second).success
+        plugin.unreserve(state, pod, "n1")
+        assert plugin.pre_filter(CycleState(), second).success

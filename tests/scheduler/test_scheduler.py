@@ -1,0 +1,210 @@
+import time
+
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.objects import ObjectMeta, PodPhase
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+from nos_tpu.scheduler.scheduler import Scheduler, new_framework
+
+from tests.factory import build_node, build_pod, build_tpu_node, slice_res
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def make_scheduler(store, gang_timeout=0.3):
+    fw, capacity, gang = new_framework(store, gang_timeout_seconds=gang_timeout)
+    return Scheduler(store, fw, capacity=capacity, gang=gang, retry_seconds=0.05)
+
+
+def sched_pod(scheduler, store, pod):
+    store.create(pod)
+    return scheduler.reconcile(Request(name=pod.metadata.name, namespace=pod.metadata.namespace))
+
+
+class TestBasicScheduling:
+    def test_binds_to_fitting_node(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 4}))
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {"cpu": 2}))
+        assert store.get("Pod", "p", "default").spec.node_name == "n1"
+
+    def test_unschedulable_marks_condition(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 1}))
+        s = make_scheduler(store)
+        result = sched_pod(s, store, build_pod("p", {"cpu": 2}))
+        pod = store.get("Pod", "p", "default")
+        assert pod.spec.node_name == ""
+        assert pod.unschedulable()
+        assert result is not None and result.requeue_after > 0
+
+    def test_prefers_exact_slice_fit(self):
+        from nos_tpu.api.v1alpha1 import annotations as annot
+        store = KubeStore()
+        # n-exact advertises a free 2x2; n-big advertises a 2x4.
+        exact = build_tpu_node(name="n-exact")
+        exact.status.allocatable = {slice_res("2x2"): 1, "cpu": 8}
+        store.create(exact)
+        big = build_tpu_node(name="n-big")
+        big.status.allocatable = {slice_res("2x2"): 1, slice_res("2x4"): 1, "cpu": 8}
+        store.create(big)
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {slice_res("2x2"): 1}))
+        # consolidation: n-exact is fully consumed by the pod; n-big strands a 2x4
+        assert store.get("Pod", "p", "default").spec.node_name == "n-exact"
+
+    def test_already_bound_pod_ignored(self):
+        store = KubeStore()
+        store.create(build_node("n1"))
+        s = make_scheduler(store)
+        pod = build_pod("p", {"cpu": 1}, node="n1")
+        store.create(pod)
+        assert s.reconcile(Request(name="p", namespace="default")) is None
+
+
+class TestPreemptionFlow:
+    def make_cluster(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={CHIPS: 8, "cpu": 64}))
+        store.create(
+            ElasticQuota(
+                metadata=ObjectMeta(name="qa", namespace="team-a"),
+                spec=ElasticQuotaSpec(min={CHIPS: 4}, max={CHIPS: 8}),
+            )
+        )
+        store.create(
+            ElasticQuota(
+                metadata=ObjectMeta(name="qb", namespace="team-b"),
+                spec=ElasticQuotaSpec(min={CHIPS: 4}, max={CHIPS: 8}),
+            )
+        )
+        return store
+
+    def test_over_quota_pod_preempted_by_guaranteed_claim(self):
+        store = self.make_cluster()
+        # team-b borrowed the whole node: 8 chips (4 over min), over-quota labeled.
+        borrower = build_pod("borrower", {CHIPS: 8}, ns="team-b", node="n1", phase="Running")
+        borrower.metadata.labels[labels.CAPACITY_LABEL] = labels.CAPACITY_OVER_QUOTA
+        store.create(borrower)
+        s = make_scheduler(store)
+        result = sched_pod(s, store, build_pod("p", {CHIPS: 4}, ns="team-a"))
+        # borrower evicted, node nominated
+        assert store.try_get("Pod", "borrower", "team-b") is None
+        assert store.get("Pod", "p", "team-a").status.nominated_node_name == "n1"
+        # next cycle binds
+        s.reconcile(Request(name="p", namespace="team-a"))
+        assert store.get("Pod", "p", "team-a").spec.node_name == "n1"
+
+    def test_in_quota_pod_not_preempted(self):
+        store = self.make_cluster()
+        holder = build_pod("holder", {CHIPS: 8}, ns="team-b", node="n1", phase="Running")
+        holder.metadata.labels[labels.CAPACITY_LABEL] = labels.CAPACITY_IN_QUOTA
+        store.create(holder)
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {CHIPS: 4}, ns="team-a"))
+        assert store.try_get("Pod", "holder", "team-b") is not None
+        assert store.get("Pod", "p", "team-a").spec.node_name == ""
+
+    def test_same_namespace_priority_preemption(self):
+        store = self.make_cluster()
+        low = build_pod("low", {CHIPS: 8}, ns="team-a", node="n1", phase="Running", priority=0)
+        store.create(low)
+        s = make_scheduler(store)
+        vip = build_pod("vip", {CHIPS: 8}, ns="team-a", priority=100)
+        sched_pod(s, store, vip)
+        assert store.try_get("Pod", "low", "team-a") is None
+
+    def test_lower_priority_preemptor_cannot_evict(self):
+        store = self.make_cluster()
+        high = build_pod("high", {CHIPS: 8}, ns="team-a", node="n1", phase="Running", priority=100)
+        store.create(high)
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {CHIPS: 8}, ns="team-a", priority=0))
+        assert store.try_get("Pod", "high", "team-a") is not None
+
+
+class TestGangScheduling:
+    def gang_pod(self, name, size=2, requests=None):
+        pod = build_pod(name, requests or {"cpu": 1}, ns="ml")
+        pod.metadata.labels[GANG_NAME_LABEL] = "job"
+        pod.metadata.labels[GANG_SIZE_LABEL] = str(size)
+        return pod
+
+    def test_gang_binds_together(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 4}))
+        store.create(build_node("n2", alloc={"cpu": 4}))
+        s = make_scheduler(store)
+        sched_pod(s, store, self.gang_pod("w0"))
+        # first member waits
+        assert store.get("Pod", "w0", "ml").spec.node_name == ""
+        sched_pod(s, store, self.gang_pod("w1"))
+        # quorum reached: both bound
+        assert store.get("Pod", "w0", "ml").spec.node_name != ""
+        assert store.get("Pod", "w1", "ml").spec.node_name != ""
+
+    def test_gang_timeout_releases_reservations(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 2}))
+        s = make_scheduler(store, gang_timeout=0.05)
+        sched_pod(s, store, self.gang_pod("w0", size=2, requests={"cpu": 2}))
+        assert store.get("Pod", "w0", "ml").spec.node_name == ""
+        time.sleep(0.1)
+        s._handle_gang_timeouts()
+        assert s.gang.waiting_count() == 0
+        assert store.get("Pod", "w0", "ml").unschedulable()
+        # the freed reservation lets an ordinary pod through
+        sched_pod(s, store, build_pod("solo", {"cpu": 2}))
+        assert store.get("Pod", "solo", "default").spec.node_name == "n1"
+
+    def test_partial_gang_counts_bound_members(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 4}))
+        s = make_scheduler(store)
+        bound = self.gang_pod("w0")
+        bound.spec.node_name = "n1"
+        bound.status.phase = PodPhase.RUNNING
+        store.create(bound)
+        sched_pod(s, store, self.gang_pod("w1"))
+        assert store.get("Pod", "w1", "ml").spec.node_name == "n1"
+
+
+class TestReviewRegressions:
+    def test_quota_only_preemption_on_roomy_node(self):
+        """Node has resource headroom; only the quota blocks the pod. The
+        over-quota borrower must still be evicted (quota-aware reprieve)."""
+        store = KubeStore()
+        store.create(build_node("n1", alloc={CHIPS: 16, "cpu": 64}))
+        for ns in ("team-a", "team-b"):
+            store.create(
+                ElasticQuota(
+                    metadata=ObjectMeta(name=f"q-{ns}", namespace=ns),
+                    spec=ElasticQuotaSpec(min={CHIPS: 4}, max={CHIPS: 16}),
+                )
+            )
+        borrower = build_pod("borrower", {CHIPS: 8}, ns="team-b", node="n1", phase="Running")
+        borrower.metadata.labels[labels.CAPACITY_LABEL] = labels.CAPACITY_OVER_QUOTA
+        store.create(borrower)
+        s = make_scheduler(store)
+        # team-a claims 6: within min 4 + fair share of unused min.
+        sched_pod(s, store, build_pod("p", {CHIPS: 6}, ns="team-a"))
+        assert store.try_get("Pod", "borrower", "team-b") is None
+        assert store.get("Pod", "p", "team-a").status.nominated_node_name == "n1"
+
+    def test_waiting_gang_member_not_marked_unschedulable(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 2}))
+        s = make_scheduler(store, gang_timeout=5)
+        pod = build_pod("w0", {"cpu": 2}, ns="ml")
+        pod.metadata.labels[GANG_NAME_LABEL] = "job"
+        pod.metadata.labels[GANG_SIZE_LABEL] = "2"
+        sched_pod(s, store, pod)
+        # retry reconcile while waiting must not run a full cycle against
+        # the pod's own assumed reservation
+        s.reconcile(Request(name="w0", namespace="ml"))
+        got = store.get("Pod", "w0", "ml")
+        assert not got.unschedulable()
+        assert got.spec.node_name == ""

@@ -1,0 +1,52 @@
+import time
+
+from nos_tpu.util.batcher import Batcher
+
+
+class TestBatcher:
+    def test_idle_window_releases(self):
+        b = Batcher(timeout_seconds=5.0, idle_seconds=0.05)
+        b.start()
+        try:
+            b.add(1)
+            b.add(2)
+            batch = b.ready(timeout=2.0)
+            assert batch == [1, 2]
+        finally:
+            b.stop()
+
+    def test_timeout_window_releases_despite_activity(self):
+        b = Batcher(timeout_seconds=0.15, idle_seconds=10.0)
+        b.start()
+        try:
+            deadline = time.monotonic() + 0.5
+            b.add(0)
+            batch = None
+            i = 1
+            while batch is None and time.monotonic() < deadline:
+                b.add(i)  # keep it busy: idle window never fires
+                i += 1
+                batch = b.ready(timeout=0.01)
+            assert batch is not None and batch[0] == 0
+        finally:
+            b.stop()
+
+    def test_batches_are_separate(self):
+        b = Batcher(timeout_seconds=5.0, idle_seconds=0.03)
+        b.start()
+        try:
+            b.add("a")
+            first = b.ready(timeout=2.0)
+            b.add("b")
+            second = b.ready(timeout=2.0)
+            assert (first, second) == (["a"], ["b"])
+        finally:
+            b.stop()
+
+    def test_no_release_when_empty(self):
+        b = Batcher(timeout_seconds=0.01, idle_seconds=0.01)
+        b.start()
+        try:
+            assert b.ready(timeout=0.1) is None
+        finally:
+            b.stop()
