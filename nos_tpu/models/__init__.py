@@ -1,0 +1,21 @@
+"""Demo workloads: the JAX jobs the cluster schedules onto carved slices.
+
+The reference's benchmark workload is a YOLOS-small inference server
+(demos/gpu-sharing-comparison); the TPU build's equivalents per
+BASELINE.json configs are a ResNet-50 (single-host slice) and a
+Llama-style transformer (multi-host gang), both TPU-first: bfloat16
+matmuls sized for the MXU, static shapes, shardable over a
+``jax.sharding.Mesh``.
+"""
+
+from nos_tpu.models.llama import LlamaConfig, llama_forward, init_llama_params
+from nos_tpu.models.resnet import ResNetConfig, init_resnet_params, resnet_forward
+
+__all__ = [
+    "LlamaConfig",
+    "ResNetConfig",
+    "init_llama_params",
+    "init_resnet_params",
+    "llama_forward",
+    "resnet_forward",
+]

@@ -1,0 +1,167 @@
+"""Llama-style decoder-only transformer in pure JAX.
+
+TPU-first choices: bfloat16 activations/params feeding the MXU, static
+shapes throughout (no data-dependent control flow under jit), grouped-query
+attention expressed as einsums XLA fuses and tiles, RoPE precomputed
+per-call from static lengths. The flagship config mirrors Llama-3-8B
+(BASELINE.json config #5: "Llama-3-8B JAX on auto-carved v5e 4x4 slice").
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def tiny_config(**overrides) -> LlamaConfig:
+    """Small config for tests / dry runs; dims stay multiples of 8 so a
+    virtual 8-device mesh can shard every axis."""
+    defaults = dict(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=128,
+    )
+    defaults.update(overrides)
+    return LlamaConfig(**defaults)
+
+
+def llama_3_8b_config() -> LlamaConfig:
+    return LlamaConfig()
+
+
+# ------------------------------------------------------------------- init
+
+
+def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
+    c = config
+    keys = iter(jax.random.split(key, 4 + 7 * c.n_layers))
+
+    def dense(k, shape, scale_dim):
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(scale_dim)).astype(
+            c.dtype
+        )
+
+    params: Params = {
+        "embed": dense(next(keys), (c.vocab_size, c.d_model), c.d_model),
+        "final_norm": jnp.ones((c.d_model,), c.dtype),
+        "lm_head": dense(next(keys), (c.d_model, c.vocab_size), c.d_model),
+        "layers": [],
+    }
+    hd = c.head_dim
+    for _ in range(c.n_layers):
+        params["layers"].append(
+            {
+                "attn_norm": jnp.ones((c.d_model,), c.dtype),
+                "wq": dense(next(keys), (c.d_model, c.n_heads * hd), c.d_model),
+                "wk": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
+                "wv": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
+                "wo": dense(next(keys), (c.n_heads * hd, c.d_model), c.n_heads * hd),
+                "mlp_norm": jnp.ones((c.d_model,), c.dtype),
+                "w_gate": dense(next(keys), (c.d_model, c.d_ff), c.d_model),
+                "w_up": dense(next(keys), (c.d_model, c.d_ff), c.d_model),
+                "w_down": dense(next(keys), (c.d_ff, c.d_model), c.d_ff),
+            }
+        )
+    return params
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight
+
+
+def _rope(seq_len: int, head_dim: int, theta: float, dtype) -> "tuple[jax.Array, jax.Array]":
+    positions = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    angles = positions[:, None] * freqs[None, :]  # [S, hd/2]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    # x: [B, S, H, hd]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _attention(
+    x: jax.Array, layer: Params, config: LlamaConfig, cos: jax.Array, sin: jax.Array
+) -> jax.Array:
+    c = config
+    b, s, _ = x.shape
+    hd = c.head_dim
+    q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
+    k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+
+    # GQA: expand kv heads to query heads by grouping queries.
+    group = c.n_heads // c.n_kv_heads
+    q = q.reshape(b, s, c.n_kv_heads, group, hd)
+    scores = jnp.einsum("bsKgh,btKh->bKgst", q, k).astype(jnp.float32) / math.sqrt(hd)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(causal[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, c.n_heads * hd)
+    return out @ layer["wo"]
+
+
+def _mlp(x: jax.Array, layer: Params) -> jax.Array:
+    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+
+
+def llama_forward(params: Params, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (float32)."""
+    c = config
+    x = params["embed"][tokens]
+    # Position tables depend only on (seq_len, head_dim): one per forward.
+    cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype)
+    for layer in params["layers"]:
+        x = x + _attention(
+            _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin
+        )
+        x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def llama_loss(params: Params, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy over shifted tokens."""
+    logits = llama_forward(params, tokens[:, :-1], config)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,115 @@
+"""ResNet-50 in pure JAX (BASELINE.json config #3: single-host slice
+inference/training workload; the role YOLOS-small plays in the reference's
+demo). bfloat16, NHWC, folded batch-norm parameters (scale/bias) so the
+whole network is convs + elementwise — ideal XLA fusion fodder.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+def tiny_resnet_config() -> ResNetConfig:
+    return ResNetConfig(num_classes=10, stage_sizes=(1, 1), width=8)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * math.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _norm_params(cout, dtype):
+    return {"scale": jnp.ones((cout,), dtype), "bias": jnp.zeros((cout,), dtype)}
+
+
+def init_resnet_params(key: jax.Array, config: ResNetConfig) -> Params:
+    c = config
+    n_blocks = sum(c.stage_sizes)
+    keys = iter(jax.random.split(key, 2 + n_blocks * 4))
+    params: Params = {
+        "stem": {
+            "conv": _conv_init(next(keys), 7, 7, 3, c.width, c.dtype),
+            "norm": _norm_params(c.width, c.dtype),
+        },
+        "stages": [],
+    }
+    cin = c.width
+    for stage_index, blocks in enumerate(c.stage_sizes):
+        stage: List[Params] = []
+        width = c.width * (2**stage_index)
+        cout = width * 4
+        for block_index in range(blocks):
+            block = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, width, c.dtype),
+                "norm1": _norm_params(width, c.dtype),
+                "conv2": _conv_init(next(keys), 3, 3, width, width, c.dtype),
+                "norm2": _norm_params(width, c.dtype),
+                "conv3": _conv_init(next(keys), 1, 1, width, cout, c.dtype),
+                "norm3": _norm_params(cout, c.dtype),
+            }
+            if block_index == 0:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, cout, c.dtype)
+                block["proj_norm"] = _norm_params(cout, c.dtype)
+            stage.append(block)
+            cin = cout
+        params["stages"].append(stage)
+    head_key = next(keys)
+    params["head"] = (
+        jax.random.normal(head_key, (cin, c.num_classes), jnp.float32) / math.sqrt(cin)
+    ).astype(c.dtype)
+    return params
+
+
+def _conv(x, kernel, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _norm(x, p):
+    # Folded batch-norm: scale/bias only (inference-style; training benches
+    # exercise the same compute shape).
+    return x * p["scale"] + p["bias"]
+
+
+def _bottleneck(x, block, stride):
+    shortcut = x
+    y = jax.nn.relu(_norm(_conv(x, block["conv1"]), block["norm1"]))
+    y = jax.nn.relu(_norm(_conv(y, block["conv2"], stride=stride), block["norm2"]))
+    y = _norm(_conv(y, block["conv3"]), block["norm3"])
+    if "proj" in block:
+        shortcut = _norm(_conv(x, block["proj"], stride=stride), block["proj_norm"])
+    return jax.nn.relu(y + shortcut)
+
+
+def resnet_forward(params: Params, images: jax.Array, config: ResNetConfig) -> jax.Array:
+    """images [B, H, W, 3] → logits [B, num_classes] (float32)."""
+    x = images.astype(config.dtype)
+    x = jax.nn.relu(_norm(_conv(x, params["stem"]["conv"], stride=2), params["stem"]["norm"]))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for stage_index, stage in enumerate(params["stages"]):
+        for block_index, block in enumerate(stage):
+            stride = 2 if (stage_index > 0 and block_index == 0) else 1
+            x = _bottleneck(x, block, stride)
+    x = jnp.mean(x, axis=(1, 2))
+    return (x @ params["head"]).astype(jnp.float32)

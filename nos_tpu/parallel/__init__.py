@@ -1,0 +1,11 @@
+from nos_tpu.parallel.mesh import mesh_from_devices, mesh_for_slice
+from nos_tpu.parallel.sharding import llama_param_sharding, llama_data_sharding
+from nos_tpu.parallel.train import make_train_step
+
+__all__ = [
+    "llama_data_sharding",
+    "llama_param_sharding",
+    "make_train_step",
+    "mesh_for_slice",
+    "mesh_from_devices",
+]

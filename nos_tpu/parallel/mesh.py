@@ -1,0 +1,53 @@
+"""Device meshes from TPU slice topologies.
+
+The bridge between the control plane's slice geometry and JAX's SPMD
+model: a pod scheduled onto a carved slice builds its Mesh from the same
+topology string the partitioner used, so data-parallel traffic rides the
+slower mesh dimension and tensor-parallel collectives ride the contiguous
+ICI dimension.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from nos_tpu.tpu.topology import Topology
+
+
+def mesh_from_devices(
+    axis_shapes: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = int(np.prod(axis_shapes))
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices for mesh {tuple(axis_shapes)}, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(tuple(axis_shapes))
+    return Mesh(grid, tuple(axis_names))
+
+
+def mesh_for_slice(
+    topology: str,
+    dp: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """('dp','tp') mesh covering one slice.
+
+    Tensor parallelism wants the fastest all-reduce, so tp takes the last
+    (contiguous) topology dimension; everything else folds into dp. An
+    explicit `dp` overrides the split (dp·tp must equal the chip count).
+    """
+    t = Topology(topology)
+    chips = t.chips
+    if dp is None:
+        tp = t.dims[-1]
+        dp = chips // tp
+    else:
+        if chips % dp:
+            raise ValueError(f"dp={dp} does not divide {chips} chips")
+        tp = chips // dp
+    return mesh_from_devices((dp, tp), ("dp", "tp"), devices)

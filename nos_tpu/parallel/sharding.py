@@ -1,0 +1,46 @@
+"""Sharding rules for the Llama model over a ('dp','tp') mesh.
+
+Megatron-style tensor parallelism: attention q/k/v and mlp gate/up shard
+their output (head / ff) dimension over tp, wo and w_down shard their
+input dimension — each layer needs exactly one psum on the residual path,
+which XLA inserts from these NamedShardings. Embedding/lm_head shard the
+vocab dimension. The batch dimension shards over dp. Parameters are
+replicated over dp (pure data parallelism; FSDP-style parameter sharding
+over dp is a later-round extension).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nos_tpu.models.llama import LlamaConfig
+
+
+def _ns(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
+    layer = {
+        "attn_norm": _ns(mesh),
+        "wq": _ns(mesh, None, "tp"),
+        "wk": _ns(mesh, None, "tp"),
+        "wv": _ns(mesh, None, "tp"),
+        "wo": _ns(mesh, "tp", None),
+        "mlp_norm": _ns(mesh),
+        "w_gate": _ns(mesh, None, "tp"),
+        "w_up": _ns(mesh, None, "tp"),
+        "w_down": _ns(mesh, "tp", None),
+    }
+    return {
+        "embed": _ns(mesh, "tp", None),
+        "final_norm": _ns(mesh),
+        "lm_head": _ns(mesh, None, "tp"),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+
+
+def llama_data_sharding(mesh: Mesh) -> NamedSharding:
+    """Tokens [B, S]: batch over dp."""
+    return _ns(mesh, "dp", None)
