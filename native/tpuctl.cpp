@@ -1,0 +1,463 @@
+// tpuctl — host-local TPU slice control library.
+//
+// The native boundary of the suite, mirroring the role of the reference's
+// CGO NVML client (pkg/gpu/nvml/client.go: the only code touching
+// hardware). TPUs have no MIG-style hardware partitioner, so the concrete
+// host-side artifact of a "slice" is (a) an entry in the host slice-state
+// file the TPU device plugin re-exposes, and (b) a *chip assignment*: an
+// ICI-contiguous rectangle of the board's chip grid. tpuctl owns both:
+//
+//  - atomic, flock-guarded read/modify/write of the per-node state file;
+//  - a 2D/3D occupancy grid per board with first-fit rectangle placement
+//    (any orientation), so fragmentation is tracked at chip granularity —
+//    stricter than the control plane's multiset model, exactly like NVML
+//    placement is stricter than MIG profile counts (the reference
+//    brute-forces creation orders for the same reason,
+//    pkg/gpu/nvml/client.go:286-340);
+//  - device enumeration from /dev/accel* (overridable root for tests)
+//    plus TPU runtime env (TPU_ACCELERATOR_TYPE / TPU_TOPOLOGY).
+//
+// State file format (line-based, versioned):
+//   tpuctl/1
+//   <device-id> <board> <profile> <chip,chip,...>
+//
+// All functions return 0 on success, negative on error, writing a message
+// into err. Exposed with C linkage for ctypes.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <string>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Topo {
+  std::vector<int> dims;
+  bool ok = false;
+};
+
+Topo parse_topo(const std::string& s) {
+  Topo t;
+  int value = 0;
+  bool have = false;
+  for (char c : s) {
+    if (c >= '0' && c <= '9') {
+      value = value * 10 + (c - '0');
+      have = true;
+    } else if (c == 'x' && have) {
+      t.dims.push_back(value);
+      value = 0;
+      have = false;
+    } else {
+      return t;
+    }
+  }
+  if (!have) return t;
+  t.dims.push_back(value);
+  for (int d : t.dims)
+    if (d < 1) return t;
+  t.ok = !t.dims.empty();
+  return t;
+}
+
+int chips_of(const Topo& t) {
+  int n = 1;
+  for (int d : t.dims) n *= d;
+  return n;
+}
+
+struct Slice {
+  std::string id;
+  int board;
+  std::string profile;
+  std::vector<int> chips;
+};
+
+struct State {
+  std::vector<Slice> slices;
+};
+
+const char* kHeader = "tpuctl/1";
+
+bool parse_state(FILE* f, State* out, std::string* err) {
+  char line[4096];
+  if (!fgets(line, sizeof line, f)) return true;  // empty file = empty state
+  if (strncmp(line, kHeader, strlen(kHeader)) != 0) {
+    *err = "bad state header";
+    return false;
+  }
+  while (fgets(line, sizeof line, f)) {
+    Slice s;
+    char id[256], profile[64], chips[2048];
+    int board;
+    if (sscanf(line, "%255s %d %63s %2047s", id, &board, profile, chips) != 4) {
+      continue;  // tolerate trailing newline/garbage
+    }
+    s.id = id;
+    s.board = board;
+    s.profile = profile;
+    const char* p = chips;
+    int v = 0;
+    bool have = false;
+    for (; *p; ++p) {
+      if (*p >= '0' && *p <= '9') {
+        v = v * 10 + (*p - '0');
+        have = true;
+      } else if (*p == ',' && have) {
+        s.chips.push_back(v);
+        v = 0;
+        have = false;
+      }
+    }
+    if (have) s.chips.push_back(v);
+    out->slices.push_back(std::move(s));
+  }
+  return true;
+}
+
+bool write_state(const std::string& path, const State& state, std::string* err) {
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) {
+    *err = std::string("open tmp: ") + strerror(errno);
+    return false;
+  }
+  fprintf(f, "%s\n", kHeader);
+  for (const auto& s : state.slices) {
+    fprintf(f, "%s %d %s ", s.id.c_str(), s.board, s.profile.c_str());
+    for (size_t i = 0; i < s.chips.size(); ++i)
+      fprintf(f, "%s%d", i ? "," : "", s.chips[i]);
+    fprintf(f, "\n");
+  }
+  if (fflush(f) != 0 || fsync(fileno(f)) != 0) {
+    *err = std::string("flush: ") + strerror(errno);
+    fclose(f);
+    return false;
+  }
+  fclose(f);
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    *err = std::string("rename: ") + strerror(errno);
+    return false;
+  }
+  return true;
+}
+
+// RAII flock on <path>.lock.
+class FileLock {
+ public:
+  explicit FileLock(const std::string& path) {
+    fd_ = open((path + ".lock").c_str(), O_CREAT | O_RDWR, 0644);
+    if (fd_ >= 0) flock(fd_, LOCK_EX);
+  }
+  ~FileLock() {
+    if (fd_ >= 0) {
+      flock(fd_, LOCK_UN);
+      close(fd_);
+    }
+  }
+  bool held() const { return fd_ >= 0; }
+
+ private:
+  int fd_ = -1;
+};
+
+bool load_state(const std::string& path, State* state, std::string* err) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) {
+    if (errno == ENOENT) return true;  // no file yet = empty state
+    *err = std::string("open: ") + strerror(errno);
+    return false;
+  }
+  bool ok = parse_state(f, state, err);
+  fclose(f);
+  return ok;
+}
+
+// Linear index of a coordinate in the board grid (row-major).
+int grid_index(const std::vector<int>& board, const std::vector<int>& coord) {
+  int idx = 0;
+  for (size_t i = 0; i < board.size(); ++i) idx = idx * board[i] + coord[i];
+  return idx;
+}
+
+// Backtracking placement of a set of slices onto the occupancy grid.
+// Largest-first ordering is the good heuristic start; full backtracking
+// makes placement order-independent — the problem the reference works
+// around by brute-forcing NVML creation-order permutations
+// (pkg/gpu/nvml/client.go:286-340) is solved exactly here.
+bool place_all(const Topo& board, std::vector<bool>& occupied,
+               const std::vector<Topo>& profiles, size_t index,
+               std::vector<std::vector<int>>* out) {
+  if (index == profiles.size()) return true;
+  const Topo& prof = profiles[index];
+  std::vector<int> dims = prof.dims;
+  std::sort(dims.begin(), dims.end());
+  std::vector<std::vector<int>> orients;
+  do {
+    if (dims.size() == board.dims.size()) orients.push_back(dims);
+  } while (std::next_permutation(dims.begin(), dims.end()));
+
+  int rank = (int)board.dims.size();
+  std::vector<int> anchor(rank, 0);
+  for (;;) {
+    for (const auto& o : orients) {
+      bool fits = true;
+      for (int i = 0; i < rank && fits; ++i)
+        if (anchor[i] + o[i] > board.dims[i]) fits = false;
+      if (!fits) continue;
+      // Collect the covered cells; check all free.
+      std::vector<int> cells;
+      std::vector<int> offset(rank, 0);
+      bool free_all = true;
+      for (;;) {
+        std::vector<int> coord(rank);
+        for (int i = 0; i < rank; ++i) coord[i] = anchor[i] + offset[i];
+        int idx = grid_index(board.dims, coord);
+        if (occupied[idx]) {
+          free_all = false;
+          break;
+        }
+        cells.push_back(idx);
+        int axis = rank - 1;
+        while (axis >= 0) {
+          if (++offset[axis] < o[axis]) break;
+          offset[axis] = 0;
+          --axis;
+        }
+        if (axis < 0) break;
+      }
+      if (!free_all) continue;
+      for (int c : cells) occupied[c] = true;
+      (*out)[index] = cells;
+      if (place_all(board, occupied, profiles, index + 1, out)) return true;
+      for (int c : cells) occupied[c] = false;
+    }
+    int axis = rank - 1;
+    while (axis >= 0) {
+      if (++anchor[axis] < board.dims[axis]) break;
+      anchor[axis] = 0;
+      --axis;
+    }
+    if (axis < 0) break;
+  }
+  return false;
+}
+
+int fail(char* err, int errcap, const std::string& message, int code = -1) {
+  if (err && errcap > 0) snprintf(err, errcap, "%s", message.c_str());
+  return code;
+}
+
+int emit(char* out, int cap, const std::string& s) {
+  if ((int)s.size() + 1 > cap) return -2;  // caller buffer too small
+  memcpy(out, s.c_str(), s.size() + 1);
+  return (int)s.size();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Enumerate accelerator device nodes under dev_root (e.g. "/dev"): counts
+// files named accel* (TPU chips appear as /dev/accel0..N or vfio entries).
+// Output: "<count>\n<name>\n<name>...". Env TPU_ACCELERATOR_TYPE /
+// TPU_TOPOLOGY are appended as "env <k>=<v>" lines when present.
+int tpuctl_enumerate(const char* dev_root, char* out, int cap) {
+  std::string result;
+  int count = 0;
+  std::string names;
+  std::string root = dev_root ? dev_root : "/dev";
+  DIR* d = opendir(root.c_str());
+  if (d) {
+    while (dirent* e = readdir(d)) {
+      if (strncmp(e->d_name, "accel", 5) != 0 &&
+          strncmp(e->d_name, "vfio", 4) != 0)
+        continue;
+      // Skip directories (e.g. the /dev/vfio container dir itself); only
+      // device nodes / files count as accelerators.
+      struct stat st;
+      std::string path = root + "/" + e->d_name;
+      if (stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) continue;
+      ++count;
+      names += e->d_name;
+      names += "\n";
+    }
+    closedir(d);
+  }
+  char buf[64];
+  snprintf(buf, sizeof buf, "%d\n", count);
+  result = buf + names;
+  for (const char* key : {"TPU_ACCELERATOR_TYPE", "TPU_TOPOLOGY"}) {
+    const char* value = getenv(key);
+    if (value) {
+      result += "env ";
+      result += key;
+      result += "=";
+      result += value;
+      result += "\n";
+    }
+  }
+  return emit(out, cap, result);
+}
+
+// List slices: one "<id> <board> <profile> <chips>" line per slice.
+int tpuctl_list_slices(const char* state_path, char* out, int cap, char* err,
+                       int errcap) {
+  FileLock lock(state_path);
+  if (!lock.held()) return fail(err, errcap, "cannot acquire lock");
+  State state;
+  std::string e;
+  if (!load_state(state_path, &state, &e)) return fail(err, errcap, e);
+  std::string result;
+  for (const auto& s : state.slices) {
+    result += s.id + " " + std::to_string(s.board) + " " + s.profile + " ";
+    for (size_t i = 0; i < s.chips.size(); ++i)
+      result += (i ? "," : "") + std::to_string(s.chips[i]);
+    result += "\n";
+  }
+  return emit(out, cap, result);
+}
+
+// Create a batch of slices ("profile:qty,profile:qty") on one board,
+// assigning ICI-contiguous chips with backtracking so the outcome does not
+// depend on creation order. All-or-nothing.
+int tpuctl_create_slices_batch(const char* state_path,
+                               const char* board_topology, int board_index,
+                               const char* spec, char* err, int errcap) {
+  Topo board = parse_topo(board_topology ? board_topology : "");
+  if (!board.ok) return fail(err, errcap, "invalid board topology");
+
+  std::vector<std::pair<Topo, std::string>> wanted;  // (topo, name)
+  {
+    std::string s = spec ? spec : "";
+    size_t pos = 0;
+    while (pos < s.size()) {
+      size_t comma = s.find(',', pos);
+      size_t end = comma == std::string::npos ? s.size() : comma;
+      std::string item = s.substr(pos, end - pos);
+      size_t colon = item.find(':');
+      if (colon == std::string::npos)
+        return fail(err, errcap, "bad spec item: " + item);
+      std::string name = item.substr(0, colon);
+      int qty = atoi(item.c_str() + colon + 1);
+      Topo t = parse_topo(name);
+      if (!t.ok || t.dims.size() != board.dims.size())
+        return fail(err, errcap, "invalid profile topology: " + name);
+      if (qty < 1) return fail(err, errcap, "quantity must be >= 1");
+      for (int i = 0; i < qty; ++i) wanted.push_back({t, name});
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+  }
+  if (wanted.empty()) return 0;
+  // Largest-first: best heuristic order for the backtracking search.
+  std::stable_sort(wanted.begin(), wanted.end(),
+                   [](const auto& a, const auto& b) {
+                     return chips_of(a.first) > chips_of(b.first);
+                   });
+
+  FileLock lock(state_path);
+  if (!lock.held()) return fail(err, errcap, "cannot acquire lock");
+  State state;
+  std::string e;
+  if (!load_state(state_path, &state, &e)) return fail(err, errcap, e);
+
+  std::vector<bool> occupied(chips_of(board), false);
+  int max_id = 0;
+  for (const auto& s : state.slices) {
+    if (s.board == board_index)
+      for (int c : s.chips)
+        if (c >= 0 && c < (int)occupied.size()) occupied[c] = true;
+    size_t dash = s.id.rfind('-');
+    if (dash != std::string::npos)
+      max_id = std::max(max_id, atoi(s.id.c_str() + dash + 1));
+  }
+
+  std::vector<Topo> profiles;
+  for (const auto& w : wanted) profiles.push_back(w.first);
+  std::vector<std::vector<int>> positions(profiles.size());
+  if (!place_all(board, occupied, profiles, 0, &positions))
+    return fail(err, errcap,
+                std::string("no contiguous placement for batch ") + spec +
+                    " (fragmented board)",
+                -3);
+  for (size_t i = 0; i < wanted.size(); ++i) {
+    Slice s;
+    s.board = board_index;
+    s.profile = wanted[i].second;
+    s.chips = positions[i];
+    s.id = std::string("tpu-") + std::to_string(board_index) + "-" +
+           wanted[i].second + "-" + std::to_string(++max_id);
+    state.slices.push_back(std::move(s));
+  }
+  if (!write_state(state_path, state, &e)) return fail(err, errcap, e);
+  return 0;
+}
+
+// Single-profile convenience wrapper.
+int tpuctl_create_slices(const char* state_path, const char* board_topology,
+                         int board_index, const char* profile, int quantity,
+                         char* err, int errcap) {
+  if (!profile || quantity < 1)
+    return fail(err, errcap, "quantity must be >= 1");
+  std::string spec = std::string(profile) + ":" + std::to_string(quantity);
+  return tpuctl_create_slices_batch(state_path, board_topology, board_index,
+                                    spec.c_str(), err, errcap);
+}
+
+int tpuctl_delete_slice(const char* state_path, const char* device_id,
+                        char* err, int errcap) {
+  FileLock lock(state_path);
+  if (!lock.held()) return fail(err, errcap, "cannot acquire lock");
+  State state;
+  std::string e;
+  if (!load_state(state_path, &state, &e)) return fail(err, errcap, e);
+  size_t before = state.slices.size();
+  state.slices.erase(
+      std::remove_if(state.slices.begin(), state.slices.end(),
+                     [&](const Slice& s) { return s.id == device_id; }),
+      state.slices.end());
+  if (state.slices.size() == before)
+    return fail(err, errcap, std::string("slice not found: ") + device_id, -4);
+  if (!write_state(state_path, state, &e)) return fail(err, errcap, e);
+  return 0;
+}
+
+// Delete every slice except the ids in keep (comma-separated) — startup
+// cleanup of orphans (reference cmd/migagent/migagent.go:190-199).
+int tpuctl_delete_all_except(const char* state_path, const char* keep,
+                             char* err, int errcap) {
+  FileLock lock(state_path);
+  if (!lock.held()) return fail(err, errcap, "cannot acquire lock");
+  State state;
+  std::string e;
+  if (!load_state(state_path, &state, &e)) return fail(err, errcap, e);
+  std::string keep_s = keep ? keep : "";
+  auto kept = [&](const std::string& id) {
+    size_t pos = 0;
+    while (pos <= keep_s.size()) {
+      size_t comma = keep_s.find(',', pos);
+      size_t end = comma == std::string::npos ? keep_s.size() : comma;
+      if (keep_s.compare(pos, end - pos, id) == 0 && end - pos == id.size())
+        return true;
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+    return false;
+  };
+  state.slices.erase(
+      std::remove_if(state.slices.begin(), state.slices.end(),
+                     [&](const Slice& s) { return !kept(s.id); }),
+      state.slices.end());
+  if (!write_state(state_path, state, &e)) return fail(err, errcap, e);
+  return 0;
+}
+
+}  // extern "C"

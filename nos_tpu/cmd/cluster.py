@@ -23,6 +23,7 @@ from nos_tpu.cmd.scheduler import build_scheduler
 from nos_tpu.cmd.tpuagent import build_tpuagent
 from nos_tpu.controllers.partitioner import PartitionerController
 from nos_tpu.device import (
+    DevicePluginAdvertiser,
     SimDevicePlugin,
     SimDevicePool,
     SimPodResourcesClient,
@@ -43,7 +44,10 @@ class SimCluster:
     pool: SimDevicePool
     partitioner: PartitionerController
     scheduler: Scheduler
+    device_backend: str = "sim"  # "sim" | "tpuctl" (native C++ slice state)
+    tpuctl_dir: str = ""
     _agent_nodes: List[str] = field(default_factory=list)
+    _tpuctl_client: object = None
 
     def add_tpu_node(self, node: Node, agent_config: Optional[TpuAgentConfig] = None) -> None:
         """Create the node and start its tpuagent (must be called before
@@ -54,10 +58,18 @@ class SimCluster:
     def start_agent(self, node_name: str, agent_config: Optional[TpuAgentConfig] = None) -> None:
         if node_name in self._agent_nodes:
             return
-        client = TpuClient(
-            SimTpuDeviceClient(self.pool), SimPodResourcesClient(self.store, self.pool)
-        )
-        plugin = SimDevicePlugin(self.store, self.pool)
+        if self.device_backend == "tpuctl":
+            device_client = self._tpuctl(node_name)
+            client = TpuClient(
+                device_client, SimPodResourcesClient(self.store, device_client.get_slices)
+            )
+            plugin = DevicePluginAdvertiser(self.store, device_client.geometry)
+        else:
+            client = TpuClient(
+                SimTpuDeviceClient(self.pool),
+                SimPodResourcesClient(self.store, self.pool.get),
+            )
+            plugin = SimDevicePlugin(self.store, self.pool)
         build_tpuagent(
             self.manager,
             node_name,
@@ -66,6 +78,20 @@ class SimCluster:
             agent_config or TpuAgentConfig(report_config_interval_seconds=0.5),
         )
         self._agent_nodes.append(node_name)
+
+    def _tpuctl(self, node_name: str):
+        from nos_tpu.api.v1alpha1 import constants
+        from nos_tpu.api.v1alpha1.labels import GKE_TPU_ACCELERATOR_LABEL
+        from nos_tpu.device.tpuctl import TpuctlDeviceClient
+        from nos_tpu.tpu.known import board_layout
+
+        if self._tpuctl_client is None:
+            self._tpuctl_client = TpuctlDeviceClient(self.tpuctl_dir, {})
+        node = self.store.get("Node", node_name)
+        accelerator = node.metadata.labels.get(GKE_TPU_ACCELERATOR_LABEL, "")
+        chips = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        self._tpuctl_client.board_topologies[node_name] = board_layout(accelerator, chips)
+        return self._tpuctl_client
 
     def start(self) -> None:
         self.manager.start()
@@ -82,6 +108,8 @@ def build_cluster(
     partitioner_config: Optional[GpuPartitionerConfig] = None,
     scheduler_config: Optional[SchedulerConfig] = None,
     operator_config: Optional[OperatorConfig] = None,
+    device_backend: str = "sim",
+    tpuctl_dir: str = "",
 ) -> SimCluster:
     store = store or KubeStore()
     manager = Manager(store=store)
@@ -116,4 +144,6 @@ def build_cluster(
         pool=SimDevicePool(),
         partitioner=partitioner,
         scheduler=scheduler,
+        device_backend=device_backend,
+        tpuctl_dir=tpuctl_dir,
     )

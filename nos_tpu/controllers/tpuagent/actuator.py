@@ -63,14 +63,19 @@ class TpuActuator:
         for device in plan.deletes:
             self.client.delete_slice(self.node_name, device.device_id)
             log.info("actuator: %s deleted %s", self.node_name, device.device_id)
+        creates_by_board: dict = {}
         for op in plan.creates:
-            self.client.create_slices(self.node_name, op.board_index, op.profile, op.quantity)
+            board = creates_by_board.setdefault(op.board_index, {})
+            board[op.profile] = board.get(op.profile, 0) + op.quantity
+        for board_index, profiles in sorted(creates_by_board.items()):
+            # One batch per board: chip-placement-aware backends solve all
+            # of a board's creates together (order-independent).
+            self.client.create_slices_batch(self.node_name, board_index, profiles)
             log.info(
-                "actuator: %s created %dx %s on board %d",
+                "actuator: %s created %s on board %d",
                 self.node_name,
-                op.quantity,
-                op.profile,
-                op.board_index,
+                profiles,
+                board_index,
             )
         self.device_plugin.restart(self.node_name)
         self.shared.on_apply(plan_id)

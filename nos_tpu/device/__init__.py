@@ -12,6 +12,7 @@ SimDevicePool in tests and kind-style dry runs) composed with
 from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
 from nos_tpu.device.client import TpuClient
 from nos_tpu.device.sim import (
+    DevicePluginAdvertiser,
     SimDevicePlugin,
     SimDevicePool,
     SimPodResourcesClient,
@@ -19,6 +20,7 @@ from nos_tpu.device.sim import (
 )
 
 __all__ = [
+    "DevicePluginAdvertiser",
     "DeviceStatus",
     "SimDevicePlugin",
     "SimDevicePool",

@@ -50,5 +50,17 @@ class TpuClient:
     def create_slices(self, node_name: str, board_index: int, profile: str, quantity: int) -> None:
         self.device_client.create_slices(node_name, board_index, profile, quantity)
 
+    def create_slices_batch(self, node_name: str, board_index: int, profiles) -> None:
+        """One board's creates as a unit. Placement-aware backends (tpuctl)
+        solve the whole batch at once — sequential creates are
+        order-dependent on a chip grid; placement-free backends just loop."""
+        batch = getattr(self.device_client, "create_slices_batch", None)
+        if batch is not None:
+            batch(node_name, board_index, profiles)
+            return
+        for profile, quantity in sorted(profiles.items()):
+            if quantity > 0:
+                self.device_client.create_slices(node_name, board_index, profile, quantity)
+
     def delete_slice(self, node_name: str, device_id: str) -> None:
         self.device_client.delete_slice(node_name, device_id)

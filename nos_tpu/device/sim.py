@@ -77,11 +77,12 @@ class SimTpuDeviceClient:
 class SimPodResourcesClient:
     """Derives used device ids from the pods bound to the node, assigning
     free devices of the requested profile deterministically (smallest id
-    first) — the sim stand-in for kubelet's allocation records."""
+    first) — the sim stand-in for kubelet's allocation records. Works over
+    any slice source: a callable node → devices."""
 
-    def __init__(self, store: KubeStore, pool: SimDevicePool) -> None:
+    def __init__(self, store: KubeStore, slices_fn) -> None:
         self.store = store
-        self.pool = pool
+        self._slices_fn = slices_fn
 
     def get_used_device_ids(self, node_name: str) -> List[str]:
         from nos_tpu.api.v1alpha1 import labels
@@ -106,7 +107,7 @@ class SimPodResourcesClient:
                     profile = constants.tpu_slice_topology(name)
                     demand[profile] = demand.get(profile, 0) + int(qty)
         used: List[str] = []
-        devices = sorted(self.pool.get(node_name), key=lambda d: d.device_id)
+        devices = sorted(self._slices_fn(node_name), key=lambda d: d.device_id)
         for device in devices:
             if demand.get(device.profile, 0) > 0:
                 demand[device.profile] -= 1
@@ -114,16 +115,18 @@ class SimPodResourcesClient:
         return used
 
 
-class SimDevicePlugin:
-    """Re-advertises the pool's carved slices on the Node object — what the
-    device-plugin restart accomplishes in the reference."""
+class DevicePluginAdvertiser:
+    """Re-advertises carved slices on the Node object — what a device-plugin
+    restart accomplishes in the reference (pkg/gpu/client.go:51-135). The
+    slice source is any callable node → {board: {profile: count}}, so the
+    same advertiser serves the sim pool and the native tpuctl backend."""
 
-    def __init__(self, store: KubeStore, pool: SimDevicePool) -> None:
+    def __init__(self, store: KubeStore, geometry_fn) -> None:
         self.store = store
-        self.pool = pool
+        self.geometry_fn = geometry_fn
 
     def restart(self, node_name: str) -> None:
-        geometry = self.pool.geometry(node_name)
+        geometry = self.geometry_fn(node_name)
         try:
             node = self.store.get("Node", node_name)
         except NotFoundError:
@@ -150,3 +153,9 @@ class SimDevicePlugin:
             target[constants.RESOURCE_TPU] = max(0, total_chips - chips_exposed)
 
         self.store.patch_merge("Node", node_name, "", mutate)
+
+
+class SimDevicePlugin(DevicePluginAdvertiser):
+    def __init__(self, store: KubeStore, pool: SimDevicePool) -> None:
+        super().__init__(store, pool.geometry)
+        self.pool = pool

@@ -1,0 +1,242 @@
+"""ctypes binding for the native tpuctl library.
+
+`TpuctlDeviceClient` implements the TpuDeviceClient protocol (the
+nvml.Client-shaped seam, reference pkg/gpu/nvml/interface.go:23-36) on top
+of libtpuctl.so: per-node state files under a base directory, with the C++
+side owning locking, atomic persistence, and concrete ICI-contiguous chip
+placement. The library is built on demand from native/ (no pip deps).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+from nos_tpu.device.types import TpuSliceDevice
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuctl.so")
+_build_lock = threading.Lock()
+
+
+class TpuctlError(RuntimeError):
+    pass
+
+
+class TpuctlUnavailableError(TpuctlError):
+    """Library missing and not buildable (no toolchain)."""
+
+
+def build_library(force: bool = False) -> str:
+    """Build libtpuctl.so via make; returns its path. make is always
+    invoked (its mtime check makes it a no-op when current), so editing
+    tpuctl.cpp never leaves a stale library silently loaded."""
+    with _build_lock:
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR] + (["-B"] if force else []),
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            if os.path.exists(_LIB_PATH) and not force:
+                return _LIB_PATH  # prebuilt library, no toolchain: best effort
+            detail = getattr(e, "stderr", "") or str(e)
+            raise TpuctlUnavailableError(f"cannot build libtpuctl.so: {detail}")
+        return _LIB_PATH
+
+
+def load_library() -> ctypes.CDLL:
+    lib = ctypes.CDLL(build_library())
+    lib.tpuctl_enumerate.restype = ctypes.c_int
+    lib.tpuctl_enumerate.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.tpuctl_list_slices.restype = ctypes.c_int
+    lib.tpuctl_list_slices.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tpuctl_create_slices.restype = ctypes.c_int
+    lib.tpuctl_create_slices.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tpuctl_create_slices_batch.restype = ctypes.c_int
+    lib.tpuctl_create_slices_batch.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tpuctl_delete_slice.restype = ctypes.c_int
+    lib.tpuctl_delete_slice.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tpuctl_delete_all_except.restype = ctypes.c_int
+    lib.tpuctl_delete_all_except.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    return lib
+
+
+_ERR_CAP = 1024
+_OUT_CAP = 1 << 20
+
+
+class TpuctlDeviceClient:
+    """TpuDeviceClient over libtpuctl.so.
+
+    `board_topologies` maps node name → board topologies (index = board),
+    mirroring what the agent derives from GKE labels; state files live at
+    ``<base_dir>/<node>.slices``.
+    """
+
+    def __init__(
+        self,
+        base_dir: str,
+        board_topologies: Dict[str, List[str]],
+        lib: Optional[ctypes.CDLL] = None,
+    ) -> None:
+        self.base_dir = base_dir
+        self.board_topologies = board_topologies
+        os.makedirs(base_dir, exist_ok=True)
+        self.lib = lib if lib is not None else load_library()
+
+    # ------------------------------------------------------------ paths
+
+    def _state_path(self, node_name: str) -> bytes:
+        return os.path.join(self.base_dir, f"{node_name}.slices").encode()
+
+    # ------------------------------------------------------- operations
+
+    def _list_lines(self, node_name: str) -> List[List[str]]:
+        """Parsed '<id> <board> <profile> <chips>' records from the lib."""
+        out = ctypes.create_string_buffer(_OUT_CAP)
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self.lib.tpuctl_list_slices(
+            self._state_path(node_name), out, _OUT_CAP, err, _ERR_CAP
+        )
+        if rc < 0:
+            raise TpuctlError(err.value.decode())
+        return [
+            parts
+            for line in out.value.decode().splitlines()
+            if len(parts := line.split()) == 4
+        ]
+
+    def get_slices(self, node_name: str) -> List[TpuSliceDevice]:
+        return [
+            TpuSliceDevice(device_id=p[0], board_index=int(p[1]), profile=p[2])
+            for p in self._list_lines(node_name)
+        ]
+
+    def create_slices(
+        self, node_name: str, board_index: int, profile: str, quantity: int
+    ) -> None:
+        boards = self.board_topologies.get(node_name, [])
+        if not 0 <= board_index < len(boards):
+            raise TpuctlError(f"{node_name}: unknown board {board_index}")
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self.lib.tpuctl_create_slices(
+            self._state_path(node_name),
+            boards[board_index].encode(),
+            board_index,
+            profile.encode(),
+            quantity,
+            err,
+            _ERR_CAP,
+        )
+        if rc < 0:
+            raise TpuctlError(err.value.decode())
+
+    def create_slices_batch(
+        self, node_name: str, board_index: int, profiles: Dict[str, int]
+    ) -> None:
+        """Atomically place a whole set of slices on one board: the C++
+        backtracking search is order-independent, unlike sequential
+        first-fit creates."""
+        boards = self.board_topologies.get(node_name, [])
+        if not 0 <= board_index < len(boards):
+            raise TpuctlError(f"{node_name}: unknown board {board_index}")
+        spec = ",".join(f"{p}:{q}" for p, q in sorted(profiles.items()) if q > 0)
+        if not spec:
+            return
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self.lib.tpuctl_create_slices_batch(
+            self._state_path(node_name),
+            boards[board_index].encode(),
+            board_index,
+            spec.encode(),
+            err,
+            _ERR_CAP,
+        )
+        if rc < 0:
+            raise TpuctlError(err.value.decode())
+
+    def delete_slice(self, node_name: str, device_id: str) -> None:
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self.lib.tpuctl_delete_slice(
+            self._state_path(node_name), device_id.encode(), err, _ERR_CAP
+        )
+        if rc < 0:
+            raise TpuctlError(err.value.decode())
+
+    def delete_all_except(self, node_name: str, keep_ids: List[str]) -> None:
+        """Startup cleanup of orphaned slices (reference
+        cmd/migagent/migagent.go:190-199)."""
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self.lib.tpuctl_delete_all_except(
+            self._state_path(node_name), ",".join(keep_ids).encode(), err, _ERR_CAP
+        )
+        if rc < 0:
+            raise TpuctlError(err.value.decode())
+
+    def geometry(self, node_name: str) -> Dict[int, Dict[str, int]]:
+        """{board: {profile: count}} for the device-plugin advertiser."""
+        out: Dict[int, Dict[str, int]] = {}
+        for device in self.get_slices(node_name):
+            board = out.setdefault(device.board_index, {})
+            board[device.profile] = board.get(device.profile, 0) + 1
+        return out
+
+    def chip_assignment(self, node_name: str) -> Dict[str, List[int]]:
+        """Device id → concrete chip indices (for the device plugin)."""
+        return {
+            p[0]: [int(c) for c in p[3].split(",") if c]
+            for p in self._list_lines(node_name)
+        }
+
+    def enumerate_host(self, dev_root: str = "/dev") -> Dict[str, object]:
+        out = ctypes.create_string_buffer(_OUT_CAP)
+        rc = self.lib.tpuctl_enumerate(dev_root.encode(), out, _OUT_CAP)
+        if rc < 0:
+            raise TpuctlError("enumerate failed")
+        lines = out.value.decode().splitlines()
+        count = int(lines[0]) if lines else 0
+        env = {}
+        names = []
+        for line in lines[1:]:
+            if line.startswith("env ") and "=" in line:
+                key, value = line[4:].split("=", 1)
+                env[key] = value
+            elif line:
+                names.append(line)
+        return {"device_count": count, "devices": names, "env": env}

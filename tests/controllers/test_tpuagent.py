@@ -23,7 +23,7 @@ def make_agent_env(node_name="n1", node=None):
     store = KubeStore()
     store.create(node or build_tpu_node(name=node_name))
     pool = SimDevicePool()
-    client = TpuClient(SimTpuDeviceClient(pool), SimPodResourcesClient(store, pool))
+    client = TpuClient(SimTpuDeviceClient(pool), SimPodResourcesClient(store, pool.get))
     plugin = SimDevicePlugin(store, pool)
     shared = SharedState()
     reporter = TpuReporter(store, client, node_name, shared, report_interval_seconds=10)

@@ -154,3 +154,31 @@ class TestEndToEnd:
             cluster.store.get("Pod", f"worker-{i}", "ml").spec.node_name for i in range(2)
         }
         assert nodes == {"tpu-0", "tpu-1"}
+
+
+class TestNativeBackend:
+    def test_carve_and_schedule_through_tpuctl(self, tmp_path):
+        """Same end-to-end loop, but slice state lives in the native C++
+        tpuctl library (flock-guarded state file + concrete chip
+        placement) instead of the in-memory sim pool."""
+        pytest.importorskip("ctypes")
+        from nos_tpu.device.tpuctl import TpuctlUnavailableError, build_library
+
+        try:
+            build_library()
+        except TpuctlUnavailableError as e:
+            pytest.skip(str(e))
+
+        c = build_cluster(device_backend="tpuctl", tpuctl_dir=str(tmp_path))
+        try:
+            c.add_tpu_node(build_tpu_node(name="tpu-native"))
+            c.start()
+            c.store.create(build_pod("train", {constants.RESOURCE_TPU: 4}, ns="ml"))
+            assert wait_for(pod_running_on(c.store, "train", "ml"), timeout=15)
+            # slice exists in the native state with concrete chips
+            chips = c._tpuctl_client.chip_assignment("tpu-native")
+            slices = {d.profile for d in c._tpuctl_client.get_slices("tpu-native")}
+            assert "2x2" in slices
+            assert any(len(v) == 4 for v in chips.values())
+        finally:
+            c.stop()
