@@ -1,0 +1,50 @@
+"""CLI dispatcher: `python -m nos_tpu <component> --config <file>`.
+
+Mirrors the reference's six binaries (SURVEY.md §2.1). `run` starts the
+whole suite in one process (kind-style); `export-metrics` is the one-shot
+telemetry job.
+"""
+import sys
+
+
+def main() -> int:
+    commands = {
+        "run": "the full suite (operator+partitioner+scheduler+agents)",
+        "export-metrics": "one-shot installation telemetry snapshot",
+        "bench": "the utilization benchmark",
+    }
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print("usage: python -m nos_tpu <command> [args]\n\ncommands:")
+        for name, desc in commands.items():
+            print(f"  {name:16s} {desc}")
+        return 0 if len(sys.argv) >= 2 else 2
+    command, argv = sys.argv[1], sys.argv[2:]
+    if command == "run":
+        from nos_tpu.cmd.run import main as run_main
+
+        return run_main(argv)
+    if command == "export-metrics":
+        from nos_tpu.cmd.metricsexporter import main as export_main
+
+        return export_main(argv)
+    if command == "bench":
+        import os
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            import bench
+        except ModuleNotFoundError:
+            print(
+                "bench.py not found (it lives at the repo root, not in the "
+                "installed package); run from a source checkout",
+                file=sys.stderr,
+            )
+            return 1
+        bench.main()
+        return 0
+    print(f"unknown command {command!r}; see --help", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

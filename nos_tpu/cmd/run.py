@@ -1,0 +1,138 @@
+"""`python -m nos_tpu run --config <file>`: run the full suite.
+
+Each reference binary takes exactly one ``--config <file>`` flag decoded
+into its typed ComponentConfig (cmd/gpupartitioner/gpupartitioner.go:74-101).
+The in-process equivalent runs all components against one store (the
+kind-style deployment of BASELINE config #1), optionally seeding simulated
+TPU nodes, serving healthz/readyz/metrics, until interrupted.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from nos_tpu.api.config import (
+    GpuPartitionerConfig,
+    SchedulerConfig,
+    TpuAgentConfig,
+)
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.cmd.cluster import build_cluster
+from nos_tpu.kube.objects import Node, NodeStatus, ObjectMeta
+from nos_tpu.util.health import HealthServer
+
+
+def load_config(path: str) -> dict:
+    if not path:
+        return {}
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def configs_from(config: dict):
+    # `section:` with no sub-keys parses to None — treat like absent.
+    p = config.get("partitioner") or {}
+    s = config.get("scheduler") or {}
+    a = config.get("agent") or {}
+    partitioner = GpuPartitionerConfig(
+        batch_window_timeout_seconds=p.get("batchWindowTimeoutSeconds", 60.0),
+        batch_window_idle_seconds=p.get("batchWindowIdleSeconds", 10.0),
+        known_tpu_geometries=p.get("knownTpuGeometries"),
+    )
+    scheduler = SchedulerConfig(
+        retry_seconds=s.get("retrySeconds", 0.5),
+        gang_wait_timeout_seconds=s.get("gangWaitTimeoutSeconds", 30.0),
+    )
+    agent = TpuAgentConfig(
+        report_config_interval_seconds=a.get("reportConfigIntervalSeconds", 10.0)
+    )
+    for c in (partitioner, scheduler, agent):
+        c.validate()
+    return partitioner, scheduler, agent
+
+
+def seed_node(spec: dict) -> Node:
+    chips = int(spec.get("chips", 8))
+    accelerator = spec.get("accelerator", "tpu-v5-lite-podslice")
+    alloc = {constants.RESOURCE_TPU: chips, "cpu": spec.get("cpu", 64), "memory": spec.get("memoryGB", 256)}
+    return Node(
+        metadata=ObjectMeta(
+            name=spec["name"],
+            labels={
+                labels.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                labels.GKE_TPU_TOPOLOGY_LABEL: spec.get("topology", "2x4"),
+                labels.PARTITIONING_LABEL: spec.get("partitioning", "tpu"),
+            },
+        ),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="Run the nos-tpu suite in-process")
+    parser.add_argument("--config", default="", help="YAML component config")
+    parser.add_argument("--health-port", type=int, default=None)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    config = load_config(args.config)
+    partitioner_cfg, scheduler_cfg, agent_cfg = configs_from(config)
+    cluster = build_cluster(
+        partitioner_config=partitioner_cfg,
+        scheduler_config=scheduler_cfg,
+        device_backend=config.get("deviceBackend", "sim"),
+        tpuctl_dir=config.get("tpuctlDir", "/tmp/nos-tpu"),
+    )
+    for spec in config.get("nodes", []):
+        cluster.add_tpu_node(seed_node(spec), agent_cfg)
+
+    port = args.health_port
+    if port is None:
+        port = (config.get("manager") or {}).get("healthProbePort", 8081)
+    health = HealthServer(port=port)
+    bound = health.start()
+    logging.info("health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics)", bound)
+
+    cluster.start()
+    stop = threading.Event()
+
+    # Maintain the telemetry snapshot the metricsexporter job forwards.
+    snapshot_path = (config.get("manager") or {}).get(
+        "metricsSnapshotPath", "/tmp/nos-tpu-metrics.json"
+    )
+    snapshot_interval = (config.get("manager") or {}).get("metricsSnapshotSeconds", 60)
+
+    def snapshot_loop():
+        from nos_tpu.cmd.metricsexporter import collect_metrics, export
+
+        while not stop.is_set():
+            try:
+                export(collect_metrics(cluster.store), snapshot_path)
+            except OSError:
+                logging.exception("metrics snapshot write failed")
+            stop.wait(snapshot_interval)
+
+    threading.Thread(target=snapshot_loop, name="metrics-snapshot", daemon=True).start()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    logging.info("nos-tpu suite running; Ctrl-C to stop")
+    try:
+        stop.wait()
+    finally:
+        cluster.stop()
+        health.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

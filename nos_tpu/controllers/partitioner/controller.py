@@ -27,6 +27,7 @@ from nos_tpu.partitioning.core import (
     PartitioningPlan,
     Planner,
 )
+from nos_tpu.util import metrics
 from nos_tpu.util import pod as podutil
 from nos_tpu.util.batcher import Batcher
 
@@ -149,6 +150,7 @@ class PartitionerController:
         applied = self.actuator.apply(current, plan)
         if applied:
             self.plans_applied += 1
+            metrics.PLANS_APPLIED.inc()
             log.info(
                 "partitioner: plan %s applied for %d pending pods", plan.id, len(pending)
             )

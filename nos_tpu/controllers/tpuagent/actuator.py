@@ -16,6 +16,7 @@ from nos_tpu.controllers.tpuagent.shared import SharedState
 from nos_tpu.device.client import TpuClient
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.util import metrics
 
 log = logging.getLogger("nos_tpu.tpuagent")
 
@@ -62,6 +63,7 @@ class TpuActuator:
 
         for device in plan.deletes:
             self.client.delete_slice(self.node_name, device.device_id)
+            metrics.SLICES_DELETED.inc()
             log.info("actuator: %s deleted %s", self.node_name, device.device_id)
         creates_by_board: dict = {}
         for op in plan.creates:
@@ -71,6 +73,7 @@ class TpuActuator:
             # One batch per board: chip-placement-aware backends solve all
             # of a board's creates together (order-independent).
             self.client.create_slices_batch(self.node_name, board_index, profiles)
+            metrics.SLICES_CREATED.inc(sum(profiles.values()))
             log.info(
                 "actuator: %s created %s on board %d",
                 self.node_name,

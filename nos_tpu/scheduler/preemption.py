@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 from nos_tpu.kube.objects import Pod, PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError
 from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.util import metrics
 from nos_tpu.util import pod as podutil
 
 log = logging.getLogger("nos_tpu.scheduler.preemption")
@@ -62,6 +63,7 @@ class Preemptor:
             )
             try:
                 self.store.delete("Pod", victim.metadata.name, victim.metadata.namespace)
+                metrics.PREEMPTIONS.inc()
             except NotFoundError:
                 pass
         return node_name

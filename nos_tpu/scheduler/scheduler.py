@@ -30,6 +30,7 @@ from nos_tpu.scheduler.framework import (
 from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
 from nos_tpu.scheduler.plugins.gang import GangScheduling
 from nos_tpu.scheduler.plugins.topology import IciTopologyScoring
+from nos_tpu.util import metrics
 
 log = logging.getLogger("nos_tpu.scheduler")
 
@@ -68,7 +69,6 @@ class Scheduler:
         self.gang = gang
         self.retry = retry_seconds
         self.pods_scheduled = 0
-        self.schedule_latencies: List[float] = []  # per-pod, seconds
         # Assume cache: pods reserved on a node but not yet bound (gang
         # members waiting in Permit). Without it, concurrent cycles would
         # stack every waiting member onto the same node.
@@ -163,7 +163,9 @@ class Scheduler:
         for bind_pod, node_name in to_bind:
             self._assumed.pop(bind_pod.namespaced_name, None)
             self._bind(bind_pod, node_name)
-        self.schedule_latencies.append(time.monotonic() - start)
+        metrics.SCHEDULE_LATENCY.observe(time.monotonic() - start)
+        if self.gang is not None and len(to_bind) > 1:
+            metrics.GANGS_SCHEDULED.inc()
         return None
 
     # ----------------------------------------------------------- helpers
@@ -201,6 +203,7 @@ class Scheduler:
         except NotFoundError:
             return
         self.pods_scheduled += 1
+        metrics.PODS_SCHEDULED.inc()
         log.info("scheduler: bound %s to %s", pod.namespaced_name, node_name)
 
     def _mark_unschedulable(self, pod: Pod, message: str) -> None:

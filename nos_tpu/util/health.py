@@ -1,0 +1,71 @@
+"""Health/readiness/metrics HTTP endpoints.
+
+Every reference binary registers healthz/readyz probes and a metrics
+endpoint on its controller manager (cmd/operator/operator.go:112-118,
+ControllerManagerConfigurationSpec addresses). This serves the same three
+endpoints for an in-process component set.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from nos_tpu.util.metrics import REGISTRY
+
+
+class HealthServer:
+    def __init__(
+        self,
+        port: int = 8081,
+        ready_check: Optional[Callable[[], bool]] = None,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self.port = port
+        self.ready_check = ready_check or (lambda: True)
+        self.host = host
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        """Starts serving; returns the bound port (0 picks a free one)."""
+        ready_check = self.ready_check
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    self._respond(200, "ok")
+                elif self.path == "/readyz":
+                    if ready_check():
+                        self._respond(200, "ok")
+                    else:
+                        self._respond(503, "not ready")
+                elif self.path == "/metrics":
+                    self._respond(200, REGISTRY.render(), "text/plain; version=0.0.4")
+                else:
+                    self._respond(404, "not found")
+
+            def _respond(self, code: int, body: str, ctype: str = "text/plain") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args) -> None:  # silence request logging
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="health", daemon=True
+        )
+        self._thread.start()
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,176 @@
+"""Domain metrics: Prometheus-text-format registry.
+
+The reference exposes only controller-runtime's default metrics and has no
+domain counters — called out as a gap in SURVEY.md §5 ("no 'slices
+created' counter") that the TPU build should fill. This registry backs the
+north-star measurements: plans applied, slices created/deleted, pods
+scheduled, schedule latency, preemptions, gang completions.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Gauge(Counter):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value}\n"
+        )
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    # Percentiles are computed from a bounded window of recent observations
+    # so a long-running scheduler never grows memory; counts/sum/buckets
+    # stay exact forever.
+    WINDOW = 1024
+
+    def __init__(self, name: str, help_text: str, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        from collections import deque
+
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._recent = deque(maxlen=self.WINDOW)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            self._recent.append(value)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> Optional[float]:
+        with self._lock:
+            if not self._recent:
+                return None
+            ordered = sorted(self._recent)
+            index = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
+            return ordered[index]
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} histogram",
+            ]
+            cumulative = 0
+            for bound, count in zip(self.buckets, self._counts):
+                cumulative += count
+                lines.append(f'{self.name}_bucket{{le="{bound}"}} {cumulative}')
+            cumulative += self._counts[-1]
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
+            lines.append(f"{self.name}_sum {self._sum}")
+            lines.append(f"{self.name}_count {self._count}")
+            return "\n".join(lines) + "\n"
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_text))
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_text))
+
+    def histogram(self, name: str, help_text: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_text, buckets))
+
+    def _get_or_create(self, name: str, factory):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = factory()
+            return self._metrics[name]
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "".join(m.render() for m in sorted(metrics, key=lambda m: m.name))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            metrics = dict(self._metrics)
+        out: Dict[str, float] = {}
+        for name, metric in metrics.items():
+            if isinstance(metric, Histogram):
+                out[f"{name}_count"] = metric.count
+                p50 = metric.percentile(50)
+                if p50 is not None:
+                    out[f"{name}_p50"] = p50
+            else:
+                out[name] = metric.value
+        return out
+
+
+# The process-wide registry (controller-runtime's metrics.Registry analogue).
+REGISTRY = MetricsRegistry()
+
+PLANS_APPLIED = REGISTRY.counter(
+    "nos_tpu_partitioning_plans_applied_total", "Partitioning plans actuated"
+)
+SLICES_CREATED = REGISTRY.counter(
+    "nos_tpu_slices_created_total", "TPU slices carved by agents"
+)
+SLICES_DELETED = REGISTRY.counter(
+    "nos_tpu_slices_deleted_total", "TPU slices destroyed by agents"
+)
+PODS_SCHEDULED = REGISTRY.counter(
+    "nos_tpu_pods_scheduled_total", "Pods bound by the scheduler"
+)
+PREEMPTIONS = REGISTRY.counter(
+    "nos_tpu_preemptions_total", "Pods evicted by quota preemption"
+)
+GANGS_SCHEDULED = REGISTRY.counter(
+    "nos_tpu_gangs_scheduled_total", "Gangs released for binding"
+)
+SCHEDULE_LATENCY = REGISTRY.histogram(
+    "nos_tpu_schedule_latency_seconds", "Per-pod scheduling cycle latency"
+)

@@ -1,0 +1,80 @@
+import json
+
+from nos_tpu.cmd.metricsexporter import collect_metrics, export
+from nos_tpu.cmd.run import configs_from, load_config, seed_node
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import build_pod, build_tpu_node
+
+
+class TestMetricsExporter:
+    def test_collect_from_cluster(self):
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_tpu_node(name="n2", chips=8))
+        m = collect_metrics(store)
+        assert m.node_count == 2
+        assert m.tpu_node_count == 2
+        assert m.total_tpu_chips == 16
+        assert m.partitioning_modes == ["tpu"]
+
+    def test_export_writes_json(self, tmp_path):
+        store = KubeStore()
+        out = tmp_path / "metrics.json"
+        payload = export(collect_metrics(store), str(out))
+        data = json.loads(out.read_text())
+        assert data == json.loads(payload)
+        assert "version" in data and "domain_metrics" in data
+
+
+class TestRunConfig:
+    def test_load_and_build_configs(self, tmp_path):
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text(
+            """
+partitioner:
+  batchWindowTimeoutSeconds: 5
+  batchWindowIdleSeconds: 1
+scheduler:
+  retrySeconds: 0.2
+agent:
+  reportConfigIntervalSeconds: 2
+nodes:
+  - name: tpu-0
+    chips: 8
+"""
+        )
+        config = load_config(str(cfg_file))
+        partitioner, scheduler, agent = configs_from(config)
+        assert partitioner.batch_window_timeout_seconds == 5
+        assert scheduler.retry_seconds == 0.2
+        assert agent.report_config_interval_seconds == 2
+        node = seed_node(config["nodes"][0])
+        assert node.metadata.name == "tpu-0"
+        assert node.status.capacity["google.com/tpu"] == 8
+
+    def test_empty_config(self):
+        partitioner, scheduler, agent = configs_from({})
+        assert partitioner.batch_window_timeout_seconds == 60.0
+
+
+class TestExporterCli:
+    def test_forwards_snapshot_file(self, tmp_path, capsys):
+        from nos_tpu.cmd.metricsexporter import main
+        snap = tmp_path / "snap.json"
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1", chips=8))
+        export(collect_metrics(store), str(snap))
+        assert main(["--input", str(snap)]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["total_tpu_chips"] == 8
+
+    def test_missing_snapshot_errors(self, tmp_path):
+        from nos_tpu.cmd.metricsexporter import main
+        assert main(["--input", str(tmp_path / "nope.json")]) == 1
+
+    def test_empty_yaml_sections_use_defaults(self, tmp_path):
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text("partitioner:\nscheduler:\nagent:\n")
+        partitioner, scheduler, agent = configs_from(load_config(str(cfg)))
+        assert partitioner.batch_window_timeout_seconds == 60.0

@@ -1,0 +1,69 @@
+import urllib.request
+
+from nos_tpu.util.health import HealthServer
+from nos_tpu.util.metrics import MetricsRegistry
+
+
+class TestRegistry:
+    def test_counter_and_gauge(self):
+        r = MetricsRegistry()
+        c = r.counter("test_total", "help me")
+        c.inc()
+        c.inc(2)
+        g = r.gauge("test_gauge")
+        g.set(7)
+        text = r.render()
+        assert "test_total 3.0" in text
+        assert "test_gauge 7.0" in text
+        assert "# TYPE test_total counter" in text
+
+    def test_same_name_returns_same_metric(self):
+        r = MetricsRegistry()
+        assert r.counter("x") is r.counter("x")
+
+    def test_histogram_buckets_and_percentile(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.7, 5.0):
+            h.observe(v)
+        assert h.count == 4
+        assert h.percentile(50) == 0.7
+        text = r.render()
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1.0"} 3' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+
+    def test_snapshot(self):
+        r = MetricsRegistry()
+        r.counter("a").inc(4)
+        h = r.histogram("b")
+        h.observe(1.0)
+        snap = r.snapshot()
+        assert snap["a"] == 4
+        assert snap["b_count"] == 1
+        assert snap["b_p50"] == 1.0
+
+
+class TestHealthServer:
+    def test_endpoints(self):
+        ready = {"ok": False}
+        server = HealthServer(port=0, ready_check=lambda: ready["ok"])
+        port = server.start()
+        try:
+            def get(path):
+                try:
+                    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+                        return resp.status, resp.read().decode()
+                except urllib.error.HTTPError as e:
+                    return e.code, ""
+
+            assert get("/healthz")[0] == 200
+            assert get("/readyz")[0] == 503
+            ready["ok"] = True
+            assert get("/readyz")[0] == 200
+            status, body = get("/metrics")
+            assert status == 200
+            assert "nos_tpu" in body
+            assert get("/nope")[0] == 404
+        finally:
+            server.stop()
