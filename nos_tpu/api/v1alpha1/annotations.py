@@ -25,9 +25,13 @@ PREFIX = "nos.nebuly.com/"
 SPEC_PARTITIONING_PLAN = PREFIX + "spec-partitioning-plan"
 STATUS_PARTITIONING_PLAN = PREFIX + "status-partitioning-plan"
 
-_SPEC_RE = re.compile(r"^nos\.nebuly\.com/spec-tpu-(\d+)-(\d+x\d+(?:x\d+)?)$")
+# Profiles are either slice topologies ("2x2", "2x2x1" — tpu mode) or
+# HBM fractions ("8gb" — sharing mode); both ride the same protocol the
+# way MIG ("1g.10gb") and MPS ("10gb") profiles share the reference's.
+_PROFILE = r"(\d+x\d+(?:x\d+)?|\d+gb)"
+_SPEC_RE = re.compile(r"^nos\.nebuly\.com/spec-tpu-(\d+)-" + _PROFILE + r"$")
 _STATUS_RE = re.compile(
-    r"^nos\.nebuly\.com/status-tpu-(\d+)-(\d+x\d+(?:x\d+)?)-(free|used)$"
+    r"^nos\.nebuly\.com/status-tpu-(\d+)-" + _PROFILE + r"-(free|used)$"
 )
 
 STATUS_FREE = "free"

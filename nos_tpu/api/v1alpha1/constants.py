@@ -14,10 +14,28 @@ RESOURCE_TPU = "google.com/tpu"
 RESOURCE_TPU_SLICE_PREFIX = "google.com/tpu-slice-"
 RESOURCE_TPU_SLICE_REGEX = re.compile(r"^google\.com/tpu-slice-(\d+x\d+(?:x\d+)?)$")
 
+# Shared (time-multiplexed) TPU resources exposed by the sharing mode:
+# HBM-denominated fractions of one chip, e.g. google.com/tpu-mem-8gb is a
+# half of a 16GB v5e chip. Analogue of nvidia.com/gpu-<N>gb (MPS slicing,
+# reference pkg/gpu/slicing/profile.go resourceRegexp).
+RESOURCE_TPU_SHARED_PREFIX = "google.com/tpu-mem-"
+RESOURCE_TPU_SHARED_REGEX = re.compile(r"^google\.com/tpu-mem-(\d+)gb$")
+
+# Smallest shareable HBM slice (reference slicing constant MinSliceMemoryGB,
+# pkg/gpu/slicing/constant.go:23).
+MIN_SHARED_SLICE_GB = 1
+
 # Aggregate custom resource used by ElasticQuota so quotas can be expressed
 # in chips regardless of which sliced resource a pod requests. Analogue of
 # nos.nebuly.com/gpu-memory (reference v1alpha1/constants.go:25-27).
 RESOURCE_TPU_CHIPS = "nos.nebuly.com/tpu-chips"
+
+# HBM-denominated aggregate (the direct nos.nebuly.com/gpu-memory analogue):
+# shared fractions count their own GB; whole chips and topology slices count
+# DEFAULT_TPU_CHIP_MEMORY_GB per chip (the reference defaults plain GPUs to
+# NvidiaGpuResourceMemoryGB=16, pkg/constant/constants.go:91-96).
+RESOURCE_TPU_MEMORY = "nos.nebuly.com/tpu-memory"
+DEFAULT_TPU_CHIP_MEMORY_GB = 16
 
 # Reference-parity NVIDIA names (kept so MIG/MPS parity modes and the
 # resource calculator can recognize them; reference pkg/constant/constants.go).
@@ -51,3 +69,29 @@ def tpu_slice_topology(resource_name: str) -> str:
 
 def tpu_slice_resource(topology: str) -> str:
     return RESOURCE_TPU_SLICE_PREFIX + topology
+
+
+def is_tpu_shared_resource(name: str) -> bool:
+    return RESOURCE_TPU_SHARED_REGEX.match(name) is not None
+
+
+def tpu_shared_profile(resource_name: str) -> str:
+    """'google.com/tpu-mem-8gb' -> '8gb'; raises ValueError otherwise."""
+    m = RESOURCE_TPU_SHARED_REGEX.match(resource_name)
+    if m is None:
+        raise ValueError(f"{resource_name!r} is not a shared TPU resource")
+    return m.group(1) + "gb"
+
+
+def tpu_shared_resource(profile: str) -> str:
+    """'8gb' (or 8) -> 'google.com/tpu-mem-8gb'."""
+    if isinstance(profile, int):
+        return f"{RESOURCE_TPU_SHARED_PREFIX}{profile}gb"
+    return RESOURCE_TPU_SHARED_PREFIX + profile
+
+
+def shared_profile_gb(profile: str) -> int:
+    """'8gb' -> 8; raises ValueError otherwise."""
+    if not profile.endswith("gb"):
+        raise ValueError(f"{profile!r} is not a shared TPU profile")
+    return int(profile[:-2])

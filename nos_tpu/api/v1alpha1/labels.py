@@ -27,10 +27,13 @@ TPU_DEVICE_PLUGIN_CONFIG_LABEL = "google.com/tpu-device-plugin.config"
 
 class PartitioningKind:
     TPU = "tpu"
+    # HBM-fraction chip sharing actuated through the device plugin
+    # (the MPS analogue: reference internal/partitioning/mps/).
+    SHARING = "sharing"
     MIG = "mig"
     MPS = "mps"
 
-    ALL = (TPU, MIG, MPS)
+    ALL = (TPU, SHARING, MIG, MPS)
 
 
 def partitioning_kind(node) -> str:

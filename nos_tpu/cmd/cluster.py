@@ -20,6 +20,7 @@ from nos_tpu.api.v1alpha1.labels import PARTITIONING_LABEL, PartitioningKind
 from nos_tpu.cmd.operator import build_operator
 from nos_tpu.cmd.partitioner import build_partitioner
 from nos_tpu.cmd.scheduler import build_scheduler
+from nos_tpu.cmd.sharingagent import build_sharingagent
 from nos_tpu.cmd.tpuagent import build_tpuagent
 from nos_tpu.controllers.partitioner import PartitionerController
 from nos_tpu.device import (
@@ -46,6 +47,7 @@ class SimCluster:
     scheduler: Scheduler
     device_backend: str = "sim"  # "sim" | "tpuctl" (native C++ slice state)
     tpuctl_dir: str = ""
+    device_plugin_config_map: str = "nos-device-plugin-config"
     _agent_nodes: List[str] = field(default_factory=list)
     _tpuctl_client: object = None
 
@@ -78,6 +80,24 @@ class SimCluster:
             agent_config or TpuAgentConfig(report_config_interval_seconds=0.5),
         )
         self._agent_nodes.append(node_name)
+
+    def add_sharing_node(self, node: Node, agent_config: Optional[TpuAgentConfig] = None) -> None:
+        """Create a sharing-mode node and start its reporter-only agent
+        (the gpuagent analogue); actuation rides the device-plugin
+        ConfigMap, so no actuator is started."""
+        self.store.create(node)
+        name = node.metadata.name
+        if name in self._agent_nodes:
+            return
+        from nos_tpu.device.sharing import SharedSliceClient
+
+        build_sharingagent(
+            self.manager,
+            name,
+            SharedSliceClient(self.store, self.device_plugin_config_map),
+            agent_config or TpuAgentConfig(report_config_interval_seconds=0.5),
+        )
+        self._agent_nodes.append(name)
 
     def _tpuctl(self, node_name: str):
         from nos_tpu.api.v1alpha1 import constants
@@ -114,13 +134,10 @@ def build_cluster(
     store = store or KubeStore()
     manager = Manager(store=store)
     build_operator(manager, operator_config)
-    partitioner = build_partitioner(
-        manager,
-        partitioner_config
-        or GpuPartitionerConfig(
-            batch_window_timeout_seconds=1.0, batch_window_idle_seconds=0.05
-        ),
+    partitioner_config = partitioner_config or GpuPartitionerConfig(
+        batch_window_timeout_seconds=1.0, batch_window_idle_seconds=0.05
     )
+    partitioner = build_partitioner(manager, partitioner_config)
     scheduler = build_scheduler(manager, scheduler_config)
     kubelet = SimKubelet(store)
     manager.add(
@@ -138,6 +155,39 @@ def build_cluster(
             ],
         )
     )
+    # Sharing-mode device plugin: re-advertises allocatable when the
+    # SharingPartitioner flips a node's config label (the sim stand-in for
+    # the real TPU device plugin re-registering).
+    from nos_tpu.api.v1alpha1.labels import TPU_DEVICE_PLUGIN_CONFIG_LABEL
+    from nos_tpu.device.sharing import SimSharedDevicePlugin
+    from nos_tpu.kube.controller import Request
+
+    shared_plugin = SimSharedDevicePlugin(
+        store, config_map_name=partitioner_config.device_plugin_config_map
+    )
+
+    def configmap_to_labeled_nodes(event):
+        return [
+            Request(name=n.metadata.name)
+            for n in store.list("Node")
+            if TPU_DEVICE_PLUGIN_CONFIG_LABEL in n.metadata.labels
+        ]
+
+    manager.add(
+        Controller(
+            "sim-shared-device-plugin",
+            store,
+            shared_plugin.reconcile,
+            [
+                Watch(
+                    kind="Node",
+                    predicate=lambda e: e.type != "DELETED"
+                    and TPU_DEVICE_PLUGIN_CONFIG_LABEL in e.object.metadata.labels,
+                ),
+                Watch(kind="ConfigMap", mapper=configmap_to_labeled_nodes),
+            ],
+        )
+    )
     return SimCluster(
         manager=manager,
         store=store,
@@ -146,4 +196,5 @@ def build_cluster(
         scheduler=scheduler,
         device_backend=device_backend,
         tpuctl_dir=tpuctl_dir,
+        device_plugin_config_map=partitioner_config.device_plugin_config_map,
     )

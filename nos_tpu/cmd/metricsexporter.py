@@ -56,18 +56,23 @@ def collect_metrics(store: KubeStore) -> InstallationMetrics:
     return m
 
 
+def _post(payload: str, endpoint: str) -> None:
+    import urllib.request
+
+    request = urllib.request.Request(
+        endpoint, data=payload.encode(), headers={"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(request, timeout=10):  # opt-in only
+        pass
+
+
 def export(metrics: InstallationMetrics, output_path: str = "", endpoint: str = "") -> str:
     payload = json.dumps(asdict(metrics), indent=2)
     if output_path:
         with open(output_path, "w") as f:
             f.write(payload + "\n")
     if endpoint:
-        import urllib.request
-
-        request = urllib.request.Request(
-            endpoint, data=payload.encode(), headers={"Content-Type": "application/json"}
-        )
-        urllib.request.urlopen(request, timeout=10)  # opt-in only
+        _post(payload, endpoint)
     return payload
 
 
@@ -97,15 +102,7 @@ def main(argv=None) -> int:
         return 1
     json.loads(payload)  # validate before forwarding
     if args.endpoint:
-        import urllib.request
-
-        request = urllib.request.Request(
-            args.endpoint,
-            data=payload.encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(request, timeout=10):
-            pass
+        _post(payload, args.endpoint)
     print(payload)
     return 0
 

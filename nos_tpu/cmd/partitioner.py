@@ -17,6 +17,7 @@ from nos_tpu.controllers.partitioner import (
 from nos_tpu.kube.controller import Controller, Manager, Watch
 from nos_tpu.kube.objects import PodPhase
 from nos_tpu.partitioning.core import Actuator, ClusterState, Planner
+from nos_tpu.partitioning.sharing import SharingPartitioner, SharingSnapshotTaker
 from nos_tpu.partitioning.tpu import (
     TpuNodeInitializer,
     TpuPartitioner,
@@ -102,4 +103,44 @@ def build_partitioner(
         )
     )
     manager.add_runnable(controller.start, controller.stop)
+
+    # Second mode, second actuation style (reference registers both the MIG
+    # and MPS controllers, gpupartitioner.go:214-250): sharing-mode nodes
+    # are actuated through the device plugin ConfigMap, not an agent.
+    from nos_tpu.partitioning.core.codec import SharedSliceCodec
+
+    sharing_partitioner = SharingPartitioner(
+        store,
+        config_map_name=config.device_plugin_config_map,
+        device_plugin_delay_seconds=config.device_plugin_delay_seconds,
+    )
+    sharing_codec = SharedSliceCodec()
+    sharing_controller = PartitionerController(
+        store=store,
+        cluster_state=cluster_state,
+        snapshot_taker=SharingSnapshotTaker(),
+        planner=Planner(sim_framework),
+        actuator=Actuator(sharing_partitioner),
+        kind="sharing",
+        batch_timeout_seconds=config.batch_window_timeout_seconds,
+        batch_idle_seconds=config.batch_window_idle_seconds,
+        plan_id_fn=plan_id_fn,
+        tracked_resource_fn=sharing_codec.is_tracked,
+    )
+    manager.add(
+        Controller(
+            "partitioner-sharing",
+            store,
+            sharing_controller.reconcile,
+            [
+                Watch(
+                    kind="Pod",
+                    predicate=lambda e: e.type != "DELETED"
+                    and e.object.status.phase == PodPhase.PENDING,
+                )
+            ],
+        )
+    )
+    manager.add_runnable(sharing_controller.start, sharing_controller.stop)
+    controller.sharing = sharing_controller
     return controller

@@ -94,7 +94,11 @@ def main(argv=None) -> int:
         tpuctl_dir=config.get("tpuctlDir", "/tmp/nos-tpu"),
     )
     for spec in config.get("nodes", []):
-        cluster.add_tpu_node(seed_node(spec), agent_cfg)
+        node = seed_node(spec)
+        if spec.get("partitioning", "tpu") == "sharing":
+            cluster.add_sharing_node(node, agent_cfg)
+        else:
+            cluster.add_tpu_node(node, agent_cfg)
 
     port = args.health_port
     if port is None:

@@ -1,0 +1,55 @@
+"""sharingagent: node-local reporter daemon for sharing-mode nodes.
+
+The gpuagent analogue (reference cmd/gpuagent/gpuagent.go:54-152):
+reporter only — actuation happens through the device plugin ConfigMap.
+Requires the node name (NODE_NAME env in a real daemonset).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from nos_tpu.api.config import TpuAgentConfig
+from nos_tpu.controllers.sharingagent import SharingReporter
+from nos_tpu.device.sharing import SharedSliceClient
+from nos_tpu.kube.controller import Controller, Manager, Request, Watch
+from nos_tpu.util.predicates import matching_name
+
+
+def build_sharingagent(
+    manager: Manager,
+    node_name: str,
+    client: SharedSliceClient,
+    config: Optional[TpuAgentConfig] = None,
+) -> SharingReporter:
+    config = config or TpuAgentConfig()
+    config.validate()
+    reporter = SharingReporter(
+        manager.store,
+        client,
+        node_name,
+        report_interval_seconds=config.report_config_interval_seconds,
+    )
+
+    def pod_on_node_mapper(event):
+        # Usage changes come from pods binding/terminating on this node.
+        if event.object.spec.node_name == node_name:
+            return [Request(name=node_name)]
+        return []
+
+    def configmap_mapper(event):
+        # A new plugin config means new exposed resources: re-report.
+        return [Request(name=node_name)]
+
+    manager.add(
+        Controller(
+            f"sharingagent-reporter-{node_name}",
+            manager.store,
+            reporter.reconcile,
+            [
+                Watch(kind="Node", predicate=matching_name(node_name)),
+                Watch(kind="Pod", mapper=pod_on_node_mapper),
+                Watch(kind="ConfigMap", mapper=configmap_mapper),
+            ],
+        )
+    )
+    return reporter

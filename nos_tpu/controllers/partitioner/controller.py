@@ -46,6 +46,7 @@ class PartitionerController:
         batch_timeout_seconds: float = 60.0,
         batch_idle_seconds: float = 10.0,
         plan_id_fn=lambda: str(int(time.time() * 1000)),
+        tracked_resource_fn=None,
     ) -> None:
         self.store = store
         self.cluster_state = cluster_state
@@ -58,6 +59,11 @@ class PartitionerController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.plans_applied = 0  # domain metric (gap noted in SURVEY.md §5)
+        from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+
+        # Which extended resources this mode's planning can serve (per-mode
+        # SliceFilter analogue); defaults to the tpu mode's slice resources.
+        self.tracked_resource_fn = tracked_resource_fn or ClusterSnapshot.is_tracked_resource
 
     # ----------------------------------------------------- pod reconcile
 
@@ -78,13 +84,11 @@ class PartitionerController:
         self.batcher.add(pod.namespaced_name)
         return None
 
-    @staticmethod
-    def _requests_tracked_resources(pod: Pod) -> bool:
-        from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+    def _requests_tracked_resources(self, pod: Pod) -> bool:
         from nos_tpu.util import resources as res
 
         request = res.compute_pod_request(pod)
-        return any(ClusterSnapshot.is_tracked_resource(name) for name in request)
+        return any(self.tracked_resource_fn(name) for name in request)
 
     # ------------------------------------------------------- plan gate
 

@@ -1,0 +1,3 @@
+from nos_tpu.controllers.sharingagent.reporter import SharingReporter
+
+__all__ = ["SharingReporter"]

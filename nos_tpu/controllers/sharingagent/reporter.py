@@ -1,0 +1,72 @@
+"""SharingReporter: shared-slice state → status annotations (reporter only).
+
+The gpuagent analogue (reference internal/controllers/gpuagent/
+reporter.go:50-110): sharing nodes have no local actuator — the device
+plugin actuates via its ConfigMap — so the node agent only mirrors actual
+device state into ``status-tpu-<chip>-<profile>-<free|used>`` annotations
+for the planner's SharingNode model. Like the reference agent refusing to
+run on MIG nodes (cmd/gpuagent/gpuagent.go:106-114), it skips nodes
+labeled for the tpu (agent-actuated) mode.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1.labels import PARTITIONING_LABEL, PartitioningKind
+from nos_tpu.device.sharing import SharedSliceClient
+from nos_tpu.device.types import group_geometries
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.store import KubeStore, NotFoundError
+
+log = logging.getLogger("nos_tpu.sharingagent")
+
+
+class SharingReporter:
+    def __init__(
+        self,
+        store: KubeStore,
+        client: SharedSliceClient,
+        node_name: str,
+        report_interval_seconds: float = 10.0,
+    ) -> None:
+        self.store = store
+        self.client = client
+        self.node_name = node_name
+        self.interval = report_interval_seconds
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        if req.name != self.node_name:
+            return None
+        try:
+            node = self.store.get("Node", self.node_name)
+        except NotFoundError:
+            return None
+        if (
+            node.metadata.labels.get(PARTITIONING_LABEL, "")
+            == PartitioningKind.TPU
+        ):
+            log.warning(
+                "sharingagent on %s: node is labeled for agent-actuated "
+                "partitioning, refusing to report",
+                self.node_name,
+            )
+            return Result(requeue_after=self.interval)
+
+        grouped = group_geometries(self.client.get_devices(self.node_name))
+        desired_status = annot.status_from_devices(
+            free=grouped["free"], used=grouped["used"]
+        )
+        current_status = {
+            k: v
+            for k, v in node.metadata.annotations.items()
+            if k.startswith(annot.PREFIX + "status-")
+            and k != annot.STATUS_PARTITIONING_PLAN
+        }
+        if current_status != desired_status:
+            patch = {k: None for k in current_status}
+            patch.update(desired_status)
+            self.store.patch_annotations("Node", self.node_name, "", patch)
+            log.info("sharingagent: %s status updated", self.node_name)
+        return Result(requeue_after=self.interval)

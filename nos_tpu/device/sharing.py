@@ -1,0 +1,174 @@
+"""Sharing-mode device plugin simulation + shared-slice client.
+
+Plays the part the nebuly-fork NVIDIA device plugin plays for MPS in the
+reference: it watches the config-selection label the SharingPartitioner
+flips (``google.com/tpu-device-plugin.config``), loads the referenced
+entry from the plugin ConfigMap, and re-advertises the node's allocatable
+as ``google.com/tpu-mem-<N>gb`` replica resources. SharedSliceClient is
+the sharingagent's read path (the slicing.Client analogue,
+pkg/gpu/slicing/client.go): it derives per-chip free/used shared slices
+from the active plugin config plus the pods bound to the node.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.labels import TPU_DEVICE_PLUGIN_CONFIG_LABEL
+from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.util import resources as res
+
+log = logging.getLogger("nos_tpu.device.sharing")
+
+
+def load_plugin_config(
+    store: KubeStore,
+    node_name: str,
+    config_map_name: str,
+    config_map_namespace: str = "",
+) -> Optional[dict]:
+    """The active sharing config for a node: label → ConfigMap key → JSON.
+    None when the node has no config label, the key is gone (superseded
+    plan), or the payload does not parse."""
+    node = store.try_get("Node", node_name)
+    if node is None:
+        return None
+    key = node.metadata.labels.get(TPU_DEVICE_PLUGIN_CONFIG_LABEL, "")
+    if not key:
+        return None
+    cm = store.try_get("ConfigMap", config_map_name, config_map_namespace)
+    if cm is None or key not in cm.data:
+        return None
+    try:
+        return json.loads(cm.data[key])
+    except json.JSONDecodeError:
+        log.warning("plugin config %s for node %s is not valid JSON", key, node_name)
+        return None
+
+
+def _config_entries(config: Optional[dict]) -> List[dict]:
+    if not config:
+        return []
+    return list(config.get("sharing", {}).get("resources", []))
+
+
+class SimSharedDevicePlugin:
+    """Reconciles a node's allocatable against its active sharing config —
+    what the real TPU device plugin does when its config label flips."""
+
+    def __init__(
+        self,
+        store: KubeStore,
+        config_map_name: str = "nos-device-plugin-config",
+        config_map_namespace: str = "",
+    ) -> None:
+        self.store = store
+        self.config_map_name = config_map_name
+        self.config_map_namespace = config_map_namespace
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        node = self.store.try_get("Node", req.name)
+        if node is None:
+            return None
+        config = load_plugin_config(
+            self.store, req.name, self.config_map_name, self.config_map_namespace
+        )
+        if config is None:
+            # No loadable config (label missing, or it points at a key that
+            # is gone mid-rollover): keep serving the last advertised state,
+            # exactly like a real device plugin that cannot reload. Wiping
+            # here would momentarily re-expose carved chips as plain.
+            return None
+        entries = _config_entries(config)
+
+        shared: Dict[str, int] = {}
+        covered_chips = set()
+        for entry in entries:
+            rename = entry.get("rename", "")
+            if not constants.is_tpu_shared_resource(rename):
+                continue
+            shared[rename] = shared.get(rename, 0) + int(entry.get("replicas", 0))
+            covered_chips.update(entry.get("chips", []))
+
+        def mutate(n):
+            target = n.status.allocatable
+            total_chips = int(n.status.capacity.get(constants.RESOURCE_TPU, 0))
+            for key in [k for k in target if constants.is_tpu_shared_resource(k)]:
+                del target[key]
+            target.update(shared)
+            # Chips folded into shared fractions stop being plain-requestable.
+            target[constants.RESOURCE_TPU] = max(0, total_chips - len(covered_chips))
+
+        try:
+            self.store.patch_merge("Node", req.name, "", mutate)
+        except NotFoundError:
+            return None
+        return None
+
+
+class SharedSliceClient:
+    """Per-chip shared-slice inventory for the sharingagent reporter.
+
+    Used counts come from the pods bound to the node (the sim stand-in for
+    kubelet pod-resources allocation records), assigned to config entries
+    deterministically (chip order)."""
+
+    def __init__(
+        self,
+        store: KubeStore,
+        config_map_name: str = "nos-device-plugin-config",
+        config_map_namespace: str = "",
+    ) -> None:
+        self.store = store
+        self.config_map_name = config_map_name
+        self.config_map_namespace = config_map_namespace
+
+    def get_devices(self, node_name: str) -> List[TpuSliceDevice]:
+        config = load_plugin_config(
+            self.store, node_name, self.config_map_name, self.config_map_namespace
+        )
+        entries = _config_entries(config)
+        if not entries:
+            return []
+
+        demand: Dict[str, int] = {}
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name != node_name:
+                continue
+            if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
+                continue
+            for name, qty in res.compute_pod_request(pod).items():
+                if constants.is_tpu_shared_resource(name):
+                    demand[name] = demand.get(name, 0) + int(qty)
+
+        devices: List[TpuSliceDevice] = []
+        ordered = sorted(
+            entries, key=lambda e: (min(e.get("chips", [0]) or [0]), e.get("rename", ""))
+        )
+        serial = 0
+        for entry in ordered:
+            rename = entry.get("rename", "")
+            if not constants.is_tpu_shared_resource(rename):
+                continue
+            profile = constants.tpu_shared_profile(rename)
+            chips = entry.get("chips", [0]) or [0]
+            for _ in range(int(entry.get("replicas", 0))):
+                serial += 1
+                status = DeviceStatus.FREE
+                if demand.get(rename, 0) > 0:
+                    demand[rename] -= 1
+                    status = DeviceStatus.USED
+                devices.append(
+                    TpuSliceDevice(
+                        device_id=f"tpushare-{node_name}-{chips[0]}-{profile}-{serial}",
+                        board_index=int(chips[0]),
+                        profile=profile,
+                        status=status,
+                    )
+                )
+        return devices

@@ -1,0 +1,105 @@
+"""Per-mode slice resource codecs.
+
+The engine core (snapshot/tracker/planner) is mode-agnostic in the
+reference; what varies per mode is how profiles map to extended resource
+names and how plain-chip requests normalize (the role SliceCalculator /
+SliceFilter play in reference internal/partitioning/{mig,mps}/). A codec
+bundles that mapping so ClusterSnapshot can serve both the tpu mode
+(topology slices) and the sharing mode (HBM fractions).
+"""
+from __future__ import annotations
+
+from typing import List, Protocol
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import ResourceList
+from nos_tpu.tpu.known import profile_for_chips
+from nos_tpu.util import resources as res
+
+
+class SliceCodec(Protocol):
+    def is_tracked(self, name: str) -> bool: ...
+
+    def resource(self, profile: str) -> str: ...
+
+    def normalize_request(
+        self, request: ResourceList, accelerator: str
+    ) -> ResourceList: ...
+
+    def take_from_pool(
+        self, pool: ResourceList, request: ResourceList, accelerators: List[str]
+    ) -> ResourceList: ...
+
+
+class TpuSliceCodec:
+    """Topology-slice resources (google.com/tpu-slice-<topo>); plain
+    google.com/tpu chip requests normalize to each generation's smallest
+    covering profile."""
+
+    def is_tracked(self, name: str) -> bool:
+        return constants.is_tpu_slice_resource(name) or name == constants.RESOURCE_TPU
+
+    def resource(self, profile: str) -> str:
+        return constants.tpu_slice_resource(profile)
+
+    def normalize_request(self, request: ResourceList, accelerator: str) -> ResourceList:
+        if accelerator:
+            return res.normalize_tpu_request(request, accelerator)
+        return dict(request)
+
+    def take_from_pool(
+        self, pool: ResourceList, request: ResourceList, accelerators: List[str]
+    ) -> ResourceList:
+        """Serve `request`'s tracked resources from `pool` (mutating it);
+        returns what remains lacking. Plain-chip requests are served by any
+        accelerator whose matching profile still has free slices."""
+        lacking: ResourceList = {}
+        for name, qty in request.items():
+            if constants.is_tpu_slice_resource(name):
+                take = min(qty, pool.get(name, 0))
+                pool[name] = pool.get(name, 0) - take
+                if qty - take > 0:
+                    lacking[name] = qty - take
+        plain = int(request.get(constants.RESOURCE_TPU, 0))
+        if plain > 0:
+            served = False
+            for accelerator in accelerators:
+                profile = profile_for_chips(plain, accelerator)
+                if profile is None:
+                    continue
+                name = constants.tpu_slice_resource(profile)
+                if pool.get(name, 0) >= 1:
+                    pool[name] -= 1
+                    served = True
+                    break
+            if not served:
+                lacking[constants.RESOURCE_TPU] = plain
+        return lacking
+
+
+class SharedSliceCodec:
+    """HBM-fraction resources (google.com/tpu-mem-<N>gb). Plain-chip
+    requests are not the sharing mode's to serve (mirroring MPS, which
+    only tracks nvidia.com/gpu-<N>gb), so they never normalize and never
+    count as lacking here."""
+
+    def is_tracked(self, name: str) -> bool:
+        return constants.is_tpu_shared_resource(name)
+
+    def resource(self, profile: str) -> str:
+        return constants.tpu_shared_resource(profile)
+
+    def normalize_request(self, request: ResourceList, accelerator: str) -> ResourceList:
+        return dict(request)
+
+    def take_from_pool(
+        self, pool: ResourceList, request: ResourceList, accelerators: List[str]
+    ) -> ResourceList:
+        lacking: ResourceList = {}
+        for name, qty in request.items():
+            if constants.is_tpu_shared_resource(name):
+                take = min(qty, pool.get(name, 0))
+                pool[name] = pool.get(name, 0) - take
+                if qty - take > 0:
+                    lacking[name] = qty - take
+        return lacking

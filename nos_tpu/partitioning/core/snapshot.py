@@ -13,13 +13,13 @@ from typing import Dict, List, Optional
 
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.objects import Pod, ResourceList
+from nos_tpu.partitioning.core.codec import SliceCodec, TpuSliceCodec
 from nos_tpu.partitioning.core.partition_state import (
     BoardPartitioning,
     NodePartitioning,
     PartitioningState,
 )
 from nos_tpu.scheduler.framework import NodeInfo
-from nos_tpu.tpu.known import profile_for_chips
 from nos_tpu.util import resources as res
 
 
@@ -47,8 +47,11 @@ class SnapshotNode:
 
 
 class ClusterSnapshot:
-    def __init__(self, nodes: Dict[str, SnapshotNode]) -> None:
+    def __init__(
+        self, nodes: Dict[str, SnapshotNode], codec: Optional[SliceCodec] = None
+    ) -> None:
         self._nodes = nodes
+        self.codec: SliceCodec = codec or TpuSliceCodec()
         self._backup: Optional[Dict[str, SnapshotNode]] = None
 
     # ------------------------------------------------------ fork/commit
@@ -98,14 +101,18 @@ class ClusterSnapshot:
         total: ResourceList = {}
         for node in self._nodes.values():
             for profile, qty in node.partitionable.free_slices().items():
-                name = constants.tpu_slice_resource(profile)
+                name = self.codec.resource(profile)
                 total[name] = total.get(name, 0) + qty
         return total
 
     @staticmethod
     def is_tracked_resource(name: str) -> bool:
-        """Resources the partitioner is responsible for serving."""
+        """Resources the default (tpu) mode is responsible for serving.
+        Instances answer per their own codec via `tracked`."""
         return constants.is_tpu_slice_resource(name) or name == constants.RESOURCE_TPU
+
+    def tracked(self, name: str) -> bool:
+        return self.codec.is_tracked(name)
 
     def normalize_request(
         self, request: ResourceList, accelerator: Optional[str] = None
@@ -117,36 +124,12 @@ class ClusterSnapshot:
         plain — in a mixed-generation cluster there is no single right
         profile, and picking one deadlocks pods against nodes of the other
         generation."""
-        if accelerator:
-            return res.normalize_tpu_request(request, accelerator)
-        return dict(request)
+        return self.codec.normalize_request(request, accelerator or "")
 
     def take_from_pool(self, pool: ResourceList, request: ResourceList) -> ResourceList:
         """Serve `request`'s tracked resources from `pool` (mutating it);
-        returns what remains lacking. Plain-chip requests are served by any
-        accelerator whose matching profile still has free slices."""
-        lacking: ResourceList = {}
-        for name, qty in request.items():
-            if constants.is_tpu_slice_resource(name):
-                take = min(qty, pool.get(name, 0))
-                pool[name] = pool.get(name, 0) - take
-                if qty - take > 0:
-                    lacking[name] = qty - take
-        plain = int(request.get(constants.RESOURCE_TPU, 0))
-        if plain > 0:
-            served = False
-            for accelerator in self.accelerators():
-                profile = profile_for_chips(plain, accelerator)
-                if profile is None:
-                    continue
-                name = constants.tpu_slice_resource(profile)
-                if pool.get(name, 0) >= 1:
-                    pool[name] -= 1
-                    served = True
-                    break
-            if not served:
-                lacking[constants.RESOURCE_TPU] = plain
-        return lacking
+        returns what remains lacking."""
+        return self.codec.take_from_pool(pool, request, self.accelerators())
 
     def get_lacking_slices(self, pod: Pod) -> ResourceList:
         """Tracked resources the pod needs beyond cluster-wide free slices
@@ -175,7 +158,7 @@ class ClusterSnapshot:
                 BoardPartitioning(
                     board_index=index,
                     resources={
-                        constants.tpu_slice_resource(profile): qty
+                        self.codec.resource(profile): qty
                         for profile, qty in geometry.items()
                     },
                 )

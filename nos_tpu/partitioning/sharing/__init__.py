@@ -1,0 +1,11 @@
+from nos_tpu.partitioning.sharing.partitioner import (
+    SharingPartitioner,
+    plugin_config_from_partitioning,
+)
+from nos_tpu.partitioning.sharing.snapshot_taker import SharingSnapshotTaker
+
+__all__ = [
+    "SharingPartitioner",
+    "SharingSnapshotTaker",
+    "plugin_config_from_partitioning",
+]

@@ -1,0 +1,138 @@
+"""Sharing partitioner: actuation = device-plugin ConfigMap + label flip.
+
+The second actuation style of the reference (MPS,
+internal/partitioning/mps/partitioner.go:61-157): instead of asking a
+node-local agent to re-carve silicon, the control plane renders the desired
+sharing layout into the TPU device plugin's ConfigMap under the key
+``<node>-<planId>``, waits for ConfigMap propagation, then points the node
+at the new config via the ``google.com/tpu-device-plugin.config`` label.
+The device plugin re-registers, exposing the ``google.com/tpu-mem-<N>gb``
+replica resources; the node-local sharingagent only reports.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.labels import TPU_DEVICE_PLUGIN_CONFIG_LABEL
+from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.partitioning.core.partition_state import NodePartitioning
+
+log = logging.getLogger("nos_tpu.partitioning.sharing")
+
+PLUGIN_CONFIG_VERSION = "v1"
+
+
+def plugin_config_from_partitioning(partitioning: NodePartitioning) -> dict:
+    """Render a NodePartitioning as the TPU device plugin's sharing config
+    (the analogue of ToPluginConfig, mps/partitioner.go:123-157): one
+    replicated-resource entry per (chip, profile), each fraction renamed to
+    its HBM-denominated resource and capped at one per container."""
+    resources = []
+    for board in partitioning.boards:
+        for resource, qty in sorted(board.resources.items()):
+            if not constants.is_tpu_shared_resource(resource) or qty <= 0:
+                continue
+            profile = constants.tpu_shared_profile(resource)
+            resources.append(
+                {
+                    "name": constants.RESOURCE_TPU,
+                    "rename": resource,
+                    "memory_gb": constants.shared_profile_gb(profile),
+                    "chips": [board.board_index],
+                    "replicas": int(qty),
+                }
+            )
+    return {
+        "version": PLUGIN_CONFIG_VERSION,
+        "sharing": {
+            "fail_requests_greater_than_one": True,
+            "resources": resources,
+        },
+    }
+
+
+class SharingPartitioner:
+    def __init__(
+        self,
+        store: KubeStore,
+        config_map_name: str = "nos-device-plugin-config",
+        config_map_namespace: str = "",
+        device_plugin_delay_seconds: float = 0.0,
+    ) -> None:
+        self.store = store
+        self.config_map_name = config_map_name
+        self.config_map_namespace = config_map_namespace
+        self.delay = device_plugin_delay_seconds
+
+    def apply_partitioning(
+        self, node_name: str, plan_id: str, partitioning: NodePartitioning
+    ) -> None:
+        key = f"{node_name}-{plan_id}"
+        config = plugin_config_from_partitioning(partitioning)
+        # The node's current label names exactly the key it owns — the only
+        # safe stale-entry identification (prefix matching would also hit
+        # node "a-b" keys while cleaning node "a").
+        superseded: Optional[str] = None
+        node = self.store.try_get("Node", node_name)
+        if node is not None:
+            superseded = node.metadata.labels.get(TPU_DEVICE_PLUGIN_CONFIG_LABEL)
+        self._write_config(key, config, superseded)
+
+        if self.delay > 0:
+            # ConfigMap content propagates to kubelet volumes asynchronously;
+            # flipping the label too early would restart the plugin against
+            # the previous content (mps/partitioner.go:98-100).
+            time.sleep(self.delay)
+
+        try:
+            self.store.patch_labels(
+                "Node", node_name, "", {TPU_DEVICE_PLUGIN_CONFIG_LABEL: key}
+            )
+        except NotFoundError:
+            log.warning("apply_partitioning: node %s vanished", node_name)
+            return
+        log.info(
+            "apply_partitioning: node %s plan %s -> %d shared resources",
+            node_name,
+            plan_id,
+            len(config["sharing"]["resources"]),
+        )
+
+    # ------------------------------------------------------------------
+
+    def _write_config(
+        self, key: str, config: dict, superseded: Optional[str]
+    ) -> None:
+        payload = json.dumps(config, sort_keys=True)
+        existing = self.store.try_get(
+            "ConfigMap", self.config_map_name, self.config_map_namespace
+        )
+        if existing is None:
+            self.store.create(
+                ConfigMap(
+                    metadata=ObjectMeta(
+                        name=self.config_map_name,
+                        namespace=self.config_map_namespace,
+                    ),
+                    data={key: payload},
+                )
+            )
+            return
+
+        def mutate(cm: ConfigMap) -> None:
+            # One live config per node: retire the entry the node's label
+            # currently points at, atomically with adding the new one (the
+            # plugin treats an unresolvable key as keep-last-state, so this
+            # window is benign).
+            if superseded and superseded != key:
+                cm.data.pop(superseded, None)
+            cm.data[key] = payload
+
+        self.store.patch_merge(
+            "ConfigMap", self.config_map_name, self.config_map_namespace, mutate
+        )

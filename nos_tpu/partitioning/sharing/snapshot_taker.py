@@ -1,0 +1,29 @@
+"""Sharing snapshot taker: ClusterState → snapshot of sharing-labeled nodes.
+
+Counterpart of the MPS snapshot taker (reference
+internal/partitioning/mps/snapshot_taker.go): nodes labeled
+``nos.nebuly.com/gpu-partitioning=sharing`` become SharingNodes and the
+snapshot speaks the shared-resource codec.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from nos_tpu.api.v1alpha1.labels import PartitioningKind, partitioning_kind
+from nos_tpu.partitioning.core.codec import SharedSliceCodec
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.core.state import ClusterState
+from nos_tpu.tpu.sharing import SharingNode
+
+
+class SharingSnapshotTaker:
+    def take_snapshot(self, state: ClusterState) -> ClusterSnapshot:
+        nodes: Dict[str, SnapshotNode] = {}
+        for name, info in state.get_nodes().items():
+            if partitioning_kind(info.node) != PartitioningKind.SHARING:
+                continue
+            sharing_node = SharingNode(info.node, owned=True)
+            if not sharing_node.is_sharing_node:
+                continue
+            nodes[name] = SnapshotNode(partitionable=sharing_node, pods=list(info.pods))
+        return ClusterSnapshot(nodes, codec=SharedSliceCodec())

@@ -29,6 +29,10 @@ class AcceleratorSpec:
     name: str  # value of cloud.google.com/gke-tpu-accelerator
     board_topology: str
     slice_shapes: Tuple[str, ...]
+    # Per-chip HBM capacity: the budget the sharing mode carves into
+    # google.com/tpu-mem-<N>gb fractions (the TPU analogue of a GPU's
+    # memory budget in reference pkg/gpu/slicing/gpu.go).
+    hbm_gb: int = 16
 
     @property
     def board_chips(self) -> int:
@@ -43,30 +47,35 @@ KNOWN_ACCELERATORS: Dict[str, AcceleratorSpec] = {
         name="tpu-v5-lite-podslice",
         board_topology="2x4",
         slice_shapes=("1x1", "1x2", "2x2", "2x4"),
+        hbm_gb=16,
     ),
     # v5e single-host device nodes (ct5l): 4 chips, 2x2.
     "tpu-v5-lite-device": AcceleratorSpec(
         name="tpu-v5-lite-device",
         board_topology="2x2",
         slice_shapes=("1x1", "1x2", "2x2"),
+        hbm_gb=16,
     ),
     # v4: 4 chips per host (2x2x1 local cube face).
     "tpu-v4-podslice": AcceleratorSpec(
         name="tpu-v4-podslice",
         board_topology="2x2x1",
         slice_shapes=("1x1x1", "1x2x1", "2x2x1"),
+        hbm_gb=32,
     ),
     # v5p: 4 chips per host.
     "tpu-v5p-slice": AcceleratorSpec(
         name="tpu-v5p-slice",
         board_topology="2x2x1",
         slice_shapes=("1x1x1", "1x2x1", "2x2x1"),
+        hbm_gb=95,
     ),
     # v6e (Trillium): 8 chips per host, 2x4, same slice configs as v5e.
     "tpu-v6e-slice": AcceleratorSpec(
         name="tpu-v6e-slice",
         board_topology="2x4",
         slice_shapes=("1x1", "1x2", "2x2", "2x4"),
+        hbm_gb=32,
     ),
 }
 
@@ -148,3 +157,9 @@ def profile_for_chips(chips: int, accelerator: str) -> Optional[str]:
         if t.chips >= chips:
             return str(t)
     return None
+
+
+def hbm_gb_per_chip(accelerator: str) -> int:
+    """Per-chip HBM budget the sharing mode may carve; 0 when unknown."""
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    return spec.hbm_gb if spec is not None else 0

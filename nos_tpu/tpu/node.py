@@ -53,6 +53,10 @@ class TpuNode:
         free_by_board: Dict[int, Geometry] = {}
         used_by_board: Dict[int, Geometry] = {}
         for s in status:
+            if "x" not in s.profile:
+                # Sharing-mode ("<N>gb") annotation left over from a
+                # relabeled node: not a topology, not ours to model.
+                continue
             if s.board_index >= len(layouts):
                 self.consistent = False
                 continue

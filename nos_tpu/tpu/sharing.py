@@ -1,0 +1,296 @@
+"""Shared-chip domain model: HBM-fraction slicing of TPU chips.
+
+The TPU analogue of the reference's MPS slicing domain
+(pkg/gpu/slicing/gpu.go:27-265, node.go:32-215): instead of carving a chip
+into ICI sub-topologies, the sharing mode time-multiplexes one chip among
+several pods, each holding a ``google.com/tpu-mem-<N>gb`` fraction of the
+chip's HBM. Geometry search is a memory-budget bin problem per chip: first
+create missing slices from spare HBM, then sacrifice free slices to make
+room (reference slicing/gpu.go:162-220), never touching used slices.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import Node, Pod, ResourceList
+from nos_tpu.tpu.geometry import Geometry, geometry_add
+from nos_tpu.tpu.known import hbm_gb_per_chip
+from nos_tpu.util import resources as res
+
+
+def _profile_gb(profile: str) -> int:
+    return constants.shared_profile_gb(profile)
+
+
+class SharedChip:
+    """One TPU chip with an HBM budget carved into shared slices.
+
+    Mirrors reference slicing.GPU: `used`/`free` map profile ("8gb") to
+    slice count; the invariant is Σ(profile_gb · count) ≤ hbm_gb.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        hbm_gb: int,
+        used: Geometry | None = None,
+        free: Geometry | None = None,
+    ) -> None:
+        self.index = index
+        self.hbm_gb = hbm_gb
+        self.used: Geometry = dict(used or {})
+        self.free: Geometry = dict(free or {})
+
+    # ----------------------------------------------------------- queries
+
+    @property
+    def geometry(self) -> Geometry:
+        return geometry_add(self.used, self.free)
+
+    def committed_memory_gb(self) -> int:
+        """HBM held by existing slices, used or free."""
+        return sum(_profile_gb(p) * q for p, q in self.used.items()) + sum(
+            _profile_gb(p) * q for p, q in self.free.items()
+        )
+
+    def spare_memory_gb(self) -> int:
+        return self.hbm_gb - self.committed_memory_gb()
+
+    def has_free_capacity(self) -> bool:
+        return bool(self.free) or self.spare_memory_gb() >= constants.MIN_SHARED_SLICE_GB
+
+    # ---------------------------------------------------------- mutation
+
+    def _create(self, profile: str, quantity: int = 1) -> int:
+        """Create up to `quantity` free slices of `profile` from spare
+        memory; returns how many were created."""
+        gb = _profile_gb(profile)
+        created = 0
+        for _ in range(quantity):
+            if gb < constants.MIN_SHARED_SLICE_GB or gb > self.spare_memory_gb():
+                break
+            self.free[profile] = self.free.get(profile, 0) + 1
+            created += 1
+        return created
+
+    def allocate(self, profile: str) -> bool:
+        """Move one free slice to used (a pod binding to it)."""
+        if self.free.get(profile, 0) <= 0:
+            return False
+        self.free[profile] -= 1
+        if self.free[profile] == 0:
+            del self.free[profile]
+        self.used[profile] = self.used.get(profile, 0) + 1
+        return True
+
+    def update_geometry_for(self, required: Geometry) -> bool:
+        """Re-carve the chip toward `required` (profile → wanted count)
+        without destroying used slices. Shape of reference
+        slicing/gpu.go:162-220 — smaller profiles first, spare memory
+        first, then trade free slices away, restoring what still fits —
+        but the trade never sacrifices free slices a required profile
+        still needs (the reference can destroy slices it just created for
+        an earlier required profile). Returns True when geometry changed."""
+        missing: Dict[str, int] = {}
+        for profile, qty in required.items():
+            diff = qty - self.free.get(profile, 0)
+            if diff > 0:
+                missing[profile] = diff
+        if not missing:
+            return False
+
+        updated = False
+        for profile in sorted(missing, key=_profile_gb):
+            created = self._create(profile, missing[profile])
+            missing[profile] -= created
+            if created:
+                updated = True
+            if missing[profile] <= 0:
+                continue
+            if self._trade_for(profile, missing[profile], required):
+                updated = True
+        return updated
+
+    def _trade_for(self, profile: str, quantity: int, required: Geometry) -> bool:
+        """Sacrifice expendable free slices — profiles nobody requires, or
+        counts beyond a profile's required quota — to make room for
+        `quantity` slices of `profile`; whatever was sacrificed but not
+        consumed is restored afterwards."""
+        gb = _profile_gb(profile)
+        sacrificed: Dict[str, int] = {}
+        created_any = False
+        for _ in range(quantity):
+            while self.spare_memory_gb() < gb:
+                victim = self._pick_expendable(required)
+                if victim is None:
+                    break
+                self.free[victim] -= 1
+                if self.free[victim] == 0:
+                    del self.free[victim]
+                sacrificed[victim] = sacrificed.get(victim, 0) + 1
+            if self._create(profile) != 1:
+                break
+            created_any = True
+        # Put back sacrificed slices that still fit (largest first keeps
+        # restoration deterministic; leftovers simply stay spare).
+        for victim in sorted(sacrificed, key=_profile_gb, reverse=True):
+            self._create(victim, sacrificed[victim])
+        return created_any
+
+    def _pick_expendable(self, required: Geometry) -> "str | None":
+        """A free slice safe to destroy: smallest non-required profile
+        first, then the smallest required profile with free count above
+        its requirement."""
+        candidates = [p for p in self.free if p not in required]
+        if not candidates:
+            candidates = [
+                p for p in self.free if self.free[p] > required.get(p, 0)
+            ]
+        if not candidates:
+            return None
+        return min(candidates, key=_profile_gb)
+
+
+class SharingNode:
+    """PartitionableNode over shared chips — the sharing-mode counterpart
+    of TpuNode (reference slicing.Node, pkg/gpu/slicing/node.go:32-215).
+    Chips play the role boards play in the tpu mode: status annotations are
+    keyed by chip index."""
+
+    def __init__(self, node: Node, owned: bool = False) -> None:
+        self.name = node.metadata.name
+        self.node = node if owned else node.deepcopy()
+        self.accelerator = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+        self.chips: List[SharedChip] = []
+        self.consistent = True
+        self._build_chips(node)
+
+    def _build_chips(self, node: Node) -> None:
+        hbm = hbm_gb_per_chip(self.accelerator)
+        chip_count = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        if hbm <= 0 or chip_count <= 0:
+            return
+        _, status = annot.parse_node_annotations(node.metadata.annotations)
+        free_by_chip: Dict[int, Geometry] = {}
+        used_by_chip: Dict[int, Geometry] = {}
+        for s in status:
+            if not s.profile.endswith("gb"):
+                continue  # tpu-mode annotation on a relabeled node: not ours
+            if s.board_index >= chip_count:
+                self.consistent = False
+                continue
+            target = free_by_chip if s.status == annot.STATUS_FREE else used_by_chip
+            chip = target.setdefault(s.board_index, {})
+            chip[s.profile] = chip.get(s.profile, 0) + s.quantity
+        for i in range(chip_count):
+            self.chips.append(
+                SharedChip(
+                    index=i,
+                    hbm_gb=hbm,
+                    used=used_by_chip.get(i, {}),
+                    free=free_by_chip.get(i, {}),
+                )
+            )
+
+    # ----------------------------------------------------------- queries
+
+    @property
+    def is_sharing_node(self) -> bool:
+        return bool(self.chips)
+
+    def geometry(self) -> Dict[int, Geometry]:
+        return {c.index: c.geometry for c in self.chips}
+
+    def has_free_capacity(self) -> bool:
+        if not self.consistent:
+            return False
+        return any(c.has_free_capacity() for c in self.chips)
+
+    def free_slices(self) -> Geometry:
+        out: Geometry = {}
+        for c in self.chips:
+            out = geometry_add(out, c.free)
+        return out
+
+    def clone(self) -> "SharingNode":
+        return copy.deepcopy(self)
+
+    # ---------------------------------------------------------- mutation
+
+    def update_geometry_for(self, lacking_slices: ResourceList) -> bool:
+        """Chips are visited in order, each serving whatever is still
+        lacking after its predecessors (same walk as TpuNode boards)."""
+        if not self.consistent:
+            return False
+        remaining: Geometry = {}
+        for name, qty in lacking_slices.items():
+            if constants.is_tpu_shared_resource(name):
+                remaining[constants.tpu_shared_profile(name)] = int(qty)
+        if not remaining:
+            return False
+        changed = False
+        for chip in self.chips:
+            if not remaining:
+                break
+            if chip.update_geometry_for(remaining):
+                changed = True
+            for profile in list(remaining):
+                remaining[profile] -= chip.free.get(profile, 0)
+                if remaining[profile] <= 0:
+                    del remaining[profile]
+        return changed
+
+    def add_pod(self, pod: Pod) -> bool:
+        """Consume free shared slices for the pod's tpu-mem requests;
+        returns False (node untouched) when it does not fit."""
+        request = res.compute_pod_request(pod)
+        needed: Geometry = {}
+        for name, qty in request.items():
+            if constants.is_tpu_shared_resource(name):
+                needed[constants.tpu_shared_profile(name)] = int(qty)
+        if not needed:
+            return True
+        plan: List[tuple] = []
+        free = {c.index: dict(c.free) for c in self.chips}
+        for profile, qty in needed.items():
+            for _ in range(qty):
+                placed = False
+                for c in self.chips:
+                    if free[c.index].get(profile, 0) > 0:
+                        free[c.index][profile] -= 1
+                        plan.append((c, profile))
+                        placed = True
+                        break
+                if not placed:
+                    return False
+        for chip, profile in plan:
+            chip.allocate(profile)
+        return True
+
+    # ------------------------------------------------------- projections
+
+    def scalar_resources(self) -> ResourceList:
+        out: ResourceList = {}
+        for c in self.chips:
+            for profile, qty in c.geometry.items():
+                name = constants.tpu_shared_resource(profile)
+                out[name] = out.get(name, 0) + qty
+        return out
+
+    def to_sim_node(self) -> Node:
+        """Node view for scheduler simulation: shared slices advertised,
+        chips carrying any slice no longer plain-requestable."""
+        node = self.node.deepcopy()
+        alloc = {
+            k: v
+            for k, v in node.status.allocatable.items()
+            if not constants.is_tpu_shared_resource(k) and k != constants.RESOURCE_TPU
+        }
+        plain_chips = sum(1 for c in self.chips if not c.geometry)
+        merged = res.sum_resources(alloc, self.scalar_resources())
+        merged[constants.RESOURCE_TPU] = plain_chips
+        node.status.allocatable = merged
+        return node

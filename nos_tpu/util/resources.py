@@ -67,13 +67,31 @@ def tpu_chips_in(request: ResourceList) -> int:
     return chips
 
 
+def tpu_memory_gb_in(
+    request: ResourceList, chip_memory_gb: int = constants.DEFAULT_TPU_CHIP_MEMORY_GB
+) -> int:
+    """Total TPU HBM GB a request amounts to: shared fractions count their
+    own size, whole chips and topology slices count `chip_memory_gb` each
+    (the gpu-memory aggregate math of reference pkg/gpu/util/resource.go:60-86)."""
+    gb = tpu_chips_in(request) * chip_memory_gb
+    for name, qty in request.items():
+        if constants.is_tpu_shared_resource(name):
+            profile = constants.tpu_shared_profile(name)
+            gb += constants.shared_profile_gb(profile) * int(qty)
+    return gb
+
+
 def with_aggregate_tpu_chips(request: ResourceList) -> ResourceList:
-    """Inject nos.nebuly.com/tpu-chips so quota checks see one chip unit."""
-    chips = tpu_chips_in(request)
-    if chips == 0:
-        return dict(request)
+    """Inject the aggregate quota resources: nos.nebuly.com/tpu-chips (chip
+    units) and nos.nebuly.com/tpu-memory (HBM GB), so ElasticQuotas can be
+    expressed in either regardless of which extended resource pods ask for."""
     out = dict(request)
-    out[constants.RESOURCE_TPU_CHIPS] = chips
+    chips = tpu_chips_in(request)
+    if chips > 0:
+        out[constants.RESOURCE_TPU_CHIPS] = chips
+    memory = tpu_memory_gb_in(request)
+    if memory > 0:
+        out[constants.RESOURCE_TPU_MEMORY] = memory
     return out
 
 

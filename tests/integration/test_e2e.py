@@ -156,6 +156,55 @@ class TestEndToEnd:
         assert nodes == {"tpu-0", "tpu-1"}
 
 
+class TestSharingEndToEnd:
+    """The MPS-analogue loop: pending pod requesting an HBM fraction →
+    sharing controller plans → device-plugin ConfigMap + label flip →
+    sim plugin re-advertises → pod schedules → reporter mirrors state."""
+
+    def test_pending_shared_pod_triggers_config_and_schedules(self, cluster):
+        cluster.add_sharing_node(
+            build_tpu_node(name="shared-1", chips=4, partitioning="sharing")
+        )
+        cluster.start()
+        mem8 = constants.tpu_shared_resource(8)
+        cluster.store.create(build_pod("infer", {mem8: 1}, ns="ml"))
+
+        assert wait_for(pod_running_on(cluster.store, "infer", "ml"), timeout=15), (
+            "pod never scheduled; node: %s"
+            % cluster.store.get("Node", "shared-1").metadata.labels
+        )
+        node = cluster.store.get("Node", "shared-1")
+        # Actuation went through the device plugin, not spec annotations.
+        assert annot.SPEC_PARTITIONING_PLAN not in node.metadata.annotations
+        key = node.metadata.labels[labels.TPU_DEVICE_PLUGIN_CONFIG_LABEL]
+        cm = cluster.store.get("ConfigMap", cluster.device_plugin_config_map)
+        assert key in cm.data
+        assert node.status.allocatable.get(mem8, 0) >= 1
+
+        # Reporter mirrors usage into status annotations.
+        def reported_used():
+            n = cluster.store.get("Node", "shared-1")
+            _, status = annot.parse_node_annotations(n.metadata.annotations)
+            return any(s.status == "used" and s.profile == "8gb" for s in status)
+
+        assert wait_for(reported_used, timeout=10)
+
+    def test_shared_pods_pack_multiple_chips(self, cluster):
+        cluster.add_sharing_node(
+            build_tpu_node(name="shared-1", chips=2, partitioning="sharing")
+        )
+        cluster.start()
+        mem8 = constants.tpu_shared_resource(8)
+        for i in range(4):  # 4 × 8gb over 2 × 16GB chips
+            cluster.store.create(build_pod(f"infer-{i}", {mem8: 1}, ns="ml"))
+        for i in range(4):
+            assert wait_for(
+                pod_running_on(cluster.store, f"infer-{i}", "ml"), timeout=20
+            ), f"infer-{i} stuck"
+        alloc = cluster.store.get("Node", "shared-1").status.allocatable
+        assert alloc.get(mem8, 0) == 4
+
+
 class TestNativeBackend:
     def test_carve_and_schedule_through_tpuctl(self, tmp_path):
         """Same end-to-end loop, but slice state lives in the native C++

@@ -1,0 +1,207 @@
+"""Sharing-strategy tests: snapshot taker, ConfigMap actuation, plugin sim,
+reporter — the MPS-path test coverage of the reference
+(internal/partitioning/mps/*_test.go, gpuagent/reporter_int_test.go)."""
+import json
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.controllers.sharingagent import SharingReporter
+from nos_tpu.device.sharing import (
+    SharedSliceClient,
+    SimSharedDevicePlugin,
+    load_plugin_config,
+)
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core.partition_state import (
+    BoardPartitioning,
+    NodePartitioning,
+)
+from nos_tpu.partitioning.core.state import ClusterState
+from nos_tpu.partitioning.sharing import (
+    SharingPartitioner,
+    SharingSnapshotTaker,
+    plugin_config_from_partitioning,
+)
+
+from tests.factory import build_pod, build_tpu_node
+
+CM = "nos-device-plugin-config"
+
+
+def mem(gb: int) -> str:
+    return constants.tpu_shared_resource(gb)
+
+
+def sharing_node(name="shared-0", chips=4, annotations=None):
+    return build_tpu_node(
+        name=name,
+        chips=chips,
+        annotations=annotations,
+        partitioning="sharing",
+    )
+
+
+def node_partitioning():
+    return NodePartitioning(
+        boards=[
+            BoardPartitioning(board_index=0, resources={mem(8): 2}),
+            BoardPartitioning(board_index=1, resources={mem(16): 1}),
+        ]
+    )
+
+
+class TestSnapshotTaker:
+    def test_only_sharing_nodes(self):
+        state = ClusterState()
+        state.update_node(sharing_node("s0"), [])
+        state.update_node(build_tpu_node(name="t0"), [])
+        snapshot = SharingSnapshotTaker().take_snapshot(state)
+        assert list(snapshot.get_nodes()) == ["s0"]
+
+    def test_snapshot_speaks_shared_codec(self):
+        state = ClusterState()
+        annotations = annot.status_from_devices(free={0: {"8gb": 1}}, used={})
+        state.update_node(sharing_node(annotations=annotations), [])
+        snapshot = SharingSnapshotTaker().take_snapshot(state)
+        assert snapshot.free_slice_resources() == {mem(8): 1}
+        assert snapshot.tracked(mem(8))
+        assert not snapshot.tracked(constants.RESOURCE_TPU)
+
+
+class TestSharingPartitioner:
+    def test_writes_configmap_and_flips_label(self):
+        store = KubeStore()
+        store.create(sharing_node())
+        SharingPartitioner(store, CM).apply_partitioning(
+            "shared-0", "plan-1", node_partitioning()
+        )
+        cm = store.get("ConfigMap", CM)
+        key = "shared-0-plan-1"
+        assert key in cm.data
+        config = json.loads(cm.data[key])
+        renames = {r["rename"]: r["replicas"] for r in config["sharing"]["resources"]}
+        assert renames == {mem(8): 2, mem(16): 1}
+        node = store.get("Node", "shared-0")
+        assert node.metadata.labels[labels.TPU_DEVICE_PLUGIN_CONFIG_LABEL] == key
+
+    def test_supersedes_previous_plan_key(self):
+        store = KubeStore()
+        store.create(sharing_node())
+        p = SharingPartitioner(store, CM)
+        p.apply_partitioning("shared-0", "plan-1", node_partitioning())
+        p.apply_partitioning("shared-0", "plan-2", node_partitioning())
+        cm = store.get("ConfigMap", CM)
+        assert list(cm.data) == ["shared-0-plan-2"]
+
+    def test_other_nodes_keys_untouched(self):
+        store = KubeStore()
+        store.create(sharing_node("shared-0"))
+        store.create(sharing_node("shared-1"))
+        p = SharingPartitioner(store, CM)
+        p.apply_partitioning("shared-0", "plan-1", node_partitioning())
+        p.apply_partitioning("shared-1", "plan-1", node_partitioning())
+        assert len(store.get("ConfigMap", CM).data) == 2
+
+    def test_plugin_config_rendering(self):
+        config = plugin_config_from_partitioning(node_partitioning())
+        assert config["sharing"]["fail_requests_greater_than_one"] is True
+        entry = config["sharing"]["resources"][0]
+        assert entry["name"] == constants.RESOURCE_TPU
+        assert entry["memory_gb"] == 8
+        assert entry["chips"] == [0]
+
+
+class TestSimSharedDevicePlugin:
+    def _actuated(self):
+        store = KubeStore()
+        store.create(sharing_node())
+        SharingPartitioner(store, CM).apply_partitioning(
+            "shared-0", "plan-1", node_partitioning()
+        )
+        SimSharedDevicePlugin(store, CM).reconcile(Request(name="shared-0"))
+        return store
+
+    def test_advertises_shared_resources(self):
+        store = self._actuated()
+        alloc = store.get("Node", "shared-0").status.allocatable
+        assert alloc[mem(8)] == 2
+        assert alloc[mem(16)] == 1
+        # Chips 0 and 1 are shared; 2 remain plain out of 4.
+        assert alloc[constants.RESOURCE_TPU] == 2
+
+    def test_load_plugin_config_roundtrip(self):
+        store = self._actuated()
+        config = load_plugin_config(store, "shared-0", CM)
+        assert config is not None and len(config["sharing"]["resources"]) == 2
+
+    def test_missing_key_keeps_last_advertised_state(self):
+        # Regression: mid-rollover (label points at a retired key) the
+        # plugin must keep serving its last state, not wipe allocatable.
+        store = self._actuated()
+        def drop_key(cm):
+            cm.data.clear()
+        store.patch_merge("ConfigMap", CM, "", drop_key)
+        SimSharedDevicePlugin(store, CM).reconcile(Request(name="shared-0"))
+        alloc = store.get("Node", "shared-0").status.allocatable
+        assert alloc[mem(8)] == 2
+        assert alloc[constants.RESOURCE_TPU] == 2
+
+    def test_prefix_named_nodes_keep_their_keys(self):
+        # Regression: cleaning node "pool-1" must not delete "pool-1-a"'s
+        # live config entry.
+        store = KubeStore()
+        store.create(sharing_node("pool-1"))
+        store.create(sharing_node("pool-1-a"))
+        p = SharingPartitioner(store, CM)
+        p.apply_partitioning("pool-1-a", "1000-1", node_partitioning())
+        p.apply_partitioning("pool-1", "1000-2", node_partitioning())
+        p.apply_partitioning("pool-1", "1000-3", node_partitioning())
+        assert set(store.get("ConfigMap", CM).data) == {
+            "pool-1-a-1000-1",
+            "pool-1-1000-3",
+        }
+
+
+class TestSharedSliceClientAndReporter:
+    def test_devices_track_pod_usage(self):
+        store = self._actuated_with_pod()
+        devices = SharedSliceClient(store, CM).get_devices("shared-0")
+        used = [d for d in devices if d.status == "used"]
+        free = [d for d in devices if d.status == "free"]
+        assert len(used) == 1 and used[0].profile == "8gb"
+        assert len(free) == 2
+
+    def test_reporter_writes_status_annotations(self):
+        store = self._actuated_with_pod()
+        reporter = SharingReporter(
+            store, SharedSliceClient(store, CM), "shared-0", 10.0
+        )
+        reporter.reconcile(Request(name="shared-0"))
+        node = store.get("Node", "shared-0")
+        _, status = annot.parse_node_annotations(node.metadata.annotations)
+        by_key = {(s.board_index, s.profile, s.status): s.quantity for s in status}
+        assert by_key[(0, "8gb", "used")] == 1
+        assert by_key[(0, "8gb", "free")] == 1
+        assert by_key[(1, "16gb", "free")] == 1
+
+    def test_reporter_refuses_tpu_mode_node(self):
+        store = KubeStore()
+        store.create(build_tpu_node(name="t0"))
+        reporter = SharingReporter(store, SharedSliceClient(store, CM), "t0", 10.0)
+        reporter.reconcile(Request(name="t0"))
+        node = store.get("Node", "t0")
+        _, status = annot.parse_node_annotations(node.metadata.annotations)
+        assert status == []
+
+    @staticmethod
+    def _actuated_with_pod():
+        store = KubeStore()
+        store.create(sharing_node())
+        SharingPartitioner(store, CM).apply_partitioning(
+            "shared-0", "plan-1", node_partitioning()
+        )
+        store.create(
+            build_pod("user", {mem(8): 1}, ns="ml", node="shared-0", phase="Running")
+        )
+        return store

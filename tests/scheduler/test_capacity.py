@@ -117,7 +117,11 @@ class TestBuildQuotaInfos:
         store.create(build_pod("p", {constants.RESOURCE_TPU: 4}, ns="a", node="n1", phase="Running"))
         store.create(build_pod("unbound", {constants.RESOURCE_TPU: 2}, ns="a"))
         infos = build_quota_infos(store)
-        assert infos.for_namespace("a").used == {CHIPS: 4, constants.RESOURCE_TPU: 4}
+        assert infos.for_namespace("a").used == {
+            CHIPS: 4,
+            constants.RESOURCE_TPU: 4,
+            constants.RESOURCE_TPU_MEMORY: 4 * constants.DEFAULT_TPU_CHIP_MEMORY_GB,
+        }
 
 
 class TestPreFilter:

@@ -195,3 +195,20 @@ class TestBoardLayout:
         assert not t.consistent
         assert not t.has_free_capacity()
         assert not t.update_geometry_for({constants.tpu_slice_resource("1x1"): 1})
+
+
+class TestSharingAnnotationTolerance:
+    def test_gb_status_annotations_ignored(self):
+        # Regression: stale sharing-mode ("<N>gb") status annotations on a
+        # node relabeled to tpu mode must not enter board geometry (they
+        # would crash topology math).
+        from nos_tpu.api.v1alpha1 import annotations as annot
+        from tests.factory import build_tpu_node
+
+        annotations = annot.status_from_devices(
+            free={0: {"8gb": 1, "2x2": 1}}, used={}
+        )
+        node = TpuNode(build_tpu_node(annotations=annotations))
+        assert node.consistent
+        assert node.boards[0].free == {"2x2": 1}
+        assert node.has_free_capacity()

@@ -1,0 +1,153 @@
+"""Sharing-domain tests (geometry math mirrors reference
+pkg/gpu/slicing/gpu_test.go + node_test.go scenarios)."""
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from nos_tpu.tpu.sharing import SharedChip, SharingNode
+
+
+def mem(gb: int) -> str:
+    return constants.tpu_shared_resource(gb)
+
+
+def sharing_node(
+    chips: int = 4,
+    accelerator: str = "tpu-v5-lite-podslice",
+    annotations: dict | None = None,
+) -> Node:
+    alloc = {constants.RESOURCE_TPU: chips}
+    return Node(
+        metadata=ObjectMeta(
+            name="shared-0",
+            labels={
+                labels.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+                labels.PARTITIONING_LABEL: "sharing",
+            },
+            annotations=annotations or {},
+        ),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def pod_requesting(resources: dict) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name="p", namespace="ns"),
+        spec=PodSpec(containers=[Container(requests=resources)]),
+    )
+
+
+class TestSharedChip:
+    def test_create_from_spare_memory(self):
+        chip = SharedChip(0, hbm_gb=16)
+        assert chip.update_geometry_for({"8gb": 2})
+        assert chip.free == {"8gb": 2}
+        assert chip.spare_memory_gb() == 0
+
+    def test_partial_create_when_budget_short(self):
+        chip = SharedChip(0, hbm_gb=16)
+        assert chip.update_geometry_for({"8gb": 3})
+        assert chip.free == {"8gb": 2}
+
+    def test_never_deletes_used_slices(self):
+        chip = SharedChip(0, hbm_gb=16, used={"8gb": 1})
+        assert chip.update_geometry_for({"16gb": 1}) is False
+        assert chip.used == {"8gb": 1}
+
+    def test_sacrifices_free_slices_for_required_profile(self):
+        chip = SharedChip(0, hbm_gb=16, free={"8gb": 2})
+        assert chip.update_geometry_for({"16gb": 1})
+        assert chip.free.get("16gb", 0) == 1
+        # The original free 8gb slices no longer fit and stay gone.
+        assert chip.free.get("8gb", 0) == 0
+
+    def test_restores_free_slices_that_still_fit(self):
+        chip = SharedChip(0, hbm_gb=16, free={"4gb": 3})
+        assert chip.update_geometry_for({"8gb": 1})
+        assert chip.free.get("8gb", 0) == 1
+        # 8 GB remain: two of the three original 4gb slices come back.
+        assert chip.free.get("4gb", 0) == 2
+
+    def test_smaller_profiles_served_first(self):
+        chip = SharedChip(0, hbm_gb=16)
+        assert chip.update_geometry_for({"12gb": 1, "4gb": 1})
+        assert chip.free == {"4gb": 1, "12gb": 1}
+
+    def test_trade_preserves_required_smaller_profiles(self):
+        # Regression: trading for 8gb must not destroy the 4gb slices the
+        # same requirement set still needs (the reference algorithm does).
+        chip = SharedChip(0, hbm_gb=16, used={"8gb": 1}, free={"4gb": 1})
+        chip.update_geometry_for({"4gb": 2, "8gb": 1})
+        assert chip.free.get("4gb", 0) == 2
+
+    def test_trade_sacrifices_excess_of_required_profile(self):
+        chip = SharedChip(0, hbm_gb=16, free={"4gb": 4})
+        assert chip.update_geometry_for({"4gb": 1, "8gb": 1})
+        assert chip.free.get("8gb", 0) == 1
+        assert chip.free.get("4gb", 0) >= 1
+
+    def test_allocate_moves_free_to_used(self):
+        chip = SharedChip(0, hbm_gb=16, free={"8gb": 1})
+        assert chip.allocate("8gb")
+        assert chip.used == {"8gb": 1}
+        assert chip.free == {}
+        assert not chip.allocate("8gb")
+
+
+class TestSharingNode:
+    def test_builds_chips_from_capacity(self):
+        node = SharingNode(sharing_node(chips=4))
+        assert node.is_sharing_node
+        assert len(node.chips) == 4
+        assert node.chips[0].hbm_gb == 16
+
+    def test_v4_hbm_budget(self):
+        node = SharingNode(sharing_node(chips=4, accelerator="tpu-v4-podslice"))
+        assert node.chips[0].hbm_gb == 32
+
+    def test_unknown_accelerator_no_chips(self):
+        node = SharingNode(sharing_node(accelerator="gpu-h100"))
+        assert not node.is_sharing_node
+
+    def test_status_annotations_restore_state(self):
+        annotations = annot.status_from_devices(
+            free={0: {"8gb": 1}}, used={1: {"16gb": 1}}
+        )
+        node = SharingNode(sharing_node(chips=2, annotations=annotations))
+        assert node.chips[0].free == {"8gb": 1}
+        assert node.chips[1].used == {"16gb": 1}
+        assert node.free_slices() == {"8gb": 1}
+
+    def test_inconsistent_on_out_of_range_chip(self):
+        annotations = annot.status_from_devices(free={9: {"8gb": 1}}, used={})
+        node = SharingNode(sharing_node(chips=2, annotations=annotations))
+        assert not node.consistent
+        assert not node.has_free_capacity()
+
+    def test_update_geometry_spreads_across_chips(self):
+        node = SharingNode(sharing_node(chips=2))
+        assert node.update_geometry_for({mem(16): 2})
+        geometry = node.geometry()
+        assert geometry[0] == {"16gb": 1}
+        assert geometry[1] == {"16gb": 1}
+
+    def test_add_pod_consumes_free_slices(self):
+        annotations = annot.status_from_devices(free={0: {"8gb": 2}}, used={})
+        node = SharingNode(sharing_node(chips=1, annotations=annotations))
+        assert node.add_pod(pod_requesting({mem(8): 2}))
+        assert node.chips[0].used == {"8gb": 2}
+        assert not node.add_pod(pod_requesting({mem(8): 1}))
+
+    def test_scalar_resources(self):
+        annotations = annot.status_from_devices(
+            free={0: {"8gb": 1}}, used={0: {"8gb": 1}}
+        )
+        node = SharingNode(sharing_node(chips=2, annotations=annotations))
+        assert node.scalar_resources() == {mem(8): 2}
+
+    def test_to_sim_node_hides_shared_chips(self):
+        annotations = annot.status_from_devices(free={0: {"8gb": 2}}, used={})
+        node = SharingNode(sharing_node(chips=2, annotations=annotations))
+        sim = node.to_sim_node()
+        assert sim.status.allocatable[mem(8)] == 2
+        # Chip 0 carries slices; chip 1 stays plain-requestable.
+        assert sim.status.allocatable[constants.RESOURCE_TPU] == 1
