@@ -89,6 +89,23 @@ def parse_node_annotations(
     return spec, status
 
 
+def status_key_profile(key: str) -> "str | None":
+    """Profile of a status annotation key ("2x2", "8gb"), None otherwise."""
+    m = _STATUS_RE.match(key)
+    return m.group(2) if m else None
+
+
+def is_sharing_status_key(key: str) -> bool:
+    """True when a status annotation carries a sharing profile ("<N>gb").
+
+    On hybrid nodes the tpuagent owns topology entries and the sharingagent
+    owns HBM-fraction entries; each reporter diffs only its own flavor so
+    neither wipes the other's report.
+    """
+    profile = status_key_profile(key)
+    return profile is not None and profile.endswith("gb")
+
+
 def _parse_quantity(value: str) -> "int | None":
     """Slice counts must be positive integers; anything else is malformed."""
     try:

@@ -19,6 +19,12 @@ CAPACITY_OVER_QUOTA = "over-quota"
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 
+# On hybrid nodes: how many of the node's chips (the highest-indexed ones)
+# form the sharing pool; the rest are carved into slice boards. The TPU
+# analogue of nos's per-GPU MIG-enabled flag, which decides whether a
+# device belongs to the MIG or the MPS pass on a hybrid node.
+SHARED_CHIPS_LABEL = "nos.nebuly.com/shared-chips"
+
 # Device-plugin config selection label flipped by the MPS-style actuation
 # path (reference internal/partitioning/mps/partitioner.go:102-110 flips
 # nvidia.com/device-plugin.config; the TPU device plugin uses its own key).
@@ -30,10 +36,15 @@ class PartitioningKind:
     # HBM-fraction chip sharing actuated through the device plugin
     # (the MPS analogue: reference internal/partitioning/mps/).
     SHARING = "sharing"
+    # Both modes on one node: slice-carving boards and shared-fraction
+    # chips coexisting (reference pkg/gpu/partitioning.go:91 declares
+    # PartitioningKindHybrid; here hybrid nodes actually participate in
+    # both the tpu and sharing planning passes).
+    HYBRID = "hybrid"
     MIG = "mig"
     MPS = "mps"
 
-    ALL = (TPU, SHARING, MIG, MPS)
+    ALL = (TPU, SHARING, HYBRID, MIG, MPS)
 
 
 def partitioning_kind(node) -> str:
@@ -43,3 +54,49 @@ def partitioning_kind(node) -> str:
     """
     value = node.metadata.labels.get(PARTITIONING_LABEL, "")
     return value if value in PartitioningKind.ALL else ""
+
+
+def is_tpu_partitioning_enabled(node) -> bool:
+    """Node participates in agent-actuated slice partitioning (tpu or
+    hybrid) — analogue of reference gpu.IsMigPartitioningEnabled."""
+    return partitioning_kind(node) in (PartitioningKind.TPU, PartitioningKind.HYBRID)
+
+
+def is_sharing_partitioning_enabled(node) -> bool:
+    """Node participates in device-plugin-actuated chip sharing (sharing
+    or hybrid) — analogue of reference gpu.IsMpsPartitioningEnabled."""
+    return partitioning_kind(node) in (
+        PartitioningKind.SHARING,
+        PartitioningKind.HYBRID,
+    )
+
+
+def shared_chip_count(node, total_chips: int) -> int:
+    """How many of the node's chips belong to the sharing pass.
+
+    Pure sharing nodes share everything; pure tpu nodes share nothing;
+    hybrid nodes split per the shared-chips label (the highest-indexed N
+    chips share, the rest carve into boards).
+    """
+    kind = partitioning_kind(node)
+    if kind in (PartitioningKind.SHARING, PartitioningKind.MPS):
+        return total_chips
+    if kind != PartitioningKind.HYBRID:
+        return 0
+    try:
+        n = int(node.metadata.labels.get(SHARED_CHIPS_LABEL, "0"))
+    except ValueError:
+        return 0
+    return max(0, min(n, total_chips))
+
+
+def kind_matches(node, kind: str) -> bool:
+    """True when the node participates in planning pass ``kind`` —
+    exact-kind nodes plus hybrid nodes for the tpu/sharing passes."""
+    value = partitioning_kind(node)
+    if value == kind:
+        return True
+    return value == PartitioningKind.HYBRID and kind in (
+        PartitioningKind.TPU,
+        PartitioningKind.SHARING,
+    )

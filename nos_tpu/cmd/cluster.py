@@ -49,6 +49,7 @@ class SimCluster:
     tpuctl_dir: str = ""
     device_plugin_config_map: str = "nos-device-plugin-config"
     _agent_nodes: List[str] = field(default_factory=list)
+    _sharing_agent_nodes: List[str] = field(default_factory=list)
     _tpuctl_client: object = None
 
     def add_tpu_node(self, node: Node, agent_config: Optional[TpuAgentConfig] = None) -> None:
@@ -86,8 +87,12 @@ class SimCluster:
         (the gpuagent analogue); actuation rides the device-plugin
         ConfigMap, so no actuator is started."""
         self.store.create(node)
-        name = node.metadata.name
-        if name in self._agent_nodes:
+        self._start_sharing_reporter(node.metadata.name, agent_config)
+
+    def _start_sharing_reporter(
+        self, name: str, agent_config: Optional[TpuAgentConfig] = None
+    ) -> None:
+        if name in self._sharing_agent_nodes:
             return
         from nos_tpu.device.sharing import SharedSliceClient
 
@@ -97,7 +102,18 @@ class SimCluster:
             SharedSliceClient(self.store, self.device_plugin_config_map),
             agent_config or TpuAgentConfig(report_config_interval_seconds=0.5),
         )
-        self._agent_nodes.append(name)
+        self._sharing_agent_nodes.append(name)
+
+    def add_hybrid_node(self, node: Node, agent_config: Optional[TpuAgentConfig] = None) -> None:
+        """Create a hybrid-mode node: slice partitioning is actuated by its
+        tpuagent, chip sharing by the device-plugin ConfigMap path. Both
+        agents run; each reporter owns only its profile flavor of the
+        status annotations (the tpuagent additionally owns the plan
+        handshake)."""
+        self.store.create(node)
+        name = node.metadata.name
+        self.start_agent(name, agent_config)
+        self._start_sharing_reporter(name, agent_config)
 
     def _tpuctl(self, node_name: str):
         from nos_tpu.api.v1alpha1 import constants

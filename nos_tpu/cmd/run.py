@@ -60,14 +60,17 @@ def seed_node(spec: dict) -> Node:
     chips = int(spec.get("chips", 8))
     accelerator = spec.get("accelerator", "tpu-v5-lite-podslice")
     alloc = {constants.RESOURCE_TPU: chips, "cpu": spec.get("cpu", 64), "memory": spec.get("memoryGB", 256)}
+    node_labels = {
+        labels.GKE_TPU_ACCELERATOR_LABEL: accelerator,
+        labels.GKE_TPU_TOPOLOGY_LABEL: spec.get("topology", "2x4"),
+        labels.PARTITIONING_LABEL: spec.get("partitioning", "tpu"),
+    }
+    if "sharedChips" in spec:
+        node_labels[labels.SHARED_CHIPS_LABEL] = str(spec["sharedChips"])
     return Node(
         metadata=ObjectMeta(
             name=spec["name"],
-            labels={
-                labels.GKE_TPU_ACCELERATOR_LABEL: accelerator,
-                labels.GKE_TPU_TOPOLOGY_LABEL: spec.get("topology", "2x4"),
-                labels.PARTITIONING_LABEL: spec.get("partitioning", "tpu"),
-            },
+            labels=node_labels,
         ),
         status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
     )
@@ -95,8 +98,11 @@ def main(argv=None) -> int:
     )
     for spec in config.get("nodes", []):
         node = seed_node(spec)
-        if spec.get("partitioning", "tpu") == "sharing":
+        kind = spec.get("partitioning", "tpu")
+        if kind == "sharing":
             cluster.add_sharing_node(node, agent_cfg)
+        elif kind == "hybrid":
+            cluster.add_hybrid_node(node, agent_cfg)
         else:
             cluster.add_tpu_node(node, agent_cfg)
 

@@ -17,7 +17,7 @@ from typing import List, Optional
 
 from nos_tpu.api.v1alpha1 import annotations as annot
 from nos_tpu.api.v1alpha1 import constants
-from nos_tpu.api.v1alpha1.labels import PARTITIONING_LABEL, partitioning_kind
+from nos_tpu.api.v1alpha1.labels import kind_matches
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.store import KubeStore
@@ -93,9 +93,9 @@ class PartitionerController:
     # ------------------------------------------------------- plan gate
 
     def _waiting_for_nodes_to_report_plan(self) -> bool:
-        for node in self.store.list(
-            "Node", label_selector={PARTITIONING_LABEL: self.kind}
-        ):
+        for node in self.store.list("Node"):
+            if not kind_matches(node, self.kind):
+                continue
             spec_plan = node.metadata.annotations.get(annot.SPEC_PARTITIONING_PLAN)
             status_plan = node.metadata.annotations.get(annot.STATUS_PARTITIONING_PLAN)
             if spec_plan and spec_plan != status_plan:

@@ -7,7 +7,7 @@ import logging
 from typing import Optional
 
 from nos_tpu.api.v1alpha1 import constants
-from nos_tpu.api.v1alpha1.labels import partitioning_kind
+from nos_tpu.api.v1alpha1.labels import is_tpu_partitioning_enabled
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.store import KubeStore
 from nos_tpu.partitioning.core import ClusterState
@@ -35,7 +35,7 @@ class StateNodeController:
         # geometry so its resources become schedulable (node_controller.go:89-95).
         if (
             self.initializer is not None
-            and partitioning_kind(node) == "tpu"
+            and is_tpu_partitioning_enabled(node)
             and not self.initializer.is_initialized(node)
         ):
             self.initializer.init_node_partitioning(node)

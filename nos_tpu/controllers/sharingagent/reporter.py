@@ -61,8 +61,9 @@ class SharingReporter:
         current_status = {
             k: v
             for k, v in node.metadata.annotations.items()
-            if k.startswith(annot.PREFIX + "status-")
-            and k != annot.STATUS_PARTITIONING_PLAN
+            # Own only sharing-profile entries: on hybrid nodes the
+            # topology entries (and the plan id) belong to the tpuagent.
+            if annot.is_sharing_status_key(k)
         }
         if current_status != desired_status:
             patch = {k: None for k in current_status}

@@ -70,6 +70,9 @@ class TpuReporter:
             k: v
             for k, v in node.metadata.annotations.items()
             if k.startswith(annot.PREFIX + "status-")
+            # Hybrid nodes: sharing-profile entries belong to the
+            # sharingagent; diffing them here would wipe its report.
+            and not annot.is_sharing_status_key(k)
         }
         if current_status != desired_status:
             patch = {k: None for k in current_status}

@@ -96,13 +96,20 @@ class SimSharedDevicePlugin:
             covered_chips.update(entry.get("chips", []))
 
         def mutate(n):
+            from nos_tpu.api.v1alpha1 import labels
+
             target = n.status.allocatable
             total_chips = int(n.status.capacity.get(constants.RESOURCE_TPU, 0))
             for key in [k for k in target if constants.is_tpu_shared_resource(k)]:
                 del target[key]
             target.update(shared)
-            # Chips folded into shared fractions stop being plain-requestable.
-            target[constants.RESOURCE_TPU] = max(0, total_chips - len(covered_chips))
+            if labels.partitioning_kind(n) == labels.PartitioningKind.HYBRID:
+                # Hybrid: slice boards own the non-shared chips; never
+                # re-expose them as plain (see DevicePluginAdvertiser).
+                target[constants.RESOURCE_TPU] = 0
+            else:
+                # Chips folded into shared fractions stop being plain-requestable.
+                target[constants.RESOURCE_TPU] = max(0, total_chips - len(covered_chips))
 
         try:
             self.store.patch_merge("Node", req.name, "", mutate)

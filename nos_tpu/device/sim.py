@@ -141,6 +141,8 @@ class DevicePluginAdvertiser:
                 chips_exposed += Topology(profile).chips * qty
 
         def mutate(n):
+            from nos_tpu.api.v1alpha1 import labels
+
             # Capacity stays the physical chip inventory (TpuNode derives its
             # board layout from it); only allocatable carries the advertised
             # scheduling view, where chips folded into slices are no longer
@@ -150,7 +152,13 @@ class DevicePluginAdvertiser:
             for key in [k for k in target if constants.is_tpu_slice_resource(k)]:
                 del target[key]
             target.update(slice_resources)
-            target[constants.RESOURCE_TPU] = max(0, total_chips - chips_exposed)
+            if labels.partitioning_kind(n) == labels.PartitioningKind.HYBRID:
+                # Hybrid: every chip is denominated as a slice or a shared
+                # fraction (plain requests are normalized by the scheduler);
+                # neither advertiser may re-expose the other pool's chips.
+                target[constants.RESOURCE_TPU] = 0
+            else:
+                target[constants.RESOURCE_TPU] = max(0, total_chips - chips_exposed)
 
         self.store.patch_merge("Node", node_name, "", mutate)
 

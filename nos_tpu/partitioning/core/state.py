@@ -93,7 +93,15 @@ class ClusterState:
 
     def is_partitioning_enabled(self, kind: str) -> bool:
         with self._lock:
-            return self._kind_counts.get(kind, 0) > 0
+            if self._kind_counts.get(kind, 0) > 0:
+                return True
+            # Hybrid nodes participate in both the tpu and sharing passes.
+            if kind in (
+                labels_api.PartitioningKind.TPU,
+                labels_api.PartitioningKind.SHARING,
+            ):
+                return self._kind_counts.get(labels_api.PartitioningKind.HYBRID, 0) > 0
+            return False
 
     # ------------------------------------------------------------ helpers
 

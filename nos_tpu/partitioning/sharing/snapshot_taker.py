@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from nos_tpu.api.v1alpha1.labels import PartitioningKind, partitioning_kind
+from nos_tpu.api.v1alpha1.labels import is_sharing_partitioning_enabled
 from nos_tpu.partitioning.core.codec import SharedSliceCodec
 from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
 from nos_tpu.partitioning.core.state import ClusterState
@@ -20,7 +20,7 @@ class SharingSnapshotTaker:
     def take_snapshot(self, state: ClusterState) -> ClusterSnapshot:
         nodes: Dict[str, SnapshotNode] = {}
         for name, info in state.get_nodes().items():
-            if partitioning_kind(info.node) != PartitioningKind.SHARING:
+            if not is_sharing_partitioning_enabled(info.node):
                 continue
             sharing_node = SharingNode(info.node, owned=True)
             if not sharing_node.is_sharing_node:

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from typing import Dict
 
-from nos_tpu.api.v1alpha1.labels import PartitioningKind, partitioning_kind
+from nos_tpu.api.v1alpha1.labels import is_tpu_partitioning_enabled
 from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
 from nos_tpu.partitioning.core.state import ClusterState
 from nos_tpu.tpu.node import TpuNode
@@ -18,7 +18,7 @@ class TpuSnapshotTaker:
     def take_snapshot(self, state: ClusterState) -> ClusterSnapshot:
         nodes: Dict[str, SnapshotNode] = {}
         for name, info in state.get_nodes().items():
-            if partitioning_kind(info.node) != PartitioningKind.TPU:
+            if not is_tpu_partitioning_enabled(info.node):
                 continue
             tpu_node = TpuNode(info.node, owned=True)
             if not tpu_node.is_tpu_node:

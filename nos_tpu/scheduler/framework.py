@@ -72,7 +72,10 @@ class NodeInfo:
 
         node_labels = self.node.metadata.labels
         accelerator = ""
-        if node_labels.get(labels.PARTITIONING_LABEL) == labels.PartitioningKind.TPU:
+        if node_labels.get(labels.PARTITIONING_LABEL) in (
+            labels.PartitioningKind.TPU,
+            labels.PartitioningKind.HYBRID,
+        ):
             accelerator = node_labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
         total: ResourceList = {}
         for pod in self.pods:
@@ -239,7 +242,10 @@ class NodeResourcesFit:
 
         request = res.compute_pod_request(pod)
         node_labels = node_info.node.metadata.labels
-        if node_labels.get(labels.PARTITIONING_LABEL) == labels.PartitioningKind.TPU:
+        if node_labels.get(labels.PARTITIONING_LABEL) in (
+            labels.PartitioningKind.TPU,
+            labels.PartitioningKind.HYBRID,
+        ):
             accelerator = node_labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
             if accelerator:
                 request = res.normalize_tpu_request(request, accelerator)

@@ -42,6 +42,9 @@ class TpuNode:
 
     def _build_boards(self, node: Node) -> None:
         capacity_chips = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        # On hybrid nodes the highest-indexed chips belong to the sharing
+        # pass; only the remainder is carved into boards here.
+        capacity_chips -= labels.shared_chip_count(node, capacity_chips)
         layouts = board_layout(self.accelerator, capacity_chips)
         if not layouts:
             # Device plugin not registered yet (capacity 0) or capacity no

@@ -170,22 +170,28 @@ class SharingNode:
 
     def _build_chips(self, node: Node) -> None:
         hbm = hbm_gb_per_chip(self.accelerator)
-        chip_count = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
-        if hbm <= 0 or chip_count <= 0:
+        total_chips = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        shared = labels.shared_chip_count(node, total_chips)
+        if hbm <= 0 or shared <= 0:
             return
+        # On hybrid nodes the sharing pool is the highest-indexed chips
+        # (the rest are slice boards); chip indices stay global so device
+        # ids and annotations never collide across the two passes.
+        offset = total_chips - shared
+        chip_count = total_chips
         _, status = annot.parse_node_annotations(node.metadata.annotations)
         free_by_chip: Dict[int, Geometry] = {}
         used_by_chip: Dict[int, Geometry] = {}
         for s in status:
             if not s.profile.endswith("gb"):
                 continue  # tpu-mode annotation on a relabeled node: not ours
-            if s.board_index >= chip_count:
+            if not (offset <= s.board_index < chip_count):
                 self.consistent = False
                 continue
             target = free_by_chip if s.status == annot.STATUS_FREE else used_by_chip
             chip = target.setdefault(s.board_index, {})
             chip[s.profile] = chip.get(s.profile, 0) + s.quantity
-        for i in range(chip_count):
+        for i in range(offset, chip_count):
             self.chips.append(
                 SharedChip(
                     index=i,
@@ -290,6 +296,10 @@ class SharingNode:
             if not constants.is_tpu_shared_resource(k) and k != constants.RESOURCE_TPU
         }
         plain_chips = sum(1 for c in self.chips if not c.geometry)
+        if labels.partitioning_kind(node) == labels.PartitioningKind.HYBRID:
+            # Hybrid chips are never plain-requestable (see the device
+            # plugin advertisers, which zero the scalar the same way).
+            plain_chips = 0
         merged = res.sum_resources(alloc, self.scalar_resources())
         merged[constants.RESOURCE_TPU] = plain_chips
         node.status.allocatable = merged

@@ -1,0 +1,161 @@
+"""Hybrid partitioning mode: one node, both passes.
+
+The reference declares PartitioningKindHybrid (pkg/gpu/partitioning.go:91)
+but neither the MIG nor MPS snapshot taker picks hybrid nodes up; here a
+hybrid node genuinely splits its chips between the slice-carving pass and
+the sharing pass (the highest-indexed ``nos.nebuly.com/shared-chips`` chips
+share, the rest carve into boards).
+"""
+import time
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.partitioning.core.state import ClusterState
+from nos_tpu.partitioning.sharing import SharingSnapshotTaker
+from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+from nos_tpu.tpu.node import TpuNode
+from nos_tpu.tpu.sharing import SharingNode
+
+from tests.factory import build_pod, build_tpu_node
+
+
+def build_hybrid_node(name="hyb-1", chips=8, shared=4):
+    node = build_tpu_node(name=name, chips=chips, partitioning="hybrid")
+    node.metadata.labels[labels.SHARED_CHIPS_LABEL] = str(shared)
+    return node
+
+
+class TestKindHelpers:
+    def test_hybrid_is_valid_kind(self):
+        node = build_hybrid_node()
+        assert labels.partitioning_kind(node) == labels.PartitioningKind.HYBRID
+
+    def test_hybrid_matches_both_passes(self):
+        node = build_hybrid_node()
+        assert labels.is_tpu_partitioning_enabled(node)
+        assert labels.is_sharing_partitioning_enabled(node)
+        assert labels.kind_matches(node, labels.PartitioningKind.TPU)
+        assert labels.kind_matches(node, labels.PartitioningKind.SHARING)
+        assert not labels.kind_matches(node, labels.PartitioningKind.MIG)
+
+    def test_exact_kinds_do_not_cross_match(self):
+        tpu = build_tpu_node(partitioning="tpu")
+        assert labels.is_tpu_partitioning_enabled(tpu)
+        assert not labels.is_sharing_partitioning_enabled(tpu)
+
+    def test_shared_chip_count_split(self):
+        assert labels.shared_chip_count(build_hybrid_node(shared=4), 8) == 4
+        # Clamped to the physical inventory.
+        assert labels.shared_chip_count(build_hybrid_node(shared=99), 8) == 8
+        # Unlabeled hybrid defaults to no sharing pool.
+        node = build_tpu_node(partitioning="hybrid")
+        assert labels.shared_chip_count(node, 8) == 0
+        # Garbage label value is ignored, not fatal.
+        node.metadata.labels[labels.SHARED_CHIPS_LABEL] = "many"
+        assert labels.shared_chip_count(node, 8) == 0
+        # Pure kinds: all or nothing.
+        assert labels.shared_chip_count(build_tpu_node(partitioning="sharing"), 8) == 8
+        assert labels.shared_chip_count(build_tpu_node(partitioning="tpu"), 8) == 0
+
+
+class TestHybridNodeModels:
+    def test_tpu_node_only_models_slicing_chips(self):
+        node = build_hybrid_node(chips=8, shared=4)
+        tpu_node = TpuNode(node)
+        assert tpu_node.is_tpu_node
+        assert sum(b.chips for b in tpu_node.boards) == 4
+
+    def test_sharing_node_models_offset_chips(self):
+        node = build_hybrid_node(chips=8, shared=4)
+        sharing_node = SharingNode(node)
+        assert sharing_node.is_sharing_node
+        assert [c.index for c in sharing_node.chips] == [4, 5, 6, 7]
+
+    def test_pools_cover_inventory_without_overlap(self):
+        node = build_hybrid_node(chips=8, shared=4)
+        tpu_chips = sum(b.chips for b in TpuNode(node).boards)
+        share_chips = len(SharingNode(node).chips)
+        assert tpu_chips + share_chips == 8
+
+    def test_sharing_status_annotation_outside_pool_marks_inconsistent(self):
+        from nos_tpu.api.v1alpha1 import annotations as annot
+
+        node = build_hybrid_node(chips=8, shared=4)
+        # Chip 0 belongs to the slicing pool; a sharing status entry there
+        # is stale agent state the planner must refuse to model.
+        entry = annot.StatusAnnotation(board_index=0, profile="8gb", status=annot.STATUS_FREE, quantity=1)
+        node.metadata.annotations[entry.key] = "1"
+        sharing_node = SharingNode(node)
+        assert not sharing_node.consistent
+        assert not sharing_node.has_free_capacity()
+
+
+class TestHybridSnapshots:
+    def test_both_takers_include_hybrid_node(self):
+        state = ClusterState()
+        state.update_node(build_hybrid_node(chips=8, shared=4), [])
+        assert "hyb-1" in TpuSnapshotTaker().take_snapshot(state).get_nodes()
+        assert "hyb-1" in SharingSnapshotTaker().take_snapshot(state).get_nodes()
+
+    def test_state_enables_both_kinds(self):
+        state = ClusterState()
+        state.update_node(build_hybrid_node(), [])
+        assert state.is_partitioning_enabled(labels.PartitioningKind.TPU)
+        assert state.is_partitioning_enabled(labels.PartitioningKind.SHARING)
+        assert not state.is_partitioning_enabled(labels.PartitioningKind.MIG)
+        state.delete_node("hyb-1")
+        assert not state.is_partitioning_enabled(labels.PartitioningKind.TPU)
+
+
+class TestHybridEndToEnd:
+    def wait_for(self, predicate, timeout=20.0, interval=0.05):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(interval)
+        return False
+
+    @pytest.fixture
+    def cluster(self):
+        from nos_tpu.cmd import build_cluster
+
+        c = build_cluster()
+        yield c
+        c.stop()
+
+    def test_hybrid_node_serves_slice_and_shared_pods(self, cluster):
+        from nos_tpu.kube.objects import PodPhase
+
+        cluster.add_hybrid_node(build_hybrid_node(chips=8, shared=4))
+        cluster.start()
+        mem8 = constants.tpu_shared_resource(8)
+        cluster.store.create(build_pod("train", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        cluster.store.create(build_pod("infer", {mem8: 1}, ns="ml"))
+
+        def running(name):
+            def check():
+                pod = cluster.store.try_get("Pod", name, "ml")
+                return (
+                    pod is not None
+                    and pod.status.phase == PodPhase.RUNNING
+                    and pod.spec.node_name == "hyb-1"
+                )
+
+            return check
+
+        assert self.wait_for(running("train")), (
+            "slice pod stuck; node: %s"
+            % cluster.store.get("Node", "hyb-1").metadata.annotations
+        )
+        assert self.wait_for(running("infer")), (
+            "shared pod stuck; node labels: %s alloc: %s"
+            % (
+                cluster.store.get("Node", "hyb-1").metadata.labels,
+                cluster.store.get("Node", "hyb-1").status.allocatable,
+            )
+        )
+        alloc = cluster.store.get("Node", "hyb-1").status.allocatable
+        # Hybrid nodes never advertise plain chips.
+        assert alloc.get(constants.RESOURCE_TPU, 0) == 0
