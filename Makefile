@@ -1,0 +1,59 @@
+# nos-tpu build/test entry points (reference Makefile analogue).
+
+PY ?= python
+IMAGE_REGISTRY ?= ghcr.io/nos-tpu
+VERSION ?= 0.1.0
+COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
+
+.PHONY: all test test-unit test-integration bench native lint \
+        docker-build $(addprefix docker-build-,$(COMPONENTS)) \
+        helm-lint deploy undeploy clean
+
+all: native test
+
+## Tests -----------------------------------------------------------------
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-unit:
+	$(PY) -m pytest tests/ -q --ignore=tests/integration
+
+test-integration:
+	$(PY) -m pytest tests/integration -q
+
+bench:
+	$(PY) bench.py
+
+## Native ----------------------------------------------------------------
+
+native:
+	$(MAKE) -C native
+
+## Lint ------------------------------------------------------------------
+
+lint:
+	$(PY) -m compileall -q nos_tpu tests bench.py __graft_entry__.py
+	$(PY) -c "import yaml,glob; [list(yaml.safe_load_all(open(f).read())) for f in glob.glob('config/**/*.yaml', recursive=True)]; print('config/ yaml ok')"
+
+## Images ----------------------------------------------------------------
+
+docker-build: $(addprefix docker-build-,$(COMPONENTS))
+
+docker-build-%:
+	docker build -f build/$*/Dockerfile -t $(IMAGE_REGISTRY)/nos-tpu-$*:$(VERSION) .
+
+## Deploy ----------------------------------------------------------------
+
+helm-lint:
+	helm lint helm-charts/nos-tpu
+
+deploy:
+	kubectl apply -k config/default
+
+undeploy:
+	kubectl delete -k config/default
+
+clean:
+	rm -rf native/build native/libtpuctl.so .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,18 @@
+{{/* Common labels */}}
+{{- define "nos-tpu.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{/* Image reference for a component: (dict "root" . "component" "operator") */}}
+{{- define "nos-tpu.image" -}}
+{{- $tag := .root.Values.image.tag | default .root.Chart.AppVersion -}}
+{{ .root.Values.image.registry }}/nos-tpu-{{ .component }}:{{ $tag }}
+{{- end }}
+
+{{/* Service account name */}}
+{{- define "nos-tpu.serviceAccountName" -}}
+{{ .Release.Name }}-nos-tpu
+{{- end }}

@@ -10,6 +10,11 @@ import sys
 def main() -> int:
     commands = {
         "run": "the full suite (operator+partitioner+scheduler+agents)",
+        "operator": "EQ/CEQ reconcilers + validating webhooks",
+        "partitioner": "dynamic TPU slice partitioner control plane",
+        "scheduler": "capacity/gang/topology-aware scheduler",
+        "tpuagent": "per-node slice reporter+actuator daemon (NODE_NAME)",
+        "sharingagent": "per-node sharing reporter daemon (NODE_NAME)",
         "export-metrics": "one-shot installation telemetry snapshot",
         "bench": "the utilization benchmark",
     }
@@ -23,6 +28,11 @@ def main() -> int:
         from nos_tpu.cmd.run import main as run_main
 
         return run_main(argv)
+    if command in ("operator", "partitioner", "scheduler", "tpuagent", "sharingagent"):
+        import importlib
+
+        module = importlib.import_module(f"nos_tpu.cmd.{command}")
+        return module.main(argv)
     if command == "export-metrics":
         from nos_tpu.cmd.metricsexporter import main as export_main
 

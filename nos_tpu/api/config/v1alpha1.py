@@ -49,9 +49,13 @@ class GpuPartitionerConfig:
 @dataclass
 class OperatorConfig:
     manager: ManagerConfig = field(default_factory=ManagerConfig)
+    # Per-chip HBM GB used for the nos.nebuly.com/tpu-memory aggregate
+    # (the reference's NvidiaGpuResourceMemoryGB, operator.go:50-126).
+    tpu_chip_memory_gb: int = 16
 
     def validate(self) -> None:
-        pass
+        if self.tpu_chip_memory_gb < 1:
+            raise ConfigError("tpu_chip_memory_gb must be >= 1")
 
 
 @dataclass

@@ -1,0 +1,78 @@
+"""Shared standalone-component runner.
+
+Each reference binary is its own Docker image taking exactly one
+``--config <file>`` flag (SURVEY.md §2.1); this helper gives every nos-tpu
+component the same shape: parse flags, decode the typed config, build the
+component onto a manager, serve healthz/readyz/metrics, run until
+SIGINT/SIGTERM. A ``stop_event`` can be injected for in-process smoke tests
+(signal handlers only work on the main thread).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from typing import Callable, Optional
+
+from nos_tpu.kube.controller import Manager
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.util.health import HealthServer
+
+
+def component_argparser(name: str) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=f"nos-tpu {name}")
+    parser.add_argument("--config", default="", help="YAML component config")
+    parser.add_argument("--health-port", type=int, default=None)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    return parser
+
+
+def run_component(
+    name: str,
+    build: Callable[[Manager, dict], None],
+    argv=None,
+    stop_event: Optional[threading.Event] = None,
+    ready_check: Optional[Callable[[], bool]] = None,
+) -> int:
+    """`build(manager, config_dict)` wires the component; then serve."""
+    from nos_tpu.cmd.run import load_config
+
+    parser = component_argparser(name)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    config = load_config(args.config)
+
+    store = KubeStore()
+    manager = Manager(store=store)
+    build(manager, config)
+
+    manager_cfg = config.get("manager") or {}
+    port = args.health_port
+    if port is None:
+        port = manager_cfg.get("healthProbePort", 8081)
+    # Bind all interfaces by default: kubelet probes the pod IP, not
+    # loopback (override via manager.healthProbeHost for local runs).
+    health = HealthServer(
+        port=port,
+        ready_check=ready_check,
+        host=manager_cfg.get("healthProbeHost", "0.0.0.0"),
+    )
+    bound = health.start()
+    logging.info("%s: health/metrics on 127.0.0.1:%d", name, bound)
+
+    stop = stop_event or threading.Event()
+    if stop_event is None:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+    manager.start()
+    logging.info("%s running", name)
+    try:
+        stop.wait()
+    finally:
+        manager.stop()
+        health.stop()
+    return 0

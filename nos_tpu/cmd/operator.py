@@ -18,8 +18,8 @@ def build_operator(manager: Manager, config: OperatorConfig | None = None) -> No
     store = manager.store
     register_elasticquota_webhooks(store)
 
-    eq = ElasticQuotaReconciler(store)
-    ceq = CompositeElasticQuotaReconciler(store)
+    eq = ElasticQuotaReconciler(store, chip_memory_gb=config.tpu_chip_memory_gb)
+    ceq = CompositeElasticQuotaReconciler(store, chip_memory_gb=config.tpu_chip_memory_gb)
 
     manager.add(
         Controller(
@@ -50,3 +50,16 @@ def build_operator(manager: Manager, config: OperatorConfig | None = None) -> No
             ],
         )
     )
+
+
+def main(argv=None) -> int:
+    """Standalone operator process (`python -m nos_tpu operator`)."""
+    from nos_tpu.cmd._component import run_component
+
+    def build(manager, config):
+        operator_cfg = OperatorConfig(
+            tpu_chip_memory_gb=int(config.get("tpuChipMemoryGB", 16))
+        )
+        build_operator(manager, operator_cfg)
+
+    return run_component("operator", build, argv)

@@ -144,3 +144,15 @@ def build_partitioner(
     manager.add_runnable(sharing_controller.start, sharing_controller.stop)
     controller.sharing = sharing_controller
     return controller
+
+
+def main(argv=None) -> int:
+    """Standalone gpupartitioner process (`python -m nos_tpu partitioner`)."""
+    from nos_tpu.cmd._component import run_component
+    from nos_tpu.cmd.run import configs_from
+
+    def build(manager, config):
+        partitioner_cfg, _, _ = configs_from(config)
+        build_partitioner(manager, partitioner_cfg)
+
+    return run_component("partitioner", build, argv)

@@ -43,6 +43,11 @@ def configs_from(config: dict):
         batch_window_timeout_seconds=p.get("batchWindowTimeoutSeconds", 60.0),
         batch_window_idle_seconds=p.get("batchWindowIdleSeconds", 10.0),
         known_tpu_geometries=p.get("knownTpuGeometries"),
+        device_plugin_config_map=p.get(
+            "devicePluginConfigMap", "nos-device-plugin-config"
+        ),
+        device_plugin_delay_seconds=p.get("devicePluginDelaySeconds", 0.0),
+        scheduler_config_file=p.get("schedulerConfigFile", ""),
     )
     scheduler = SchedulerConfig(
         retry_seconds=s.get("retrySeconds", 0.5),

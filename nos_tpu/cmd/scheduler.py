@@ -47,3 +47,15 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
         )
     )
     return scheduler
+
+
+def main(argv=None) -> int:
+    """Standalone scheduler process (`python -m nos_tpu scheduler`)."""
+    from nos_tpu.cmd._component import run_component
+    from nos_tpu.cmd.run import configs_from
+
+    def build(manager, config):
+        _, scheduler_cfg, _ = configs_from(config)
+        build_scheduler(manager, scheduler_cfg)
+
+    return run_component("scheduler", build, argv)

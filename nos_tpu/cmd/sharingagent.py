@@ -53,3 +53,29 @@ def build_sharingagent(
         )
     )
     return reporter
+
+
+def main(argv=None) -> int:
+    """Standalone sharingagent daemon (`python -m nos_tpu sharingagent`).
+    Requires NODE_NAME (reference cmd/gpuagent/gpuagent.go)."""
+    import os
+
+    from nos_tpu.cmd._component import run_component
+    from nos_tpu.cmd.run import configs_from
+
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        import sys
+
+        print("sharingagent: NODE_NAME env is required", file=sys.stderr)
+        return 1
+
+    def build(manager, config):
+        _, _, agent_cfg = configs_from(config)
+        client = SharedSliceClient(
+            manager.store,
+            config.get("devicePluginConfigMap", "nos-device-plugin-config"),
+        )
+        build_sharingagent(manager, node_name, client, agent_cfg)
+
+    return run_component(f"sharingagent[{node_name}]", build, argv)

@@ -58,3 +58,79 @@ def build_tpuagent(
             [Watch(kind="Node", predicate=matching_name(node_name))],
         )
     )
+
+
+def main(argv=None) -> int:
+    """Standalone tpuagent daemon (`python -m nos_tpu tpuagent`).
+
+    Requires NODE_NAME (reference cmd/migagent/migagent.go:71). The device
+    backend comes from config: `tpuctl` drives the native slice-state
+    library; `sim` (default) an in-process pool — real hardware actuation
+    is wired per-site behind the same TpuClient interface.
+    """
+    import os
+
+    from nos_tpu.cmd._component import run_component
+    from nos_tpu.cmd.run import configs_from
+
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        import sys
+
+        print("tpuagent: NODE_NAME env is required", file=sys.stderr)
+        return 1
+
+    def build(manager, config):
+        _, _, agent_cfg = configs_from(config)
+        backend = config.get("deviceBackend", "sim")
+        if backend == "tpuctl":
+            from nos_tpu.api.v1alpha1 import constants as const
+            from nos_tpu.api.v1alpha1.labels import GKE_TPU_ACCELERATOR_LABEL
+            from nos_tpu.device.sim import DevicePluginAdvertiser, SimPodResourcesClient
+            from nos_tpu.device.tpuctl import TpuctlDeviceClient
+            from nos_tpu.tpu.known import board_layout
+            from nos_tpu.util.predicates import matching_name
+
+            device = TpuctlDeviceClient(config.get("tpuctlDir", "/var/run/nos-tpu"), {})
+
+            # Learn this node's board layout from its labels/capacity before
+            # any actuation (the SimCluster path does the same,
+            # cluster.py _tpuctl); without it every create fails with
+            # "unknown board".
+            def sync_topology(req):
+                node = manager.store.try_get("Node", node_name)
+                if node is not None:
+                    accelerator = node.metadata.labels.get(GKE_TPU_ACCELERATOR_LABEL, "")
+                    chips = int(node.status.capacity.get(const.RESOURCE_TPU, 0))
+                    device.board_topologies[node_name] = board_layout(accelerator, chips)
+                return None
+
+            manager.add(
+                Controller(
+                    f"tpuagent-topology-{node_name}",
+                    manager.store,
+                    sync_topology,
+                    [Watch(kind="Node", predicate=matching_name(node_name))],
+                )
+            )
+            client = TpuClient(
+                device, SimPodResourcesClient(manager.store, device.get_slices)
+            )
+            plugin = DevicePluginAdvertiser(manager.store, device.geometry)
+        else:
+            from nos_tpu.device.sim import (
+                DevicePluginAdvertiser,
+                SimDevicePlugin,
+                SimDevicePool,
+                SimPodResourcesClient,
+                SimTpuDeviceClient,
+            )
+
+            pool = SimDevicePool()
+            client = TpuClient(
+                SimTpuDeviceClient(pool), SimPodResourcesClient(manager.store, pool.get)
+            )
+            plugin = SimDevicePlugin(manager.store, pool)
+        build_tpuagent(manager, node_name, client, plugin)
+
+    return run_component(f"tpuagent[{node_name}]", build, argv)

@@ -45,8 +45,11 @@ def _filter_to_min(request: ResourceList, min_resources: ResourceList) -> Resour
 
 
 class _QuotaReconcilerBase:
-    def __init__(self, store: KubeStore) -> None:
+    def __init__(self, store: KubeStore, chip_memory_gb: int | None = None) -> None:
+        from nos_tpu.api.v1alpha1 import constants
+
         self.store = store
+        self.chip_memory_gb = chip_memory_gb or constants.DEFAULT_TPU_CHIP_MEMORY_GB
 
     def _running_pods(self, namespaces: List[str]) -> List[Pod]:
         pods: List[Pod] = []
@@ -64,7 +67,9 @@ class _QuotaReconcilerBase:
         used: ResourceList = {}
         for pod in pods:
             request = _filter_to_min(
-                res.with_aggregate_tpu_chips(res.compute_pod_request(pod)),
+                res.with_aggregate_tpu_chips(
+                    res.compute_pod_request(pod), self.chip_memory_gb
+                ),
                 min_resources,
             )
             candidate = res.sum_resources(used, request)

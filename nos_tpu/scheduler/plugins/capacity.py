@@ -156,7 +156,9 @@ class ElasticQuotaInfos:
         return True
 
 
-def build_quota_infos(store: KubeStore) -> ElasticQuotaInfos:
+def build_quota_infos(
+    store: KubeStore, chip_memory_gb: "int | None" = None
+) -> ElasticQuotaInfos:
     """Informer-bridge analogue (capacityscheduling/informer.go:57-300):
     CEQs cover their namespace lists and shadow per-namespace EQs; usage is
     rebuilt from pods bound to nodes."""
@@ -194,23 +196,32 @@ def build_quota_infos(store: KubeStore) -> ElasticQuotaInfos:
         if info is not None:
             info.add_pod(
                 pod.namespaced_name,
-                quota_request(pod),
+                quota_request(pod, chip_memory_gb),
             )
     return result
 
 
-def quota_request(pod: Pod) -> ResourceList:
+def quota_request(pod: Pod, chip_memory_gb: "int | None" = None) -> ResourceList:
     """Pod request with the aggregate chip resource injected, so quotas can
     be expressed in nos.nebuly.com/tpu-chips (the reference injects
-    nos.nebuly.com/gpu-memory, pkg/gpu/util/resource.go:60-86)."""
-    return res.with_aggregate_tpu_chips(res.compute_pod_request(pod))
+    nos.nebuly.com/gpu-memory, pkg/gpu/util/resource.go:60-86).
+    `chip_memory_gb` is the CapacitySchedulingArgs knob (reference
+    pkg/api/scheduler/types.go NvidiaGpuResourceMemoryGB)."""
+    from nos_tpu.api.v1alpha1 import constants
+
+    return res.with_aggregate_tpu_chips(
+        res.compute_pod_request(pod),
+        chip_memory_gb or constants.DEFAULT_TPU_CHIP_MEMORY_GB,
+    )
 
 
 class CapacityScheduling:
     name = "CapacityScheduling"
 
-    def __init__(self, store: KubeStore) -> None:
+    def __init__(self, store: KubeStore, chip_memory_gb: "int | None" = None) -> None:
         self.store = store
+        # CapacitySchedulingArgs knob (reference pkg/api/scheduler/types.go).
+        self.chip_memory_gb = chip_memory_gb
         # Reservations in flight (bound this cycle but possibly not yet
         # re-listed): quota name -> pod key -> request.
         self._reserved: Dict[str, Dict[str, ResourceList]] = {}
@@ -218,7 +229,7 @@ class CapacityScheduling:
     # -------------------------------------------------------- snapshot
 
     def snapshot(self) -> ElasticQuotaInfos:
-        infos = build_quota_infos(self.store)
+        infos = build_quota_infos(self.store, self.chip_memory_gb)
         for quota_name, pods in self._reserved.items():
             info = infos.get(quota_name)
             if info is None:
@@ -232,16 +243,18 @@ class CapacityScheduling:
     def pre_filter(self, state: CycleState, pod: Pod) -> Status:
         infos = self.snapshot()
         state[STATE_KEY] = infos
-        return self.check_quota(pod, infos)
+        return self.check_quota(pod, infos, self.chip_memory_gb)
 
     @staticmethod
-    def check_quota(pod: Pod, infos: ElasticQuotaInfos) -> Status:
+    def check_quota(
+        pod: Pod, infos: ElasticQuotaInfos, chip_memory_gb: "int | None" = None
+    ) -> Status:
         """The quota admission decision, reusable against simulated infos
         (preemption evaluates victims by re-running this)."""
         info = infos.for_namespace(pod.metadata.namespace)
         if info is None:
             return Status.ok()
-        request = quota_request(pod)
+        request = quota_request(pod, chip_memory_gb)
         tracked = {
             k: v for k, v in request.items() if k in info.min or (info.max and k in info.max)
         }
@@ -266,7 +279,7 @@ class CapacityScheduling:
         infos = state.get(STATE_KEY) or self.snapshot()
         info = infos.for_namespace(pod.metadata.namespace)
         if info is not None:
-            self._reserved.setdefault(info.name, {})[pod.namespaced_name] = quota_request(pod)
+            self._reserved.setdefault(info.name, {})[pod.namespaced_name] = quota_request(pod, self.chip_memory_gb)
         return Status.ok()
 
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:

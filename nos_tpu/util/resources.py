@@ -81,15 +81,20 @@ def tpu_memory_gb_in(
     return gb
 
 
-def with_aggregate_tpu_chips(request: ResourceList) -> ResourceList:
+def with_aggregate_tpu_chips(
+    request: ResourceList,
+    chip_memory_gb: int = constants.DEFAULT_TPU_CHIP_MEMORY_GB,
+) -> ResourceList:
     """Inject the aggregate quota resources: nos.nebuly.com/tpu-chips (chip
     units) and nos.nebuly.com/tpu-memory (HBM GB), so ElasticQuotas can be
-    expressed in either regardless of which extended resource pods ask for."""
+    expressed in either regardless of which extended resource pods ask for.
+    `chip_memory_gb` is the per-chip HBM the deployment declares (the
+    reference's NvidiaGpuResourceMemoryGB operator knob)."""
     out = dict(request)
     chips = tpu_chips_in(request)
     if chips > 0:
         out[constants.RESOURCE_TPU_CHIPS] = chips
-    memory = tpu_memory_gb_in(request)
+    memory = tpu_memory_gb_in(request, chip_memory_gb)
     if memory > 0:
         out[constants.RESOURCE_TPU_MEMORY] = memory
     return out

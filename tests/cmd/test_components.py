@@ -1,0 +1,168 @@
+"""Standalone component binaries + packaging manifests.
+
+The reference ships six binaries, each `--config <file>` (SURVEY.md §2.1);
+here each subcommand must start, serve health probes, and shut down
+cleanly on SIGTERM. Manifest tests parse the kustomize config tree and the
+helm chart's static files (templates with Go-template syntax are checked
+for existence + component coverage, not YAML-parsed).
+"""
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_health(port: int, timeout: float = 15.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=1
+            ) as resp:
+                if resp.status == 200:
+                    return True
+        except OSError:
+            time.sleep(0.1)
+    return False
+
+
+@pytest.mark.parametrize(
+    "component,env",
+    [
+        ("operator", {}),
+        ("partitioner", {}),
+        ("scheduler", {}),
+        ("tpuagent", {"NODE_NAME": "test-node"}),
+        ("sharingagent", {"NODE_NAME": "test-node"}),
+    ],
+)
+def test_component_starts_serves_health_and_stops(component, env):
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nos_tpu", component, "--health-port", str(port)],
+        cwd=REPO,
+        env={**os.environ, "PYTHONPATH": str(REPO), **env},
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        assert wait_health(port), (
+            f"{component} never became healthy: "
+            + proc.stderr.read1().decode(errors="replace")
+        )
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=10) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_agents_require_node_name():
+    for component in ("tpuagent", "sharingagent"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "nos_tpu", component],
+            cwd=REPO,
+            env={k: v for k, v in os.environ.items() if k != "NODE_NAME"},
+            capture_output=True,
+            timeout=30,
+        )
+        assert proc.returncode == 1
+        assert b"NODE_NAME" in proc.stderr
+
+
+class TestManifests:
+    def test_config_tree_is_valid_yaml(self):
+        files = sorted((REPO / "config").rglob("*.yaml"))
+        assert len(files) >= 8
+        for f in files:
+            for doc in yaml.safe_load_all(f.read_text()):
+                assert doc is None or isinstance(doc, dict), f
+
+    def test_crds_match_api_types(self):
+        eq = yaml.safe_load(
+            (REPO / "config/crd/bases/nos.nebuly.com_elasticquotas.yaml").read_text()
+        )
+        assert eq["spec"]["group"] == "nos.nebuly.com"
+        assert eq["spec"]["names"]["kind"] == "ElasticQuota"
+        assert eq["spec"]["names"]["shortNames"] == ["eq", "eqs"]
+        version = eq["spec"]["versions"][0]
+        props = version["schema"]["openAPIV3Schema"]["properties"]
+        assert set(props["spec"]["properties"]) == {"min", "max"}
+        assert "used" in props["status"]["properties"]
+
+        ceq = yaml.safe_load(
+            (
+                REPO / "config/crd/bases/nos.nebuly.com_compositeelasticquotas.yaml"
+            ).read_text()
+        )
+        assert ceq["spec"]["names"]["kind"] == "CompositeElasticQuota"
+        spec_props = ceq["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+            "properties"
+        ]["spec"]
+        assert set(spec_props["properties"]) == {"namespaces", "min", "max"}
+        assert spec_props["required"] == ["namespaces"]
+
+    def test_chart_static_files_parse(self):
+        chart = REPO / "helm-charts/nos-tpu"
+        meta = yaml.safe_load((chart / "Chart.yaml").read_text())
+        assert meta["name"] == "nos-tpu"
+        values = yaml.safe_load((chart / "values.yaml").read_text())
+        for component in (
+            "operator",
+            "partitioner",
+            "scheduler",
+            "tpuagent",
+            "sharingagent",
+            "metricsexporter",
+        ):
+            assert "enabled" in values[component], component
+        # CRDs in the chart stay in sync with the kustomize copies.
+        for crd in (chart / "crds").glob("*.yaml"):
+            assert (
+                crd.read_text()
+                == (REPO / "config/crd/bases" / crd.name).read_text()
+            ), f"{crd.name} diverged from config/crd/bases"
+
+    def test_chart_covers_every_component(self):
+        templates = REPO / "helm-charts/nos-tpu/templates"
+        rendered = "\n".join(
+            p.read_text() for p in templates.rglob("*.yaml")
+        ) + (templates / "NOTES.txt").read_text()
+        for component in (
+            "operator",
+            "partitioner",
+            "scheduler",
+            "tpuagent",
+            "sharingagent",
+            "metricsexporter",
+        ):
+            assert component in rendered, f"chart misses {component}"
+
+    def test_dockerfiles_exist_per_component(self):
+        for component in (
+            "operator",
+            "partitioner",
+            "scheduler",
+            "tpuagent",
+            "sharingagent",
+            "metricsexporter",
+        ):
+            dockerfile = REPO / "build" / component / "Dockerfile"
+            assert dockerfile.is_file(), component
+            assert "ENTRYPOINT" in dockerfile.read_text()
