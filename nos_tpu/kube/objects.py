@@ -158,3 +158,23 @@ class ConfigMap:
 
     def deepcopy(self) -> "ConfigMap":
         return copy.deepcopy(self)
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    """policy/v1 PDB subset the preemptor consults: a matchLabels selector
+    plus exactly one of minAvailable / maxUnavailable (absolute counts)."""
+
+    selector: Dict[str, str] = field(default_factory=dict)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    kind: str = "PodDisruptionBudget"
+
+    def deepcopy(self) -> "PodDisruptionBudget":
+        return copy.deepcopy(self)

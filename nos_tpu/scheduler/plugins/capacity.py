@@ -69,6 +69,11 @@ class ElasticQuotaInfo:
             if k in self.min
         )
 
+    def is_borrowing(self) -> bool:
+        """Using beyond guaranteed min for any tracked resource — the quota
+        is living on borrowed capacity (capacity_scheduling.go:566-581)."""
+        return any(self.used.get(k, 0) > v for k, v in self.min.items())
+
     def used_over_max_with(self, request: ResourceList) -> bool:
         if self.max is None:
             return False
@@ -140,6 +145,18 @@ class ElasticQuotaInfos:
             for i in self._infos.values()
         )
         return math.floor(info.min.get(resource, 0) / agg_min * unused)
+
+    def used_over_entitled(self, name: str) -> bool:
+        """used > min + guaranteed_overquota for any tracked resource: the
+        quota holds more than its fair entitlement and is preemptible by an
+        entitled borrower (capacity_scheduling.go:556-563)."""
+        info = self._infos.get(name)
+        if info is None:
+            return False
+        return any(
+            info.used.get(k, 0) > info.min.get(k, 0) + self.guaranteed_overquota(name, k)
+            for k in info.min
+        )
 
     def within_guaranteed_with(self, name: str, request: ResourceList) -> bool:
         """used+request ≤ min + guaranteed_overquota for every requested

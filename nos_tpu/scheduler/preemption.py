@@ -5,22 +5,127 @@ Victim selection per elastic-quota semantics (SelectVictimsOnNode,
 cross-quota victims must be running over-quota (label written by the
 operator) and the preemptor must still be within its guaranteed share
 (min + fair redistribution of unused min). The reprieve loop then re-adds
-victims (highest priority first) while the pod stays feasible, minimizing
-evictions; the reference's PDB-aware reprieve (:626-674) reduces to this
-without PodDisruptionBudgets.
+victims while the pod stays feasible, minimizing evictions, honoring
+PodDisruptionBudgets the way the reference does (:626-674): victims whose
+eviction would violate a PDB are reprieved first, and nodes are compared by
+fewest PDB violations before fewest evictions.
+
+TPU extension (SURVEY.md §7 hard part): victims are *units*, not pods. A
+multi-host gang (nos.nebuly.com/gang) holds one ICI slice across several
+nodes; evicting one member deadlocks the rest on their chips. So a gang is
+selected, reprieved, and evicted atomically — eviction cascades to members
+on other nodes, and the quota simulation frees the whole gang's usage.
 """
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from nos_tpu.kube.objects import Pod, PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError
 from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.scheduler.plugins.gang import gang_of
 from nos_tpu.util import metrics
 from nos_tpu.util import pod as podutil
 
 log = logging.getLogger("nos_tpu.scheduler.preemption")
+
+
+@dataclass
+class VictimUnit:
+    """The atom of preemption: one pod, or one gang's pods.
+
+    ``local`` are the members on the candidate node (they free node
+    capacity); ``members`` is the cluster-wide set (they all get evicted and
+    all free quota usage).
+    """
+
+    local: List[Pod]
+    members: List[Pod]
+    gang_key: Optional[str] = None
+
+    @property
+    def max_priority(self) -> int:
+        return max((p.spec.priority for p in self.members), default=0)
+
+    @property
+    def newest_creation(self) -> float:
+        return max((p.metadata.creation_timestamp for p in self.members), default=0.0)
+
+
+@dataclass
+class _NodeVictims:
+    units: List[VictimUnit]
+    num_pdb_violations: int
+
+    @property
+    def pods(self) -> List[Pod]:
+        return [p for u in self.units for p in u.members]
+
+
+class _PdbLedger:
+    """Tracks remaining allowed disruptions per PodDisruptionBudget.
+
+    Mirrors the reference's filterPodsWithPDBViolation: a victim "violates"
+    a PDB when, given the evictions already charged, the budget has run out.
+    """
+
+    def __init__(self, store: Optional[KubeStore]) -> None:
+        # [namespace, selector, remaining allowed disruptions] per PDB.
+        self._budgets: List[list] = []
+        if store is None:
+            return
+        pdbs = list(store.list("PodDisruptionBudget"))
+        pods_by_ns: Dict[str, list] = {}
+        for pdb in pdbs:
+            ns = pdb.metadata.namespace
+            if ns not in pods_by_ns:
+                pods_by_ns[ns] = list(store.list("Pod", namespace=ns))
+        for pdb in pdbs:
+            selector = dict(pdb.spec.selector)
+            matching = [
+                p
+                for p in pods_by_ns[pdb.metadata.namespace]
+                if selector.items() <= p.metadata.labels.items()
+            ]
+            healthy = sum(1 for p in matching if p.status.phase == PodPhase.RUNNING)
+            if pdb.spec.min_available is not None:
+                allowed = healthy - pdb.spec.min_available
+            elif pdb.spec.max_unavailable is not None:
+                # disruptionsAllowed = currentHealthy - desiredHealthy, with
+                # desiredHealthy = expected - maxUnavailable (policy/v1):
+                # already-unavailable pods consume the budget.
+                allowed = healthy - (len(matching) - pdb.spec.max_unavailable)
+            else:
+                allowed = healthy
+            self._budgets.append([pdb.metadata.namespace, selector, max(0, allowed)])
+
+    def clone(self) -> "_PdbLedger":
+        c = _PdbLedger(None)
+        c._budgets = [list(b) for b in self._budgets]
+        return c
+
+    def _matching(self, pod: Pod):
+        for budget in self._budgets:
+            ns, selector, _ = budget
+            if pod.metadata.namespace == ns and selector.items() <= pod.metadata.labels.items():
+                yield budget
+
+    def would_violate(self, unit: VictimUnit) -> bool:
+        charges: Dict[int, int] = {}
+        for pod in unit.members:
+            for budget in self._matching(pod):
+                key = id(budget)
+                charges[key] = charges.get(key, 0) + 1
+                if charges[key] > budget[2]:
+                    return True
+        return False
+
+    def charge(self, unit: VictimUnit) -> None:
+        for pod in unit.members:
+            for budget in self._matching(pod):
+                budget[2] = max(0, budget[2] - 1)
 
 
 class Preemptor:
@@ -28,6 +133,15 @@ class Preemptor:
         self.store = store
         self.plugin = plugin  # CapacityScheduling (provides .framework)
         self.infos = infos
+        # Quota requests in the simulation must be denominated exactly like
+        # the infos were built, or evict/restore drift (CapacitySchedulingArgs
+        # chip-memory knob).
+        self.chip_memory_gb = getattr(plugin, "chip_memory_gb", None)
+
+    def _quota_request(self, pod: Pod):
+        from nos_tpu.scheduler.plugins.capacity import quota_request
+
+        return quota_request(pod, self.chip_memory_gb)
 
     # ----------------------------------------------------------- entry
 
@@ -37,28 +151,36 @@ class Preemptor:
         framework = getattr(self.plugin, "framework", None)
         if framework is None:
             return None
-        best: Optional[Tuple[str, List[Pod]]] = None
+        best: Optional[Tuple[str, _NodeVictims]] = None
+        best_key = None
+        ledger = _PdbLedger(self.store)
         for node_name in sorted(filtered_nodes):
             node_info = self._node_info(node_name)
             if node_info is None:
                 continue
-            victims = self.select_victims_on_node(state, pod, node_info, framework)
+            victims = self.select_victims_on_node(
+                state, pod, node_info, framework, ledger=ledger.clone()
+            )
             if victims is None:
                 continue
-            key = (len(victims), max((v.spec.priority for v in victims), default=0))
-            if best is None or key < (
-                len(best[1]),
-                max((v.spec.priority for v in best[1]), default=0),
-            ):
-                best = (node_name, victims)
+            # Node comparison (reference pickOneNodeForPreemption order):
+            # fewest PDB violations, fewest evicted pods, lowest top victim
+            # priority.
+            key = (
+                victims.num_pdb_violations,
+                len(victims.pods),
+                max((v.spec.priority for v in victims.pods), default=0),
+            )
+            if best is None or key < best_key:
+                best, best_key = (node_name, victims), key
         if best is None:
             return None
         node_name, victims = best
-        for victim in victims:
+        for victim in victims.pods:
             log.info(
-                "preempting %s on %s for %s",
+                "preempting %s (node %s) for %s",
                 victim.namespaced_name,
-                node_name,
+                victim.spec.node_name or node_name,
                 pod.namespaced_name,
             )
             try:
@@ -71,12 +193,17 @@ class Preemptor:
     # ---------------------------------------------------------- victims
 
     def select_victims_on_node(
-        self, state: CycleState, pod: Pod, node_info: NodeInfo, framework
-    ) -> Optional[List[Pod]]:
-        eligible = [v for v in node_info.pods if self._eligible(pod, v)]
-        if not eligible:
+        self,
+        state: CycleState,
+        pod: Pod,
+        node_info: NodeInfo,
+        framework,
+        ledger: Optional[_PdbLedger] = None,
+    ) -> Optional[_NodeVictims]:
+        units = self._eligible_units(pod, node_info)
+        if not units:
             return None
-        from nos_tpu.scheduler.plugins.capacity import CapacityScheduling, quota_request
+        from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
 
         # Feasibility is node filters AND the quota admission re-evaluated
         # against simulated usage — a victim whose eviction only relieves
@@ -86,61 +213,147 @@ class Preemptor:
         def feasible(trial: NodeInfo) -> bool:
             if not framework.run_filter_plugins(state, pod, trial).success:
                 return False
-            return CapacityScheduling.check_quota(pod, sim_infos).success
+            return CapacityScheduling.check_quota(
+                pod, sim_infos, self.chip_memory_gb
+            ).success
 
-        def evict_sim(victim: Pod) -> None:
-            v_info = sim_infos.for_namespace(victim.metadata.namespace)
-            if v_info is not None:
-                v_info.remove_pod(victim.namespaced_name, quota_request(victim))
+        def evict_sim(unit: VictimUnit) -> None:
+            # The whole gang dies, so the whole gang's quota usage frees —
+            # including members on other nodes.
+            for victim in unit.members:
+                v_info = sim_infos.for_namespace(victim.metadata.namespace)
+                if v_info is not None:
+                    v_info.remove_pod(victim.namespaced_name, self._quota_request(victim))
 
-        def restore_sim(victim: Pod) -> None:
-            v_info = sim_infos.for_namespace(victim.metadata.namespace)
-            if v_info is not None:
-                v_info.add_pod(victim.namespaced_name, quota_request(victim))
+        def restore_sim(unit: VictimUnit) -> None:
+            for victim in unit.members:
+                v_info = sim_infos.for_namespace(victim.metadata.namespace)
+                if v_info is not None:
+                    v_info.add_pod(victim.namespaced_name, self._quota_request(victim))
 
         trial = NodeInfo(node=node_info.node, pods=list(node_info.pods))
-        for v in eligible:
-            trial.remove_pod(v)
-            evict_sim(v)
+        for unit in units:
+            for p in unit.local:
+                trial.remove_pod(p)
+            evict_sim(unit)
         if not feasible(trial):
             return None
-        # Reprieve: re-add victims (highest priority, then newest first)
-        # while the pod stays feasible.
-        victims: List[Pod] = []
-        for v in sorted(
-            eligible,
-            key=lambda p: (-p.spec.priority, -p.metadata.creation_timestamp),
+
+        # Reprieve (reference :626-674): PDB-violating units first, then the
+        # rest; within each class highest priority, then newest first. The
+        # classification pass charges the shared budgets cumulatively (the
+        # reference's filterPodsWithPDBViolation decrements pdbsAllowed as
+        # it walks), so two victims that individually fit a budget of one
+        # are correctly split into one non-violating and one violating.
+        if ledger is None:
+            ledger = _PdbLedger(self.store)
+        violating: List[VictimUnit] = []
+        non_violating: List[VictimUnit] = []
+        for unit in sorted(
+            units, key=lambda u: (-u.max_priority, -u.newest_creation)
         ):
-            trial.add_pod(v)
-            restore_sim(v)
+            if ledger.would_violate(unit):
+                violating.append(unit)
+            else:
+                ledger.charge(unit)
+                non_violating.append(unit)
+
+        victims: List[VictimUnit] = []
+        num_violations = 0
+        for unit, violates in [(u, True) for u in violating] + [
+            (u, False) for u in non_violating
+        ]:
+            for p in unit.local:
+                trial.add_pod(p)
+            restore_sim(unit)
             if feasible(trial):
                 continue  # reprieved
-            trial.remove_pod(v)
-            evict_sim(v)
-            victims.append(v)
-        return victims if victims else None
+            for p in unit.local:
+                trial.remove_pod(p)
+            evict_sim(unit)
+            victims.append(unit)
+            if violates:
+                # Count violating PODS (an 8-pod gang disrupts 8), matching
+                # the reference's pickOneNodeForPreemption comparison.
+                num_violations += len(unit.members)
+        if not victims:
+            return None
+        return _NodeVictims(units=victims, num_pdb_violations=num_violations)
+
+    # ------------------------------------------------------------ units
+
+    def _eligible_units(self, preemptor: Pod, node_info: NodeInfo) -> List[VictimUnit]:
+        """Group the node's pods into atomic victim units; a unit is
+        eligible only if every one of its cluster-wide members is (a gang
+        cannot be half-evicted)."""
+        singles: List[Pod] = []
+        gangs: Dict[str, List[Pod]] = {}
+        for p in node_info.pods:
+            gang = gang_of(p)
+            if gang is None:
+                singles.append(p)
+            else:
+                gangs.setdefault(gang[0], []).append(p)
+
+        units: List[VictimUnit] = []
+        for p in singles:
+            if self._eligible(preemptor, p):
+                units.append(VictimUnit(local=[p], members=[p]))
+        for key, local in gangs.items():
+            members = self._gang_members(key)
+            if members and all(self._eligible(preemptor, m) for m in members):
+                units.append(VictimUnit(local=local, members=members, gang_key=key))
+        return units
+
+    def _gang_members(self, gang_key: str) -> List[Pod]:
+        # Membership via gang_of, matching _eligible_units' grouping: a pod
+        # with a gang name but a malformed size is NOT a member (it schedules
+        # solo), so it can never sit in two victim units at once.
+        ns, _ = gang_key.split("/", 1)
+        members = []
+        for p in self.store.list("Pod", namespace=ns):
+            gang = gang_of(p)
+            if (
+                gang is not None
+                and gang[0] == gang_key
+                and p.spec.node_name
+                and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            ):
+                members.append(p)
+        return members
 
     def _eligible(self, preemptor: Pod, victim: Pod) -> bool:
+        """Mirrors the reference's SelectVictimsOnNode eligibility branches
+        (capacity_scheduling.go:512-598), keyed on whether serving the
+        preemptor would take its quota over min."""
         if victim.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
             return False
         p_info = self.infos.for_namespace(preemptor.metadata.namespace)
         v_info = self.infos.for_namespace(victim.metadata.namespace)
-        same_quota = (
-            p_info is not None and v_info is not None and p_info.name == v_info.name
-        ) or (p_info is None and v_info is None and
-              preemptor.metadata.namespace == victim.metadata.namespace)
-        if same_quota:
-            # Intra-quota: plain priority preemption (:468-541).
-            return victim.spec.priority < preemptor.spec.priority
-        # Cross-quota: only over-quota (borrowed) capacity is reclaimable,
-        # and only by a preemptor still entitled to guaranteed capacity.
-        if not podutil.is_over_quota(victim):
-            return False
         if p_info is None:
+            # Preemptor outside any quota: plain priority preemption among
+            # non-quota pods only (:585-598).
+            return v_info is None and victim.spec.priority < preemptor.spec.priority
+        if v_info is None:
             return False
-        from nos_tpu.scheduler.plugins.capacity import quota_request
-
-        return self.infos.within_guaranteed_with(p_info.name, quota_request(preemptor))
+        request = self._quota_request(preemptor)
+        if p_info.used_over_min_with(request):
+            # Preemptor would borrow: same-quota lower-priority victims
+            # (:536-541); cross-quota over-quota pods, but only while the
+            # preemptor stays within min + its guaranteed fair share and
+            # the victim's quota exceeds its own (:543-564).
+            if v_info.name == p_info.name:
+                return victim.spec.priority < preemptor.spec.priority
+            if not podutil.is_over_quota(victim):
+                return False
+            return self.infos.within_guaranteed_with(
+                p_info.name, request
+            ) and self.infos.used_over_entitled(v_info.name)
+        # Preemptor within guaranteed min: its capacity is being borrowed —
+        # reclaim from any borrowing quota's over-quota pods (:566-581).
+        if v_info.name == p_info.name:
+            return False
+        return podutil.is_over_quota(victim) and v_info.is_borrowing()
 
     # ----------------------------------------------------------- helpers
 
