@@ -116,7 +116,12 @@ def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _attention(
-    x: jax.Array, layer: Params, config: LlamaConfig, cos: jax.Array, sin: jax.Array
+    x: jax.Array,
+    layer: Params,
+    config: LlamaConfig,
+    cos: jax.Array,
+    sin: jax.Array,
+    mesh=None,
 ) -> jax.Array:
     c = config
     b, s, _ = x.shape
@@ -127,6 +132,13 @@ def _attention(
 
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
+
+    if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        # Sequence-parallel path: exact blockwise attention with K/V blocks
+        # rotating over the sp ring (nos_tpu/parallel/ring_attention.py).
+        from nos_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh, causal=True) @ layer["wo"]
 
     # GQA: expand kv heads to query heads by grouping queries.
     group = c.n_heads // c.n_kv_heads
@@ -143,25 +155,38 @@ def _mlp(x: jax.Array, layer: Params) -> jax.Array:
     return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
 
 
-def llama_forward(params: Params, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] (float32)."""
+def llama_forward(
+    params: Params, tokens: jax.Array, config: LlamaConfig, mesh=None
+) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (float32).
+
+    With a mesh carrying an ``sp`` axis >1, attention runs sequence-parallel
+    via ring attention; everything else is identical (XLA shards the
+    elementwise/matmul ops along S from the data sharding).
+    """
     c = config
     x = params["embed"][tokens]
     # Position tables depend only on (seq_len, head_dim): one per forward.
     cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype)
     for layer in params["layers"]:
         x = x + _attention(
-            _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin
+            _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin, mesh
         )
         x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
-def llama_loss(params: Params, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
-    """Next-token cross entropy over shifted tokens."""
-    logits = llama_forward(params, tokens[:, :-1], config)
+def llama_loss(
+    params: Params, tokens: jax.Array, config: LlamaConfig, mesh=None
+) -> jax.Array:
+    """Next-token cross entropy over shifted tokens.
+
+    The forward runs on the FULL sequence (keeping S divisible by the sp
+    axis) and the final position's logits are dropped from the loss.
+    """
+    logits = llama_forward(params, tokens, config, mesh)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)

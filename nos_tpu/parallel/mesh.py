@@ -30,6 +30,23 @@ def mesh_from_devices(
     return Mesh(grid, tuple(axis_names))
 
 
+def default_training_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """('dp','sp','tp') mesh over the available devices.
+
+    tp takes the innermost (contiguous-ICI) position, sp the next ring, and
+    the remainder folds into dp — the ordering that keeps tensor-parallel
+    all-reduces and ring-attention neighbor exchanges on the fastest links.
+    Axes that don't divide the device count collapse to 1.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    tp = 2 if n % 2 == 0 else 1
+    rem = n // tp
+    sp = 2 if rem % 2 == 0 else 1
+    dp = rem // sp
+    return mesh_from_devices((dp, sp, tp), ("dp", "sp", "tp"), devices)
+
+
 def mesh_for_slice(
     topology: str,
     dp: Optional[int] = None,

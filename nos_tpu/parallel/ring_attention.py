@@ -1,0 +1,148 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context sequence parallelism for the JAX workloads this suite
+schedules (SURVEY.md maps nos's scale axis to TPU slice topology; the
+workload-side counterpart is sequence sharding so one carved slice can
+train contexts larger than a single chip's HBM).
+
+The sequence axis is block-distributed over the ``sp`` mesh axis. Each
+device keeps its query block resident and the K/V blocks rotate around the
+ring via ``lax.ppermute`` (neighbor exchanges ride contiguous ICI, never
+DCN); softmax is accumulated online (running max / normalizer / weighted
+sum, the Milakov-Gimelshein scheme), so the full [S, S] score matrix never
+materializes and memory stays O(S·S/n) per chip. Compute is exact — the
+result matches dense attention to float tolerance.
+
+Composes with tensor parallelism: heads shard over ``tp``, so the shard_map
+block sees [B/dp, S/sp, H/tp, hd] and the ring math is unchanged.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal):
+    """One ring step: fold the current K/V block into the accumulators.
+
+    q [B,Sq,Kv,g,hd] grouped queries; k/v [B,Skv,Kv,hd]; accumulators in
+    float32: m,l [B,Kv,g,Sq], acc [B,Kv,g,Sq,hd].
+    """
+    hd = q.shape[-1]
+    scores = jnp.einsum(
+        "bsKgh,btKh->bKgst", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(hd)
+    if causal:
+        sq, skv = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(sq)
+        kv_pos = kv_offset + jnp.arange(skv)
+        mask = kv_pos[None, :] <= q_pos[:, None]  # [Sq, Skv]
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+
+    block_max = jnp.max(scores, axis=-1)  # [B,Kv,g,Sq]
+    new_m = jnp.maximum(m, block_max)
+    # Rows fully masked so far have new_m = -inf; exp against 0 keeps the
+    # masked probabilities at exp(-inf)=0 instead of exp(nan).
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    probs = jnp.exp(scores - safe_m[..., None])  # [B,Kv,g,Sq,Skv]
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    new_l = l * correction + jnp.sum(probs, axis=-1)
+    new_acc = acc * correction[..., None] + jnp.einsum(
+        "bKgst,btKh->bKgsh", probs, v.astype(jnp.float32)
+    )
+    return new_m, new_l, new_acc
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: bool):
+    """The per-device block: local q stays, k/v rotate around the ring.
+
+    ``n_shards`` is static (the mesh axis size) so the ring unrolls into a
+    scan with a known trip count — reverse-mode AD flows through the
+    ppermutes (their transpose is the reverse permute).
+    """
+    n = n_shards
+    my_idx = jax.lax.axis_index(axis_name)
+    b, sq, n_q_heads, hd = q.shape
+    n_kv_heads = k.shape[2]
+    group = n_q_heads // n_kv_heads
+    qg = q.reshape(b, sq, n_kv_heads, group, hd)
+
+    m0 = jnp.full((b, n_kv_heads, group, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, n_kv_heads, group, sq), jnp.float32)
+    acc0 = jnp.zeros((b, n_kv_heads, group, sq, hd), jnp.float32)
+    q_offset = my_idx * sq
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def update(k_blk, v_blk, m, l, acc, kv_idx):
+        def run():
+            return _online_block_update(
+                qg, k_blk, v_blk, m, l, acc, q_offset, kv_idx * k_blk.shape[1], causal
+            )
+
+        if not causal:
+            return run()
+        # Fully-future blocks are entirely masked: skip their FLOPs inside
+        # the cond (the ring stays synchronous, so this saves compute, not
+        # steps).
+        return jax.lax.cond(kv_idx > my_idx, lambda: (m, l, acc), run)
+
+    # Own block first, then n-1 permute-and-update rounds: the last
+    # exchanged block is consumed, never a wasted hop.
+    m, l, acc = update(k, v, m0, l0, acc0, my_idx)
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, acc = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        # Block i arrived from i ring hops upstream.
+        m, l, acc = update(k_blk, v_blk, m, l, acc, (my_idx - i) % n)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, m, l, acc), jnp.arange(1, n), length=n - 1
+    )
+    out = acc / l[..., None]  # causal rows always see their own position
+    # [B,Kv,g,Sq,hd] -> [B,Sq,Hq*hd]
+    out = jnp.moveaxis(out, 3, 1).reshape(b, sq, n_q_heads * hd)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = "tp",
+) -> jax.Array:
+    """Exact attention with q/k/v [B, S, H, hd] sequence-sharded over
+    ``axis_name``. Returns [B, S, Hq·hd]. Axis names absent from the mesh
+    are ignored, so the same call works on ('dp','tp'), ('sp',), or
+    ('dp','sp','tp') meshes.
+    """
+    names = mesh.axis_names
+    ba = batch_axis if batch_axis in names else None
+    sa = axis_name if axis_name in names else None
+    ha = head_axis if head_axis in names else None
+    if sa is None:
+        raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
+    qkv_spec = P(ba, sa, ha, None)
+    out_spec = P(ba, sa, ha)
+    fn = partial(
+        _ring_attention_local, axis_name=sa, n_shards=mesh.shape[sa], causal=causal
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )(q, k, v)

@@ -42,5 +42,8 @@ def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
 
 
 def llama_data_sharding(mesh: Mesh) -> NamedSharding:
-    """Tokens [B, S]: batch over dp."""
+    """Tokens [B, S]: batch over dp; sequence over sp when the mesh has it
+    (ring attention consumes the same block distribution)."""
+    if "sp" in mesh.axis_names:
+        return _ns(mesh, "dp", "sp")
     return _ns(mesh, "dp", None)

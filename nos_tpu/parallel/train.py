@@ -30,7 +30,7 @@ def make_train_step(mesh: Mesh, config: LlamaConfig, learning_rate: float = 1e-3
     state_sharding = (param_sharding, param_sharding)
 
     def loss_fn(params, tokens):
-        return llama_loss(params, tokens, config)
+        return llama_loss(params, tokens, config, mesh)
 
     @partial(
         jax.jit,
