@@ -1,0 +1,68 @@
+"""Benchmark client: saturates a TPU slice/share with inference requests.
+
+The TPU analogue of the reference's benchmarks client
+(demos/gpu-sharing-comparison/client/main.py): a loop that constantly runs
+inference on a small vision model and records per-inference latency. The
+reference exports to Prometheus; here latencies stream to stdout as JSON
+lines (one summary line every WINDOW seconds) so the harness — or a
+PodMonitor sidecar — can scrape them.
+
+Runs identically on a carved slice (google.com/tpu-slice-*), an HBM
+fraction (google.com/tpu-mem-*gb), or a time-shared chip: the resource
+request in the Pod manifest is the only difference, which is the point of
+the comparison.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    window = float(os.environ.get("REPORT_WINDOW_SECONDS", "10"))
+    batch = int(os.environ.get("BATCH_SIZE", "8"))
+    image = int(os.environ.get("IMAGE_SIZE", "224"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from nos_tpu.models.resnet import (
+        init_resnet_params,
+        resnet_forward,
+        tiny_resnet_config,
+    )
+
+    config = tiny_resnet_config()
+    params = init_resnet_params(jax.random.key(0), config)
+    images = jnp.zeros((batch, image, image, 3), jnp.float32)
+    infer = jax.jit(lambda p, x: resnet_forward(p, x, config))
+    jax.block_until_ready(infer(params, images))  # compile outside the loop
+
+    latencies: list = []
+    window_start = time.monotonic()
+    while True:
+        start = time.monotonic()
+        jax.block_until_ready(infer(params, images))
+        latencies.append(time.monotonic() - start)
+        now = time.monotonic()
+        if now - window_start >= window:
+            print(
+                json.dumps(
+                    {
+                        "backend": jax.default_backend(),
+                        "inferences": len(latencies),
+                        "avg_s": statistics.fmean(latencies),
+                        "p50_s": statistics.median(latencies),
+                    }
+                ),
+                flush=True,
+            )
+            latencies.clear()
+            window_start = now
+
+
+if __name__ == "__main__":
+    sys.exit(main())

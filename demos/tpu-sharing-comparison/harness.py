@@ -1,0 +1,107 @@
+"""Local sharing-comparison harness: contention curves on one accelerator.
+
+Mirrors the reference's experiment (demos/gpu-sharing-comparison/README.md):
+average inference time of a small vision model vs number of workloads
+sharing one device, under each sharing discipline this framework's
+partitioner can actuate:
+
+- ``time-shared``  N workers submit concurrently to the same device with no
+  isolation — latency degrades roughly linearly with N (the reference's
+  time-slicing row).
+- ``partitioned``  each worker runs in its own exclusive turn, modeling the
+  hard isolation a carved slice / HBM fraction gives — per-inference
+  latency stays flat regardless of N (the reference's MIG row; real
+  slice isolation needs the operator on a cluster, see README).
+
+Usage: python harness.py [--pods 1,3,5,7] [--seconds 5]
+Prints a markdown table like the reference's results table.
+"""
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+import threading
+import time
+
+
+def build_infer():
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, __file__.rsplit("/demos/", 1)[0])
+    from nos_tpu.models.resnet import (
+        init_resnet_params,
+        resnet_forward,
+        tiny_resnet_config,
+    )
+
+    config = tiny_resnet_config()
+    params = init_resnet_params(jax.random.key(0), config)
+    images = jnp.zeros((8, 224, 224, 3), jnp.float32)
+    infer = jax.jit(lambda x: resnet_forward(params, x, config))
+    jax.block_until_ready(infer(images))
+    return jax, infer, images
+
+
+def timed_loop(jax, infer, images, stop_at: float, out: list) -> None:
+    while time.monotonic() < stop_at:
+        start = time.monotonic()
+        jax.block_until_ready(infer(images))
+        out.append(time.monotonic() - start)
+
+
+def run_time_shared(jax, infer, images, n: int, seconds: float) -> float:
+    """N concurrent workers contending for the device."""
+    stop_at = time.monotonic() + seconds
+    results: list = [[] for _ in range(n)]
+    threads = [
+        threading.Thread(target=timed_loop, args=(jax, infer, images, stop_at, results[i]))
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    all_lat = [x for r in results for x in r]
+    return statistics.fmean(all_lat) if all_lat else float("nan")
+
+
+def run_partitioned(jax, infer, images, n: int, seconds: float) -> float:
+    """Each worker gets an exclusive, isolated execution turn."""
+    all_lat: list = []
+    for _ in range(n):
+        out: list = []
+        timed_loop(jax, infer, images, time.monotonic() + seconds / n, out)
+        all_lat.extend(out)
+    return statistics.fmean(all_lat) if all_lat else float("nan")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pods", default="1,3,5,7")
+    parser.add_argument("--seconds", type=float, default=5.0)
+    args = parser.parse_args()
+    pod_counts = [int(x) for x in args.pods.split(",")]
+
+    jax, infer, images = build_infer()
+    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+
+    rows = {}
+    for mode, runner in (("time-shared", run_time_shared), ("partitioned", run_partitioned)):
+        rows[mode] = {}
+        for n in pod_counts:
+            rows[mode][n] = runner(jax, infer, images, n, args.seconds)
+            print(f"{mode} x{n}: {rows[mode][n]:.4f}s", file=sys.stderr)
+
+    header = "| mode | " + " | ".join(f"{n} pods" for n in pod_counts) + " |"
+    sep = "|---" * (len(pod_counts) + 1) + "|"
+    print(header)
+    print(sep)
+    for mode in rows:
+        cells = " | ".join(f"{rows[mode][n]:.4f}" for n in pod_counts)
+        print(f"| {mode} | {cells} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -137,11 +137,21 @@ class Preemptor:
         # the infos were built, or evict/restore drift (CapacitySchedulingArgs
         # chip-memory knob).
         self.chip_memory_gb = getattr(plugin, "chip_memory_gb", None)
+        # Per-cycle caches: store/infos are fixed for one preemption cycle,
+        # so request aggregation, entitlement math, and gang membership are
+        # computed once, not per victim per node.
+        self._request_cache: Dict[str, dict] = {}
+        self._entitled_cache: Dict[str, bool] = {}
+        self._victim_quota_cache: Dict[str, bool] = {}
+        self._gang_cache: Dict[str, List[Pod]] = {}
 
     def _quota_request(self, pod: Pod):
         from nos_tpu.scheduler.plugins.capacity import quota_request
 
-        return quota_request(pod, self.chip_memory_gb)
+        key = pod.namespaced_name
+        if key not in self._request_cache:
+            self._request_cache[key] = quota_request(pod, self.chip_memory_gb)
+        return self._request_cache[key]
 
     # ----------------------------------------------------------- entry
 
@@ -252,11 +262,12 @@ class Preemptor:
         for unit in sorted(
             units, key=lambda u: (-u.max_priority, -u.newest_creation)
         ):
-            if ledger.would_violate(unit):
-                violating.append(unit)
-            else:
-                ledger.charge(unit)
-                non_violating.append(unit)
+            violates = ledger.would_violate(unit)
+            # Budgets are charged unconditionally (clamped at zero), like the
+            # reference's filterPodsWithPDBViolation: a violating victim that
+            # matches several PDBs still consumes the ones with room left.
+            ledger.charge(unit)
+            (violating if violates else non_violating).append(unit)
 
         victims: List[VictimUnit] = []
         num_violations = 0
@@ -308,7 +319,10 @@ class Preemptor:
     def _gang_members(self, gang_key: str) -> List[Pod]:
         # Membership via gang_of, matching _eligible_units' grouping: a pod
         # with a gang name but a malformed size is NOT a member (it schedules
-        # solo), so it can never sit in two victim units at once.
+        # solo), so it can never sit in two victim units at once. Cached for
+        # the cycle — the same gang shows up on every candidate node.
+        if gang_key in self._gang_cache:
+            return self._gang_cache[gang_key]
         ns, _ = gang_key.split("/", 1)
         members = []
         for p in self.store.list("Pod", namespace=ns):
@@ -320,6 +334,7 @@ class Preemptor:
                 and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
             ):
                 members.append(p)
+        self._gang_cache[gang_key] = members
         return members
 
     def _eligible(self, preemptor: Pod, victim: Pod) -> bool:
@@ -346,9 +361,15 @@ class Preemptor:
                 return victim.spec.priority < preemptor.spec.priority
             if not podutil.is_over_quota(victim):
                 return False
-            return self.infos.within_guaranteed_with(
-                p_info.name, request
-            ) and self.infos.used_over_entitled(v_info.name)
+            if p_info.name not in self._entitled_cache:
+                self._entitled_cache[p_info.name] = self.infos.within_guaranteed_with(
+                    p_info.name, request
+                )
+            if v_info.name not in self._victim_quota_cache:
+                self._victim_quota_cache[v_info.name] = self.infos.used_over_entitled(
+                    v_info.name
+                )
+            return self._entitled_cache[p_info.name] and self._victim_quota_cache[v_info.name]
         # Preemptor within guaranteed min: its capacity is being borrowed —
         # reclaim from any borrowing quota's over-quota pods (:566-581).
         if v_info.name == p_info.name:
