@@ -168,8 +168,24 @@ def run_model_step_bench() -> None:
             f"model step ({jax.default_backend()}): {step_ms:.2f} ms "
             f"(tiny llama fwd, batch 8 x 128)"
         )
+
     except Exception as e:  # pragma: no cover - accelerator quirks
         log(f"model step bench skipped: {type(e).__name__}: {e}")
+        return
+
+    try:
+        flash_config = tiny_config(attention="flash")
+        fwd_flash = jax.jit(lambda p, t: llama_forward(p, t, flash_config))
+        jax.block_until_ready(fwd_flash(params, tokens))
+        start = time.monotonic()
+        for _ in range(iters):
+            out = fwd_flash(params, tokens)
+        jax.block_until_ready(out)
+        log(
+            f"model step flash-attn pallas: {(time.monotonic() - start) / iters * 1000:.2f} ms"
+        )
+    except Exception as e:  # pragma: no cover - pallas needs tpu or interpret
+        log(f"flash-attn step skipped: {type(e).__name__}: {e}")
 
 
 def main() -> None:

@@ -30,6 +30,9 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # "dense" (XLA einsum) or "flash" (Pallas kernel, nos_tpu/ops/ —
+    # forward-only, for inference/serving paths).
+    attention: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -139,6 +142,15 @@ def _attention(
         from nos_tpu.parallel.ring_attention import ring_attention
 
         return ring_attention(q, k, v, mesh, causal=True) @ layer["wo"]
+
+    if c.attention == "flash":
+        # Single-chip blockwise attention on the MXU (nos_tpu/ops/).
+        from nos_tpu.ops import flash_attention
+
+        out = flash_attention(
+            q, k, v, causal=True, interpret=jax.default_backend() == "cpu"
+        )
+        return out.reshape(b, s, c.n_heads * hd) @ layer["wo"]
 
     # GQA: expand kv heads to query heads by grouping queries.
     group = c.n_heads // c.n_kv_heads

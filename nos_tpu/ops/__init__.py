@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops of the JAX workloads.
+
+The reference suite has no compute kernels (it is a Kubernetes operator,
+SURVEY.md §5); these belong to the TPU build's workload side — the models
+the partitioner places onto carved slices. Kernels follow the
+HBM→VMEM→MXU dataflow: blocks staged into VMEM by BlockSpecs, matmuls on
+the MXU in float32 accumulation, elementwise work on the VPU.
+"""
+from nos_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,143 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise exact attention (the same online-softmax math as
+nos_tpu/parallel/ring_attention.py, but within one chip): the [S, S] score
+matrix never leaves VMEM — each grid step holds one query block and streams
+key/value blocks through the MXU, keeping running max / normalizer /
+accumulator in float32. Memory per step is O(blk_q·S + S·hd) VMEM instead
+of O(S²) HBM, and the matmuls are MXU-shaped (last dim 128-padded by the
+caller's head_dim choice).
+
+Grid: (batch, q_heads, S/blk_q). GQA is free — the K/V BlockSpec index_map
+sends query head h to kv head h // group, so kv blocks are fetched once per
+group without materializing the expanded heads.
+
+Forward-only: wrap in jax.custom_vjp with a recompute backward before using
+under grad (the dense path remains the training default; this kernel serves
+inference and serving benches).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool, scale: float):
+    q = q_ref[0, 0].astype(jnp.float32)  # [blk_q, hd]
+    blk_q = q.shape[0]
+    seq_len = k_ref.shape[2]
+    n_kv_blocks = seq_len // blk_k
+    q_start = pl.program_id(2) * blk_q
+
+    m0 = jnp.full((blk_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    acc0 = jnp.zeros((blk_q, q.shape[1]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [blk_q, blk_k]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            kv_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(kv_pos <= q_pos, s, -jnp.inf)
+        blk_max = jnp.max(s, axis=1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(s - safe_m)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return new_m, l, acc
+
+    if causal:
+        # Blocks fully in the future contribute nothing: stop the stream at
+        # the last block intersecting this query block's causal frontier.
+        upper = jax.lax.div(q_start + blk_q + blk_k - 1, blk_k)
+        upper = jnp.minimum(upper, n_kv_blocks)
+    else:
+        upper = n_kv_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _divisor_block(s: int, blk: int) -> int:
+    """Largest divisor of s that is <= blk."""
+    blk = min(blk, s)
+    while s % blk:
+        blk -= 1
+    return blk
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    blk_q: int = 128,
+    blk_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """q [B, S, Hq, hd], k/v [B, S, Hkv, hd] → [B, S, Hq, hd].
+
+    Hq must be a multiple of Hkv (GQA). S must divide by the block sizes
+    (block sizes clamp down to S for short sequences).
+    """
+    b, s, hq, hd = q.shape
+    hkv = k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    # Clamp block sizes to the largest divisor of S: arbitrary prompt
+    # lengths work, power-of-two lengths keep full MXU-shaped blocks.
+    blk_q = _divisor_block(s, blk_q)
+    blk_k = _divisor_block(s, blk_k)
+
+    # [B, H, S, hd] puts (sequence, head_dim) in the tiled trailing dims.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel, blk_k=blk_k, causal=causal, scale=1.0 / math.sqrt(hd)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, hq, s // blk_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, blk_q, hd),
+                lambda bi, hi, qi: (bi, hi, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, s, hd),
+                lambda bi, hi, qi: (bi, hi // group, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, s, hd),
+                lambda bi, hi, qi: (bi, hi // group, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, blk_q, hd),
+            lambda bi, hi, qi: (bi, hi, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hq, s, hd), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
