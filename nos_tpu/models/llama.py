@@ -33,6 +33,25 @@ class LlamaConfig:
     # "dense" (XLA einsum) or "flash" (Pallas kernel, nos_tpu/ops/ —
     # forward-only, for inference/serving paths).
     attention: str = "dense"
+    # n_experts > 0 swaps every MLP for a routed mixture-of-experts
+    # (nos_tpu/models/moe.py) with experts sharded over the ep mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # Weight of the Switch-style load-balancing loss in llama_loss.
+    moe_aux_coef: float = 0.01
+
+    def moe_config(self):
+        from nos_tpu.models.moe import MoeConfig
+
+        return MoeConfig(
+            d_model=self.d_model,
+            d_ff=self.d_ff,
+            n_experts=self.n_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            dtype=self.dtype,
+        )
 
     @property
     def head_dim(self) -> int:
@@ -78,19 +97,25 @@ def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
     }
     hd = c.head_dim
     for _ in range(c.n_layers):
-        params["layers"].append(
-            {
-                "attn_norm": jnp.ones((c.d_model,), c.dtype),
-                "wq": dense(next(keys), (c.d_model, c.n_heads * hd), c.d_model),
-                "wk": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
-                "wv": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
-                "wo": dense(next(keys), (c.n_heads * hd, c.d_model), c.n_heads * hd),
-                "mlp_norm": jnp.ones((c.d_model,), c.dtype),
-                "w_gate": dense(next(keys), (c.d_model, c.d_ff), c.d_model),
-                "w_up": dense(next(keys), (c.d_model, c.d_ff), c.d_model),
-                "w_down": dense(next(keys), (c.d_ff, c.d_model), c.d_ff),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((c.d_model,), c.dtype),
+            "wq": dense(next(keys), (c.d_model, c.n_heads * hd), c.d_model),
+            "wk": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
+            "wv": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
+            "wo": dense(next(keys), (c.n_heads * hd, c.d_model), c.n_heads * hd),
+            "mlp_norm": jnp.ones((c.d_model,), c.dtype),
+        }
+        if c.n_experts > 0:
+            from nos_tpu.models.moe import init_moe_params
+
+            layer["moe"] = init_moe_params(next(keys), c.moe_config())
+            # consume the unused dense-mlp keys to keep layer streams stable
+            next(keys), next(keys)
+        else:
+            layer["w_gate"] = dense(next(keys), (c.d_model, c.d_ff), c.d_model)
+            layer["w_up"] = dense(next(keys), (c.d_model, c.d_ff), c.d_model)
+            layer["w_down"] = dense(next(keys), (c.d_ff, c.d_model), c.d_ff)
+        params["layers"].append(layer)
     return params
 
 
@@ -168,25 +193,47 @@ def _mlp(x: jax.Array, layer: Params) -> jax.Array:
 
 
 def llama_forward(
-    params: Params, tokens: jax.Array, config: LlamaConfig, mesh=None
-) -> jax.Array:
+    params: Params,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh=None,
+    with_aux: bool = False,
+):
     """tokens [B, S] int32 → logits [B, S, vocab] (float32).
 
     With a mesh carrying an ``sp`` axis >1, attention runs sequence-parallel
     via ring attention; everything else is identical (XLA shards the
-    elementwise/matmul ops along S from the data sharding).
+    elementwise/matmul ops along S from the data sharding). ``with_aux``
+    additionally returns the summed MoE load-balancing loss (0 for dense).
     """
     c = config
     x = params["embed"][tokens]
     # Position tables depend only on (seq_len, head_dim): one per forward.
     cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype)
+    aux_total = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         x = x + _attention(
             _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin, mesh
         )
-        x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
+        h = _rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        if "moe" in layer:
+            from nos_tpu.models.moe import moe_mlp
+
+            if with_aux:
+                delta, aux = moe_mlp(
+                    layer["moe"], h, c.moe_config(), mesh, return_aux=True
+                )
+                aux_total = aux_total + aux
+            else:
+                delta = moe_mlp(layer["moe"], h, c.moe_config(), mesh)
+            x = x + delta
+        else:
+            x = x + _mlp(h, layer)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if with_aux:
+        return logits, aux_total
+    return logits
 
 
 def llama_loss(
@@ -197,8 +244,13 @@ def llama_loss(
     The forward runs on the FULL sequence (keeping S divisible by the sp
     axis) and the final position's logits are dropped from the loss.
     """
-    logits = llama_forward(params, tokens, config, mesh)
+    logits, aux = llama_forward(params, tokens, config, mesh, with_aux=True)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    loss = jnp.mean(nll)
+    if config.n_experts > 0:
+        # Average the per-layer balance losses; keeps routing spread so the
+        # static expert capacity stays effective.
+        loss = loss + config.moe_aux_coef * aux / max(1, config.n_layers)
+    return loss

@@ -1,0 +1,145 @@
+"""Mixture-of-experts MLP with expert parallelism.
+
+TPU-first MoE: routing is top-k with a STATIC per-expert capacity (XLA
+needs static shapes — overflow tokens are dropped, the standard
+Switch/GShard discipline), dispatch/combine are scatter/gather einsums the
+compiler lays out as all-to-alls when the expert dimension is sharded, and
+the expert FFNs run as one batched einsum over stacked weights so the MXU
+sees [E·C, d]×[d, f] tiles instead of E small matmuls.
+
+Sharding: stacked expert weights and the [E, C, d] dispatch buffer shard
+their leading dim over the ``ep`` mesh axis (each device owns E/ep
+experts); the hidden dim can additionally shard over ``tp``. Constraints
+are annotated — XLA inserts the token all-to-all across ep.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+
+def capacity_per_expert(n_tokens: int, config: MoeConfig) -> int:
+    """Static buffer depth per expert: ceil(k·T/E · factor), min 1."""
+    c = config
+    return max(
+        1, math.ceil(c.top_k * n_tokens / c.n_experts * c.capacity_factor)
+    )
+
+
+def init_moe_params(key: jax.Array, config: MoeConfig) -> Params:
+    c = config
+    k_router, k_gate, k_up, k_down = jax.random.split(key, 4)
+
+    def dense(k, shape, scale_dim):
+        return (
+            jax.random.normal(k, shape, jnp.float32) / math.sqrt(scale_dim)
+        ).astype(c.dtype)
+
+    return {
+        # Router stays float32: routing decisions are precision-sensitive.
+        "router": jax.random.normal(k_router, (c.d_model, c.n_experts), jnp.float32)
+        / math.sqrt(c.d_model),
+        "w_gate": dense(k_gate, (c.n_experts, c.d_model, c.d_ff), c.d_model),
+        "w_up": dense(k_up, (c.n_experts, c.d_model, c.d_ff), c.d_model),
+        "w_down": dense(k_down, (c.n_experts, c.d_ff, c.d_model), c.d_ff),
+    }
+
+
+def moe_param_sharding(mesh, config: MoeConfig) -> Params:
+    """NamedShardings: experts over ep, hidden over tp, router replicated.
+    Axes missing from the mesh fall back to replication (partition_spec)."""
+    from nos_tpu.parallel.mesh import partition_spec as ps
+
+    return {
+        "router": NamedSharding(mesh, P()),
+        "w_gate": NamedSharding(mesh, ps(mesh, "ep", None, "tp")),
+        "w_up": NamedSharding(mesh, ps(mesh, "ep", None, "tp")),
+        "w_down": NamedSharding(mesh, ps(mesh, "ep", "tp", None)),
+    }
+
+
+def moe_mlp(
+    params: Params,
+    x: jax.Array,
+    config: MoeConfig,
+    mesh: Optional[Any] = None,
+    return_aux: bool = False,
+):
+    """x [B, S, d] → [B, S, d] through top-k routed experts.
+
+    With ``return_aux``, also returns the Switch-style load-balancing loss
+    ``E · Σ_e f_e · P_e`` (dispatch fraction × mean router probability per
+    expert) — add it to the training loss or the router collapses onto few
+    experts and static capacity drops most tokens.
+    """
+    c = config
+    b, s, d = x.shape
+    t = b * s
+    cap = capacity_per_expert(t, c)
+    flat = x.reshape(t, d)
+
+    # ---- routing (float32)
+    logits = flat.astype(jnp.float32) @ params["router"]  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, c.top_k)  # [T, k]
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    # ---- static-capacity positions: pair (token, k-slot) -> slot in expert
+    pair_e = top_e.reshape(t * c.top_k)  # [P]
+    pair_w = top_p.reshape(t * c.top_k)
+    onehot = jax.nn.one_hot(pair_e, c.n_experts, dtype=jnp.int32)  # [P, E]
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)  # [P]
+    keep = pos < cap
+    pos = jnp.minimum(pos, cap - 1)
+
+    # ---- dispatch [E, C, d]
+    token_idx = jnp.repeat(jnp.arange(t), c.top_k)
+    contrib = flat[token_idx] * keep[:, None].astype(flat.dtype)
+    dispatch = jnp.zeros((c.n_experts, cap, d), flat.dtype).at[pair_e, pos].add(contrib)
+    if mesh is not None and "ep" in mesh.axis_names:
+        dispatch = jax.lax.with_sharding_constraint(
+            dispatch, NamedSharding(mesh, P("ep", None, None))
+        )
+
+    # ---- expert FFN over stacked weights (one batched einsum per matmul)
+    gate = jnp.einsum("ecd,edf->ecf", dispatch, params["w_gate"])
+    up = jnp.einsum("ecd,edf->ecf", dispatch, params["w_up"])
+    out_e = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, params["w_down"])
+    if mesh is not None and "ep" in mesh.axis_names:
+        out_e = jax.lax.with_sharding_constraint(
+            out_e, NamedSharding(mesh, P("ep", None, None))
+        )
+
+    # ---- combine: gather each pair's expert output, weight, sum over k
+    gathered = out_e[pair_e, pos]  # [P, d]
+    weighted = gathered * (pair_w * keep).astype(gathered.dtype)[:, None]
+    out = jnp.sum(weighted.reshape(t, c.top_k, d), axis=1)
+    out = out.reshape(b, s, d).astype(x.dtype)
+    if not return_aux:
+        return out
+    # Load-balance loss (Switch): E · Σ_e f_e·P_e with f_e the fraction of
+    # tokens whose TOP-1 choice is expert e and P_e the mean router
+    # probability. Uniform routing scores 1.0; collapse scores ~E.
+    top1_frac = jnp.mean(
+        jax.nn.one_hot(top_e[:, 0], c.n_experts, dtype=jnp.float32), axis=0
+    )
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = c.n_experts * jnp.sum(top1_frac * mean_prob)
+    return out, aux

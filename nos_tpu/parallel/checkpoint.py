@@ -23,8 +23,60 @@ import orbax.checkpoint as ocp
 TrainState = Tuple[Any, Any]  # (params, velocity), matching train.make_train_step
 
 
+class Checkpointer:
+    """Long-lived manager for a training loop: saves overlap compute (orbax
+    serializes in the background), and the loop only blocks in
+    ``wait()``/``close()`` — call close() (or use as a context manager) at
+    exit or on the preemption signal."""
+
+    def __init__(self, path: str, *, max_to_keep: Optional[int] = None) -> None:
+        self.path = os.path.abspath(path)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, enable_async_checkpointing=True
+        )
+        self._manager = ocp.CheckpointManager(self.path, options=options)
+
+    def save(self, step: int, state: TrainState, *, force: bool = False) -> None:
+        """Enqueue an async save; raises if orbax skips it (stale step)."""
+        saved = self._manager.save(step, args=ocp.args.StandardSave(state), force=force)
+        if not saved:
+            raise RuntimeError(
+                f"checkpoint save skipped for step {step} under {self.path} "
+                f"(latest is {self._manager.latest_step()}; pass force=True)"
+            )
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def restore(self, shard_like: TrainState, step: Optional[int] = None):
+        if step is None:
+            step = self._manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.path}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            shard_like,
+        )
+        return self._manager.restore(step, args=ocp.args.StandardRestore(abstract)), step
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._manager.close()
+
+    def __enter__(self) -> "Checkpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def save_checkpoint(path: str, state: TrainState, step: int, *, force: bool = False) -> None:
-    """Write `state` at `step` under path/<step>/ (atomic rename on finish).
+    """One-shot synchronous save of `state` at `step` under path/<step>/
+    (atomic rename on finish). Training loops should hold a `Checkpointer`
+    instead so saves overlap compute.
 
     Raises if the manager skips the save (orbax silently refuses steps <=
     its latest unless forced — a dropped checkpoint must never be silent

@@ -12,9 +12,17 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 from nos_tpu.tpu.topology import Topology
+
+
+def partition_spec(mesh: Mesh, *axes) -> PartitionSpec:
+    """PartitionSpec over `axes` with names the mesh doesn't carry degraded
+    to replication — one sharding rule serves every mesh shape."""
+    return PartitionSpec(
+        *(a if (a is None or a in mesh.axis_names) else None for a in axes)
+    )
 
 
 def mesh_from_devices(

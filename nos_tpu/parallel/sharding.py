@@ -18,7 +18,11 @@ from nos_tpu.models.llama import LlamaConfig
 
 
 def _ns(mesh: Mesh, *spec) -> NamedSharding:
-    return NamedSharding(mesh, P(*spec))
+    # Axis names the mesh doesn't carry degrade to replication, so the same
+    # sharding rules serve ('dp','tp'), ('dp','sp','tp'), ('dp','ep'), ...
+    from nos_tpu.parallel.mesh import partition_spec
+
+    return NamedSharding(mesh, partition_spec(mesh, *spec))
 
 
 def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
@@ -29,10 +33,15 @@ def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
         "wv": _ns(mesh, None, "tp"),
         "wo": _ns(mesh, "tp", None),
         "mlp_norm": _ns(mesh),
-        "w_gate": _ns(mesh, None, "tp"),
-        "w_up": _ns(mesh, None, "tp"),
-        "w_down": _ns(mesh, "tp", None),
     }
+    if config.n_experts > 0:
+        from nos_tpu.models.moe import moe_param_sharding
+
+        layer["moe"] = moe_param_sharding(mesh, config.moe_config())
+    else:
+        layer["w_gate"] = _ns(mesh, None, "tp")
+        layer["w_up"] = _ns(mesh, None, "tp")
+        layer["w_down"] = _ns(mesh, "tp", None)
     return {
         "embed": _ns(mesh, "tp", None),
         "final_norm": _ns(mesh),

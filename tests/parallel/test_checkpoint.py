@@ -59,6 +59,26 @@ class TestCheckpointResume:
         assert jnp.isfinite(loss_b)
         assert float(loss_b) < float(loss_a) + 0.5
 
+    def test_async_checkpointer_loop(self, tmp_path):
+        """The training-loop form: async saves overlap steps, restore sees
+        the latest after close."""
+        from nos_tpu.parallel.checkpoint import Checkpointer
+
+        config = tiny_config()
+        mesh = mesh_from_devices((2, 2), ("dp", "tp"), jax.devices()[:4])
+        step_fn, shard_state = make_train_step(mesh, config)
+        state = shard_state(init_llama_params(jax.random.key(0), config))
+        with Checkpointer(str(tmp_path / "ckpt"), max_to_keep=2) as ckpt:
+            for i in range(3):
+                state, _ = step_fn(state, make_tokens())
+                ckpt.save(i, state)
+            ckpt.wait()
+            assert ckpt.latest_step() == 2
+            restored, step = ckpt.restore(state)
+            assert step == 2
+            with pytest.raises(RuntimeError):
+                ckpt.save(1, state)  # stale step must not be silent
+
     def test_missing_checkpoint_raises(self, tmp_path):
         config = tiny_config()
         mesh = mesh_from_devices((1, 1), ("dp", "tp"), jax.devices()[:1])
