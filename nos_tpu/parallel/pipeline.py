@@ -1,0 +1,160 @@
+"""Pipeline parallelism: GPipe schedule over a ``pp`` mesh axis.
+
+The transformer stack is split into pp stages — layer parameters stack
+along a leading dim sharded over ``pp``, so each device holds L/pp layers
+in HBM (the memory win that lets one slice hold a model pp× its per-chip
+capacity). The batch splits into microbatches that stream through the
+stages: each tick every stage applies its local layers to the microbatch
+it holds, then hands the activation to the next stage over a single
+``ppermute`` hop (neighbor ICI traffic, never DCN). The schedule runs
+M + pp - 1 ticks; the classic GPipe bubble is (pp-1)/(M+pp-1), shrinking
+as microbatches grow.
+
+Embedding, final norm, and the LM head are replicated outside the pipeline
+body (they are a small fraction of parameters); only the repeated blocks
+ride the pp axis. Differentiable end to end — the schedule unrolls into
+static ticks of scan/ppermute/where, all with transpose rules.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nos_tpu.models.llama import LlamaConfig, _attention, _mlp, _rms_norm, _rope
+
+Params = Dict[str, Any]
+
+
+def stack_layer_params(params: Params) -> Params:
+    """[{leaf...}] * L → {leaf: [L, ...]} — the pp-shardable layout."""
+    layers = params["layers"]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return {**{k: v for k, v in params.items() if k != "layers"}, "layers": stacked}
+
+
+def pipeline_param_sharding(mesh: Mesh, config: LlamaConfig) -> Params:
+    """Stacked layers shard dim 0 over pp (and hidden dims over tp when
+    present); embed/head replicate over pp like the dense rules."""
+    from nos_tpu.parallel.sharding import llama_param_sharding
+
+    base = llama_param_sharding(mesh, config)
+    stacked_layers = jax.tree.map(
+        lambda ns: NamedSharding(mesh, P("pp", *ns.spec)),
+        base["layers"][0],
+        is_leaf=lambda x: isinstance(x, NamedSharding),
+    )
+    return {
+        **{k: v for k, v in base.items() if k != "layers"},
+        "layers": stacked_layers,
+    }
+
+
+def _block(carry_x, layer: Params, config: LlamaConfig, cos, sin):
+    """One transformer block on one stage (dense attention — sp/flash
+    compose at the outer level, not inside the pipeline body)."""
+    x = carry_x
+    x = x + _attention(_rms_norm(x, layer["attn_norm"], config.norm_eps), layer, config, cos, sin)
+    x = x + _mlp(_rms_norm(x, layer["mlp_norm"], config.norm_eps), layer)
+    return x
+
+
+def _stage_apply(local_layers: Params, x, config: LlamaConfig, cos, sin):
+    """Apply this stage's L/pp stacked layers via scan."""
+
+    def step(h, layer):
+        return _block(h, layer, config, cos, sin), None
+
+    out, _ = jax.lax.scan(step, x, local_layers)
+    return out
+
+
+def _pipeline_local(stacked_layers, x_mb, config: LlamaConfig, cos, sin, *, n_stages: int):
+    """shard_map body over ('pp',): run the GPipe schedule.
+
+    x_mb: [M, mb, S, D] microbatched activations (post-embedding),
+    replicated — stage 0 ingests them in order. Returns [M, mb, S, D]
+    activations after the full stack (valid on every device via psum).
+    """
+    s = jax.lax.axis_index("pp")
+    m = x_mb.shape[0]
+    zero = jnp.zeros_like(x_mb[0])
+    ys = jnp.zeros_like(x_mb)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    act = zero  # activation leaving this stage last tick
+    for t in range(m + n_stages - 1):
+        incoming = jax.lax.ppermute(act, "pp", perm)
+        feed = x_mb[t] if t < m else zero
+        x_in = jnp.where(s == 0, feed, incoming)
+        out = _stage_apply(stacked_layers, x_in, config, cos, sin)
+        # Last stage completed microbatch t-s this tick (valid when
+        # 0 <= t-s < m); store it.
+        idx = jnp.clip(t - s, 0, m - 1)
+        valid = (s == n_stages - 1) & (t - s >= 0) & (t - s < m)
+        current = jax.lax.dynamic_slice_in_dim(ys, idx, 1, axis=0)[0]
+        ys = jax.lax.dynamic_update_slice_in_dim(
+            ys, jnp.where(valid, out, current)[None], idx, axis=0
+        )
+        act = out
+    # Everyone holds zeros except the last stage: one psum replicates the
+    # pipeline output to all stages (embed/head run replicated after).
+    return jax.lax.psum(jnp.where(s == n_stages - 1, ys, jnp.zeros_like(ys)), "pp")
+
+
+def pipeline_llama_forward(
+    params: Params,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    n_microbatches: int = 0,
+) -> jax.Array:
+    """tokens [B, S] → logits [B, S, vocab], transformer blocks pipelined
+    over the mesh's ``pp`` axis. `params` must be in stacked layout
+    (stack_layer_params). B must divide by n_microbatches (default: pp)."""
+    c = config
+    n_stages = mesh.shape["pp"]
+    if c.n_layers % n_stages:
+        raise ValueError(f"{c.n_layers} layers do not divide {n_stages} pp stages")
+    m = n_microbatches or n_stages
+    b, s_len = tokens.shape
+    if b % m:
+        raise ValueError(f"batch {b} does not divide {m} microbatches")
+
+    x = params["embed"][tokens]
+    cos, sin = _rope(s_len, c.head_dim, c.rope_theta, c.dtype)
+    x_mb = x.reshape(m, b // m, s_len, c.d_model)
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])
+    # Compose with data parallelism: each dp shard pipelines its slice of
+    # every microbatch.
+    data_spec = P(None, "dp") if "dp" in mesh.axis_names else P()
+    fn = partial(_pipeline_local, config=c, cos=cos, sin=sin, n_stages=n_stages)
+    y_mb = jax.shard_map(
+        lambda lp, xm: fn(lp, xm),
+        mesh=mesh,
+        in_specs=(layer_specs, data_spec),
+        out_specs=data_spec,
+        check_vma=False,
+    )(params["layers"], x_mb)
+
+    y = y_mb.reshape(b, s_len, c.d_model)
+    y = _rms_norm(y, params["final_norm"], c.norm_eps)
+    return (y @ params["lm_head"]).astype(jnp.float32)
+
+
+def pipeline_llama_loss(
+    params: Params,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    n_microbatches: int = 0,
+) -> jax.Array:
+    logits = pipeline_llama_forward(params, tokens, config, mesh, n_microbatches)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
