@@ -45,6 +45,7 @@ class SimCluster:
     pool: SimDevicePool
     partitioner: PartitionerController
     scheduler: Scheduler
+    kubelet: Optional[SimKubelet] = None
     device_backend: str = "sim"  # "sim" | "tpuctl" (native C++ slice state)
     tpuctl_dir: str = ""
     device_plugin_config_map: str = "nos-device-plugin-config"
@@ -123,6 +124,10 @@ class SimCluster:
 
         if self._tpuctl_client is None:
             self._tpuctl_client = TpuctlDeviceClient(self.tpuctl_dir, {})
+            if self.kubelet is not None:
+                # Native backend: admission arbitrates against tpuctl's
+                # slice state instead of the sim pool.
+                self.kubelet.geometry_fn = self._tpuctl_client.geometry
         node = self.store.get("Node", node_name)
         accelerator = node.metadata.labels.get(GKE_TPU_ACCELERATOR_LABEL, "")
         chips = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
@@ -155,7 +160,10 @@ def build_cluster(
     )
     partitioner = build_partitioner(manager, partitioner_config)
     scheduler = build_scheduler(manager, scheduler_config)
-    kubelet = SimKubelet(store)
+    pool = SimDevicePool()
+    # Admission arbitrates against the device inventory (ground truth),
+    # the backstop for scheduler-vs-repartitioner races — see SimKubelet.
+    kubelet = SimKubelet(store, geometry_fn=pool.geometry)
     manager.add(
         Controller(
             "sim-kubelet",
@@ -207,9 +215,10 @@ def build_cluster(
     return SimCluster(
         manager=manager,
         store=store,
-        pool=SimDevicePool(),
+        pool=pool,
         partitioner=partitioner,
         scheduler=scheduler,
+        kubelet=kubelet,
         device_backend=device_backend,
         tpuctl_dir=tpuctl_dir,
         device_plugin_config_map=partitioner_config.device_plugin_config_map,

@@ -59,6 +59,7 @@ class PartitionerController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.plans_applied = 0  # domain metric (gap noted in SURVEY.md §5)
+        self.nodes_repartitioned = 0  # per-node slice reconfigs (north star)
         from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
 
         # Which extended resources this mode's planning can serve (per-mode
@@ -130,6 +131,18 @@ class PartitionerController:
                         self.batcher.add(item)
                     continue
                 self.process_pending_pods()
+                # Level-triggered retry: a pod whose first plan attempt
+                # could not help emits no further events (the scheduler
+                # marks it unschedulable once), so capacity freed later —
+                # e.g. other pods finishing — would never retrigger
+                # planning. Re-enqueue whatever is still pending; the
+                # batch windows pace the retry cadence.
+                if self.cluster_state.is_partitioning_enabled(self.kind):
+                    for pod in self.fetch_pending_pods():
+                        if podutil.extra_resources_could_help_scheduling(
+                            pod
+                        ) and self._requests_tracked_resources(pod):
+                            self.batcher.add(pod.namespaced_name)
             except Exception:  # pragma: no cover - defensive
                 log.exception("partitioner batch processing failed")
 
@@ -143,10 +156,11 @@ class PartitionerController:
             if not p.spec.node_name
         ]
 
-    def process_pending_pods(self) -> bool:
+    def process_pending_pods(self) -> int:
+        """Returns the number of nodes re-partitioned (0 = no-op plan)."""
         pending = self.fetch_pending_pods()
         if not pending:
-            return False
+            return 0
         snapshot = self.snapshot_taker.take_snapshot(self.cluster_state)
         current = snapshot.partitioning_state()
         desired = self.planner.plan(snapshot, pending)
@@ -154,6 +168,7 @@ class PartitionerController:
         applied = self.actuator.apply(current, plan)
         if applied:
             self.plans_applied += 1
+            self.nodes_repartitioned += applied
             metrics.PLANS_APPLIED.inc()
             log.info(
                 "partitioner: plan %s applied for %d pending pods", plan.id, len(pending)

@@ -69,6 +69,7 @@ class TpuActuator:
         for op in plan.creates:
             board = creates_by_board.setdefault(op.board_index, {})
             board[op.profile] = board.get(op.profile, 0) + op.quantity
+        self._clamp_to_board_capacity(node, plan, creates_by_board)
         for board_index, profiles in sorted(creates_by_board.items()):
             # One batch per board: chip-placement-aware backends solve all
             # of a board's creates together (order-independent).
@@ -83,3 +84,66 @@ class TpuActuator:
         self.device_plugin.restart(self.node_name)
         self.shared.on_apply(plan_id)
         return None
+
+    def _clamp_to_board_capacity(self, node, plan, creates_by_board: dict) -> None:
+        """Refuse creates that would exceed a board's physical chips.
+
+        The control plane can ask for an impossible geometry when it planned
+        against state that lagged a recent bind (its spec plus still-used
+        slices exceeding the board). Real silicon rejects such placements at
+        device-creation; mirror that here so an inflated geometry is never
+        advertised, and let the level-triggered loop re-converge from the
+        next report. Reference analogue: NVML creation failures in
+        migagent's apply, which are logged and re-reconciled.
+        """
+        from nos_tpu.api.v1alpha1 import constants, labels
+        from nos_tpu.tpu.known import board_layout
+        from nos_tpu.tpu.topology import Topology
+
+        accelerator = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+        chips = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        layouts = board_layout(accelerator, chips)
+        if not layouts:
+            return
+        deleted_ids = {d.device_id for d in plan.deletes}
+        surviving: dict = {}
+        for device in self.client.get_devices(self.node_name):
+            if device.device_id not in deleted_ids:
+                surviving[device.board_index] = surviving.get(
+                    device.board_index, 0
+                ) + Topology(device.profile).chips
+        for board_index, profiles in sorted(creates_by_board.items()):
+            if board_index >= len(layouts):
+                log.error(
+                    "actuator: %s spec references board %d beyond layout %s; "
+                    "dropping its creates",
+                    self.node_name,
+                    board_index,
+                    layouts,
+                )
+                profiles.clear()
+                continue
+            budget = Topology(layouts[board_index]).chips - surviving.get(
+                board_index, 0
+            )
+            for profile in sorted(profiles):
+                per = Topology(profile).chips
+                fit = max(0, min(profiles[profile], budget // per))
+                if fit < profiles[profile]:
+                    log.error(
+                        "actuator: %s board %d: spec wants %dx %s but only "
+                        "%d chips remain; clamping to %d (stale plan, will "
+                        "re-converge)",
+                        self.node_name,
+                        board_index,
+                        profiles[profile],
+                        profile,
+                        budget,
+                        fit,
+                    )
+                    profiles[profile] = fit
+                budget -= fit * per
+            for profile in [p for p, q in profiles.items() if q <= 0]:
+                del profiles[profile]
+        for board_index in [b for b, p in creates_by_board.items() if not p]:
+            del creates_by_board[board_index]

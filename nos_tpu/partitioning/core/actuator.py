@@ -38,21 +38,22 @@ class Actuator:
         self,
         current: PartitioningState,
         plan: PartitioningPlan,
-    ) -> bool:
-        """Returns True when anything was actuated."""
+    ) -> int:
+        """Applies the per-node diff; returns the number of nodes actuated
+        (0 = nothing to do, truthiness matches the reference's bool)."""
         desired = plan.desired_state
         if not desired:
             log.debug("actuator: empty desired state, skipping")
-            return False
+            return 0
         if partitioning_state_equal(current, desired):
             log.debug("actuator: desired == current, skipping")
-            return False
-        applied = False
+            return 0
+        applied = 0
         for node_name, node_partitioning in sorted(desired.items()):
             if _node_key(current.get(node_name, NodePartitioning())) == _node_key(
                 node_partitioning
             ):
                 continue  # this node already matches
             self.partitioner.apply_partitioning(node_name, plan.id, node_partitioning)
-            applied = True
+            applied += 1
         return applied

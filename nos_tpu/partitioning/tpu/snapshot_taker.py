@@ -23,5 +23,8 @@ class TpuSnapshotTaker:
             tpu_node = TpuNode(info.node, owned=True)
             if not tpu_node.is_tpu_node:
                 continue
+            # Plan against live pod bindings, not the reporter's (possibly
+            # stale) used/free split — see rebuild_usage_from_pods.
+            tpu_node.rebuild_usage_from_pods(info.pods)
             nodes[name] = SnapshotNode(partitionable=tpu_node, pods=list(info.pods))
         return ClusterSnapshot(nodes)

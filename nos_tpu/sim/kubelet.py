@@ -1,28 +1,75 @@
-"""SimKubelet: flips bound pods to Running.
+"""SimKubelet: admits bound pods against device truth, then runs them.
 
 The reference relies on real kubelets; in the in-process cluster (tests,
 kind-style dry runs, benchmarks) this controller provides the missing
-lifecycle edge: a pod bound by the scheduler becomes Running, which in turn
-drives quota accounting and device usage reporting.
+lifecycle edges:
+
+- **Admission**: a real kubelet is the last line of defense against
+  scheduler/repartitioner races — it rejects a pod whose devices are not
+  actually allocatable (``OutOfcpu``-style terminal failure). Here the
+  arbiter is the device layer's slice inventory (ground truth, not the
+  node's possibly-lagging allocatable): if the pod's normalized slice
+  demand plus that of already-admitted pods exceeds the devices that
+  exist, the pod is failed with reason ``OutOfTpu``. Without this, a
+  bind racing a re-carve can double-book a board's chips.
+- **Running**: an admitted pod becomes Running, which in turn drives
+  quota accounting and device usage reporting.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Dict, Optional
 
+from nos_tpu.api.v1alpha1 import constants, labels
 from nos_tpu.kube.controller import Request, Result
-from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.objects import Pod, PodCondition, PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.util import resources as res
+
+import logging
+
+log = logging.getLogger("nos_tpu.kubelet")
+
+# node name -> board index -> profile -> count
+GeometryFn = Callable[[str], Dict[int, Dict[str, int]]]
 
 
 class SimKubelet:
-    def __init__(self, store: KubeStore) -> None:
+    def __init__(self, store: KubeStore, geometry_fn: Optional[GeometryFn] = None) -> None:
         self.store = store
+        self.geometry_fn = geometry_fn
+        self.admission_rejects = 0
 
     def reconcile(self, req: Request) -> Optional[Result]:
         pod = self.store.try_get("Pod", req.name, req.namespace)
         if pod is None:
             return None
         if not pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
+            return None
+
+        if not self._admit(pod):
+            self.admission_rejects += 1
+            log.warning(
+                "kubelet: rejecting %s on %s: slice demand exceeds devices "
+                "(OutOfTpu)",
+                pod.namespaced_name,
+                pod.spec.node_name,
+            )
+
+            def fail(p):
+                p.status.phase = PodPhase.FAILED
+                p.status.conditions.append(
+                    PodCondition(
+                        type="PodScheduled",
+                        status="False",
+                        reason="OutOfTpu",
+                        message="node has no free slice for the pod's request",
+                    )
+                )
+
+            try:
+                self.store.patch_merge("Pod", req.name, req.namespace, fail)
+            except NotFoundError:
+                pass
             return None
 
         def mutate(p):
@@ -33,3 +80,51 @@ class SimKubelet:
         except NotFoundError:
             pass
         return None
+
+    # ------------------------------------------------------------ admission
+
+    def _admit(self, pod: Pod) -> bool:
+        """Slice-denominated admission against the device inventory."""
+        if self.geometry_fn is None:
+            return True
+        node = self.store.try_get("Node", pod.spec.node_name)
+        if node is None:
+            return True
+        if node.metadata.labels.get(labels.PARTITIONING_LABEL) not in (
+            labels.PartitioningKind.TPU,
+            labels.PartitioningKind.HYBRID,
+        ):
+            return True
+        accelerator = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+        if not accelerator:
+            return True
+        demand = self._slice_demand(pod, accelerator)
+        if not demand:
+            return True  # no slice resources involved (e.g. sharing mode)
+        for other in self.store.list("Pod"):
+            if other.spec.node_name != pod.spec.node_name:
+                continue
+            if other.namespaced_name == pod.namespaced_name:
+                continue
+            # Already-admitted pods hold their devices.
+            if other.status.phase != PodPhase.RUNNING:
+                continue
+            for profile, qty in self._slice_demand(other, accelerator).items():
+                demand[profile] = demand.get(profile, 0) + qty
+        inventory: Dict[str, int] = {}
+        try:
+            for board in self.geometry_fn(pod.spec.node_name).values():
+                for profile, qty in board.items():
+                    inventory[profile] = inventory.get(profile, 0) + qty
+        except Exception:  # device layer unavailable: fail open
+            return True
+        return all(inventory.get(p, 0) >= q for p, q in demand.items())
+
+    @staticmethod
+    def _slice_demand(pod: Pod, accelerator: str) -> Dict[str, int]:
+        request = res.normalize_tpu_request(res.compute_pod_request(pod), accelerator)
+        return {
+            constants.tpu_slice_topology(name): int(qty)
+            for name, qty in request.items()
+            if constants.is_tpu_slice_resource(name)
+        }

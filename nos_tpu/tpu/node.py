@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 from nos_tpu.api.v1alpha1 import annotations as annot
 from nos_tpu.api.v1alpha1 import constants, labels
-from nos_tpu.kube.objects import Node, Pod, ResourceList
+from nos_tpu.kube.objects import Node, Pod, PodPhase, ResourceList
 from nos_tpu.tpu.board import TpuBoard
 from nos_tpu.tpu.geometry import Geometry, geometry_add
 from nos_tpu.tpu.known import KNOWN_ACCELERATORS, board_layout
@@ -128,6 +128,43 @@ class TpuNode:
                 if remaining[profile] <= 0:
                     del remaining[profile]
         return changed
+
+    def rebuild_usage_from_pods(self, pods: List[Pod]) -> None:
+        """Re-derive the used/free split from the pods actually bound to
+        this node (API-store truth), keeping only the reported *geometry*
+        from the status annotations.
+
+        The reporter's used/free split lags binds by up to a report
+        interval; planning against a stale "free" can carve away a slice a
+        just-bound pod occupies, letting the scheduler double-book the
+        board's chips. If some bound pod's profile has no device in the
+        reported geometry, the node is mid-transition: mark it inconsistent
+        so the planner leaves it alone until the agent re-reports
+        (tpu/node.py consistency contract, reference node.go:34-37
+        analogue).
+        """
+        demand: Geometry = {}
+        for pod in pods:
+            if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
+                continue
+            request = res.normalize_tpu_request(
+                res.compute_pod_request(pod), self.accelerator
+            )
+            for name, qty in request.items():
+                if constants.is_tpu_slice_resource(name):
+                    profile = constants.tpu_slice_topology(name)
+                    demand[profile] = demand.get(profile, 0) + int(qty)
+        for board in self.boards:
+            board.free = geometry_add(board.free, board.used)
+            board.used = {}
+        for profile in sorted(demand):
+            for _ in range(demand[profile]):
+                for board in self.boards:
+                    if board.allocate(profile):
+                        break
+                else:
+                    self.consistent = False
+                    return
 
     def add_pod(self, pod: Pod) -> bool:
         """Consume free slices for the pod's (normalized) TPU request.

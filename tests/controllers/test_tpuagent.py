@@ -131,3 +131,88 @@ class TestActuatorReporterLoop:
     def test_actuator_ignores_other_nodes(self):
         store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
         assert actuator.reconcile(Request(name="other")) is None
+
+
+class TestCapacityClamp:
+    """A spec planned against stale state can demand more chips than the
+    board has (spec plus still-used slices); the actuator must refuse the
+    impossible creates like real silicon would, and let the loop
+    re-converge from the next report."""
+
+    def test_creates_clamped_when_spec_exceeds_board(self):
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        # Two used 2x2 slices occupy the whole 8-chip board.
+        pool.create("n1", 0, "2x2", 2)
+        store.create(build_pod("a", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+        store.create(build_pod("b", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+        # Stale spec: keep one 2x2 and add two 1x2 (would be 12 chips).
+        def set_spec(n):
+            n.metadata.annotations.update(
+                {
+                    **annot.spec_from_geometries({0: {"2x2": 1, "1x2": 2}}),
+                    annot.SPEC_PARTITIONING_PLAN: "p1",
+                }
+            )
+
+        store.patch_merge("Node", "n1", None, set_spec)
+        shared.on_report()
+        actuator.reconcile(Request(name="n1"))
+        geometry = pool.geometry("n1")
+        total_chips = sum(
+            {"1x1": 1, "1x2": 2, "2x2": 4, "2x4": 8}[p] * q
+            for p, q in geometry.get(0, {}).items()
+        )
+        assert total_chips <= 8, geometry
+        # Used devices were never deleted.
+        assert geometry[0].get("2x2", 0) == 2
+
+
+class TestKubeletAdmission:
+    """The sim kubelet arbitrates admission against device truth — the
+    backstop for a bind racing a re-carve (real kubelet: OutOfcpu-style
+    terminal rejection)."""
+
+    def _kubelet_env(self):
+        from nos_tpu.sim import SimKubelet
+
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1"))
+        pool = SimDevicePool()
+        kubelet = SimKubelet(store, geometry_fn=pool.geometry)
+        return store, pool, kubelet
+
+    def test_second_pod_on_single_slice_rejected(self):
+        store, pool, kubelet = self._kubelet_env()
+        pool.create("n1", 0, "2x2", 1)
+        for name in ("a", "b"):
+            store.create(build_pod(name, {"google.com/tpu": 4}, node="n1"))
+        kubelet.reconcile(Request(name="a", namespace="default"))
+        kubelet.reconcile(Request(name="b", namespace="default"))
+        phases = {
+            name: store.get("Pod", name, "default").status.phase for name in ("a", "b")
+        }
+        assert phases["a"] == "Running"
+        assert phases["b"] == "Failed"
+        assert kubelet.admission_rejects == 1
+
+    def test_fitting_pods_admitted(self):
+        store, pool, kubelet = self._kubelet_env()
+        pool.create("n1", 0, "2x2", 2)
+        for name in ("a", "b"):
+            store.create(build_pod(name, {"google.com/tpu": 4}, node="n1"))
+        kubelet.reconcile(Request(name="a", namespace="default"))
+        kubelet.reconcile(Request(name="b", namespace="default"))
+        assert all(
+            store.get("Pod", n, "default").status.phase == "Running" for n in ("a", "b")
+        )
+
+    def test_non_tpu_node_always_admits(self):
+        from nos_tpu.sim import SimKubelet
+        from tests.factory import build_node
+
+        store = KubeStore()
+        store.create(build_node(name="plain"))
+        kubelet = SimKubelet(store, geometry_fn=lambda n: {})
+        store.create(build_pod("p", {"cpu": 1}, node="plain"))
+        kubelet.reconcile(Request(name="p", namespace="default"))
+        assert store.get("Pod", "p", "default").status.phase == "Running"

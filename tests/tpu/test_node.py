@@ -10,7 +10,10 @@ from nos_tpu.kube.objects import (
     Pod,
     PodSpec,
 )
+from nos_tpu.kube.objects import PodPhase
 from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import build_pod, build_tpu_node
 
 V5E = "tpu-v5-lite-podslice"
 
@@ -212,3 +215,47 @@ class TestSharingAnnotationTolerance:
         assert node.consistent
         assert node.boards[0].free == {"2x2": 1}
         assert node.has_free_capacity()
+
+
+class TestRebuildUsageFromPods:
+    """The planner must plan against live pod bindings, not the reporter's
+    (lag-prone) used/free split — a stale 'free' lets the planner carve a
+    slice a just-bound pod occupies (the scheduler then double-books the
+    board's chips)."""
+
+    def test_bound_pod_claims_reportedly_free_slice(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        node = TpuNode(build_tpu_node(annotations=ann))
+        pod = build_pod("w", {constants.RESOURCE_TPU: 4}, node="tpu-node")
+        node.rebuild_usage_from_pods([pod])
+        assert node.boards[0].used == {"2x2": 1}
+        assert node.boards[0].free == {"2x2": 1}
+
+    def test_stale_used_without_pods_becomes_free(self):
+        ann = annot.status_from_devices(free={}, used={0: {"2x2": 2}})
+        node = TpuNode(build_tpu_node(annotations=ann))
+        node.rebuild_usage_from_pods([])
+        assert node.boards[0].used == {}
+        assert node.boards[0].free == {"2x2": 2}
+
+    def test_unattributable_demand_marks_inconsistent(self):
+        # A bound pod whose profile has no device: mid-transition node.
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        node = TpuNode(build_tpu_node(annotations=ann))
+        pods = [
+            build_pod("a", {constants.RESOURCE_TPU: 4}, node="tpu-node"),
+            build_pod("b", {constants.RESOURCE_TPU: 4}, node="tpu-node"),
+        ]
+        node.rebuild_usage_from_pods(pods)
+        assert not node.consistent
+        assert not node.has_free_capacity()
+
+    def test_terminal_pods_hold_nothing(self):
+        ann = annot.status_from_devices(free={0: {"2x2": 2}}, used={})
+        node = TpuNode(build_tpu_node(annotations=ann))
+        pod = build_pod(
+            "done", {constants.RESOURCE_TPU: 4}, node="tpu-node",
+            phase=PodPhase.SUCCEEDED,
+        )
+        node.rebuild_usage_from_pods([pod])
+        assert node.boards[0].used == {}
