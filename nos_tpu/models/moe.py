@@ -63,15 +63,16 @@ def init_moe_params(key: jax.Array, config: MoeConfig) -> Params:
 
 
 def moe_param_sharding(mesh, config: MoeConfig) -> Params:
-    """NamedShardings: experts over ep, hidden over tp, router replicated.
-    Axes missing from the mesh fall back to replication (partition_spec)."""
+    """NamedShardings: experts over ep, hidden over tp, the remaining
+    d_model dimension FSDP-sharded over dp, router replicated. Axes
+    missing from the mesh fall back to replication (partition_spec)."""
     from nos_tpu.parallel.mesh import partition_spec as ps
 
     return {
         "router": NamedSharding(mesh, P()),
-        "w_gate": NamedSharding(mesh, ps(mesh, "ep", None, "tp")),
-        "w_up": NamedSharding(mesh, ps(mesh, "ep", None, "tp")),
-        "w_down": NamedSharding(mesh, ps(mesh, "ep", "tp", None)),
+        "w_gate": NamedSharding(mesh, ps(mesh, "ep", "dp", "tp")),
+        "w_up": NamedSharding(mesh, ps(mesh, "ep", "dp", "tp")),
+        "w_down": NamedSharding(mesh, ps(mesh, "ep", "tp", "dp")),
     }
 
 

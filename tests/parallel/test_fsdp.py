@@ -1,0 +1,73 @@
+"""FSDP: parameters and optimizer state shard over dp (VERDICT round-1 #5).
+
+A Llama-3-8B train state (~32 GB with momentum in bf16) cannot fit one
+v5e chip's 16 GB HBM; chip-count-fractional parameter storage is what
+makes BASELINE config #5 (auto-carved 4x4 slice) runnable. These tests
+pin the memory contract and the numerics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.parallel.mesh import mesh_from_devices
+from nos_tpu.parallel.train import make_train_step
+
+
+def _local_bytes(tree) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        for shard in leaf.addressable_shards:
+            total += shard.data.size * shard.data.dtype.itemsize
+    return total
+
+
+def _global_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+class TestFsdp:
+    def test_param_bytes_shard_over_mesh(self):
+        devices = jax.devices()[:8]
+        mesh = mesh_from_devices((4, 2), ("dp", "tp"), devices)
+        config = tiny_config()
+        _, shard_state = make_train_step(mesh, config)
+        params, velocity = shard_state(init_llama_params(jax.random.key(0), config))
+
+        global_bytes = _global_bytes(params)
+        local = _local_bytes(params)
+        # Each device holds ~1/8th; 1-D norm scales stay replicated, so
+        # allow their slack: bound by 1/8 of global + full replicated bytes.
+        replicated = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(params) if x.ndim == 1
+        )
+        assert local <= global_bytes + 7 * replicated  # sanity: all shards
+        per_dev = local / len(devices)
+        assert per_dev <= global_bytes / 8 + replicated, (
+            f"per-device {per_dev} vs fully-sharded {global_bytes / 8} "
+            f"+ replicated {replicated}"
+        )
+        # Optimizer state shards identically.
+        assert _local_bytes(velocity) == local
+
+    def test_fsdp_loss_matches_single_device(self):
+        config = tiny_config()
+        params = init_llama_params(jax.random.key(0), config)
+        tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, config.vocab_size)
+
+        mesh1 = mesh_from_devices((1, 1), ("dp", "tp"), jax.devices()[:1])
+        step1, shard1 = make_train_step(mesh1, config)
+        state1, loss1 = step1(shard1(params), tokens)
+
+        mesh8 = mesh_from_devices((4, 2), ("dp", "tp"), jax.devices()[:8])
+        step8, shard8 = make_train_step(mesh8, config)
+        state8, loss8 = step8(shard8(params), tokens)
+
+        np.testing.assert_allclose(float(loss1), float(loss8), rtol=2e-2)
+        # Updated params agree too (momentum-SGD step is deterministic).
+        p1 = jax.tree.leaves(state1[0])[0]
+        p8 = jax.tree.leaves(state8[0])[0]
+        np.testing.assert_allclose(
+            np.asarray(p1, np.float32), np.asarray(p8, np.float32), atol=3e-2
+        )
