@@ -37,7 +37,7 @@ import subprocess
 import sys
 import time
 
-TPU_CHILD_TIMEOUT_S = 420.0
+TPU_CHILD_TIMEOUT_S = 900.0
 
 
 def log(msg: str) -> None:
@@ -116,11 +116,20 @@ def run_tpu_child() -> None:
             n_kv_heads=8,
             d_ff=7168,
         )
-        batch_candidates = [(8, 2048), (4, 2048), (2, 1024)]
+        # (batch, seq, attention, remat): flash attention (O(S) memory,
+        # no [S,S] scores) + per-layer remat is what lets a 1B model
+        # train at real token counts on a 16 GB chip; prefer no-remat
+        # (fewer recompute FLOPs) when the batch fits without it.
+        batch_candidates = [
+            (8, 2048, "flash", False),
+            (8, 2048, "flash", True),
+            (4, 2048, "flash", True),
+            (2, 1024, "dense", False),
+        ]
         train_iters, fwd_iters = 10, 20
     else:
         config = tiny_config()
-        batch_candidates = [(8, 128)]
+        batch_candidates = [(8, 128, "dense", False)]
         train_iters, fwd_iters = 5, 10
 
     mesh = mesh_from_devices((1, 1), ("dp", "tp"), jax.devices()[:1])
@@ -134,22 +143,31 @@ def run_tpu_child() -> None:
         "model_params_b": round(n_params / 1e9, 4),
     }
 
+    def snapshot() -> None:
+        # Emit the running result after every section: the parent takes the
+        # LAST stdout line, so a timeout mid-bench still salvages every
+        # completed number instead of losing the run.
+        print(json.dumps(result), flush=True)
+
     # ---- train step (loss -> grad -> momentum SGD), largest batch that fits
-    train_step, shard_state = make_train_step(mesh, config)
+    import dataclasses
+
     state = None
-    for batch, seq in batch_candidates:
+    for batch, seq, attn, remat in batch_candidates:
         tokens = jnp.zeros((batch, seq), jnp.int32)
         try:
-            # Fresh params per attempt: shard_state's device_put may alias
-            # them and train_step donates its state, so a failed attempt
-            # can leave the previous params' buffers deleted.
+            t_cfg = dataclasses.replace(config, attention=attn, remat=remat)
+            train_step, shard_state = make_train_step(mesh, t_cfg)
+            # Fresh params per attempt: the state is donated (halves peak
+            # HBM), so a failed attempt leaves its buffers deleted.
             params = init_llama_params(jax.random.key(0), config)
-            state = shard_state(params)
+            state = shard_state(params, donate=True)
+            del params
             t_c = time.monotonic()
             state, loss = train_step(state, tokens)
             jax.block_until_ready(loss)
             log(f"[tpu-child] train compile+1st step {time.monotonic()-t_c:.1f}s "
-                f"(batch {batch}x{seq})")
+                f"(batch {batch}x{seq} attn={attn} remat={remat})")
             start = time.monotonic()
             for _ in range(train_iters):
                 state, loss = train_step(state, tokens)
@@ -161,6 +179,8 @@ def run_tpu_child() -> None:
             result.update(
                 train_batch=batch,
                 train_seq=seq,
+                train_attention=attn,
+                train_remat=remat,
                 train_step_ms=round(step_s * 1000, 2),
                 train_tokens_per_s=round(tokens_per_step / step_s, 1),
                 train_mfu_pct=round(100.0 * flops / step_s / peak, 2),
@@ -168,10 +188,11 @@ def run_tpu_child() -> None:
             log(f"[tpu-child] train: {step_s*1000:.1f} ms/step, "
                 f"{tokens_per_step/step_s:.0f} tok/s, "
                 f"MFU {result['train_mfu_pct']:.1f}% (peak {peak/1e12:.0f} TF)")
+            snapshot()
             break
         except Exception as e:  # OOM etc. -> try the next smaller batch
-            log(f"[tpu-child] train batch {batch}x{seq} failed: "
-                f"{type(e).__name__}: {str(e)[:200]}")
+            log(f"[tpu-child] train batch {batch}x{seq} attn={attn} "
+                f"remat={remat} failed: {type(e).__name__}: {str(e)[:200]}")
             state = None
     del state
     # train_step donated the state (which may alias params): rebuild for
@@ -184,16 +205,19 @@ def run_tpu_child() -> None:
     )
     tokens = jnp.zeros((batch, seq), jnp.int32)
 
-    def bench_fwd(cfg, label):
+    def bench_fwd(cfg, label, toks=None, iters=None):
+        toks = tokens if toks is None else toks
+        iters = iters or fwd_iters
         fwd = jax.jit(lambda p, t: llama_forward(p, t, cfg))
-        out = fwd(params, tokens)
+        out = fwd(params, toks)
         jax.block_until_ready(out)
         start = time.monotonic()
-        for _ in range(fwd_iters):
-            out = fwd(params, tokens)
+        for _ in range(iters):
+            out = fwd(params, toks)
         jax.block_until_ready(out)
-        ms = (time.monotonic() - start) / fwd_iters * 1000
-        log(f"[tpu-child] fwd {label}: {ms:.2f} ms/step (batch {batch}x{seq})")
+        ms = (time.monotonic() - start) / iters * 1000
+        log(f"[tpu-child] fwd {label}: {ms:.2f} ms/step "
+            f"(batch {'x'.join(map(str, toks.shape))})")
         return ms
 
     try:
@@ -202,8 +226,6 @@ def run_tpu_child() -> None:
         log(f"[tpu-child] fwd dense failed: {type(e).__name__}: {str(e)[:200]}")
     if on_tpu:
         try:
-            import dataclasses
-
             flash_cfg = dataclasses.replace(config, attention="flash")
             result["fwd_flash_step_ms"] = round(bench_fwd(flash_cfg, "flash"), 2)
             if "fwd_step_ms" in result:
@@ -212,6 +234,39 @@ def run_tpu_child() -> None:
                 )
         except Exception as e:
             log(f"[tpu-child] fwd flash failed: {type(e).__name__}: {str(e)[:200]}")
+        snapshot()
+
+        # ---- long context: where flash earns its keep. Dense materializes
+        # fp32 [b,K,g,s,s] scores (s=8192: 4 GB per layer); flash streams
+        # K/V blocks with O(blk) VMEM. Report per-seq dense/flash ms and
+        # the speedup (dense OOM -> speedup reported as inf-proxy null,
+        # flash time still recorded).
+        for long_seq in (4096, 8192):
+            long_toks = jnp.zeros((1, long_seq), jnp.int32)
+            d_ms = f_ms = None
+            try:
+                d_ms = bench_fwd(config, f"dense@{long_seq}", long_toks, iters=8)
+            except Exception as e:
+                log(f"[tpu-child] dense@{long_seq} failed: "
+                    f"{type(e).__name__}: {str(e)[:160]}")
+            try:
+                f_ms = bench_fwd(
+                    dataclasses.replace(config, attention="flash"),
+                    f"flash@{long_seq}",
+                    long_toks,
+                    iters=8,
+                )
+            except Exception as e:
+                log(f"[tpu-child] flash@{long_seq} failed: "
+                    f"{type(e).__name__}: {str(e)[:160]}")
+            tag = f"seq{long_seq // 1024}k"
+            if d_ms is not None:
+                result[f"fwd_dense_{tag}_ms"] = round(d_ms, 2)
+            if f_ms is not None:
+                result[f"fwd_flash_{tag}_ms"] = round(f_ms, 2)
+            if d_ms is not None and f_ms is not None:
+                result[f"flash_speedup_{tag}"] = round(d_ms / f_ms, 3)
+            snapshot()
 
     print(json.dumps(result), flush=True)
 
@@ -229,7 +284,16 @@ def run_tpu_bench_subprocess() -> dict:
             timeout=TPU_CHILD_TIMEOUT_S,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # Salvage the child's newest parseable JSON snapshot: the kill can
+        # land mid-print, so scan backwards past any torn last line.
+        for line in reversed((e.stdout or b"").decode().strip().splitlines()):
+            try:
+                out = json.loads(line)
+            except ValueError:
+                continue
+            out["truncated"] = True
+            return out
         return {"error": f"accelerator bench timed out after {TPU_CHILD_TIMEOUT_S:.0f}s "
                          "(backend init unreachable?)"}
     if proc.returncode != 0:

@@ -20,6 +20,37 @@ from nos_tpu.kube.store import KubeStore
 from nos_tpu.util.health import HealthServer
 
 
+def build_store(config: dict) -> KubeStore:
+    """Store backend from the component config's `store:` block.
+
+    - `type: in-memory` (default) — the in-process suite/test store.
+    - `type: kubeconfig` — live apiserver via a kubeconfig
+      (`kubeconfig: <path>`, `context: <name>` optional).
+    - `type: in-cluster` — pod service-account credentials; what a helm
+      install runs (reference binaries always run in-cluster,
+      cmd/operator/operator.go:50-126).
+    """
+    store_cfg = (config.get("store") or {}) if isinstance(config, dict) else {}
+    stype = store_cfg.get("type", "in-memory")
+    if stype == "in-memory":
+        return KubeStore()
+    from nos_tpu.kube.apiclient import KubeApiClient
+    from nos_tpu.kube.apistore import KubeApiStore
+
+    if stype == "kubeconfig":
+        client = KubeApiClient.from_kubeconfig(
+            store_cfg.get("kubeconfig") or None, store_cfg.get("context") or None
+        )
+    elif stype == "in-cluster":
+        client = KubeApiClient.in_cluster()
+    else:
+        raise ValueError(f"unknown store type {stype!r}")
+    kinds = store_cfg.get("kinds")
+    store = KubeApiStore(client, kinds=kinds) if kinds else KubeApiStore(client)
+    store.start(sync_timeout_s=float(store_cfg.get("syncTimeoutSeconds", 30)))
+    return store
+
+
 def component_argparser(name: str) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description=f"nos-tpu {name}")
     parser.add_argument("--config", default="", help="YAML component config")
@@ -46,7 +77,7 @@ def run_component(
     )
     config = load_config(args.config)
 
-    store = KubeStore()
+    store = build_store(config)
     manager = Manager(store=store)
     build(manager, config)
 
@@ -75,4 +106,6 @@ def run_component(
     finally:
         manager.stop()
         health.stop()
+        if hasattr(store, "stop"):  # KubeApiStore: stop informer threads
+            store.stop()
     return 0

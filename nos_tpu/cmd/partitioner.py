@@ -23,7 +23,7 @@ from nos_tpu.partitioning.tpu import (
     TpuPartitioner,
     TpuSnapshotTaker,
 )
-from nos_tpu.scheduler.framework import Framework, NodeResourcesFit, NodeSelectorFit
+from nos_tpu.scheduler.framework import Framework, vanilla_filter_plugins
 from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
 from nos_tpu.tpu.known import set_known_geometries
 
@@ -61,7 +61,7 @@ def build_partitioner(
     capacity = CapacityScheduling(store)
     sim_framework = Framework(
         pre_filter_plugins=[capacity],
-        filter_plugins=[NodeResourcesFit(), NodeSelectorFit()],
+        filter_plugins=vanilla_filter_plugins(),
     )
 
     controller = PartitionerController(

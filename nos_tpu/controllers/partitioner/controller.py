@@ -69,19 +69,34 @@ class PartitionerController:
     # ----------------------------------------------------- pod reconcile
 
     def reconcile(self, req: Request) -> Optional[Result]:
-        if not self.cluster_state.is_partitioning_enabled(self.kind):
-            return None
         pod = self.store.try_get("Pod", req.name, req.namespace)
         if pod is None:
             return None
-        if not podutil.extra_resources_could_help_scheduling(pod):
-            return None
         if not self._requests_tracked_resources(pod):
+            log.debug("%s: no %s-tracked extra resources", req.name, self.kind)
             return None
+        if not podutil.extra_resources_could_help_scheduling(pod):
+            log.debug("%s: repartitioning cannot help (schedulable/preempting/bound)", pod.namespaced_name)
+            return None
+        if not self.cluster_state.is_partitioning_enabled(self.kind):
+            # The pod's event can overtake the node event that enables
+            # partitioning (real informers deliver kinds on independent
+            # streams) — dropping here would orphan the pod forever. Requeue
+            # with pod-age-proportional backoff: tight while the race window
+            # is plausible, capped at 30s so a cluster that genuinely has no
+            # nodes of this kind only pays a slow heartbeat per pod.
+            age = max(0.0, time.time() - pod.metadata.creation_timestamp)
+            delay = min(30.0, max(1.0, age / 4.0))
+            log.debug(
+                "%s: partitioning disabled for kind=%s, requeueing in %.1fs",
+                pod.namespaced_name, self.kind, delay,
+            )
+            return Result(requeue_after=delay)
         if self._waiting_for_nodes_to_report_plan():
             # Never plan on state the agents have not confirmed
             # (partitioner_controller.go:118-122).
             return Result(requeue_after=1.0)
+        log.debug("%s: added to %s batch", pod.namespaced_name, self.kind)
         self.batcher.add(pod.namespaced_name)
         return None
 

@@ -68,6 +68,75 @@ class Toleration:
     value: str = ""
     effect: str = ""  # "" matches all effects
 
+    def tolerates(self, taint: "Taint") -> bool:
+        """core/v1 toleration semantics: empty key + Exists tolerates
+        everything; empty effect matches all effects."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if not self.key:
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | NoExecute | PreferNoSchedule
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        present = self.key in labels
+        value = labels.get(self.key, "")
+        if self.operator == "In":
+            return present and value in self.values
+        if self.operator == "NotIn":
+            return not present or value not in self.values
+        if self.operator == "Exists":
+            return present
+        if self.operator == "DoesNotExist":
+            return not present
+        if self.operator in ("Gt", "Lt"):
+            try:
+                lhs, rhs = int(value), int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lhs > rhs if self.operator == "Gt" else lhs < rhs
+        return False
+
+
+@dataclass
+class NodeSelectorTerm:
+    """AND of match_expressions (terms themselves OR together)."""
+
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+@dataclass
+class NodeAffinity:
+    """requiredDuringSchedulingIgnoredDuringExecution: node must match at
+    least one term (terms OR, expressions within a term AND)."""
+
+    required_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        if not self.required_terms:
+            return True
+        return any(t.matches(labels) for t in self.required_terms)
+
 
 @dataclass
 class Container:
@@ -96,6 +165,7 @@ class PodSpec:
     priority_class_name: str = ""
     tolerations: List[Toleration] = field(default_factory=list)
     node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[NodeAffinity] = None
 
 
 @dataclass
@@ -141,8 +211,15 @@ class NodeStatus:
 
 
 @dataclass
+class NodeSpec:
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
     status: NodeStatus = field(default_factory=NodeStatus)
     kind: str = "Node"
 

@@ -1,0 +1,553 @@
+"""Wire codecs: typed nos-tpu objects ↔ Kubernetes API JSON.
+
+The reference talks to the apiserver through client-go's generated
+(de)serializers; here the same job is done explicitly for the subset of
+core/v1, policy/v1 and nos.nebuly.com/v1alpha1 the suite speaks. Every
+kind the KubeStore can hold has a ``to_wire``/``from_wire`` pair, so the
+API-backed store (nos_tpu/kube/apistore.py) and the in-memory store hold
+identical Python objects.
+
+Quantity convention: chips/slices are plain integers; memory-like
+resources ("memory", "*-memory", "storage", "ephemeral-storage") are
+floats in Gi units — "16Gi" ↔ 16.0. Milli-quantities parse to fractional
+floats ("500m" ↔ 0.5).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from nos_tpu.api.v1alpha1.elasticquota import (
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+    ElasticQuota,
+    ElasticQuotaSpec,
+    ElasticQuotaStatus,
+)
+from nos_tpu.kube.objects import (
+    ConfigMap,
+    Container,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodCondition,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+)
+
+# kind -> (api prefix, plural, namespaced)
+RESOURCES: Dict[str, Tuple[str, str, bool]] = {
+    "Pod": ("/api/v1", "pods", True),
+    "Node": ("/api/v1", "nodes", False),
+    "ConfigMap": ("/api/v1", "configmaps", True),
+    "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
+    "ElasticQuota": ("/apis/nos.nebuly.com/v1alpha1", "elasticquotas", True),
+    "CompositeElasticQuota": (
+        "/apis/nos.nebuly.com/v1alpha1",
+        "compositeelasticquotas",
+        True,
+    ),
+}
+
+API_VERSIONS: Dict[str, str] = {
+    "Pod": "v1",
+    "Node": "v1",
+    "ConfigMap": "v1",
+    "PodDisruptionBudget": "policy/v1",
+    "ElasticQuota": "nos.nebuly.com/v1alpha1",
+    "CompositeElasticQuota": "nos.nebuly.com/v1alpha1",
+}
+
+
+def resource_path(kind: str, namespace: str = "", name: str = "") -> str:
+    prefix, plural, namespaced = RESOURCES[kind]
+    path = prefix
+    if namespaced and namespace:
+        path += f"/namespaces/{namespace}"
+    path += f"/{plural}"
+    if name:
+        path += f"/{name}"
+    return path
+
+
+# ----------------------------------------------------------------- quantity
+
+_BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50}
+_DECIMAL = {"n": 1e-9, "u": 1e-6, "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12}
+
+
+def parse_quantity(value: Any, memory: bool = False) -> float:
+    """K8s quantity → float.
+
+    ``memory=True`` normalizes EVERY spelling to Gi units — "16Gi", "1G",
+    "16384Mi" and plain-byte integers all land on the same scale, so a pod
+    requesting "1G" and a node advertising "16Gi" compare correctly.
+    ``memory=False`` (counts: chips, cpu) keeps natural units, with "500m"
+    → 0.5."""
+    if isinstance(value, (int, float)):
+        return float(value) / 2**30 if memory else float(value)
+    s = str(value).strip()
+    for suffix, mult in _BINARY.items():
+        if s.endswith(suffix):
+            v = float(s[: -len(suffix)]) * mult
+            return v / 2**30 if memory else v
+    for suffix, mult in _DECIMAL.items():
+        if s.endswith(suffix):
+            v = float(s[: -len(suffix)]) * mult
+            return v / 2**30 if memory else v
+    return float(s) / 2**30 if memory else float(s)
+
+
+def _memory_like(name: str) -> bool:
+    return "memory" in name or "storage" in name
+
+
+def format_quantity(name: str, value: float) -> str:
+    if _memory_like(name):
+        if value == int(value):
+            return f"{int(value)}Gi"
+        return f"{int(value * 1024)}Mi"
+    if value == int(value):
+        return str(int(value))
+    return f"{int(round(value * 1000))}m"
+
+
+def _resources_from_wire(d: Optional[Dict[str, Any]]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in (d or {}).items():
+        memory = _memory_like(k)
+        q = parse_quantity(v, memory=memory)
+        # chips/slices stay integral
+        out[k] = int(q) if not memory and q == int(q) else q
+    return out
+
+
+def _resources_to_wire(d: Dict[str, float]) -> Dict[str, str]:
+    return {k: format_quantity(k, v) for k, v in d.items()}
+
+
+# ----------------------------------------------------------------- metadata
+
+_RFC3339 = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _ts_to_wire(ts: Optional[float]) -> Optional[str]:
+    if ts is None:
+        return None
+    return time.strftime(_RFC3339, time.gmtime(ts))
+
+
+def _ts_from_wire(s: Optional[str]) -> Optional[float]:
+    if not s:
+        return None
+    try:
+        import calendar
+
+        return float(calendar.timegm(time.strptime(s[:19] + "Z", _RFC3339)))
+    except ValueError:
+        return None
+
+
+def _rv_from_wire(rv: Any) -> int:
+    try:
+        return int(rv)
+    except (TypeError, ValueError):
+        return 0
+
+
+def meta_to_wire(m: ObjectMeta) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": m.name}
+    if m.namespace:
+        out["namespace"] = m.namespace
+    if m.uid:
+        out["uid"] = m.uid
+    if m.labels:
+        out["labels"] = dict(m.labels)
+    if m.annotations:
+        out["annotations"] = dict(m.annotations)
+    if m.resource_version:
+        out["resourceVersion"] = str(m.resource_version)
+    if m.creation_timestamp:
+        out["creationTimestamp"] = _ts_to_wire(m.creation_timestamp)
+    if m.deletion_timestamp is not None:
+        out["deletionTimestamp"] = _ts_to_wire(m.deletion_timestamp)
+    if m.owner_references:
+        out["ownerReferences"] = [
+            {
+                "kind": o.kind,
+                "name": o.name,
+                "uid": o.uid,
+                "controller": o.controller,
+                # apiVersion is required on the wire; the suite only
+                # follows kind/name.
+                "apiVersion": "v1",
+            }
+            for o in m.owner_references
+        ]
+    return out
+
+
+def meta_from_wire(d: Dict[str, Any]) -> ObjectMeta:
+    return ObjectMeta(
+        name=d.get("name", ""),
+        namespace=d.get("namespace", ""),
+        uid=d.get("uid", ""),
+        labels=dict(d.get("labels") or {}),
+        annotations=dict(d.get("annotations") or {}),
+        creation_timestamp=_ts_from_wire(d.get("creationTimestamp")) or 0.0,
+        resource_version=_rv_from_wire(d.get("resourceVersion")),
+        owner_references=[
+            OwnerReference(
+                kind=o.get("kind", ""),
+                name=o.get("name", ""),
+                uid=o.get("uid", ""),
+                controller=bool(o.get("controller", False)),
+            )
+            for o in d.get("ownerReferences") or []
+        ],
+        deletion_timestamp=_ts_from_wire(d.get("deletionTimestamp")),
+    )
+
+
+# ---------------------------------------------------------------------- Pod
+
+
+def _container_to_wire(c: Container) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": c.name}
+    if c.image:
+        out["image"] = c.image
+    resources: Dict[str, Any] = {}
+    if c.requests:
+        resources["requests"] = _resources_to_wire(c.requests)
+    if c.limits:
+        resources["limits"] = _resources_to_wire(c.limits)
+    if resources:
+        out["resources"] = resources
+    return out
+
+
+def _container_from_wire(d: Dict[str, Any]) -> Container:
+    res = d.get("resources") or {}
+    return Container(
+        name=d.get("name", "main"),
+        image=d.get("image", ""),
+        requests=_resources_from_wire(res.get("requests")),
+        limits=_resources_from_wire(res.get("limits")),
+    )
+
+
+def _affinity_to_wire(a: Optional[NodeAffinity]) -> Optional[Dict[str, Any]]:
+    if a is None or not a.required_terms:
+        return None
+    return {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {
+                        "matchExpressions": [
+                            {"key": r.key, "operator": r.operator, "values": list(r.values)}
+                            for r in t.match_expressions
+                        ]
+                    }
+                    for t in a.required_terms
+                ]
+            }
+        }
+    }
+
+
+def _affinity_from_wire(d: Optional[Dict[str, Any]]) -> Optional[NodeAffinity]:
+    node_aff = (d or {}).get("nodeAffinity") or {}
+    required = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    terms = required.get("nodeSelectorTerms") or []
+    if not terms:
+        return None
+    return NodeAffinity(
+        required_terms=[
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(
+                        key=e.get("key", ""),
+                        operator=e.get("operator", "In"),
+                        values=list(e.get("values") or []),
+                    )
+                    for e in t.get("matchExpressions") or []
+                ]
+            )
+            for t in terms
+        ]
+    )
+
+
+def pod_to_wire(pod: Pod) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "containers": [_container_to_wire(c) for c in pod.spec.containers],
+    }
+    if pod.spec.init_containers:
+        spec["initContainers"] = [_container_to_wire(c) for c in pod.spec.init_containers]
+    if pod.spec.node_name:
+        spec["nodeName"] = pod.spec.node_name
+    if pod.spec.scheduler_name:
+        spec["schedulerName"] = pod.spec.scheduler_name
+    if pod.spec.priority:
+        spec["priority"] = pod.spec.priority
+    if pod.spec.priority_class_name:
+        spec["priorityClassName"] = pod.spec.priority_class_name
+    if pod.spec.node_selector:
+        spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.tolerations:
+        spec["tolerations"] = [
+            {"key": t.key, "operator": t.operator, "value": t.value, "effect": t.effect}
+            for t in pod.spec.tolerations
+        ]
+    aff = _affinity_to_wire(pod.spec.affinity)
+    if aff:
+        spec["affinity"] = aff
+    status: Dict[str, Any] = {"phase": pod.status.phase}
+    if pod.status.conditions:
+        status["conditions"] = [
+            {"type": c.type, "status": c.status, "reason": c.reason, "message": c.message}
+            for c in pod.status.conditions
+        ]
+    if pod.status.nominated_node_name:
+        status["nominatedNodeName"] = pod.status.nominated_node_name
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta_to_wire(pod.metadata),
+        "spec": spec,
+        "status": status,
+    }
+
+
+def pod_from_wire(d: Dict[str, Any]) -> Pod:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return Pod(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=PodSpec(
+            containers=[_container_from_wire(c) for c in spec.get("containers") or []],
+            init_containers=[
+                _container_from_wire(c) for c in spec.get("initContainers") or []
+            ],
+            node_name=spec.get("nodeName", ""),
+            scheduler_name=spec.get("schedulerName", "default-scheduler"),
+            priority=int(spec.get("priority") or 0),
+            priority_class_name=spec.get("priorityClassName", ""),
+            tolerations=[
+                Toleration(
+                    key=t.get("key", ""),
+                    operator=t.get("operator", "Equal"),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", ""),
+                )
+                for t in spec.get("tolerations") or []
+            ],
+            node_selector=dict(spec.get("nodeSelector") or {}),
+            affinity=_affinity_from_wire(spec.get("affinity")),
+        ),
+        status=PodStatus(
+            phase=status.get("phase", "Pending"),
+            conditions=[
+                PodCondition(
+                    type=c.get("type", ""),
+                    status=c.get("status", ""),
+                    reason=c.get("reason", ""),
+                    message=c.get("message", ""),
+                )
+                for c in status.get("conditions") or []
+            ],
+            nominated_node_name=status.get("nominatedNodeName", ""),
+        ),
+    )
+
+
+# --------------------------------------------------------------------- Node
+
+
+def node_to_wire(node: Node) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {}
+    if node.spec.taints:
+        spec["taints"] = [
+            {"key": t.key, "value": t.value, "effect": t.effect} for t in node.spec.taints
+        ]
+    if node.spec.unschedulable:
+        spec["unschedulable"] = True
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": meta_to_wire(node.metadata),
+        "spec": spec,
+        "status": {
+            "capacity": _resources_to_wire(node.status.capacity),
+            "allocatable": _resources_to_wire(node.status.allocatable),
+        },
+    }
+
+
+def node_from_wire(d: Dict[str, Any]) -> Node:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return Node(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=NodeSpec(
+            taints=[
+                Taint(
+                    key=t.get("key", ""),
+                    value=t.get("value", ""),
+                    effect=t.get("effect", "NoSchedule"),
+                )
+                for t in spec.get("taints") or []
+            ],
+            unschedulable=bool(spec.get("unschedulable", False)),
+        ),
+        status=NodeStatus(
+            capacity=_resources_from_wire(status.get("capacity")),
+            allocatable=_resources_from_wire(status.get("allocatable")),
+        ),
+    )
+
+
+# ---------------------------------------------------------------- ConfigMap
+
+
+def configmap_to_wire(cm: ConfigMap) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": meta_to_wire(cm.metadata),
+        "data": dict(cm.data),
+    }
+
+
+def configmap_from_wire(d: Dict[str, Any]) -> ConfigMap:
+    return ConfigMap(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        data=dict(d.get("data") or {}),
+    )
+
+
+# ---------------------------------------------------------------------- PDB
+
+
+def pdb_to_wire(pdb: PodDisruptionBudget) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {"selector": {"matchLabels": dict(pdb.spec.selector)}}
+    if pdb.spec.min_available is not None:
+        spec["minAvailable"] = pdb.spec.min_available
+    if pdb.spec.max_unavailable is not None:
+        spec["maxUnavailable"] = pdb.spec.max_unavailable
+    return {
+        "apiVersion": "policy/v1",
+        "kind": "PodDisruptionBudget",
+        "metadata": meta_to_wire(pdb.metadata),
+        "spec": spec,
+    }
+
+
+def pdb_from_wire(d: Dict[str, Any]) -> PodDisruptionBudget:
+    spec = d.get("spec") or {}
+    sel = (spec.get("selector") or {}).get("matchLabels") or {}
+    return PodDisruptionBudget(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=PodDisruptionBudgetSpec(
+            selector=dict(sel),
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
+        ),
+    )
+
+
+# ------------------------------------------------------------ ElasticQuota
+
+
+def eq_to_wire(eq: ElasticQuota) -> Dict[str, Any]:
+    return {
+        "apiVersion": "nos.nebuly.com/v1alpha1",
+        "kind": "ElasticQuota",
+        "metadata": meta_to_wire(eq.metadata),
+        "spec": {
+            "min": _resources_to_wire(eq.spec.min),
+            "max": _resources_to_wire(eq.spec.max),
+        },
+        "status": {"used": _resources_to_wire(eq.status.used)},
+    }
+
+
+def eq_from_wire(d: Dict[str, Any]) -> ElasticQuota:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return ElasticQuota(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=ElasticQuotaSpec(
+            min=_resources_from_wire(spec.get("min")),
+            max=_resources_from_wire(spec.get("max")),
+        ),
+        status=ElasticQuotaStatus(used=_resources_from_wire(status.get("used"))),
+    )
+
+
+def ceq_to_wire(ceq: CompositeElasticQuota) -> Dict[str, Any]:
+    return {
+        "apiVersion": "nos.nebuly.com/v1alpha1",
+        "kind": "CompositeElasticQuota",
+        "metadata": meta_to_wire(ceq.metadata),
+        "spec": {
+            "namespaces": list(ceq.spec.namespaces),
+            "min": _resources_to_wire(ceq.spec.min),
+            "max": _resources_to_wire(ceq.spec.max),
+        },
+        "status": {"used": _resources_to_wire(ceq.status.used)},
+    }
+
+
+def ceq_from_wire(d: Dict[str, Any]) -> CompositeElasticQuota:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return CompositeElasticQuota(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=CompositeElasticQuotaSpec(
+            namespaces=list(spec.get("namespaces") or []),
+            min=_resources_from_wire(spec.get("min")),
+            max=_resources_from_wire(spec.get("max")),
+        ),
+        status=ElasticQuotaStatus(used=_resources_from_wire(status.get("used"))),
+    )
+
+
+# ----------------------------------------------------------------- dispatch
+
+_TO_WIRE = {
+    "Pod": pod_to_wire,
+    "Node": node_to_wire,
+    "ConfigMap": configmap_to_wire,
+    "PodDisruptionBudget": pdb_to_wire,
+    "ElasticQuota": eq_to_wire,
+    "CompositeElasticQuota": ceq_to_wire,
+}
+
+_FROM_WIRE = {
+    "Pod": pod_from_wire,
+    "Node": node_from_wire,
+    "ConfigMap": configmap_from_wire,
+    "PodDisruptionBudget": pdb_from_wire,
+    "ElasticQuota": eq_from_wire,
+    "CompositeElasticQuota": ceq_from_wire,
+}
+
+
+def to_wire(obj: Any) -> Dict[str, Any]:
+    return _TO_WIRE[obj.kind](obj)
+
+
+def from_wire(d: Dict[str, Any]) -> Any:
+    return _FROM_WIRE[d["kind"]](d)

@@ -31,8 +31,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     # "dense" (XLA einsum) or "flash" (Pallas kernel, nos_tpu/ops/ —
-    # forward-only, for inference/serving paths).
+    # differentiable via its custom_vjp; O(S) memory for training and
+    # serving at long context).
     attention: str = "dense"
+    # Per-layer rematerialisation: save only each block's input and
+    # recompute activations in the backward — trades ~1/3 more FLOPs for
+    # activation memory that no longer scales with n_layers, which is what
+    # lets a 16 GB chip train at real batch×sequence sizes.
+    remat: bool = False
     # n_experts > 0 swaps every MLP for a routed mixture-of-experts
     # (nos_tpu/models/moe.py) with experts sharded over the ep mesh axis.
     n_experts: int = 0
@@ -169,7 +175,8 @@ def _attention(
         return ring_attention(q, k, v, mesh, causal=True) @ layer["wo"]
 
     if c.attention == "flash":
-        # Single-chip blockwise attention on the MXU (nos_tpu/ops/).
+        # Single-chip blockwise attention on the MXU (nos_tpu/ops/); the
+        # kernel's custom_vjp makes this branch trainable.
         from nos_tpu.ops import flash_attention
 
         out = flash_attention(
@@ -210,8 +217,7 @@ def llama_forward(
     x = params["embed"][tokens]
     # Position tables depend only on (seq_len, head_dim): one per forward.
     cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype)
-    aux_total = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
+    def block(x, layer):
         x = x + _attention(
             _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin, mesh
         )
@@ -223,17 +229,41 @@ def llama_forward(
                 delta, aux = moe_mlp(
                     layer["moe"], h, c.moe_config(), mesh, return_aux=True
                 )
-                aux_total = aux_total + aux
             else:
                 delta = moe_mlp(layer["moe"], h, c.moe_config(), mesh)
-            x = x + delta
+                aux = jnp.zeros((), jnp.float32)
         else:
-            x = x + _mlp(h, layer)
+            delta = _mlp(h, layer)
+            aux = jnp.zeros((), jnp.float32)
+        return x + delta, aux
+
+    if c.remat:
+        # Save only each block's input; recompute the rest in the backward.
+        block = jax.checkpoint(block)
+
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x, aux = block(x, layer)
+        aux_total = aux_total + aux
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if with_aux:
         return logits, aux_total
     return logits
+
+
+def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token NLL via nll = logsumexp(logits) - logits[target].
+
+    Equivalent to -log_softmax[target] but never materializes the full
+    [B, S, vocab] log-probability tensor for the backward — at real batch
+    sizes that tensor is GBs of HBM (XLA recomputes the softmax from the
+    saved logits instead)."""
+    targets = tokens[:, 1:]
+    logits_t = logits[:, :-1]
+    lse = jax.scipy.special.logsumexp(logits_t, axis=-1)
+    picked = jnp.take_along_axis(logits_t, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
 
 
 def llama_loss(
@@ -245,10 +275,7 @@ def llama_loss(
     axis) and the final position's logits are dropped from the loss.
     """
     logits, aux = llama_forward(params, tokens, config, mesh, with_aux=True)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(nll)
+    loss = next_token_nll(logits, tokens)
     if config.n_experts > 0:
         # Average the per-layer balance losses; keeps routing spread so the
         # static expert capacity stays effective.

@@ -1,20 +1,27 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 Blockwise exact attention (the same online-softmax math as
 nos_tpu/parallel/ring_attention.py, but within one chip): the [S, S] score
-matrix never leaves VMEM — each grid step holds one query block and streams
-key/value blocks through the MXU, keeping running max / normalizer /
-accumulator in float32. Memory per step is O(blk_q·S + S·hd) VMEM instead
-of O(S²) HBM, and the matmuls are MXU-shaped (last dim 128-padded by the
-caller's head_dim choice).
+matrix never exists — the grid streams key/value blocks through the MXU
+while running max / normalizer / accumulator live in VMEM scratch. K/V
+ride the grid's innermost dimension as (blk_k, hd) blocks, so Pallas
+pipelines their HBM→VMEM DMAs against compute; VMEM per step is
+O(blk_q·hd + blk_k·hd), independent of S — the long-context headroom the
+dense path lacks.
 
-Grid: (batch, q_heads, S/blk_q). GQA is free — the K/V BlockSpec index_map
-sends query head h to kv head h // group, so kv blocks are fetched once per
-group without materializing the expanded heads.
+Training-capable: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes probabilities blockwise from the saved logsumexp
+(never materializing [S, S]) in two more Pallas kernels — one streaming
+K/V per query block (dq), one streaming Q per key/value block (dk/dv).
 
-Forward-only: wrap in jax.custom_vjp with a recompute backward before using
-under grad (the dense path remains the training default; this kernel serves
-inference and serving benches).
+Grid: (batch, q_heads, S/blk_q, S/blk_k). GQA is free — the K/V BlockSpec
+index_map sends query head h to kv head h // group, so kv blocks are
+fetched once per group without materializing the expanded heads; the
+backward accumulates dk/dv per query head and group-sums outside the
+kernel. Causal blocks entirely in the future are skipped with ``pl.when``.
+
+Replaces the reference's dense-attention workloads (nos has no kernels —
+its "workloads" are Pods); this is the TPU build's own perf frontier.
 """
 from __future__ import annotations
 
@@ -26,49 +33,316 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_NEG_INF = -1e30
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool, scale: float):
-    q = q_ref[0, 0].astype(jnp.float32)  # [blk_q, hd]
-    blk_q = q.shape[0]
-    seq_len = k_ref.shape[2]
-    n_kv_blocks = seq_len // blk_k
+
+def _causal_mask(blk_q: int, blk_k: int, q_start, k_start):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return kv_pos <= q_pos
+
+
+# ------------------------------------------------------------------ forward
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, blk_q: int, blk_k: int, causal: bool, scale: float,
+):
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * blk_q
+    k_start = ki * blk_k
 
-    m0 = jnp.full((blk_q, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((blk_q, 1), jnp.float32)
-    acc0 = jnp.zeros((blk_q, q.shape[1]), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+    # Causal: blocks fully in the future contribute nothing — skip the MXU
+    # work (the DMA was already pipelined; compute is the bottleneck).
+    needed = True if not causal else k_start <= q_start + blk_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        # Matmuls stay in the input dtype (bf16) with f32 accumulation —
+        # the MXU's native mode; casting inputs to f32 first would demote
+        # every matmul to the slow f32 path. Softmax stats run f32 on the
+        # VPU.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [blk_q, blk_k]
+        ) * scale  # [blk_q, blk_k] f32
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            kv_pos = j * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(kv_pos <= q_pos, s, -jnp.inf)
+            s = jnp.where(_causal_mask(blk_q, blk_k, q_start, k_start), s, -jnp.inf)
+        m_prev = m_scr[...]
         blk_max = jnp.max(s, axis=1, keepdims=True)
-        new_m = jnp.maximum(m, blk_max)
-        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        m_new = jnp.maximum(m_prev, blk_max)
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - safe_m)
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        return new_m, l, acc
 
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = l_scr[...]
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l)  # [blk_q, 1]
+
+
+def _fwd_pallas(qt, kt, vt, *, causal, blk_q, blk_k, group, interpret, scale):
+    b, hq, s, hd = qt.shape
+    grid = (b, hq, s // blk_q, s // blk_k)
+    kernel = functools.partial(
+        _fwd_kernel, blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            # Row stats ride as [B, H, S, 1]: a trailing unit dim keeps the
+            # block's minor dims legal for the TPU tiling (blk_q × 1).
+            pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, s, hd), qt.dtype),
+            jax.ShapeDtypeStruct((b, hq, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+
+# ----------------------------------------------------------------- backward
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, k_start):
+    """Shared backward block math: recompute p from lse, form ds.
+
+    lse/delta arrive as [blk_q, 1] f32 column stats and broadcast. Inputs
+    stay bf16 into the MXU (f32 accumulate); p/ds round back to the input
+    dtype for their second matmuls — same rounding as the forward."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    p = jnp.exp(s - lse)  # exact softmax prob: lse = m + log l
     if causal:
-        # Blocks fully in the future contribute nothing: stop the stream at
-        # the last block intersecting this query block's causal frontier.
-        upper = jax.lax.div(q_start + blk_q + blk_k - 1, blk_k)
-        upper = jnp.minimum(upper, n_kv_blocks)
-    else:
-        upper = n_kv_blocks
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+        p = jnp.where(_causal_mask(blk_q, blk_k, q_start, k_start), p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta) * scale
+    return p.astype(q.dtype), ds.astype(q.dtype)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, blk_q: int, blk_k: int, causal: bool, scale: float,
+):
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_start = pl.program_id(2) * blk_q
+    k_start = ki * blk_k
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    needed = True if not causal else k_start <= q_start + blk_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        _, ds = _bwd_p_ds(
+            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
+            blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale,
+            q_start=q_start, k_start=k_start,
+        )
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    *, blk_q: int, blk_k: int, causal: bool, scale: float,
+):
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    q_start = qi * blk_q
+    k_start = pl.program_id(2) * blk_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    needed = True if not causal else k_start <= q_start + blk_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        p, ds = _bwd_p_ds(
+            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
+            blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale,
+            q_start=q_start, k_start=k_start,
+        )
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(qt, kt, vt, dot, lse, delta, *, causal, blk_q, blk_k, group, interpret, scale):
+    b, hq, s, hd = qt.shape
+    kwargs = dict(blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale)
+    q_spec = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+    )
+    row_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kwargs),
+        grid=(b, hq, s // blk_q, s // blk_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=pl.BlockSpec(
+            (1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hq, s, hd), qt.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, hd), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # dk/dv: stream Q blocks (innermost) per K/V block. Accumulated per
+    # QUERY head ([B, Hq, S, hd]); the GQA group-sum happens outside.
+    q_spec_t = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    kv_spec_t = pl.BlockSpec(
+        (1, 1, blk_k, hd), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)
+    )
+    row_spec_t = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    dkv_out = pl.BlockSpec((1, 1, blk_k, hd), lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    dkh, dvh = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kwargs),
+        grid=(b, hq, s // blk_k, s // blk_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, s, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, s, hd), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, hd), jnp.float32),
+            pltpu.VMEM((blk_k, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    hkv = hq // group
+    dk = dkh.reshape(b, hkv, group, s, hd).sum(axis=2).astype(kt.dtype)
+    dv = dvh.reshape(b, hkv, group, s, hd).sum(axis=2).astype(vt.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- custom_vjp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+    b, s, hq, hd = q.shape
+    group = hq // k.shape[2]
+    scale = 1.0 / math.sqrt(hd)
+    # [B, H, S, hd] puts (sequence, head_dim) in the tiled trailing dims.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot, lse = _fwd_pallas(
+        qt, kt, vt, causal=causal, blk_q=blk_q, blk_k=blk_k,
+        group=group, interpret=interpret, scale=scale,
+    )
+    out = ot.transpose(0, 2, 1, 3)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, blk_q, blk_k, interpret, res, do):
+    q, k, v, out, lse = res
+    b, s, hq, hd = q.shape
+    group = hq // k.shape[2]
+    scale = 1.0 / math.sqrt(hd)
+    # delta_i = rowsum(do_i · o_i): cheap elementwise, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)[..., None]  # [B, Hq, S, 1]
+    dq, dk, dv = _bwd_pallas(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        do.transpose(0, 2, 1, 3),
+        lse,
+        delta,
+        causal=causal, blk_q=blk_q, blk_k=blk_k,
+        group=group, interpret=interpret, scale=scale,
+    )
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _divisor_block(s: int, blk: int) -> int:
@@ -85,59 +359,27 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    blk_q: int = 128,
-    blk_k: int = 128,
+    blk_q: int = 256,
+    blk_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """q [B, S, Hq, hd], k/v [B, S, Hkv, hd] → [B, S, Hq, hd].
 
     Hq must be a multiple of Hkv (GQA). S must divide by the block sizes
-    (block sizes clamp down to S for short sequences).
+    (block sizes clamp down to S for short sequences). Differentiable:
+    the custom_vjp backward recomputes attention blockwise from the saved
+    logsumexp — O(S) memory end to end.
+
+    Default blocks (256 q × 512 kv) keep each MXU dot large enough to
+    amortize grid overhead while staying far under VMEM with double
+    buffering.
     """
     b, s, hq, hd = q.shape
     hkv = k.shape[2]
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
-    group = hq // hkv
     # Clamp block sizes to the largest divisor of S: arbitrary prompt
     # lengths work, power-of-two lengths keep full MXU-shaped blocks.
     blk_q = _divisor_block(s, blk_q)
     blk_k = _divisor_block(s, blk_k)
-
-    # [B, H, S, hd] puts (sequence, head_dim) in the tiled trailing dims.
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-
-    kernel = functools.partial(
-        _flash_kernel, blk_k=blk_k, causal=causal, scale=1.0 / math.sqrt(hd)
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b, hq, s // blk_q),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, blk_q, hd),
-                lambda bi, hi, qi: (bi, hi, qi, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, s, hd),
-                lambda bi, hi, qi: (bi, hi // group, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, s, hd),
-                lambda bi, hi, qi: (bi, hi // group, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, blk_q, hd),
-            lambda bi, hi, qi: (bi, hi, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, hq, s, hd), q.dtype),
-        interpret=interpret,
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return _flash(q, k, v, causal, blk_q, blk_k, interpret)

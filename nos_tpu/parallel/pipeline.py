@@ -37,8 +37,11 @@ def stack_layer_params(params: Params) -> Params:
 
 
 def pipeline_param_sharding(mesh: Mesh, config: LlamaConfig) -> Params:
-    """Stacked layers shard dim 0 over pp (and hidden dims over tp when
-    present); embed/head replicate over pp like the dense rules."""
+    """Stacked layers shard dim 0 over pp; the per-layer dims keep the
+    dense rules — hidden over tp AND the FSDP dp shard, so each stage's
+    resident layer slabs are further chip-count-fractional (ZeRO-style;
+    the shard_map all-gathers them on use). embed/head replicate over pp
+    like the dense rules."""
     from nos_tpu.parallel.sharding import llama_param_sharding
 
     base = llama_param_sharding(mesh, config)
@@ -153,8 +156,7 @@ def pipeline_llama_loss(
     mesh: Mesh,
     n_microbatches: int = 0,
 ) -> jax.Array:
+    from nos_tpu.models.llama import next_token_nll
+
     logits = pipeline_llama_forward(params, tokens, config, mesh, n_microbatches)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return next_token_nll(logits, tokens)

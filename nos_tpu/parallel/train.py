@@ -49,11 +49,22 @@ def make_train_step(mesh: Mesh, config: LlamaConfig, learning_rate: float = 1e-3
         )
         return (new_params, new_velocity), loss
 
-    def shard_state(params):
+    def shard_state(params, donate: bool = False):
+        """Shard (params, zero-velocity) onto the mesh.
+
+        By default the caller's ``params`` remain valid afterwards: the
+        resharding goes through a jitted identity, which always produces
+        fresh buffers (``jax.device_put`` aliases when the sharding already
+        matches — e.g. on a 1-device mesh — and ``train_step`` then donates
+        the caller's own arrays out from under them). Pass ``donate=True``
+        to hand the buffers over instead, halving peak HBM when params were
+        freshly initialized and will not be reused.
+        """
         velocity = jax.tree.map(jnp.zeros_like, params)
-        return (
-            jax.device_put(params, param_sharding),
-            jax.device_put(velocity, param_sharding),
-        )
+        if donate:
+            params = jax.device_put(params, param_sharding)
+        else:
+            params = jax.jit(lambda p: p, out_shardings=param_sharding)(params)
+        return (params, jax.device_put(velocity, param_sharding))
 
     return train_step, shard_state

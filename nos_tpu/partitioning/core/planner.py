@@ -25,6 +25,12 @@ from nos_tpu.tpu.topology import Topology
 log = logging.getLogger("nos_tpu.partitioning")
 
 
+def _gang_of(pod: Pod):
+    from nos_tpu.scheduler.plugins.gang import gang_of
+
+    return gang_of(pod)
+
+
 def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
     """Priority first, then smallest slice request, then namespace/name
     (reference core/util.go:34-71): high-priority pods get first pick and
@@ -65,6 +71,49 @@ class Planner:
             return snapshot.partitioning_state()
 
         candidates = sort_candidate_pods(pending_pods)
+
+        # Gang fidelity (SURVEY §7 pitfall): a gang member carved for in
+        # isolation wastes a slice the gang can never use. Trial-plan on a
+        # scratch copy first; any gang that cannot FULLY form (running
+        # members + trial placements < size) contributes no pods to the
+        # real plan, so no board is re-carved for a half-formable gang.
+        # The trial (a full deepcopy + simulation pass) only runs when a
+        # gang pod is actually in the batch.
+        import copy as _copy
+
+        excluded: set = set()
+        if any(_gang_of(p) for p in candidates):
+            trial = _copy.deepcopy(snapshot)
+            trial_placed = self._plan_pass(
+                trial, SliceTracker(trial, candidates), candidates, quiet=True
+            )
+            excluded = self._half_formable_gangs(snapshot, candidates, trial_placed)
+        if excluded:
+            log.info(
+                "planner: gangs %s cannot fully form; excluding their pods",
+                sorted(excluded),
+            )
+            candidates = [
+                p for p in candidates
+                if (_gang_of(p) or (None,))[0] not in excluded
+            ]
+            if not candidates:
+                return snapshot.partitioning_state()
+            tracker = SliceTracker(snapshot, candidates)
+            if tracker.empty:
+                return snapshot.partitioning_state()
+
+        self._plan_pass(snapshot, tracker, candidates)
+        return snapshot.partitioning_state()
+
+    def _plan_pass(
+        self,
+        snapshot: ClusterSnapshot,
+        tracker: SliceTracker,
+        candidates: List[Pod],
+        quiet: bool = False,
+    ) -> List[Pod]:
+        placed: List[Pod] = []
         for node_name in snapshot.get_candidate_nodes():
             if tracker.empty:
                 break
@@ -83,13 +132,46 @@ class Planner:
                     continue
                 if self._try_add_pod(snapshot, node_name, pod):
                     tracker.remove(pod)
+                    placed.append(pod)
                     added_any = True
             if added_any:
                 snapshot.commit()
-                log.info("planner: node %s re-carved for pending pods", node_name)
+                if not quiet:
+                    log.info("planner: node %s re-carved for pending pods", node_name)
             else:
                 snapshot.revert()
-        return snapshot.partitioning_state()
+        return placed
+
+    @staticmethod
+    def _half_formable_gangs(
+        snapshot: ClusterSnapshot, candidates: List[Pod], trial_placed: List[Pod]
+    ) -> set:
+        """Gang keys whose running + trial-placed membership < size."""
+        sizes = {}
+        placed_count: dict = {}
+        for pod in candidates:
+            gang = _gang_of(pod)
+            if gang:
+                sizes[gang[0]] = gang[1]
+        if not sizes:
+            return set()
+        for pod in trial_placed:
+            gang = _gang_of(pod)
+            if gang:
+                placed_count[gang[0]] = placed_count.get(gang[0], 0) + 1
+        bound_count: dict = {}
+        # ALL nodes, not just carve candidates: a member running on a
+        # fully-carved node still counts toward gang completeness.
+        for snap_node in snapshot.get_nodes().values():
+            for pod in snap_node.pods:
+                gang = _gang_of(pod)
+                if gang:
+                    bound_count[gang[0]] = bound_count.get(gang[0], 0) + 1
+        return {
+            key
+            for key, size in sizes.items()
+            if bound_count.get(key, 0) + placed_count.get(key, 0) < size
+        }
 
     # ------------------------------------------------------------------
 

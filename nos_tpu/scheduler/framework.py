@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Sequence
 
-from nos_tpu.kube.objects import Node, Pod, ResourceList
+from nos_tpu.kube.objects import Node, Pod, ResourceList, Taint
 from nos_tpu.util import resources as res
 
 
@@ -282,3 +282,65 @@ class NodeSelectorFit:
                     f"node selector {key}={value} not satisfied", self.name
                 )
         return Status.ok()
+
+
+class NodeAffinityFit:
+    """Required node-affinity filter: the node's labels must satisfy at
+    least one nodeSelectorTerm (the in-tree NodeAffinity predicate the
+    reference's embedded simulation inherits from the full plugin set,
+    cmd/gpupartitioner/gpupartitioner.go:294-318)."""
+
+    name = "NodeAffinity"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        affinity = pod.spec.affinity
+        if affinity is None or affinity.matches(node_info.node.metadata.labels):
+            return Status.ok()
+        return Status.unschedulable("required node affinity not satisfied", self.name)
+
+
+class TaintTolerationFit:
+    """NoSchedule/NoExecute taints must each be tolerated (in-tree
+    TaintToleration predicate; PreferNoSchedule only affects scoring and is
+    ignored here like the vanilla filter does)."""
+
+    name = "TaintToleration"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        for taint in node_info.node.spec.taints:
+            if taint.effect not in ("NoSchedule", "NoExecute"):
+                continue
+            if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+                return Status.unschedulable(
+                    f"untolerated taint {taint.key}={taint.value}:{taint.effect}",
+                    self.name,
+                )
+        return Status.ok()
+
+
+class NodeUnschedulableFit:
+    """Cordoned nodes (`kubectl cordon` → spec.unschedulable) admit nothing
+    without an explicit unschedulable toleration."""
+
+    name = "NodeUnschedulable"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if not node_info.node.spec.unschedulable:
+            return Status.ok()
+        cordon = Taint(key="node.kubernetes.io/unschedulable", effect="NoSchedule")
+        if any(t.tolerates(cordon) for t in pod.spec.tolerations):
+            return Status.ok()
+        return Status.unschedulable("node is cordoned (unschedulable)", self.name)
+
+
+def vanilla_filter_plugins() -> List[FilterPlugin]:
+    """The in-tree predicate set both the real scheduler and the planner's
+    embedded simulation run — keeping the two aligned is what prevents the
+    planner from carving slices the scheduler would then refuse to use."""
+    return [
+        NodeUnschedulableFit(),
+        TaintTolerationFit(),
+        NodeAffinityFit(),
+        NodeSelectorFit(),
+        NodeResourcesFit(),
+    ]

@@ -22,8 +22,7 @@ from nos_tpu.scheduler.framework import (
     CycleState,
     Framework,
     NodeInfo,
-    NodeResourcesFit,
-    NodeSelectorFit,
+    vanilla_filter_plugins,
     Status,
     StatusCode,
 )
@@ -44,7 +43,7 @@ def new_framework(
     gang = GangScheduling(store, wait_timeout_seconds=gang_timeout_seconds)
     framework = Framework(
         pre_filter_plugins=[capacity],
-        filter_plugins=[NodeResourcesFit(), NodeSelectorFit()],
+        filter_plugins=vanilla_filter_plugins(),
         post_filter_plugins=[capacity],
         reserve_plugins=[capacity],
         permit_plugins=[gang],

@@ -1,0 +1,381 @@
+"""KubeApiStore against a real HTTP apiserver stub.
+
+The envtest analogue for this suite (reference boots etcd+apiserver in
+suite_int_test.go:56-63): every test talks through real sockets, chunked
+watch streams, and resourceVersion conflicts — the exact code path a
+production cluster exercises.
+"""
+import threading
+import time
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.api.v1alpha1.constants import RESOURCE_TPU_CHIPS
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.kube import serde
+from nos_tpu.kube.apiclient import ApiError, ClusterCredentials, KubeApiClient
+from nos_tpu.kube.apistore import KubeApiStore
+from nos_tpu.kube.objects import (
+    ConfigMap,
+    Container,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.kube.store import ConflictError, NotFoundError
+from tests.kube.stub_apiserver import StubApiServer
+
+
+@pytest.fixture()
+def api():
+    with StubApiServer() as server:
+        yield server
+
+
+def make_client(server: StubApiServer) -> KubeApiClient:
+    return KubeApiClient(ClusterCredentials(server=server.url), timeout=5.0)
+
+
+@pytest.fixture()
+def store(api):
+    s = KubeApiStore(make_client(api), kinds=("Pod", "Node", "ConfigMap", "ElasticQuota"))
+    s.start(sync_timeout_s=10.0)
+    yield s
+    s.stop()
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def make_pod(name="p1", ns="default", chips=4) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests={RESOURCE_TPU_CHIPS: chips})]),
+    )
+
+
+class TestApiClient:
+    def test_crud_roundtrip(self, api):
+        client = make_client(api)
+        path = serde.resource_path("Pod", "default")
+        wire = serde.to_wire(make_pod())
+        created = client.create(path, wire)
+        assert created["metadata"]["uid"]  # stub keeps client uid or mints one
+        got = client.get(serde.resource_path("Pod", "default", "p1"))
+        assert got["spec"]["containers"][0]["resources"]["requests"][RESOURCE_TPU_CHIPS] == "4"
+        items, rv = client.list(serde.resource_path("Pod"))
+        assert len(items) == 1 and int(rv) >= 1
+        client.delete(serde.resource_path("Pod", "default", "p1"))
+        with pytest.raises(ApiError) as ei:
+            client.get(serde.resource_path("Pod", "default", "p1"))
+        assert ei.value.status == 404
+
+    def test_put_conflict_on_stale_rv(self, api):
+        client = make_client(api)
+        path = serde.resource_path("Pod", "default")
+        created = client.create(path, serde.to_wire(make_pod()))
+        item_path = serde.resource_path("Pod", "default", "p1")
+        client.replace(item_path, created)  # rv still fresh: ok
+        with pytest.raises(ApiError) as ei:
+            client.replace(item_path, created)  # now stale
+        assert ei.value.status == 409
+
+    def test_watch_streams_events(self, api):
+        client = make_client(api)
+        _, rv = client.list(serde.resource_path("Pod"))
+        seen = []
+        done = threading.Event()
+
+        def consume():
+            for event in client.watch(serde.resource_path("Pod"), rv, timeout_seconds=5):
+                seen.append((event["type"], event["object"]["metadata"]["name"]))
+                if len(seen) >= 2:
+                    break
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        client.create(serde.resource_path("Pod", "default"), serde.to_wire(make_pod()))
+        client.delete(serde.resource_path("Pod", "default", "p1"))
+        assert done.wait(5.0)
+        assert seen == [("ADDED", "p1"), ("DELETED", "p1")]
+
+
+class TestKubeApiStore:
+    def test_read_your_writes(self, store):
+        store.create(make_pod())
+        pod = store.get("Pod", "p1", "default")
+        assert pod.spec.containers[0].requests[RESOURCE_TPU_CHIPS] == 4
+
+    def test_informer_sees_external_objects(self, api, store):
+        # An "external client" (kubectl analogue) writes directly to the
+        # apiserver; the informer must surface it.
+        api.inject("configmaps", serde.to_wire(
+            ConfigMap(metadata=ObjectMeta(name="ext", namespace="kube-system"),
+                      data={"k": "v"})
+        ))
+        assert wait_for(lambda: store.try_get("ConfigMap", "ext", "kube-system"))
+        assert store.get("ConfigMap", "ext", "kube-system").data == {"k": "v"}
+
+    def test_watch_events_flow_through_store(self, store):
+        q = store.watch(kinds={"Pod"})
+        store.create(make_pod())
+        event = q.get(timeout=5.0)
+        assert event.type == "ADDED" and event.object.metadata.name == "p1"
+
+    def test_patch_merge_persists_to_apiserver(self, api, store):
+        store.create(make_pod())
+
+        def set_phase(p):
+            p.status.phase = PodPhase.RUNNING
+
+        store.patch_merge("Pod", "p1", "default", set_phase)
+        wire = api.read("pods", "default", "p1")
+        assert wire["status"]["phase"] == "Running"
+
+    def test_patch_merge_retries_conflicts(self, api, store):
+        store.create(make_pod())
+        client = make_client(api)
+        item_path = serde.resource_path("Pod", "default", "p1")
+        raced = {"done": False}
+
+        def mutate(p):
+            # Simulate a concurrent writer racing the first attempt: bump
+            # the object behind patch_merge's back exactly once.
+            if not raced["done"]:
+                raced["done"] = True
+                live = client.get(item_path)
+                live["metadata"]["labels"] = {"raced": "yes"}
+                client.replace(item_path, live)
+            p.metadata.annotations["patched"] = "true"
+
+        out = store.patch_merge("Pod", "p1", "default", mutate)
+        assert out.metadata.annotations["patched"] == "true"
+        # the racer's write survived too (retry re-read the live object)
+        assert api.read("pods", "default", "p1")["metadata"]["labels"] == {"raced": "yes"}
+
+    def test_delete_and_not_found(self, store):
+        store.create(make_pod())
+        store.delete("Pod", "p1", "default")
+        with pytest.raises(NotFoundError):
+            store.get("Pod", "p1", "default")
+        with pytest.raises(NotFoundError):
+            store.delete("Pod", "p1", "default")
+
+    def test_update_conflict_surface(self, api, store):
+        store.create(make_pod())
+        stale = store.get("Pod", "p1", "default")
+
+        def relabel(p):
+            p.metadata.labels["touched"] = "yes"
+
+        store.patch_merge("Pod", "p1", "default", relabel)  # bumps rv
+        with pytest.raises(ConflictError):
+            store.update(stale, check_version=True)
+
+    def test_noop_patch_sends_nothing(self, api, store):
+        store.create(make_pod())
+        before = api.read("pods", "default", "p1")["metadata"]["resourceVersion"]
+        store.patch_merge("Pod", "p1", "default", lambda p: None)
+        after = api.read("pods", "default", "p1")["metadata"]["resourceVersion"]
+        assert before == after  # empty diff -> no write at all
+
+    def test_bind_goes_through_binding_subresource(self, api, store):
+        store.create(make_pod())
+
+        def bind(p):
+            p.spec.node_name = "tpu-7"
+
+        store.patch_merge("Pod", "p1", "default", bind)
+        wire = api.read("pods", "default", "p1")
+        assert wire["spec"]["nodeName"] == "tpu-7"
+        # the stub rejects nodeName via plain PATCH (422), so reaching here
+        # proves the /binding subresource path was used
+
+    def test_status_goes_through_status_subresource(self, api, store):
+        store.create(make_pod())
+
+        def run_and_label(p):
+            p.status.phase = PodPhase.RUNNING
+            p.metadata.labels["state"] = "live"
+
+        store.patch_merge("Pod", "p1", "default", run_and_label)
+        wire = api.read("pods", "default", "p1")
+        assert wire["status"]["phase"] == "Running"
+        assert wire["metadata"]["labels"] == {"state": "live"}
+
+    def test_patch_preserves_unmodeled_fields(self, api, store):
+        """Fields outside the suite's model (volumes, serviceAccount, …)
+        must survive a patch_merge — the merge diff only mentions modeled
+        fields it changed."""
+        wire = serde.to_wire(make_pod("rich"))
+        wire["spec"]["serviceAccountName"] = "train-sa"
+        wire["spec"]["volumes"] = [{"name": "data", "emptyDir": {}}]
+        api.inject("pods", wire)
+        assert wait_for(lambda: store.try_get("Pod", "rich", "default"))
+        store.patch_merge(
+            "Pod", "rich", "default",
+            lambda p: p.metadata.annotations.update({"x": "y"}),
+        )
+        after = api.read("pods", "default", "rich")
+        assert after["spec"]["serviceAccountName"] == "train-sa"
+        assert after["spec"]["volumes"] == [{"name": "data", "emptyDir": {}}]
+        assert after["metadata"]["annotations"]["x"] == "y"
+
+    def test_indexers_work_over_cache(self, store):
+        store.add_indexer("Pod", "phase", lambda p: [p.status.phase])
+        store.create(make_pod("a"))
+        store.create(make_pod("b"))
+        assert len(store.list_by_index("Pod", "phase", PodPhase.PENDING)) == 2
+
+
+class TestOperatorAgainstApi:
+    def test_eq_overquota_labels_on_real_api_objects(self, api):
+        """The VERDICT done-criterion shape: `operator` reconciles real EQ
+        CRDs end to end — over-quota labels land on objects living in the
+        (stub) apiserver, via watches, not in-process shortcuts."""
+        from nos_tpu.api.config import OperatorConfig
+        from nos_tpu.cmd.operator import build_operator
+        from nos_tpu.kube.controller import Manager
+
+        store = KubeApiStore(
+            make_client(api), kinds=("Pod", "ElasticQuota", "CompositeElasticQuota")
+        )
+        store.start(sync_timeout_s=10.0)
+        manager = Manager(store=store)
+        build_operator(manager, OperatorConfig())
+        manager.start()
+        try:
+            store.create(
+                ElasticQuota(
+                    metadata=ObjectMeta(name="eq-a", namespace="team-a"),
+                    spec=ElasticQuotaSpec(
+                        min={RESOURCE_TPU_CHIPS: 4}, max={RESOURCE_TPU_CHIPS: 8}
+                    ),
+                )
+            )
+            pod = make_pod("train", ns="team-a", chips=6)  # over min -> over-quota
+            pod.spec.node_name = "tpu-0"
+            pod.status.phase = PodPhase.RUNNING
+            store.create(pod)
+
+            def quota_used():
+                wire = api.read("elasticquotas", "team-a", "eq-a")
+                used = ((wire or {}).get("status") or {}).get("used") or {}
+                return used.get(RESOURCE_TPU_CHIPS) == "6"
+
+            assert wait_for(quota_used, timeout=10.0), api.read(
+                "elasticquotas", "team-a", "eq-a"
+            )
+            wire_pod = api.read("pods", "team-a", "train")
+            assert (
+                wire_pod["metadata"]["labels"].get(labels.CAPACITY_LABEL)
+                == labels.CAPACITY_OVER_QUOTA
+            ), wire_pod["metadata"].get("labels")
+        finally:
+            manager.stop()
+            store.stop()
+
+
+class TestOperatorProcess:
+    def test_operator_binary_with_kubeconfig_store(self, api, tmp_path):
+        """`python -m nos_tpu operator --config …` with `store: kubeconfig`
+        connects to an apiserver over real sockets and reconciles EQ CRDs
+        it did not create — the deploy-artifact path, end to end."""
+        import os
+        import pathlib
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import urllib.request
+
+        import yaml
+
+        repo = pathlib.Path(__file__).resolve().parents[2]
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(yaml.safe_dump({
+            "current-context": "stub",
+            "contexts": [{"name": "stub",
+                          "context": {"cluster": "stub", "user": "stub"}}],
+            "clusters": [{"name": "stub", "cluster": {"server": api.url}}],
+            "users": [{"name": "stub", "user": {}}],
+        }))
+        cfg = tmp_path / "operator.yaml"
+        cfg.write_text(yaml.safe_dump({
+            "store": {
+                "type": "kubeconfig",
+                "kubeconfig": str(kubeconfig),
+                "kinds": ["Pod", "ElasticQuota", "CompositeElasticQuota"],
+            }
+        }))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "nos_tpu", "operator",
+             "--config", str(cfg), "--health-port", str(port)],
+            cwd=repo,
+            env={**os.environ, "PYTHONPATH": str(repo)},
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+        try:
+            def healthy():
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1
+                    ) as resp:
+                        return resp.status == 200
+                except OSError:
+                    return False
+
+            assert wait_for(healthy, timeout=20.0)
+            api.inject("elasticquotas", serde.to_wire(ElasticQuota(
+                metadata=ObjectMeta(name="eq-x", namespace="team-x"),
+                spec=ElasticQuotaSpec(min={RESOURCE_TPU_CHIPS: 4},
+                                      max={RESOURCE_TPU_CHIPS: 8}),
+            )))
+            pod = make_pod("train", ns="team-x", chips=2)
+            pod.spec.node_name = "tpu-0"
+            pod.status.phase = PodPhase.RUNNING
+            api.inject("pods", serde.to_wire(pod))
+
+            def quota_used():
+                wire = api.read("elasticquotas", "team-x", "eq-x")
+                used = ((wire or {}).get("status") or {}).get("used") or {}
+                return used.get(RESOURCE_TPU_CHIPS) == "2"
+
+            assert wait_for(quota_used, timeout=15.0), api.read(
+                "elasticquotas", "team-x", "eq-x")
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+class TestInformerDegradation:
+    def test_missing_crd_serves_empty_and_boots(self):
+        """A cluster without the nos CRDs must not wedge component boot:
+        the informer reports synced-empty for the unavailable kind."""
+        with StubApiServer(disabled_plurals={"elasticquotas"}) as api:
+            store = KubeApiStore(
+                make_client(api), kinds=("Pod", "ElasticQuota"), relist_backoff_s=0.2
+            )
+            store.start(sync_timeout_s=10.0)  # must NOT raise TimeoutError
+            try:
+                assert store.list("ElasticQuota") == []
+                store.create(make_pod())  # the available kind still works
+                assert store.get("Pod", "p1", "default")
+            finally:
+                store.stop()

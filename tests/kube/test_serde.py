@@ -1,0 +1,137 @@
+"""Wire-codec round trips: obj -> K8s JSON -> obj is identity for every
+field the suite reads, and quantities follow the documented convention."""
+import pytest
+
+from nos_tpu.api.v1alpha1.elasticquota import (
+    CompositeElasticQuota,
+    CompositeElasticQuotaSpec,
+    ElasticQuota,
+    ElasticQuotaSpec,
+)
+from nos_tpu.kube import serde
+from nos_tpu.kube.objects import (
+    Container,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "wire,value",
+        [
+            ("4", 4.0),
+            ("500m", 0.5),
+            (8, 8.0),
+        ],
+    )
+    def test_parse_counts(self, wire, value):
+        assert serde.parse_quantity(wire) == pytest.approx(value)
+
+    @pytest.mark.parametrize(
+        "wire,gi",
+        [
+            ("16Gi", 16.0),
+            ("512Mi", 0.5),
+            ("1G", 1e9 / 2**30),
+            (str(2**30), 1.0),  # plain bytes
+            (2**31, 2.0),
+        ],
+    )
+    def test_parse_memory_normalizes_every_spelling_to_gi(self, wire, gi):
+        assert serde.parse_quantity(wire, memory=True) == pytest.approx(gi)
+
+    def test_mixed_spellings_compare_on_one_scale(self):
+        # a pod asking "1G" fits a node advertising "16Gi" (the review
+        # scenario: raw-unit parsing made this reject every node)
+        req = serde._resources_from_wire({"memory": "1G"})
+        alloc = serde._resources_from_wire({"memory": "16Gi"})
+        assert req["memory"] < alloc["memory"]
+
+    def test_format_roundtrip(self):
+        assert serde.format_quantity("google.com/tpu", 8) == "8"
+        assert serde.format_quantity("memory", 16.0) == "16Gi"
+        assert serde.format_quantity("memory", 0.5) == "512Mi"
+        assert serde.format_quantity("cpu", 0.5) == "500m"
+
+
+class TestRoundTrips:
+    def test_pod_full(self):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name="p", namespace="ns", labels={"a": "b"},
+                annotations={"x": "y"},
+            ),
+            spec=PodSpec(
+                containers=[Container(requests={"google.com/tpu": 8, "memory": 2.0})],
+                node_name="n1",
+                priority=100,
+                tolerations=[Toleration(key="tpu", operator="Exists", effect="NoSchedule")],
+                node_selector={"pool": "tpu"},
+                affinity=NodeAffinity(required_terms=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(key="topo", operator="In", values=["2x4"]),
+                    ]),
+                ]),
+            ),
+        )
+        back = serde.from_wire(serde.to_wire(pod))
+        assert back.spec.containers[0].requests == {"google.com/tpu": 8, "memory": 2.0}
+        assert back.spec.tolerations[0].operator == "Exists"
+        assert back.spec.affinity.required_terms[0].match_expressions[0].values == ["2x4"]
+        assert back.spec.node_selector == {"pool": "tpu"}
+        assert back.metadata.labels == {"a": "b"}
+
+    def test_node_with_taints(self):
+        node = Node(
+            metadata=ObjectMeta(name="n1", labels={"t": "v"}),
+            spec=NodeSpec(taints=[Taint(key="tpu", value="yes", effect="NoSchedule")],
+                          unschedulable=True),
+            status=NodeStatus(capacity={"google.com/tpu": 8},
+                              allocatable={"google.com/tpu": 8, "memory": 128.0}),
+        )
+        back = serde.from_wire(serde.to_wire(node))
+        assert back.spec.taints[0].key == "tpu"
+        assert back.spec.unschedulable is True
+        assert back.status.allocatable == {"google.com/tpu": 8, "memory": 128.0}
+
+    def test_pdb_eq_ceq(self):
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="ns"),
+            spec=PodDisruptionBudgetSpec(selector={"app": "x"}, min_available=2),
+        )
+        back = serde.from_wire(serde.to_wire(pdb))
+        assert back.spec.selector == {"app": "x"} and back.spec.min_available == 2
+
+        eq = ElasticQuota(
+            metadata=ObjectMeta(name="eq", namespace="ns"),
+            spec=ElasticQuotaSpec(min={"google.com/tpu": 4}, max={"google.com/tpu": 8}),
+        )
+        back = serde.from_wire(serde.to_wire(eq))
+        assert back.spec.min == {"google.com/tpu": 4}
+
+        ceq = CompositeElasticQuota(
+            metadata=ObjectMeta(name="ceq", namespace="default"),
+            spec=CompositeElasticQuotaSpec(namespaces=["a", "b"],
+                                           min={"google.com/tpu": 8}),
+        )
+        back = serde.from_wire(serde.to_wire(ceq))
+        assert back.spec.namespaces == ["a", "b"]
+
+    def test_toleration_taint_matching(self):
+        t = Toleration(key="tpu", operator="Equal", value="yes", effect="NoSchedule")
+        assert t.tolerates(Taint(key="tpu", value="yes", effect="NoSchedule"))
+        assert not t.tolerates(Taint(key="tpu", value="no", effect="NoSchedule"))
+        wildcard = Toleration(operator="Exists")
+        assert wildcard.tolerates(Taint(key="anything", effect="NoExecute"))

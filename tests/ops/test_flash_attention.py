@@ -73,3 +73,97 @@ class TestFlashAttention:
         got = flash_attention(q, k, v, blk_q=16, blk_k=16, interpret=True)
         want = dense_4d(q, k, v)
         assert jnp.allclose(got, want, atol=1e-5)
+
+
+class TestFlashBackward:
+    """The custom_vjp recompute backward vs autodiff-through-dense."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense(self, causal):
+        q, k, v = random_qkv(jax.random.key(10), b=2, s=32, hq=4, hkv=4, hd=16)
+        do_seed = jax.random.normal(jax.random.key(11), q.shape)
+
+        def f_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=causal, blk_q=16, blk_k=16, interpret=True
+            )
+            return jnp.sum(out * do_seed)
+
+        def f_dense(q, k, v):
+            return jnp.sum(dense_4d(q, k, v, causal=causal) * do_seed)
+
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_flash, g_dense):
+            assert jnp.allclose(a, b, atol=1e-4), (
+                name,
+                float(jnp.abs(a - b).max()),
+            )
+
+    def test_gqa_grads_sum_over_group(self):
+        # dk/dv must aggregate all query heads in each kv head's group.
+        q, k, v = random_qkv(jax.random.key(12), b=1, s=32, hq=8, hkv=2, hd=8)
+
+        def f_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, blk_q=8, blk_k=8, interpret=True) ** 2
+            )
+
+        def f_dense(q, k, v):
+            return jnp.sum(dense_4d(q, k, v) ** 2)
+
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_flash, g_dense):
+            assert jnp.allclose(a, b, atol=1e-4), (
+                name,
+                float(jnp.abs(a - b).max()),
+            )
+
+    def test_uneven_blocks(self):
+        q, k, v = random_qkv(jax.random.key(13), b=1, s=48, hq=2, hkv=2, hd=8)
+
+        def f(blk_q, blk_k):
+            return jax.grad(
+                lambda q: jnp.sum(
+                    flash_attention(
+                        q, k, v, blk_q=blk_q, blk_k=blk_k, interpret=True
+                    )
+                    ** 2
+                )
+            )(q)
+
+        # blk_q != blk_k exercises the rectangular causal frontier.
+        assert jnp.allclose(f(16, 8), f(48, 48), atol=1e-4)
+
+    def test_llama_flash_loss_grads_match_dense(self):
+        """attention="flash" is trainable end to end (the round-2 landmine:
+        grad-of-flash used to die inside Pallas AD)."""
+        from nos_tpu.models.llama import init_llama_params, llama_loss, tiny_config
+
+        dense_cfg = tiny_config()
+        flash_cfg = tiny_config(attention="flash")
+        params = init_llama_params(jax.random.key(0), dense_cfg)
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, dense_cfg.vocab_size)
+
+        l_d, g_d = jax.value_and_grad(lambda p: llama_loss(p, tokens, dense_cfg))(params)
+        l_f, g_f = jax.value_and_grad(lambda p: llama_loss(p, tokens, flash_cfg))(params)
+        assert abs(float(l_d) - float(l_f)) < 2e-2
+        wq_d = jnp.asarray(g_d["layers"][0]["wq"], jnp.float32)
+        wq_f = jnp.asarray(g_f["layers"][0]["wq"], jnp.float32)
+        # bf16 model: dense rounds probs to bf16 pre-PV, flash stays f32.
+        assert jnp.allclose(wq_d, wq_f, atol=3e-2), float(jnp.abs(wq_d - wq_f).max())
+
+    def test_remat_grads_match_no_remat(self):
+        from nos_tpu.models.llama import init_llama_params, llama_loss, tiny_config
+
+        cfg = tiny_config()
+        cfg_r = tiny_config(remat=True)
+        params = init_llama_params(jax.random.key(0), cfg)
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+        l, g = jax.value_and_grad(lambda p: llama_loss(p, tokens, cfg))(params)
+        l_r, g_r = jax.value_and_grad(lambda p: llama_loss(p, tokens, cfg_r))(params)
+        assert float(l) == float(l_r)
+        a = jnp.asarray(g["layers"][0]["wq"], jnp.float32)
+        b = jnp.asarray(g_r["layers"][0]["wq"], jnp.float32)
+        assert jnp.allclose(a, b, atol=1e-6), float(jnp.abs(a - b).max())

@@ -104,5 +104,5 @@ class TestPipelineTraining:
         config, params, _ = setup(n_layers=4)
         mesh = mesh_from_devices((2, 2, 2), ("dp", "pp", "tp"))
         sharding = pipeline_param_sharding(mesh, config)
-        assert sharding["layers"]["wq"].spec == ("pp", None, "tp")
+        assert sharding["layers"]["wq"].spec == ("pp", "dp", "tp")
         assert sharding["embed"].spec[0] == "tp"

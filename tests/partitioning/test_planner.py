@@ -170,3 +170,139 @@ class TestPlannerRegressions:
         geometry = {b.board_index: b.resources for b in plan["v5e-virgin"].boards}
         assert geometry[0].get(slice_res("2x2"), 0) >= 1
         assert [p.metadata.name for p in snap.get_node("v5e-virgin").pods] == ["p"]
+
+
+class TestPlannerSimulationFidelity:
+    """VERDICT #5: the planner's embedded simulation runs the same vanilla
+    predicates as the real scheduler (taints, affinity, cordon), so it
+    never carves for a pod the scheduler would then refuse to place."""
+
+    def test_declines_carve_for_untolerated_pod(self):
+        from nos_tpu.kube.objects import Taint
+        from nos_tpu.scheduler.framework import vanilla_filter_plugins
+
+        node = build_tpu_node(name="n1")
+        node.spec.taints = [Taint(key="maintenance", effect="NoSchedule")]
+        snap = snapshot_of(node)
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        planner = Planner(Framework(filter_plugins=vanilla_filter_plugins()))
+        plan = planner.plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) == 0, geometry
+        assert snap.get_node("n1").pods == []
+
+    def test_carves_for_tolerated_pod(self):
+        from nos_tpu.kube.objects import Taint, Toleration
+        from nos_tpu.scheduler.framework import vanilla_filter_plugins
+
+        node = build_tpu_node(name="n1")
+        node.spec.taints = [Taint(key="maintenance", effect="NoSchedule")]
+        snap = snapshot_of(node)
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        pod.spec.tolerations = [Toleration(key="maintenance", operator="Exists")]
+        planner = Planner(Framework(filter_plugins=vanilla_filter_plugins()))
+        plan = planner.plan(snap, [pod])
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) >= 1
+
+    def test_declines_carve_for_cordoned_node(self):
+        from nos_tpu.scheduler.framework import vanilla_filter_plugins
+
+        node = build_tpu_node(name="n1")
+        node.spec.unschedulable = True
+        snap = snapshot_of(node)
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        planner = Planner(Framework(filter_plugins=vanilla_filter_plugins()))
+        planner.plan(snap, [pod])
+        assert snap.get_node("n1").pods == []
+
+    def test_declines_carve_for_affinity_mismatch(self):
+        from nos_tpu.kube.objects import (
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+        from nos_tpu.scheduler.framework import vanilla_filter_plugins
+
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        pod.spec.affinity = NodeAffinity(required_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="pool", operator="In", values=["gold"]),
+            ])
+        ])
+        planner = Planner(Framework(filter_plugins=vanilla_filter_plugins()))
+        planner.plan(snap, [pod])
+        assert snap.get_node("n1").pods == []
+
+
+class TestPlannerGangFidelity:
+    """VERDICT #5: a half-formable gang triggers no carve (SURVEY §7 — a
+    slice carved for a lone gang member is a slice the gang can never use)."""
+
+    def _gang_pod(self, name, gang, size, res=None):
+        pod = build_pod(name, res or {slice_res("2x2"): 1}, ns="team")
+        pod.metadata.labels["nos.nebuly.com/gang"] = gang
+        pod.metadata.labels["nos.nebuly.com/gang-size"] = str(size)
+        return pod
+
+    def test_half_formable_gang_triggers_no_carve(self):
+        # gang of 3 but only 2 members pending and capacity for 2 -> the
+        # gang can never complete; nothing may be carved for it.
+        node = build_tpu_node(name="n1")  # one 2x4 board = 8 chips
+        snap = snapshot_of(node)
+        pods = [self._gang_pod(f"m{i}", "trainer", 3) for i in range(2)]
+        planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+        plan = planner.plan(snap, pods)
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) == 0, geometry
+        assert snap.get_node("n1").pods == []
+
+    def test_fully_formable_gang_is_carved(self):
+        node = build_tpu_node(name="n1")
+        snap = snapshot_of(node)
+        pods = [self._gang_pod(f"m{i}", "trainer", 2) for i in range(2)]
+        planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+        plan = planner.plan(snap, pods)
+        geometry = {b.board_index: b.resources for b in plan["n1"].boards}
+        assert geometry[0].get(slice_res("2x2"), 0) >= 2
+        assert len(snap.get_node("n1").pods) == 2
+
+    def test_gang_exclusion_leaves_other_pods_served(self):
+        node = build_tpu_node(name="n1")
+        snap = snapshot_of(node)
+        loner = build_pod("solo", {slice_res("2x2"): 1})
+        gang = [self._gang_pod(f"m{i}", "trainer", 5) for i in range(2)]
+        planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+        planner.plan(snap, gang + [loner])
+        assert [p.metadata.name for p in snap.get_node("n1").pods] == ["solo"]
+
+    def test_gang_counts_already_running_members(self):
+        # 1 member already bound on the node + 1 pending = size 2: formable.
+        node = build_tpu_node(name="n1")
+        running = self._gang_pod("m0", "trainer", 2)
+        running.spec.node_name = "n1"
+        snap = snapshot_of(node, pods_by_node={"n1": [running]})
+        pending = self._gang_pod("m1", "trainer", 2)
+        planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+        planner.plan(snap, [pending])
+        names = [p.metadata.name for p in snap.get_node("n1").pods]
+        assert "m1" in names
+
+    def test_gang_member_on_fully_carved_node_still_counts(self):
+        # m0 runs on n1 whose board is fully carved (n1 is NOT a carve
+        # candidate); m1 pending with room on n2. The gang (size 2) is
+        # fully formable and must not be excluded.
+        from nos_tpu.api.v1alpha1 import annotations as annot
+
+        full_ann = annot.status_from_devices(free={}, used={0: {"2x4": 1}})
+        n1 = build_tpu_node(name="n1", annotations=full_ann)
+        running = self._gang_pod("m0", "trainer", 2, res={slice_res("2x4"): 1})
+        running.spec.node_name = "n1"
+        n2 = build_tpu_node(name="n2")
+        snap = snapshot_of(n1, n2, pods_by_node={"n1": [running]})
+        assert "n1" not in snap.get_candidate_nodes()  # premise of the test
+        pending = self._gang_pod("m1", "trainer", 2)
+        planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+        planner.plan(snap, [pending])
+        assert [p.metadata.name for p in snap.get_node("n2").pods] == ["m1"]

@@ -208,3 +208,71 @@ class TestReviewRegressions:
         got = store.get("Pod", "w0", "ml")
         assert not got.unschedulable()
         assert got.spec.node_name == ""
+
+
+class TestVanillaPredicates:
+    """Taints/tolerations, required node affinity, and cordon — the in-tree
+    predicate subset VERDICT #5 requires in the real scheduler."""
+
+    def test_skips_tainted_node_binds_tolerating_pod(self):
+        from nos_tpu.kube.objects import Taint, Toleration
+
+        store = KubeStore()
+        tainted = build_node("n-tainted", alloc={"cpu": 8})
+        tainted.spec.taints = [Taint(key="dedicated", value="infra", effect="NoSchedule")]
+        store.create(tainted)
+        s = make_scheduler(store)
+
+        sched_pod(s, store, build_pod("plain", {"cpu": 2}))
+        assert store.get("Pod", "plain", "default").spec.node_name == ""
+
+        tolerant = build_pod("tolerant", {"cpu": 2})
+        tolerant.spec.tolerations = [
+            Toleration(key="dedicated", operator="Equal", value="infra")
+        ]
+        sched_pod(s, store, tolerant)
+        assert store.get("Pod", "tolerant", "default").spec.node_name == "n-tainted"
+
+    def test_prefer_no_schedule_taint_does_not_filter(self):
+        from nos_tpu.kube.objects import Taint
+
+        store = KubeStore()
+        soft = build_node("n-soft", alloc={"cpu": 8})
+        soft.spec.taints = [Taint(key="spot", effect="PreferNoSchedule")]
+        store.create(soft)
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {"cpu": 2}))
+        assert store.get("Pod", "p", "default").spec.node_name == "n-soft"
+
+    def test_cordoned_node_admits_nothing(self):
+        store = KubeStore()
+        cordoned = build_node("n-cordoned", alloc={"cpu": 8})
+        cordoned.spec.unschedulable = True
+        store.create(cordoned)
+        free = build_node("n-free", alloc={"cpu": 8})
+        store.create(free)
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {"cpu": 2}))
+        assert store.get("Pod", "p", "default").spec.node_name == "n-free"
+
+    def test_required_node_affinity(self):
+        from nos_tpu.kube.objects import (
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        store = KubeStore()
+        gold = build_node("n-gold", alloc={"cpu": 8})
+        gold.metadata.labels["pool"] = "gold"
+        store.create(gold)
+        store.create(build_node("n-plain", alloc={"cpu": 64}))
+        s = make_scheduler(store)
+        pod = build_pod("p", {"cpu": 2})
+        pod.spec.affinity = NodeAffinity(required_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="pool", operator="In", values=["gold"])
+            ])
+        ])
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "p", "default").spec.node_name == "n-gold"
