@@ -1,17 +1,20 @@
-"""Local sharing-comparison harness: contention curves on one accelerator.
+"""Local sharing-comparison harness: contention curves on one machine.
 
 Mirrors the reference's experiment (demos/gpu-sharing-comparison/README.md):
 average inference time of a small vision model vs number of workloads
-sharing one device, under each sharing discipline this framework's
-partitioner can actuate:
+sharing one device, under two sharing disciplines. Both disciplines run
+REAL concurrent OS processes — nothing takes turns under a lock — so the
+contention column measures actual interference, not a modeling assumption:
 
-- ``time-shared``  N workers submit concurrently to the same device with no
-  isolation — latency degrades roughly linearly with N (the reference's
-  time-slicing row).
-- ``partitioned``  each worker runs in its own exclusive turn, modeling the
-  hard isolation a carved slice / HBM fraction gives — per-inference
-  latency stays flat regardless of N (the reference's MIG row; real
-  slice isolation needs the operator on a cluster, see README).
+- ``time-shared``  N worker processes all scheduled over the SAME full
+  compute resource (every core) with no isolation; they interfere freely
+  — the reference's time-slicing row, latency grows with N.
+- ``partitioned``  each worker process is pinned to its own EXCLUSIVE,
+  fixed-size core set (``sched_setaffinity``; size = cores / max pods) —
+  the local stand-in for a carved slice's hard isolation: per-inference
+  latency stays flat regardless of how many neighbors exist, because the
+  neighbors physically cannot touch the worker's cores. Real TPU slice /
+  HBM-fraction isolation needs the operator on a cluster (README).
 
 Usage: python harness.py [--pods 1,3,5,7] [--seconds 5]
 Prints a markdown table like the reference's results table.
@@ -19,17 +22,33 @@ Prints a markdown table like the reference's results table.
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import statistics
+import subprocess
 import sys
-import threading
 import time
 
+REPO_ROOT = __file__.rsplit("/demos/", 1)[0]
 
-def build_infer():
+
+# ------------------------------------------------------------------ worker
+
+
+def run_worker() -> None:
+    """One benchmark pod: pin to NOS_DEMO_CORES (if set), run the
+    inference loop for NOS_DEMO_SECONDS, print a JSON latency line."""
+    cores = os.environ.get("NOS_DEMO_CORES", "")
+    if cores:
+        os.sched_setaffinity(0, {int(c) for c in cores.split(",")})
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
     import jax
+
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    sys.path.insert(0, __file__.rsplit("/demos/", 1)[0])
+    sys.path.insert(0, REPO_ROOT)
     from nos_tpu.models.resnet import (
         init_resnet_params,
         resnet_forward,
@@ -40,58 +59,108 @@ def build_infer():
     params = init_resnet_params(jax.random.key(0), config)
     images = jnp.zeros((8, 224, 224, 3), jnp.float32)
     infer = jax.jit(lambda x: resnet_forward(params, x, config))
-    jax.block_until_ready(infer(images))
-    return jax, infer, images
+    jax.block_until_ready(infer(images))  # compile outside the window
 
-
-def timed_loop(jax, infer, images, stop_at: float, out: list) -> None:
+    seconds = float(os.environ.get("NOS_DEMO_SECONDS", "5"))
+    # Ready/go handshake: compile time varies wildly between workers (and
+    # grows under contention), so the parent must release the barrier only
+    # after EVERY worker has finished compiling — otherwise the windows
+    # barely overlap and the contention column measures near-solo latency.
+    print("READY", flush=True)
+    sys.stdin.readline()  # parent writes GO once all workers are ready
+    latencies = []
+    stop_at = time.monotonic() + seconds
     while time.monotonic() < stop_at:
         start = time.monotonic()
         jax.block_until_ready(infer(images))
-        out.append(time.monotonic() - start)
+        latencies.append(time.monotonic() - start)
+    print(json.dumps({"n": len(latencies), "mean_s": statistics.fmean(latencies) if latencies else None}))
 
 
-def run_time_shared(jax, infer, images, n: int, seconds: float) -> float:
-    """N concurrent workers contending for the device."""
-    stop_at = time.monotonic() + seconds
-    results: list = [[] for _ in range(n)]
-    threads = [
-        threading.Thread(target=timed_loop, args=(jax, infer, images, stop_at, results[i]))
-        for i in range(n)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    all_lat = [x for r in results for x in r]
-    return statistics.fmean(all_lat) if all_lat else float("nan")
+# ----------------------------------------------------------------- parent
 
 
-def run_partitioned(jax, infer, images, n: int, seconds: float) -> float:
-    """Each worker gets an exclusive, isolated execution turn."""
-    all_lat: list = []
-    for _ in range(n):
-        out: list = []
-        timed_loop(jax, infer, images, time.monotonic() + seconds / n, out)
-        all_lat.extend(out)
-    return statistics.fmean(all_lat) if all_lat else float("nan")
+def launch(n: int, seconds: float, core_sets) -> float:
+    """Spawn n REAL processes, one per core set (None = unpinned); release
+    them simultaneously once all report READY; average their means."""
+    procs = []
+    for i in range(n):
+        env = {**os.environ, "NOS_DEMO_SECONDS": str(seconds)}
+        if core_sets is not None:
+            env["NOS_DEMO_CORES"] = ",".join(str(c) for c in core_sets[i])
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                env=env,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+            )
+        )
+    # Barrier: wait for every worker's READY (compile done), then GO all.
+    for i, p in enumerate(procs):
+        line = p.stdout.readline().decode().strip()
+        if line != "READY":
+            raise RuntimeError(
+                f"worker {i} (pid {p.pid}) failed before READY "
+                f"(rc={p.poll()}): {line!r} — see its stderr above"
+            )
+    for p in procs:
+        p.stdin.write(b"GO\n")
+        p.stdin.flush()
+    means = []
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=seconds + 120)
+        lines = out.decode().strip().splitlines()
+        if p.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"worker {i} (pid {p.pid}) died rc={p.returncode} with no "
+                f"report — see its stderr above"
+            )
+        report = json.loads(lines[-1])
+        if report["mean_s"] is not None:
+            means.append(report["mean_s"])
+    return statistics.fmean(means) if means else float("nan")
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--pods", default="1,3,5,7")
     parser.add_argument("--seconds", type=float, default=5.0)
+    parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args()
+    if args.worker:
+        return run_worker()
     pod_counts = [int(x) for x in args.pods.split(",")]
 
-    jax, infer, images = build_infer()
-    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    cores = sorted(os.sched_getaffinity(0))
+    slice_size = max(1, len(cores) // max(pod_counts))
+    print(
+        f"{len(cores)} cores; partitioned slice = {slice_size} exclusive cores/pod",
+        file=sys.stderr,
+    )
+    if len(cores) < max(pod_counts):
+        print(
+            f"WARNING: only {len(cores)} cores for up to {max(pod_counts)} pods — "
+            "slices must overlap, so the partitioned row cannot demonstrate "
+            "isolation on this machine",
+            file=sys.stderr,
+        )
 
     rows = {}
-    for mode, runner in (("time-shared", run_time_shared), ("partitioned", run_partitioned)):
+    for mode in ("time-shared", "partitioned"):
         rows[mode] = {}
         for n in pod_counts:
-            rows[mode][n] = runner(jax, infer, images, n, args.seconds)
+            if mode == "partitioned":
+                core_sets = [
+                    [
+                        cores[(i * slice_size + j) % len(cores)]
+                        for j in range(slice_size)
+                    ]
+                    for i in range(n)
+                ]
+            else:
+                core_sets = None  # everyone everywhere: full contention
+            rows[mode][n] = launch(n, args.seconds, core_sets)
             print(f"{mode} x{n}: {rows[mode][n]:.4f}s", file=sys.stderr)
 
     header = "| mode | " + " | ".join(f"{n} pods" for n in pod_counts) + " |"

@@ -1,0 +1,156 @@
+"""Preempt → checkpoint → resume, end to end.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu \
+     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/preempt_resume.py
+
+The demo boots the full control-plane suite in-process (partitioner,
+scheduler, operator, tpu agent, sim kubelet) over one v5e host, then plays
+the elastic-quota story the framework exists for:
+
+1. `trainer` (guaranteed 0 chips) borrows the whole 2x4 board and trains a
+   tiny Llama with orbax checkpoints;
+2. `claimant` (guaranteed the node) claims half — CapacityScheduling
+   preempts the over-quota trainer, the freed board is re-carved;
+3. the trainer resumes from its checkpoint on the remaining 2x2 slice —
+   restored cross-mesh onto the smaller topology, training continues.
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+)
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.parallel.checkpoint import Checkpointer
+from nos_tpu.parallel.mesh import mesh_from_devices
+from nos_tpu.parallel.train import make_train_step
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def wait(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def submit(store, name, ns, chips):
+    store.create(
+        Pod(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=PodSpec(containers=[Container(requests={constants.RESOURCE_TPU: chips})]),
+        )
+    )
+
+
+def phase(store, name, ns):
+    pod = store.try_get("Pod", name, ns)
+    return pod.status.phase if pod else "GONE"
+
+
+def main() -> None:
+    cluster = build_cluster(
+        partitioner_config=GpuPartitionerConfig(
+            batch_window_timeout_seconds=0.3, batch_window_idle_seconds=0.05
+        ),
+        scheduler_config=SchedulerConfig(retry_seconds=0.1),
+    )
+    alloc = {constants.RESOURCE_TPU: 8, "cpu": 64, "memory": 256}
+    cluster.add_tpu_node(
+        Node(
+            metadata=ObjectMeta(
+                name="tpu-0",
+                labels={
+                    labels.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                    labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+                    labels.PARTITIONING_LABEL: "tpu",
+                },
+            ),
+            status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+        ),
+        agent_config=TpuAgentConfig(report_config_interval_seconds=0.1),
+    )
+    for ns, mn in (("trainer", 0), ("claimant", 8)):
+        cluster.store.create(
+            ElasticQuota(
+                metadata=ObjectMeta(name=f"eq-{ns}", namespace=ns),
+                spec=ElasticQuotaSpec(min={CHIPS: mn}, max={CHIPS: 8}),
+            )
+        )
+    cluster.start()
+    ckpt_dir = tempfile.mkdtemp(prefix="nos-tpu-demo-")
+    try:
+        # -------- phase 1: borrow the board, train, checkpoint
+        submit(cluster.store, "train", "trainer", 8)
+        assert wait(lambda: phase(cluster.store, "train", "trainer") == PodPhase.RUNNING)
+        print("[1] trainer borrowed the full 2x4 board and is RUNNING")
+
+        config = tiny_config()
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, config.vocab_size)
+        mesh8 = mesh_from_devices((4, 2), ("dp", "tp"), jax.devices()[:8])
+        step8, shard8 = make_train_step(mesh8, config)
+        state = shard8(init_llama_params(jax.random.key(0), config), donate=True)
+        with Checkpointer(ckpt_dir) as ckpt:
+            for i in range(3):
+                state, loss = step8(state, tokens)
+                print(f"    step {i + 1}: loss {float(loss):.4f}  (8-chip mesh)")
+            ckpt.save(3, state, force=True)
+            ckpt.wait()
+        print("[1] checkpoint saved at step 3")
+
+        # -------- phase 2: the guaranteed owner claims; trainer preempted
+        submit(cluster.store, "claim", "claimant", 4)
+        assert wait(lambda: phase(cluster.store, "claim", "claimant") == PodPhase.RUNNING)
+        assert wait(lambda: phase(cluster.store, "train", "trainer") != PodPhase.RUNNING)
+        print("[2] claimant took its guaranteed 2x2; over-quota trainer preempted")
+
+        # -------- phase 3: resume smaller, cross-mesh restore
+        submit(cluster.store, "train-resume", "trainer", 4)
+        assert wait(
+            lambda: phase(cluster.store, "train-resume", "trainer") == PodPhase.RUNNING
+        )
+        print("[3] trainer rescheduled on the re-carved 2x2 slice")
+
+        mesh4 = mesh_from_devices((2, 2), ("dp", "tp"), jax.devices()[:4])
+        step4, shard4 = make_train_step(mesh4, config)
+        like = shard4(init_llama_params(jax.random.key(7), config), donate=True)
+        with Checkpointer(ckpt_dir) as ckpt:
+            restored, step = ckpt.restore(like)
+        for i in range(2):
+            restored, loss = step4(restored, tokens)
+            print(f"    step {step + i + 1}: loss {float(loss):.4f}  (4-chip mesh, resumed)")
+        print("[3] training continued from the checkpoint on the smaller slice — done")
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    main()

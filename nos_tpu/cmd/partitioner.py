@@ -59,9 +59,17 @@ def build_partitioner(
     # refused at scheduling time (gpupartitioner.go:294-318 + SURVEY §7
     # "simulation fidelity").
     capacity = CapacityScheduling(store)
+    # The sim includes the ICI co-location filter so the planner never
+    # carves for a gang member in a pool the scheduler would reject
+    # (store-bound members pin the pool; members placed WITHIN one plan
+    # are kept co-located by the gang pre-pass running per node pool's
+    # nodes in sequence — a cross-pool split inside a single plan resolves
+    # via permit-timeout + replan, the level-triggered backstop).
+    from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
+
     sim_framework = Framework(
         pre_filter_plugins=[capacity],
-        filter_plugins=vanilla_filter_plugins(),
+        filter_plugins=vanilla_filter_plugins() + [MultihostIciFilter(store)],
     )
 
     controller = PartitionerController(
@@ -88,6 +96,24 @@ def build_partitioner(
         )
     )
     manager.add(Controller("state-pod", store, pod_ctrl.reconcile, [Watch(kind="Pod")]))
+
+    # Multi-host slice expansion: a plain-chip request exceeding one board
+    # becomes a gang of per-host board slices (BASELINE config #5; the
+    # admission-mutation seam — see controllers/partitioner/multihost.py).
+    from nos_tpu.controllers.partitioner.multihost import (
+        MultihostExpander,
+        leader_deleted_mapper,
+    )
+
+    expander = MultihostExpander(store)
+    manager.add(
+        Controller(
+            "multihost-expander",
+            store,
+            expander.reconcile,
+            [Watch(kind="Pod", mapper=leader_deleted_mapper(store))],
+        )
+    )
     manager.add(
         Controller(
             "partitioner-tpu",

@@ -113,9 +113,16 @@ def main(argv=None) -> int:
                     [Watch(kind="Node", predicate=matching_name(node_name))],
                 )
             )
-            client = TpuClient(
-                device, SimPodResourcesClient(manager.store, device.get_slices)
-            )
+            socket = config.get("podResourcesSocket", "")
+            if socket:
+                # Real kubelet: allocation ground truth from the
+                # pod-resources gRPC API (reference pkg/resource/client.go).
+                from nos_tpu.device.podresources import KubeletPodResourcesClient
+
+                pod_resources = KubeletPodResourcesClient(socket_path=socket)
+            else:
+                pod_resources = SimPodResourcesClient(manager.store, device.get_slices)
+            client = TpuClient(device, pod_resources)
             plugin = DevicePluginAdvertiser(manager.store, device.geometry)
         else:
             from nos_tpu.device.sim import (

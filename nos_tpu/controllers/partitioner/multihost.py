@@ -1,0 +1,243 @@
+"""Multi-host slice expansion: oversized chip requests become slice gangs.
+
+BASELINE config #5's north-star flow: a user submits ONE pod asking
+``google.com/tpu: 16`` on v5e. No single host can serve it — the chips
+span an ICI domain of several hosts — so this controller (the mutating
+half of the admission seam; the reference's operator owns the analogous
+webhooks, /root/reference/cmd/operator/operator.go:96-117) expands it:
+
+1. pick the smallest multi-host topology holding the request
+   (``nos_tpu/tpu/known.py`` ``multihost_profile_for_chips`` — 16 chips on
+   v5e → 4x4 over 2 hosts of 2x4);
+2. rewrite the pod's request to its per-host share (one full-board slice)
+   and label it a gang leader (``nos.nebuly.com/gang`` +
+   ``gang-size=n_hosts`` + the multihost-topology annotation);
+3. create the missing ``n_hosts - 1`` worker pods, owner-referenced to the
+   leader, each requesting one board slice with the same gang labels.
+
+Everything downstream then composes with no special cases: the tracker
+sees n_hosts lacking board slices, the planner carves all hosts in ONE
+plan (and its gang pre-pass refuses partial carves), the agents confirm
+per-node plan ids (the plan gate's per-slice quorum), GangScheduling's
+Permit binds the gang atomically inside one node pool, and gang-atomic
+preemption frees every chip of the slice together.
+
+Workers are garbage-collected when their leader disappears (the
+owner-reference contract; this suite has no kube GC to lean on).
+
+NOTE: in cluster-connected mode this rewrite must run as a mutating
+admission webhook (pod specs are immutable post-admission on a real
+apiserver); the in-process store models that seam.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+from typing import List, Optional
+
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.controller import Request, Result
+from nos_tpu.kube.objects import Container, ObjectMeta, OwnerReference, Pod, PodPhase
+from nos_tpu.kube.store import AlreadyExistsError, KubeStore, NotFoundError
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+from nos_tpu.tpu.known import (
+    KNOWN_ACCELERATORS,
+    multihost_profile_for_chips,
+    profile_for_chips,
+)
+from nos_tpu.util import resources as res
+
+log = logging.getLogger("nos_tpu.multihost")
+
+MULTIHOST_TOPOLOGY_ANNOTATION = "nos.nebuly.com/multihost-topology"
+MULTIHOST_ROLE_LABEL = "nos.nebuly.com/multihost-role"
+ROLE_LEADER = "leader"
+ROLE_WORKER = "worker"
+
+
+class MultihostExpander:
+    def __init__(self, store: KubeStore) -> None:
+        self.store = store
+
+    # --------------------------------------------------------------- util
+
+    def _cluster_accelerator(self) -> Optional[str]:
+        """The accelerator generation of the partitioned TPU fleet.
+
+        Heterogeneous fleets would carry the target generation on the pod
+        (node selector); absent that, the first partitioned TPU node's
+        label decides."""
+        for node in self.store.list("Node"):
+            accel = node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL)
+            if accel and node.metadata.labels.get(labels.PARTITIONING_LABEL):
+                return accel
+        return None
+
+    @staticmethod
+    def _oversized_chips(pod: Pod, accelerator: str) -> int:
+        """The plain-chip request when it exceeds one board, else 0."""
+        request = res.compute_pod_request(pod)
+        plain = int(request.get(constants.RESOURCE_TPU, 0))
+        if plain <= 0:
+            return 0
+        if profile_for_chips(plain, accelerator) is not None:
+            return 0  # single-host: normalized downstream, not expanded
+        return plain
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        pod = self.store.try_get("Pod", req.name, req.namespace)
+        if pod is None:
+            return None
+        if pod.metadata.labels.get(MULTIHOST_ROLE_LABEL) == ROLE_WORKER:
+            self._gc_orphan_worker(pod)
+            return None
+        if pod.metadata.labels.get(MULTIHOST_ROLE_LABEL) == ROLE_LEADER:
+            self._ensure_workers(pod)
+            return None
+        if pod.status.phase != PodPhase.PENDING or pod.spec.node_name:
+            return None
+        accelerator = self._cluster_accelerator()
+        if accelerator is None:
+            return None
+        chips = self._oversized_chips(pod, accelerator)
+        if chips <= 0:
+            return None
+        profile = multihost_profile_for_chips(chips, accelerator)
+        if profile is None:
+            log.warning(
+                "%s: %d chips exceed every multi-host topology of %s",
+                pod.namespaced_name, chips, accelerator,
+            )
+            return None
+        shape, n_hosts = profile
+        self._expand(pod, accelerator, shape, n_hosts)
+        return None
+
+    # ------------------------------------------------------------- expand
+
+    def _expand(self, pod: Pod, accelerator: str, shape: str, n_hosts: int) -> None:
+        spec = KNOWN_ACCELERATORS[accelerator]
+        board_slice = constants.tpu_slice_resource(spec.board_topology)
+        gang_name = pod.metadata.name
+
+        def mutate(p: Pod) -> None:
+            p.metadata.labels[GANG_NAME_LABEL] = gang_name
+            p.metadata.labels[GANG_SIZE_LABEL] = str(n_hosts)
+            p.metadata.labels[MULTIHOST_ROLE_LABEL] = ROLE_LEADER
+            p.metadata.annotations[MULTIHOST_TOPOLOGY_ANNOTATION] = shape
+            self._rewrite_requests(p, board_slice)
+
+        self.store.patch_merge("Pod", pod.metadata.name, pod.metadata.namespace, mutate)
+        leader = self.store.get("Pod", pod.metadata.name, pod.metadata.namespace)
+        self._ensure_workers(leader)
+        log.info(
+            "%s: expanded to %s multi-host slice — gang of %d × %s",
+            pod.namespaced_name, shape, n_hosts, board_slice,
+        )
+
+    @staticmethod
+    def _rewrite_requests(pod: Pod, board_slice: str) -> None:
+        """Replace the oversized plain-chip ask with ONE per-host board
+        slice (the leader's share; each worker asks the same). Limits are
+        rewritten symmetrically: extended resources require
+        requests == limits on a real apiserver."""
+        rewritten = False
+        for container in pod.spec.containers:
+            had_request = container.requests.pop(constants.RESOURCE_TPU, None) is not None
+            had_limit = container.limits.pop(constants.RESOURCE_TPU, None) is not None
+            if (had_request or had_limit) and not rewritten:
+                container.requests[board_slice] = (
+                    container.requests.get(board_slice, 0) + 1
+                )
+                container.limits[board_slice] = container.requests[board_slice]
+                rewritten = True
+        if not rewritten and pod.spec.containers:
+            pod.spec.containers[0].requests[board_slice] = 1
+            pod.spec.containers[0].limits[board_slice] = 1
+
+    def _ensure_workers(self, leader: Pod) -> None:
+        """Idempotently create the leader's n_hosts-1 sibling workers."""
+        try:
+            size = int(leader.metadata.labels.get(GANG_SIZE_LABEL, "0"))
+        except ValueError:
+            return
+        for i in range(1, size):
+            name = f"{leader.metadata.name}-w{i}"
+            if self.store.try_get("Pod", name, leader.metadata.namespace):
+                continue
+            worker = Pod(
+                metadata=ObjectMeta(
+                    name=name,
+                    namespace=leader.metadata.namespace,
+                    labels={
+                        **{
+                            k: v
+                            for k, v in leader.metadata.labels.items()
+                            if k != MULTIHOST_ROLE_LABEL
+                        },
+                        MULTIHOST_ROLE_LABEL: ROLE_WORKER,
+                    },
+                    annotations={
+                        MULTIHOST_TOPOLOGY_ANNOTATION: leader.metadata.annotations.get(
+                            MULTIHOST_TOPOLOGY_ANNOTATION, ""
+                        )
+                    },
+                    owner_references=[
+                        OwnerReference(
+                            kind="Pod",
+                            name=leader.metadata.name,
+                            uid=leader.metadata.uid,
+                            controller=True,
+                        )
+                    ],
+                ),
+                spec=copy.deepcopy(leader.spec),
+            )
+            worker.spec.node_name = ""
+            try:
+                self.store.create(worker)
+            except AlreadyExistsError:
+                pass
+
+    def _gc_orphan_worker(self, worker: Pod) -> None:
+        """Workers follow their leader's lifecycle (owner-reference GC)."""
+        for ref in worker.metadata.owner_references:
+            if ref.kind == "Pod" and ref.controller:
+                if self.store.try_get("Pod", ref.name, worker.metadata.namespace):
+                    return
+                try:
+                    self.store.delete(
+                        "Pod", worker.metadata.name, worker.metadata.namespace
+                    )
+                    log.info(
+                        "%s: garbage-collected (leader %s gone)",
+                        worker.namespaced_name, ref.name,
+                    )
+                except NotFoundError:
+                    pass
+                return
+
+
+def leader_deleted_mapper(store: KubeStore):
+    """Watch mapper: a leader's DELETED event enqueues its workers so the
+    GC path runs without polling."""
+    from nos_tpu.kube.store import DELETED
+
+    def mapper(event) -> List[Request]:
+        pod = event.object
+        if event.type != DELETED:
+            return [Request(name=pod.metadata.name, namespace=pod.metadata.namespace)]
+        if pod.metadata.labels.get(MULTIHOST_ROLE_LABEL) != ROLE_LEADER:
+            return [Request(name=pod.metadata.name, namespace=pod.metadata.namespace)]
+        return [
+            Request(name=p.metadata.name, namespace=p.metadata.namespace)
+            for p in store.list("Pod", namespace=pod.metadata.namespace)
+            if any(
+                r.kind == "Pod" and r.name == pod.metadata.name
+                for r in p.metadata.owner_references
+            )
+        ]
+
+    return mapper

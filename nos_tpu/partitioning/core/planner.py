@@ -84,10 +84,15 @@ class Planner:
         excluded: set = set()
         if any(_gang_of(p) for p in candidates):
             trial = _copy.deepcopy(snapshot)
-            trial_placed = self._plan_pass(
-                trial, SliceTracker(trial, candidates), candidates, quiet=True
+            trial_tracker = SliceTracker(trial, candidates)
+            # Members the CURRENT geometry already serves draw from the
+            # free pool and never enter the tracker — they count as
+            # placeable alongside the trial's re-carve placements.
+            servable = [p for p in candidates if p not in trial_tracker]
+            trial_placed = self._plan_pass(trial, trial_tracker, candidates, quiet=True)
+            excluded = self._half_formable_gangs(
+                snapshot, candidates, trial_placed + servable
             )
-            excluded = self._half_formable_gangs(snapshot, candidates, trial_placed)
         if excluded:
             log.info(
                 "planner: gangs %s cannot fully form; excluding their pods",

@@ -111,6 +111,14 @@ class GangScheduling:
         with self._lock:
             return sum(len(g.members) for g in self._waiting.values())
 
+    def waiting_members(self, gang_key: str) -> List[Tuple[Pod, str]]:
+        """(pod, node) pairs reserved in Permit for this gang — placement
+        state invisible in the store (no bind yet), consulted by the ICI
+        co-location filter."""
+        with self._lock:
+            waiting = self._waiting.get(gang_key)
+            return list(waiting.members.values()) if waiting else []
+
     # ----------------------------------------------------------- helpers
 
     def _bound_members(self, gang_key: str) -> int:

@@ -83,3 +83,58 @@ class IciTopologyScoring:
                             total += 20
                             break
         return total
+
+
+class MultihostIciFilter:
+    """HARD co-location for multi-host slices: every member of one slice
+    must land inside one GKE node pool — the ICI domain boundary. The
+    soft gang-affinity score above cannot guarantee this (a busy pool
+    would silently strand members across domains where ICI does not
+    reach, producing a slice that can never form a JAX mesh)."""
+
+    name = "MultihostIci"
+
+    def __init__(self, store: KubeStore, gang=None) -> None:
+        self.store = store
+        self.gang = gang  # GangScheduling: exposes Permit-reserved members
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo):
+        from nos_tpu.controllers.partitioner.multihost import (
+            MULTIHOST_TOPOLOGY_ANNOTATION,
+        )
+        from nos_tpu.scheduler.framework import Status
+
+        if not pod.metadata.annotations.get(MULTIHOST_TOPOLOGY_ANNOTATION):
+            return Status.ok()
+        gang = gang_of(pod)
+        if gang is None:
+            return Status.ok()
+        key, _ = gang
+        ns, name = key.split("/", 1)
+        placed_pools = set()
+
+        def pool_of(node_name: str) -> None:
+            node = self.store.try_get("Node", node_name)
+            if node is not None:
+                placed_pools.add(node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
+
+        for member in self.store.list("Pod", namespace=ns):
+            if (
+                member.metadata.labels.get(GANG_NAME_LABEL) == name
+                and member.spec.node_name
+                and member.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            ):
+                pool_of(member.spec.node_name)
+        if self.gang is not None:
+            for _, node_name in self.gang.waiting_members(key):
+                pool_of(node_name)
+        placed_pools.discard("")  # unlabeled sim nodes: no constraint
+        if not placed_pools:
+            return Status.ok()
+        pool = node_info.node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+        if pool in placed_pools:
+            return Status.ok()
+        return Status.unschedulable(
+            f"multi-host slice pinned to node pool {sorted(placed_pools)[0]!r}",
+            self.name,
+        )

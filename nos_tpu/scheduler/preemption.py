@@ -88,6 +88,11 @@ class _PdbLedger:
                 p
                 for p in pods_by_ns[pdb.metadata.namespace]
                 if selector.items() <= p.metadata.labels.items()
+                # Terminal pods are outside the PDB's expected count — a
+                # pile of Succeeded pods must not shrink desiredHealthy
+                # (round-1 advisory; matches the disruption controller's
+                # expectedCount over non-terminal pods).
+                and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
             ]
             healthy = sum(1 for p in matching if p.status.phase == PodPhase.RUNNING)
             if pdb.spec.min_available is not None:
@@ -173,13 +178,15 @@ class Preemptor:
             )
             if victims is None:
                 continue
-            # Node comparison (reference pickOneNodeForPreemption order):
-            # fewest PDB violations, fewest evicted pods, lowest top victim
-            # priority.
+            # Node comparison in the upstream pickOneNodeForPreemption
+            # order: fewest PDB violations, lowest top victim priority,
+            # smallest priority sum, then fewest evicted pods (round-1
+            # advisory: victim importance outranks victim count).
             key = (
                 victims.num_pdb_violations,
-                len(victims.pods),
                 max((v.spec.priority for v in victims.pods), default=0),
+                sum(v.spec.priority for v in victims.pods),
+                len(victims.pods),
             )
             if best is None or key < best_key:
                 best, best_key = (node_name, victims), key
@@ -221,11 +228,32 @@ class Preemptor:
         sim_infos = self.infos.clone()
 
         def feasible(trial: NodeInfo) -> bool:
-            if not framework.run_filter_plugins(state, pod, trial).success:
-                return False
-            return CapacityScheduling.check_quota(
+            if not CapacityScheduling.check_quota(
                 pod, sim_infos, self.chip_memory_gb
-            ).success
+            ).success:
+                return False
+            if framework.run_filter_plugins(state, pod, trial).success:
+                return True
+            # Dynamic-partitioning awareness: on a TPU-partitioned node the
+            # current slice denominations are NOT the constraint — freed
+            # boards get re-carved by the partitioner the moment the victim
+            # dies (level-triggered batch). Compare in chip units instead,
+            # and still require every non-resource predicate to hold.
+            headroom = self._tpu_chips_headroom(trial)
+            if headroom is None:
+                return False
+            import nos_tpu.util.resources as resources
+
+            needed = resources.tpu_chips_in(resources.compute_pod_request(pod))
+            if needed <= 0 or needed > headroom:
+                return False
+            from nos_tpu.scheduler.framework import NodeResourcesFit
+
+            return all(
+                plugin.filter(state, pod, trial).success
+                for plugin in framework.filter_plugins
+                if not isinstance(plugin, NodeResourcesFit)
+            )
 
         def evict_sim(unit: VictimUnit) -> None:
             # The whole gang dies, so the whole gang's quota usage frees —
@@ -330,9 +358,11 @@ class Preemptor:
             if (
                 gang is not None
                 and gang[0] == gang_key
-                and p.spec.node_name
                 and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
             ):
+                # Unbound pending members belong to the unit too: the gang
+                # dies as a whole, or its survivors deadlock waiting on a
+                # quorum that can never re-form (round-1 advisory).
                 members.append(p)
         self._gang_cache[gang_key] = members
         return members
@@ -377,6 +407,29 @@ class Preemptor:
         return podutil.is_over_quota(victim) and v_info.is_borrowing()
 
     # ----------------------------------------------------------- helpers
+
+    @staticmethod
+    def _tpu_chips_headroom(trial: NodeInfo) -> Optional[int]:
+        """Physical chips minus chips held by the trial's surviving pods,
+        for TPU-partitioned nodes (None elsewhere): the capacity a
+        re-carve could reshape into any profile."""
+        from nos_tpu.api.v1alpha1 import constants, labels
+        import nos_tpu.util.resources as resources
+
+        node = trial.node
+        if node.metadata.labels.get(labels.PARTITIONING_LABEL) not in (
+            labels.PartitioningKind.TPU,
+            labels.PartitioningKind.HYBRID,
+        ):
+            return None
+        total = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        if total <= 0:
+            return None
+        used = sum(
+            resources.tpu_chips_in(resources.compute_pod_request(p))
+            for p in trial.pods
+        )
+        return total - used
 
     def _node_info(self, node_name: str) -> Optional[NodeInfo]:
         node = self.store.try_get("Node", node_name)

@@ -28,6 +28,7 @@ from nos_tpu.scheduler.framework import (
 )
 from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
 from nos_tpu.scheduler.plugins.gang import GangScheduling
+from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
 from nos_tpu.scheduler.plugins.topology import IciTopologyScoring
 from nos_tpu.util import metrics
 
@@ -43,7 +44,7 @@ def new_framework(
     gang = GangScheduling(store, wait_timeout_seconds=gang_timeout_seconds)
     framework = Framework(
         pre_filter_plugins=[capacity],
-        filter_plugins=vanilla_filter_plugins(),
+        filter_plugins=vanilla_filter_plugins() + [MultihostIciFilter(store, gang)],
         post_filter_plugins=[capacity],
         reserve_plugins=[capacity],
         permit_plugins=[gang],
@@ -206,10 +207,19 @@ class Scheduler:
         log.info("scheduler: bound %s to %s", pod.namespaced_name, node_name)
 
     def _mark_unschedulable(self, pod: Pod, message: str) -> None:
-        if pod.unschedulable():
+        # A nominated pod reaching here had its post-preemption retry and
+        # STILL cannot fit — on partitioned TPU nodes that means the freed
+        # chips need a re-carve, which the partitioner refuses to do for
+        # "preempting" pods. Clearing the nomination hands the pod back to
+        # the partitioner's batch (level-triggered handoff; upstream
+        # clears nominatedNodeName on the same condition).
+        clear_nomination = bool(pod.status.nominated_node_name)
+        if pod.unschedulable() and not clear_nomination:
             return  # already marked; avoid patch churn
 
         def mutate(p):
+            if clear_nomination:
+                p.status.nominated_node_name = ""
             p.status.conditions = [
                 c for c in p.status.conditions if c.type != "PodScheduled"
             ]

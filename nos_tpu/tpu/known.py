@@ -33,6 +33,11 @@ class AcceleratorSpec:
     # google.com/tpu-mem-<N>gb fractions (the TPU analogue of a GPU's
     # memory budget in reference pkg/gpu/slicing/gpu.go).
     hbm_gb: int = 16
+    # ICI-valid topologies spanning SEVERAL hosts (each an exact tiling of
+    # board_topology). A plain-chip request exceeding one board expands to
+    # a gang of per-host board slices over one of these shapes
+    # (controllers/partitioner/multihost.py).
+    multihost_shapes: Tuple[str, ...] = ()
 
     @property
     def board_chips(self) -> int:
@@ -48,6 +53,7 @@ KNOWN_ACCELERATORS: Dict[str, AcceleratorSpec] = {
         board_topology="2x4",
         slice_shapes=("1x1", "1x2", "2x2", "2x4"),
         hbm_gb=16,
+        multihost_shapes=("4x4", "4x8", "8x8", "8x16", "16x16"),
     ),
     # v5e single-host device nodes (ct5l): 4 chips, 2x2.
     "tpu-v5-lite-device": AcceleratorSpec(
@@ -62,6 +68,7 @@ KNOWN_ACCELERATORS: Dict[str, AcceleratorSpec] = {
         board_topology="2x2x1",
         slice_shapes=("1x1x1", "1x2x1", "2x2x1"),
         hbm_gb=32,
+        multihost_shapes=("2x2x2", "2x2x4", "2x4x4", "4x4x4"),
     ),
     # v5p: 4 chips per host.
     "tpu-v5p-slice": AcceleratorSpec(
@@ -69,6 +76,7 @@ KNOWN_ACCELERATORS: Dict[str, AcceleratorSpec] = {
         board_topology="2x2x1",
         slice_shapes=("1x1x1", "1x2x1", "2x2x1"),
         hbm_gb=95,
+        multihost_shapes=("2x2x2", "2x2x4", "2x4x4", "4x4x4"),
     ),
     # v6e (Trillium): 8 chips per host, 2x4, same slice configs as v5e.
     "tpu-v6e-slice": AcceleratorSpec(
@@ -76,6 +84,7 @@ KNOWN_ACCELERATORS: Dict[str, AcceleratorSpec] = {
         board_topology="2x4",
         slice_shapes=("1x1", "1x2", "2x2", "2x4"),
         hbm_gb=32,
+        multihost_shapes=("4x4", "4x8", "8x8"),
     ),
 }
 
@@ -163,3 +172,22 @@ def hbm_gb_per_chip(accelerator: str) -> int:
     """Per-chip HBM budget the sharing mode may carve; 0 when unknown."""
     spec = KNOWN_ACCELERATORS.get(accelerator)
     return spec.hbm_gb if spec is not None else 0
+
+
+def multihost_profile_for_chips(chips: int, accelerator: str):
+    """(shape, n_hosts) of the smallest multi-host topology holding
+    ``chips`` chips, or None.
+
+    Only meaningful when the request exceeds one board (single-host
+    requests go through profile_for_chips); each shape tiles exactly into
+    per-host boards, so n_hosts = shape chips / board chips."""
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    if spec is None:
+        return None
+    candidates = sorted(
+        (Topology(s) for s in spec.multihost_shapes), key=lambda t: (t.chips, str(t))
+    )
+    for t in candidates:
+        if t.chips >= chips:
+            return str(t), t.chips // spec.board_chips
+    return None

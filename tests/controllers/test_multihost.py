@@ -1,0 +1,148 @@
+"""MultihostExpander unit tests: request rewriting, idempotency, worker
+GC, and the hard ICI-domain filter."""
+import pytest
+
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.controllers.partitioner.multihost import (
+    MULTIHOST_ROLE_LABEL,
+    MULTIHOST_TOPOLOGY_ANNOTATION,
+    MultihostExpander,
+    ROLE_LEADER,
+    ROLE_WORKER,
+)
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+@pytest.fixture
+def store():
+    s = KubeStore()
+    s.create(build_tpu_node(name="tpu-0"))
+    return s
+
+
+def reconcile(store, name, ns="default"):
+    MultihostExpander(store).reconcile(Request(name=name, namespace=ns))
+
+
+class TestExpansion:
+    def test_oversized_request_becomes_gang(self, store):
+        store.create(build_pod("big", {constants.RESOURCE_TPU: 16}))
+        reconcile(store, "big")
+        leader = store.get("Pod", "big", "default")
+        assert leader.metadata.labels[GANG_NAME_LABEL] == "big"
+        assert leader.metadata.labels[GANG_SIZE_LABEL] == "2"
+        assert leader.metadata.labels[MULTIHOST_ROLE_LABEL] == ROLE_LEADER
+        assert leader.metadata.annotations[MULTIHOST_TOPOLOGY_ANNOTATION] == "4x4"
+        request = leader.spec.containers[0].requests
+        assert constants.RESOURCE_TPU not in request
+        assert request[slice_res("2x4")] == 1
+        worker = store.get("Pod", "big-w1", "default")
+        assert worker.metadata.labels[MULTIHOST_ROLE_LABEL] == ROLE_WORKER
+        assert worker.spec.containers[0].requests[slice_res("2x4")] == 1
+        assert worker.metadata.owner_references[0].name == "big"
+
+    def test_single_host_request_untouched(self, store):
+        store.create(build_pod("small", {constants.RESOURCE_TPU: 4}))
+        reconcile(store, "small")
+        pod = store.get("Pod", "small", "default")
+        assert GANG_NAME_LABEL not in pod.metadata.labels
+        assert pod.spec.containers[0].requests == {constants.RESOURCE_TPU: 4}
+        assert store.list("Pod") == [pod]
+
+    def test_slice_request_untouched(self, store):
+        store.create(build_pod("sliced", {slice_res("2x2"): 1}))
+        reconcile(store, "sliced")
+        pod = store.get("Pod", "sliced", "default")
+        assert GANG_NAME_LABEL not in pod.metadata.labels
+
+    def test_reconcile_is_idempotent(self, store):
+        store.create(build_pod("big", {constants.RESOURCE_TPU: 32}))
+        reconcile(store, "big")
+        reconcile(store, "big")  # leader path: only ensures workers
+        pods = store.list("Pod")
+        assert len(pods) == 4  # leader + 3 workers, no duplicates
+        leader = store.get("Pod", "big", "default")
+        assert leader.spec.containers[0].requests[slice_res("2x4")] == 1
+
+    def test_request_beyond_all_topologies_left_alone(self, store):
+        store.create(build_pod("huge", {constants.RESOURCE_TPU: 4096}))
+        reconcile(store, "huge")
+        pod = store.get("Pod", "huge", "default")
+        assert GANG_NAME_LABEL not in pod.metadata.labels  # warned, skipped
+
+    def test_worker_gc_when_leader_gone(self, store):
+        store.create(build_pod("big", {constants.RESOURCE_TPU: 16}))
+        reconcile(store, "big")
+        store.delete("Pod", "big", "default")
+        reconcile(store, "big-w1")
+        assert store.try_get("Pod", "big-w1", "default") is None
+
+    def test_worker_kept_while_leader_alive(self, store):
+        store.create(build_pod("big", {constants.RESOURCE_TPU: 16}))
+        reconcile(store, "big")
+        reconcile(store, "big-w1")
+        assert store.try_get("Pod", "big-w1", "default") is not None
+
+
+class TestMultihostIciFilter:
+    def _member(self, name, gang="g1", node=""):
+        pod = build_pod(name, {slice_res("2x4"): 1})
+        pod.metadata.labels[GANG_NAME_LABEL] = gang
+        pod.metadata.labels[GANG_SIZE_LABEL] = "2"
+        pod.metadata.annotations[MULTIHOST_TOPOLOGY_ANNOTATION] = "4x4"
+        pod.spec.node_name = node
+        if node:
+            pod.status.phase = "Running"
+        return pod
+
+    def _node(self, name, pool):
+        node = build_tpu_node(name=name)
+        node.metadata.labels["cloud.google.com/gke-nodepool"] = pool
+        return node
+
+    def test_members_pinned_to_first_pool(self):
+        from nos_tpu.scheduler.framework import CycleState, NodeInfo
+        from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
+
+        store = KubeStore()
+        store.create(self._node("a1", "pool-a"))
+        store.create(self._node("b1", "pool-b"))
+        store.create(self._member("m0", node="a1"))
+        f = MultihostIciFilter(store)
+        pending = self._member("m1")
+        ok = f.filter(CycleState(), pending, NodeInfo(node=store.get("Node", "a1")))
+        blocked = f.filter(CycleState(), pending, NodeInfo(node=store.get("Node", "b1")))
+        assert ok.success
+        assert not blocked.success and "pinned" in blocked.message
+
+    def test_permit_reserved_members_pin_too(self):
+        from nos_tpu.scheduler.framework import CycleState, NodeInfo
+        from nos_tpu.scheduler.plugins.gang import GangScheduling
+        from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
+
+        store = KubeStore()
+        store.create(self._node("a1", "pool-a"))
+        store.create(self._node("b1", "pool-b"))
+        gang = GangScheduling(store)
+        m0 = self._member("m0")
+        store.create(m0)
+        gang.permit(CycleState(), m0, "a1")  # reserved, not bound
+        f = MultihostIciFilter(store, gang)
+        blocked = f.filter(
+            CycleState(), self._member("m1"), NodeInfo(node=store.get("Node", "b1"))
+        )
+        assert not blocked.success
+
+    def test_non_multihost_pods_unconstrained(self):
+        from nos_tpu.scheduler.framework import CycleState, NodeInfo
+        from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
+
+        store = KubeStore()
+        store.create(self._node("b1", "pool-b"))
+        pod = build_pod("plain", {slice_res("2x2"): 1})
+        f = MultihostIciFilter(store)
+        assert f.filter(CycleState(), pod, NodeInfo(node=store.get("Node", "b1"))).success

@@ -1,0 +1,111 @@
+"""Kubelet pod-resources gRPC client against an in-process gRPC server.
+
+Mirrors the reference's client tests (pkg/resource/client_test.go pattern):
+a real server on a unix socket, the real wire protocol, no shortcuts.
+"""
+import concurrent.futures
+import os
+
+import grpc
+import pytest
+
+from nos_tpu.device.podresources import (
+    LIST_METHOD,
+    KubeletPodResourcesClient,
+)
+from nos_tpu.device.proto import podresources_pb2 as pb
+
+
+def make_response(entries):
+    """entries: [(resource_name, [device_ids])]"""
+    response = pb.ListPodResourcesResponse()
+    pod = response.pod_resources.add()
+    pod.name, pod.namespace = "train", "ml"
+    container = pod.containers.add()
+    container.name = "main"
+    for resource_name, ids in entries:
+        device = container.devices.add()
+        device.resource_name = resource_name
+        device.device_ids.extend(ids)
+    return response
+
+
+@pytest.fixture
+def lister_server(tmp_path):
+    """Real gRPC server on a unix socket; yields (socket_path, set_response)."""
+    state = {"response": pb.ListPodResourcesResponse()}
+
+    def handle_list(request, context):
+        assert isinstance(request, pb.ListPodResourcesRequest)
+        return state["response"]
+
+    service = LIST_METHOD.strip("/").rsplit("/", 1)
+    handler = grpc.method_handlers_generic_handler(
+        service[0],
+        {
+            service[1]: grpc.unary_unary_rpc_method_handler(
+                handle_list,
+                request_deserializer=pb.ListPodResourcesRequest.FromString,
+                response_serializer=pb.ListPodResourcesResponse.SerializeToString,
+            )
+        },
+    )
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((handler,))
+    socket_path = os.path.join(tmp_path, "kubelet.sock")
+    server.add_insecure_port(f"unix://{socket_path}")
+    server.start()
+    yield socket_path, lambda r: state.update(response=r)
+    server.stop(grace=None)
+
+
+class TestKubeletPodResourcesClient:
+    def test_lists_tpu_device_ids(self, lister_server):
+        socket_path, set_response = lister_server
+        set_response(make_response([
+            ("google.com/tpu-slice-2x2", ["tpu-0-slice-0", "tpu-0-slice-1"]),
+            ("google.com/tpu", ["tpu-0-chip-3"]),
+            ("nvidia.com/gpu", ["gpu-7"]),  # foreign resource: ignored
+        ]))
+        client = KubeletPodResourcesClient(socket_path=socket_path, timeout_seconds=5)
+        try:
+            assert client.get_used_device_ids("any-node") == [
+                "tpu-0-chip-3",
+                "tpu-0-slice-0",
+                "tpu-0-slice-1",
+            ]
+        finally:
+            client.close()
+
+    def test_empty_allocation(self, lister_server):
+        socket_path, _ = lister_server
+        client = KubeletPodResourcesClient(socket_path=socket_path, timeout_seconds=5)
+        try:
+            assert client.get_used_device_ids() == []
+        finally:
+            client.close()
+
+    def test_deduplicates_across_containers(self, lister_server):
+        socket_path, set_response = lister_server
+        response = make_response([("google.com/tpu-slice-1x1", ["d0"])])
+        second = response.pod_resources[0].containers.add()
+        second.name = "sidecar"
+        device = second.devices.add()
+        device.resource_name = "google.com/tpu-slice-1x1"
+        device.device_ids.append("d0")
+        set_response(response)
+        client = KubeletPodResourcesClient(socket_path=socket_path, timeout_seconds=5)
+        try:
+            assert client.get_used_device_ids() == ["d0"]
+        finally:
+            client.close()
+
+    def test_unreachable_socket_raises(self, tmp_path):
+        client = KubeletPodResourcesClient(
+            socket_path=os.path.join(tmp_path, "nope.sock"), timeout_seconds=0.5
+        )
+        try:
+            with pytest.raises(grpc.RpcError):
+                client.get_used_device_ids()
+        finally:
+            client.close()

@@ -1,0 +1,179 @@
+"""Multi-host slice e2e (VERDICT round-2 #3 / BASELINE config #5).
+
+One pod asks ``google.com/tpu: 32`` on a v5e fleet. The expander turns it
+into a 4x8 slice — a gang of 4 per-host 2x4 board slices; the planner
+carves every host; GangScheduling binds the gang atomically inside one
+node pool; preemption frees all 32 chips as a unit; deleting the leader
+garbage-collects its workers.
+"""
+import time
+
+import pytest
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.cmd import build_cluster
+from nos_tpu.controllers.partitioner.multihost import (
+    MULTIHOST_ROLE_LABEL,
+    MULTIHOST_TOPOLOGY_ANNOTATION,
+)
+from nos_tpu.kube.objects import ObjectMeta, PodPhase
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster():
+    c = build_cluster(
+        partitioner_config=GpuPartitionerConfig(
+            batch_window_timeout_seconds=0.3, batch_window_idle_seconds=0.05
+        ),
+        scheduler_config=SchedulerConfig(retry_seconds=0.1),
+    )
+    for i in range(4):
+        node = build_tpu_node(name=f"tpu-{i}")
+        node.metadata.labels["cloud.google.com/gke-nodepool"] = "pool-a"
+        c.add_tpu_node(
+            node, agent_config=TpuAgentConfig(report_config_interval_seconds=0.1)
+        )
+    yield c
+    c.stop()
+
+
+def gang_members(store, ns="ml"):
+    return [
+        p
+        for p in store.list("Pod", namespace=ns)
+        if p.metadata.labels.get(GANG_NAME_LABEL) == "big"
+    ]
+
+
+class TestMultihostSlice:
+    def test_oversized_request_runs_as_full_gang(self, cluster):
+        cluster.start()
+        # Fragment the fleet first: small jobs leave every board carved as
+        # 2x2 slices, so serving the multi-host gang REQUIRES the planner
+        # to re-carve each host back to a full 2x4 board.
+        for i in range(4):
+            cluster.store.create(
+                build_pod(f"small-{i}", {constants.RESOURCE_TPU: 4}, ns="ml")
+            )
+
+        def smalls_running():
+            pods = [
+                p
+                for p in cluster.store.list("Pod", namespace="ml")
+                if p.metadata.name.startswith("small-")
+            ]
+            return len(pods) == 4 and all(
+                p.status.phase == PodPhase.RUNNING for p in pods
+            )
+
+        assert wait_for(smalls_running)
+        for i in range(4):
+            cluster.store.delete("Pod", f"small-{i}", "ml")
+        plans_before = cluster.partitioner.plans_applied
+        cluster.store.create(build_pod("big", {constants.RESOURCE_TPU: 32}, ns="ml"))
+
+        # Expansion: leader rewritten + 3 workers, gang size 4, 4x8 shape.
+        assert wait_for(lambda: len(gang_members(cluster.store)) == 4), (
+            [p.metadata.name for p in cluster.store.list("Pod", namespace="ml")]
+        )
+        leader = cluster.store.get("Pod", "big", "ml")
+        assert leader.metadata.annotations[MULTIHOST_TOPOLOGY_ANNOTATION] == "4x8"
+        assert leader.metadata.labels[GANG_SIZE_LABEL] == "4"
+        request = leader.spec.containers[0].requests
+        assert constants.RESOURCE_TPU not in request
+        assert request[slice_res("2x4")] == 1
+
+        # The whole gang runs, one member per host — all 32 chips bound.
+        def all_running():
+            members = gang_members(cluster.store)
+            return len(members) == 4 and all(
+                m.status.phase == PodPhase.RUNNING and m.spec.node_name
+                for m in members
+            )
+
+        assert wait_for(all_running), [
+            (p.metadata.name, p.status.phase, p.spec.node_name)
+            for p in gang_members(cluster.store)
+        ]
+        nodes_used = {m.spec.node_name for m in gang_members(cluster.store)}
+        assert len(nodes_used) == 4  # one board slice per host
+        # Every host was carved to a full-board slice by the plan(s).
+        for node_name in nodes_used:
+            assert cluster.pool.geometry(node_name).get(0, {}).get("2x4", 0) == 1
+        assert cluster.partitioner.plans_applied > plans_before
+
+    def test_leader_delete_garbage_collects_workers(self, cluster):
+        cluster.start()
+        cluster.store.create(build_pod("big", {constants.RESOURCE_TPU: 32}, ns="ml"))
+        assert wait_for(lambda: len(gang_members(cluster.store)) == 4)
+        cluster.store.delete("Pod", "big", "ml")
+        assert wait_for(lambda: len(cluster.store.list("Pod", namespace="ml")) == 0), (
+            [p.metadata.name for p in cluster.store.list("Pod", namespace="ml")]
+        )
+
+    def test_preempting_gang_frees_all_chips(self, cluster):
+        # team-a's multi-host slice borrows past its guaranteed min;
+        # team-b claiming its min preempts the gang as a unit — all 32
+        # chips come back together, never a stranded partial slice.
+        for ns, mn in (("team-a", 0), ("team-b", 32)):
+            cluster.store.create(
+                ElasticQuota(
+                    metadata=ObjectMeta(name=f"eq-{ns}", namespace=ns),
+                    spec=ElasticQuotaSpec(min={CHIPS: mn}, max={CHIPS: 32}),
+                )
+            )
+        cluster.start()
+        cluster.store.create(
+            build_pod("big", {constants.RESOURCE_TPU: 32}, ns="team-a")
+        )
+
+        def gang_running(ns):
+            members = [
+                p
+                for p in cluster.store.list("Pod", namespace=ns)
+                if p.metadata.labels.get(GANG_NAME_LABEL)
+            ]
+            return len(members) == 4 and all(
+                m.status.phase == PodPhase.RUNNING for m in members
+            )
+
+        assert wait_for(lambda: gang_running("team-a"))
+
+        for i in range(4):
+            cluster.store.create(
+                build_pod(f"claim-{i}", {constants.RESOURCE_TPU: 8}, ns="team-b")
+            )
+
+        def team_b_running():
+            pods = cluster.store.list("Pod", namespace="team-b")
+            return sum(
+                1 for p in pods if p.status.phase == PodPhase.RUNNING
+            ) == 4
+
+        assert wait_for(team_b_running, timeout=25.0), [
+            (p.metadata.name, p.status.phase)
+            for p in cluster.store.list("Pod", namespace="team-b")
+        ]
+        # the whole gang went together (no stranded members holding chips)
+        leftovers = [
+            p
+            for p in cluster.store.list("Pod", namespace="team-a")
+            if p.status.phase == PodPhase.RUNNING
+        ]
+        assert leftovers == []

@@ -1,0 +1,132 @@
+"""Preempt → checkpoint → resume: the control plane meets the training
+stack (VERDICT round-2 #8).
+
+An over-quota training job is preempted by CapacityScheduling when the
+guaranteed owner claims its min; the freed board is re-carved for the
+claimant; the evicted workload restores from its orbax checkpoint onto the
+SMALLER slice it can still get — cross-mesh — and training continues with
+identical numerics. No reference feature matches this story: nos stops at
+eviction, the workload side is the TPU build's own ground.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import ObjectMeta, PodPhase
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.parallel.checkpoint import Checkpointer
+from nos_tpu.parallel.mesh import mesh_from_devices
+from nos_tpu.parallel.train import make_train_step
+
+from tests.factory import build_pod, build_tpu_node
+
+CHIPS = constants.RESOURCE_TPU_CHIPS
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster():
+    c = build_cluster(
+        partitioner_config=GpuPartitionerConfig(
+            batch_window_timeout_seconds=0.3, batch_window_idle_seconds=0.05
+        ),
+        scheduler_config=SchedulerConfig(retry_seconds=0.1),
+    )
+    c.add_tpu_node(
+        build_tpu_node(name="tpu-0"),
+        agent_config=TpuAgentConfig(report_config_interval_seconds=0.1),
+    )
+    yield c
+    c.stop()
+
+
+class TestPreemptCheckpointResume:
+    def test_full_story(self, cluster, tmp_path):
+        # Quotas: the claimant owns the node's guaranteed pool; the trainer
+        # owns nothing and borrows all of it (the classic elastic-quota
+        # posture: researchers borrow the production team's idle chips).
+        for ns, mn in (("trainer", 0), ("claimant", 8)):
+            cluster.store.create(
+                ElasticQuota(
+                    metadata=ObjectMeta(name=f"eq-{ns}", namespace=ns),
+                    spec=ElasticQuotaSpec(min={CHIPS: mn}, max={CHIPS: 8}),
+                )
+            )
+        cluster.start()
+
+        # ---- phase 1: the training job runs on a full 2x4 board (8 chips,
+        # borrowed) and checkpoints its sharded state.
+        cluster.store.create(build_pod("train", {constants.RESOURCE_TPU: 8}, ns="trainer"))
+
+        def running(name, ns):
+            pod = cluster.store.try_get("Pod", name, ns)
+            return pod is not None and pod.status.phase == PodPhase.RUNNING
+
+        assert wait_for(lambda: running("train", "trainer"))
+
+        # The workload side: 8-"chip" mesh (virtual CPU devices stand in),
+        # dp×tp training with checkpoints.
+        config = tiny_config()
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, config.vocab_size)
+        mesh8 = mesh_from_devices((4, 2), ("dp", "tp"), jax.devices()[:8])
+        step8, shard8 = make_train_step(mesh8, config)
+        state = shard8(init_llama_params(jax.random.key(0), config), donate=True)
+        losses = []
+        with Checkpointer(str(tmp_path / "ckpt")) as ckpt:
+            for i in range(3):
+                state, loss = step8(state, tokens)
+                losses.append(float(loss))
+            ckpt.save(3, state, force=True)
+            ckpt.wait()
+            reference_params = jax.tree.map(np.asarray, state[0])
+
+        # ---- phase 2: the claimant takes its guaranteed min; the borrowed
+        # board is preempted and re-carved.
+        cluster.store.create(build_pod("claim", {constants.RESOURCE_TPU: 4}, ns="claimant"))
+        assert wait_for(lambda: running("claim", "claimant"), timeout=20.0), (
+            cluster.store.try_get("Pod", "claim", "claimant").status
+        )
+        assert wait_for(
+            lambda: cluster.store.try_get("Pod", "train", "trainer") is None
+            or cluster.store.get("Pod", "train", "trainer").status.phase
+            != PodPhase.RUNNING
+        ), "over-quota trainer survived the claim"
+
+        # ---- phase 3: the trainer resubmits at the size that still fits
+        # (4 chips), lands on the re-carved half, and resumes from the
+        # checkpoint on a DIFFERENT mesh (cross-mesh restore).
+        cluster.store.create(
+            build_pod("train-resume", {constants.RESOURCE_TPU: 4}, ns="trainer")
+        )
+        assert wait_for(lambda: running("train-resume", "trainer"), timeout=20.0), (
+            cluster.store.try_get("Pod", "train-resume", "trainer").status
+        )
+
+        mesh4 = mesh_from_devices((2, 2), ("dp", "tp"), jax.devices()[:4])
+        step4, shard4 = make_train_step(mesh4, config)
+        like = shard4(init_llama_params(jax.random.key(7), config), donate=True)
+        with Checkpointer(str(tmp_path / "ckpt")) as ckpt:
+            assert ckpt.latest_step() == 3
+            restored, step = ckpt.restore(like)
+            assert step == 3
+        # exact continuity: restored params equal the preempted run's
+        for a, b in zip(jax.tree.leaves(restored[0]), jax.tree.leaves(reference_params)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        # and training actually continues on the smaller slice
+        restored, loss = step4(restored, tokens)
+        assert float(loss) < losses[0]
