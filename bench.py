@@ -121,7 +121,8 @@ def run_tpu_child() -> None:
         # train at real token counts on a 16 GB chip; prefer no-remat
         # (fewer recompute FLOPs) when the batch fits without it.
         batch_candidates = [
-            (8, 2048, "flash", False),
+            (8, 2048, "flash", False),   # best MFU if it fits (no recompute)
+            (16, 2048, "flash", True),   # 2x tokens amortize the remat tax
             (8, 2048, "flash", True),
             (4, 2048, "flash", True),
             (2, 1024, "dense", False),

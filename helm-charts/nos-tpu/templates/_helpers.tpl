@@ -16,3 +16,17 @@ app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- define "nos-tpu.serviceAccountName" -}}
 {{ .Release.Name }}-nos-tpu
 {{- end }}
+
+{{/* Config stanzas shared by every component: store backend + leader
+     election. Rendered INTO each component's yaml (the Python entrypoints
+     read top-level `store:` and `leaderElection:` keys —
+     nos_tpu/cmd/_component.py). */}}
+{{- define "nos-tpu.commonConfig" -}}
+store:
+  type: {{ .Values.store.type }}
+leaderElection:
+  enabled: {{ .Values.leaderElection.enabled }}
+  namespace: {{ .Release.Namespace }}
+  leaseDurationSeconds: {{ .Values.leaderElection.leaseDurationSeconds }}
+  renewPeriodSeconds: {{ .Values.leaderElection.renewPeriodSeconds }}
+{{- end }}

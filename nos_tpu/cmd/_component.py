@@ -99,11 +99,40 @@ def run_component(
     if stop_event is None:
         for sig in (signal.SIGINT, signal.SIGTERM):
             signal.signal(sig, lambda *_: stop.set())
-    manager.start()
+
+    elector = None
+    le_cfg = (config.get("leaderElection") or {}) if isinstance(config, dict) else {}
+    if le_cfg.get("enabled", False):
+        # One active replica per component: controllers start only once the
+        # lease is held, and a lost lease fail-stops the process (the
+        # controller-runtime contract; reference components run the same
+        # election through their manager options).
+        import os
+        import socket
+
+        from nos_tpu.kube.leaderelection import LeaderElector
+
+        identity = le_cfg.get("identity") or f"{socket.gethostname()}-{os.getpid()}"
+        elector = LeaderElector(
+            store,
+            name=f"nos-tpu-{name}",
+            identity=identity,
+            namespace=le_cfg.get("namespace", "nos-system"),
+            lease_duration_s=float(le_cfg.get("leaseDurationSeconds", 15)),
+            renew_period_s=float(le_cfg.get("renewPeriodSeconds", 5)),
+            on_started_leading=manager.start,
+            on_stopped_leading=stop.set,
+        )
+        elector.start()
+        logging.info("%s: waiting for leader lease as %s", name, identity)
+    else:
+        manager.start()
     logging.info("%s running", name)
     try:
         stop.wait()
     finally:
+        if elector is not None:
+            elector.stop()
         manager.stop()
         health.stop()
         if hasattr(store, "stop"):  # KubeApiStore: stop informer threads

@@ -61,5 +61,26 @@ def main(argv=None) -> int:
             tpu_chip_memory_gb=int(config.get("tpuChipMemoryGB", 16))
         )
         build_operator(manager, operator_cfg)
+        webhook_cfg = config.get("webhook") or {}
+        if webhook_cfg.get("enabled", False):
+            # The apiserver-facing TLS admission endpoint (reference
+            # operator.go:96-117); the in-store seam keeps validating
+            # writes made through this process either way. Starts
+            # IMMEDIATELY, not behind the leader lease: the webhook
+            # Service load-balances over every replica, and a non-leader
+            # refusing connections would fail cluster-wide quota writes
+            # (controller-runtime runs webhook servers with
+            # NeedLeaderElection=false for the same reason).
+            from nos_tpu.kube.webhook import build_elasticquota_webhook_server
+
+            server = build_elasticquota_webhook_server(
+                manager.store,
+                port=int(webhook_cfg.get("port", 9443)),
+                host=webhook_cfg.get("host", "0.0.0.0"),
+                cert_file=webhook_cfg.get("certFile", ""),
+                key_file=webhook_cfg.get("keyFile", ""),
+            )
+            server.start()
+            manager.add_runnable(lambda: None, server.stop)
 
     return run_component("operator", build, argv)

@@ -1,0 +1,197 @@
+"""Lease-based leader election over the store.
+
+Reference binaries enable controller-runtime leader election so exactly one
+replica of each deployment reconciles (`LeaderElection` options built from
+the component configs, pkg/api/nos.nebuly.com/config/v1alpha1). The same
+semantics here, client-go's resourcelock pattern over a ConfigMap: the
+lock object's annotations carry holderIdentity + a renew counter;
+acquisition and renewal are optimistic-concurrency patches, so over the
+API-backed store (nos_tpu/kube/apistore.py) this is a real distributed
+lock — conflicting writers lose the resourceVersion race and observe the
+winner.
+
+Clock skew cannot steal a live lease: a challenger times the lease age
+from its OWN monotonic clock, starting when it first observes a given
+(holder, renew) pair — the remote wall-clock timestamp is informational
+only (exactly client-go's observedTime discipline). A leader that cannot
+reach the store steps down once its local renew deadline (the lease
+duration) passes without a successful renewal.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+from nos_tpu.kube.store import AlreadyExistsError, ConflictError, KubeStore, NotFoundError
+
+logger = logging.getLogger("nos_tpu.leaderelection")
+
+HOLDER_ANNOTATION = "nos.nebuly.com/leader-holder"
+RENEW_ANNOTATION = "nos.nebuly.com/leader-renew-time"
+
+
+class _HeldByOther(Exception):
+    def __init__(self, holder: str) -> None:
+        super().__init__(f"lease held by {holder}")
+        self.holder = holder
+
+
+class LeaderElector:
+    """Acquire/renew a named lease; callbacks fire on transitions."""
+
+    def __init__(
+        self,
+        store: KubeStore,
+        name: str,
+        identity: str,
+        namespace: str = "nos-system",
+        lease_duration_s: float = 15.0,
+        renew_period_s: float = 5.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.store = store
+        self.name = name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration_s = lease_duration_s
+        self.renew_period_s = renew_period_s
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (holder, renew) last observed on the lock + local monotonic time
+        # of FIRST observing that exact pair — the skew-free age source.
+        self._observed: Optional[tuple] = None
+        self._last_renew_ok = 0.0  # local monotonic of our last good renew
+
+    # -------------------------------------------------------------- lease
+
+    def _try_acquire_or_renew(self) -> bool:
+        now_mono = time.monotonic()
+
+        def mutate(cm: ConfigMap) -> None:
+            holder = cm.metadata.annotations.get(HOLDER_ANNOTATION, "")
+            renew = cm.metadata.annotations.get(RENEW_ANNOTATION, "")
+            if holder and holder != self.identity:
+                observed = self._observed
+                if observed is None or observed[0] != holder or observed[1] != renew:
+                    # Fresh activity on the lock: restart OUR lease timer.
+                    self._observed = (holder, renew, now_mono)
+                    raise _HeldByOther(holder)
+                if now_mono - observed[2] < self.lease_duration_s:
+                    raise _HeldByOther(holder)
+                # No renewal for a full local lease duration: expired.
+            cm.metadata.annotations[HOLDER_ANNOTATION] = self.identity
+            # Wall time is informational (humans, kubectl); expiry never
+            # compares it across machines.
+            cm.metadata.annotations[RENEW_ANNOTATION] = str(time.time())
+
+        try:
+            self.store.patch_merge("ConfigMap", self.name, self.namespace, mutate)
+            return True
+        except _HeldByOther as e:
+            logger.debug("lease %s held by %s", self.name, e.holder)
+            return False
+        except ConflictError:
+            return False
+        except NotFoundError:
+            pass
+        try:
+            self.store.create(
+                ConfigMap(
+                    metadata=ObjectMeta(
+                        name=self.name,
+                        namespace=self.namespace,
+                        annotations={
+                            HOLDER_ANNOTATION: self.identity,
+                            RENEW_ANNOTATION: str(time.time()),
+                        },
+                    )
+                )
+            )
+            return True
+        except AlreadyExistsError:
+            return False
+
+    def release(self) -> None:
+        """Voluntarily drop the lease: clearing the holder lets the next
+        challenger acquire instantly (no lease-duration wait)."""
+
+        def mutate(cm: ConfigMap) -> None:
+            if cm.metadata.annotations.get(HOLDER_ANNOTATION) != self.identity:
+                raise _HeldByOther(cm.metadata.annotations.get(HOLDER_ANNOTATION, ""))
+            cm.metadata.annotations[HOLDER_ANNOTATION] = ""
+            cm.metadata.annotations[RENEW_ANNOTATION] = "0"
+
+        try:
+            self.store.patch_merge("ConfigMap", self.name, self.namespace, mutate)
+        except (_HeldByOther, NotFoundError, ConflictError):
+            pass
+        except Exception as e:  # noqa: BLE001 — releasing must never raise
+            logger.warning("lease %s: release failed: %s", self.name, e)
+
+    # --------------------------------------------------------------- loop
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Block until stopped: acquire, then renew; transition callbacks
+        fire on gain/loss. A lost or unrenewable lease stops leadership
+        (the controller-runtime leader-elected runnable contract); store
+        errors never kill the loop — an unreachable apiserver demotes the
+        leader only after the renew deadline."""
+        stop = stop or self._stop
+        while not stop.is_set():
+            try:
+                got = self._try_acquire_or_renew()
+            except Exception as e:  # noqa: BLE001 — elector must survive
+                logger.warning(
+                    "lease %s: renew attempt failed: %s: %s",
+                    self.name, type(e).__name__, e,
+                )
+                # Retain leadership only within the renew deadline.
+                got = (
+                    self.is_leader
+                    and time.monotonic() - self._last_renew_ok < self.lease_duration_s
+                )
+            else:
+                if got:
+                    self._last_renew_ok = time.monotonic()
+            if got and not self.is_leader:
+                self.is_leader = True
+                logger.info("lease %s: %s became leader", self.name, self.identity)
+                if self.on_started_leading:
+                    self.on_started_leading()
+            elif not got and self.is_leader:
+                self.is_leader = False
+                logger.warning("lease %s: %s LOST leadership", self.name, self.identity)
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+            stop.wait(self.renew_period_s if self.is_leader else self.renew_period_s / 2)
+        if self.is_leader:
+            self.is_leader = False
+            self.release()
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"leader-elector-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def wait_for_leadership(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.is_leader:
+                return True
+            time.sleep(0.02)
+        return False

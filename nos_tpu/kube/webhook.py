@@ -1,0 +1,224 @@
+"""Validating admission webhook server — the TLS endpoint a real
+apiserver calls.
+
+Reference pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:31-97
+registers validators on controller-runtime's webhook server; the apiserver
+POSTs AdmissionReview v1 documents to it over TLS and enforces the
+returned allow/deny. This module is that half: an HTTPS server decoding
+AdmissionReview requests, converting the embedded object through the wire
+codecs, and running the same validator functions the in-process store
+seam uses (nos_tpu/controllers/elasticquota/webhooks.py) against the
+informer-backed store — one validation implementation, two transports.
+
+Certificates: production mounts a cert-manager secret (`certFile` /
+`keyFile` in the operator config); for demos/tests
+``generate_self_signed_cert`` mints one with the ``cryptography`` package.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from nos_tpu.kube import serde
+from nos_tpu.kube.store import AdmissionError, KubeStore
+
+logger = logging.getLogger("nos_tpu.webhook")
+
+# Webhook URL paths, mirroring the reference's controller-runtime
+# registrations (one path per validated kind).
+PATH_ELASTICQUOTA = "/validate-nos-nebuly-com-v1alpha1-elasticquota"
+PATH_COMPOSITEELASTICQUOTA = "/validate-nos-nebuly-com-v1alpha1-compositeelasticquota"
+
+
+def generate_self_signed_cert(
+    common_name: str = "nos-tpu-webhook",
+    sans: Tuple[str, ...] = ("localhost", "127.0.0.1"),
+    days: int = 365,
+) -> Tuple[bytes, bytes]:
+    """(cert_pem, key_pem) for local serving; production uses cert-manager."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    alt_names = []
+    for san in sans:
+        try:
+            alt_names.append(x509.IPAddress(ipaddress.ip_address(san)))
+        except ValueError:
+            alt_names.append(x509.DNSName(san))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName(alt_names), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    return (
+        cert.public_bytes(serialization.Encoding.PEM),
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ),
+    )
+
+
+class WebhookServer:
+    """HTTPS AdmissionReview endpoint bound to validator callables."""
+
+    def __init__(
+        self,
+        store: KubeStore,
+        port: int = 9443,
+        host: str = "0.0.0.0",
+        cert_pem: Optional[bytes] = None,
+        key_pem: Optional[bytes] = None,
+        cert_file: str = "",
+        key_file: str = "",
+    ) -> None:
+        self.store = store
+        # path -> validator(obj, store) raising AdmissionError to deny
+        self._validators: Dict[str, Callable] = {}
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # noqa: N802 — quiet
+                pass
+
+            def do_POST(self) -> None:  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                path = self.path.partition("?")[0]
+                validator = server._validators.get(path)
+                if validator is None:
+                    self._respond(404, {"message": f"no webhook at {path}"})
+                    return
+                try:
+                    review = json.loads(body)
+                    response = server._review(review, validator)
+                except Exception as e:  # noqa: BLE001 — malformed reviews
+                    self._respond(400, {"message": f"bad AdmissionReview: {e}"})
+                    return
+                self._respond(200, response)
+
+            def _respond(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        if cert_file and key_file:
+            ctx.load_cert_chain(cert_file, key_file)
+        else:
+            import tempfile
+            import os
+
+            if cert_pem is None or key_pem is None:
+                cert_pem, key_pem = generate_self_signed_cert()
+                logger.warning(
+                    "webhook: serving with a generated self-signed certificate "
+                    "(configure certFile/keyFile for production)"
+                )
+            self.cert_pem = cert_pem
+            with tempfile.TemporaryDirectory(prefix="nos-tpu-webhook-") as d:
+                cert_path = os.path.join(d, "tls.crt")
+                key_path = os.path.join(d, "tls.key")
+                with open(cert_path, "wb") as f:
+                    f.write(cert_pem)
+                with open(key_path, "wb") as f:
+                    f.write(key_pem)
+                ctx.load_cert_chain(cert_path, key_path)
+        self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="webhook-server", daemon=True
+        )
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def register(self, path: str, validator: Callable) -> None:
+        self._validators[path] = validator
+
+    def start(self) -> "WebhookServer":
+        self._thread.start()
+        logger.info("webhook server listening on :%d (TLS)", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------- review
+
+    def _review(self, review: dict, validator: Callable) -> dict:
+        request = review.get("request") or {}
+        uid = request.get("uid", "")
+        wire = request.get("object") or {}
+        try:
+            obj = serde.from_wire(wire)
+            validator(obj, self.store)
+            response = {"uid": uid, "allowed": True}
+        except AdmissionError as e:
+            response = {
+                "uid": uid,
+                "allowed": False,
+                "status": {"message": str(e), "code": 403},
+            }
+        except Exception as e:  # noqa: BLE001 — undecodable objects deny
+            response = {
+                "uid": uid,
+                "allowed": False,
+                "status": {"message": f"webhook error: {e}", "code": 400},
+            }
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
+
+def build_elasticquota_webhook_server(
+    store: KubeStore,
+    port: int = 9443,
+    host: str = "0.0.0.0",
+    cert_file: str = "",
+    key_file: str = "",
+) -> WebhookServer:
+    """The operator's webhook server with both quota validators bound
+    (reference operator.go:96-117 SetupWebhookWithManager calls)."""
+    from nos_tpu.controllers.elasticquota.webhooks import (
+        validate_composite_elastic_quota,
+        validate_elastic_quota,
+    )
+
+    server = WebhookServer(
+        store, port=port, host=host, cert_file=cert_file, key_file=key_file
+    )
+    server.register(PATH_ELASTICQUOTA, validate_elastic_quota)
+    server.register(PATH_COMPOSITEELASTICQUOTA, validate_composite_elastic_quota)
+    return server

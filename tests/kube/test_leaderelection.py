@@ -1,0 +1,174 @@
+"""Leader election: one holder at a time, renewal, takeover, handover —
+including over the API-backed store where the lock is a real
+resourceVersion race on the apiserver."""
+import threading
+import time
+
+import pytest
+
+from nos_tpu.kube.leaderelection import LeaderElector
+from nos_tpu.kube.store import KubeStore
+
+
+def make_elector(store, ident, **kw):
+    events = []
+    elector = LeaderElector(
+        store,
+        name="nos-tpu-test",
+        identity=ident,
+        lease_duration_s=kw.pop("lease", 0.5),
+        renew_period_s=kw.pop("renew", 0.1),
+        on_started_leading=lambda: events.append(f"{ident}-up"),
+        on_stopped_leading=lambda: events.append(f"{ident}-down"),
+        **kw,
+    )
+    return elector, events
+
+
+class TestLeaderElection:
+    def test_single_elector_leads_and_renews(self):
+        store = KubeStore()
+        elector, events = make_elector(store, "a")
+        elector.start()
+        try:
+            assert elector.wait_for_leadership(5.0)
+            time.sleep(0.6)  # several renew periods > lease duration
+            assert elector.is_leader  # renewal kept the lease alive
+            assert events == ["a-up"]
+        finally:
+            elector.stop()
+
+    def test_second_elector_waits_then_takes_over(self):
+        store = KubeStore()
+        first, _ = make_elector(store, "a")
+        second, events = make_elector(store, "b")
+        first.start()
+        assert first.wait_for_leadership(5.0)
+        second.start()
+        try:
+            time.sleep(0.3)
+            assert not second.is_leader  # lease held and renewed by a
+            first.stop()  # clean shutdown releases the lease
+            assert second.wait_for_leadership(5.0)
+            assert "b-up" in events
+        finally:
+            second.stop()
+
+    def test_crashed_leader_expires(self):
+        store = KubeStore()
+        first, _ = make_elector(store, "a")
+        first.start()
+        assert first.wait_for_leadership(5.0)
+        # simulate a crash: stop renewing WITHOUT releasing
+        first._stop.set()
+        first._thread.join(timeout=2.0)
+        # undo run()'s clean release to model a hard crash
+        store.patch_annotations(
+            "ConfigMap", "nos-tpu-test", "nos-system",
+            {"nos.nebuly.com/leader-holder": "a",
+             "nos.nebuly.com/leader-renew-time": str(time.time())},
+        )
+        second, _ = make_elector(store, "b")
+        second.start()
+        try:
+            assert second.wait_for_leadership(5.0)  # after lease expiry
+        finally:
+            second.stop()
+
+    def test_over_api_store(self):
+        from nos_tpu.kube.apiclient import ClusterCredentials, KubeApiClient
+        from nos_tpu.kube.apistore import KubeApiStore
+        from tests.kube.stub_apiserver import StubApiServer
+
+        with StubApiServer() as api:
+            stores = [
+                KubeApiStore(
+                    KubeApiClient(ClusterCredentials(server=api.url), timeout=5.0),
+                    kinds=("ConfigMap",),
+                )
+                for _ in range(2)
+            ]
+            for s in stores:
+                s.start(sync_timeout_s=10.0)
+            a, _ = make_elector(stores[0], "a")
+            b, _ = make_elector(stores[1], "b")
+            a.start()
+            b.start()
+            try:
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if a.is_leader or b.is_leader:
+                        break
+                    time.sleep(0.02)
+                time.sleep(0.3)
+                # exactly one leader, racing through the real apiserver
+                assert a.is_leader != b.is_leader
+            finally:
+                a.stop()
+                b.stop()
+                for s in stores:
+                    s.stop()
+
+
+class TestElectorRobustness:
+    def test_store_errors_do_not_kill_elector_and_demote_after_deadline(self):
+        store = KubeStore()
+        elector, events = make_elector(store, "a", lease=0.4, renew=0.1)
+        elector.start()
+        try:
+            assert elector.wait_for_leadership(5.0)
+            # apiserver "outage": every patch raises
+            original = store.patch_merge
+
+            def broken(*a, **k):
+                raise OSError("connection refused")
+
+            store.patch_merge = broken
+            time.sleep(0.2)
+            assert elector.is_leader  # within the renew deadline: retained
+            time.sleep(0.5)
+            assert not elector.is_leader  # deadline passed: stepped down
+            assert "a-down" in events
+            store.patch_merge = original
+            assert elector.wait_for_leadership(5.0)  # recovers
+        finally:
+            elector.stop()
+
+    def test_clock_skew_cannot_steal_a_live_lease(self):
+        """The holder's wall-clock timestamps are garbage (epoch 0); the
+        challenger must still honor the lease as long as renewals keep
+        CHANGING — expiry is timed locally from observed transitions."""
+        store = KubeStore()
+        from nos_tpu.kube.leaderelection import (
+            HOLDER_ANNOTATION,
+            RENEW_ANNOTATION,
+        )
+        from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+
+        store.create(ConfigMap(metadata=ObjectMeta(
+            name="nos-tpu-test", namespace="nos-system",
+            annotations={HOLDER_ANNOTATION: "skewed", RENEW_ANNOTATION: "1"})))
+        stop = threading.Event()
+
+        def keep_renewing():
+            i = 2
+            while not stop.is_set():
+                store.patch_annotations(
+                    "ConfigMap", "nos-tpu-test", "nos-system",
+                    {RENEW_ANNOTATION: str(i)})  # ancient-looking but changing
+                i += 1
+                time.sleep(0.05)
+
+        t = threading.Thread(target=keep_renewing, daemon=True)
+        t.start()
+        challenger, _ = make_elector(store, "b", lease=0.4, renew=0.1)
+        challenger.start()
+        try:
+            time.sleep(1.0)  # several lease durations of live renewals
+            assert not challenger.is_leader
+            stop.set()
+            t.join()
+            assert challenger.wait_for_leadership(5.0)  # holder went silent
+        finally:
+            stop.set()
+            challenger.stop()

@@ -1,0 +1,120 @@
+"""Validating webhook server over real TLS: AdmissionReview in,
+allow/deny out — the transport a production apiserver uses."""
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from nos_tpu.api.v1alpha1.constants import RESOURCE_TPU_CHIPS
+from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+from nos_tpu.kube import serde
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.kube.webhook import (
+    PATH_COMPOSITEELASTICQUOTA,
+    PATH_ELASTICQUOTA,
+    build_elasticquota_webhook_server,
+    generate_self_signed_cert,
+)
+
+
+@pytest.fixture
+def webhook():
+    store = KubeStore()
+    server = build_elasticquota_webhook_server(store, port=0, host="127.0.0.1")
+    server.start()
+    yield store, server
+    server.stop()
+
+
+def post_review(server, path, wire_obj, uid="review-1"):
+    """POST an AdmissionReview the way the apiserver does, verifying the
+    server's certificate like a configured caBundle would."""
+    ctx = ssl.create_default_context(cadata=server.cert_pem.decode())
+    ctx.check_hostname = False  # cert SAN is localhost; we dial 127.0.0.1
+    body = json.dumps(
+        {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": uid, "object": wire_obj},
+        }
+    ).encode()
+    req = urllib.request.Request(
+        f"https://127.0.0.1:{server.port}{path}",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, context=ctx, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def eq_wire(name="eq", ns="team-a", mn=4, mx=8):
+    return serde.to_wire(
+        ElasticQuota(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=ElasticQuotaSpec(
+                min={RESOURCE_TPU_CHIPS: mn}, max={RESOURCE_TPU_CHIPS: mx}
+            ),
+        )
+    )
+
+
+class TestWebhookServer:
+    def test_allows_valid_elasticquota(self, webhook):
+        _, server = webhook
+        review = post_review(server, PATH_ELASTICQUOTA, eq_wire())
+        assert review["response"]["allowed"] is True
+        assert review["response"]["uid"] == "review-1"
+
+    def test_denies_min_over_max(self, webhook):
+        _, server = webhook
+        review = post_review(server, PATH_ELASTICQUOTA, eq_wire(mn=9, mx=8))
+        assert review["response"]["allowed"] is False
+        assert "below spec.min" in review["response"]["status"]["message"]
+
+    def test_denies_second_quota_in_namespace(self, webhook):
+        store, server = webhook
+        store.create(serde.from_wire(eq_wire(name="existing")))
+        review = post_review(server, PATH_ELASTICQUOTA, eq_wire(name="another"))
+        assert review["response"]["allowed"] is False
+        assert "already has ElasticQuota" in review["response"]["status"]["message"]
+
+    def test_denies_overlapping_composite(self, webhook):
+        store, server = webhook
+        from nos_tpu.api.v1alpha1.elasticquota import (
+            CompositeElasticQuota,
+            CompositeElasticQuotaSpec,
+        )
+
+        store.create(
+            CompositeElasticQuota(
+                metadata=ObjectMeta(name="ceq-1", namespace="default"),
+                spec=CompositeElasticQuotaSpec(namespaces=["team-a", "team-b"]),
+            )
+        )
+        wire = serde.to_wire(
+            CompositeElasticQuota(
+                metadata=ObjectMeta(name="ceq-2", namespace="default"),
+                spec=CompositeElasticQuotaSpec(namespaces=["team-b", "team-c"]),
+            )
+        )
+        review = post_review(server, PATH_COMPOSITEELASTICQUOTA, wire)
+        assert review["response"]["allowed"] is False
+        assert "already covered" in review["response"]["status"]["message"]
+
+    def test_unknown_path_404s(self, webhook):
+        _, server = webhook
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_review(server, "/validate-nothing", eq_wire())
+        assert ei.value.code == 404
+
+    def test_malformed_review_denies(self, webhook):
+        _, server = webhook
+        review = post_review(server, PATH_ELASTICQUOTA, {"kind": "Garbage"})
+        assert review["response"]["allowed"] is False
+
+    def test_self_signed_cert_has_sans(self):
+        cert_pem, key_pem = generate_self_signed_cert(sans=("localhost", "10.0.0.1"))
+        assert b"BEGIN CERTIFICATE" in cert_pem
+        assert b"BEGIN RSA PRIVATE KEY" in key_pem or b"BEGIN PRIVATE KEY" in key_pem
