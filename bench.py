@@ -269,6 +269,30 @@ def run_tpu_child() -> None:
                 result[f"flash_speedup_{tag}"] = round(d_ms / f_ms, 3)
             snapshot()
 
+        # ---- serving: KV-cache autoregressive decode throughput (the
+        # per-token cost a slice tenant sees; memory-bandwidth-bound).
+        try:
+            from nos_tpu.models.generate import generate as kv_generate
+
+            new_tokens = 64
+            gen = jax.jit(
+                lambda p, t: kv_generate(p, t, config, max_new_tokens=new_tokens)
+            )
+            prompt = jnp.zeros((1, 128), jnp.int32)
+            jax.block_until_ready(gen(params, prompt))
+            start = time.monotonic()
+            iters = 3
+            for _ in range(iters):
+                out = gen(params, prompt)
+            jax.block_until_ready(out)
+            tok_s = new_tokens * iters / (time.monotonic() - start)
+            result["decode_tokens_per_s"] = round(tok_s, 1)
+            log(f"[tpu-child] decode: {tok_s:.1f} tok/s "
+                f"(KV cache, prompt 128 + {new_tokens} new)")
+            snapshot()
+        except Exception as e:
+            log(f"[tpu-child] decode failed: {type(e).__name__}: {str(e)[:160]}")
+
     print(json.dumps(result), flush=True)
 
 

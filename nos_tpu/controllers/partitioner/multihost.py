@@ -118,105 +118,112 @@ class MultihostExpander:
     # ------------------------------------------------------------- expand
 
     def _expand(self, pod: Pod, accelerator: str, shape: str, n_hosts: int) -> None:
-        spec = KNOWN_ACCELERATORS[accelerator]
-        board_slice = constants.tpu_slice_resource(spec.board_topology)
-        gang_name = pod.metadata.name
-
         def mutate(p: Pod) -> None:
-            p.metadata.labels[GANG_NAME_LABEL] = gang_name
-            p.metadata.labels[GANG_SIZE_LABEL] = str(n_hosts)
-            p.metadata.labels[MULTIHOST_ROLE_LABEL] = ROLE_LEADER
-            p.metadata.annotations[MULTIHOST_TOPOLOGY_ANNOTATION] = shape
-            self._rewrite_requests(p, board_slice)
+            expand_leader_in_place(p, accelerator, shape, n_hosts)
 
         self.store.patch_merge("Pod", pod.metadata.name, pod.metadata.namespace, mutate)
         leader = self.store.get("Pod", pod.metadata.name, pod.metadata.namespace)
+        self._ensure_service(leader)
         self._ensure_workers(leader)
         log.info(
-            "%s: expanded to %s multi-host slice — gang of %d × %s",
-            pod.namespaced_name, shape, n_hosts, board_slice,
+            "%s: expanded to %s multi-host slice — gang of %d hosts",
+            pod.namespaced_name, shape, n_hosts,
         )
 
-    @staticmethod
-    def _rewrite_requests(pod: Pod, board_slice: str) -> None:
-        """Replace the oversized plain-chip ask with ONE per-host board
-        slice (the leader's share; each worker asks the same). Limits are
-        rewritten symmetrically: extended resources require
-        requests == limits on a real apiserver."""
-        rewritten = False
-        for container in pod.spec.containers:
-            had_request = container.requests.pop(constants.RESOURCE_TPU, None) is not None
-            had_limit = container.limits.pop(constants.RESOURCE_TPU, None) is not None
-            if (had_request or had_limit) and not rewritten:
-                container.requests[board_slice] = (
-                    container.requests.get(board_slice, 0) + 1
+    def _ensure_service(self, leader: Pod) -> None:
+        """Headless Service named after the gang: gives every member a
+        stable DNS record (<hostname>.<gang>.<ns>.svc) so the coordinator
+        address the env carries actually resolves."""
+        from nos_tpu.kube.objects import Service, ServicePort, ServiceSpec
+        from nos_tpu.parallel.distributed import DEFAULT_COORDINATOR_PORT
+
+        gang = leader.metadata.labels.get(GANG_NAME_LABEL, "")
+        if not gang or self.store.try_get("Service", gang, leader.metadata.namespace):
+            return
+        try:
+            self.store.create(
+                Service(
+                    metadata=ObjectMeta(
+                        name=gang,
+                        namespace=leader.metadata.namespace,
+                        owner_references=[
+                            OwnerReference(
+                                kind="Pod",
+                                name=leader.metadata.name,
+                                uid=leader.metadata.uid,
+                                controller=True,
+                            )
+                        ],
+                    ),
+                    spec=ServiceSpec(
+                        selector={GANG_NAME_LABEL: gang},
+                        ports=[
+                            ServicePort(
+                                name="coordinator", port=DEFAULT_COORDINATOR_PORT
+                            )
+                        ],
+                        cluster_ip="None",  # headless: per-pod DNS records
+                    ),
                 )
-                container.limits[board_slice] = container.requests[board_slice]
-                rewritten = True
-        if not rewritten and pod.spec.containers:
-            pod.spec.containers[0].requests[board_slice] = 1
-            pod.spec.containers[0].limits[board_slice] = 1
+            )
+        except AlreadyExistsError:
+            pass
 
     def _ensure_workers(self, leader: Pod) -> None:
-        """Idempotently create the leader's n_hosts-1 sibling workers."""
+        """Idempotently create the leader's n_hosts-1 sibling workers.
+
+        Over the API-backed store the worker is built from the leader's
+        RAW wire object, so every field the projection doesn't model
+        (volumes, probes, serviceAccount, …) carries over to the workers
+        with full fidelity."""
         try:
             size = int(leader.metadata.labels.get(GANG_SIZE_LABEL, "0"))
         except ValueError:
             return
+        raw_get = getattr(self.store, "raw_get", None)
+        leader_wire = None
+        if raw_get is not None:
+            try:
+                leader_wire = raw_get(
+                    "Pod", leader.metadata.name, leader.metadata.namespace
+                )
+            except Exception:  # noqa: BLE001 — fall back to the projection
+                leader_wire = None
         for i in range(1, size):
             name = f"{leader.metadata.name}-w{i}"
             if self.store.try_get("Pod", name, leader.metadata.namespace):
                 continue
-            worker = Pod(
-                metadata=ObjectMeta(
-                    name=name,
-                    namespace=leader.metadata.namespace,
-                    labels={
-                        **{
-                            k: v
-                            for k, v in leader.metadata.labels.items()
-                            if k != MULTIHOST_ROLE_LABEL
-                        },
-                        MULTIHOST_ROLE_LABEL: ROLE_WORKER,
-                    },
-                    annotations={
-                        MULTIHOST_TOPOLOGY_ANNOTATION: leader.metadata.annotations.get(
-                            MULTIHOST_TOPOLOGY_ANNOTATION, ""
-                        )
-                    },
-                    owner_references=[
-                        OwnerReference(
-                            kind="Pod",
-                            name=leader.metadata.name,
-                            uid=leader.metadata.uid,
-                            controller=True,
-                        )
-                    ],
-                ),
-                spec=copy.deepcopy(leader.spec),
-            )
-            worker.spec.node_name = ""
             try:
-                self.store.create(worker)
+                if leader_wire is not None:
+                    self.store.raw_create(
+                        "Pod", worker_wire_from_leader(leader_wire, i, size)
+                    )
+                else:
+                    self.store.create(worker_from_leader(leader, i, size))
             except AlreadyExistsError:
                 pass
 
     def _gc_orphan_worker(self, worker: Pod) -> None:
-        """Workers follow their leader's lifecycle (owner-reference GC)."""
+        """Workers (and the gang's headless Service) follow their leader's
+        lifecycle — the owner-reference GC contract, done by hand for the
+        in-memory store (a real cluster's garbage collector does the same
+        from the ownerReferences the expander sets)."""
         for ref in worker.metadata.owner_references:
             if ref.kind == "Pod" and ref.controller:
                 if self.store.try_get("Pod", ref.name, worker.metadata.namespace):
                     return
-                try:
-                    self.store.delete(
-                        "Pod", worker.metadata.name, worker.metadata.namespace
-                    )
-                    log.info(
-                        "%s: garbage-collected (leader %s gone)",
-                        worker.namespaced_name, ref.name,
-                    )
-                except NotFoundError:
-                    pass
+                for kind, name in (
+                    ("Pod", worker.metadata.name),
+                    ("Service", ref.name),
+                ):
+                    try:
+                        self.store.delete(kind, name, worker.metadata.namespace)
+                        log.info(
+                            "%s/%s: garbage-collected (leader %s gone)",
+                            kind, name, ref.name,
+                        )
+                    except NotFoundError:
+                        pass
                 return
 
 
@@ -241,3 +248,188 @@ def leader_deleted_mapper(store: KubeStore):
         ]
 
     return mapper
+
+
+# --------------------------------------------------------- shared mutation
+
+
+def expand_leader_in_place(pod: Pod, accelerator: str, shape: str, n_hosts: int) -> None:
+    """The gang-leader rewrite, applied to a Pod object in place: gang
+    labels, topology annotation, per-host slice request, distributed-init
+    env (rank 0), and the DNS identity that makes the coordinator address
+    resolvable. Shared by the controller's store-patch path (in-memory
+    suite) and the mutating admission webhook (real clusters, where pod
+    labels/requests/env are immutable after admission)."""
+    from nos_tpu.parallel.distributed import gang_member_env
+
+    spec = KNOWN_ACCELERATORS[accelerator]
+    board_slice = constants.tpu_slice_resource(spec.board_topology)
+    gang = pod.metadata.name
+    pod.metadata.labels[GANG_NAME_LABEL] = gang
+    pod.metadata.labels[GANG_SIZE_LABEL] = str(n_hosts)
+    pod.metadata.labels[MULTIHOST_ROLE_LABEL] = ROLE_LEADER
+    pod.metadata.annotations[MULTIHOST_TOPOLOGY_ANNOTATION] = shape
+    _rewrite_requests(pod, board_slice)
+    pod.spec.hostname = pod.metadata.name
+    pod.spec.subdomain = gang  # headless Service of the same name
+    for container in pod.spec.containers:
+        container.env.update(
+            gang_member_env(gang, pod.metadata.namespace, 0, n_hosts)
+        )
+
+
+def _rewrite_requests(pod: Pod, board_slice: str) -> None:
+    """Replace the oversized plain-chip ask with ONE per-host board slice
+    (the leader's share; each worker asks the same). Limits are rewritten
+    symmetrically: extended resources require requests == limits on a real
+    apiserver."""
+    rewritten = False
+    for container in pod.spec.containers:
+        had_request = container.requests.pop(constants.RESOURCE_TPU, None) is not None
+        had_limit = container.limits.pop(constants.RESOURCE_TPU, None) is not None
+        if (had_request or had_limit) and not rewritten:
+            container.requests[board_slice] = container.requests.get(board_slice, 0) + 1
+            container.limits[board_slice] = container.requests[board_slice]
+            rewritten = True
+    if not rewritten and pod.spec.containers:
+        pod.spec.containers[0].requests[board_slice] = 1
+        pod.spec.containers[0].limits[board_slice] = 1
+
+
+def worker_from_leader(leader: Pod, rank: int, size: int) -> Pod:
+    """A typed worker pod mirroring the leader (in-memory store path)."""
+    from nos_tpu.parallel.distributed import gang_member_env
+
+    name = f"{leader.metadata.name}-w{rank}"
+    worker = Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace=leader.metadata.namespace,
+            labels={
+                **{
+                    k: v
+                    for k, v in leader.metadata.labels.items()
+                    if k != MULTIHOST_ROLE_LABEL
+                },
+                MULTIHOST_ROLE_LABEL: ROLE_WORKER,
+            },
+            annotations={
+                MULTIHOST_TOPOLOGY_ANNOTATION: leader.metadata.annotations.get(
+                    MULTIHOST_TOPOLOGY_ANNOTATION, ""
+                )
+            },
+            owner_references=[
+                OwnerReference(
+                    kind="Pod",
+                    name=leader.metadata.name,
+                    uid=leader.metadata.uid,
+                    controller=True,
+                )
+            ],
+        ),
+        spec=copy.deepcopy(leader.spec),
+    )
+    worker.spec.node_name = ""
+    worker.spec.hostname = name
+    for container in worker.spec.containers:
+        container.env.update(
+            gang_member_env(leader.metadata.name, leader.metadata.namespace, rank, size)
+        )
+    return worker
+
+
+def worker_wire_from_leader(leader_wire: dict, rank: int, size: int) -> dict:
+    """A worker's WIRE pod built from the leader's raw wire object — full
+    fidelity for every field the typed projection does not model."""
+    import json as _json
+
+    from nos_tpu.parallel.distributed import gang_member_env
+
+    wire = _json.loads(_json.dumps(leader_wire))
+    meta = wire.setdefault("metadata", {})
+    leader_name = meta.get("name", "")
+    namespace = meta.get("namespace", "")
+    name = f"{leader_name}-w{rank}"
+    labels = dict(meta.get("labels") or {})
+    labels[MULTIHOST_ROLE_LABEL] = ROLE_WORKER
+    wire["metadata"] = {
+        "name": name,
+        "namespace": namespace,
+        "labels": labels,
+        "annotations": {
+            MULTIHOST_TOPOLOGY_ANNOTATION: (meta.get("annotations") or {}).get(
+                MULTIHOST_TOPOLOGY_ANNOTATION, ""
+            )
+        },
+        "ownerReferences": [
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "name": leader_name,
+                "uid": meta.get("uid", ""),
+                "controller": True,
+            }
+        ],
+    }
+    wire.pop("status", None)
+    spec = wire.setdefault("spec", {})
+    spec.pop("nodeName", None)
+    spec["hostname"] = name
+    env_vars = gang_member_env(leader_name, namespace, rank, size)
+    for container in spec.get("containers") or []:
+        env = [e for e in container.get("env") or [] if e.get("name") not in env_vars]
+        env.extend({"name": k, "value": v} for k, v in sorted(env_vars.items()))
+        container["env"] = env
+    return wire
+
+
+# ------------------------------------------------------ admission mutation
+
+
+def admission_mutate_pod(wire_pod: dict, store: KubeStore):
+    """JSONPatch ops expanding an oversized pod AT ADMISSION — the only
+    point a real cluster allows this rewrite (webhook server route
+    ``/mutate-v1-pod``). Returns None (no patch) for pods that need no
+    expansion. Ops are computed against the ORIGINAL wire object, so
+    unmodeled fields survive untouched."""
+    from nos_tpu.kube import serde
+    from nos_tpu.kube.apistore import _overlay_containers
+
+    pod = serde.pod_from_wire(wire_pod)
+    if pod.metadata.labels.get(MULTIHOST_ROLE_LABEL):
+        return None  # already expanded (or one of our own workers)
+    if pod.spec.node_name:
+        return None
+    expander = MultihostExpander(store)
+    accelerator = expander._cluster_accelerator()
+    if accelerator is None:
+        return None
+    chips = expander._oversized_chips(pod, accelerator)
+    if chips <= 0:
+        return None
+    profile = multihost_profile_for_chips(chips, accelerator)
+    if profile is None:
+        return None
+    shape, n_hosts = profile
+    expand_leader_in_place(pod, accelerator, shape, n_hosts)
+    projected = serde.pod_to_wire(pod)
+    ops = []
+    for key in ("labels", "annotations"):
+        merged = {
+            **((wire_pod.get("metadata") or {}).get(key) or {}),
+            **(projected["metadata"].get(key) or {}),
+        }
+        ops.append({"op": "add", "path": f"/metadata/{key}", "value": merged})
+    ops.append(
+        {
+            "op": "replace",
+            "path": "/spec/containers",
+            "value": _overlay_containers(
+                (wire_pod.get("spec") or {}).get("containers"),
+                projected["spec"].get("containers"),
+            ),
+        }
+    )
+    ops.append({"op": "add", "path": "/spec/hostname", "value": pod.spec.hostname})
+    ops.append({"op": "add", "path": "/spec/subdomain", "value": pod.spec.subdomain})
+    return ops

@@ -62,6 +62,34 @@ STATUS_SUBRESOURCE = {
 _MISSING = object()
 
 
+def _overlay_containers(live_list, projected_list):
+    """Projected containers + the live wire's unmodeled per-container
+    fields. Lists are atomic in a merge-patch, so when a diff must mention
+    spec.containers it has to carry the WHOLE array — this overlay keeps
+    everything the projection doesn't model (volumeMounts, probes,
+    valueFrom env entries, …) from being wiped by our own patch."""
+    by_name = {c.get("name"): c for c in live_list or []}
+    out = []
+    for c in projected_list or []:
+        base = dict(by_name.get(c.get("name"), {}))
+        merged = {**base, **{k: v for k, v in c.items() if k != "env"}}
+        if "env" in c or "env" in base:
+            # env entries merge BY NAME; live valueFrom sources survive
+            # unless the projection explicitly overrides that name.
+            projected_env = {e["name"]: e for e in c.get("env") or []}
+            merged_env = []
+            for entry in base.get("env") or []:
+                override = projected_env.pop(entry["name"], None)
+                merged_env.append(override if override is not None else entry)
+            merged_env.extend(projected_env.values())
+            if merged_env:
+                merged["env"] = merged_env
+            else:
+                merged.pop("env", None)
+        out.append(merged)
+    return out
+
+
 def _merge_diff(old: Dict[str, Any], new: Dict[str, Any]) -> Dict[str, Any]:
     """Minimal JSON merge-patch turning `old` into `new`.
 
@@ -313,6 +341,17 @@ class KubeApiStore(KubeStore):
         if not diff:
             self._apply_upsert(live)
             return copy.deepcopy(live)
+        if kind == "Pod" and "containers" in (diff.get("spec") or {}):
+            # The containers array is replaced wholesale by a merge-patch:
+            # graft the live wire's unmodeled fields back in first.
+            try:
+                live_wire = self._client.get(path)
+            except ApiError as e:
+                raise _api_error_to_store(e) from e
+            diff["spec"]["containers"] = _overlay_containers(
+                (live_wire.get("spec") or {}).get("containers"),
+                diff["spec"]["containers"],
+            )
         try:
             status_diff = (
                 diff.pop("status", None) if kind in STATUS_SUBRESOURCE else None
@@ -345,6 +384,28 @@ class KubeApiStore(KubeStore):
             raise _api_error_to_store(e) from e
         self._apply_upsert(refreshed)
         return copy.deepcopy(refreshed)
+
+    # ------------------------------------------------------------- raw path
+
+    def raw_get(self, kind: str, name: str, namespace: str = "") -> Dict[str, Any]:
+        """The live WIRE object — full fidelity beyond the typed
+        projection (e.g. cloning a pod spec with volumes/probes intact)."""
+        try:
+            return self._client.get(serde.resource_path(kind, namespace, name))
+        except ApiError as e:
+            raise _api_error_to_store(e) from e
+
+    def raw_create(self, kind: str, wire: Dict[str, Any]) -> Any:
+        """POST a wire object as-is; the typed projection lands in cache."""
+        namespace = (wire.get("metadata") or {}).get("namespace", "")
+        try:
+            resp = self._client.create(serde.resource_path(kind, namespace), wire)
+        except ApiError as e:
+            raise _api_error_to_store(e) from e
+        resp.setdefault("kind", kind)
+        stored = serde.from_wire(resp)
+        self._apply_upsert(stored)
+        return copy.deepcopy(stored)
 
     # ------------------------------------------------------------ read path
     # get/try_get/list/list_by_index/watch/stop_watch/indexers are inherited:

@@ -145,6 +145,11 @@ class Container:
     # requests/limits: resource name -> quantity
     requests: ResourceList = field(default_factory=dict)
     limits: ResourceList = field(default_factory=dict)
+    # name -> value (the suite only writes literal values, e.g. the gang's
+    # distributed-init coordinates). valueFrom entries are not modeled;
+    # the API-backed store grafts them back into any patch that must
+    # mention the containers array (apistore._overlay_containers).
+    env: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -166,6 +171,10 @@ class PodSpec:
     tolerations: List[Toleration] = field(default_factory=list)
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[NodeAffinity] = None
+    # Stable pod DNS under a headless Service (<hostname>.<subdomain>.<ns>
+    # .svc) — what makes a gang leader's coordinator address resolvable.
+    hostname: str = ""
+    subdomain: str = ""
 
 
 @dataclass
@@ -234,6 +243,30 @@ class ConfigMap:
     kind: str = "ConfigMap"
 
     def deepcopy(self) -> "ConfigMap":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""  # "None" = headless (per-pod DNS records)
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    kind: str = "Service"
+
+    def deepcopy(self) -> "Service":
         return copy.deepcopy(self)
 
 

@@ -26,6 +26,9 @@ from nos_tpu.api.v1alpha1.elasticquota import (
 )
 from nos_tpu.kube.objects import (
     ConfigMap,
+    Service,
+    ServicePort,
+    ServiceSpec,
     Container,
     NodeAffinity,
     NodeSelectorRequirement,
@@ -50,6 +53,7 @@ RESOURCES: Dict[str, Tuple[str, str, bool]] = {
     "Pod": ("/api/v1", "pods", True),
     "Node": ("/api/v1", "nodes", False),
     "ConfigMap": ("/api/v1", "configmaps", True),
+    "Service": ("/api/v1", "services", True),
     "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
     "ElasticQuota": ("/apis/nos.nebuly.com/v1alpha1", "elasticquotas", True),
     "CompositeElasticQuota": (
@@ -63,6 +67,7 @@ API_VERSIONS: Dict[str, str] = {
     "Pod": "v1",
     "Node": "v1",
     "ConfigMap": "v1",
+    "Service": "v1",
     "PodDisruptionBudget": "policy/v1",
     "ElasticQuota": "nos.nebuly.com/v1alpha1",
     "CompositeElasticQuota": "nos.nebuly.com/v1alpha1",
@@ -233,6 +238,8 @@ def _container_to_wire(c: Container) -> Dict[str, Any]:
         resources["limits"] = _resources_to_wire(c.limits)
     if resources:
         out["resources"] = resources
+    if c.env:
+        out["env"] = [{"name": k, "value": v} for k, v in sorted(c.env.items())]
     return out
 
 
@@ -243,6 +250,11 @@ def _container_from_wire(d: Dict[str, Any]) -> Container:
         image=d.get("image", ""),
         requests=_resources_from_wire(res.get("requests")),
         limits=_resources_from_wire(res.get("limits")),
+        env={
+            e["name"]: e.get("value", "")
+            for e in d.get("env") or []
+            if "name" in e and "valueFrom" not in e
+        },
     )
 
 
@@ -313,6 +325,10 @@ def pod_to_wire(pod: Pod) -> Dict[str, Any]:
     aff = _affinity_to_wire(pod.spec.affinity)
     if aff:
         spec["affinity"] = aff
+    if pod.spec.hostname:
+        spec["hostname"] = pod.spec.hostname
+    if pod.spec.subdomain:
+        spec["subdomain"] = pod.spec.subdomain
     status: Dict[str, Any] = {"phase": pod.status.phase}
     if pod.status.conditions:
         status["conditions"] = [
@@ -355,6 +371,8 @@ def pod_from_wire(d: Dict[str, Any]) -> Pod:
             ],
             node_selector=dict(spec.get("nodeSelector") or {}),
             affinity=_affinity_from_wire(spec.get("affinity")),
+            hostname=spec.get("hostname", ""),
+            subdomain=spec.get("subdomain", ""),
         ),
         status=PodStatus(
             phase=status.get("phase", "Pending"),
@@ -434,6 +452,50 @@ def configmap_from_wire(d: Dict[str, Any]) -> ConfigMap:
     return ConfigMap(
         metadata=meta_from_wire(d.get("metadata") or {}),
         data=dict(d.get("data") or {}),
+    )
+
+
+# ------------------------------------------------------------------- Service
+
+
+def service_to_wire(svc: Service) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {}
+    if svc.spec.selector:
+        spec["selector"] = dict(svc.spec.selector)
+    if svc.spec.ports:
+        spec["ports"] = [
+            {"name": p.name, "port": p.port,
+             "targetPort": p.target_port or p.port}
+            for p in svc.spec.ports
+        ]
+    if svc.spec.cluster_ip:
+        spec["clusterIP"] = svc.spec.cluster_ip
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta_to_wire(svc.metadata),
+        "spec": spec,
+    }
+
+
+def service_from_wire(d: Dict[str, Any]) -> Service:
+    spec = d.get("spec") or {}
+    return Service(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=ServiceSpec(
+            selector=dict(spec.get("selector") or {}),
+            ports=[
+                ServicePort(
+                    name=p.get("name", ""),
+                    port=int(p.get("port") or 0),
+                    target_port=int(p.get("targetPort") or 0)
+                    if str(p.get("targetPort") or "0").isdigit()
+                    else 0,
+                )
+                for p in spec.get("ports") or []
+            ],
+            cluster_ip=str(spec.get("clusterIP") or ""),
+        ),
     )
 
 
@@ -530,6 +592,7 @@ _TO_WIRE = {
     "Pod": pod_to_wire,
     "Node": node_to_wire,
     "ConfigMap": configmap_to_wire,
+    "Service": service_to_wire,
     "PodDisruptionBudget": pdb_to_wire,
     "ElasticQuota": eq_to_wire,
     "CompositeElasticQuota": ceq_to_wire,
@@ -539,6 +602,7 @@ _FROM_WIRE = {
     "Pod": pod_from_wire,
     "Node": node_from_wire,
     "ConfigMap": configmap_from_wire,
+    "Service": service_from_wire,
     "PodDisruptionBudget": pdb_from_wire,
     "ElasticQuota": eq_from_wire,
     "CompositeElasticQuota": ceq_from_wire,

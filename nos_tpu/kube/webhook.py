@@ -33,6 +33,9 @@ logger = logging.getLogger("nos_tpu.webhook")
 # registrations (one path per validated kind).
 PATH_ELASTICQUOTA = "/validate-nos-nebuly-com-v1alpha1-elasticquota"
 PATH_COMPOSITEELASTICQUOTA = "/validate-nos-nebuly-com-v1alpha1-compositeelasticquota"
+# Mutating path: multi-host slice expansion at pod admission (the only
+# point a real apiserver allows the rewrite).
+PATH_MUTATE_POD = "/mutate-v1-pod"
 
 
 def generate_self_signed_cert(
@@ -95,6 +98,8 @@ class WebhookServer:
         self.store = store
         # path -> validator(obj, store) raising AdmissionError to deny
         self._validators: Dict[str, Callable] = {}
+        # path -> mutator(wire_obj, store) -> JSONPatch ops | None
+        self._mutators: Dict[str, Callable] = {}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -108,12 +113,16 @@ class WebhookServer:
                 body = self.rfile.read(n)
                 path = self.path.partition("?")[0]
                 validator = server._validators.get(path)
-                if validator is None:
+                mutator = server._mutators.get(path)
+                if validator is None and mutator is None:
                     self._respond(404, {"message": f"no webhook at {path}"})
                     return
                 try:
                     review = json.loads(body)
-                    response = server._review(review, validator)
+                    if mutator is not None:
+                        response = server._mutate_review(review, mutator)
+                    else:
+                        response = server._review(review, validator)
                 except Exception as e:  # noqa: BLE001 — malformed reviews
                     self._respond(400, {"message": f"bad AdmissionReview: {e}"})
                     return
@@ -164,6 +173,9 @@ class WebhookServer:
     def register(self, path: str, validator: Callable) -> None:
         self._validators[path] = validator
 
+    def register_mutator(self, path: str, mutator: Callable) -> None:
+        self._mutators[path] = mutator
+
     def start(self) -> "WebhookServer":
         self._thread.start()
         logger.info("webhook server listening on :%d (TLS)", self.port)
@@ -201,6 +213,28 @@ class WebhookServer:
             "response": response,
         }
 
+    def _mutate_review(self, review: dict, mutator: Callable) -> dict:
+        request = review.get("request") or {}
+        uid = request.get("uid", "")
+        wire = request.get("object") or {}
+        response: dict = {"uid": uid, "allowed": True}
+        try:
+            ops = mutator(wire, self.store)
+            if ops:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = base64.b64encode(
+                    json.dumps(ops).encode()
+                ).decode()
+        except Exception as e:  # noqa: BLE001 — mutation failures must not
+            # block unrelated admissions (failurePolicy Ignore semantics
+            # server-side too): admit unmodified, log loudly.
+            logger.warning("mutating webhook failed, admitting unpatched: %s", e)
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
 
 def build_elasticquota_webhook_server(
     store: KubeStore,
@@ -221,4 +255,12 @@ def build_elasticquota_webhook_server(
     )
     server.register(PATH_ELASTICQUOTA, validate_elastic_quota)
     server.register(PATH_COMPOSITEELASTICQUOTA, validate_composite_elastic_quota)
+
+    # Multi-host expansion belongs to the partitioner conceptually, but the
+    # admission rewrite must happen HERE: pod labels/requests/env are
+    # immutable after admission on a real apiserver (the in-memory suite's
+    # controller patch path models the same seam without TLS).
+    from nos_tpu.controllers.partitioner.multihost import admission_mutate_pod
+
+    server.register_mutator(PATH_MUTATE_POD, admission_mutate_pod)
     return server

@@ -1,0 +1,194 @@
+"""Autoregressive generation with a KV cache.
+
+The serving half of the workload stack (the training half lives in
+nos_tpu/parallel): prefill runs the full-sequence forward once and keeps
+every layer's K/V; each decode step then attends one query position
+against the cache — O(S) per token instead of O(S²) re-forwarding.
+
+TPU-first choices: the cache is a static-shape [B, max_len, Hkv, hd]
+ring-less buffer written with ``lax.dynamic_update_slice`` at a traced
+position; the decode loop is a ``lax.scan`` over token steps (one compiled
+program regardless of generation length); attention masks by position
+against iota instead of slicing (no dynamic shapes anywhere, so XLA tiles
+every matmul onto the MXU). GQA attends grouped queries against the
+unexpanded cache exactly like the training path.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nos_tpu.models.llama import (
+    LlamaConfig,
+    _apply_rope,
+    _mlp,
+    _rms_norm,
+    _rope,
+    _rope_at,
+    llama_forward,
+)
+
+Params = Dict[str, Any]
+Cache = List[Dict[str, jax.Array]]
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Cache:
+    """Per-layer K/V buffers [B, max_len, Hkv, hd] in the model dtype."""
+    c = config
+    shape = (batch, max_len, c.n_kv_heads, c.head_dim)
+    return [
+        {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+        for _ in range(c.n_layers)
+    ]
+
+
+def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig):
+    """q [B, 1, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
+    ``n_valid`` positions (a traced scalar)."""
+    c = config
+    b, _, hq, hd = q.shape
+    t = cache_k.shape[1]
+    group = c.n_heads // c.n_kv_heads
+    qg = q.reshape(b, 1, c.n_kv_heads, group, hd)
+    scores = jnp.einsum("bsKgh,btKh->bKgst", qg, cache_k).astype(jnp.float32)
+    scores = scores / math.sqrt(hd)
+    valid = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4) < n_valid
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bKgst,btKh->bsKgh", probs, cache_v)
+    return out.reshape(b, 1, c.n_heads * hd)
+
+
+def prefill(
+    params: Params, tokens: jax.Array, config: LlamaConfig, max_len: int
+) -> Tuple[jax.Array, Cache]:
+    """Full forward over the prompt; returns (logits [B, S, vocab], cache
+    holding the prompt's K/V in positions [0, S))."""
+    c = config
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt length {s} exceeds cache capacity {max_len}")
+    x = params["embed"][tokens]
+    cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype)
+    cache = init_kv_cache(c, b, max_len)
+    for i, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
+        hd = c.head_dim
+        q = (h @ layer["wq"]).reshape(b, s, c.n_heads, hd)
+        k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+        v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        cache[i]["k"] = jax.lax.dynamic_update_slice(
+            cache[i]["k"], k.astype(c.dtype), (0, 0, 0, 0)
+        )
+        cache[i]["v"] = jax.lax.dynamic_update_slice(
+            cache[i]["v"], v.astype(c.dtype), (0, 0, 0, 0)
+        )
+        # causal attention within the prompt (same math as training dense)
+        group = c.n_heads // c.n_kv_heads
+        qg = q.reshape(b, s, c.n_kv_heads, group, hd)
+        scores = jnp.einsum("bsKgh,btKh->bKgst", qg, k).astype(jnp.float32)
+        scores = scores / math.sqrt(hd)
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal[None, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, c.n_heads * hd)
+        x = x + attn @ layer["wo"]
+        x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32), cache
+
+
+def decode_step(
+    params: Params,
+    cache: Cache,
+    pos: jax.Array,
+    token: jax.Array,
+    config: LlamaConfig,
+) -> Tuple[jax.Array, Cache]:
+    """One token at (traced) position ``pos`` → (logits [B, vocab], cache
+    with K/V written at pos)."""
+    c = config
+    b = token.shape[0]
+    hd = c.head_dim
+    x = params["embed"][token][:, None, :]  # [B, 1, D]
+    cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype)  # [1, hd/2]
+
+    new_cache: Cache = []
+    for layer, kv in zip(params["layers"], cache):
+        h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = (h @ layer["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, hd)
+        v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
+        new_cache.append({"k": ck, "v": cv})
+        attn = _cache_attention(q, ck, cv, pos + 1, c)
+        x = x + attn @ layer["wo"]
+        x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return (x[:, 0] @ params["lm_head"]).astype(jnp.float32), new_cache
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    config: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """prompt [B, S] → generated tokens [B, max_new_tokens].
+
+    Greedy when temperature == 0, otherwise temperature sampling. The
+    decode loop is one ``lax.scan`` — compile once, reuse for any prompt
+    of the same shape."""
+    c = config
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    logits, cache = prefill(params, prompt, c, max_len)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+            prompt.dtype
+        )
+
+    # Single-use keys: every sample consumes a fresh split — the carried
+    # key is only ever a split parent, never passed to categorical itself.
+    rng, first_key = jax.random.split(rng)
+    first = pick(logits[:, -1], first_key)
+
+    def body(carry, _):
+        cache, pos, token, rng = carry
+        rng, sub = jax.random.split(rng)
+        logits, cache = decode_step(params, cache, pos, token, c)
+        nxt = pick(logits, sub)
+        return (cache, pos + 1, nxt, rng), token
+
+    (_, _, _, _), tokens = jax.lax.scan(
+        body, (cache, jnp.asarray(s), first, rng), None, length=max_new_tokens
+    )
+    return jnp.moveaxis(tokens, 0, 1)  # [B, max_new_tokens]
+
+
+def reference_generate(
+    params: Params, prompt: jax.Array, config: LlamaConfig, max_new_tokens: int
+) -> jax.Array:
+    """Cache-free greedy generation (re-forwards the whole sequence every
+    step) — the O(S²·N) oracle the cached path is tested against."""
+    tokens = prompt
+    for _ in range(max_new_tokens):
+        logits = llama_forward(params, tokens, config)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return tokens[:, prompt.shape[1]:]

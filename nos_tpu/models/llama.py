@@ -134,11 +134,17 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x32 * rms).astype(x.dtype) * weight
 
 
-def _rope(seq_len: int, head_dim: int, theta: float, dtype) -> "tuple[jax.Array, jax.Array]":
-    positions = jnp.arange(seq_len, dtype=jnp.float32)
+def _rope_at(positions: jax.Array, head_dim: int, theta: float, dtype):
+    """(cos, sin) tables for arbitrary (possibly traced) positions [P] →
+    each [P, hd/2]. Shared by training/prefill (arange positions) and
+    KV-cache decode (a single traced position)."""
     freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
-    angles = positions[:, None] * freqs[None, :]  # [S, hd/2]
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def _rope(seq_len: int, head_dim: int, theta: float, dtype) -> "tuple[jax.Array, jax.Array]":
+    return _rope_at(jnp.arange(seq_len), head_dim, theta, dtype)
 
 
 def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:

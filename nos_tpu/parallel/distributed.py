@@ -1,0 +1,96 @@
+"""Multi-host distributed runtime bootstrap.
+
+The bridge between the control plane's multi-host slice gangs
+(nos_tpu/controllers/partitioner/multihost.py) and the workload's JAX
+mesh: the expander stamps each gang member with its distributed
+coordinates —
+
+  NOS_TPU_COORDINATOR    host:port of process 0 (the gang leader)
+  NOS_TPU_NUM_PROCESSES  gang size
+  NOS_TPU_PROCESS_ID     this member's rank
+
+— and the training container calls ``initialize()`` before touching any
+device. After that, ``jax.devices()`` spans the whole ICI slice, and
+``global_mesh`` lays the usual dp/sp/tp axes over it; everything in
+nos_tpu/parallel (FSDP, ring attention, pipeline, MoE) works unchanged
+because it is mesh-shape-agnostic.
+
+On GKE multi-host TPU podslices, jax.distributed can also self-discover
+through the TPU metadata server; the env coordinates take precedence when
+present so the same image runs under both discovery modes.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+logger = logging.getLogger("nos_tpu.distributed")
+
+COORDINATOR_ENV = "NOS_TPU_COORDINATOR"
+NUM_PROCESSES_ENV = "NOS_TPU_NUM_PROCESSES"
+PROCESS_ID_ENV = "NOS_TPU_PROCESS_ID"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def gang_member_env(leader: str, namespace: str, rank: int, size: int,
+                    port: int = DEFAULT_COORDINATOR_PORT) -> dict:
+    """The env block the expander stamps on gang member ``rank``.
+
+    The coordinator address uses the leader pod's stable DNS name under a
+    headless service named after the gang (create one per gang, or rely on
+    GKE podslice discovery instead)."""
+    return {
+        COORDINATOR_ENV: f"{leader}.{leader}.{namespace}.svc:{port}",
+        NUM_PROCESSES_ENV: str(size),
+        PROCESS_ID_ENV: str(rank),
+    }
+
+
+def env_coordinates(environ=None) -> Optional[Tuple[str, int, int]]:
+    """(coordinator, num_processes, process_id) from the env, or None when
+    the gang coordinates are absent/incomplete."""
+    environ = environ if environ is not None else os.environ
+    coordinator = environ.get(COORDINATOR_ENV, "")
+    try:
+        num = int(environ.get(NUM_PROCESSES_ENV, ""))
+        pid = int(environ.get(PROCESS_ID_ENV, ""))
+    except ValueError:
+        return None
+    if not coordinator or num < 1 or not (0 <= pid < num):
+        return None
+    return coordinator, num, pid
+
+
+def initialize(environ=None) -> bool:
+    """Call ``jax.distributed.initialize`` from the gang coordinates.
+
+    Returns True when a multi-process runtime was initialized, False for
+    the single-process case (absent/size-1 coordinates) — callers can
+    always invoke this unconditionally first thing in main()."""
+    coords = env_coordinates(environ)
+    if coords is None or coords[1] == 1:
+        logger.info("distributed: single-process (no gang coordinates)")
+        return False
+    coordinator, num, pid = coords
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=pid,
+    )
+    logger.info(
+        "distributed: initialized as process %d/%d (coordinator %s)",
+        pid, num, coordinator,
+    )
+    return True
+
+
+def global_mesh(axis_shape: Sequence[int], axis_names: Sequence[str]):
+    """A Mesh over ALL processes' devices (call after ``initialize``)."""
+    import jax
+
+    from nos_tpu.parallel.mesh import mesh_from_devices
+
+    return mesh_from_devices(tuple(axis_shape), tuple(axis_names), jax.devices())

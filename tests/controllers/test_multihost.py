@@ -146,3 +146,148 @@ class TestMultihostIciFilter:
         pod = build_pod("plain", {slice_res("2x2"): 1})
         f = MultihostIciFilter(store)
         assert f.filter(CycleState(), pod, NodeInfo(node=store.get("Node", "b1"))).success
+
+
+class TestAdmissionMutation:
+    """The mutating-webhook path: JSONPatch expansion at pod admission,
+    preserving every unmodeled field (real clusters reject post-create
+    label/request/env rewrites, so this is the production expansion path)."""
+
+    def _wire_pod(self, chips=32):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "big", "namespace": "ml",
+                         "labels": {"team": "research"}},
+            "spec": {
+                "serviceAccountName": "train-sa",
+                "volumes": [{"name": "data", "emptyDir": {}}],
+                "containers": [{
+                    "name": "main",
+                    "image": "trainer:1",
+                    "volumeMounts": [{"name": "data", "mountPath": "/data"}],
+                    "env": [
+                        {"name": "NODE_NAME",
+                         "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}}},
+                        {"name": "MODE", "value": "train"},
+                    ],
+                    "resources": {"requests": {"google.com/tpu": str(chips)},
+                                  "limits": {"google.com/tpu": str(chips)}},
+                }],
+            },
+            "status": {"phase": "Pending"},
+        }
+
+    def test_jsonpatch_expands_and_preserves_unmodeled_fields(self, store):
+        from nos_tpu.controllers.partitioner.multihost import admission_mutate_pod
+
+        ops = admission_mutate_pod(self._wire_pod(), store)
+        assert ops, "oversized pod must be patched"
+        by_path = {op["path"]: op for op in ops}
+        labels_value = by_path["/metadata/labels"]["value"]
+        assert labels_value["team"] == "research"  # user labels survive
+        assert labels_value[GANG_SIZE_LABEL] == "4"
+        containers = by_path["/spec/containers"]["value"]
+        main = containers[0]
+        assert main["volumeMounts"] == [{"name": "data", "mountPath": "/data"}]
+        env_names = [e["name"] for e in main["env"]]
+        assert "NODE_NAME" in env_names  # valueFrom entry kept
+        assert "MODE" in env_names
+        assert "NOS_TPU_PROCESS_ID" in env_names
+        assert main["resources"]["requests"] == {slice_res("2x4"): "1"}
+        assert main["resources"]["limits"] == {slice_res("2x4"): "1"}
+        assert by_path["/spec/hostname"]["value"] == "big"
+        assert by_path["/spec/subdomain"]["value"] == "big"
+
+    def test_small_pod_gets_no_patch(self, store):
+        from nos_tpu.controllers.partitioner.multihost import admission_mutate_pod
+
+        wire = self._wire_pod(chips=4)
+        assert admission_mutate_pod(wire, store) is None
+
+    def test_mutation_over_tls(self, store):
+        """End to end through the webhook server: AdmissionReview in,
+        base64 JSONPatch out."""
+        import base64
+        import json as _json
+        import ssl
+        import urllib.request
+
+        from nos_tpu.kube.webhook import (
+            PATH_MUTATE_POD,
+            build_elasticquota_webhook_server,
+        )
+
+        server = build_elasticquota_webhook_server(store, port=0, host="127.0.0.1")
+        server.start()
+        try:
+            ctx = ssl.create_default_context(cadata=server.cert_pem.decode())
+            ctx.check_hostname = False
+            body = _json.dumps({
+                "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+                "request": {"uid": "m1", "object": self._wire_pod()},
+            }).encode()
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{server.port}{PATH_MUTATE_POD}",
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, context=ctx, timeout=5) as resp:
+                review = _json.loads(resp.read())
+            response = review["response"]
+            assert response["allowed"] is True
+            assert response["patchType"] == "JSONPatch"
+            ops = _json.loads(base64.b64decode(response["patch"]))
+            assert any(op["path"] == "/spec/containers" for op in ops)
+        finally:
+            server.stop()
+
+
+class TestWorkerWireFidelity:
+    def test_workers_inherit_unmodeled_spec_over_api_store(self):
+        """Against a live apiserver, workers clone the leader's RAW wire:
+        volumes/probes/serviceAccount survive into every gang member."""
+        from nos_tpu.kube.apiclient import ClusterCredentials, KubeApiClient
+        from nos_tpu.kube.apistore import KubeApiStore
+        from nos_tpu.kube.controller import Request
+        from tests.kube.stub_apiserver import StubApiServer
+        from nos_tpu.kube import serde
+
+        with StubApiServer() as api:
+            store = KubeApiStore(
+                KubeApiClient(ClusterCredentials(server=api.url), timeout=5.0),
+                kinds=("Pod", "Node", "Service"),
+            )
+            store.start(sync_timeout_s=10.0)
+            try:
+                store.create(build_tpu_node(name="tpu-0"))
+                # an "already expanded" leader (as the mutating webhook
+                # would admit it) with unmodeled spec fields
+                wire = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "big", "namespace": "ml", "labels": {
+                        GANG_NAME_LABEL: "big", GANG_SIZE_LABEL: "2",
+                        MULTIHOST_ROLE_LABEL: ROLE_LEADER}},
+                    "spec": {
+                        "serviceAccountName": "train-sa",
+                        "volumes": [{"name": "data", "emptyDir": {}}],
+                        "hostname": "big", "subdomain": "big",
+                        "containers": [{
+                            "name": "main",
+                            "resources": {"requests": {slice_res("2x4"): "1"}},
+                        }],
+                    },
+                }
+                api.inject("pods", wire)
+                import time as _t
+                deadline = _t.monotonic() + 5
+                while _t.monotonic() < deadline and not store.try_get("Pod", "big", "ml"):
+                    _t.sleep(0.02)
+                MultihostExpander(store).reconcile(Request(name="big", namespace="ml"))
+                worker_wire = api.read("pods", "ml", "big-w1")
+                assert worker_wire, "worker not created"
+                assert worker_wire["spec"]["serviceAccountName"] == "train-sa"
+                assert worker_wire["spec"]["volumes"] == [{"name": "data", "emptyDir": {}}]
+                assert worker_wire["spec"]["hostname"] == "big-w1"
+                env = {e["name"]: e.get("value") for e in
+                       worker_wire["spec"]["containers"][0].get("env") or []}
+                assert env.get("NOS_TPU_PROCESS_ID") == "1"
+            finally:
+                store.stop()

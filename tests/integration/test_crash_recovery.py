@@ -1,0 +1,132 @@
+"""Control-plane crash/restart recovery (SURVEY §5 failure detection).
+
+The apiserver state and the silicon (native tpuctl slice store on disk)
+both survive a control-plane crash; everything in-memory dies. A restarted
+suite must rebuild its world from those two sources alone: keep running
+workloads booked, finish interrupted handshakes, and serve new pods
+without double-booking chips.
+"""
+import time
+
+import pytest
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import build_pod, build_tpu_node
+
+FAST = dict(
+    partitioner_config=GpuPartitionerConfig(
+        batch_window_timeout_seconds=0.3, batch_window_idle_seconds=0.05
+    ),
+    scheduler_config=SchedulerConfig(retry_seconds=0.1),
+)
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def running(store, name, ns="ml"):
+    pod = store.try_get("Pod", name, ns)
+    return pod is not None and pod.status.phase == PodPhase.RUNNING
+
+
+class TestCrashRecovery:
+    def test_restart_preserves_bookings_and_serves_new_pods(self, tmp_path):
+        store = KubeStore()
+
+        # ---- life before the crash: pod A runs on carved silicon.
+        first = build_cluster(
+            store=store, device_backend="tpuctl", tpuctl_dir=str(tmp_path), **FAST
+        )
+        first.add_tpu_node(
+            build_tpu_node(name="tpu-0"),
+            agent_config=TpuAgentConfig(report_config_interval_seconds=0.1),
+        )
+        first.start()
+        store.create(build_pod("job-a", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        assert wait_for(lambda: running(store, "job-a"))
+        first.stop()  # CRASH — store + tpuctl disk survive, memory dies
+
+        # ---- restart: a brand-new suite over the same store + silicon.
+        second = build_cluster(
+            store=store, device_backend="tpuctl", tpuctl_dir=str(tmp_path), **FAST
+        )
+        second.start_agent(
+            "tpu-0", agent_config=TpuAgentConfig(report_config_interval_seconds=0.1)
+        )
+        second.start()
+        try:
+            # a NEW pod is served from the remaining capacity
+            store.create(build_pod("job-b", {constants.RESOURCE_TPU: 4}, ns="ml"))
+            assert wait_for(lambda: running(store, "job-b")), (
+                store.get("Node", "tpu-0").metadata.annotations
+            )
+            # the pre-crash workload kept its booking (no double-carve)
+            assert running(store, "job-a")
+            a = store.get("Pod", "job-a", "ml")
+            b = store.get("Pod", "job-b", "ml")
+            assert a.spec.node_name == b.spec.node_name == "tpu-0"
+            # handshake converged after restart
+            node = store.get("Node", "tpu-0")
+            assert (
+                node.metadata.annotations[annot.STATUS_PARTITIONING_PLAN]
+                == node.metadata.annotations[annot.SPEC_PARTITIONING_PLAN]
+            )
+        finally:
+            second.stop()
+
+    def test_restart_completes_orphaned_handshake(self, tmp_path):
+        """A crash between writing the spec plan and the agent's
+        confirmation leaves spec != status; the restarted agent must
+        resolve the handshake so planning unblocks."""
+        store = KubeStore()
+        first = build_cluster(
+            store=store, device_backend="tpuctl", tpuctl_dir=str(tmp_path), **FAST
+        )
+        first.add_tpu_node(
+            build_tpu_node(name="tpu-0"),
+            agent_config=TpuAgentConfig(report_config_interval_seconds=0.1),
+        )
+        first.start()
+        store.create(build_pod("job-a", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        assert wait_for(lambda: running(store, "job-a"))
+        first.stop()
+
+        # Orphan the handshake: pretend the partitioner wrote a plan id the
+        # (dead) agent never acknowledged.
+        store.patch_annotations(
+            "Node", "tpu-0", "",
+            {annot.SPEC_PARTITIONING_PLAN: "orphan-99"},
+        )
+
+        second = build_cluster(
+            store=store, device_backend="tpuctl", tpuctl_dir=str(tmp_path), **FAST
+        )
+        second.start_agent(
+            "tpu-0", agent_config=TpuAgentConfig(report_config_interval_seconds=0.1)
+        )
+        second.start()
+        try:
+            # the agent confirms the orphaned plan id...
+            assert wait_for(
+                lambda: store.get("Node", "tpu-0").metadata.annotations.get(
+                    annot.STATUS_PARTITIONING_PLAN
+                )
+                == "orphan-99"
+            ), store.get("Node", "tpu-0").metadata.annotations
+            # ...so planning unblocks and new work still schedules
+            store.create(build_pod("job-b", {constants.RESOURCE_TPU: 4}, ns="ml"))
+            assert wait_for(lambda: running(store, "job-b"))
+        finally:
+            second.stop()

@@ -23,6 +23,7 @@ _PLURAL_TO_KIND = {
     "pods": "Pod",
     "nodes": "Node",
     "configmaps": "ConfigMap",
+    "services": "Service",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "elasticquotas": "ElasticQuota",
     "compositeelasticquotas": "CompositeElasticQuota",

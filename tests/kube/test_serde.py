@@ -74,7 +74,8 @@ class TestRoundTrips:
                 annotations={"x": "y"},
             ),
             spec=PodSpec(
-                containers=[Container(requests={"google.com/tpu": 8, "memory": 2.0})],
+                containers=[Container(requests={"google.com/tpu": 8, "memory": 2.0},
+                                      env={"NOS_TPU_PROCESS_ID": "2"})],
                 node_name="n1",
                 priority=100,
                 tolerations=[Toleration(key="tpu", operator="Exists", effect="NoSchedule")],
@@ -88,6 +89,7 @@ class TestRoundTrips:
         )
         back = serde.from_wire(serde.to_wire(pod))
         assert back.spec.containers[0].requests == {"google.com/tpu": 8, "memory": 2.0}
+        assert back.spec.containers[0].env == {"NOS_TPU_PROCESS_ID": "2"}
         assert back.spec.tolerations[0].operator == "Exists"
         assert back.spec.affinity.required_terms[0].match_expressions[0].values == ["2x4"]
         assert back.spec.node_selector == {"pool": "tpu"}

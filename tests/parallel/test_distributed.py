@@ -1,0 +1,61 @@
+"""Distributed bootstrap: env coordinates, single-process no-op, and the
+expander's stamping of gang members."""
+import pytest
+
+from nos_tpu.parallel.distributed import (
+    COORDINATOR_ENV,
+    NUM_PROCESSES_ENV,
+    PROCESS_ID_ENV,
+    env_coordinates,
+    gang_member_env,
+    initialize,
+)
+
+
+class TestEnvCoordinates:
+    def test_roundtrip(self):
+        env = gang_member_env("big", "ml", rank=2, size=4)
+        assert env_coordinates(env) == ("big.big.ml.svc:8476", 4, 2)
+
+    @pytest.mark.parametrize(
+        "env",
+        [
+            {},
+            {COORDINATOR_ENV: "x:1"},  # missing rank/size
+            {COORDINATOR_ENV: "x:1", NUM_PROCESSES_ENV: "4", PROCESS_ID_ENV: "9"},
+            {COORDINATOR_ENV: "x:1", NUM_PROCESSES_ENV: "bad", PROCESS_ID_ENV: "0"},
+            {COORDINATOR_ENV: "", NUM_PROCESSES_ENV: "4", PROCESS_ID_ENV: "0"},
+        ],
+    )
+    def test_invalid_coordinates(self, env):
+        assert env_coordinates(env) is None
+
+    def test_initialize_is_noop_without_coordinates(self):
+        assert initialize({}) is False
+
+    def test_initialize_is_noop_for_size_one(self):
+        env = gang_member_env("solo", "ml", rank=0, size=1)
+        assert initialize(env) is False
+
+
+class TestExpanderStampsCoordinates:
+    def test_gang_members_carry_ranks(self):
+        from nos_tpu.api.v1alpha1 import constants
+        from nos_tpu.controllers.partitioner.multihost import MultihostExpander
+        from nos_tpu.kube.controller import Request
+        from nos_tpu.kube.store import KubeStore
+        from tests.factory import build_pod, build_tpu_node
+
+        store = KubeStore()
+        store.create(build_tpu_node(name="tpu-0"))
+        store.create(build_pod("big", {constants.RESOURCE_TPU: 32}))
+        MultihostExpander(store).reconcile(Request(name="big", namespace="default"))
+
+        leader = store.get("Pod", "big", "default")
+        assert env_coordinates(leader.spec.containers[0].env) == (
+            "big.big.default.svc:8476", 4, 0,
+        )
+        for i in range(1, 4):
+            worker = store.get("Pod", f"big-w{i}", "default")
+            coords = env_coordinates(worker.spec.containers[0].env)
+            assert coords == ("big.big.default.svc:8476", 4, i)
