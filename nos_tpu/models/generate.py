@@ -53,7 +53,9 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig):
     t = cache_k.shape[1]
     group = c.n_heads // c.n_kv_heads
     qg = q.reshape(b, 1, c.n_kv_heads, group, hd)
-    scores = jnp.einsum("bsKgh,btKh->bKgst", qg, cache_k).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bsKgh,btKh->bKgst", qg, cache_k, preferred_element_type=jnp.float32
+    )
     scores = scores / math.sqrt(hd)
     valid = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4) < n_valid
     scores = jnp.where(valid, scores, -1e30)
@@ -91,7 +93,9 @@ def prefill(
         # causal attention within the prompt (same math as training dense)
         group = c.n_heads // c.n_kv_heads
         qg = q.reshape(b, s, c.n_kv_heads, group, hd)
-        scores = jnp.einsum("bsKgh,btKh->bKgst", qg, k).astype(jnp.float32)
+        scores = jnp.einsum(
+            "bsKgh,btKh->bKgst", qg, k, preferred_element_type=jnp.float32
+        )
         scores = scores / math.sqrt(hd)
         causal = jnp.tril(jnp.ones((s, s), bool))
         scores = jnp.where(causal[None, None, None], scores, -1e30)

@@ -176,8 +176,15 @@ def _attention(
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         # Sequence-parallel path: exact blockwise attention with K/V blocks
         # rotating over the sp ring (nos_tpu/parallel/ring_attention.py).
-        from nos_tpu.parallel.ring_attention import ring_attention
+        # attention="flash" runs the Pallas kernel per ring block with the
+        # hand-written ring backward; "dense" keeps the portable jnp ring.
+        from nos_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_flash_attention,
+        )
 
+        if c.attention == "flash":
+            return ring_flash_attention(q, k, v, mesh, causal=True) @ layer["wo"]
         return ring_attention(q, k, v, mesh, causal=True) @ layer["wo"]
 
     if c.attention == "flash":
@@ -193,7 +200,9 @@ def _attention(
     # GQA: expand kv heads to query heads by grouping queries.
     group = c.n_heads // c.n_kv_heads
     q = q.reshape(b, s, c.n_kv_heads, group, hd)
-    scores = jnp.einsum("bsKgh,btKh->bKgst", q, k).astype(jnp.float32) / math.sqrt(hd)
+    scores = jnp.einsum(
+        "bsKgh,btKh->bKgst", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)
     causal = jnp.tril(jnp.ones((s, s), bool))
     scores = jnp.where(causal[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)

@@ -14,11 +14,20 @@ backward recomputes probabilities blockwise from the saved logsumexp
 (never materializing [S, S]) in two more Pallas kernels — one streaming
 K/V per query block (dq), one streaming Q per key/value block (dk/dv).
 
-Grid: (batch, q_heads, S/blk_q, S/blk_k). GQA is free — the K/V BlockSpec
-index_map sends query head h to kv head h // group, so kv blocks are
-fetched once per group without materializing the expanded heads; the
-backward accumulates dk/dv per query head and group-sums outside the
-kernel. Causal blocks entirely in the future are skipped with ``pl.when``.
+Every kernel takes GLOBAL position offsets for q and kv (SMEM scalars, so
+they may be traced — e.g. ``axis_index`` under shard_map). That is what
+lets ring attention (nos_tpu/parallel/ring_attention.py) run these same
+kernels per rotating K/V block with exact cross-chip causality:
+``flash_attention_block`` returns the (out, logsumexp) partials that
+merge across ring steps, and ``flash_block_grads`` the matching
+per-block gradients.
+
+Grid: (batch, q_heads, Sq/blk_q, Skv/blk_k). GQA is free — the K/V
+BlockSpec index_map sends query head h to kv head h // group, so kv
+blocks are fetched once per group without materializing the expanded
+heads; the backward accumulates dk/dv per query head and group-sums
+outside the kernel. Causal blocks entirely in the future are skipped with
+``pl.when``.
 
 Replaces the reference's dense-attention workloads (nos has no kernels —
 its "workloads" are Pods); this is the TPU build's own perf frontier.
@@ -40,17 +49,27 @@ def _causal_mask(blk_q: int, blk_k: int, q_start, k_start):
     return kv_pos <= q_pos
 
 
+def _smem_scalar_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _dimsem(n: int = 3):
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n + ("arbitrary",),
+    )
+
+
 # ------------------------------------------------------------------ forward
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, blk_q: int, blk_k: int, causal: bool, scale: float,
 ):
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
-    q_start = pl.program_id(2) * blk_q
-    k_start = ki * blk_k
+    q_start = pl.program_id(2) * blk_q + qoff_ref[0]
+    k_start = ki * blk_k + koff_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -92,13 +111,23 @@ def _fwd_kernel(
     @pl.when(ki == n_k - 1)
     def _finish():
         l = l_scr[...]
-        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(l)  # [blk_q, 1]
+        # Rows with no valid key yet (a block entirely in this row's
+        # future) hold l == 0: output 0 with lse = -inf so a later merge
+        # (ring attention) weighs them at exp(-inf) = 0 instead of NaN.
+        has_mass = l > 0.0
+        safe_l = jnp.where(has_mass, l, 1.0)
+        o_ref[0, 0] = jnp.where(
+            has_mass, acc_scr[...] / safe_l, 0.0
+        ).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            has_mass, m_scr[...] + jnp.log(safe_l), -jnp.inf
+        )
 
 
-def _fwd_pallas(qt, kt, vt, *, causal, blk_q, blk_k, group, interpret, scale):
-    b, hq, s, hd = qt.shape
-    grid = (b, hq, s // blk_q, s // blk_k)
+def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale):
+    b, hq, sq, hd = qt.shape
+    skv = kt.shape[2]
+    grid = (b, hq, sq // blk_q, skv // blk_k)
     kernel = functools.partial(
         _fwd_kernel, blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale
     )
@@ -106,6 +135,8 @@ def _fwd_pallas(qt, kt, vt, *, causal, blk_q, blk_k, group, interpret, scale):
         kernel,
         grid=grid,
         in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
             pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec(
                 (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
@@ -121,19 +152,17 @@ def _fwd_pallas(qt, kt, vt, *, causal, blk_q, blk_k, group, interpret, scale):
             pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, s, hd), qt.dtype),
-            jax.ShapeDtypeStruct((b, hq, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq, hd), qt.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, 1), jnp.float32),
             pltpu.VMEM((blk_q, 1), jnp.float32),
             pltpu.VMEM((blk_q, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
+        compiler_params=_dimsem(),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(jnp.asarray([q_off], jnp.int32), jnp.asarray([kv_off], jnp.int32), qt, kt, vt)
 
 
 # ----------------------------------------------------------------- backward
@@ -144,11 +173,14 @@ def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, 
 
     lse/delta arrive as [blk_q, 1] f32 column stats and broadcast. Inputs
     stay bf16 into the MXU (f32 accumulate); p/ds round back to the input
-    dtype for their second matmuls — same rounding as the forward."""
+    dtype for their second matmuls — same rounding as the forward. Rows
+    with lse = -inf (no mass: fully-future rows of a ring block) produce
+    p = exp(-inf - -inf) garbage unless guarded — mask them to zero."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    p = jnp.exp(s - lse)  # exact softmax prob: lse = m + log l
+    finite = jnp.isfinite(lse)
+    p = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse, 0.0)), 0.0)
     if causal:
         p = jnp.where(_causal_mask(blk_q, blk_k, q_start, k_start), p, 0.0)
     dp = jax.lax.dot_general(
@@ -159,13 +191,13 @@ def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, 
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     *, blk_q: int, blk_k: int, causal: bool, scale: float,
 ):
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
-    q_start = pl.program_id(2) * blk_q
-    k_start = ki * blk_k
+    q_start = pl.program_id(2) * blk_q + qoff_ref[0]
+    k_start = ki * blk_k + koff_ref[0]
 
     @pl.when(ki == 0)
     def _init():
@@ -194,13 +226,14 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
     *, blk_q: int, blk_k: int, causal: bool, scale: float,
 ):
     qi = pl.program_id(3)
     n_q = pl.num_programs(3)
-    q_start = qi * blk_q
-    k_start = pl.program_id(2) * blk_k
+    q_start = qi * blk_q + qoff_ref[0]
+    k_start = pl.program_id(2) * blk_k + koff_ref[0]
 
     @pl.when(qi == 0)
     def _init():
@@ -233,9 +266,13 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(qt, kt, vt, dot, lse, delta, *, causal, blk_q, blk_k, group, interpret, scale):
-    b, hq, s, hd = qt.shape
+def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, grad_dtype=None):
+    b, hq, sq, hd = qt.shape
+    skv = kt.shape[2]
+    dq_dtype = grad_dtype or qt.dtype
+    dkv_dtype = grad_dtype or kt.dtype
     kwargs = dict(blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale)
+    offs = (jnp.asarray([q_off], jnp.int32), jnp.asarray([kv_off], jnp.int32))
     q_spec = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
         (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
@@ -244,21 +281,22 @@ def _bwd_pallas(qt, kt, vt, dot, lse, delta, *, causal, blk_q, blk_k, group, int
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **kwargs),
-        grid=(b, hq, s // blk_q, s // blk_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        grid=(b, hq, sq // blk_q, skv // blk_k),
+        in_specs=[
+            _smem_scalar_spec(), _smem_scalar_spec(),
+            q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
+        ],
         out_specs=pl.BlockSpec(
             (1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((b, hq, s, hd), qt.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, hd), dq_dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
+        compiler_params=_dimsem(),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(*offs, qt, kt, vt, dot, lse, delta)
 
     # dk/dv: stream Q blocks (innermost) per K/V block. Accumulated per
-    # QUERY head ([B, Hq, S, hd]); the GQA group-sum happens outside.
+    # QUERY head ([B, Hq, Skv, hd]); the GQA group-sum happens outside.
     q_spec_t = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
     kv_spec_t = pl.BlockSpec(
         (1, 1, blk_k, hd), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)
@@ -267,25 +305,26 @@ def _bwd_pallas(qt, kt, vt, dot, lse, delta, *, causal, blk_q, blk_k, group, int
     dkv_out = pl.BlockSpec((1, 1, blk_k, hd), lambda bi, hi, ki, qi: (bi, hi, ki, 0))
     dkh, dvh = pl.pallas_call(
         functools.partial(_dkv_kernel, **kwargs),
-        grid=(b, hq, s // blk_k, s // blk_q),
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
+        grid=(b, hq, skv // blk_k, sq // blk_q),
+        in_specs=[
+            _smem_scalar_spec(), _smem_scalar_spec(),
+            q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t,
+        ],
         out_specs=[dkv_out, dkv_out],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, s, hd), jnp.float32),
-            jax.ShapeDtypeStruct((b, hq, s, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, skv, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, skv, hd), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, hd), jnp.float32),
             pltpu.VMEM((blk_k, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
+        compiler_params=_dimsem(),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(*offs, qt, kt, vt, dot, lse, delta)
     hkv = hq // group
-    dk = dkh.reshape(b, hkv, group, s, hd).sum(axis=2).astype(kt.dtype)
-    dv = dvh.reshape(b, hkv, group, s, hd).sum(axis=2).astype(vt.dtype)
+    dk = dkh.reshape(b, hkv, group, skv, hd).sum(axis=2).astype(dkv_dtype)
+    dv = dvh.reshape(b, hkv, group, skv, hd).sum(axis=2).astype(dkv_dtype)
     return dq, dk, dv
 
 
@@ -307,7 +346,7 @@ def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     ot, lse = _fwd_pallas(
-        qt, kt, vt, causal=causal, blk_q=blk_q, blk_k=blk_k,
+        qt, kt, vt, 0, 0, causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=group, interpret=interpret, scale=scale,
     )
     out = ot.transpose(0, 2, 1, 3)
@@ -316,13 +355,7 @@ def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
 
 def _flash_bwd(causal, blk_q, blk_k, interpret, res, do):
     q, k, v, out, lse = res
-    b, s, hq, hd = q.shape
-    group = hq // k.shape[2]
-    scale = 1.0 / math.sqrt(hd)
-    # delta_i = rowsum(do_i · o_i): cheap elementwise, XLA fuses it.
-    delta = jnp.sum(
-        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).transpose(0, 2, 1)[..., None]  # [B, Hq, S, 1]
+    delta = _delta(do, out)
     dq, dk, dv = _bwd_pallas(
         q.transpose(0, 2, 1, 3),
         k.transpose(0, 2, 1, 3),
@@ -330,14 +363,24 @@ def _flash_bwd(causal, blk_q, blk_k, interpret, res, do):
         do.transpose(0, 2, 1, 3),
         lse,
         delta,
+        0, 0,
         causal=causal, blk_q=blk_q, blk_k=blk_k,
-        group=group, interpret=interpret, scale=scale,
+        group=q.shape[2] // k.shape[2], interpret=interpret,
+        scale=1.0 / math.sqrt(q.shape[3]),
     )
     return (
         dq.transpose(0, 2, 1, 3),
         dk.transpose(0, 2, 1, 3),
         dv.transpose(0, 2, 1, 3),
     )
+
+
+def _delta(do, out):
+    """delta_i = rowsum(do_i · o_i): cheap elementwise, XLA fuses it.
+    [B, S, H, hd] inputs → [B, H, S, 1]."""
+    return jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1)[..., None]
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -381,3 +424,110 @@ def flash_attention(
     blk_q = _divisor_block(s, blk_q)
     blk_k = _divisor_block(s, blk_k)
     return _flash(q, k, v, causal, blk_q, blk_k, interpret)
+
+
+# ---------------------------------------------------------- block partials
+
+
+def flash_attention_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offset,
+    kv_offset,
+    *,
+    causal: bool = True,
+    blk_q: int = 256,
+    blk_k: int = 512,
+    interpret: bool = False,
+):
+    """Forward PARTIALS of q [B, Sq, Hq, hd] against one K/V block
+    [B, Skv, Hkv, hd] whose global positions start at the (possibly
+    traced) offsets → (out [B, Sq, Hq, hd], lse [B, Hq, Sq, 1]).
+
+    Rows with no causally-visible key in this block return out = 0 with
+    lse = -inf, so partials from different blocks merge exactly with
+    ``merge_flash_partials`` — the kernel-side engine of ring attention.
+    """
+    b, sq, hq, hd = q.shape
+    if hq % k.shape[2]:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {k.shape[2]}")
+    blk_q = _divisor_block(sq, blk_q)
+    blk_k = _divisor_block(k.shape[1], blk_k)
+    group = hq // k.shape[2]
+    ot, lse = _fwd_pallas(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        q_offset, kv_offset,
+        causal=causal, blk_q=blk_q, blk_k=blk_k,
+        group=group, interpret=interpret, scale=1.0 / math.sqrt(hd),
+    )
+    return ot.transpose(0, 2, 1, 3), lse
+
+
+def merge_flash_partials(out_a, lse_a, out_b, lse_b):
+    """Exact online-softmax merge of two block partials (out in
+    [B, S, H, hd], lse in [B, H, S, 1]) → (out, lse) as if both blocks had
+    been attended together."""
+    lse_new = jnp.logaddexp(lse_a, lse_b)  # -inf + -inf handled exactly
+    w_a = jnp.exp(jnp.where(jnp.isfinite(lse_a), lse_a - lse_new, -jnp.inf))
+    w_b = jnp.exp(jnp.where(jnp.isfinite(lse_b), lse_b - lse_new, -jnp.inf))
+    # [B, H, S, 1] weights → [B, S, H, 1] to match the out layout
+    w_a = w_a.transpose(0, 2, 1, 3)
+    w_b = w_b.transpose(0, 2, 1, 3)
+    out = out_a.astype(jnp.float32) * w_a + out_b.astype(jnp.float32) * w_b
+    return out.astype(out_a.dtype), lse_new
+
+
+def flash_block_grads(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    do: jax.Array,
+    q_offset,
+    kv_offset,
+    *,
+    causal: bool = True,
+    blk_q: int = 256,
+    blk_k: int = 512,
+    interpret: bool = False,
+    grad_dtype=None,
+    delta: jax.Array = None,
+):
+    """Per-block gradients matching ``flash_attention_block``: the
+    contribution of THIS K/V block to (dq, dk, dv), given the MERGED
+    (out, lse) of the full attention (the standard flash backward math —
+    each block's dq/dk/dv term only needs the global row stats).
+
+    ``grad_dtype`` (e.g. f32 for the ring path, whose contributions are
+    summed across hops AFTER this call) overrides the input dtypes;
+    ``delta`` lets a caller that invokes this per ring hop precompute the
+    loop-invariant rowsum(do·out) once."""
+    b, sq, hq, hd = q.shape
+    if hq % k.shape[2]:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {k.shape[2]}")
+    blk_q = _divisor_block(sq, blk_q)
+    blk_k = _divisor_block(k.shape[1], blk_k)
+    if delta is None:
+        delta = _delta(do, out)
+    dq, dk, dv = _bwd_pallas(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        do.transpose(0, 2, 1, 3),
+        lse,
+        delta,
+        q_offset, kv_offset,
+        causal=causal, blk_q=blk_q, blk_k=blk_k,
+        group=hq // k.shape[2], interpret=interpret,
+        scale=1.0 / math.sqrt(hd),
+        grad_dtype=grad_dtype,
+    )
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
+    )

@@ -34,8 +34,11 @@ def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal):
     float32: m,l [B,Kv,g,Sq], acc [B,Kv,g,Sq,hd].
     """
     hd = q.shape[-1]
+    # Inputs stay in the model dtype (bf16) with f32 ACCUMULATION — the
+    # MXU's native mode; casting inputs to f32 first would demote the
+    # matmul to the slow f32 path (same rule as ops/flash_attention.py).
     scores = jnp.einsum(
-        "bsKgh,btKh->bKgst", q.astype(jnp.float32), k.astype(jnp.float32)
+        "bsKgh,btKh->bKgst", q, k, preferred_element_type=jnp.float32
     ) / math.sqrt(hd)
     if causal:
         sq, skv = q.shape[1], k.shape[1]
@@ -52,8 +55,11 @@ def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal):
     probs = jnp.exp(scores - safe_m[..., None])  # [B,Kv,g,Sq,Skv]
     correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
     new_l = l * correction + jnp.sum(probs, axis=-1)
+    # Probabilities round to the input dtype for the PV matmul (bf16 MXU,
+    # f32 accumulate) — the same rounding the dense training path applies.
     new_acc = acc * correction[..., None] + jnp.einsum(
-        "bKgst,btKh->bKgsh", probs, v.astype(jnp.float32)
+        "bKgst,btKh->bKgsh", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
     )
     return new_m, new_l, new_acc
 
@@ -112,6 +118,27 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
     return out.astype(q.dtype)
 
 
+def _ring_shard_map(local_fn, mesh, axis_name, batch_axis, head_axis, out_rank4):
+    """Axis resolution + shard_map scaffolding shared by both ring
+    implementations. Returns (wrapped_fn, sequence_axis_name)."""
+    names = mesh.axis_names
+    ba = batch_axis if batch_axis in names else None
+    sa = axis_name if axis_name in names else None
+    ha = head_axis if head_axis in names else None
+    if sa is None:
+        raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
+    qkv_spec = P(ba, sa, ha, None)
+    out_spec = P(ba, sa, ha, None) if out_rank4 else P(ba, sa, ha)
+    wrapped = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    return wrapped, sa
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -128,21 +155,182 @@ def ring_attention(
     are ignored, so the same call works on ('dp','tp'), ('sp',), or
     ('dp','sp','tp') meshes.
     """
+    def build(sa):
+        return partial(
+            _ring_attention_local, axis_name=sa, n_shards=mesh.shape[sa], causal=causal
+        )
+
     names = mesh.axis_names
-    ba = batch_axis if batch_axis in names else None
-    sa = axis_name if axis_name in names else None
-    ha = head_axis if head_axis in names else None
-    if sa is None:
+    sa0 = axis_name if axis_name in names else None
+    if sa0 is None:
         raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
-    qkv_spec = P(ba, sa, ha, None)
-    out_spec = P(ba, sa, ha)
-    fn = partial(
-        _ring_attention_local, axis_name=sa, n_shards=mesh.shape[sa], causal=causal
+    wrapped, _ = _ring_shard_map(
+        build(sa0), mesh, axis_name, batch_axis, head_axis, out_rank4=False
     )
-    return jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=out_spec,
-        check_vma=False,
-    )(q, k, v)
+    return wrapped(q, k, v)
+
+
+# ------------------------------------------------------- kernel-backed ring
+
+
+def _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret):
+    """Forward ring with the Pallas flash kernel per K/V block: local q
+    stays resident, blocks rotate, (out, lse) partials merge exactly
+    (ops/flash_attention.py block APIs)."""
+    from nos_tpu.ops.flash_attention import (
+        flash_attention_block,
+        merge_flash_partials,
+    )
+
+    my_idx = jax.lax.axis_index(axis_name)
+    sq = q.shape[1]
+    q_off = my_idx * sq
+
+    def block(k_blk, v_blk, kv_idx):
+        return flash_attention_block(
+            q, k_blk, v_blk, q_off, kv_idx * sq, causal=causal, interpret=interpret
+        )
+
+    def folded(out, lse, k_blk, v_blk, kv_idx):
+        def run():
+            o2, lse2 = block(k_blk, v_blk, kv_idx)
+            return merge_flash_partials(out, lse, o2, lse2)
+
+        if not causal:
+            return run()
+        # Fully-future blocks contribute nothing: skip their kernels.
+        return jax.lax.cond(kv_idx > my_idx, lambda: (out, lse), run)
+
+    out, lse = block(k, v, my_idx)
+    # Carry the partial in f32 across the ring (one rounding at the END,
+    # matching the jnp ring's f32 accumulator) — per-hop bf16 rounding
+    # would compound with ring size.
+    out = out.astype(jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_blk, v_blk, out, lse = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        out, lse = folded(out, lse, k_blk, v_blk, (my_idx - i) % n)
+        return (k_blk, v_blk, out, lse), None
+
+    (_, _, out, lse), _ = jax.lax.scan(
+        step, (k, v, out, lse), jnp.arange(1, n), length=n - 1
+    )
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_local(q, k, v, out, lse, do, axis_name, n, causal, interpret):
+    """Backward ring: K/V blocks make a FULL revolution carrying their
+    gradient accumulators with them, so after n hops each block's dk/dv
+    arrives back at its owner fully aggregated; dq accumulates locally.
+    The per-block terms need only the local q-row stats (out, lse, do) —
+    the standard flash backward identity."""
+    from nos_tpu.ops.flash_attention import _delta, flash_block_grads
+
+    my_idx = jax.lax.axis_index(axis_name)
+    sq = q.shape[1]
+    q_off = my_idx * sq
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    # Loop-invariant row stats: computed ONCE, not per ring hop.
+    delta = _delta(do, out)
+
+    def contribution(k_blk, v_blk, kv_idx):
+        # f32 block grads: the cross-ring sums below accumulate in f32 and
+        # round once at the end (the single-chip backward's contract).
+        return flash_block_grads(
+            q, k_blk, v_blk, out, lse, do, q_off, kv_idx * sq,
+            causal=causal, interpret=interpret,
+            grad_dtype=jnp.float32, delta=delta,
+        )
+
+    def step(carry, i):
+        k_blk, v_blk, dk_acc, dv_acc, dq = carry
+        kv_idx = (my_idx - i) % n
+
+        def run():
+            dq_c, dk_c, dv_c = contribution(k_blk, v_blk, kv_idx)
+            return (
+                dk_acc + dk_c,
+                dv_acc + dv_c,
+                dq + dq_c,
+            )
+
+        if causal:
+            dk_acc, dv_acc, dq = jax.lax.cond(
+                kv_idx > my_idx, lambda: (dk_acc, dv_acc, dq), run
+            )
+        else:
+            dk_acc, dv_acc, dq = run()
+        # Rotate the block WITH its accumulator: after n hops both are home.
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (k_blk, v_blk, dk_acc, dv_acc, dq), None
+
+    carry = (k, v, jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32), dq0)
+    (k_end, v_end, dk, dv, dq), _ = jax.lax.scan(
+        step, carry, jnp.arange(n), length=n
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def make_ring_flash_local(axis_name: str, n: int, causal: bool, interpret: bool):
+    """The shard_map-body ring-flash attention with a hand-written ring
+    backward (Pallas kernels are forward primitives; autodiff cannot see
+    through them, so the vjp replays the ring explicitly)."""
+
+    @jax.custom_vjp
+    def ring_flash(q, k, v):
+        out, _ = _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return _ring_flash_bwd_local(
+            q, k, v, out, lse, do, axis_name, n, causal, interpret
+        )
+
+    ring_flash.defvjp(fwd, bwd)
+    return ring_flash
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = "tp",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``ring_attention`` with the Pallas flash kernel doing each block's
+    math: same exactness contract, kernel-rate compute, O(blk) VMEM. The
+    jnp path remains as the portable fallback (and the oracle in tests)."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
+        )
+    names = mesh.axis_names
+    sa0 = axis_name if axis_name in names else None
+    if sa0 is None:
+        raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    fn = make_ring_flash_local(sa0, mesh.shape[sa0], causal, interpret)
+    wrapped, _ = _ring_shard_map(
+        fn, mesh, axis_name, batch_axis, head_axis, out_rank4=True
+    )
+    out = wrapped(q, k, v)
+    b, s, hq, hd = q.shape
+    return out.reshape(b, s, hq * hd)
