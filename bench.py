@@ -620,6 +620,33 @@ def run_control_plane_bench() -> dict:
         log(f"phase4 churn: {plans} plans / {reconfigs} board re-carves in "
             f"{churn_s:.1f}s ({reconfig_rate:.2f} reconfigs/sec, "
             f"converged={churn_ok})")
+        delete_all_pods()
+
+        # ---- Phase 5: multi-host slice. ONE pod asks for the whole
+        # cluster (32 chips = a 4x8 ICI slice over all 4 hosts); the
+        # expander builds the gang, the planner carves every host, Permit
+        # binds atomically. Measured: submission -> whole gang Running.
+        from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL
+
+        t_mh = time.monotonic()
+        submit(TOTAL, ns="bench")
+        big_name = f"job-{counter['n']}"
+
+        def gang_running():
+            members = [
+                p
+                for p in cluster.store.list("Pod", namespace="bench")
+                if p.metadata.labels.get(GANG_NAME_LABEL) == big_name
+            ]
+            return len(members) == N_NODES and all(
+                p.status.phase == PodPhase.RUNNING and p.spec.node_name
+                for p in members
+            )
+
+        multihost_ok = wait_until(gang_running, timeout=30.0)
+        multihost_s = time.monotonic() - t_mh
+        log(f"phase5 multihost: {TOTAL}-chip request -> {N_NODES}-host gang "
+            f"{'RUNNING' if multihost_ok else 'TIMED OUT'} in {multihost_s:.1f}s")
 
         out = {
             "utilization_pct": round(util, 2),
@@ -632,6 +659,8 @@ def run_control_plane_bench() -> dict:
             "borrow_converged": bool(borrowed),
             "fair_share_restored": bool(ok and borrowed),
             "admission_rejects": getattr(cluster.kubelet, "admission_rejects", 0),
+            "multihost_gang_formed": bool(multihost_ok),
+            "multihost_time_to_running_s": round(multihost_s, 2),
         }
         return out
     finally:
