@@ -620,7 +620,6 @@ def run_control_plane_bench() -> dict:
         log(f"phase4 churn: {plans} plans / {reconfigs} board re-carves in "
             f"{churn_s:.1f}s ({reconfig_rate:.2f} reconfigs/sec, "
             f"converged={churn_ok})")
-        delete_all_pods()
 
         # ---- Phase 5: multi-host slice. ONE pod asks for the whole
         # cluster (32 chips = a 4x8 ICI slice over all 4 hosts); the
@@ -629,8 +628,7 @@ def run_control_plane_bench() -> dict:
         from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL
 
         t_mh = time.monotonic()
-        submit(TOTAL, ns="bench")
-        big_name = f"job-{counter['n']}"
+        big_name = submit(TOTAL, ns="bench")
 
         def gang_running():
             members = [
