@@ -1,19 +1,24 @@
 """Sharded training step.
 
-One jitted function: loss → grad → SGD-with-momentum update, with
-NamedSharding constraints on inputs/outputs so XLA lays out dp gradient
-all-reduces and tp collectives over the mesh (no hand-written collectives
-— the scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
-the psums).
+One jitted function: loss → grad → optimizer update, with NamedSharding
+constraints on inputs/outputs so XLA lays out dp gradient all-reduces and
+tp collectives over the mesh (no hand-written collectives — the
+scaling-book recipe: pick a mesh, annotate shardings, let XLA insert the
+psums).
+
+The optimizer is any optax GradientTransformation (AdamW and friends);
+its state shards exactly like the parameters — moment subtrees carry the
+FSDP/tp NamedShardings leaf for leaf, scalars (step counts) replicate —
+so a Llama-3-8B AdamW state is as chip-count-fractional as the params.
+The default (no optax passed) remains the momentum-SGD update.
 """
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nos_tpu.models.llama import LlamaConfig, llama_loss
 from nos_tpu.parallel.sharding import (
@@ -22,12 +27,76 @@ from nos_tpu.parallel.sharding import (
 )
 
 
-def make_train_step(mesh: Mesh, config: LlamaConfig, learning_rate: float = 1e-3, momentum: float = 0.9):
+def optimizer_state_sharding(opt_state, param_sharding, mesh: Mesh):
+    """NamedShardings for an optax state: subtrees structured like the
+    params (adam mu/nu, momentum traces, …) get the params' shardings
+    wholesale; everything else (step counts, empty states) replicates."""
+    params_structure = jax.tree.structure(param_sharding)
+    replicated = NamedSharding(mesh, P())
+
+    def is_param_shaped(node) -> bool:
+        try:
+            return jax.tree.structure(node) == params_structure
+        except Exception:  # noqa: BLE001 — non-pytree nodes
+            return False
+
+    found = {"n": 0}
+
+    def assign(node):
+        if is_param_shaped(node):
+            found["n"] += 1
+            return param_sharding
+        return jax.tree.map(lambda _: replicated, node)
+
+    sharded = jax.tree.map(assign, opt_state, is_leaf=is_param_shaped)
+    if found["n"] == 0 and jax.tree.leaves(opt_state):
+        # e.g. optax.masked inserting MaskedNode placeholders: the moment
+        # tree no longer matches the params' structure and would silently
+        # replicate — on a 16 GB chip that is the difference between
+        # fitting and OOM, so fail loudly instead.
+        raise ValueError(
+            "optimizer state contains no params-structured subtree; its "
+            "moments would be fully replicated. Restructure the optimizer "
+            "(plain adamw/sgd/chain work) or shard its state manually."
+        )
+    return sharded
+
+
+def make_train_step(
+    mesh: Mesh,
+    config: LlamaConfig,
+    learning_rate: float = 1e-3,
+    momentum: float = 0.9,
+    optimizer=None,
+):
     """Returns (train_step, shard_state) where
-    train_step(state, tokens) -> (state, loss); state = (params, velocity)."""
+    train_step(state, tokens) -> (state, loss).
+
+    ``optimizer``: any optax GradientTransformation (state = (params,
+    opt_state), sharded via ``optimizer_state_sharding``) — the optimizer
+    then OWNS the hyperparameters, so passing non-default learning_rate /
+    momentum alongside it is rejected rather than silently ignored. None
+    keeps the built-in momentum-SGD update (state = (params, velocity))."""
+    if optimizer is not None and (learning_rate != 1e-3 or momentum != 0.9):
+        raise ValueError(
+            "learning_rate/momentum configure the built-in SGD update; an "
+            "optax optimizer carries its own hyperparameters — set them "
+            "there instead"
+        )
     param_sharding = llama_param_sharding(mesh, config)
     data_sharding = llama_data_sharding(mesh)
-    state_sharding = (param_sharding, param_sharding)
+    if optimizer is not None:
+        from nos_tpu.models.llama import init_llama_params
+
+        abstract_params = jax.eval_shape(
+            lambda: init_llama_params(jax.random.key(0), config)
+        )
+        opt_sharding = optimizer_state_sharding(
+            jax.eval_shape(optimizer.init, abstract_params), param_sharding, mesh
+        )
+        state_sharding = (param_sharding, opt_sharding)
+    else:
+        state_sharding = (param_sharding, param_sharding)
 
     def loss_fn(params, tokens):
         return llama_loss(params, tokens, config, mesh)
@@ -39,10 +108,16 @@ def make_train_step(mesh: Mesh, config: LlamaConfig, learning_rate: float = 1e-3
         donate_argnums=(0,),
     )
     def train_step(state, tokens):
-        params, velocity = state
+        params, opt = state
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if optimizer is not None:
+            import optax
+
+            updates, opt = optimizer.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt), loss
         new_velocity = jax.tree.map(
-            lambda v, g: momentum * v + g.astype(v.dtype), velocity, grads
+            lambda v, g: momentum * v + g.astype(v.dtype), opt, grads
         )
         new_params = jax.tree.map(
             lambda p, v: p - learning_rate * v, params, new_velocity
@@ -50,7 +125,10 @@ def make_train_step(mesh: Mesh, config: LlamaConfig, learning_rate: float = 1e-3
         return (new_params, new_velocity), loss
 
     def shard_state(params, donate: bool = False):
-        """Shard (params, zero-velocity) onto the mesh.
+        """Shard (params, optimizer state) onto the mesh — zero velocity
+        for the built-in SGD, ``optimizer.init`` (run eagerly on the
+        already-sharded params, then placed onto the state shardings) for
+        the optax path.
 
         By default the caller's ``params`` remain valid afterwards: the
         resharding goes through a jitted identity, which always produces
@@ -60,11 +138,18 @@ def make_train_step(mesh: Mesh, config: LlamaConfig, learning_rate: float = 1e-3
         to hand the buffers over instead, halving peak HBM when params were
         freshly initialized and will not be reused.
         """
-        velocity = jax.tree.map(jnp.zeros_like, params)
         if donate:
             params = jax.device_put(params, param_sharding)
         else:
             params = jax.jit(lambda p: p, out_shardings=param_sharding)(params)
-        return (params, jax.device_put(velocity, param_sharding))
+        if optimizer is not None:
+            opt_state = jax.device_put(
+                optimizer.init(params), state_sharding[1]
+            )
+            return (params, opt_state)
+        velocity = jax.device_put(
+            jax.tree.map(jnp.zeros_like, params), param_sharding
+        )
+        return (params, velocity)
 
     return train_step, shard_state
