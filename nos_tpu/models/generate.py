@@ -90,17 +90,28 @@ def prefill(
         cache[i]["v"] = jax.lax.dynamic_update_slice(
             cache[i]["v"], v.astype(c.dtype), (0, 0, 0, 0)
         )
-        # causal attention within the prompt (same math as training dense)
-        group = c.n_heads // c.n_kv_heads
-        qg = q.reshape(b, s, c.n_kv_heads, group, hd)
-        scores = jnp.einsum(
-            "bsKgh,btKh->bKgst", qg, k, preferred_element_type=jnp.float32
-        )
-        scores = scores / math.sqrt(hd)
-        causal = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(causal[None, None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, c.n_heads * hd)
+        # causal attention within the prompt; long prompts ride the flash
+        # kernel (O(blk) VMEM) when the config asks for it, matching the
+        # training path's dispatch
+        if c.attention == "flash":
+            from nos_tpu.ops import flash_attention
+
+            attn = flash_attention(
+                q, k, v, causal=True, interpret=jax.default_backend() == "cpu"
+            ).reshape(b, s, c.n_heads * hd)
+        else:
+            group = c.n_heads // c.n_kv_heads
+            qg = q.reshape(b, s, c.n_kv_heads, group, hd)
+            scores = jnp.einsum(
+                "bsKgh,btKh->bKgst", qg, k, preferred_element_type=jnp.float32
+            )
+            scores = scores / math.sqrt(hd)
+            causal = jnp.tril(jnp.ones((s, s), bool))
+            scores = jnp.where(causal[None, None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(
+                b, s, c.n_heads * hd
+            )
         x = x + attn @ layer["wo"]
         x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
