@@ -125,6 +125,9 @@ class MultihostExpander:
         leader = self.store.get("Pod", pod.metadata.name, pod.metadata.namespace)
         self._ensure_service(leader)
         self._ensure_workers(leader)
+        from nos_tpu.util import metrics
+
+        metrics.MULTIHOST_EXPANSIONS.inc()
         log.info(
             "%s: expanded to %s multi-host slice — gang of %d hosts",
             pod.namespaced_name, shape, n_hosts,

@@ -162,6 +162,9 @@ class LeaderElector:
                     self._last_renew_ok = time.monotonic()
             if got and not self.is_leader:
                 self.is_leader = True
+                from nos_tpu.util import metrics
+
+                metrics.LEADER_TRANSITIONS.inc()
                 logger.info("lease %s: %s became leader", self.name, self.identity)
                 if self.on_started_leading:
                     self.on_started_leading()

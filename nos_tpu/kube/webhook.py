@@ -196,6 +196,9 @@ class WebhookServer:
             validator(obj, self.store)
             response = {"uid": uid, "allowed": True}
         except AdmissionError as e:
+            from nos_tpu.util import metrics
+
+            metrics.WEBHOOK_DENIALS.inc()
             response = {
                 "uid": uid,
                 "allowed": False,

@@ -174,3 +174,15 @@ GANGS_SCHEDULED = REGISTRY.counter(
 SCHEDULE_LATENCY = REGISTRY.histogram(
     "nos_tpu_schedule_latency_seconds", "Per-pod scheduling cycle latency"
 )
+MULTIHOST_EXPANSIONS = REGISTRY.counter(
+    "nos_tpu_multihost_expansions_total",
+    "Oversized chip requests expanded into multi-host slice gangs",
+)
+WEBHOOK_DENIALS = REGISTRY.counter(
+    "nos_tpu_webhook_denials_total",
+    "AdmissionReview requests the validating webhooks denied",
+)
+LEADER_TRANSITIONS = REGISTRY.counter(
+    "nos_tpu_leader_transitions_total",
+    "Leadership acquisitions across all components' leases",
+)

@@ -67,3 +67,60 @@ class TestHealthServer:
             assert get("/nope")[0] == 404
         finally:
             server.stop()
+
+
+class TestSubsystemCounters:
+    """The round-3 subsystems feed the domain registry too."""
+
+    def test_multihost_expansion_counts(self):
+        from nos_tpu.api.v1alpha1 import constants
+        from nos_tpu.controllers.partitioner.multihost import MultihostExpander
+        from nos_tpu.kube.controller import Request
+        from nos_tpu.kube.store import KubeStore
+        from nos_tpu.util import metrics
+        from tests.factory import build_pod, build_tpu_node
+
+        before = metrics.MULTIHOST_EXPANSIONS.value
+        store = KubeStore()
+        store.create(build_tpu_node(name="tpu-0"))
+        store.create(build_pod("big", {constants.RESOURCE_TPU: 16}))
+        MultihostExpander(store).reconcile(Request(name="big", namespace="default"))
+        assert metrics.MULTIHOST_EXPANSIONS.value == before + 1
+
+    def test_webhook_denial_counts(self):
+        from nos_tpu.kube.store import KubeStore
+        from nos_tpu.kube.webhook import WebhookServer
+        from nos_tpu.util import metrics
+
+        before = metrics.WEBHOOK_DENIALS.value
+        server = WebhookServer.__new__(WebhookServer)  # review logic only
+        server.store = KubeStore()
+
+        def deny(obj, store):
+            from nos_tpu.kube.store import AdmissionError
+
+            raise AdmissionError("nope")
+
+        review = {"request": {"uid": "u", "object": {
+            "kind": "ElasticQuota", "metadata": {"name": "x", "namespace": "ns"},
+            "spec": {}}}}
+        out = server._review(review, deny)
+        assert out["response"]["allowed"] is False
+        assert metrics.WEBHOOK_DENIALS.value == before + 1
+
+    def test_leader_transition_counts(self):
+        from nos_tpu.kube.leaderelection import LeaderElector
+        from nos_tpu.kube.store import KubeStore
+        from nos_tpu.util import metrics
+
+        before = metrics.LEADER_TRANSITIONS.value
+        elector = LeaderElector(
+            KubeStore(), name="m", identity="a",
+            lease_duration_s=0.3, renew_period_s=0.05,
+        )
+        elector.start()
+        try:
+            assert elector.wait_for_leadership(5.0)
+            assert metrics.LEADER_TRANSITIONS.value == before + 1
+        finally:
+            elector.stop()
