@@ -45,6 +45,7 @@ from nos_tpu.tpu.known import (
     multihost_profile_for_chips,
     profile_for_chips,
 )
+from nos_tpu.util import metrics
 from nos_tpu.util import resources as res
 
 log = logging.getLogger("nos_tpu.multihost")
@@ -125,8 +126,6 @@ class MultihostExpander:
         leader = self.store.get("Pod", pod.metadata.name, pod.metadata.namespace)
         self._ensure_service(leader)
         self._ensure_workers(leader)
-        from nos_tpu.util import metrics
-
         metrics.MULTIHOST_EXPANSIONS.inc()
         log.info(
             "%s: expanded to %s multi-host slice — gang of %d hosts",

@@ -1,0 +1,3 @@
+from nos_tpu.data.pipeline import BatchLoader, pack_documents, prefetch_to_device
+
+__all__ = ["BatchLoader", "pack_documents", "prefetch_to_device"]

@@ -1,0 +1,176 @@
+"""Input pipeline: host-side batching with device prefetch.
+
+The IO half of the training runtime (the reference has no data loader —
+its workloads are Pods; a training framework needs one). TPU-first
+shape:
+
+- batches are assembled on HOST (numpy) — tokenization/packing never
+  touches the accelerator;
+- ``prefetch_to_device`` keeps ``depth`` batches in flight: the next
+  batch's host→device DMA overlaps the current step's compute, so the
+  MXU never waits on PCIe/DCN feeds;
+- every batch lands ALREADY SHARDED (``jax.device_put`` with the mesh's
+  data NamedSharding) — dp shards get their slice directly, no
+  scatter-from-one-device hop;
+- under multi-host (``jax.process_count() > 1``) each process feeds only
+  its addressable shard of the batch: the loader strides the sample
+  stream by process index, the standard per-host data-parallel feed.
+
+Deterministic: one integer seed fixes the sample order for every epoch
+across restarts — resuming from an orbax checkpoint at step N replays
+the exact stream by fast-forwarding the generator.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+
+def pack_documents(
+    documents: Iterable[np.ndarray],
+    seq_len: int,
+    eos_id: int,
+) -> Iterator[np.ndarray]:
+    """Greedy sequence packing: concatenate token documents separated by
+    ``eos_id`` and emit dense [seq_len] windows — no padding FLOPs, the
+    standard pretraining feed."""
+    buffer: List[int] = []
+    for doc in documents:
+        buffer.extend(int(t) for t in doc)
+        buffer.append(eos_id)
+        while len(buffer) >= seq_len:
+            yield np.asarray(buffer[:seq_len], np.int32)
+            del buffer[:seq_len]
+
+
+class BatchLoader:
+    """Deterministic host-side batch stream over a token corpus.
+
+    ``corpus``: one long int32 token array (memory-mapped files work —
+    anything ndarray-like with __getitem__ slicing). Samples are random
+    seq_len windows drawn by a seeded generator; ``skip(n)`` fast-forwards
+    past n batches for checkpoint-resume replay.
+    """
+
+    def __init__(
+        self,
+        corpus,
+        batch: int,
+        seq_len: int,
+        seed: int = 0,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
+    ) -> None:
+        if len(corpus) < seq_len + 1:
+            raise ValueError(
+                f"corpus of {len(corpus)} tokens is shorter than seq_len {seq_len}"
+            )
+        self.corpus = corpus
+        self.batch = batch
+        self.seq_len = seq_len
+        self.seed = seed
+        if process_index is None or process_count is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+            except Exception:  # noqa: BLE001 — host-only usage
+                process_index, process_count = 0, 1
+        if batch % process_count:
+            raise ValueError(
+                f"global batch {batch} does not divide {process_count} processes"
+            )
+        self.process_index = process_index
+        self.process_count = process_count
+        self.local_batch = batch // process_count
+        self._rng = np.random.default_rng(seed)
+
+    def skip(self, n_batches: int) -> None:
+        """Fast-forward (checkpoint resume): replays the RNG stream — only
+        the start-index draws, never the corpus copies — so batch N after
+        a restart equals batch N of the original run at negligible cost."""
+        for _ in range(n_batches):
+            self._draw_starts()
+
+    def _draw_starts(self) -> np.ndarray:
+        # One GLOBAL draw per batch; every process takes its own stride of
+        # the same sample list, so the union across processes is exactly
+        # the single-process batch (bitwise-stable resharding).
+        return self._rng.integers(0, len(self.corpus) - self.seq_len, size=self.batch)
+
+    def _draw(self) -> np.ndarray:
+        starts = self._draw_starts()
+        mine = starts[self.process_index::self.process_count]
+        return np.stack(
+            [np.asarray(self.corpus[s:s + self.seq_len], np.int32) for s in mine]
+        )
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self._draw()
+
+
+def prefetch_to_device(
+    host_batches: Iterable[np.ndarray],
+    sharding,
+    depth: int = 2,
+) -> Iterator:
+    """Wrap a host batch iterator so device transfer runs ``depth`` batches
+    ahead on a background thread: the jax.device_put (async dispatch +
+    DMA) of batch N+1 overlaps step N's compute. ``sharding`` is the data
+    NamedSharding (nos_tpu.parallel.sharding.llama_data_sharding), so each
+    batch arrives sharded over dp/sp with no further movement."""
+    import jax
+
+    done = object()
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    error: collections.deque = collections.deque(maxlen=1)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        # Bounded, abandonment-aware put: an early-stopping consumer sets
+        # `stop`, and the feeder must exit rather than block forever on a
+        # full queue holding pinned device buffers.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def feeder() -> None:
+        try:
+            for host_batch in host_batches:
+                if not put(jax.device_put(host_batch, sharding)):
+                    return
+        except Exception as e:  # noqa: BLE001 — surfaced on the consumer side
+            error.append(e)
+        finally:
+            put(done)
+
+    thread = threading.Thread(target=feeder, name="data-prefetch", daemon=True)
+    thread.start()
+
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                if error:
+                    raise error.popleft()
+                return
+            yield item
+    finally:
+        # GeneratorExit (consumer stopped early) or normal exhaustion:
+        # release the feeder and drop any buffered batches.
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 
 from nos_tpu.kube.objects import ConfigMap, ObjectMeta
 from nos_tpu.kube.store import AlreadyExistsError, ConflictError, KubeStore, NotFoundError
+from nos_tpu.util import metrics
 
 logger = logging.getLogger("nos_tpu.leaderelection")
 
@@ -161,10 +162,10 @@ class LeaderElector:
                 if got:
                     self._last_renew_ok = time.monotonic()
             if got and not self.is_leader:
-                self.is_leader = True
-                from nos_tpu.util import metrics
-
+                # Counter ticks BEFORE the flag flips: wait_for_leadership
+                # observers must never see is_leader without the count.
                 metrics.LEADER_TRANSITIONS.inc()
+                self.is_leader = True
                 logger.info("lease %s: %s became leader", self.name, self.identity)
                 if self.on_started_leading:
                     self.on_started_leading()

@@ -26,6 +26,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from nos_tpu.kube import serde
 from nos_tpu.kube.store import AdmissionError, KubeStore
+from nos_tpu.util import metrics
 
 logger = logging.getLogger("nos_tpu.webhook")
 
@@ -196,8 +197,6 @@ class WebhookServer:
             validator(obj, self.store)
             response = {"uid": uid, "allowed": True}
         except AdmissionError as e:
-            from nos_tpu.util import metrics
-
             metrics.WEBHOOK_DENIALS.inc()
             response = {
                 "uid": uid,
@@ -205,6 +204,7 @@ class WebhookServer:
                 "status": {"message": str(e), "code": 403},
             }
         except Exception as e:  # noqa: BLE001 — undecodable objects deny
+            metrics.WEBHOOK_DENIALS.inc()
             response = {
                 "uid": uid,
                 "allowed": False,
