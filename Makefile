@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-unit test-integration bench native lint \
+.PHONY: all test test-unit test-integration bench examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -24,6 +24,13 @@ test-integration:
 
 bench:
 	$(PY) bench.py
+
+## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
+## for real chips) -------------------------------------------------------
+
+examples:
+	PYTHONPATH=. $(PY) examples/train_llama.py
+	PYTHONPATH=. $(PY) examples/preempt_resume.py
 
 ## Native ----------------------------------------------------------------
 

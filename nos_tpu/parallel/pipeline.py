@@ -58,11 +58,19 @@ def pipeline_param_sharding(mesh: Mesh, config: LlamaConfig) -> Params:
 
 def _block(carry_x, layer: Params, config: LlamaConfig, cos, sin):
     """One transformer block on one stage (dense attention — sp/flash
-    compose at the outer level, not inside the pipeline body)."""
+    compose at the outer level, not inside the pipeline body). MoE layers
+    run their routed FFN locally per stage (experts are stage-resident
+    alongside the rest of the stacked layer; the balance aux loss is not
+    threaded through the pipeline — add it as a separate regularizer if
+    routing collapse matters for your run)."""
     x = carry_x
     x = x + _attention(_rms_norm(x, layer["attn_norm"], config.norm_eps), layer, config, cos, sin)
-    x = x + _mlp(_rms_norm(x, layer["mlp_norm"], config.norm_eps), layer)
-    return x
+    h = _rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    if "moe" in layer:
+        from nos_tpu.models.moe import moe_mlp
+
+        return x + moe_mlp(layer["moe"], h, config.moe_config(), None)
+    return x + _mlp(h, layer)
 
 
 def _stage_apply(local_layers: Params, x, config: LlamaConfig, cos, sin):

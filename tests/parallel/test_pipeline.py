@@ -139,3 +139,17 @@ class TestPipelineTraining:
         got = pipeline_llama_loss(stacked, tokens, config, mesh)
         want = llama_loss(params, tokens, config)
         assert abs(float(got) - float(want)) < 2e-2
+
+    def test_moe_layers_pipeline(self):
+        """MoE blocks ride the pipeline: routed FFN per stage, loss matches
+        the sequential forward (sans the balance aux term, which the
+        pipeline loss does not thread)."""
+        from nos_tpu.models.llama import llama_forward, next_token_nll
+
+        config = tiny_config(n_layers=2, n_experts=4)
+        params = init_llama_params(jax.random.key(0), config)
+        tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, config.vocab_size)
+        mesh = mesh_from_devices((2,), ("pp",), jax.devices()[:2])
+        got = pipeline_llama_loss(stack_layer_params(params), tokens, config, mesh)
+        want = next_token_nll(llama_forward(params, tokens, config), tokens)
+        assert abs(float(got) - float(want)) < 2e-2, (float(got), float(want))
