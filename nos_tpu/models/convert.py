@@ -1,0 +1,136 @@
+"""HuggingFace Llama checkpoints → nos-tpu parameter trees.
+
+Real weights for the workload stack: plain-RoPE `transformers`
+Llama-family checkpoints (Llama 2, Llama 3.0, TinyLlama, …) convert into
+the pytree `nos_tpu.models.llama` trains and serves, so a slice tenant
+can fine-tune or deploy a published model rather than random init.
+Checkpoints needing features the forward does not implement
+(rope_scaling of 3.1+, attention biases, adapters) are REJECTED at
+conversion rather than converted into silently different models.
+
+Layout notes (verified by the torch-vs-JAX logits parity test):
+
+- HF Linear stores [out, in]; this tree stores [in, out] → transpose.
+- Rotary embedding conventions match (the half-split "neox" rotation with
+  per-half frequency tables), so Q/K convert untouched.
+- GQA head ordering matches (kv-head-major query heads).
+- ``lm_head`` may be tied to the embedding (``tie_word_embeddings``); the
+  converter materializes it either way.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+
+def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
+    # Silent-corruption guards: features this forward does not implement
+    # must fail at conversion, not at serving time with wrong logits.
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not implemented by "
+            "nos_tpu.models.llama (plain RoPE only); refusing to convert "
+            "a model whose positions would silently differ"
+        )
+    head_dim = getattr(hf_config, "head_dim", None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if head_dim not in (None, derived):
+        raise ValueError(
+            f"head_dim={head_dim} != hidden_size/num_heads={derived}: "
+            "unsupported layout"
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        d_ff=hf_config.intermediate_size,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+        dtype=dtype,
+    )
+
+
+def _t(tensor, dtype) -> jnp.ndarray:
+    """torch [out, in] weight → jnp [in, out]."""
+    return jnp.asarray(np.asarray(tensor.detach().cpu().float().numpy().T), dtype)
+
+
+def _v(tensor, dtype) -> jnp.ndarray:
+    return jnp.asarray(np.asarray(tensor.detach().cpu().float().numpy()), dtype)
+
+
+def params_from_hf_state_dict(state_dict, config: LlamaConfig) -> Params:
+    """``model.state_dict()`` of a transformers LlamaForCausalLM → the
+    nos-tpu parameter tree (in ``config.dtype``)."""
+    c = config
+    dt = c.dtype
+    sd = dict(state_dict)
+    consumed = set()
+
+    def take(key, fn):
+        consumed.add(key)
+        return fn(sd[key], dt)
+
+    embed = take("model.embed_tokens.weight", _v)
+    params: Params = {
+        "embed": embed,
+        "final_norm": take("model.norm.weight", _v),
+        "lm_head": (
+            take("lm_head.weight", _t)
+            if "lm_head.weight" in sd
+            else embed.T  # tied embeddings: one conversion, transposed view
+        ),
+        "layers": [],
+    }
+    for i in range(c.n_layers):
+        prefix = f"model.layers.{i}."
+        params["layers"].append(
+            {
+                "attn_norm": take(prefix + "input_layernorm.weight", _v),
+                "wq": take(prefix + "self_attn.q_proj.weight", _t),
+                "wk": take(prefix + "self_attn.k_proj.weight", _t),
+                "wv": take(prefix + "self_attn.v_proj.weight", _t),
+                "wo": take(prefix + "self_attn.o_proj.weight", _t),
+                "mlp_norm": take(prefix + "post_attention_layernorm.weight", _v),
+                "w_gate": take(prefix + "mlp.gate_proj.weight", _t),
+                "w_up": take(prefix + "mlp.up_proj.weight", _t),
+                "w_down": take(prefix + "mlp.down_proj.weight", _t),
+            }
+        )
+    # Anything left over (attention/MLP biases, adapters, …) is a weight
+    # this forward would NOT apply — dropping it silently would serve a
+    # different model. Rotary frequency buffers are derived state, not
+    # weights.
+    leftover = [
+        k for k in sd
+        if k not in consumed and not k.endswith("rotary_emb.inv_freq")
+    ]
+    if leftover:
+        raise ValueError(
+            f"unconverted weights {leftover[:4]}{'...' if len(leftover) > 4 else ''}: "
+            "this checkpoint uses features nos_tpu.models.llama does not "
+            "implement (biases/adapters?)"
+        )
+    return params
+
+
+def load_hf_llama(model_or_path, dtype=jnp.bfloat16) -> Tuple[Params, LlamaConfig]:
+    """(params, config) from a transformers model instance or a local /
+    hub checkpoint path."""
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+
+        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+    config = config_from_hf(model_or_path.config, dtype)
+    return params_from_hf_state_dict(model_or_path.state_dict(), config), config
