@@ -1,12 +1,14 @@
 """HuggingFace Llama checkpoints → nos-tpu parameter trees.
 
-Real weights for the workload stack: plain-RoPE `transformers`
-Llama-family checkpoints (Llama 2, Llama 3.0, TinyLlama, …) convert into
-the pytree `nos_tpu.models.llama` trains and serves, so a slice tenant
-can fine-tune or deploy a published model rather than random init.
-Checkpoints needing features the forward does not implement
-(rope_scaling of 3.1+, attention biases, adapters) are REJECTED at
-conversion rather than converted into silently different models.
+Real weights for the workload stack: `transformers` Llama-family
+checkpoints — plain RoPE (Llama 2/3.0, TinyLlama, …) and the llama3
+scaled RoPE of Llama 3.1+ — convert into the pytree
+`nos_tpu.models.llama` trains and serves, so a slice tenant can
+fine-tune or deploy a published model rather than random init.
+Checkpoints needing features the forward does not implement (other
+rope_scaling types, attention biases, leftover adapter weights) are
+REJECTED at conversion rather than converted into silently different
+models.
 
 Layout notes (verified by the torch-vs-JAX logits parity test):
 
@@ -33,11 +35,21 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
     # Silent-corruption guards: features this forward does not implement
     # must fail at conversion, not at serving time with wrong logits.
     scaling = getattr(hf_config, "rope_scaling", None)
+    rope_scaling = None
     if scaling:
-        raise ValueError(
-            f"rope_scaling={scaling!r} is not implemented by "
-            "nos_tpu.models.llama (plain RoPE only); refusing to convert "
-            "a model whose positions would silently differ"
+        rope_type = scaling.get("rope_type", scaling.get("type", ""))
+        if rope_type != "llama3":
+            raise ValueError(
+                f"rope_scaling={scaling!r} is not implemented by "
+                "nos_tpu.models.llama (plain or llama3 RoPE only); refusing "
+                "to convert a model whose positions would silently differ"
+            )
+        rope_scaling = (
+            "llama3",
+            float(scaling["factor"]),
+            float(scaling["low_freq_factor"]),
+            float(scaling["high_freq_factor"]),
+            float(scaling["original_max_position_embeddings"]),
         )
     head_dim = getattr(hf_config, "head_dim", None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
@@ -56,6 +68,7 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         ),
         d_ff=hf_config.intermediate_size,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
         norm_eps=float(hf_config.rms_norm_eps),
         dtype=dtype,
     )

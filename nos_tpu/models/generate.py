@@ -74,7 +74,7 @@ def prefill(
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache capacity {max_len}")
     x = params["embed"][tokens]
-    cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype)
+    cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     cache = init_kv_cache(c, b, max_len)
     for i, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
@@ -131,7 +131,7 @@ def decode_step(
     b = token.shape[0]
     hd = c.head_dim
     x = params["embed"][token][:, None, :]  # [B, 1, D]
-    cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype)  # [1, hd/2]
+    cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype, c.rope_scaling)  # [1, hd/2]
 
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):

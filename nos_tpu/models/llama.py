@@ -28,6 +28,10 @@ class LlamaConfig:
     n_kv_heads: int = 8
     d_ff: int = 14336
     rope_theta: float = 500000.0
+    # Llama-3.1-style frequency scaling, hashable form:
+    # ("llama3", factor, low_freq_factor, high_freq_factor,
+    #  original_max_position_embeddings); None = plain RoPE.
+    rope_scaling: Any = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     # "dense" (XLA einsum) or "flash" (Pallas kernel, nos_tpu/ops/ —
@@ -134,17 +138,34 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x32 * rms).astype(x.dtype) * weight
 
 
-def _rope_at(positions: jax.Array, head_dim: int, theta: float, dtype):
+def _llama3_scaled_freqs(freqs: jax.Array, scaling) -> jax.Array:
+    """The Llama-3.1 frequency transform: long wavelengths divide by
+    ``factor``, short ones stay, the middle band interpolates smoothly
+    (the public rope_type="llama3" recipe; parity-tested against the
+    transformers implementation in tests/models/test_convert.py)."""
+    _, factor, low_ff, high_ff, orig_max = scaling
+    wavelen = 2.0 * math.pi / freqs
+    low_wavelen = orig_max / low_ff
+    high_wavelen = orig_max / high_ff
+    smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+    mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+    out = jnp.where(wavelen > low_wavelen, freqs / factor, mid)
+    return jnp.where(wavelen < high_wavelen, freqs, out)
+
+
+def _rope_at(positions: jax.Array, head_dim: int, theta: float, dtype, scaling=None):
     """(cos, sin) tables for arbitrary (possibly traced) positions [P] →
     each [P, hd/2]. Shared by training/prefill (arange positions) and
     KV-cache decode (a single traced position)."""
     freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    if scaling is not None:
+        freqs = _llama3_scaled_freqs(freqs, scaling)
     angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
-def _rope(seq_len: int, head_dim: int, theta: float, dtype) -> "tuple[jax.Array, jax.Array]":
-    return _rope_at(jnp.arange(seq_len), head_dim, theta, dtype)
+def _rope(seq_len: int, head_dim: int, theta: float, dtype, scaling=None):
+    return _rope_at(jnp.arange(seq_len), head_dim, theta, dtype, scaling)
 
 
 def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -231,7 +252,7 @@ def llama_forward(
     c = config
     x = params["embed"][tokens]
     # Position tables depend only on (seq_len, head_dim): one per forward.
-    cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype)
+    cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     def block(x, layer):
         x = x + _attention(
             _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin, mesh

@@ -153,7 +153,7 @@ def _prepare_pipeline_inputs(params: Params, tokens: jax.Array, config: LlamaCon
         raise ValueError(f"batch {b} does not divide {m} microbatches")
 
     x = params["embed"][tokens]
-    cos, sin = _rope(s_len, c.head_dim, c.rope_theta, c.dtype)
+    cos, sin = _rope(s_len, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     x_mb = x.reshape(m, b // m, s_len, c.d_model)
 
     layer_specs = jax.tree.map(lambda _: P("pp"), params["layers"])

@@ -82,16 +82,44 @@ class TestConversion:
         with pytest.raises(ValueError, match="unconverted weights"):
             params_from_hf_state_dict(sd, config)
 
-    def test_rope_scaling_rejected(self, hf_model):
+    def test_unsupported_rope_scaling_rejected(self, hf_model):
         from nos_tpu.models.convert import config_from_hf
 
         hf_cfg = hf_model.config
-        hf_cfg.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+        hf_cfg.rope_scaling = {"rope_type": "yarn", "factor": 8.0}
         try:
             with pytest.raises(ValueError, match="rope_scaling"):
                 config_from_hf(hf_cfg)
         finally:
             hf_cfg.rope_scaling = None
+
+    def test_llama3_rope_scaling_logits_match_torch(self):
+        """Llama-3.1-style scaled RoPE: transformers applies its own
+        implementation; ours must produce the same logits."""
+        from transformers import LlamaConfig as HFConfig
+        from transformers import LlamaForCausalLM
+
+        torch.manual_seed(1)
+        hf_cfg = HFConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+            max_position_embeddings=256, rope_theta=10000.0,
+            attention_dropout=0.0,
+            rope_scaling={
+                "rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 32,
+            },
+        )
+        model = LlamaForCausalLM(hf_cfg)
+        model.eval()
+        params, config = load_hf_llama(model, dtype=jnp.float32)
+        assert config.rope_scaling is not None
+        tokens_np = np.arange(48, dtype=np.int64)[None, :] % 128  # spans bands
+        with torch.no_grad():
+            want = model(torch.from_numpy(tokens_np)).logits.numpy()
+        got = np.asarray(llama_forward(params, jnp.asarray(tokens_np), config))
+        np.testing.assert_allclose(got, want, atol=3e-4)
 
     def test_dtype_conversion(self, hf_model):
         params, config = load_hf_llama(hf_model)  # default bf16
