@@ -44,13 +44,17 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
                 "nos_tpu.models.llama (plain or llama3 RoPE only); refusing "
                 "to convert a model whose positions would silently differ"
             )
-        rope_scaling = (
-            "llama3",
-            float(scaling["factor"]),
-            float(scaling["low_freq_factor"]),
-            float(scaling["high_freq_factor"]),
-            float(scaling["original_max_position_embeddings"]),
+        required = (
+            "factor", "low_freq_factor", "high_freq_factor",
+            "original_max_position_embeddings",
         )
+        missing = [k for k in required if k not in scaling]
+        if missing:
+            raise ValueError(
+                f"rope_scaling={scaling!r} lacks {missing}; refusing to "
+                "guess scaled-RoPE parameters"
+            )
+        rope_scaling = ("llama3",) + tuple(float(scaling[k]) for k in required)
     head_dim = getattr(hf_config, "head_dim", None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
     if head_dim not in (None, derived):

@@ -45,9 +45,10 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Cache:
     ]
 
 
-def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig):
+def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None):
     """q [B, 1, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
-    ``n_valid`` positions (a traced scalar)."""
+    ``n_valid`` positions (a traced scalar). ``key_valid`` [B, T]
+    additionally masks slots that hold padding (left-padded batches)."""
     c = config
     b, _, hq, hd = q.shape
     t = cache_k.shape[1]
@@ -58,6 +59,8 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig):
     )
     scores = scores / math.sqrt(hd)
     valid = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4) < n_valid
+    if key_valid is not None:
+        valid = valid & key_valid[:, None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bKgst,btKh->bsKgh", probs, cache_v)
@@ -65,25 +68,49 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig):
 
 
 def prefill(
-    params: Params, tokens: jax.Array, config: LlamaConfig, max_len: int
+    params: Params, tokens: jax.Array, config: LlamaConfig, max_len: int,
+    pad_id: int = None,
 ) -> Tuple[jax.Array, Cache]:
     """Full forward over the prompt; returns (logits [B, S, vocab], cache
-    holding the prompt's K/V in positions [0, S))."""
+    holding the prompt's K/V in positions [0, S)).
+
+    ``pad_id`` enables LEFT-padded variable-length batches: pad tokens are
+    excluded from attention, and RoPE positions count only real tokens so
+    every row's first real token sits at position 0. The last column is
+    always a real token under left padding, so ``logits[:, -1]`` is the
+    next-token distribution for every row."""
     c = config
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache capacity {max_len}")
     x = params["embed"][tokens]
-    cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
+    if pad_id is None:
+        cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
+        cos_b = sin_b = None
+        token_valid = None
+    else:
+        token_valid = tokens != pad_id  # [B, S]
+        positions = jnp.clip(jnp.cumsum(token_valid, axis=1) - 1, 0)  # [B, S]
+        cos_b, sin_b = _rope_at(
+            positions.reshape(-1), c.head_dim, c.rope_theta, c.dtype, c.rope_scaling
+        )
+        cos_b = cos_b.reshape(b, s, -1)[:, :, None, :]  # [B, S, 1, hd/2]
+        sin_b = sin_b.reshape(b, s, -1)[:, :, None, :]
+        cos = sin = None
     cache = init_kv_cache(c, b, max_len)
+    def rope(arr):
+        if pad_id is None:
+            return _apply_rope(arr, cos, sin)
+        return _apply_rope(arr, cos_b, sin_b)  # rank-4: per-row tables
+
     for i, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
         hd = c.head_dim
         q = (h @ layer["wq"]).reshape(b, s, c.n_heads, hd)
         k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
         v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
+        q = rope(q)
+        k = rope(k)
         cache[i]["k"] = jax.lax.dynamic_update_slice(
             cache[i]["k"], k.astype(c.dtype), (0, 0, 0, 0)
         )
@@ -92,8 +119,9 @@ def prefill(
         )
         # causal attention within the prompt; long prompts ride the flash
         # kernel (O(blk) VMEM) when the config asks for it, matching the
-        # training path's dispatch
-        if c.attention == "flash":
+        # training path's dispatch. Padded batches need per-key masks the
+        # kernel does not take, so they use the dense path.
+        if c.attention == "flash" and pad_id is None:
             from nos_tpu.ops import flash_attention
 
             attn = flash_attention(
@@ -106,8 +134,10 @@ def prefill(
                 "bsKgh,btKh->bKgst", qg, k, preferred_element_type=jnp.float32
             )
             scores = scores / math.sqrt(hd)
-            causal = jnp.tril(jnp.ones((s, s), bool))
-            scores = jnp.where(causal[None, None, None], scores, -1e30)
+            mask = jnp.tril(jnp.ones((s, s), bool))[None, None, None]
+            if token_valid is not None:
+                mask = mask & token_valid[:, None, None, None, :]
+            scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             attn = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(
                 b, s, c.n_heads * hd
@@ -124,14 +154,31 @@ def decode_step(
     pos: jax.Array,
     token: jax.Array,
     config: LlamaConfig,
+    rope_pos: jax.Array = None,
+    key_valid: jax.Array = None,
 ) -> Tuple[jax.Array, Cache]:
-    """One token at (traced) position ``pos`` → (logits [B, vocab], cache
-    with K/V written at pos)."""
+    """One token at (traced) physical cache slot ``pos`` → (logits
+    [B, vocab], cache with K/V written at pos).
+
+    Left-padded batches decouple the two position notions: ``pos`` is the
+    uniform physical slot (prompt length + step), while ``rope_pos`` [B]
+    carries each row's LOGICAL position (real tokens seen so far);
+    ``key_valid`` [B, T] masks the pad slots out of attention."""
     c = config
     b = token.shape[0]
     hd = c.head_dim
     x = params["embed"][token][:, None, :]  # [B, 1, D]
-    cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype, c.rope_scaling)  # [1, hd/2]
+    if rope_pos is None:
+        cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype, c.rope_scaling)
+        cos = cos[None, :, None, :]  # [1, 1, 1, hd/2]: broadcast over rows
+        sin = sin[None, :, None, :]
+    else:
+        cos, sin = _rope_at(rope_pos, hd, c.rope_theta, c.dtype, c.rope_scaling)
+        cos = cos[:, None, None, :]  # [B, 1, 1, hd/2]: per-row tables
+        sin = sin[:, None, None, :]
+
+    def rope1(arr):  # arr [B, 1, H, hd]
+        return _apply_rope(arr, cos, sin)
 
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):
@@ -139,12 +186,12 @@ def decode_step(
         q = (h @ layer["wq"]).reshape(b, 1, c.n_heads, hd)
         k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, hd)
         v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
-        q = _apply_rope(q, cos, sin)
-        k = _apply_rope(k, cos, sin)
+        q = rope1(q)
+        k = rope1(k)
         ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
-        attn = _cache_attention(q, ck, cv, pos + 1, c)
+        attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
         x = x + attn @ layer["wo"]
         x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
@@ -158,18 +205,34 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    pad_id: Optional[int] = None,
 ) -> jax.Array:
     """prompt [B, S] → generated tokens [B, max_new_tokens].
 
     Greedy when temperature == 0, otherwise temperature sampling. The
     decode loop is one ``lax.scan`` — compile once, reuse for any prompt
-    of the same shape."""
+    of the same shape. Variable-length prompts batch via LEFT padding:
+    pass ``pad_id`` and pad each row on the left; pads never attend and
+    each row's RoPE counts only its real tokens, so the batched output
+    equals row-by-row unpadded generation."""
     c = config
     b, s = prompt.shape
     max_len = s + max_new_tokens
-    logits, cache = prefill(params, prompt, c, max_len)
+    logits, cache = prefill(params, prompt, c, max_len, pad_id=pad_id)
     if rng is None:
         rng = jax.random.key(0)
+
+    if pad_id is not None:
+        token_valid = prompt != pad_id
+        rope_pos0 = jnp.sum(token_valid, axis=1)  # next logical position per row
+        # Appended slots are physically bounded by pos+1 in decode, so
+        # pre-marking them valid is safe; only prompt pads stay masked.
+        key_valid = jnp.pad(
+            token_valid, ((0, 0), (0, max_new_tokens)), constant_values=True
+        )
+    else:
+        rope_pos0 = None
+        key_valid = None
 
     def pick(logits, key):
         if temperature <= 0.0:
@@ -180,18 +243,24 @@ def generate(
 
     # Single-use keys: every sample consumes a fresh split — the carried
     # key is only ever a split parent, never passed to categorical itself.
+    # Left padding keeps the LAST column real, so logits[:, -1] is the
+    # next-token distribution for every row either way.
     rng, first_key = jax.random.split(rng)
     first = pick(logits[:, -1], first_key)
 
     def body(carry, _):
-        cache, pos, token, rng = carry
+        cache, pos, rope_pos, token, rng = carry
         rng, sub = jax.random.split(rng)
-        logits, cache = decode_step(params, cache, pos, token, c)
+        logits, cache = decode_step(
+            params, cache, pos, token, c, rope_pos=rope_pos, key_valid=key_valid
+        )
         nxt = pick(logits, sub)
-        return (cache, pos + 1, nxt, rng), token
+        next_rope = None if rope_pos is None else rope_pos + 1
+        return (cache, pos + 1, next_rope, nxt, rng), token
 
-    (_, _, _, _), tokens = jax.lax.scan(
-        body, (cache, jnp.asarray(s), first, rng), None, length=max_new_tokens
+    (_, _, _, _, _), tokens = jax.lax.scan(
+        body, (cache, jnp.asarray(s), rope_pos0, first, rng), None,
+        length=max_new_tokens,
     )
     return jnp.moveaxis(tokens, 0, 1)  # [B, max_new_tokens]
 

@@ -169,10 +169,14 @@ def _rope(seq_len: int, head_dim: int, theta: float, dtype, scaling=None):
 
 
 def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    # x: [B, S, H, hd]
+    """x [B, S, H, hd] rotated by tables of rank 2 ([S, hd/2], shared
+    across the batch) or rank 4 (already broadcast — per-row tables for
+    left-padded serving). THE rotation formula: every path (training,
+    prefill, decode) calls this one implementation."""
     x1, x2 = jnp.split(x, 2, axis=-1)
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 

@@ -103,12 +103,15 @@ class TestConversion:
         hf_cfg = HFConfig(
             vocab_size=128, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
-            max_position_embeddings=256, rope_theta=10000.0,
+            max_position_embeddings=512, rope_theta=10000.0,
             attention_dropout=0.0,
+            # original_max=128 puts wavelength 62.8 inside the [32, 128]
+            # medium band, so the smooth-interpolation branch is exercised
+            # (not just keep / divide-by-factor).
             rope_scaling={
                 "rope_type": "llama3", "factor": 8.0,
                 "low_freq_factor": 1.0, "high_freq_factor": 4.0,
-                "original_max_position_embeddings": 32,
+                "original_max_position_embeddings": 128,
             },
         )
         model = LlamaForCausalLM(hf_cfg)

@@ -118,3 +118,36 @@ class TestFlashPrefill:
         got = generate(params, prompt, flash_cfg, max_new_tokens=6)
         want = reference_generate(params, prompt, config, max_new_tokens=6)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestLeftPaddedBatching:
+    def test_padded_batch_matches_per_row_generation(self, setup):
+        """The serving contract: batching variable-length prompts with
+        left padding produces exactly what each row would generate alone."""
+        config, params, _ = setup
+        PAD = 0
+        rows = [
+            jax.random.randint(jax.random.key(3), (5,), 1, config.vocab_size),
+            jax.random.randint(jax.random.key(4), (8,), 1, config.vocab_size),
+            jax.random.randint(jax.random.key(5), (3,), 1, config.vocab_size),
+        ]
+        width = max(r.shape[0] for r in rows)
+        padded = jnp.stack([
+            jnp.concatenate([jnp.full((width - r.shape[0],), PAD, r.dtype), r])
+            for r in rows
+        ])
+        batched = generate(params, padded, config, max_new_tokens=6, pad_id=PAD)
+        for i, row in enumerate(rows):
+            solo = generate(params, row[None], config, max_new_tokens=6)
+            np.testing.assert_array_equal(
+                np.asarray(batched[i]), np.asarray(solo[0]),
+                err_msg=f"row {i} (len {row.shape[0]})",
+            )
+
+    def test_unpadded_rows_unaffected_by_pad_id(self, setup):
+        config, params, prompt = setup
+        plain = generate(params, prompt, config, max_new_tokens=5)
+        with_pad = generate(
+            params, prompt, config, max_new_tokens=5, pad_id=255
+        )  # 255 absent from the prompt
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(with_pad))
