@@ -206,6 +206,7 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     pad_id: Optional[int] = None,
+    eos_id: Optional[int] = None,
 ) -> jax.Array:
     """prompt [B, S] → generated tokens [B, max_new_tokens].
 
@@ -214,7 +215,9 @@ def generate(
     of the same shape. Variable-length prompts batch via LEFT padding:
     pass ``pad_id`` and pad each row on the left; pads never attend and
     each row's RoPE counts only its real tokens, so the batched output
-    equals row-by-row unpadded generation."""
+    equals row-by-row unpadded generation. With ``eos_id``, a row that
+    emits it keeps emitting ``eos_id`` for the rest of the (static-length)
+    scan — trim on the first occurrence."""
     c = config
     b, s = prompt.shape
     max_len = s + max_new_tokens
@@ -247,19 +250,25 @@ def generate(
     # next-token distribution for every row either way.
     rng, first_key = jax.random.split(rng)
     first = pick(logits[:, -1], first_key)
+    done0 = (
+        jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+    )
 
     def body(carry, _):
-        cache, pos, rope_pos, token, rng = carry
+        cache, pos, rope_pos, token, done, rng = carry
         rng, sub = jax.random.split(rng)
         logits, cache = decode_step(
             params, cache, pos, token, c, rope_pos=rope_pos, key_valid=key_valid
         )
         nxt = pick(logits, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
         next_rope = None if rope_pos is None else rope_pos + 1
-        return (cache, pos + 1, next_rope, nxt, rng), token
+        return (cache, pos + 1, next_rope, nxt, done, rng), token
 
-    (_, _, _, _, _), tokens = jax.lax.scan(
-        body, (cache, jnp.asarray(s), rope_pos0, first, rng), None,
+    (_, _, _, _, _, _), tokens = jax.lax.scan(
+        body, (cache, jnp.asarray(s), rope_pos0, first, done0, rng), None,
         length=max_new_tokens,
     )
     return jnp.moveaxis(tokens, 0, 1)  # [B, max_new_tokens]

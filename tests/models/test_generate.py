@@ -151,3 +151,25 @@ class TestLeftPaddedBatching:
             params, prompt, config, max_new_tokens=5, pad_id=255
         )  # 255 absent from the prompt
         np.testing.assert_array_equal(np.asarray(plain), np.asarray(with_pad))
+
+
+class TestEosStopping:
+    def test_rows_freeze_after_eos(self, setup):
+        config, params, prompt = setup
+        # find some eos id the greedy run actually emits
+        free = generate(params, prompt, config, max_new_tokens=8)
+        eos = int(np.asarray(free)[0, 2])  # 3rd token of row 0
+        stopped = generate(params, prompt, config, max_new_tokens=8, eos_id=eos)
+        row = np.asarray(stopped)[0]
+        first = int(np.argmax(row == eos))
+        assert (row[first:] == eos).all()  # frozen after first eos
+        # tokens before eos are unchanged vs the free run
+        np.testing.assert_array_equal(row[:first], np.asarray(free)[0, :first])
+
+    def test_eos_never_emitted_is_noop(self, setup):
+        config, params, prompt = setup
+        free = generate(params, prompt, config, max_new_tokens=6)
+        emitted = set(np.asarray(free).ravel().tolist())
+        unused = next(t for t in range(config.vocab_size) if t not in emitted)
+        stopped = generate(params, prompt, config, max_new_tokens=6, eos_id=unused)
+        np.testing.assert_array_equal(np.asarray(free), np.asarray(stopped))
