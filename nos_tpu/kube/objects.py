@@ -139,6 +139,25 @@ class NodeAffinity:
 
 
 @dataclass
+class TopologySpreadConstraint:
+    """topologySpreadConstraints entry (DoNotSchedule honored as a filter,
+    ScheduleAnyway left to scoring like the in-tree plugin)."""
+
+    topology_key: str = ""
+    max_skew: int = 1
+    when_unsatisfiable: str = "DoNotSchedule"
+    # matchLabels only; matchExpressions are not modeled.
+    match_labels: Dict[str, str] = field(default_factory=dict)
+
+    def selects(self, labels: Dict[str, str]) -> bool:
+        # Upstream nil-selector semantics: a constraint without a selector
+        # matches NO pods (the constraint is a no-op), not every pod.
+        if not self.match_labels:
+            return False
+        return all(labels.get(k) == v for k, v in self.match_labels.items())
+
+
+@dataclass
 class Container:
     name: str = "main"
     image: str = ""
@@ -171,6 +190,9 @@ class PodSpec:
     tolerations: List[Toleration] = field(default_factory=list)
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[NodeAffinity] = None
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
     # Stable pod DNS under a headless Service (<hostname>.<subdomain>.<ns>
     # .svc) — what makes a gang leader's coordinator address resolvable.
     hostname: str = ""

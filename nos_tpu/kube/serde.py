@@ -46,6 +46,7 @@ from nos_tpu.kube.objects import (
     PodStatus,
     Taint,
     Toleration,
+    TopologySpreadConstraint,
 )
 
 # kind -> (api prefix, plural, namespaced)
@@ -325,6 +326,23 @@ def pod_to_wire(pod: Pod) -> Dict[str, Any]:
     aff = _affinity_to_wire(pod.spec.affinity)
     if aff:
         spec["affinity"] = aff
+    if pod.spec.topology_spread_constraints:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": c.max_skew,
+                "topologyKey": c.topology_key,
+                "whenUnsatisfiable": c.when_unsatisfiable,
+                # Empty selector stays ABSENT on the wire: the k8s API reads
+                # labelSelector:{} as match-ALL, the opposite of the
+                # nil-selector (match nothing) semantics modeled here.
+                **(
+                    {"labelSelector": {"matchLabels": dict(c.match_labels)}}
+                    if c.match_labels
+                    else {}
+                ),
+            }
+            for c in pod.spec.topology_spread_constraints
+        ]
     if pod.spec.hostname:
         spec["hostname"] = pod.spec.hostname
     if pod.spec.subdomain:
@@ -371,6 +389,17 @@ def pod_from_wire(d: Dict[str, Any]) -> Pod:
             ],
             node_selector=dict(spec.get("nodeSelector") or {}),
             affinity=_affinity_from_wire(spec.get("affinity")),
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    topology_key=c.get("topologyKey", ""),
+                    max_skew=int(c.get("maxSkew") or 1),
+                    when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+                    match_labels=dict(
+                        (c.get("labelSelector") or {}).get("matchLabels") or {}
+                    ),
+                )
+                for c in spec.get("topologySpreadConstraints") or []
+            ],
             hostname=spec.get("hostname", ""),
             subdomain=spec.get("subdomain", ""),
         ),

@@ -17,7 +17,11 @@ from nos_tpu.kube.objects import Pod
 from nos_tpu.partitioning.core.partition_state import PartitioningState
 from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
 from nos_tpu.partitioning.core.tracker import SliceTracker
-from nos_tpu.scheduler.framework import CycleState, Framework
+from nos_tpu.scheduler.framework import (
+    CycleState,
+    Framework,
+    TOPOLOGY_NODE_INFOS_KEY,
+)
 from nos_tpu.util import resources as res
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.tpu.topology import Topology
@@ -197,6 +201,16 @@ class Planner:
         accelerator = getattr(node.partitionable, "accelerator", "")
         sim_pod = self._simulation_pod(snapshot, pod, accelerator)
         state = CycleState()
+        if sim_pod.spec.topology_spread_constraints:
+            # Cross-node context for the topology-spread predicate,
+            # published the same way the real cycle does (cached on the
+            # snapshot across trials). Scope caveat: the snapshot holds
+            # only partitionable nodes (mirroring the reference's
+            # ClusterState, which caches only partitioning-labeled nodes),
+            # so spread domains that exist purely on non-TPU nodes are
+            # invisible to the simulation — the real scheduler still
+            # enforces them at bind time.
+            state[TOPOLOGY_NODE_INFOS_KEY] = snapshot.sim_node_infos()
         status = self.framework.run_pre_filter_plugins(state, sim_pod)
         if not status.success:
             return False

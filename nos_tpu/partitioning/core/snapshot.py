@@ -53,6 +53,7 @@ class ClusterSnapshot:
         self._nodes = nodes
         self.codec: SliceCodec = codec or TpuSliceCodec()
         self._backup: Optional[Dict[str, SnapshotNode]] = None
+        self._sim_cache: Optional[List[NodeInfo]] = None
 
     # ------------------------------------------------------ fork/commit
 
@@ -60,15 +61,18 @@ class ClusterSnapshot:
         if self._backup is not None:
             raise RuntimeError("snapshot already forked")
         self._backup = copy.deepcopy(self._nodes)
+        self._sim_cache = None
 
     def commit(self) -> None:
         self._backup = None
+        self._sim_cache = None
 
     def revert(self) -> None:
         if self._backup is None:
             raise RuntimeError("snapshot not forked")
         self._nodes = self._backup
         self._backup = None
+        self._sim_cache = None
 
     # --------------------------------------------------------- queries
 
@@ -141,13 +145,26 @@ class ClusterSnapshot:
         pool = self.free_slice_resources()
         return self.take_from_pool(pool, request)
 
+    def sim_node_infos(self) -> List[NodeInfo]:
+        """Every node's sim view, for predicates needing cluster-wide
+        context (topology spread). Cached until the next fork/commit/
+        revert/add_pod — the planner's mutation points. The planner's
+        geometry re-carve right after fork() is covered because fork
+        invalidates and nothing reads between the two."""
+        if self._sim_cache is None:
+            self._sim_cache = [n.sim_node_info() for n in self._nodes.values()]
+        return self._sim_cache
+
     # -------------------------------------------------------- mutation
 
     def add_pod(self, node_name: str, pod: Pod) -> bool:
         node = self._nodes.get(node_name)
         if node is None:
             return False
-        return node.add_pod(pod)
+        added = node.add_pod(pod)
+        if added:
+            self._sim_cache = None
+        return added
 
     # ------------------------------------------------------ projection
 

@@ -333,6 +333,98 @@ class NodeUnschedulableFit:
         return Status.unschedulable("node is cordoned (unschedulable)", self.name)
 
 
+# CycleState key under which the cycle driver (scheduler loop or planner
+# simulation) publishes every NodeInfo of the cluster view the cycle runs
+# against, for plugins that need cross-node context (topology spread).
+TOPOLOGY_NODE_INFOS_KEY = "topology_node_infos"
+
+
+class PodTopologySpreadFit:
+    """DoNotSchedule topologySpreadConstraints (in-tree PodTopologySpread
+    predicate). Skew for a domain = matching pods in that domain (pod
+    included if its own labels match the selector) minus the minimum over
+    all observed domains; placement is refused when any constraint's skew
+    would exceed maxSkew.
+
+    Needs the whole cluster view, which a per-node filter doesn't get, so
+    the cycle driver publishes it in CycleState under
+    ``TOPOLOGY_NODE_INFOS_KEY`` (the in-tree plugin does the same thing via
+    its PreFilter snapshot). Per-domain counts are computed once per cycle
+    and cached in CycleState; each filter call then only recounts the
+    candidate NodeInfo it was handed, which also honors trial views that
+    differ from the published cluster (preemption simulates victim
+    eviction by passing a NodeInfo with victims removed — its counts must
+    win over the published, pre-eviction one). Domains are approximated as
+    "every published node carrying the topology key" — node-affinity
+    eligibility narrowing is not modeled. ScheduleAnyway constraints are
+    ignored (scoring-only upstream).
+    """
+
+    name = "PodTopologySpread"
+    _CACHE_KEY = "pod_topology_spread_counts"
+
+    @staticmethod
+    def _matching(info: NodeInfo, constraint) -> int:
+        return sum(1 for p in info.pods if constraint.selects(p.metadata.labels))
+
+    def _cycle_counts(self, state: CycleState, constraints) -> List[Dict]:
+        """Per constraint: {'domains': {domain: matching}, 'per_node':
+        {node: (domain, matching)}} over the published cluster view."""
+        cached = state.get(self._CACHE_KEY)
+        if cached is not None:
+            return cached
+        all_infos: Sequence[NodeInfo] = state.get(TOPOLOGY_NODE_INFOS_KEY) or []
+        computed = []
+        for c in constraints:
+            domains: Dict[str, int] = {}
+            per_node: Dict[str, tuple] = {}
+            for info in all_infos:
+                domain = info.node.metadata.labels.get(c.topology_key)
+                if domain is None:
+                    continue
+                n = self._matching(info, c)
+                domains[domain] = domains.get(domain, 0) + n
+                per_node[info.name] = (domain, n)
+            computed.append({"domains": domains, "per_node": per_node})
+        state[self._CACHE_KEY] = computed
+        return computed
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        constraints = [
+            c
+            for c in pod.spec.topology_spread_constraints
+            if c.when_unsatisfiable == "DoNotSchedule"
+        ]
+        if not constraints:
+            return Status.ok()
+        cycle = self._cycle_counts(state, constraints)
+        node_labels = node_info.node.metadata.labels
+        for c, cached in zip(constraints, cycle):
+            if c.topology_key not in node_labels:
+                return Status.unschedulable(
+                    f"node has no {c.topology_key} label", self.name
+                )
+            counts = dict(cached["domains"])
+            candidate = node_labels[c.topology_key]
+            # Substitute the handed-in view of this node for the published
+            # one: identical on the normal path, differs under preemption's
+            # trial (victims removed) — the trial must be what's counted.
+            pub_domain, pub_n = cached["per_node"].get(node_info.name, (candidate, 0))
+            counts[pub_domain] = counts.get(pub_domain, 0) - pub_n
+            counts.setdefault(candidate, 0)
+            counts[candidate] += self._matching(node_info, c)
+            if c.selects(pod.metadata.labels):
+                counts[candidate] += 1
+            skew = counts[candidate] - min(counts.values())
+            if skew > c.max_skew:
+                return Status.unschedulable(
+                    f"placing on {c.topology_key}={candidate} would skew "
+                    f"{skew} > maxSkew {c.max_skew}",
+                    self.name,
+                )
+        return Status.ok()
+
+
 def vanilla_filter_plugins() -> List[FilterPlugin]:
     """The in-tree predicate set both the real scheduler and the planner's
     embedded simulation run — keeping the two aligned is what prevents the
@@ -342,5 +434,6 @@ def vanilla_filter_plugins() -> List[FilterPlugin]:
         TaintTolerationFit(),
         NodeAffinityFit(),
         NodeSelectorFit(),
+        PodTopologySpreadFit(),
         NodeResourcesFit(),
     ]

@@ -227,12 +227,54 @@ class Preemptor:
         # quota pressure (node has headroom) must not be reprieved.
         sim_infos = self.infos.clone()
 
+        # Gang evictions remove pods from OTHER nodes too; the spread
+        # predicate counts the whole cluster, so those remote removals must
+        # be visible in its published view or cross-node evictions could
+        # never resolve (or falsely resolve) a skew violation. Trial copies
+        # of affected remote nodes are kept here and overlaid per feasible()
+        # call; the candidate node itself is handled by the filter's own
+        # trial-substitution.
+        from nos_tpu.scheduler.framework import (
+            TOPOLOGY_NODE_INFOS_KEY,
+            PodTopologySpreadFit,
+        )
+
+        has_spread = any(
+            c.when_unsatisfiable == "DoNotSchedule"
+            for c in pod.spec.topology_spread_constraints
+        )
+        published = state.get(TOPOLOGY_NODE_INFOS_KEY) if has_spread else None
+        remote_trials: Dict[str, NodeInfo] = {}
+
+        def _remote_trial(node_name: str) -> Optional[NodeInfo]:
+            if published is None or node_name == node_info.name:
+                return None
+            if node_name not in remote_trials:
+                for info in published:
+                    if info.name == node_name:
+                        remote_trials[node_name] = NodeInfo(
+                            node=info.node, pods=list(info.pods)
+                        )
+                        break
+            return remote_trials.get(node_name)
+
+        def filter_state() -> CycleState:
+            if published is None or not remote_trials:
+                return state
+            overlay = CycleState(state)
+            overlay[TOPOLOGY_NODE_INFOS_KEY] = [
+                remote_trials.get(i.name, i) for i in published
+            ]
+            overlay.pop(PodTopologySpreadFit._CACHE_KEY, None)
+            return overlay
+
         def feasible(trial: NodeInfo) -> bool:
             if not CapacityScheduling.check_quota(
                 pod, sim_infos, self.chip_memory_gb
             ).success:
                 return False
-            if framework.run_filter_plugins(state, pod, trial).success:
+            fs = filter_state()
+            if framework.run_filter_plugins(fs, pod, trial).success:
                 return True
             # Dynamic-partitioning awareness: on a TPU-partitioned node the
             # current slice denominations are NOT the constraint — freed
@@ -250,7 +292,7 @@ class Preemptor:
             from nos_tpu.scheduler.framework import NodeResourcesFit
 
             return all(
-                plugin.filter(state, pod, trial).success
+                plugin.filter(fs, pod, trial).success
                 for plugin in framework.filter_plugins
                 if not isinstance(plugin, NodeResourcesFit)
             )
@@ -262,12 +304,20 @@ class Preemptor:
                 v_info = sim_infos.for_namespace(victim.metadata.namespace)
                 if v_info is not None:
                     v_info.remove_pod(victim.namespaced_name, self._quota_request(victim))
+                if victim.spec.node_name:
+                    remote = _remote_trial(victim.spec.node_name)
+                    if remote is not None:
+                        remote.remove_pod(victim)
 
         def restore_sim(unit: VictimUnit) -> None:
             for victim in unit.members:
                 v_info = sim_infos.for_namespace(victim.metadata.namespace)
                 if v_info is not None:
                     v_info.add_pod(victim.namespaced_name, self._quota_request(victim))
+                if victim.spec.node_name:
+                    remote = _remote_trial(victim.spec.node_name)
+                    if remote is not None:
+                        remote.add_pod(victim)
 
         trial = NodeInfo(node=node_info.node, pods=list(node_info.pods))
         for unit in units:

@@ -22,6 +22,7 @@ from nos_tpu.scheduler.framework import (
     CycleState,
     Framework,
     NodeInfo,
+    TOPOLOGY_NODE_INFOS_KEY,
     vanilla_filter_plugins,
     Status,
     StatusCode,
@@ -97,12 +98,17 @@ class Scheduler:
     def schedule_one(self, pod: Pod) -> Optional[Result]:
         start = time.monotonic()
         state = CycleState()
+        # Published before ANY extension point: the PreFilter-failure
+        # preemption path below also runs filter plugins (victim trials),
+        # and those need the same cluster view as the normal filter pass.
+        node_infos = self._node_infos()
+        state[TOPOLOGY_NODE_INFOS_KEY] = list(node_infos.values())
         status = self.framework.run_pre_filter_plugins(state, pod)
         if not status.success:
             # PreFilter rejection (e.g. quota max) still gets a preemption
             # attempt — evicting victims may change the quota math
             # (capacity_scheduling.go PostFilter runs on any failure).
-            filtered = {name: status for name in self._node_infos()}
+            filtered = {name: status for name in node_infos}
             nominated = self.framework.run_post_filter_plugins(state, pod, filtered)
             if nominated:
                 self._set_nominated(pod, nominated)
@@ -110,7 +116,6 @@ class Scheduler:
             self._mark_unschedulable(pod, status.message)
             return Result(requeue_after=self.retry)
 
-        node_infos = self._node_infos()
         feasible: List[NodeInfo] = []
         filtered: Dict[str, Status] = {}
         for info in node_infos.values():

@@ -95,6 +95,49 @@ class TestRoundTrips:
         assert back.spec.node_selector == {"pool": "tpu"}
         assert back.metadata.labels == {"a": "b"}
 
+    def test_pod_topology_spread(self):
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace="ns"),
+            spec=PodSpec(
+                containers=[Container()],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        topology_key="topology.kubernetes.io/zone",
+                        max_skew=2,
+                        when_unsatisfiable="DoNotSchedule",
+                        match_labels={"app": "web"},
+                    )
+                ],
+            ),
+        )
+        back = serde.from_wire(serde.to_wire(pod))
+        c = back.spec.topology_spread_constraints[0]
+        assert c.topology_key == "topology.kubernetes.io/zone"
+        assert c.max_skew == 2
+        assert c.when_unsatisfiable == "DoNotSchedule"
+        assert c.match_labels == {"app": "web"}
+
+    def test_topology_spread_empty_selector_omitted_on_wire(self):
+        # labelSelector:{} means match-ALL to the k8s API — the opposite of
+        # the modeled nil-selector no-op — so it must not be emitted.
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace="ns"),
+            spec=PodSpec(
+                containers=[Container()],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(topology_key="zone")
+                ],
+            ),
+        )
+        wire = serde.to_wire(pod)
+        assert "labelSelector" not in wire["spec"]["topologySpreadConstraints"][0]
+        back = serde.from_wire(wire)
+        assert back.spec.topology_spread_constraints[0].match_labels == {}
+
     def test_node_with_taints(self):
         node = Node(
             metadata=ObjectMeta(name="n1", labels={"t": "v"}),

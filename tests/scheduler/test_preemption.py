@@ -215,3 +215,63 @@ class TestPdbAwarePreemption:
             store.try_get("Pod", "svc-1", "team-b"),
         ]
         assert sum(1 for x in survivors if x is None) == 1
+
+
+class TestSpreadAwarePreemption:
+    """Cross-node gang evictions must be visible to the topology-spread
+    predicate during victim trials: the published (pre-eviction) counts for
+    remote nodes would otherwise report a resolvable skew that the real
+    post-eviction cluster does not have — destroying a gang for a
+    nomination the next cycle rejects."""
+
+    def test_gang_not_destroyed_when_remote_evictions_break_spread(self):
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        store = KubeStore()
+        n1 = build_node("n1", alloc={CHIPS: 4, "cpu": 64})
+        n1.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        n1.metadata.labels["pool"] = "a"
+        store.create(n1)
+        n2 = build_node("n2", alloc={CHIPS: 8, "cpu": 64})
+        n2.metadata.labels["topology.kubernetes.io/zone"] = "zone-b"
+        store.create(n2)
+        store.create(quota("team-a"))
+        store.create(quota("team-b"))
+
+        # team-b web gang: one member on n1, two on n2 (all over-quota).
+        store.create(
+            over_quota_pod("w0", 4, "team-b", "n1", gang="trainer", gang_size=3,
+                           extra_labels={"app": "web"})
+        )
+        for i, name in enumerate(("w1", "w2")):
+            store.create(
+                over_quota_pod(name, 4, "team-b", "n2", gang="trainer", gang_size=3,
+                               extra_labels={"app": "web"})
+            )
+        # Two high-priority non-victim web replicas on n1 (cpu-only).
+        for i in range(2):
+            anchor = build_pod(f"anchor-{i}", {"cpu": 1}, ns="team-a",
+                               node="n1", phase="Running", priority=100)
+            anchor.metadata.labels["app"] = "web"
+            store.create(anchor)
+
+        preemptor = build_pod("p", {CHIPS: 4}, ns="team-a")
+        preemptor.metadata.labels["app"] = "web"
+        preemptor.spec.node_selector = {"pool": "a"}  # only n1 is a candidate
+        preemptor.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                topology_key="topology.kubernetes.io/zone",
+                max_skew=1,
+                match_labels={"app": "web"},
+            )
+        ]
+        s = make_scheduler(store)
+        sched_pod(s, store, preemptor)
+        # True post-eviction counts: zone-a 2 anchors + preemptor = 3,
+        # zone-b 0 -> skew 3 > 1: infeasible. The stale published view
+        # (zone-b still 2) would wrongly report skew 1 and evict the gang.
+        assert store.try_get("Pod", "w0", "team-b") is not None
+        assert store.try_get("Pod", "w1", "team-b") is not None
+        assert store.try_get("Pod", "w2", "team-b") is not None
+        pod = store.get("Pod", "p", "team-a")
+        assert pod.status.nominated_node_name == ""

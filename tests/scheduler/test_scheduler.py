@@ -276,3 +276,121 @@ class TestVanillaPredicates:
         ])
         sched_pod(s, store, pod)
         assert store.get("Pod", "p", "default").spec.node_name == "n-gold"
+
+    def _spread_pod(self, name, zone_key="topology.kubernetes.io/zone"):
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        pod = build_pod(name, {"cpu": 1})
+        pod.metadata.labels["app"] = "web"
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                topology_key=zone_key, max_skew=1, match_labels={"app": "web"}
+            )
+        ]
+        return pod
+
+    def test_topology_spread_prefers_empty_zone(self):
+        store = KubeStore()
+        for name, zone in (("n-a", "zone-a"), ("n-b", "zone-b")):
+            node = build_node(name, alloc={"cpu": 8})
+            node.metadata.labels["topology.kubernetes.io/zone"] = zone
+            store.create(node)
+        # Two replicas already running in zone-a.
+        for i in range(2):
+            running = build_pod(f"web-{i}", {"cpu": 1}, node="n-a", phase=PodPhase.RUNNING)
+            running.metadata.labels["app"] = "web"
+            store.create(running)
+        s = make_scheduler(store)
+        sched_pod(s, store, self._spread_pod("web-new"))
+        # zone-a would skew 3-0=3 > 1; only zone-b satisfies the constraint.
+        assert store.get("Pod", "web-new", "default").spec.node_name == "n-b"
+
+    def test_topology_spread_unschedulable_when_all_zones_skewed(self):
+        store = KubeStore()
+        node = build_node("n-a", alloc={"cpu": 8})
+        node.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        store.create(node)
+        # A zone-b domain exists with zero replicas but no capacity, so the
+        # only fitting node (zone-a, 2 replicas) violates maxSkew=1.
+        full = build_node("n-b", alloc={"cpu": 1})
+        full.metadata.labels["topology.kubernetes.io/zone"] = "zone-b"
+        store.create(full)
+        filler = build_pod("filler", {"cpu": 1}, node="n-b", phase=PodPhase.RUNNING)
+        store.create(filler)
+        for i in range(2):
+            running = build_pod(f"web-{i}", {"cpu": 1}, node="n-a", phase=PodPhase.RUNNING)
+            running.metadata.labels["app"] = "web"
+            store.create(running)
+        s = make_scheduler(store)
+        sched_pod(s, store, self._spread_pod("web-new"))
+        pod = store.get("Pod", "web-new", "default")
+        assert pod.spec.node_name == ""
+        assert pod.unschedulable()
+
+    def test_topology_spread_trial_view_overrides_published(self):
+        # Preemption hands the filter a trial NodeInfo with victims
+        # removed; the trial's counts must win over the published view or
+        # eviction could never resolve a skew violation.
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+        from nos_tpu.scheduler.framework import (
+            CycleState,
+            NodeInfo,
+            PodTopologySpreadFit,
+            TOPOLOGY_NODE_INFOS_KEY,
+        )
+
+        def zone_node(name, zone):
+            node = build_node(name, alloc={"cpu": 8})
+            node.metadata.labels["topology.kubernetes.io/zone"] = zone
+            return node
+
+        def web_pod(name):
+            p = build_pod(name, {"cpu": 1}, phase=PodPhase.RUNNING)
+            p.metadata.labels["app"] = "web"
+            return p
+
+        published_a = NodeInfo(zone_node("n-a", "zone-a"), [web_pod("w1"), web_pod("w2")])
+        published_b = NodeInfo(zone_node("n-b", "zone-b"), [])
+        state = CycleState()
+        state[TOPOLOGY_NODE_INFOS_KEY] = [published_a, published_b]
+        incoming = self._spread_pod("web-new")
+        plugin = PodTopologySpreadFit()
+        # Published view: zone-a already has 2, zone-b 0 -> n-a violates.
+        assert not plugin.filter(state, incoming, published_a).success
+        # Trial view of n-a with both victims evicted: skew resolves.
+        trial = NodeInfo(published_a.node, [])
+        assert plugin.filter(state, incoming, trial).success
+
+    def test_topology_spread_nil_selector_is_noop(self):
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        store = KubeStore()
+        node = build_node("n-a", alloc={"cpu": 8})
+        node.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        store.create(node)
+        crowded = build_node("n-b", alloc={"cpu": 8})
+        crowded.metadata.labels["topology.kubernetes.io/zone"] = "zone-b"
+        store.create(crowded)
+        for i in range(3):
+            store.create(build_pod(f"other-{i}", {"cpu": 1}, node="n-b", phase=PodPhase.RUNNING))
+        s = make_scheduler(store)
+        pod = build_pod("p", {"cpu": 1})
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(topology_key="topology.kubernetes.io/zone")
+        ]
+        sched_pod(s, store, pod)
+        # Upstream nil-selector matches no pods: the constraint is a no-op
+        # and must not reject the (otherwise skewed-looking) zones.
+        assert store.get("Pod", "p", "default").spec.node_name != ""
+
+    def test_topology_spread_requires_topology_label(self):
+        store = KubeStore()
+        unlabeled = build_node("n-bare", alloc={"cpu": 8})
+        store.create(unlabeled)
+        zoned = build_node("n-zoned", alloc={"cpu": 8})
+        zoned.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        store.create(zoned)
+        s = make_scheduler(store)
+        sched_pod(s, store, self._spread_pod("web-new"))
+        # Nodes without the topology key cannot host DoNotSchedule spreads.
+        assert store.get("Pod", "web-new", "default").spec.node_name == "n-zoned"
