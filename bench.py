@@ -237,11 +237,61 @@ def run_tpu_child() -> None:
             log(f"[tpu-child] fwd flash failed: {type(e).__name__}: {str(e)[:200]}")
         snapshot()
 
+        # ---- serving: KV-cache autoregressive decode throughput (the
+        # per-token cost a slice tenant sees; memory-bandwidth-bound).
+        # Runs BEFORE the long-context sweep: its compiled executables and
+        # score buffers are the biggest HBM pressure in the child, and a
+        # timeout/OOM there must not cost the serving numbers.
+        jax.clear_caches()
+        try:
+            from nos_tpu.models.generate import generate as kv_generate
+
+            new_tokens = 64
+            gen = jax.jit(
+                lambda p, t: kv_generate(p, t, config, max_new_tokens=new_tokens)
+            )
+            prompt = jnp.zeros((1, 128), jnp.int32)
+            jax.block_until_ready(gen(params, prompt))
+            start = time.monotonic()
+            iters = 3
+            for _ in range(iters):
+                out = gen(params, prompt)
+            jax.block_until_ready(out)
+            tok_s = new_tokens * iters / (time.monotonic() - start)
+            result["decode_tokens_per_s"] = round(tok_s, 1)
+            log(f"[tpu-child] decode: {tok_s:.1f} tok/s "
+                f"(KV cache, prompt 128 + {new_tokens} new)")
+            snapshot()
+
+            # int8 weight-only serving: decode re-reads every weight per
+            # token, so halved weight bytes should read straight through
+            # to tokens/s (HBM-bandwidth-bound).
+            from nos_tpu.models.quantize import quantize_params, weight_bytes
+
+            qparams = jax.jit(quantize_params)(params)
+            ratio = weight_bytes(qparams) / max(1, weight_bytes(params))
+            jax.block_until_ready(gen(qparams, prompt))
+            start = time.monotonic()
+            for _ in range(iters):
+                out = gen(qparams, prompt)
+            jax.block_until_ready(out)
+            tok_s_q = new_tokens * iters / (time.monotonic() - start)
+            result["decode_int8_tokens_per_s"] = round(tok_s_q, 1)
+            result["int8_weight_bytes_ratio"] = round(ratio, 3)
+            result["int8_decode_speedup"] = round(tok_s_q / tok_s, 3)
+            log(f"[tpu-child] decode int8: {tok_s_q:.1f} tok/s "
+                f"({result['int8_decode_speedup']}x, weights {ratio:.2f}x bytes)")
+            del qparams
+            snapshot()
+        except Exception as e:
+            log(f"[tpu-child] decode failed: {type(e).__name__}: {str(e)[:160]}")
+
         # ---- long context: where flash earns its keep. Dense materializes
         # fp32 [b,K,g,s,s] scores (s=8192: 4 GB per layer); flash streams
         # K/V blocks with O(blk) VMEM. Report per-seq dense/flash ms and
         # the speedup (dense OOM -> speedup reported as inf-proxy null,
         # flash time still recorded).
+        jax.clear_caches()
         for long_seq in (4096, 8192):
             long_toks = jnp.zeros((1, long_seq), jnp.int32)
             d_ms = f_ms = None
@@ -268,30 +318,6 @@ def run_tpu_child() -> None:
             if d_ms is not None and f_ms is not None:
                 result[f"flash_speedup_{tag}"] = round(d_ms / f_ms, 3)
             snapshot()
-
-        # ---- serving: KV-cache autoregressive decode throughput (the
-        # per-token cost a slice tenant sees; memory-bandwidth-bound).
-        try:
-            from nos_tpu.models.generate import generate as kv_generate
-
-            new_tokens = 64
-            gen = jax.jit(
-                lambda p, t: kv_generate(p, t, config, max_new_tokens=new_tokens)
-            )
-            prompt = jnp.zeros((1, 128), jnp.int32)
-            jax.block_until_ready(gen(params, prompt))
-            start = time.monotonic()
-            iters = 3
-            for _ in range(iters):
-                out = gen(params, prompt)
-            jax.block_until_ready(out)
-            tok_s = new_tokens * iters / (time.monotonic() - start)
-            result["decode_tokens_per_s"] = round(tok_s, 1)
-            log(f"[tpu-child] decode: {tok_s:.1f} tok/s "
-                f"(KV cache, prompt 128 + {new_tokens} new)")
-            snapshot()
-        except Exception as e:
-            log(f"[tpu-child] decode failed: {type(e).__name__}: {str(e)[:160]}")
 
     print(json.dumps(result), flush=True)
 

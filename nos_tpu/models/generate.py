@@ -24,7 +24,9 @@ import jax.numpy as jnp
 from nos_tpu.models.llama import (
     LlamaConfig,
     _apply_rope,
+    _embed_rows,
     _mlp,
+    _mm,
     _rms_norm,
     _rope,
     _rope_at,
@@ -83,7 +85,7 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache capacity {max_len}")
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens, c.dtype)
     if pad_id is None:
         cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
         cos_b = sin_b = None
@@ -106,9 +108,9 @@ def prefill(
     for i, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
         hd = c.head_dim
-        q = (h @ layer["wq"]).reshape(b, s, c.n_heads, hd)
-        k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
-        v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        q = _mm(h, layer["wq"]).reshape(b, s, c.n_heads, hd)
+        k = _mm(h, layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+        v = _mm(h, layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
         q = rope(q)
         k = rope(k)
         cache[i]["k"] = jax.lax.dynamic_update_slice(
@@ -142,10 +144,10 @@ def prefill(
             attn = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(
                 b, s, c.n_heads * hd
             )
-        x = x + attn @ layer["wo"]
+        x = x + _mm(attn, layer["wo"])
         x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32), cache
+    return _mm(x, params["lm_head"]).astype(jnp.float32), cache
 
 
 def decode_step(
@@ -167,7 +169,7 @@ def decode_step(
     c = config
     b = token.shape[0]
     hd = c.head_dim
-    x = params["embed"][token][:, None, :]  # [B, 1, D]
+    x = _embed_rows(params["embed"], token, c.dtype)[:, None, :]  # [B, 1, D]
     if rope_pos is None:
         cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype, c.rope_scaling)
         cos = cos[None, :, None, :]  # [1, 1, 1, hd/2]: broadcast over rows
@@ -183,19 +185,45 @@ def decode_step(
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):
         h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
-        q = (h @ layer["wq"]).reshape(b, 1, c.n_heads, hd)
-        k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, hd)
-        v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
+        q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = _mm(h, layer["wk"]).reshape(b, 1, c.n_kv_heads, hd)
+        v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = rope1(q)
         k = rope1(k)
         ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
-        x = x + attn @ layer["wo"]
+        x = x + _mm(attn, layer["wo"])
         x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return (x[:, 0] @ params["lm_head"]).astype(jnp.float32), new_cache
+    return _mm(x[:, 0], params["lm_head"]).astype(jnp.float32), new_cache
+
+
+def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """Standard sampling filters, static-shape throughout (jit-stable):
+    top-k keeps the k highest logits; nucleus (top-p) keeps the smallest
+    prefix of the probability-sorted vocabulary whose mass reaches p (the
+    first token crossing the threshold is kept). Masked entries go to -inf
+    so ``jax.random.categorical`` never draws them."""
+    if top_k and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        # mass strictly ABOVE each rank: rank is kept while that mass < p,
+        # which keeps the first token whose inclusion crosses p. Rank 0 is
+        # kept unconditionally so top_p <= 0 degrades to greedy instead of
+        # masking the whole vocabulary (categorical over all--inf silently
+        # returns token 0).
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        keep = (mass_before < top_p).at[..., 0].set(True)
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
 
 
 def generate(
@@ -204,13 +232,16 @@ def generate(
     config: LlamaConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
     pad_id: Optional[int] = None,
     eos_id: Optional[int] = None,
 ) -> jax.Array:
     """prompt [B, S] → generated tokens [B, max_new_tokens].
 
-    Greedy when temperature == 0, otherwise temperature sampling. The
+    Greedy when temperature == 0, otherwise temperature sampling with
+    optional top-k / nucleus (top-p) filtering applied in that order. The
     decode loop is one ``lax.scan`` — compile once, reuse for any prompt
     of the same shape. Variable-length prompts batch via LEFT padding:
     pass ``pad_id`` and pad each row on the left; pads never attend and
@@ -240,9 +271,8 @@ def generate(
     def pick(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-            prompt.dtype
-        )
+        filtered = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(key, filtered, axis=-1).astype(prompt.dtype)
 
     # Single-use keys: every sample consumes a fresh split — the carried
     # key is only ever a split parent, never passed to categorical itself.

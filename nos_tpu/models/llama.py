@@ -132,6 +132,23 @@ def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
 # ---------------------------------------------------------------- forward
 
 
+def _mm(x: jax.Array, w) -> jax.Array:
+    """x @ w for bf16 weights or int8 QuantizedLinear (serving path)."""
+    from nos_tpu.models.quantize import QuantizedLinear
+
+    if isinstance(w, QuantizedLinear):
+        return w.matmul(x)
+    return x @ w
+
+
+def _embed_rows(embed, tokens: jax.Array, dtype) -> jax.Array:
+    from nos_tpu.models.quantize import QuantizedEmbedding
+
+    if isinstance(embed, QuantizedEmbedding):
+        return embed.lookup(tokens, dtype)
+    return embed[tokens]
+
+
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
@@ -191,9 +208,9 @@ def _attention(
     c = config
     b, s, _ = x.shape
     hd = c.head_dim
-    q = (x @ layer["wq"]).reshape(b, s, c.n_heads, hd)
-    k = (x @ layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
-    v = (x @ layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = _mm(x, layer["wq"]).reshape(b, s, c.n_heads, hd)
+    k = _mm(x, layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = _mm(x, layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
 
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
@@ -209,8 +226,8 @@ def _attention(
         )
 
         if c.attention == "flash":
-            return ring_flash_attention(q, k, v, mesh, causal=True) @ layer["wo"]
-        return ring_attention(q, k, v, mesh, causal=True) @ layer["wo"]
+            return _mm(ring_flash_attention(q, k, v, mesh, causal=True), layer["wo"])
+        return _mm(ring_attention(q, k, v, mesh, causal=True), layer["wo"])
 
     if c.attention == "flash":
         # Single-chip blockwise attention on the MXU (nos_tpu/ops/); the
@@ -220,7 +237,7 @@ def _attention(
         out = flash_attention(
             q, k, v, causal=True, interpret=jax.default_backend() == "cpu"
         )
-        return out.reshape(b, s, c.n_heads * hd) @ layer["wo"]
+        return _mm(out.reshape(b, s, c.n_heads * hd), layer["wo"])
 
     # GQA: expand kv heads to query heads by grouping queries.
     group = c.n_heads // c.n_kv_heads
@@ -232,11 +249,11 @@ def _attention(
     scores = jnp.where(causal[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     out = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, c.n_heads * hd)
-    return out @ layer["wo"]
+    return _mm(out, layer["wo"])
 
 
 def _mlp(x: jax.Array, layer: Params) -> jax.Array:
-    return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+    return _mm(jax.nn.silu(_mm(x, layer["w_gate"])) * _mm(x, layer["w_up"]), layer["w_down"])
 
 
 def llama_forward(
@@ -254,7 +271,7 @@ def llama_forward(
     additionally returns the summed MoE load-balancing loss (0 for dense).
     """
     c = config
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens, c.dtype)
     # Position tables depend only on (seq_len, head_dim): one per forward.
     cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     def block(x, layer):
@@ -286,7 +303,7 @@ def llama_forward(
         x, aux = block(x, layer)
         aux_total = aux_total + aux
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     if with_aux:
         return logits, aux_total
     return logits

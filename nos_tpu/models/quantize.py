@@ -1,0 +1,145 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound: every generated token re-reads the full
+weight set, so weight bytes — not FLOPs — set the tokens/s ceiling. Storing
+weights as int8 with per-output-channel float scales quarters the bytes
+(vs bf16: halves) while the MXU still sees a normal matmul: XLA fuses the
+int8→bf16 convert into the dot's operand load, so the dequant never
+materializes in HBM.
+
+The reference has no model stack (SURVEY.md §5: "It is NOT a training
+framework"); this serves the TPU build's own serving north star — more
+tokens/s per carved slice tenant.
+
+Serving-only: quantized weights are not differentiable (there is no STE
+here); keep the bf16 originals for training.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# Weight leaves quantized as [in, out] matmul operands.
+_LINEAR_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedLinear:
+    """int8 weight [in, out] + per-output-channel scale [out] (f32)."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    def matmul(self, x: jax.Array) -> jax.Array:
+        # Convert-then-dot fuses on TPU: int8 rows stream from HBM, the
+        # widening happens in registers feeding the MXU tiles.
+        return (x @ self.q.astype(x.dtype)) * self.scale.astype(x.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedEmbedding:
+    """int8 table [vocab, d] + per-row scale [vocab] (f32); dequant after
+    the gather so only the looked-up rows widen."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    def lookup(self, tokens: jax.Array, dtype) -> jax.Array:
+        return self.q[tokens].astype(dtype) * self.scale[tokens][..., None].astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _absmax_quantize(w: jax.Array, axis: int):
+    """Symmetric absmax int8 along ``axis`` (the contraction axis): returns
+    (q int8, scale f32 with ``axis`` dropped)."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=axis)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(w32 / jnp.expand_dims(scale, axis)), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_linear(w: jax.Array) -> QuantizedLinear:
+    """[in, out] weight → int8 with one scale per output column."""
+    q, scale = _absmax_quantize(w, axis=0)
+    return QuantizedLinear(q=q, scale=scale)
+
+
+def quantize_embedding(w: jax.Array) -> QuantizedEmbedding:
+    """[vocab, d] table → int8 with one scale per vocab row."""
+    q, scale = _absmax_quantize(w, axis=1)
+    return QuantizedEmbedding(q=q, scale=scale)
+
+
+def quantize_params(params: Params) -> Params:
+    """Llama param tree → serving tree with every dense matmul weight and
+    the embedding table int8-quantized. Norm vectors stay in the model
+    dtype (tiny, and RMSNorm is scale-sensitive). MoE expert stacks are
+    left unquantized — their einsum path dequants differently; quantize
+    them when the serving bench says they matter.
+    """
+    out: Params = {
+        "embed": quantize_embedding(params["embed"]),
+        "final_norm": params["final_norm"],
+        "lm_head": quantize_linear(params["lm_head"]),
+        "layers": [],
+    }
+    for layer in params["layers"]:
+        q_layer: Params = {}
+        for key, value in layer.items():
+            if key in _LINEAR_KEYS:
+                q_layer[key] = quantize_linear(value)
+            else:
+                q_layer[key] = value
+        out["layers"].append(q_layer)
+    return out
+
+
+def dequantize_params(params: Params, dtype=jnp.bfloat16) -> Params:
+    """Inverse of quantize_params (up to rounding): expands every quantized
+    leaf back to a dense weight — the fake-quant oracle tests compare the
+    int8 forward against, and the escape hatch back to training dtype."""
+
+    def expand(leaf):
+        if isinstance(leaf, QuantizedLinear):
+            return (leaf.q.astype(jnp.float32) * leaf.scale[None, :]).astype(dtype)
+        if isinstance(leaf, QuantizedEmbedding):
+            return (leaf.q.astype(jnp.float32) * leaf.scale[:, None]).astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        expand,
+        params,
+        is_leaf=lambda x: isinstance(x, (QuantizedLinear, QuantizedEmbedding)),
+    )
+
+
+def weight_bytes(params: Params) -> int:
+    """Total bytes of all array leaves (the HBM working set decode streams)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+        if hasattr(leaf, "dtype")
+    )

@@ -192,3 +192,61 @@ class TestShardedServing:
             sharded, prompt
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSamplingFilters:
+    def test_top_k_one_equals_greedy(self, setup):
+        config, params, prompt = setup
+        greedy = generate(params, prompt, config, max_new_tokens=6)
+        top1 = generate(
+            params, prompt, config, max_new_tokens=6,
+            temperature=0.7, top_k=1, rng=jax.random.key(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(top1))
+
+    def test_tiny_top_p_equals_greedy(self, setup):
+        config, params, prompt = setup
+        greedy = generate(params, prompt, config, max_new_tokens=6)
+        nucleus = generate(
+            params, prompt, config, max_new_tokens=6,
+            temperature=0.7, top_p=1e-6, rng=jax.random.key(10),
+        )
+        # the nucleus always keeps the first (highest-prob) token
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+    def test_top_k_samples_stay_in_top_k_set(self, setup):
+        from nos_tpu.models.generate import _filter_logits
+
+        config, params, prompt = setup
+        logits = jax.random.normal(jax.random.key(2), (3, config.vocab_size))
+        k = 5
+        filtered = _filter_logits(logits, top_k=k, top_p=1.0)
+        allowed = jax.lax.top_k(logits, k)[1]
+        draws = jax.vmap(
+            lambda key: jax.random.categorical(key, filtered, axis=-1)
+        )(jax.random.split(jax.random.key(3), 64))  # [64, 3]
+        for row in range(3):
+            assert set(np.asarray(draws[:, row])) <= set(np.asarray(allowed[row]))
+
+    def test_top_p_keeps_minimal_prefix(self):
+        from nos_tpu.models.generate import _filter_logits
+
+        # probs 0.5, 0.3, 0.15, 0.05 -> top_p=0.6 keeps {0, 1}: mass before
+        # token 1 is 0.5 < 0.6 (kept, crossing the threshold), before
+        # token 2 is 0.8 >= 0.6 (dropped).
+        probs = jnp.array([[0.5, 0.3, 0.15, 0.05]])
+        logits = jnp.log(probs)
+        filtered = np.asarray(_filter_logits(logits, top_k=0, top_p=0.6))
+        assert np.isfinite(filtered[0, :2]).all()
+        assert np.isneginf(filtered[0, 2:]).all()
+
+    def test_filters_compose_under_jit(self, setup):
+        config, params, prompt = setup
+        out = jax.jit(
+            lambda p, t, r: generate(
+                p, t, config, max_new_tokens=4,
+                temperature=0.9, top_k=8, top_p=0.9, rng=r,
+            )
+        )(params, prompt, jax.random.key(4))
+        assert out.shape == (2, 4)
+        assert (np.asarray(out) >= 0).all()
