@@ -76,6 +76,16 @@ def moe_param_sharding(mesh, config: MoeConfig) -> Params:
     }
 
 
+def _emm(x: jax.Array, w) -> jax.Array:
+    """Batched expert matmul [E, C, in] x [E, in, out] for dense stacks or
+    int8 QuantizedExpertStack (serving path)."""
+    from nos_tpu.models.quantize import QuantizedExpertStack
+
+    if isinstance(w, QuantizedExpertStack):
+        return w.expert_matmul(x)
+    return jnp.einsum("eci,eio->eco", x, w)
+
+
 def moe_mlp(
     params: Params,
     x: jax.Array,
@@ -120,9 +130,9 @@ def moe_mlp(
         )
 
     # ---- expert FFN over stacked weights (one batched einsum per matmul)
-    gate = jnp.einsum("ecd,edf->ecf", dispatch, params["w_gate"])
-    up = jnp.einsum("ecd,edf->ecf", dispatch, params["w_up"])
-    out_e = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, params["w_down"])
+    gate = _emm(dispatch, params["w_gate"])
+    up = _emm(dispatch, params["w_up"])
+    out_e = _emm(jax.nn.silu(gate) * up, params["w_down"])
     if mesh is not None and "ep" in mesh.axis_names:
         out_e = jax.lax.with_sharding_constraint(
             out_e, NamedSharding(mesh, P("ep", None, None))

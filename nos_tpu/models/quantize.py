@@ -69,6 +69,30 @@ class QuantizedEmbedding:
         return cls(*children)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedExpertStack:
+    """Stacked MoE expert weights [E, in, out] in int8 with per-(expert,
+    output-channel) scales [E, out]; the batched expert einsum dequants
+    per tile like the 2-D path."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    def expert_matmul(self, x: jax.Array) -> jax.Array:
+        # x [E, C, in] -> [E, C, out]
+        return jnp.einsum("eci,eio->eco", x, self.q.astype(x.dtype)) * self.scale[
+            :, None, :
+        ].astype(x.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
 def _absmax_quantize(w: jax.Array, axis: int):
     """Symmetric absmax int8 along ``axis`` (the contraction axis): returns
     (q int8, scale f32 with ``axis`` dropped)."""
@@ -93,12 +117,17 @@ def quantize_embedding(w: jax.Array) -> QuantizedEmbedding:
     return QuantizedEmbedding(q=q, scale=scale)
 
 
+def quantize_expert_stack(w: jax.Array) -> QuantizedExpertStack:
+    """[E, in, out] stacked experts → int8 along the contraction axis."""
+    q, scale = _absmax_quantize(w, axis=1)
+    return QuantizedExpertStack(q=q, scale=scale)
+
+
 def quantize_params(params: Params) -> Params:
-    """Llama param tree → serving tree with every dense matmul weight and
-    the embedding table int8-quantized. Norm vectors stay in the model
-    dtype (tiny, and RMSNorm is scale-sensitive). MoE expert stacks are
-    left unquantized — their einsum path dequants differently; quantize
-    them when the serving bench says they matter.
+    """Llama param tree → serving tree with every dense matmul weight, the
+    embedding table, and MoE expert stacks int8-quantized. Norm vectors
+    stay in the model dtype (tiny, and RMSNorm is scale-sensitive); the
+    MoE router stays float32 (routing is precision-sensitive).
     """
     out: Params = {
         "embed": quantize_embedding(params["embed"]),
@@ -111,6 +140,13 @@ def quantize_params(params: Params) -> Params:
         for key, value in layer.items():
             if key in _LINEAR_KEYS:
                 q_layer[key] = quantize_linear(value)
+            elif key == "moe":
+                q_layer[key] = {
+                    "router": value["router"],
+                    "w_gate": quantize_expert_stack(value["w_gate"]),
+                    "w_up": quantize_expert_stack(value["w_up"]),
+                    "w_down": quantize_expert_stack(value["w_down"]),
+                }
             else:
                 q_layer[key] = value
         out["layers"].append(q_layer)
@@ -127,12 +163,16 @@ def dequantize_params(params: Params, dtype=jnp.bfloat16) -> Params:
             return (leaf.q.astype(jnp.float32) * leaf.scale[None, :]).astype(dtype)
         if isinstance(leaf, QuantizedEmbedding):
             return (leaf.q.astype(jnp.float32) * leaf.scale[:, None]).astype(dtype)
+        if isinstance(leaf, QuantizedExpertStack):
+            return (leaf.q.astype(jnp.float32) * leaf.scale[:, None, :]).astype(dtype)
         return leaf
 
     return jax.tree_util.tree_map(
         expand,
         params,
-        is_leaf=lambda x: isinstance(x, (QuantizedLinear, QuantizedEmbedding)),
+        is_leaf=lambda x: isinstance(
+            x, (QuantizedLinear, QuantizedEmbedding, QuantizedExpertStack)
+        ),
     )
 
 

@@ -24,7 +24,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nos_tpu.models.llama import LlamaConfig, _attention, _mlp, _rms_norm, _rope
+from nos_tpu.models.llama import (
+    LlamaConfig,
+    _attention,
+    _embed_rows,
+    _mlp,
+    _mm,
+    _rms_norm,
+    _rope,
+)
 
 Params = Dict[str, Any]
 
@@ -152,7 +160,7 @@ def _prepare_pipeline_inputs(params: Params, tokens: jax.Array, config: LlamaCon
     if b % m:
         raise ValueError(f"batch {b} does not divide {m} microbatches")
 
-    x = params["embed"][tokens]
+    x = _embed_rows(params["embed"], tokens, c.dtype)
     cos, sin = _rope(s_len, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     x_mb = x.reshape(m, b // m, s_len, c.d_model)
 
@@ -189,7 +197,7 @@ def pipeline_llama_forward(
 
     y = y_mb.reshape(b, s_len, c.d_model)
     y = _rms_norm(y, params["final_norm"], c.norm_eps)
-    return (y @ params["lm_head"]).astype(jnp.float32)
+    return _mm(y, params["lm_head"]).astype(jnp.float32)
 
 
 def pipeline_llama_loss(
@@ -222,7 +230,7 @@ def pipeline_llama_loss(
         ys = _pipeline_schedule(layers, xm, c, cos, sin, n_stages=n_stages)
         y = ys.reshape(-1, s_len, c.d_model)  # microbatch order == batch order
         h = _rms_norm(y, final_norm, c.norm_eps)
-        logits = (h @ lm_head).astype(jnp.float32)
+        logits = _mm(h, lm_head).astype(jnp.float32)
         local_loss = next_token_nll(logits, tm.reshape(-1, s_len))
         # Only the last stage computed real activations: one scalar hop.
         loss = jax.lax.psum(

@@ -56,6 +56,56 @@ def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
     }
 
 
+def llama_quantized_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
+    """Sharding tree matching quantize_params' output: each int8 weight
+    shards like its dense original, and its per-output-channel scale
+    shards along the same axis as the output dimension (per-vocab-row for
+    the embedding), so dequantization stays local — no collective touches
+    the scales. Structure mirrors the quantized pytree (QuantizedLinear /
+    QuantizedEmbedding nodes whose leaves are NamedShardings), which is
+    exactly what ``jax.device_put(qparams, sharding_tree)`` wants."""
+    from nos_tpu.models.quantize import QuantizedEmbedding, QuantizedLinear
+
+    def lin(in_axis, out_axis):
+        return QuantizedLinear(
+            q=_ns(mesh, in_axis, out_axis), scale=_ns(mesh, out_axis)
+        )
+
+    layer = {
+        "attn_norm": _ns(mesh),
+        "wq": lin("dp", "tp"),
+        "wk": lin("dp", "tp"),
+        "wv": lin("dp", "tp"),
+        "wo": lin("tp", "dp"),
+        "mlp_norm": _ns(mesh),
+    }
+    if config.n_experts > 0:
+        from nos_tpu.models.quantize import QuantizedExpertStack
+
+        def stack(mid_axis, out_axis):
+            return QuantizedExpertStack(
+                q=_ns(mesh, "ep", mid_axis, out_axis),
+                scale=_ns(mesh, "ep", out_axis),
+            )
+
+        layer["moe"] = {
+            "router": _ns(mesh),
+            "w_gate": stack("dp", "tp"),
+            "w_up": stack("dp", "tp"),
+            "w_down": stack("tp", "dp"),
+        }
+    else:
+        layer["w_gate"] = lin("dp", "tp")
+        layer["w_up"] = lin("dp", "tp")
+        layer["w_down"] = lin("tp", "dp")
+    return {
+        "embed": QuantizedEmbedding(q=_ns(mesh, "tp", "dp"), scale=_ns(mesh, "tp")),
+        "final_norm": _ns(mesh),
+        "lm_head": lin("dp", "tp"),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+
+
 def llama_data_sharding(mesh: Mesh) -> NamedSharding:
     """Tokens [B, S]: batch over dp; sequence over sp when the mesh has it
     (ring attention consumes the same block distribution)."""

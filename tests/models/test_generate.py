@@ -193,6 +193,25 @@ class TestShardedServing:
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_tp_sharded_int8_generate_identically(self, setup):
+        """The serving matrix closes: int8-quantized weights shard over tp
+        with their scales along the output axis (dequant stays local), and
+        sharded quantized generation matches the unsharded quantized run
+        token for token."""
+        from nos_tpu.models.quantize import quantize_params
+        from nos_tpu.parallel.mesh import mesh_from_devices
+        from nos_tpu.parallel.sharding import llama_quantized_sharding
+
+        config, params, prompt = setup
+        qparams = quantize_params(params)
+        want = generate(qparams, prompt, config, max_new_tokens=6)
+        mesh = mesh_from_devices((1, 4), ("dp", "tp"), jax.devices()[:4])
+        sharded = jax.device_put(qparams, llama_quantized_sharding(mesh, config))
+        got = jax.jit(lambda p, t: generate(p, t, config, max_new_tokens=6))(
+            sharded, prompt
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
 
 class TestSamplingFilters:
     def test_top_k_one_equals_greedy(self, setup):
