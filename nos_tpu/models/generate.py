@@ -47,6 +47,16 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Cache:
     ]
 
 
+def _ffn(h: jax.Array, layer: Params, config: LlamaConfig) -> jax.Array:
+    """Dense MLP or routed MoE, matching llama_forward's block dispatch so
+    MoE checkpoints serve through the same cache path."""
+    if "moe" in layer:
+        from nos_tpu.models.moe import moe_mlp
+
+        return moe_mlp(layer["moe"], h, config.moe_config())
+    return _mlp(h, layer)
+
+
 def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None):
     """q [B, 1, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
     ``n_valid`` positions (a traced scalar). ``key_valid`` [B, T]
@@ -145,7 +155,7 @@ def prefill(
                 b, s, c.n_heads * hd
             )
         x = x + _mm(attn, layer["wo"])
-        x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
+        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     return _mm(x, params["lm_head"]).astype(jnp.float32), cache
 
@@ -195,7 +205,7 @@ def decode_step(
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
         x = x + _mm(attn, layer["wo"])
-        x = x + _mlp(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer)
+        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     return _mm(x[:, 0], params["lm_head"]).astype(jnp.float32), new_cache
 
