@@ -283,6 +283,32 @@ def run_tpu_child() -> None:
                 f"({result['int8_decode_speedup']}x, weights {ratio:.2f}x bytes)")
             del qparams
             snapshot()
+
+            # continuous batching: decode is weight-bandwidth-bound, so
+            # batched slots share each weight read — aggregate tok/s should
+            # approach slots x single-stream.
+            from nos_tpu.serve import Engine, GenRequest
+
+            slots, n_req, gen_len = 4, 8, 64
+            eng = Engine(params, config, max_slots=slots, max_len=256)
+            ids = [
+                eng.submit(GenRequest(prompt=[7] * 120, max_new_tokens=gen_len))
+                for _ in range(n_req)
+            ]
+            start = time.monotonic()
+            results = eng.run()
+            wall = time.monotonic() - start
+            total = sum(len(t) for t in results.values())
+            result["serve_slots"] = slots
+            result["serve_tokens_per_s"] = round(total / wall, 1)
+            result["serve_vs_single_stream"] = round(
+                (total / wall) / tok_s, 3
+            )
+            log(f"[tpu-child] engine: {total} tokens / {wall:.1f}s = "
+                f"{total/wall:.1f} tok/s across {slots} slots "
+                f"({result['serve_vs_single_stream']}x single-stream)")
+            del eng
+            snapshot()
         except Exception as e:
             log(f"[tpu-child] decode failed: {type(e).__name__}: {str(e)[:160]}")
 

@@ -70,7 +70,13 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_vali
         "bsKgh,btKh->bKgst", qg, cache_k, preferred_element_type=jnp.float32
     )
     scores = scores / math.sqrt(hd)
-    valid = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4) < n_valid
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4)
+    if getattr(n_valid, "ndim", 0) == 1:
+        # per-row frontier [B] (continuous batching: rows decode at
+        # different depths)
+        valid = iota < n_valid[:, None, None, None, None]
+    else:
+        valid = iota < n_valid
     if key_valid is not None:
         valid = valid & key_valid[:, None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
@@ -175,11 +181,18 @@ def decode_step(
     Left-padded batches decouple the two position notions: ``pos`` is the
     uniform physical slot (prompt length + step), while ``rope_pos`` [B]
     carries each row's LOGICAL position (real tokens seen so far);
-    ``key_valid`` [B, T] masks the pad slots out of attention."""
+    ``key_valid`` [B, T] masks the pad slots out of attention.
+
+    ``pos`` may also be per-row [B] (continuous batching: every slot
+    decodes at its own depth) — K/V writes become row scatters and the
+    attention frontier is per-row; rope defaults to ``pos`` itself."""
     c = config
     b = token.shape[0]
     hd = c.head_dim
+    per_row = getattr(pos, "ndim", 0) == 1
     x = _embed_rows(params["embed"], token, c.dtype)[:, None, :]  # [B, 1, D]
+    if rope_pos is None and per_row:
+        rope_pos = pos
     if rope_pos is None:
         cos, sin = _rope_at(pos[None], hd, c.rope_theta, c.dtype, c.rope_scaling)
         cos = cos[None, :, None, :]  # [1, 1, 1, hd/2]: broadcast over rows
@@ -192,6 +205,7 @@ def decode_step(
     def rope1(arr):  # arr [B, 1, H, hd]
         return _apply_rope(arr, cos, sin)
 
+    rows = jnp.arange(b)
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):
         h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
@@ -200,8 +214,12 @@ def decode_step(
         v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = rope1(q)
         k = rope1(k)
-        ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
+        if per_row:
+            ck = kv["k"].at[rows, pos].set(k[:, 0].astype(c.dtype))
+            cv = kv["v"].at[rows, pos].set(v[:, 0].astype(c.dtype))
+        else:
+            ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
         x = x + _mm(attn, layer["wo"])

@@ -425,6 +425,71 @@ class PodTopologySpreadFit:
         return Status.ok()
 
 
+class TaintTolerationScoring:
+    """PreferNoSchedule taints affect scoring, not filtering (the in-tree
+    TaintToleration score half the filter above deliberately ignores):
+    nodes with fewer untolerated soft taints score higher."""
+
+    name = "TaintTolerationScore"
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
+        untolerated = sum(
+            1
+            for taint in node_info.node.spec.taints
+            if taint.effect == "PreferNoSchedule"
+            and not any(t.tolerates(taint) for t in pod.spec.tolerations)
+        )
+        return max(0, 20 - 10 * untolerated)
+
+
+class PodTopologySpreadScoring:
+    """ScheduleAnyway topologySpreadConstraints (the soft half the filter
+    ignores): domains with fewer matching pods score higher, pulling new
+    replicas toward the emptiest domain without ever blocking placement.
+    Domain counts are computed once per cycle and cached in CycleState
+    (own key — the Fit plugin's cache covers DoNotSchedule constraints),
+    so each per-node score call is a dict lookup."""
+
+    name = "PodTopologySpreadScore"
+    _CACHE_KEY = "pod_topology_spread_score_counts"
+
+    def _domain_counts(self, state: CycleState, constraints) -> List[Dict[str, int]]:
+        cached = state.get(self._CACHE_KEY)
+        if cached is not None:
+            return cached
+        all_infos: Sequence[NodeInfo] = state.get(TOPOLOGY_NODE_INFOS_KEY) or []
+        computed = []
+        for c in constraints:
+            domains: Dict[str, int] = {}
+            for info in all_infos:
+                domain = info.node.metadata.labels.get(c.topology_key)
+                if domain is not None:
+                    domains[domain] = domains.get(domain, 0) + (
+                        PodTopologySpreadFit._matching(info, c)
+                    )
+            computed.append(domains)
+        state[self._CACHE_KEY] = computed
+        return computed
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
+        constraints = [
+            c
+            for c in pod.spec.topology_spread_constraints
+            if c.when_unsatisfiable == "ScheduleAnyway"
+        ]
+        if not constraints:
+            return 0
+        counts = self._domain_counts(state, constraints)
+        total = 0
+        for c, domains in zip(constraints, counts):
+            domain = node_info.node.metadata.labels.get(c.topology_key)
+            if domain is None:
+                continue
+            count = domains.get(domain, PodTopologySpreadFit._matching(node_info, c))
+            total += round(20 / (1 + count))
+        return total // len(constraints)
+
+
 def vanilla_filter_plugins() -> List[FilterPlugin]:
     """The in-tree predicate set both the real scheduler and the planner's
     embedded simulation run — keeping the two aligned is what prevents the

@@ -22,6 +22,8 @@ from nos_tpu.scheduler.framework import (
     CycleState,
     Framework,
     NodeInfo,
+    PodTopologySpreadScoring,
+    TaintTolerationScoring,
     TOPOLOGY_NODE_INFOS_KEY,
     vanilla_filter_plugins,
     Status,
@@ -49,7 +51,11 @@ def new_framework(
         post_filter_plugins=[capacity],
         reserve_plugins=[capacity],
         permit_plugins=[gang],
-        score_plugins=[IciTopologyScoring(store)],
+        score_plugins=[
+            IciTopologyScoring(store),
+            TaintTolerationScoring(),
+            PodTopologySpreadScoring(),
+        ],
     )
     capacity.framework = framework  # preemption re-runs the filters
     return framework, capacity, gang

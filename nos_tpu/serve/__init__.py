@@ -1,0 +1,1 @@
+from nos_tpu.serve.engine import Engine, GenRequest  # noqa: F401

@@ -1,0 +1,252 @@
+"""Continuous-batching serving engine.
+
+The slot-level scheduler a serving replica runs on its carved slice:
+requests with different prompt lengths and generation budgets share one
+fixed-shape batched decode program. A finishing request frees its slot
+mid-flight and the next queued request is admitted without draining the
+batch — decode utilization stays near the slot count instead of sawtoothing
+to the slowest member (the reference has no serving stack; this implements
+the workload the sharing demo and BASELINE's serving north star describe).
+
+TPU-first mechanics, all static shapes:
+- One KV cache of [slots, max_len, Hkv, hd] per layer; each row decodes at
+  its own depth via per-row scatter writes and a per-row attention
+  frontier (models/generate.decode_step with pos [B]).
+- Admission prefills a single row (left-padded to a power-of-two bucket,
+  one compiled prefill per bucket) and splices its K/V rows into the
+  batch cache at the free slot — running rows are untouched.
+- Decode is ONE jitted per-row step for all slots every tick; idle slots
+  ride along fully masked (their attention sees zero valid keys), so the
+  program never recompiles as traffic changes.
+- Multi-step scheduling: ``ticks_per_sync`` decode ticks run inside one
+  ``lax.scan`` dispatch before the host sees the tokens — dispatch/sync
+  latency (PCIe, or a whole network RTT on tunneled chips) amortizes over
+  the chunk instead of taxing every token. A request finishing mid-chunk
+  wastes at most ticks_per_sync-1 ticks of its own slot; its tokens are
+  trimmed host-side and the slot frees at the chunk boundary.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.generate import decode_step, prefill
+from nos_tpu.models.llama import LlamaConfig
+
+# Left-pad bucket: token id that can never appear in a real prompt.
+PAD_ID = -1
+
+
+@dataclass
+class GenRequest:
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    id: int = -1
+
+
+@dataclass
+class _Slot:
+    request: GenRequest
+    out: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+@dataclass
+class Completion:
+    id: int
+    tokens: List[int]
+
+
+class Engine:
+    """Greedy continuous-batching engine over a fixed slot count.
+
+    ``submit`` enqueues; ``step`` admits + decodes one tick; ``run`` drains
+    everything and returns completions keyed by request id.
+    """
+
+    def __init__(
+        self,
+        params,
+        config: LlamaConfig,
+        max_slots: int = 4,
+        max_len: int = 512,
+        ticks_per_sync: int = 8,
+    ) -> None:
+        self.params = params
+        self.config = config
+        self.slots_n = max_slots
+        self.max_len = max_len
+        self.ticks_per_sync = max(1, ticks_per_sync)
+        c = config
+        self._cache = [
+            {
+                "k": jnp.zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
+                "v": jnp.zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
+            }
+            for _ in range(c.n_layers)
+        ]
+        # Host-side control state (tiny; device round-trips once per tick).
+        self._pos = np.zeros(max_slots, np.int32)  # next physical write slot
+        self._rope = np.zeros(max_slots, np.int32)  # logical position (no pads)
+        self._key_valid = np.zeros((max_slots, max_len), bool)
+        self._last = np.zeros(max_slots, np.int32)
+        self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._queue: List[GenRequest] = []
+        self._done: List[Completion] = []
+        self._ids = itertools.count()
+        self.ticks = 0
+
+        ticks = self.ticks_per_sync
+
+        def _decode(params, cache, pos, last, rope, key_valid):
+            def tick(carry, _):
+                cache, pos, last, rope = carry
+                logits, cache = decode_step(
+                    params, cache, pos, last, config,
+                    rope_pos=rope, key_valid=key_valid,
+                )
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (cache, pos + 1, nxt, rope + 1), nxt
+
+            (cache, pos, last, rope), toks = jax.lax.scan(
+                tick, (cache, pos, last, rope), None, length=ticks
+            )
+            return toks, cache, pos, last, rope  # toks [ticks, B]
+
+        self._decode = jax.jit(_decode, donate_argnums=(1,))
+        self._prefill_cache: Dict[int, object] = {}
+
+    # ---------------------------------------------------------- frontend
+
+    def submit(self, request: GenRequest) -> int:
+        request.id = next(self._ids)
+        if len(request.prompt) > self.max_len:
+            # _bucket clamps to max_len, so the chunk math below would
+            # wave an over-long prompt through and crash mid-run instead.
+            raise ValueError(
+                f"prompt length {len(request.prompt)} > engine max_len "
+                f"{self.max_len}"
+            )
+        # Decode advances in whole chunks; a slot's physical frontier can
+        # reach bucket + ceil((max_new-1)/ticks)*ticks before it frees.
+        t = self.ticks_per_sync
+        chunks = -(-max(0, request.max_new_tokens - 1) // t)
+        need = self._bucket(len(request.prompt)) + chunks * t
+        if need > self.max_len:
+            raise ValueError(
+                f"request needs {need} cache slots (bucketed prompt + "
+                f"chunked decode) > engine max_len {self.max_len}"
+            )
+        self._queue.append(request)
+        return request.id
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain queue + slots; returns {request id: generated tokens}."""
+        while self._queue or any(s is not None for s in self._slots):
+            self.step()
+        out = {c.id: c.tokens for c in self._done}
+        self._done.clear()
+        return out
+
+    # ---------------------------------------------------------- scheduling
+
+    def _bucket(self, n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return min(b, self.max_len)
+
+    def _prefill_for(self, bucket: int):
+        """One compiled prefill per prompt-length bucket."""
+        if bucket not in self._prefill_cache:
+            cfg = self.config
+
+            def _pre(params, prompt):
+                logits, cache = prefill(params, prompt, cfg, bucket, pad_id=PAD_ID)
+                first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return first, cache
+
+            self._prefill_cache[bucket] = jax.jit(_pre)
+        return self._prefill_cache[bucket]
+
+    def _admit(self, b: int, request: GenRequest) -> None:
+        bucket = self._bucket(len(request.prompt))
+        pad = bucket - len(request.prompt)
+        padded = jnp.asarray(
+            [[PAD_ID] * pad + list(request.prompt)], jnp.int32
+        )
+        first, row_cache = self._prefill_for(bucket)(self.params, padded)
+        for layer, row in zip(self._cache, row_cache):
+            for key in ("k", "v"):
+                layer[key] = jax.lax.dynamic_update_slice(
+                    layer[key], row[key], (b, 0, 0, 0)
+                )
+        slot = _Slot(request=request)
+        self._slots[b] = slot
+        self._pos[b] = bucket
+        self._rope[b] = len(request.prompt)
+        self._key_valid[b, :pad] = False
+        self._key_valid[b, pad:] = True
+        self._last[b] = int(first[0])
+        self._emit(b, int(first[0]))
+
+    def _emit(self, b: int, token: int) -> None:
+        """Append one token; marks (but does not free) a finished slot —
+        chunk processing frees at the boundary."""
+        slot = self._slots[b]
+        slot.out.append(token)
+        req = slot.request
+        if len(slot.out) >= req.max_new_tokens or (
+            req.eos_id is not None and token == req.eos_id
+        ):
+            slot.done = True
+
+    # ------------------------------------------------------------- tick
+
+    def step(self) -> None:
+        """One scheduling round: admit into free slots, then run one
+        ticks_per_sync decode chunk in a single device dispatch."""
+        for b in range(self.slots_n):
+            if self._slots[b] is None and self._queue:
+                self._admit(b, self._queue.pop(0))
+            # Admission can satisfy a whole request (max_new_tokens=1, or
+            # an immediate EOS from prefill): free before decoding.
+            self._retire(b)
+        if not any(s is not None for s in self._slots):
+            return
+        self.ticks += 1
+        toks, self._cache, _, _, _ = self._decode(
+            self.params,
+            self._cache,
+            jnp.asarray(self._pos),
+            jnp.asarray(self._last),
+            jnp.asarray(self._rope),
+            jnp.asarray(self._key_valid),
+        )
+        tokens = np.asarray(toks)  # [ticks_per_sync, B]
+        ticks = tokens.shape[0]
+        # Host state mirrors the device chunk exactly: every row advanced
+        # `ticks` positions whether its tenant needed them or not.
+        self._pos += ticks
+        self._rope += ticks
+        self._last = tokens[-1].astype(np.int32).copy()
+        for b in range(self.slots_n):
+            if self._slots[b] is None:
+                continue
+            for j in range(ticks):
+                if self._slots[b].done:
+                    break
+                self._emit(b, int(tokens[j, b]))
+            self._retire(b)
+
+    def _retire(self, b: int) -> None:
+        slot = self._slots[b]
+        if slot is not None and slot.done:
+            self._done.append(Completion(id=slot.request.id, tokens=slot.out))
+            self._slots[b] = None

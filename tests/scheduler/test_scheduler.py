@@ -394,3 +394,73 @@ class TestVanillaPredicates:
         sched_pod(s, store, self._spread_pod("web-new"))
         # Nodes without the topology key cannot host DoNotSchedule spreads.
         assert store.get("Pod", "web-new", "default").spec.node_name == "n-zoned"
+
+
+class TestSoftScoring:
+    def test_prefer_no_schedule_steers_away_when_alternative_exists(self):
+        from nos_tpu.kube.objects import Taint
+
+        store = KubeStore()
+        soft = build_node("n-soft", alloc={"cpu": 8})
+        soft.spec.taints = [Taint(key="spot", effect="PreferNoSchedule")]
+        store.create(soft)
+        store.create(build_node("n-clean", alloc={"cpu": 8}))
+        s = make_scheduler(store)
+        sched_pod(s, store, build_pod("p", {"cpu": 2}))
+        # both pass the filter; the soft taint demotes n-soft in scoring
+        assert store.get("Pod", "p", "default").spec.node_name == "n-clean"
+
+    def test_schedule_anyway_spread_prefers_empty_zone_without_blocking(self):
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        store = KubeStore()
+        for name, zone in (("n-a", "zone-a"), ("n-b", "zone-b")):
+            node = build_node(name, alloc={"cpu": 8})
+            node.metadata.labels["topology.kubernetes.io/zone"] = zone
+            store.create(node)
+        # Crowd zone-b: the scheduler's name tiebreak alone would pick n-b
+        # (max on names), so the assertion below only holds when the spread
+        # scorer actually demotes the crowded zone.
+        for i in range(2):
+            running = build_pod(f"web-{i}", {"cpu": 1}, node="n-b", phase=PodPhase.RUNNING)
+            running.metadata.labels["app"] = "web"
+            store.create(running)
+        s = make_scheduler(store)
+        pod = build_pod("web-new", {"cpu": 1})
+        pod.metadata.labels["app"] = "web"
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                topology_key="topology.kubernetes.io/zone",
+                max_skew=1,
+                when_unsatisfiable="ScheduleAnyway",
+                match_labels={"app": "web"},
+            )
+        ]
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "web-new", "default").spec.node_name == "n-a"
+
+    def test_schedule_anyway_never_blocks_single_zone(self):
+        from nos_tpu.kube.objects import TopologySpreadConstraint
+
+        store = KubeStore()
+        node = build_node("n-a", alloc={"cpu": 8})
+        node.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        store.create(node)
+        for i in range(3):
+            running = build_pod(f"web-{i}", {"cpu": 1}, node="n-a", phase=PodPhase.RUNNING)
+            running.metadata.labels["app"] = "web"
+            store.create(running)
+        s = make_scheduler(store)
+        pod = build_pod("web-new", {"cpu": 1})
+        pod.metadata.labels["app"] = "web"
+        pod.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                topology_key="topology.kubernetes.io/zone",
+                max_skew=1,
+                when_unsatisfiable="ScheduleAnyway",
+                match_labels={"app": "web"},
+            )
+        ]
+        sched_pod(s, store, pod)
+        # soft constraint: heavily skewed but the only node still binds
+        assert store.get("Pod", "web-new", "default").spec.node_name == "n-a"
