@@ -58,22 +58,26 @@ def _ffn(h: jax.Array, layer: Params, config: LlamaConfig) -> jax.Array:
 
 
 def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None):
-    """q [B, 1, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
-    ``n_valid`` positions (a traced scalar). ``key_valid`` [B, T]
-    additionally masks slots that hold padding (left-padded batches)."""
+    """q [B, S, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
+    ``n_valid`` positions. ``n_valid`` may be a scalar (one shared
+    frontier), [B] (per-row frontiers — continuous batching), or [B, S]
+    (per-query frontiers — multi-token chunk decode, where query i sees
+    keys [0, pos+i+1)). ``key_valid`` [B, T] additionally masks slots
+    that hold padding (left-padded batches)."""
     c = config
-    b, _, hq, hd = q.shape
+    b, s, hq, hd = q.shape
     t = cache_k.shape[1]
     group = c.n_heads // c.n_kv_heads
-    qg = q.reshape(b, 1, c.n_kv_heads, group, hd)
+    qg = q.reshape(b, s, c.n_kv_heads, group, hd)
     scores = jnp.einsum(
         "bsKgh,btKh->bKgst", qg, cache_k, preferred_element_type=jnp.float32
     )
     scores = scores / math.sqrt(hd)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4)
-    if getattr(n_valid, "ndim", 0) == 1:
-        # per-row frontier [B] (continuous batching: rows decode at
-        # different depths)
+    ndim = getattr(n_valid, "ndim", 0)
+    if ndim == 2:
+        valid = iota < n_valid[:, None, None, :, None]
+    elif ndim == 1:
         valid = iota < n_valid[:, None, None, None, None]
     else:
         valid = iota < n_valid
@@ -82,7 +86,7 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_vali
     scores = jnp.where(valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bKgst,btKh->bsKgh", probs, cache_v)
-    return out.reshape(b, 1, c.n_heads * hd)
+    return out.reshape(b, s, c.n_heads * hd)
 
 
 def prefill(
@@ -226,6 +230,65 @@ def decode_step(
         x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     return _mm(x[:, 0], params["lm_head"]).astype(jnp.float32), new_cache
+
+
+def decode_chunk(
+    params: Params,
+    cache: Cache,
+    pos: jax.Array,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    write_mask: jax.Array = None,
+) -> Tuple[jax.Array, Cache]:
+    """``m`` tokens at per-row physical slots ``pos``..``pos+m-1`` →
+    (logits [B, m, vocab], cache with the chunk's K/V written).
+
+    The multi-token generalization of decode_step: query i attends the
+    cache frontier [0, pos+i+1) — causal within the chunk, everything
+    before it outside. One dispatch verifies a whole speculative draft or
+    ingests a prompt chunk (chunked prefill) at O(m·T) instead of m
+    sequential O(T) steps.
+
+    ``pos`` is [B] (per-row, like the engine's decode). ``write_mask``
+    [B, m] skips K/V writes for padding positions by redirecting them to
+    the cache's LAST slot — callers using it must size the cache with a
+    sacrificial trailing slot their frontier never reaches.
+    """
+    c = config
+    b, m = tokens.shape
+    hd = c.head_dim
+    x = _embed_rows(params["embed"], tokens, c.dtype)  # [B, m, D]
+    offsets = jnp.arange(m, dtype=pos.dtype)
+    posmat = pos[:, None] + offsets[None, :]  # [B, m]
+    cos, sin = _rope_at(
+        posmat.reshape(-1), hd, c.rope_theta, c.dtype, c.rope_scaling
+    )
+    cos = cos.reshape(b, m, 1, -1)
+    sin = sin.reshape(b, m, 1, -1)
+    t_cache = cache[0]["k"].shape[1]
+    if write_mask is not None:
+        write_pos = jnp.where(write_mask, posmat, t_cache - 1)
+    else:
+        write_pos = posmat
+    rows = jnp.arange(b)[:, None]
+    frontier = posmat + 1  # [B, m]: query i sees keys < pos+i+1
+
+    new_cache: Cache = []
+    for layer, kv in zip(params["layers"], cache):
+        h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _mm(h, layer["wq"]).reshape(b, m, c.n_heads, hd)
+        k = _mm(h, layer["wk"]).reshape(b, m, c.n_kv_heads, hd)
+        v = _mm(h, layer["wv"]).reshape(b, m, c.n_kv_heads, hd)
+        q = _apply_rope(q, cos, sin)
+        k = _apply_rope(k, cos, sin)
+        ck = kv["k"].at[rows, write_pos].set(k.astype(c.dtype))
+        cv = kv["v"].at[rows, write_pos].set(v.astype(c.dtype))
+        new_cache.append({"k": ck, "v": cv})
+        attn = _cache_attention(q, ck, cv, frontier, c)
+        x = x + _mm(attn, layer["wo"])
+        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    return _mm(x, params["lm_head"]).astype(jnp.float32), new_cache
 
 
 def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:

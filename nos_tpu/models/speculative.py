@@ -1,0 +1,167 @@
+"""Speculative decoding: draft-model lookahead with exact target outputs.
+
+Decode is HBM-bandwidth-bound — each sequential token re-reads the target's
+full weight set. A small draft model proposes ``k`` tokens autoregressively
+(cheap weight reads), and the target verifies all k in ONE multi-token
+``decode_chunk`` (one full-weight read for up to k+1 committed tokens).
+Greedy acceptance commits only tokens that match the target's own argmax
+(the first mismatch is replaced by the target's token — the "bonus"), so
+the output equals the target's greedy sequence up to one numeric caveat:
+the chunked verify accumulates in a different order than stepwise decode,
+and an argmax whose top-2 gap is below that float drift can flip. The
+tests pin token-identity on the shipped configs; a good draft only adds
+speed, a bad one only costs it.
+
+Per round, all inside one jitted dispatch with donated caches:
+  1. draft scans k steps from the last committed token,
+  2. target verifies [last, d_1..d_k] in one chunk,
+  3. acceptance = longest matching prefix; positions advance per row,
+  4. one extra draft step ingests d_k's K/V so the draft cache invariant
+     (holds every committed token but the last) survives full acceptance.
+Stale K/V beyond a row's frontier is never attended (the frontier only
+unmasks written history, and rewinds overwrite before they re-expose), so
+rejection "rollback" is just a position decrement — no cache copies.
+
+Throughput gain ≈ (mean accepted + 1) / (1 + (k+1)·draft/target cost
+ratio) — k scan steps plus the d_k ingest; with a well-matched draft,
+several target tokens per full-weight read.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.generate import decode_chunk, decode_step, prefill
+from nos_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, object]
+
+
+def _spec_round(
+    t_params, d_params, t_config: LlamaConfig, d_config: LlamaConfig, k: int
+):
+    """Builds the jitted one-round function (closure over static configs)."""
+
+    def round_fn(t_cache, d_cache, pos, last):
+        b = last.shape[0]
+
+        # 1. draft k tokens (writes K/V for [last, d_1..d_{k-1}])
+        def draft_tick(carry, _):
+            cache, p, tok = carry
+            logits, cache = decode_step(d_params, cache, p, tok, d_config)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, p + 1, nxt), nxt
+
+        (d_cache, _, _), drafts = jax.lax.scan(
+            draft_tick, (d_cache, pos, last), None, length=k
+        )
+        drafts = jnp.moveaxis(drafts, 0, 1)  # [B, k]
+
+        # 2. target verifies the whole chain in one chunk
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # [B, k+1]
+        logits, t_cache = decode_chunk(t_params, t_cache, pos, chunk, t_config)
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+
+        # 3. longest matching prefix: accept while d_{i+1} == t_i
+        match = drafts == targets[:, :k]  # [B, k]
+        accepted = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((b, 1), bool)], axis=1), axis=1
+        ).astype(jnp.int32)  # [B]: k if all matched
+        # committed tokens this round: d_1..d_a then the target's bonus
+        idx = jnp.arange(k + 1)[None, :]
+        bonus = jnp.take_along_axis(targets, accepted[:, None], axis=1)[:, 0]
+        drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))  # [B, k+1]
+        out = jnp.where(
+            idx < accepted[:, None],
+            drafts_pad,
+            jnp.where(idx == accepted[:, None], bonus[:, None], 0),
+        )  # [B, k+1]; rows valid through accepted+1 tokens
+        count = accepted + 1
+
+        # 4. ingest d_k's K/V so full acceptance leaves no draft-cache hole
+        _, d_cache = decode_step(d_params, d_cache, pos + k, drafts[:, -1], d_config)
+
+        return t_cache, d_cache, pos + count, bonus, drafts, out, count
+
+    return round_fn
+
+
+def speculative_generate(
+    target_params: Params,
+    draft_params: Params,
+    prompt: jax.Array,
+    target_config: LlamaConfig,
+    draft_config: LlamaConfig,
+    max_new_tokens: int,
+    k: int = 4,
+    eos_id: Optional[int] = None,
+) -> Tuple[jax.Array, dict]:
+    """prompt [B, S] → (tokens [B, max_new_tokens], stats).
+
+    Greedy speculative decoding; output matches
+    ``generate(target_params, ...)`` up to the chunk-vs-step float drift
+    described in the module docstring (token-identical on the pinned test
+    configs). ``stats`` reports rounds and mean accepted drafts per
+    active row-round — rows that finished (eos/max) are excluded from
+    both numerator and denominator. Finished rows keep riding the batch;
+    their surplus is trimmed host-side, and with ``eos_id`` rows are
+    padded with it after their first EOS.
+    """
+    b, s = prompt.shape
+    max_len = s + max_new_tokens + k + 2  # chunk overshoot + draft ingest margin
+    t_logits, t_cache = prefill(target_params, prompt, target_config, max_len)
+    _, d_cache = prefill(draft_params, prompt, draft_config, max_len)
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+
+    round_fn = jax.jit(
+        _spec_round(target_params, draft_params, target_config, draft_config, k),
+        donate_argnums=(0, 1),
+    )
+
+    pos = jnp.full((b,), s, jnp.int32)
+    last = first
+    rows: List[List[int]] = [[int(first[i])] for i in range(b)]
+    done = [
+        eos_id is not None and rows[i][0] == eos_id for i in range(b)
+    ]
+    rounds = 0
+    accepted_total = 0
+    active_row_rounds = 0
+    while not all(
+        len(r) >= max_new_tokens or d for r, d in zip(rows, done)
+    ):
+        active = [
+            not d and len(r) < max_new_tokens for r, d in zip(rows, done)
+        ]
+        t_cache, d_cache, pos, last, _, out, count = round_fn(
+            t_cache, d_cache, pos, last
+        )
+        rounds += 1
+        out_np = np.asarray(out)
+        count_np = np.asarray(count)
+        for i in range(b):
+            if not active[i]:
+                # finished rows ride the batch but their garbage
+                # acceptance must not pollute the stats
+                continue
+            active_row_rounds += 1
+            accepted_total += int(count_np[i]) - 1  # drafts only, minus bonus
+            for j in range(int(count_np[i])):
+                if len(rows[i]) >= max_new_tokens:
+                    break
+                tok = int(out_np[i, j])
+                rows[i].append(tok)
+                if eos_id is not None and tok == eos_id:
+                    done[i] = True
+                    break
+    for i in range(b):
+        fill = eos_id if (eos_id is not None and done[i]) else 0
+        rows[i] = (rows[i] + [fill] * max_new_tokens)[:max_new_tokens]
+    stats = {
+        "rounds": rounds,
+        "mean_accepted": accepted_total / max(1, active_row_rounds),
+    }
+    return jnp.asarray(rows, jnp.int32), stats

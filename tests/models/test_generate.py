@@ -303,3 +303,55 @@ class TestMoeServing:
         out = generate(quantize_params(params), prompt=jnp.zeros((1, 4), jnp.int32),
                        config=config, max_new_tokens=4)
         assert out.shape == (1, 4)
+
+
+class TestDecodeChunk:
+    def test_chunk_matches_sequential_decode_steps(self, setup):
+        """decode_chunk(m tokens) == m sequential decode_steps: same
+        logits at every position, same cache contents."""
+        from nos_tpu.models.generate import decode_chunk
+
+        config, params, prompt = setup
+        b, s = prompt.shape
+        m = 4
+        _, cache_a = prefill(params, prompt, config, max_len=s + m)
+        _, cache_b = prefill(params, prompt, config, max_len=s + m)
+        extra = jax.random.randint(jax.random.key(21), (b, m), 0, config.vocab_size)
+
+        chunk_logits, cache_a = decode_chunk(
+            params, cache_a, jnp.full((b,), s, jnp.int32), extra, config
+        )
+        for i in range(m):
+            step_logits, cache_b = decode_step(
+                params, cache_b, jnp.asarray(s + i), extra[:, i], config
+            )
+            np.testing.assert_allclose(
+                np.asarray(chunk_logits[:, i]), np.asarray(step_logits),
+                atol=2e-2, err_msg=f"position {i}",
+            )
+        for la, lb in zip(cache_a, cache_b):
+            np.testing.assert_allclose(
+                np.asarray(la["k"], np.float32), np.asarray(lb["k"], np.float32),
+                atol=1e-2,
+            )
+
+    def test_write_mask_redirects_to_trash_slot(self, setup):
+        from nos_tpu.models.generate import decode_chunk, init_kv_cache
+
+        config, params, prompt = setup
+        b, s = prompt.shape
+        m = 4
+        # +1 sacrificial trailing slot
+        _, cache = prefill(params, prompt, config, max_len=s + m + 1)
+        before = np.asarray(cache[0]["k"]).copy()
+        mask = jnp.asarray([[True, True, False, False]] * b)
+        extra = jax.random.randint(jax.random.key(22), (b, m), 0, config.vocab_size)
+        _, cache = decode_chunk(
+            params, cache, jnp.full((b,), s, jnp.int32), extra, config,
+            write_mask=mask,
+        )
+        after = np.asarray(cache[0]["k"])
+        # masked positions s+2, s+3 unchanged; writes landed at s, s+1, trash
+        np.testing.assert_array_equal(after[:, s + 2], before[:, s + 2])
+        np.testing.assert_array_equal(after[:, s + 3], before[:, s + 3])
+        assert not np.array_equal(after[:, s], before[:, s])

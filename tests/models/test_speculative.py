@@ -1,0 +1,69 @@
+"""Speculative decoding vs plain greedy generation.
+
+The whole point is EXACTNESS: whatever the draft proposes, the committed
+sequence equals the target's own greedy output — a perfect draft only
+makes it faster, a terrible draft only makes it slower.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.generate import generate
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = tiny_config()
+    target = init_llama_params(jax.random.key(0), config)
+    draft_cfg = tiny_config(n_layers=1)
+    draft = init_llama_params(jax.random.key(7), draft_cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, config.vocab_size)
+    return config, target, draft_cfg, draft, prompt
+
+
+class TestSpeculativeExactness:
+    def test_perfect_draft_matches_and_accepts_everything(self, setup):
+        """Draft == target: every proposal accepted, output still exact."""
+        config, target, _, _, prompt = setup
+        want = np.asarray(generate(target, prompt, config, max_new_tokens=10))
+        got, stats = speculative_generate(
+            target, target, prompt, config, config, max_new_tokens=10, k=4
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert stats["mean_accepted"] == pytest.approx(4.0), stats
+
+    def test_unrelated_draft_still_exact(self, setup):
+        """A draft that knows nothing about the target still yields the
+        target's exact greedy tokens — only the acceptance rate drops."""
+        config, target, draft_cfg, draft, prompt = setup
+        want = np.asarray(generate(target, prompt, config, max_new_tokens=10))
+        got, stats = speculative_generate(
+            target, draft, prompt, config, draft_cfg, max_new_tokens=10, k=4
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert 0.0 <= stats["mean_accepted"] <= 4.0
+
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_exact_for_any_lookahead(self, setup, k):
+        config, target, draft_cfg, draft, prompt = setup
+        want = np.asarray(generate(target, prompt, config, max_new_tokens=7))
+        got, _ = speculative_generate(
+            target, draft, prompt, config, draft_cfg, max_new_tokens=7, k=k
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_eos_freezes_rows(self, setup):
+        config, target, draft_cfg, draft, prompt = setup
+        free = np.asarray(generate(target, prompt, config, max_new_tokens=8))
+        eos = int(free[0, 2])
+        want = np.asarray(
+            generate(target, prompt, config, max_new_tokens=8, eos_id=eos)
+        )
+        got, _ = speculative_generate(
+            target, draft, prompt, config, draft_cfg,
+            max_new_tokens=8, k=3, eos_id=eos,
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
