@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nos_tpu.models.generate import decode_step, prefill
+from nos_tpu.models.generate import decode_chunk, decode_step, prefill
 from nos_tpu.models.llama import LlamaConfig
 
 # Left-pad bucket: token id that can never appear in a real prompt.
@@ -77,12 +77,17 @@ class Engine:
         max_slots: int = 4,
         max_len: int = 512,
         ticks_per_sync: int = 8,
+        prefill_chunk: int = 256,
     ) -> None:
         self.params = params
         self.config = config
         self.slots_n = max_slots
         self.max_len = max_len
         self.ticks_per_sync = max(1, ticks_per_sync)
+        # Prompts whose bucket exceeds this ingest via fixed-size
+        # decode_chunk pieces (O(chunk x T) peak attention memory instead
+        # of the one-shot prefill's O(bucket^2)).
+        self.prefill_chunk = max(8, prefill_chunk)
         c = config
         self._cache = [
             {
@@ -122,6 +127,13 @@ class Engine:
         self._decode = jax.jit(_decode, donate_argnums=(1,))
         self._prefill_cache: Dict[int, object] = {}
 
+        def _ingest(params, row_cache, start, piece, mask):
+            return decode_chunk(
+                params, row_cache, start, piece, config, write_mask=mask
+            )
+
+        self._ingest = jax.jit(_ingest, donate_argnums=(1,))
+
     # ---------------------------------------------------------- frontend
 
     def submit(self, request: GenRequest) -> int:
@@ -134,10 +146,16 @@ class Engine:
                 f"{self.max_len}"
             )
         # Decode advances in whole chunks; a slot's physical frontier can
-        # reach bucket + ceil((max_new-1)/ticks)*ticks before it frees.
+        # reach the admission frontier + ceil((max_new-1)/ticks)*ticks
+        # before it frees. The admission frontier is the pow2 bucket on
+        # the padded-prefill path but the RAW length on the chunked path
+        # (no left pad) — using the bucket there would reject exactly the
+        # long prompts chunked admission exists for.
         t = self.ticks_per_sync
         chunks = -(-max(0, request.max_new_tokens - 1) // t)
-        need = self._bucket(len(request.prompt)) + chunks * t
+        bucket = self._bucket(len(request.prompt))
+        frontier = len(request.prompt) if bucket > self.prefill_chunk else bucket
+        need = frontier + chunks * t
         if need > self.max_len:
             raise ValueError(
                 f"request needs {need} cache slots (bucketed prompt + "
@@ -177,6 +195,9 @@ class Engine:
 
     def _admit(self, b: int, request: GenRequest) -> None:
         bucket = self._bucket(len(request.prompt))
+        if bucket > self.prefill_chunk:
+            self._admit_chunked(b, request)
+            return
         pad = bucket - len(request.prompt)
         padded = jnp.asarray(
             [[PAD_ID] * pad + list(request.prompt)], jnp.int32
@@ -195,6 +216,47 @@ class Engine:
         self._key_valid[b, pad:] = True
         self._last[b] = int(first[0])
         self._emit(b, int(first[0]))
+
+    def _admit_chunked(self, b: int, request: GenRequest) -> None:
+        """Long-prompt admission: ingest the prompt through fixed-size
+        decode_chunk pieces into a fresh single-row cache (positions
+        [0, L), no left pad — the final RIGHT-padded piece masks its
+        writes to the row cache's sacrificial trailing slot), then splice
+        the row into the batch cache."""
+        from nos_tpu.models.generate import init_kv_cache
+
+        c = self.config
+        n = self.prefill_chunk
+        prompt = list(request.prompt)
+        length = len(prompt)
+        row_cache = init_kv_cache(c, 1, self.max_len + 1)
+        logits = None
+        for start in range(0, length, n):
+            piece = prompt[start:start + n]
+            real = len(piece)
+            piece = piece + [0] * (n - real)
+            mask = jnp.asarray([[True] * real + [False] * (n - real)])
+            logits, row_cache = self._ingest(
+                self.params,
+                row_cache,
+                jnp.asarray([start], jnp.int32),
+                jnp.asarray([piece], jnp.int32),
+                mask,
+            )
+        last_idx = (length - 1) % n
+        first = int(jnp.argmax(logits[0, last_idx]))
+        for layer, row in zip(self._cache, row_cache):
+            for key in ("k", "v"):
+                layer[key] = jax.lax.dynamic_update_slice(
+                    layer[key], row[key][:, : self.max_len], (b, 0, 0, 0)
+                )
+        slot = _Slot(request=request)
+        self._slots[b] = slot
+        self._pos[b] = length
+        self._rope[b] = length
+        self._key_valid[b, :] = True
+        self._last[b] = first
+        self._emit(b, first)
 
     def _emit(self, b: int, token: int) -> None:
         """Append one token; marks (but does not free) a finished slot —

@@ -96,6 +96,33 @@ class TestEngineParity:
         with pytest.raises(ValueError):
             eng.submit(GenRequest(prompt=[1] * 40, max_new_tokens=1))
 
+    def test_chunked_prefill_matches_solo(self, setup):
+        """Long prompts admit via fixed-size decode_chunk pieces (no
+        one-shot prefill, no left pad); tokens must still be identical to
+        solo generation. Lengths cover mid-chunk, exact-multiple, and
+        shorter-than-chunk (which takes the padded prefill path)."""
+        config, params = setup
+        eng = Engine(params, config, max_slots=2, max_len=64, prefill_chunk=8)
+        prompts = [
+            rand_prompt(jax.random.key(50 + i), n, config.vocab_size)
+            for i, n in enumerate((10, 16, 21, 5))
+        ]
+        ids = [eng.submit(GenRequest(prompt=p, max_new_tokens=5)) for p in prompts]
+        results = eng.run()
+        for rid, p in zip(ids, prompts):
+            assert results[rid] == solo(params, config, p, 5), f"request {rid}"
+
+    def test_long_prompt_capacity_uses_raw_length_not_bucket(self, setup):
+        """A prompt past max_len/2 must still admit on the chunked path:
+        its frontier is the raw length, not the power-of-two bucket."""
+        config, params = setup
+        eng = Engine(params, config, max_slots=1, max_len=64, prefill_chunk=8,
+                     ticks_per_sync=4)
+        p = rand_prompt(jax.random.key(60), 40, config.vocab_size)  # bucket=64
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=5))  # 40+8 <= 64
+        results = eng.run()
+        assert results[rid] == solo(params, config, p, 5)
+
     def test_quantized_engine_runs(self, setup):
         from nos_tpu.models.quantize import quantize_params
 
