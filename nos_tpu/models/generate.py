@@ -291,30 +291,63 @@ def decode_chunk(
     return _mm(x, params["lm_head"]).astype(jnp.float32), new_cache
 
 
+def _nucleus_cutoff(sorted_desc: jax.Array, top_p) -> jax.Array:
+    """THE nucleus rule, shared by the static and per-row filters: given
+    descending-sorted logits [..., V] and a broadcastable top_p, returns
+    the per-row cutoff logit. Mass strictly ABOVE each rank: rank is kept
+    while that mass < p, which keeps the first token whose inclusion
+    crosses p. Rank 0 is kept unconditionally so top_p <= 0 degrades to
+    greedy instead of masking the whole vocabulary (categorical over
+    all--inf silently returns token 0)."""
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = (mass_before < top_p).at[..., 0].set(True)
+    return jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+
+
 def _filter_logits(logits: jax.Array, top_k: int, top_p: float) -> jax.Array:
-    """Standard sampling filters, static-shape throughout (jit-stable):
-    top-k keeps the k highest logits; nucleus (top-p) keeps the smallest
-    prefix of the probability-sorted vocabulary whose mass reaches p (the
-    first token crossing the threshold is kept). Masked entries go to -inf
-    so ``jax.random.categorical`` never draws them."""
+    """Standard sampling filters with STATIC parameters (jit-stable for
+    generate's scalar arguments): top-k keeps the k highest logits;
+    nucleus (top-p) keeps the smallest prefix of the probability-sorted
+    vocabulary whose mass reaches p. Masked entries go to -inf so
+    ``jax.random.categorical`` never draws them."""
     if top_k and 0 < top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
         sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        # mass strictly ABOVE each rank: rank is kept while that mass < p,
-        # which keeps the first token whose inclusion crosses p. Rank 0 is
-        # kept unconditionally so top_p <= 0 degrades to greedy instead of
-        # masking the whole vocabulary (categorical over all--inf silently
-        # returns token 0).
-        mass_before = jnp.cumsum(probs, axis=-1) - probs
-        keep = (mass_before < top_p).at[..., 0].set(True)
-        cutoff = jnp.min(
-            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
-        )
+        cutoff = _nucleus_cutoff(sorted_desc, top_p)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return logits
+
+
+def pick_tokens_per_row(
+    logits: jax.Array,
+    temp: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    keys: jax.Array,
+) -> jax.Array:
+    """Per-row next token for mixed batches (continuous batching): greedy
+    where temp == 0, otherwise temperature sampling with per-row TRACED
+    top-k / nucleus parameters and per-row PRNG keys [B] — each row's
+    stream depends only on its own key sequence, never on its slot index
+    or co-tenants. One descending sort serves both filters (masking below
+    the k-th value preserves the order)."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.where(temp > 0, temp, 1.0)[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    kth = jnp.where((top_k > 0)[:, None], kth, -jnp.inf)
+    filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # top-k masking keeps descending order: reuse the sort for the nucleus
+    sorted2 = jnp.where(sorted_desc < kth, -jnp.inf, sorted_desc)
+    cutoff = _nucleus_cutoff(sorted2, top_p[:, None])
+    filtered = jnp.where(filtered < cutoff, -jnp.inf, filtered)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
 
 
 def generate(

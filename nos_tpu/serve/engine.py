@@ -35,7 +35,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nos_tpu.models.generate import decode_chunk, decode_step, prefill
+from nos_tpu.models.generate import (
+    decode_chunk,
+    decode_step,
+    pick_tokens_per_row,
+    prefill,
+)
 from nos_tpu.models.llama import LlamaConfig
 
 # Left-pad bucket: token id that can never appear in a real prompt.
@@ -47,6 +52,14 @@ class GenRequest:
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    # Sampling (per request, rows mix freely in one batch): greedy when
+    # temperature == 0; otherwise temperature sampling with optional
+    # top-k / nucleus filtering. Sampled streams draw from the engine's
+    # key sequence, so they are reproducible per (engine seed, request
+    # id) but not bitwise equal to a solo generate() run.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
     id: int = -1
 
 
@@ -78,6 +91,7 @@ class Engine:
         max_len: int = 512,
         ticks_per_sync: int = 8,
         prefill_chunk: int = 256,
+        seed: int = 0,
     ) -> None:
         self.params = params
         self.config = config
@@ -101,6 +115,16 @@ class Engine:
         self._rope = np.zeros(max_slots, np.int32)  # logical position (no pads)
         self._key_valid = np.zeros((max_slots, max_len), bool)
         self._last = np.zeros(max_slots, np.int32)
+        self._temp = np.zeros(max_slots, np.float32)
+        self._topk = np.zeros(max_slots, np.int32)
+        self._topp = np.ones(max_slots, np.float32)
+        # Per-slot PRNG streams: a request's key chain is derived ONLY from
+        # (engine seed, request id), so its sampled tokens are reproducible
+        # regardless of co-tenants, slot placement, or arrival order.
+        self._base_key = jax.random.key(seed)
+        self._row_keys = jax.vmap(
+            lambda i: jax.random.fold_in(jax.random.key(seed), i)
+        )(jnp.arange(max_slots))
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._queue: List[GenRequest] = []
         self._done: List[Completion] = []
@@ -109,7 +133,7 @@ class Engine:
 
         ticks = self.ticks_per_sync
 
-        def _decode(params, cache, pos, last, rope, key_valid):
+        def _decode_greedy(params, cache, pos, last, rope, key_valid):
             def tick(carry, _):
                 cache, pos, last, rope = carry
                 logits, cache = decode_step(
@@ -119,12 +143,33 @@ class Engine:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (cache, pos + 1, nxt, rope + 1), nxt
 
-            (cache, pos, last, rope), toks = jax.lax.scan(
+            (cache, _, _, _), toks = jax.lax.scan(
                 tick, (cache, pos, last, rope), None, length=ticks
             )
-            return toks, cache, pos, last, rope  # toks [ticks, B]
+            return toks, cache  # toks [ticks, B]
 
-        self._decode = jax.jit(_decode, donate_argnums=(1,))
+        def _decode_sampled(
+            params, cache, pos, last, rope, key_valid, temp, topk, topp, keys
+        ):
+            def tick(carry, _):
+                cache, pos, last, rope, keys = carry
+                logits, cache = decode_step(
+                    params, cache, pos, last, config,
+                    rope_pos=rope, key_valid=key_valid,
+                )
+                both = jax.vmap(jax.random.split)(keys)  # [B, 2] keys
+                nxt = pick_tokens_per_row(logits, temp, topk, topp, both[:, 1])
+                return (cache, pos + 1, nxt, rope + 1, both[:, 0]), nxt
+
+            (cache, _, _, _, keys), toks = jax.lax.scan(
+                tick, (cache, pos, last, rope, keys), None, length=ticks
+            )
+            return toks, cache, keys
+
+        # Two programs so the default all-greedy workload never pays the
+        # sampling sorts; step() picks by whether any live slot samples.
+        self._decode_greedy = jax.jit(_decode_greedy, donate_argnums=(1,))
+        self._decode_sampled = jax.jit(_decode_sampled, donate_argnums=(1,))
         self._prefill_cache: Dict[int, object] = {}
 
         def _ingest(params, row_cache, start, piece, mask):
@@ -188,7 +233,7 @@ class Engine:
             def _pre(params, prompt):
                 logits, cache = prefill(params, prompt, cfg, bucket, pad_id=PAD_ID)
                 first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return first, cache
+                return first, logits[:, -1], cache
 
             self._prefill_cache[bucket] = jax.jit(_pre)
         return self._prefill_cache[bucket]
@@ -202,7 +247,7 @@ class Engine:
         padded = jnp.asarray(
             [[PAD_ID] * pad + list(request.prompt)], jnp.int32
         )
-        first, row_cache = self._prefill_for(bucket)(self.params, padded)
+        first, first_logits, row_cache = self._prefill_for(bucket)(self.params, padded)
         for layer, row in zip(self._cache, row_cache):
             for key in ("k", "v"):
                 layer[key] = jax.lax.dynamic_update_slice(
@@ -214,8 +259,10 @@ class Engine:
         self._rope[b] = len(request.prompt)
         self._key_valid[b, :pad] = False
         self._key_valid[b, pad:] = True
-        self._last[b] = int(first[0])
-        self._emit(b, int(first[0]))
+        self._set_sampling(b, request)
+        tok = self._first_token(b, request, argmax=int(first[0]), raw=first_logits)
+        self._last[b] = tok
+        self._emit(b, tok)
 
     def _admit_chunked(self, b: int, request: GenRequest) -> None:
         """Long-prompt admission: ingest the prompt through fixed-size
@@ -255,8 +302,33 @@ class Engine:
         self._pos[b] = length
         self._rope[b] = length
         self._key_valid[b, :] = True
-        self._last[b] = first
-        self._emit(b, first)
+        self._set_sampling(b, request)
+        tok = self._first_token(b, request, argmax=first, raw=logits[0, last_idx][None])
+        self._last[b] = tok
+        self._emit(b, tok)
+
+    def _set_sampling(self, b: int, request: GenRequest) -> None:
+        self._temp[b] = request.temperature
+        self._topk[b] = request.top_k
+        self._topp[b] = request.top_p
+
+    def _first_token(self, b: int, request: GenRequest, argmax: int, raw) -> int:
+        """First generated token from the admission logits, and the slot's
+        key chain: both derive from fold_in(engine seed, request id) ONLY,
+        so a request's sampled stream survives any co-tenancy."""
+        req_key = jax.random.fold_in(self._base_key, request.id)
+        carry, sub = jax.random.split(req_key)
+        self._row_keys = self._row_keys.at[b].set(carry)
+        if request.temperature <= 0:
+            return argmax
+        tok = pick_tokens_per_row(
+            jnp.asarray(raw, jnp.float32).reshape(1, -1),
+            jnp.asarray([request.temperature], jnp.float32),
+            jnp.asarray([request.top_k], jnp.int32),
+            jnp.asarray([request.top_p], jnp.float32),
+            sub[None],
+        )
+        return int(tok[0])
 
     def _emit(self, b: int, token: int) -> None:
         """Append one token; marks (but does not free) a finished slot —
@@ -283,14 +355,28 @@ class Engine:
         if not any(s is not None for s in self._slots):
             return
         self.ticks += 1
-        toks, self._cache, _, _, _ = self._decode(
-            self.params,
-            self._cache,
-            jnp.asarray(self._pos),
-            jnp.asarray(self._last),
-            jnp.asarray(self._rope),
-            jnp.asarray(self._key_valid),
-        )
+        if (self._temp > 0).any():
+            toks, self._cache, self._row_keys = self._decode_sampled(
+                self.params,
+                self._cache,
+                jnp.asarray(self._pos),
+                jnp.asarray(self._last),
+                jnp.asarray(self._rope),
+                jnp.asarray(self._key_valid),
+                jnp.asarray(self._temp),
+                jnp.asarray(self._topk),
+                jnp.asarray(self._topp),
+                self._row_keys,
+            )
+        else:
+            toks, self._cache = self._decode_greedy(
+                self.params,
+                self._cache,
+                jnp.asarray(self._pos),
+                jnp.asarray(self._last),
+                jnp.asarray(self._rope),
+                jnp.asarray(self._key_valid),
+            )
         tokens = np.asarray(toks)  # [ticks_per_sync, B]
         ticks = tokens.shape[0]
         # Host state mirrors the device chunk exactly: every row advanced
@@ -312,3 +398,7 @@ class Engine:
         if slot is not None and slot.done:
             self._done.append(Completion(id=slot.request.id, tokens=slot.out))
             self._slots[b] = None
+            # stale sampling params must not keep the sampled program hot
+            self._temp[b] = 0.0
+            self._topk[b] = 0
+            self._topp[b] = 1.0
