@@ -290,7 +290,10 @@ def run_tpu_child() -> None:
             from nos_tpu.serve import Engine, GenRequest
 
             slots, n_req, gen_len = 4, 8, 64
-            eng = Engine(params, config, max_slots=slots, max_len=256)
+            # 16 ticks/sync: dispatch latency (a network RTT on tunneled
+            # chips) amortizes over the chunk
+            eng = Engine(params, config, max_slots=slots, max_len=256,
+                         ticks_per_sync=16)
             ids = [
                 eng.submit(GenRequest(prompt=[7] * 120, max_new_tokens=gen_len))
                 for _ in range(n_req)

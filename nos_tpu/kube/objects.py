@@ -139,6 +139,26 @@ class NodeAffinity:
 
 
 @dataclass
+class PodAffinityTerm:
+    """requiredDuringSchedulingIgnoredDuringExecution pod (anti-)affinity
+    term: selects PODS by matchLabels within a topology domain."""
+
+    topology_key: str = ""
+    # matchLabels only; matchExpressions are not modeled.
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    # Empty = the owning pod's own namespace (k8s default).
+    namespaces: List[str] = field(default_factory=list)
+
+    def selects(self, pod_labels: Dict[str, str], pod_ns: str, own_ns: str) -> bool:
+        if not self.match_labels:
+            return False
+        allowed = self.namespaces or [own_ns]
+        if pod_ns not in allowed:
+            return False
+        return all(pod_labels.get(k) == v for k, v in self.match_labels.items())
+
+
+@dataclass
 class TopologySpreadConstraint:
     """topologySpreadConstraints entry (DoNotSchedule honored as a filter,
     ScheduleAnyway left to scoring like the in-tree plugin)."""
@@ -190,6 +210,10 @@ class PodSpec:
     tolerations: List[Toleration] = field(default_factory=list)
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[NodeAffinity] = None
+    # Required-during-scheduling inter-pod terms (k8s nests these under
+    # affinity.podAffinity / affinity.podAntiAffinity on the wire).
+    pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity: List[PodAffinityTerm] = field(default_factory=list)
     topology_spread_constraints: List[TopologySpreadConstraint] = field(
         default_factory=list
     )

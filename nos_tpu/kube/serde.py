@@ -44,6 +44,7 @@ from nos_tpu.kube.objects import (
     PodDisruptionBudgetSpec,
     PodSpec,
     PodStatus,
+    PodAffinityTerm,
     Taint,
     Toleration,
     TopologySpreadConstraint,
@@ -259,11 +260,48 @@ def _container_from_wire(d: Dict[str, Any]) -> Container:
     )
 
 
-def _affinity_to_wire(a: Optional[NodeAffinity]) -> Optional[Dict[str, Any]]:
-    if a is None or not a.required_terms:
-        return None
-    return {
-        "nodeAffinity": {
+def _pod_terms_to_wire(terms: List[PodAffinityTerm]) -> List[Dict[str, Any]]:
+    out = []
+    for t in terms:
+        entry: Dict[str, Any] = {"topologyKey": t.topology_key}
+        if t.match_labels:
+            entry["labelSelector"] = {"matchLabels": dict(t.match_labels)}
+        if t.namespaces:
+            entry["namespaces"] = list(t.namespaces)
+        out.append(entry)
+    return out
+
+
+def _pod_terms_from_wire(block: Optional[Dict[str, Any]]) -> List[PodAffinityTerm]:
+    terms = (block or {}).get("requiredDuringSchedulingIgnoredDuringExecution") or []
+    out = []
+    for t in terms:
+        match_labels = dict((t.get("labelSelector") or {}).get("matchLabels") or {})
+        if not match_labels:
+            # matchExpressions-only or empty selectors are not modeled;
+            # keeping them would turn into match-NOTHING terms (selects()
+            # on empty labels), making a positive podAffinity pod
+            # permanently unschedulable. Drop the term at ingest instead
+            # (same {}-vs-nil hazard the spread codec guards against).
+            continue
+        out.append(
+            PodAffinityTerm(
+                topology_key=t.get("topologyKey", ""),
+                match_labels=match_labels,
+                namespaces=list(t.get("namespaces") or []),
+            )
+        )
+    return out
+
+
+def _affinity_to_wire(
+    a: Optional[NodeAffinity],
+    pod_affinity: List[PodAffinityTerm] = (),
+    pod_anti_affinity: List[PodAffinityTerm] = (),
+) -> Optional[Dict[str, Any]]:
+    out: Dict[str, Any] = {}
+    if a is not None and a.required_terms:
+        out["nodeAffinity"] = {
             "requiredDuringSchedulingIgnoredDuringExecution": {
                 "nodeSelectorTerms": [
                     {
@@ -276,7 +314,19 @@ def _affinity_to_wire(a: Optional[NodeAffinity]) -> Optional[Dict[str, Any]]:
                 ]
             }
         }
-    }
+    if pod_affinity:
+        out["podAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": _pod_terms_to_wire(
+                pod_affinity
+            )
+        }
+    if pod_anti_affinity:
+        out["podAntiAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": _pod_terms_to_wire(
+                pod_anti_affinity
+            )
+        }
+    return out or None
 
 
 def _affinity_from_wire(d: Optional[Dict[str, Any]]) -> Optional[NodeAffinity]:
@@ -323,7 +373,9 @@ def pod_to_wire(pod: Pod) -> Dict[str, Any]:
             {"key": t.key, "operator": t.operator, "value": t.value, "effect": t.effect}
             for t in pod.spec.tolerations
         ]
-    aff = _affinity_to_wire(pod.spec.affinity)
+    aff = _affinity_to_wire(
+        pod.spec.affinity, pod.spec.pod_affinity, pod.spec.pod_anti_affinity
+    )
     if aff:
         spec["affinity"] = aff
     if pod.spec.topology_spread_constraints:
@@ -389,6 +441,12 @@ def pod_from_wire(d: Dict[str, Any]) -> Pod:
             ],
             node_selector=dict(spec.get("nodeSelector") or {}),
             affinity=_affinity_from_wire(spec.get("affinity")),
+            pod_affinity=_pod_terms_from_wire(
+                (spec.get("affinity") or {}).get("podAffinity")
+            ),
+            pod_anti_affinity=_pod_terms_from_wire(
+                (spec.get("affinity") or {}).get("podAntiAffinity")
+            ),
             topology_spread_constraints=[
                 TopologySpreadConstraint(
                     topology_key=c.get("topologyKey", ""),

@@ -201,7 +201,12 @@ class Planner:
         accelerator = getattr(node.partitionable, "accelerator", "")
         sim_pod = self._simulation_pod(snapshot, pod, accelerator)
         state = CycleState()
-        if sim_pod.spec.topology_spread_constraints:
+        if (
+            sim_pod.spec.topology_spread_constraints
+            or sim_pod.spec.pod_affinity
+            or sim_pod.spec.pod_anti_affinity
+            or snapshot.has_anti_affinity_pods()
+        ):
             # Cross-node context for the topology-spread predicate,
             # published the same way the real cycle does (cached on the
             # snapshot across trials). Scope caveat: the snapshot holds

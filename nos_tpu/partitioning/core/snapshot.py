@@ -54,6 +54,7 @@ class ClusterSnapshot:
         self.codec: SliceCodec = codec or TpuSliceCodec()
         self._backup: Optional[Dict[str, SnapshotNode]] = None
         self._sim_cache: Optional[List[NodeInfo]] = None
+        self._anti_cache: Optional[bool] = None
 
     # ------------------------------------------------------ fork/commit
 
@@ -62,10 +63,12 @@ class ClusterSnapshot:
             raise RuntimeError("snapshot already forked")
         self._backup = copy.deepcopy(self._nodes)
         self._sim_cache = None
+        self._anti_cache = None
 
     def commit(self) -> None:
         self._backup = None
         self._sim_cache = None
+        self._anti_cache = None
 
     def revert(self) -> None:
         if self._backup is None:
@@ -73,6 +76,7 @@ class ClusterSnapshot:
         self._nodes = self._backup
         self._backup = None
         self._sim_cache = None
+        self._anti_cache = None
 
     # --------------------------------------------------------- queries
 
@@ -147,13 +151,27 @@ class ClusterSnapshot:
 
     def sim_node_infos(self) -> List[NodeInfo]:
         """Every node's sim view, for predicates needing cluster-wide
-        context (topology spread). Cached until the next fork/commit/
-        revert/add_pod — the planner's mutation points. The planner's
-        geometry re-carve right after fork() is covered because fork
-        invalidates and nothing reads between the two."""
+        context (topology spread, inter-pod affinity). Cached until the
+        next fork/commit/revert/add_pod — the planner's mutation points.
+        The planner's geometry re-carve right after fork() is covered
+        because fork invalidates and nothing reads between the two."""
         if self._sim_cache is None:
             self._sim_cache = [n.sim_node_info() for n in self._nodes.values()]
         return self._sim_cache
+
+    def has_anti_affinity_pods(self) -> bool:
+        """Whether any placed pod carries required anti-affinity — those
+        terms are SYMMETRIC (they reject incoming pods), so the simulation
+        must publish the cluster view even for term-less candidates.
+        Cached with the same invalidation points as sim_node_infos — the
+        planner calls this once per (pod, node) trial."""
+        if self._anti_cache is None:
+            self._anti_cache = any(
+                p.spec.pod_anti_affinity
+                for node in self._nodes.values()
+                for p in node.pods
+            )
+        return self._anti_cache
 
     # -------------------------------------------------------- mutation
 
@@ -164,6 +182,7 @@ class ClusterSnapshot:
         added = node.add_pod(pod)
         if added:
             self._sim_cache = None
+            self._anti_cache = None
         return added
 
     # ------------------------------------------------------ projection

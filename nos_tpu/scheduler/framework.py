@@ -425,6 +425,116 @@ class PodTopologySpreadFit:
         return Status.ok()
 
 
+class InterPodAffinityFit:
+    """Required pod affinity / anti-affinity (in-tree InterPodAffinity
+    predicate, matchLabels subset), over the published cluster view:
+
+    - podAffinity term: the candidate node's topology domain must already
+      hold a matching pod — with the upstream bootstrap carve-out that a
+      term matching the INCOMING pod's own labels is satisfiable when no
+      pod matches anywhere (the first replica of a self-affine group).
+    - podAntiAffinity term: no matching pod may share the candidate's
+      domain. Symmetry is enforced like upstream: an EXISTING pod's
+      required anti-affinity also rejects the incoming pod from its
+      domain. (Existing pods' positive affinity is not symmetric.)
+
+    Per-cycle indexes are cached in CycleState so each node filter is a
+    lookup, not a cluster scan.
+    """
+
+    name = "InterPodAffinity"
+    _CACHE_KEY = "inter_pod_affinity_index"
+
+    def _index(self, state: CycleState):
+        """Per-node view of the published cluster: {node name: (node
+        labels, [pods])}. Kept per-node (not flattened) so filter() can
+        substitute the handed-in trial NodeInfo for its published entry —
+        preemption simulates victim eviction through that substitution,
+        exactly like PodTopologySpreadFit."""
+        cached = state.get(self._CACHE_KEY)
+        if cached is not None:
+            return cached
+        all_infos: Sequence[NodeInfo] = state.get(TOPOLOGY_NODE_INFOS_KEY) or []
+        cached = {
+            info.name: (info.node.metadata.labels, info.pods) for info in all_infos
+        }
+        state[self._CACHE_KEY] = cached
+        return cached
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        has_terms = pod.spec.pod_affinity or pod.spec.pod_anti_affinity
+        by_node = dict(self._index(state))
+        # The handed view of this node wins over the published one: on the
+        # normal path they are identical; under preemption the trial has
+        # victims removed and THAT is what must be matched against.
+        by_node[node_info.name] = (node_info.node.metadata.labels, node_info.pods)
+        node_labels = node_info.node.metadata.labels
+        own_ns = pod.metadata.namespace
+
+        # Symmetric anti-affinity applies to EVERY incoming pod, terms or
+        # not: an existing pod's required anti-affinity rejects the
+        # incoming pod from its domain.
+        for n_labels, pods_ in by_node.values():
+            for p in pods_:
+                for term in p.spec.pod_anti_affinity:
+                    domain = n_labels.get(term.topology_key)
+                    if domain is None:
+                        continue
+                    if node_labels.get(term.topology_key) == domain and term.selects(
+                        pod.metadata.labels, own_ns, p.metadata.namespace
+                    ):
+                        return Status.unschedulable(
+                            f"an existing pod's anti-affinity "
+                            f"({term.topology_key}={domain}) excludes this pod",
+                            self.name,
+                        )
+        if not has_terms:
+            return Status.ok()
+        for term in pod.spec.pod_affinity:
+            domain = node_labels.get(term.topology_key)
+            if domain is None:
+                return Status.unschedulable(
+                    f"node has no {term.topology_key} label", self.name
+                )
+            matched_any = False
+            matched_here = False
+            for n_labels, pods_ in by_node.values():
+                for p in pods_:
+                    if term.selects(p.metadata.labels, p.metadata.namespace, own_ns):
+                        matched_any = True
+                        if n_labels.get(term.topology_key) == domain:
+                            matched_here = True
+                            break
+                if matched_here:
+                    break
+            if not matched_here:
+                # bootstrap: a self-affine group's first replica
+                if not matched_any and term.selects(
+                    pod.metadata.labels, own_ns, own_ns
+                ):
+                    continue
+                return Status.unschedulable(
+                    f"no pod matching affinity term in {term.topology_key}="
+                    f"{domain}",
+                    self.name,
+                )
+        for term in pod.spec.pod_anti_affinity:
+            domain = node_labels.get(term.topology_key)
+            if domain is None:
+                continue  # no domain -> nothing to collide with (upstream)
+            for n_labels, pods_ in by_node.values():
+                if n_labels.get(term.topology_key) != domain:
+                    continue
+                for p in pods_:
+                    if term.selects(p.metadata.labels, p.metadata.namespace, own_ns):
+                        return Status.unschedulable(
+                            f"anti-affinity: matching pod already in "
+                            f"{term.topology_key}={domain}",
+                            self.name,
+                        )
+        return Status.ok()
+
+
 class TaintTolerationScoring:
     """PreferNoSchedule taints affect scoring, not filtering (the in-tree
     TaintToleration score half the filter above deliberately ignores):
@@ -500,5 +610,6 @@ def vanilla_filter_plugins() -> List[FilterPlugin]:
         NodeAffinityFit(),
         NodeSelectorFit(),
         PodTopologySpreadFit(),
+        InterPodAffinityFit(),
         NodeResourcesFit(),
     ]

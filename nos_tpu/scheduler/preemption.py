@@ -236,13 +236,14 @@ class Preemptor:
         # trial-substitution.
         from nos_tpu.scheduler.framework import (
             TOPOLOGY_NODE_INFOS_KEY,
+            InterPodAffinityFit,
             PodTopologySpreadFit,
         )
 
         has_spread = any(
             c.when_unsatisfiable == "DoNotSchedule"
             for c in pod.spec.topology_spread_constraints
-        )
+        ) or bool(pod.spec.pod_affinity or pod.spec.pod_anti_affinity)
         published = state.get(TOPOLOGY_NODE_INFOS_KEY) if has_spread else None
         remote_trials: Dict[str, NodeInfo] = {}
 
@@ -266,6 +267,7 @@ class Preemptor:
                 remote_trials.get(i.name, i) for i in published
             ]
             overlay.pop(PodTopologySpreadFit._CACHE_KEY, None)
+            overlay.pop(InterPodAffinityFit._CACHE_KEY, None)
             return overlay
 
         def feasible(trial: NodeInfo) -> bool:

@@ -119,6 +119,35 @@ class TestRoundTrips:
         assert c.when_unsatisfiable == "DoNotSchedule"
         assert c.match_labels == {"app": "web"}
 
+    def test_pod_affinity_terms_roundtrip(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace="ns"),
+            spec=PodSpec(
+                containers=[Container()],
+                pod_affinity=[PodAffinityTerm(
+                    topology_key="topology.kubernetes.io/zone",
+                    match_labels={"app": "cache"},
+                )],
+                pod_anti_affinity=[PodAffinityTerm(
+                    topology_key="kubernetes.io/hostname",
+                    match_labels={"app": "web"},
+                    namespaces=["ns", "other"],
+                )],
+            ),
+        )
+        wire = serde.to_wire(pod)
+        assert "podAffinity" in wire["spec"]["affinity"]
+        back = serde.from_wire(wire)
+        aff = back.spec.pod_affinity[0]
+        assert (aff.topology_key, aff.match_labels) == (
+            "topology.kubernetes.io/zone", {"app": "cache"},
+        )
+        anti = back.spec.pod_anti_affinity[0]
+        assert anti.namespaces == ["ns", "other"]
+        assert anti.match_labels == {"app": "web"}
+
     def test_topology_spread_empty_selector_omitted_on_wire(self):
         # labelSelector:{} means match-ALL to the k8s API — the opposite of
         # the modeled nil-selector no-op — so it must not be emitted.

@@ -235,6 +235,25 @@ class TestPlannerSimulationFidelity:
         planner.plan(snap, [pod])
         assert snap.get_node("n1").pods == []
 
+    def test_declines_carve_when_anti_affinity_violated(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+        from nos_tpu.scheduler.framework import vanilla_filter_plugins
+
+        node = build_tpu_node(name="n1")
+        node.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        resident = build_pod("resident", {"cpu": 1})
+        resident.metadata.labels["app"] = "web"
+        snap = snapshot_of(node, pods_by_node={"n1": [resident]})
+        pod = build_pod("web-new", {slice_res("2x2"): 1})
+        pod.metadata.labels["app"] = "web"
+        pod.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "web"},
+        )]
+        planner = Planner(Framework(filter_plugins=vanilla_filter_plugins()))
+        planner.plan(snap, [pod])
+        assert "web-new" not in [p.metadata.name for p in snap.get_node("n1").pods]
+
     def test_declines_carve_when_topology_spread_violated(self):
         from nos_tpu.kube.objects import TopologySpreadConstraint
         from nos_tpu.scheduler.framework import vanilla_filter_plugins

@@ -275,3 +275,33 @@ class TestSpreadAwarePreemption:
         assert store.try_get("Pod", "w2", "team-b") is not None
         pod = store.get("Pod", "p", "team-a")
         assert pod.status.nominated_node_name == ""
+
+
+class TestAffinityAwarePreemption:
+    def test_eviction_resolves_anti_affinity_violation(self):
+        """The victim trial (candidate pods minus victims) is what the
+        inter-pod affinity predicate must see: evicting the only
+        conflicting pod makes the node feasible, so preemption must
+        nominate instead of leaving the pod pending forever on a stale
+        pre-eviction index."""
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        store = KubeStore()
+        n1 = build_node("n1", alloc={CHIPS: 8, "cpu": 64})
+        n1.metadata.labels["topology.kubernetes.io/zone"] = "zone-a"
+        store.create(n1)
+        store.create(quota("team-a"))
+        store.create(quota("team-b"))
+        blocker = over_quota_pod("blocker", 8, "team-b", "n1",
+                                 extra_labels={"app": "web"})
+        store.create(blocker)
+        s = make_scheduler(store)
+        preemptor = build_pod("p", {CHIPS: 4}, ns="team-a")
+        preemptor.metadata.labels["app"] = "web"
+        preemptor.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "web"},
+        )]
+        sched_pod(s, store, preemptor)
+        assert store.try_get("Pod", "blocker", "team-b") is None
+        assert store.get("Pod", "p", "team-a").status.nominated_node_name == "n1"

@@ -464,3 +464,110 @@ class TestSoftScoring:
         sched_pod(s, store, pod)
         # soft constraint: heavily skewed but the only node still binds
         assert store.get("Pod", "web-new", "default").spec.node_name == "n-a"
+
+
+class TestInterPodAffinity:
+    def zone_node(self, store, name, zone, cpu=8):
+        node = build_node(name, alloc={"cpu": cpu})
+        node.metadata.labels["topology.kubernetes.io/zone"] = zone
+        store.create(node)
+        return node
+
+    def web_pod(self, name, node, labels_=None):
+        pod = build_pod(name, {"cpu": 1}, node=node, phase=PodPhase.RUNNING)
+        for k, v in (labels_ or {"app": "web"}).items():
+            pod.metadata.labels[k] = v
+        return pod
+
+    def test_affinity_co_locates_with_matching_pod(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        store = KubeStore()
+        self.zone_node(store, "n-a", "zone-a")
+        self.zone_node(store, "n-b", "zone-b")
+        store.create(self.web_pod("cache", "n-b", {"app": "cache"}))
+        s = make_scheduler(store)
+        pod = build_pod("worker", {"cpu": 1})
+        pod.spec.pod_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "cache"},
+        )]
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "worker", "default").spec.node_name == "n-b"
+
+    def test_affinity_bootstrap_self_match(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        store = KubeStore()
+        self.zone_node(store, "n-a", "zone-a")
+        s = make_scheduler(store)
+        pod = build_pod("first", {"cpu": 1})
+        pod.metadata.labels["app"] = "group"
+        pod.spec.pod_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "group"},
+        )]
+        # no matching pod exists anywhere, but the term matches the
+        # incoming pod itself: the first replica must be schedulable
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "first", "default").spec.node_name == "n-a"
+
+    def test_anti_affinity_spreads_replicas(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        store = KubeStore()
+        self.zone_node(store, "n-a", "zone-a")
+        self.zone_node(store, "n-b", "zone-b")
+        store.create(self.web_pod("web-0", "n-a"))
+        s = make_scheduler(store)
+        pod = build_pod("web-1", {"cpu": 1})
+        pod.metadata.labels["app"] = "web"
+        pod.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "web"},
+        )]
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "web-1", "default").spec.node_name == "n-b"
+
+    def test_existing_pods_anti_affinity_is_symmetric(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        store = KubeStore()
+        self.zone_node(store, "n-a", "zone-a")
+        # the RESIDENT declares anti-affinity against app=web pods; an
+        # incoming web pod with NO terms of its own must still be rejected
+        # from zone-a (upstream symmetry)
+        resident = self.web_pod("landlord", "n-a", {"app": "landlord"})
+        resident.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "web"},
+        )]
+        store.create(resident)
+        self.zone_node(store, "n-b", "zone-b")
+        s = make_scheduler(store)
+        incoming = build_pod("web-new", {"cpu": 1})
+        incoming.metadata.labels["app"] = "web"
+        sched_pod(s, store, incoming)
+        assert store.get("Pod", "web-new", "default").spec.node_name == "n-b"
+
+    def test_namespace_scoping_defaults_to_own_namespace(self):
+        from nos_tpu.kube.objects import PodAffinityTerm
+
+        store = KubeStore()
+        # n-a is the ONLY node: if the foreign-namespace pod wrongly
+        # triggered the anti-affinity, web-1 would be unschedulable — the
+        # bind below can only happen when namespace scoping works.
+        self.zone_node(store, "n-a", "zone-a")
+        foreign = build_pod("web-0", {"cpu": 1}, ns="other", node="n-a",
+                            phase=PodPhase.RUNNING)
+        foreign.metadata.labels["app"] = "web"
+        store.create(foreign)
+        s = make_scheduler(store)
+        pod = build_pod("web-1", {"cpu": 1})  # ns=default
+        pod.metadata.labels["app"] = "web"
+        pod.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_labels={"app": "web"},
+        )]
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "web-1", "default").spec.node_name == "n-a"
