@@ -68,6 +68,7 @@ def make_train_step(
     learning_rate: float = 1e-3,
     momentum: float = 0.9,
     optimizer=None,
+    accum_steps: int = 1,
 ):
     """Returns (train_step, shard_state) where
     train_step(state, tokens) -> (state, loss).
@@ -76,7 +77,15 @@ def make_train_step(
     opt_state), sharded via ``optimizer_state_sharding``) — the optimizer
     then OWNS the hyperparameters, so passing non-default learning_rate /
     momentum alongside it is rejected rather than silently ignored. None
-    keeps the built-in momentum-SGD update (state = (params, velocity))."""
+    keeps the built-in momentum-SGD update (state = (params, velocity)).
+
+    ``accum_steps`` > 1 enables gradient accumulation: ``tokens``
+    [accum·B, S] is processed as ``accum_steps`` sequential micro-batches
+    inside one ``lax.scan`` (one backward's activations live at a time —
+    effective batch grows without touching peak activation HBM), with
+    gradients accumulated in float32 and averaged before ONE optimizer
+    update. Equal-sized micro-batches make the result the same gradient
+    as a single large batch (pinned by test)."""
     if optimizer is not None and (learning_rate != 1e-3 or momentum != 0.9):
         raise ValueError(
             "learning_rate/momentum configure the built-in SGD update; an "
@@ -101,6 +110,48 @@ def make_train_step(
     def loss_fn(params, tokens):
         return llama_loss(params, tokens, config, mesh)
 
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grad_of(params, tokens):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, tokens)
+        total_b = tokens.shape[0]
+        if total_b % accum_steps:
+            raise ValueError(
+                f"batch {total_b} is not divisible by accum_steps {accum_steps}"
+            )
+        micro = tokens.reshape(accum_steps, total_b // accum_steps, -1)
+        # One hoisted reshard of the whole stack (micro-batch rows spread
+        # over dp) instead of a collective inside every scan iteration.
+        micro = jax.lax.with_sharding_constraint(
+            micro,
+            NamedSharding(mesh, P(None, *data_sharding.spec)),
+        )
+
+        def acc(carry, batch):
+            loss_sum, g_sum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            g_sum = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), g_sum, grads
+            )
+            return (loss_sum + loss, g_sum), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            acc, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        scale = 1.0 / accum_steps
+        # Final cast back to the param dtype: the accumulation happened in
+        # f32; keeping f32 grads would also flip optax moment dtypes and
+        # force a retrace on the second step.
+        grads = jax.tree.map(
+            lambda g, p: (g * scale).astype(p.dtype), g_sum, params
+        )
+        return loss_sum * scale, grads
+
     @partial(
         jax.jit,
         in_shardings=(state_sharding, data_sharding),
@@ -109,7 +160,7 @@ def make_train_step(
     )
     def train_step(state, tokens):
         params, opt = state
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        loss, grads = grad_of(params, tokens)
         if optimizer is not None:
             import optax
 
