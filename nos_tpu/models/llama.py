@@ -133,10 +133,14 @@ def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
 
 
 def _mm(x: jax.Array, w) -> jax.Array:
-    """x @ w for bf16 weights or int8 QuantizedLinear (serving path)."""
+    """x @ w, dispatching on the weight leaf: dense bf16, int8
+    QuantizedLinear (serving), or LoraLinear (adapter fine-tuning)."""
+    from nos_tpu.models.lora import LoraLinear
     from nos_tpu.models.quantize import QuantizedLinear
 
     if isinstance(w, QuantizedLinear):
+        return w.matmul(x)
+    if isinstance(w, LoraLinear):
         return w.matmul(x)
     return x @ w
 

@@ -1,0 +1,199 @@
+"""LoRA fine-tuning: low-rank adapters on the attention/MLP projections.
+
+Fine-tuning a checkpoint on a slice tenant's budget: instead of touching
+the base weights (N params of optimizer state), train rank-r adapters
+``delta W = (alpha/r) * A @ B`` on selected projections — the trainable
+state is thousands of times smaller, the base stays frozen (and can stay
+donated/shared between jobs), and the result either serves directly
+(adapters applied on the fly) or merges back into a dense checkpoint that
+composes with everything downstream (int8 quantization, TP sharding, the
+serving engine).
+
+TPU-first: adapters attach as ``LoraLinear`` pytree nodes the forward's
+``_mm`` dispatch already understands (same mechanism as int8
+QuantizedLinear), so NO model code forks — llama_forward, generate,
+prefill, the engine all run adapted weights unchanged. The adapter matmul
+``(x @ A) @ B`` keeps the low-rank structure (never materializes the
+[in, out] delta) — rank-r tiles ride the MXU alongside the dense matmul.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# Projections LoRA understands (2-D [in, out] leaves of a llama layer).
+_TARGETABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Which per-layer projections get adapters (Q and V, the classic pick).
+    targets: Tuple[str, ...] = ("wq", "wv")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LoraLinear:
+    """Frozen base weight [in, out] + trainable low-rank delta A[in,r] @
+    B[r,out] — applied on the fly, never materialized."""
+
+    w: jax.Array
+    a: jax.Array
+    b: jax.Array
+    # static (aux) so jit treats it as a compile-time constant
+    scale: float = 1.0
+
+    def matmul(self, x: jax.Array) -> jax.Array:
+        base = x @ self.w
+        delta = (x @ self.a.astype(x.dtype)) @ self.b.astype(x.dtype)
+        return base + self.scale * delta
+
+    def tree_flatten(self):
+        return (self.w, self.a, self.b), self.scale
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, scale=aux)
+
+
+def init_lora_params(key: jax.Array, config, lora: LoraConfig) -> Params:
+    """Adapter tree mirroring params['layers']: per layer, per target,
+    {'a': [in, r] (scaled normal), 'b': [r, out] (ZEROS — the delta starts
+    at exactly zero, so step 0 reproduces the base model bit for bit)."""
+    for t in lora.targets:
+        if t not in _TARGETABLE:
+            raise ValueError(f"unknown LoRA target {t!r}; choose from {_TARGETABLE}")
+    c = config
+    hd = c.head_dim
+    dims = {
+        "wq": (c.d_model, c.n_heads * hd),
+        "wk": (c.d_model, c.n_kv_heads * hd),
+        "wv": (c.d_model, c.n_kv_heads * hd),
+        "wo": (c.n_heads * hd, c.d_model),
+        "w_gate": (c.d_model, c.d_ff),
+        "w_up": (c.d_model, c.d_ff),
+        "w_down": (c.d_ff, c.d_model),
+    }
+    layers = []
+    keys = jax.random.split(key, c.n_layers)
+    for lk in keys:
+        t_keys = jax.random.split(lk, len(lora.targets))
+        layer = {}
+        for t, tk in zip(lora.targets, t_keys):
+            d_in, d_out = dims[t]
+            layer[t] = {
+                "a": (
+                    jax.random.normal(tk, (d_in, lora.rank), jnp.float32)
+                    / math.sqrt(d_in)
+                ).astype(c.dtype),
+                "b": jnp.zeros((lora.rank, d_out), c.dtype),
+            }
+        layers.append(layer)
+    return {"layers": layers}
+
+
+def attach_lora(params: Params, lora_params: Params, lora: LoraConfig) -> Params:
+    """Base params + adapters → forward-ready tree with LoraLinear nodes at
+    the targeted projections (everything else shared by reference)."""
+    out = dict(params)
+    out["layers"] = []
+    for base_layer, ad_layer in zip(params["layers"], lora_params["layers"]):
+        layer = dict(base_layer)
+        for t, ab in ad_layer.items():
+            if t not in layer:
+                raise ValueError(
+                    f"LoRA target {t!r} absent from layer (MoE layers have "
+                    "no dense MLP projections)"
+                )
+            layer[t] = LoraLinear(
+                w=layer[t], a=ab["a"], b=ab["b"], scale=lora.scale
+            )
+        out["layers"].append(layer)
+    return out
+
+
+def merge_lora(params: Params, lora_params: Params, lora: LoraConfig) -> Params:
+    """Fold the adapters into dense weights: W + (alpha/r)·A@B — the
+    serving artifact (quantizes, shards, and serves like any checkpoint)."""
+    out = dict(params)
+    out["layers"] = []
+    for base_layer, ad_layer in zip(params["layers"], lora_params["layers"]):
+        layer = dict(base_layer)
+        for t, ab in ad_layer.items():
+            if t not in layer:
+                raise ValueError(
+                    f"LoRA target {t!r} absent from layer (MoE layers have "
+                    "no dense MLP projections)"
+                )
+            w = layer[t]
+            delta = (
+                ab["a"].astype(jnp.float32) @ ab["b"].astype(jnp.float32)
+            ) * lora.scale
+            layer[t] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+        out["layers"].append(layer)
+    return out
+
+
+def make_lora_train_step(
+    mesh,
+    config,
+    lora: LoraConfig,
+    learning_rate: float = 1e-3,
+    optimizer=None,
+):
+    """Returns (train_step, shard_adapters) where
+    train_step(adapter_state, base_params, tokens) -> (adapter_state, loss).
+
+    Only the adapters carry gradients and optimizer state; the base params
+    flow through as frozen constants (shard them once with
+    llama_param_sharding and reuse across steps/jobs). Adapters are tiny —
+    they replicate across the mesh (no FSDP needed at rank«d)."""
+    import optax as _optax
+
+    from nos_tpu.models.llama import llama_loss
+    from nos_tpu.parallel.sharding import llama_data_sharding
+
+    if optimizer is not None and learning_rate != 1e-3:
+        # same contract as make_train_step: an optax optimizer OWNS its
+        # hyperparameters — reject rather than silently ignore.
+        raise ValueError(
+            "learning_rate configures the built-in Adam; an optax optimizer "
+            "carries its own — set it there instead"
+        )
+    opt = optimizer or _optax.adam(learning_rate)
+    data_sharding = llama_data_sharding(mesh)
+
+    def loss_fn(adapters, base_params, tokens):
+        return llama_loss(attach_lora(base_params, adapters, lora), tokens, config, mesh)
+
+    @jax.jit
+    def train_step(adapter_state, base_params, tokens):
+        adapters, opt_state = adapter_state
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(adapters, base_params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, adapters)
+        adapters = _optax.apply_updates(adapters, updates)
+        return (adapters, opt_state), loss
+
+    def shard_adapters(adapters):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(mesh, P())
+        sharded = jax.device_put(
+            adapters, jax.tree.map(lambda _: replicated, adapters)
+        )
+        return (sharded, opt.init(sharded))
+
+    return train_step, shard_adapters
