@@ -93,20 +93,34 @@ def init_lora_params(key: jax.Array, config, lora: LoraConfig) -> Params:
         layer = {}
         for t, tk in zip(lora.targets, t_keys):
             d_in, d_out = dims[t]
+            # Adapters stay float32: Adam's shrinking steps would round
+            # to zero against bf16's 8-bit mantissa as the adapters grow
+            # (the dense path accumulates f32 for the same reason), and at
+            # rank<<d the extra bytes are noise. matmul casts per use.
             layer[t] = {
-                "a": (
-                    jax.random.normal(tk, (d_in, lora.rank), jnp.float32)
-                    / math.sqrt(d_in)
-                ).astype(c.dtype),
-                "b": jnp.zeros((lora.rank, d_out), c.dtype),
+                "a": jax.random.normal(tk, (d_in, lora.rank), jnp.float32)
+                / math.sqrt(d_in),
+                "b": jnp.zeros((lora.rank, d_out), jnp.float32),
             }
         layers.append(layer)
     return {"layers": layers}
 
 
+def _check_layer_counts(params: Params, lora_params: Params) -> None:
+    n_base, n_ad = len(params["layers"]), len(lora_params["layers"])
+    if n_base != n_ad:
+        # zip would silently truncate the model to the shorter tree —
+        # a 2-layer "merge" of a 32-layer checkpoint producing garbage.
+        raise ValueError(
+            f"adapter tree has {n_ad} layers but the model has {n_base}; "
+            "the adapters were built for a different config"
+        )
+
+
 def attach_lora(params: Params, lora_params: Params, lora: LoraConfig) -> Params:
     """Base params + adapters → forward-ready tree with LoraLinear nodes at
     the targeted projections (everything else shared by reference)."""
+    _check_layer_counts(params, lora_params)
     out = dict(params)
     out["layers"] = []
     for base_layer, ad_layer in zip(params["layers"], lora_params["layers"]):
@@ -127,6 +141,7 @@ def attach_lora(params: Params, lora_params: Params, lora: LoraConfig) -> Params
 def merge_lora(params: Params, lora_params: Params, lora: LoraConfig) -> Params:
     """Fold the adapters into dense weights: W + (alpha/r)·A@B — the
     serving artifact (quantizes, shards, and serves like any checkpoint)."""
+    _check_layer_counts(params, lora_params)
     out = dict(params)
     out["layers"] = []
     for base_layer, ad_layer in zip(params["layers"], lora_params["layers"]):

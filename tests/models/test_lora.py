@@ -118,3 +118,18 @@ class TestLora:
             init_lora_params(
                 jax.random.key(0), config, LoraConfig(targets=("embed",))
             )
+
+    def test_mismatched_layer_counts_rejected(self, setup):
+        config, params, lora, _, _ = setup
+        small = init_lora_params(
+            jax.random.key(0), tiny_config(n_layers=1), lora
+        )
+        with pytest.raises(ValueError):
+            attach_lora(params, small, lora)
+        with pytest.raises(ValueError):
+            merge_lora(params, small, lora)
+
+    def test_adapters_stay_float32(self, setup):
+        config, _, lora, adapters, _ = setup
+        ab = adapters["layers"][0]["wq"]
+        assert ab["a"].dtype == jnp.float32 and ab["b"].dtype == jnp.float32
