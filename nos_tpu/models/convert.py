@@ -62,6 +62,9 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
             f"head_dim={head_dim} != hidden_size/num_heads={derived}: "
             "unsupported layout"
         )
+    # Mistral-family sliding window (the arch is otherwise Llama-shaped;
+    # the same converter serves both). transformers uses None for "full".
+    sliding = getattr(hf_config, "sliding_window", None)
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -74,6 +77,7 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         rope_scaling=rope_scaling,
         norm_eps=float(hf_config.rms_norm_eps),
+        sliding_window=int(sliding) if sliding else None,
         dtype=dtype,
     )
 

@@ -30,6 +30,7 @@ from nos_tpu.models.llama import (
     _rms_norm,
     _rope,
     _rope_at,
+    _window_causal_mask,
     llama_forward,
 )
 
@@ -76,11 +77,16 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_vali
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4)
     ndim = getattr(n_valid, "ndim", 0)
     if ndim == 2:
-        valid = iota < n_valid[:, None, None, :, None]
+        frontier = n_valid[:, None, None, :, None]
     elif ndim == 1:
-        valid = iota < n_valid[:, None, None, None, None]
+        frontier = n_valid[:, None, None, None, None]
     else:
-        valid = iota < n_valid
+        frontier = n_valid
+    valid = iota < frontier
+    if c.sliding_window is not None:
+        # the query at frontier f-1 sees keys (f-1-W, f-1]; cache slots ==
+        # logical positions on the unpadded serving path this supports
+        valid = valid & (iota >= frontier - c.sliding_window)
     if key_valid is not None:
         valid = valid & key_valid[:, None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
@@ -105,6 +111,20 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache capacity {max_len}")
+    if c.sliding_window is not None and c.attention == "flash":
+        # same loud contract as llama_forward: no silent dense fallback
+        raise ValueError(
+            "sliding_window is dense-path only (the flash kernel has no "
+            "window support); use attention='dense'"
+        )
+    if c.sliding_window is not None and pad_id is not None:
+        # left padding decouples physical cache slots from logical
+        # positions; the window mask runs over physical slots, so the
+        # combination would silently attend the wrong band
+        raise ValueError(
+            "sliding_window does not support left-padded prompts; batch "
+            "via the engine's chunked admission instead"
+        )
     x = _embed_rows(params["embed"], tokens, c.dtype)
     if pad_id is None:
         cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
@@ -143,7 +163,7 @@ def prefill(
         # kernel (O(blk) VMEM) when the config asks for it, matching the
         # training path's dispatch. Padded batches need per-key masks the
         # kernel does not take, so they use the dense path.
-        if c.attention == "flash" and pad_id is None:
+        if c.attention == "flash" and pad_id is None and c.sliding_window is None:
             from nos_tpu.ops import flash_attention
 
             attn = flash_attention(
@@ -156,7 +176,7 @@ def prefill(
                 "bsKgh,btKh->bKgst", qg, k, preferred_element_type=jnp.float32
             )
             scores = scores / math.sqrt(hd)
-            mask = jnp.tril(jnp.ones((s, s), bool))[None, None, None]
+            mask = _window_causal_mask(s, c.sliding_window)[None, None, None]
             if token_valid is not None:
                 mask = mask & token_valid[:, None, None, None, :]
             scores = jnp.where(mask, scores, -1e30)

@@ -43,6 +43,11 @@ class LlamaConfig:
     # activation memory that no longer scales with n_layers, which is what
     # lets a 16 GB chip train at real batch×sequence sizes.
     remat: bool = False
+    # Sliding-window attention (Mistral-style): each query attends only
+    # the last `sliding_window` positions. None = full causal attention.
+    # Masking-only (the KV cache is not ring-buffered), and dense-path
+    # only — the flash kernel and ring attention reject it loudly.
+    sliding_window: Any = None
     # n_experts > 0 swaps every MLP for a routed mixture-of-experts
     # (nos_tpu/models/moe.py) with experts sharded over the ep mesh axis.
     n_experts: int = 0
@@ -201,6 +206,17 @@ def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
+def _window_causal_mask(s: int, sliding_window) -> jax.Array:
+    """THE causal mask [s, s]: lower-triangular, banded to the last
+    ``sliding_window`` positions when set (query i sees keys (i-W, i]).
+    One source of truth for the training forward and serving prefill."""
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    if sliding_window is not None:
+        pos = jnp.arange(s)
+        causal = causal & (pos[:, None] - pos[None, :] < sliding_window)
+    return causal
+
+
 def _attention(
     x: jax.Array,
     layer: Params,
@@ -219,7 +235,16 @@ def _attention(
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
+    if c.sliding_window is not None and c.attention == "flash":
+        raise ValueError(
+            "sliding_window is dense-path only (the flash kernel has no "
+            "window support); use attention='dense'"
+        )
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        if c.sliding_window is not None:
+            raise ValueError(
+                "sliding_window is not implemented for ring attention"
+            )
         # Sequence-parallel path: exact blockwise attention with K/V blocks
         # rotating over the sp ring (nos_tpu/parallel/ring_attention.py).
         # attention="flash" runs the Pallas kernel per ring block with the
@@ -249,7 +274,7 @@ def _attention(
     scores = jnp.einsum(
         "bsKgh,btKh->bKgst", q, k, preferred_element_type=jnp.float32
     ) / math.sqrt(hd)
-    causal = jnp.tril(jnp.ones((s, s), bool))
+    causal = _window_causal_mask(s, c.sliding_window)
     scores = jnp.where(causal[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     out = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, c.n_heads * hd)

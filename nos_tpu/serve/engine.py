@@ -199,7 +199,8 @@ class Engine:
         t = self.ticks_per_sync
         chunks = -(-max(0, request.max_new_tokens - 1) // t)
         bucket = self._bucket(len(request.prompt))
-        frontier = len(request.prompt) if bucket > self.prefill_chunk else bucket
+        chunked = bucket > self.prefill_chunk or self.config.sliding_window is not None
+        frontier = len(request.prompt) if chunked else bucket
         need = frontier + chunks * t
         if need > self.max_len:
             raise ValueError(
@@ -240,7 +241,10 @@ class Engine:
 
     def _admit(self, b: int, request: GenRequest) -> None:
         bucket = self._bucket(len(request.prompt))
-        if bucket > self.prefill_chunk:
+        if bucket > self.prefill_chunk or self.config.sliding_window is not None:
+            # sliding-window configs always take the chunked path: its
+            # positions are physical==logical (no left pad), which the
+            # window mask requires
             self._admit_chunked(b, request)
             return
         pad = bucket - len(request.prompt)
@@ -273,9 +277,11 @@ class Engine:
         from nos_tpu.models.generate import init_kv_cache
 
         c = self.config
-        n = self.prefill_chunk
         prompt = list(request.prompt)
         length = len(prompt)
+        # short prompts (windowed configs route here too) use bucket-sized
+        # pieces, not the full prefill_chunk width
+        n = min(self.prefill_chunk, self._bucket(length))
         row_cache = init_kv_cache(c, 1, self.max_len + 1)
         logits = None
         for start in range(0, length, n):

@@ -1,0 +1,122 @@
+"""Sliding-window attention (Mistral family): torch transformers is the
+oracle, and the cached serving paths must agree with the windowed
+forward."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from nos_tpu.models.convert import load_hf_llama
+from nos_tpu.models.generate import generate, prefill, reference_generate
+from nos_tpu.models.llama import init_llama_params, llama_forward, tiny_config
+
+WINDOW = 6
+
+
+@pytest.fixture(scope="module")
+def hf_mistral():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(0)
+    config = MistralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        sliding_window=WINDOW,
+        attention_dropout=0.0,
+    )
+    model = MistralForCausalLM(config)
+    model.eval()
+    return model
+
+
+class TestSlidingWindow:
+    def test_window_wider_than_sequence_is_full_attention(self):
+        config = tiny_config()
+        windowed = tiny_config(sliding_window=64)
+        params = init_llama_params(jax.random.key(0), config)
+        tokens = jax.random.randint(jax.random.key(1), (2, 12), 0, config.vocab_size)
+        np.testing.assert_array_equal(
+            np.asarray(llama_forward(params, tokens, config)),
+            np.asarray(llama_forward(params, tokens, windowed)),
+        )
+
+    def test_window_changes_logits_beyond_band(self):
+        config = tiny_config()
+        windowed = tiny_config(sliding_window=4)
+        params = init_llama_params(jax.random.key(0), config)
+        tokens = jax.random.randint(jax.random.key(1), (1, 16), 0, config.vocab_size)
+        full = np.asarray(llama_forward(params, tokens, config))
+        band = np.asarray(llama_forward(params, tokens, windowed))
+        # inside the band identical, beyond it different
+        np.testing.assert_allclose(full[:, :4], band[:, :4], atol=1e-5)
+        assert not np.allclose(full[:, -1], band[:, -1])
+
+    def test_mistral_logits_match_torch(self, hf_mistral):
+        params, config = load_hf_llama(hf_mistral, dtype=jnp.float32)
+        assert config.sliding_window == WINDOW
+        # sequence twice the window so the band actually truncates
+        tokens_np = np.array(
+            [[1, 5, 9, 42, 17, 99, 3, 64, 7, 21, 88, 120, 2, 33, 54, 76]],
+            dtype=np.int64,
+        )
+        with torch.no_grad():
+            want = hf_mistral(torch.from_numpy(tokens_np)).logits.numpy()
+        got = np.asarray(llama_forward(params, jnp.asarray(tokens_np), config))
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_windowed_kv_generation_matches_cache_free_oracle(self, hf_mistral):
+        params, config = load_hf_llama(hf_mistral, dtype=jnp.float32)
+        prompt = jnp.asarray([[2, 11, 23, 5, 77, 41, 8, 19, 101, 64]], jnp.int32)
+        want = reference_generate(params, prompt, config, max_new_tokens=8)
+        got = generate(params, prompt, config, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    def test_windowed_generation_matches_torch(self, hf_mistral):
+        params, config = load_hf_llama(hf_mistral, dtype=jnp.float32)
+        prompt_np = np.array([[2, 11, 23, 5, 77, 41, 8, 19]], dtype=np.int64)
+        with torch.no_grad():
+            want = hf_mistral.generate(
+                torch.from_numpy(prompt_np),
+                max_new_tokens=8,
+                do_sample=False,
+                num_beams=1,
+            ).numpy()[:, prompt_np.shape[1]:]
+        got = generate(params, jnp.asarray(prompt_np), config, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_flash_with_window_rejected(self):
+        config = tiny_config(sliding_window=4, attention="flash")
+        params = init_llama_params(jax.random.key(0), config)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError):
+            llama_forward(params, tokens, config)
+
+    def test_left_padded_prefill_rejected(self):
+        config = tiny_config(sliding_window=4)
+        params = init_llama_params(jax.random.key(0), config)
+        with pytest.raises(ValueError):
+            prefill(params, jnp.zeros((1, 8), jnp.int32), config, 16, pad_id=0)
+
+    def test_engine_serves_windowed_config(self):
+        from nos_tpu.serve import Engine, GenRequest
+
+        config = tiny_config(sliding_window=6)
+        params = init_llama_params(jax.random.key(0), config)
+        prompt = np.asarray(
+            jax.random.randint(jax.random.key(2), (10,), 1, config.vocab_size)
+        ).tolist()
+        want = np.asarray(
+            generate(params, jnp.asarray([prompt], jnp.int32), config, max_new_tokens=5)
+        )[0].tolist()
+        eng = Engine(params, config, max_slots=2, max_len=64)
+        rid = eng.submit(GenRequest(prompt=prompt, max_new_tokens=5))
+        assert eng.run()[rid] == want
