@@ -42,6 +42,7 @@ from nos_tpu.models.generate import (
     prefill,
 )
 from nos_tpu.models.llama import LlamaConfig
+from nos_tpu.util import metrics
 
 # Left-pad bucket: token id that can never appear in a real prompt.
 PAD_ID = -1
@@ -130,6 +131,7 @@ class Engine:
         self._done: List[Completion] = []
         self._ids = itertools.count()
         self.ticks = 0
+        metrics.SERVE_SLOTS.set(max_slots)
 
         ticks = self.ticks_per_sync
 
@@ -208,6 +210,7 @@ class Engine:
                 f"chunked decode) > engine max_len {self.max_len}"
             )
         self._queue.append(request)
+        metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         return request.id
 
     def run(self) -> Dict[int, List[int]]:
@@ -385,6 +388,10 @@ class Engine:
             )
         tokens = np.asarray(toks)  # [ticks_per_sync, B]
         ticks = tokens.shape[0]
+        active_slots = sum(1 for s in self._slots if s is not None)
+        metrics.SERVE_TICKS.inc(ticks)
+        metrics.SERVE_SLOT_TICKS_ACTIVE.inc(ticks * active_slots)
+        metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         # Host state mirrors the device chunk exactly: every row advanced
         # `ticks` positions whether its tenant needed them or not.
         self._pos += ticks
@@ -403,6 +410,8 @@ class Engine:
         slot = self._slots[b]
         if slot is not None and slot.done:
             self._done.append(Completion(id=slot.request.id, tokens=slot.out))
+            metrics.SERVE_REQUESTS.inc()
+            metrics.SERVE_TOKENS.inc(len(slot.out))
             self._slots[b] = None
             # stale sampling params must not keep the sampled program hot
             self._temp[b] = 0.0

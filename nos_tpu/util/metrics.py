@@ -186,3 +186,26 @@ LEADER_TRANSITIONS = REGISTRY.counter(
     "nos_tpu_leader_transitions_total",
     "Leadership acquisitions across all components' leases",
 )
+
+# Serving engine (a replica exports these next to the control-plane set).
+SERVE_REQUESTS = REGISTRY.counter(
+    "nos_tpu_serve_requests_total", "Requests completed by the serving engine"
+)
+SERVE_TOKENS = REGISTRY.counter(
+    "nos_tpu_serve_tokens_total", "Tokens generated by the serving engine"
+)
+SERVE_TICKS = REGISTRY.counter(
+    "nos_tpu_serve_decode_ticks_total",
+    "Batched decode ticks executed (each reads the weights once)",
+)
+SERVE_SLOT_TICKS_ACTIVE = REGISTRY.counter(
+    "nos_tpu_serve_slot_ticks_active_total",
+    "Per-slot ticks spent on live requests (active / (ticks*slots) = "
+    "batch occupancy)",
+)
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "nos_tpu_serve_queue_depth", "Requests waiting for a free slot"
+)
+SERVE_SLOTS = REGISTRY.gauge(
+    "nos_tpu_serve_slots", "Configured slot count (the occupancy denominator)"
+)

@@ -191,3 +191,31 @@ class TestEngineSampling:
             return eng.run()[rid]
 
         assert tokens_of(False) == tokens_of(True)
+
+
+class TestEngineMetrics:
+    def test_serving_counters_advance(self, setup):
+        from nos_tpu.util import metrics
+
+        config, params = setup
+        req0 = metrics.SERVE_REQUESTS.value
+        tok0 = metrics.SERVE_TOKENS.value
+        tick0 = metrics.SERVE_TICKS.value
+        active0 = metrics.SERVE_SLOT_TICKS_ACTIVE.value
+        eng = Engine(params, config, max_slots=2, max_len=64)
+        ids = [
+            eng.submit(GenRequest(
+                prompt=rand_prompt(jax.random.key(90 + i), 5, config.vocab_size),
+                max_new_tokens=4,
+            ))
+            for i in range(3)
+        ]
+        eng.run()
+        assert metrics.SERVE_REQUESTS.value - req0 == 3
+        assert metrics.SERVE_TOKENS.value - tok0 == 12
+        assert metrics.SERVE_TICKS.value > tick0
+        assert metrics.SERVE_SLOTS.value == 2
+        # occupancy numerator never exceeds this engine's ticks * slots
+        tick_delta = metrics.SERVE_TICKS.value - tick0
+        active_delta = metrics.SERVE_SLOT_TICKS_ACTIVE.value - active0
+        assert 0 < active_delta <= tick_delta * 2
