@@ -141,21 +141,27 @@ class NodeAffinity:
 @dataclass
 class PodAffinityTerm:
     """requiredDuringSchedulingIgnoredDuringExecution pod (anti-)affinity
-    term: selects PODS by matchLabels within a topology domain."""
+    term: selects PODS by labelSelector (matchLabels AND matchExpressions,
+    k8s semantics) within a topology domain."""
 
     topology_key: str = ""
-    # matchLabels only; matchExpressions are not modeled.
     match_labels: Dict[str, str] = field(default_factory=dict)
+    # In/NotIn/Exists/DoesNotExist over pod labels (NodeSelectorRequirement
+    # evaluates the same operator set).
+    match_expressions: List["NodeSelectorRequirement"] = field(default_factory=list)
     # Empty = the owning pod's own namespace (k8s default).
     namespaces: List[str] = field(default_factory=list)
 
     def selects(self, pod_labels: Dict[str, str], pod_ns: str, own_ns: str) -> bool:
-        if not self.match_labels:
+        if not self.match_labels and not self.match_expressions:
+            # nil selector matches NO pods (upstream semantics)
             return False
         allowed = self.namespaces or [own_ns]
         if pod_ns not in allowed:
             return False
-        return all(pod_labels.get(k) == v for k, v in self.match_labels.items())
+        if not all(pod_labels.get(k) == v for k, v in self.match_labels.items()):
+            return False
+        return all(r.matches(pod_labels) for r in self.match_expressions)
 
 
 @dataclass

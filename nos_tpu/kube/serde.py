@@ -264,8 +264,16 @@ def _pod_terms_to_wire(terms: List[PodAffinityTerm]) -> List[Dict[str, Any]]:
     out = []
     for t in terms:
         entry: Dict[str, Any] = {"topologyKey": t.topology_key}
+        selector: Dict[str, Any] = {}
         if t.match_labels:
-            entry["labelSelector"] = {"matchLabels": dict(t.match_labels)}
+            selector["matchLabels"] = dict(t.match_labels)
+        if t.match_expressions:
+            selector["matchExpressions"] = [
+                {"key": r.key, "operator": r.operator, "values": list(r.values)}
+                for r in t.match_expressions
+            ]
+        if selector:
+            entry["labelSelector"] = selector
         if t.namespaces:
             entry["namespaces"] = list(t.namespaces)
         out.append(entry)
@@ -276,18 +284,25 @@ def _pod_terms_from_wire(block: Optional[Dict[str, Any]]) -> List[PodAffinityTer
     terms = (block or {}).get("requiredDuringSchedulingIgnoredDuringExecution") or []
     out = []
     for t in terms:
-        match_labels = dict((t.get("labelSelector") or {}).get("matchLabels") or {})
-        if not match_labels:
-            # matchExpressions-only or empty selectors are not modeled;
-            # keeping them would turn into match-NOTHING terms (selects()
-            # on empty labels), making a positive podAffinity pod
-            # permanently unschedulable. Drop the term at ingest instead
-            # (same {}-vs-nil hazard the spread codec guards against).
+        selector = t.get("labelSelector") or {}
+        match_labels = dict(selector.get("matchLabels") or {})
+        match_expressions = [
+            NodeSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=list(e.get("values") or []),
+            )
+            for e in selector.get("matchExpressions") or []
+        ]
+        if not match_labels and not match_expressions:
+            # empty selectors stay nil (match nothing) — dropping keeps the
+            # {}-vs-nil hazard contained at ingest like the spread codec
             continue
         out.append(
             PodAffinityTerm(
                 topology_key=t.get("topologyKey", ""),
                 match_labels=match_labels,
+                match_expressions=match_expressions,
                 namespaces=list(t.get("namespaces") or []),
             )
         )

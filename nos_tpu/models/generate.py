@@ -376,9 +376,12 @@ def generate(
     config: LlamaConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    # keyword-only: inserting these positionally would silently rebind
+    # existing callers' positional rng/pad_id/eos_id arguments
+    *,
     top_k: int = 0,
     top_p: float = 1.0,
-    rng: Optional[jax.Array] = None,
     pad_id: Optional[int] = None,
     eos_id: Optional[int] = None,
 ) -> jax.Array:

@@ -447,23 +447,41 @@ class InterPodAffinityFit:
 
     def _index(self, state: CycleState):
         """Per-node view of the published cluster: {node name: (node
-        labels, [pods])}. Kept per-node (not flattened) so filter() can
-        substitute the handed-in trial NodeInfo for its published entry —
-        preemption simulates victim eviction through that substitution,
-        exactly like PodTopologySpreadFit."""
+        labels, [pods])} plus a precomputed per-node list of anti-affinity
+        entries [(term, owner_ns, domain)] — the symmetric check runs per
+        filter call, so it must cost O(anti-affine pods), not a full
+        cluster scan. Kept per-node so filter() can substitute the
+        handed-in trial NodeInfo for its published entry — preemption
+        simulates victim eviction through that substitution, exactly like
+        PodTopologySpreadFit."""
         cached = state.get(self._CACHE_KEY)
         if cached is not None:
             return cached
         all_infos: Sequence[NodeInfo] = state.get(TOPOLOGY_NODE_INFOS_KEY) or []
-        cached = {
-            info.name: (info.node.metadata.labels, info.pods) for info in all_infos
-        }
+        by_node = {}
+        anti_by_node = {}
+        for info in all_infos:
+            by_node[info.name] = (info.node.metadata.labels, info.pods)
+            anti_by_node[info.name] = self._anti_entries(info)
+        cached = {"by_node": by_node, "anti_by_node": anti_by_node}
         state[self._CACHE_KEY] = cached
         return cached
 
+    @staticmethod
+    def _anti_entries(info: NodeInfo):
+        entries = []
+        n_labels = info.node.metadata.labels
+        for p in info.pods:
+            for term in p.spec.pod_anti_affinity:
+                domain = n_labels.get(term.topology_key)
+                if domain is not None:
+                    entries.append((term, p.metadata.namespace, domain))
+        return entries
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         has_terms = pod.spec.pod_affinity or pod.spec.pod_anti_affinity
-        by_node = dict(self._index(state))
+        index = self._index(state)
+        by_node = dict(index["by_node"])
         # The handed view of this node wins over the published one: on the
         # normal path they are identical; under preemption the trial has
         # victims removed and THAT is what must be matched against.
@@ -473,21 +491,29 @@ class InterPodAffinityFit:
 
         # Symmetric anti-affinity applies to EVERY incoming pod, terms or
         # not: an existing pod's required anti-affinity rejects the
-        # incoming pod from its domain.
-        for n_labels, pods_ in by_node.values():
-            for p in pods_:
-                for term in p.spec.pod_anti_affinity:
-                    domain = n_labels.get(term.topology_key)
-                    if domain is None:
-                        continue
-                    if node_labels.get(term.topology_key) == domain and term.selects(
-                        pod.metadata.labels, own_ns, p.metadata.namespace
-                    ):
-                        return Status.unschedulable(
-                            f"an existing pod's anti-affinity "
-                            f"({term.topology_key}={domain}) excludes this pod",
-                            self.name,
-                        )
+        # incoming pod from its domain. Precomputed entries (candidate
+        # node's recomputed from the trial view).
+        for name, entries in index["anti_by_node"].items():
+            if name == node_info.name:
+                continue
+            for term, owner_ns, domain in entries:
+                if node_labels.get(term.topology_key) == domain and term.selects(
+                    pod.metadata.labels, own_ns, owner_ns
+                ):
+                    return Status.unschedulable(
+                        f"an existing pod's anti-affinity "
+                        f"({term.topology_key}={domain}) excludes this pod",
+                        self.name,
+                    )
+        for term, owner_ns, domain in self._anti_entries(node_info):
+            if node_labels.get(term.topology_key) == domain and term.selects(
+                pod.metadata.labels, own_ns, owner_ns
+            ):
+                return Status.unschedulable(
+                    f"an existing pod's anti-affinity "
+                    f"({term.topology_key}={domain}) excludes this pod",
+                    self.name,
+                )
         if not has_terms:
             return Status.ok()
         for term in pod.spec.pod_affinity:

@@ -240,10 +240,20 @@ class Preemptor:
             PodTopologySpreadFit,
         )
 
-        has_spread = any(
-            c.when_unsatisfiable == "DoNotSchedule"
-            for c in pod.spec.topology_spread_constraints
-        ) or bool(pod.spec.pod_affinity or pod.spec.pod_anti_affinity)
+        has_spread = (
+            any(
+                c.when_unsatisfiable == "DoNotSchedule"
+                for c in pod.spec.topology_spread_constraints
+            )
+            or bool(pod.spec.pod_affinity or pod.spec.pod_anti_affinity)
+            # victims' own anti-affinity is SYMMETRIC: a remote gang member
+            # whose term excludes the preemptor must disappear from the
+            # published view when its unit is trial-evicted, or feasible()
+            # keeps seeing the conflict eviction would resolve
+            or any(
+                m.spec.pod_anti_affinity for u in units for m in u.members
+            )
+        )
         published = state.get(TOPOLOGY_NODE_INFOS_KEY) if has_spread else None
         remote_trials: Dict[str, NodeInfo] = {}
 

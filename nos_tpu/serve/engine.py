@@ -181,10 +181,34 @@ class Engine:
 
         self._ingest = jax.jit(_ingest, donate_argnums=(1,))
 
+        def _splice(cache, row_cache, b):
+            # donated in-place row writes: without this, each of the
+            # 2*n_layers eager dynamic_update_slice calls would copy the
+            # whole batch cache through HBM per admission
+            return [
+                {
+                    key: jax.lax.dynamic_update_slice(
+                        layer[key], row[key][:, : self.max_len], (b, 0, 0, 0)
+                    )
+                    for key in ("k", "v")
+                }
+                for layer, row in zip(cache, row_cache)
+            ]
+
+        self._splice = jax.jit(_splice, donate_argnums=(0,))
+
     # ---------------------------------------------------------- frontend
 
     def submit(self, request: GenRequest) -> int:
         request.id = next(self._ids)
+        if not request.prompt:
+            # an empty prompt has no admission logits: the chunked path
+            # would crash mid-run and the padded path would emit garbage
+            raise ValueError("prompt must contain at least one token")
+        if request.max_new_tokens < 1:
+            # admission always emits the prefill token, so 0 cannot be
+            # honored as a budget
+            raise ValueError("max_new_tokens must be >= 1")
         if len(request.prompt) > self.max_len:
             # _bucket clamps to max_len, so the chunk math below would
             # wave an over-long prompt through and crash mid-run instead.
@@ -255,11 +279,7 @@ class Engine:
             [[PAD_ID] * pad + list(request.prompt)], jnp.int32
         )
         first, first_logits, row_cache = self._prefill_for(bucket)(self.params, padded)
-        for layer, row in zip(self._cache, row_cache):
-            for key in ("k", "v"):
-                layer[key] = jax.lax.dynamic_update_slice(
-                    layer[key], row[key], (b, 0, 0, 0)
-                )
+        self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))
         slot = _Slot(request=request)
         self._slots[b] = slot
         self._pos[b] = bucket
@@ -301,11 +321,7 @@ class Engine:
             )
         last_idx = (length - 1) % n
         first = int(jnp.argmax(logits[0, last_idx]))
-        for layer, row in zip(self._cache, row_cache):
-            for key in ("k", "v"):
-                layer[key] = jax.lax.dynamic_update_slice(
-                    layer[key], row[key][:, : self.max_len], (b, 0, 0, 0)
-                )
+        self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))
         slot = _Slot(request=request)
         self._slots[b] = slot
         self._pos[b] = length

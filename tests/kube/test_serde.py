@@ -209,3 +209,28 @@ class TestRoundTrips:
         assert not t.tolerates(Taint(key="tpu", value="no", effect="NoSchedule"))
         wildcard = Toleration(operator="Exists")
         assert wildcard.tolerates(Taint(key="anything", effect="NoExecute"))
+
+
+class TestPodAffinityExpressions:
+    def test_match_expressions_roundtrip(self):
+        from nos_tpu.kube.objects import NodeSelectorRequirement, PodAffinityTerm
+
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace="ns"),
+            spec=PodSpec(
+                containers=[Container()],
+                pod_anti_affinity=[PodAffinityTerm(
+                    topology_key="zone",
+                    match_expressions=[NodeSelectorRequirement(
+                        key="app", operator="In", values=["web", "api"],
+                    )],
+                )],
+            ),
+        )
+        back = serde.from_wire(serde.to_wire(pod))
+        term = back.spec.pod_anti_affinity[0]
+        assert term.match_expressions[0].key == "app"
+        assert term.match_expressions[0].values == ["web", "api"]
+        # the term must actually select by expression
+        assert term.selects({"app": "api"}, "ns", "ns")
+        assert not term.selects({"app": "db"}, "ns", "ns")

@@ -95,6 +95,11 @@ class TestEngineParity:
         # (the bucket clamp would otherwise wave it through)
         with pytest.raises(ValueError):
             eng.submit(GenRequest(prompt=[1] * 40, max_new_tokens=1))
+        # degenerate requests fail loudly at submit, not mid-batch
+        with pytest.raises(ValueError):
+            eng.submit(GenRequest(prompt=[], max_new_tokens=4))
+        with pytest.raises(ValueError):
+            eng.submit(GenRequest(prompt=[1, 2], max_new_tokens=0))
 
     def test_chunked_prefill_matches_solo(self, setup):
         """Long prompts admit via fixed-size decode_chunk pieces (no

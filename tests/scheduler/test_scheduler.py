@@ -550,6 +550,27 @@ class TestInterPodAffinity:
         sched_pod(s, store, incoming)
         assert store.get("Pod", "web-new", "default").spec.node_name == "n-b"
 
+    def test_match_expressions_terms_enforced(self):
+        from nos_tpu.kube.objects import NodeSelectorRequirement, PodAffinityTerm
+
+        store = KubeStore()
+        self.zone_node(store, "n-a", "zone-a")
+        self.zone_node(store, "n-b", "zone-b")
+        store.create(self.web_pod("web-0", "n-a"))
+        s = make_scheduler(store)
+        pod = build_pod("web-1", {"cpu": 1})
+        pod.metadata.labels["app"] = "web"
+        # matchExpressions-only selector (operator In) — previously dropped
+        # at ingest; must spread like the matchLabels equivalent
+        pod.spec.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="topology.kubernetes.io/zone",
+            match_expressions=[NodeSelectorRequirement(
+                key="app", operator="In", values=["web"],
+            )],
+        )]
+        sched_pod(s, store, pod)
+        assert store.get("Pod", "web-1", "default").spec.node_name == "n-b"
+
     def test_namespace_scoping_defaults_to_own_namespace(self):
         from nos_tpu.kube.objects import PodAffinityTerm
 
