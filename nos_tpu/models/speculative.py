@@ -136,6 +136,10 @@ def speculative_generate(
         active = [
             not d and len(r) < max_new_tokens for r, d in zip(rows, done)
         ]
+        # Finished rows keep riding the batch while pos advances up to k+1
+        # per round; clamp so their k+1 chunk writes stay inside max_len
+        # (active rows never reach the clamp by the max_len sizing above).
+        pos = jnp.minimum(pos, max_len - k - 1)
         t_cache, d_cache, pos, last, _, out, count = round_fn(
             t_cache, d_cache, pos, last
         )

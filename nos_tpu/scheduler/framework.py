@@ -438,17 +438,19 @@ class InterPodAffinityFit:
       required anti-affinity also rejects the incoming pod from its
       domain. (Existing pods' positive affinity is not symmetric.)
 
-    Per-cycle indexes are cached in CycleState so each node filter is a
-    lookup, not a cluster scan.
+    Per-cycle indexes are cached in CycleState — the symmetric
+    anti-affinity entries AND per-term match locations for the incoming
+    pod's own terms — so each node filter costs O(pods on the candidate
+    node) for the trial-view rescan, never a cluster scan.
     """
 
     name = "InterPodAffinity"
     _CACHE_KEY = "inter_pod_affinity_index"
+    _TERM_CACHE_KEY = "inter_pod_affinity_term_index"
 
     def _index(self, state: CycleState):
-        """Per-node view of the published cluster: {node name: (node
-        labels, [pods])} plus a precomputed per-node list of anti-affinity
-        entries [(term, owner_ns, domain)] — the symmetric check runs per
+        """Precomputed per-node list of anti-affinity entries
+        [(term, owner_ns, domain)] for the symmetric check — it runs per
         filter call, so it must cost O(anti-affine pods), not a full
         cluster scan. Kept per-node so filter() can substitute the
         handed-in trial NodeInfo for its published entry — preemption
@@ -458,13 +460,48 @@ class InterPodAffinityFit:
         if cached is not None:
             return cached
         all_infos: Sequence[NodeInfo] = state.get(TOPOLOGY_NODE_INFOS_KEY) or []
-        by_node = {}
         anti_by_node = {}
         for info in all_infos:
-            by_node[info.name] = (info.node.metadata.labels, info.pods)
             anti_by_node[info.name] = self._anti_entries(info)
-        cached = {"by_node": by_node, "anti_by_node": anti_by_node}
+        cached = {"anti_by_node": anti_by_node}
         state[self._CACHE_KEY] = cached
+        return cached
+
+    def _term_index(self, state: CycleState, pod: Pod):
+        """Per-term match locations over the published cluster for the
+        incoming pod's own affinity/anti-affinity terms, computed once per
+        cycle: for each term, which nodes hold a matching pod
+        (``node_hit``), how many such nodes sit in each topology domain
+        (``domain_hits``) and in total (``total_hits``). filter() then
+        answers matched-here/matched-any by subtracting the candidate's
+        published contribution and rescanning only the candidate's trial
+        view (so preemption victim-eviction is still honored)."""
+        cached = state.get(self._TERM_CACHE_KEY)
+        if cached is not None:
+            return cached
+        all_infos: Sequence[NodeInfo] = state.get(TOPOLOGY_NODE_INFOS_KEY) or []
+        own_ns = pod.metadata.namespace
+        cached = {}
+        for term in list(pod.spec.pod_affinity) + list(pod.spec.pod_anti_affinity):
+            key = id(term)
+            if key in cached:
+                continue
+            node_hit = {}
+            domain_hits: Dict[str, int] = {}
+            total_hits = 0
+            for info in all_infos:
+                hit = any(
+                    term.selects(p.metadata.labels, p.metadata.namespace, own_ns)
+                    for p in info.pods
+                )
+                node_hit[info.name] = hit
+                if hit:
+                    total_hits += 1
+                    domain = info.node.metadata.labels.get(term.topology_key)
+                    if domain is not None:
+                        domain_hits[domain] = domain_hits.get(domain, 0) + 1
+            cached[key] = (node_hit, domain_hits, total_hits)
+        state[self._TERM_CACHE_KEY] = cached
         return cached
 
     @staticmethod
@@ -481,11 +518,6 @@ class InterPodAffinityFit:
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         has_terms = pod.spec.pod_affinity or pod.spec.pod_anti_affinity
         index = self._index(state)
-        by_node = dict(index["by_node"])
-        # The handed view of this node wins over the published one: on the
-        # normal path they are identical; under preemption the trial has
-        # victims removed and THAT is what must be matched against.
-        by_node[node_info.name] = (node_info.node.metadata.labels, node_info.pods)
         node_labels = node_info.node.metadata.labels
         own_ns = pod.metadata.namespace
 
@@ -516,24 +548,30 @@ class InterPodAffinityFit:
                 )
         if not has_terms:
             return Status.ok()
+        # Per-term published-cluster index, minus the candidate's published
+        # contribution, plus a rescan of ONLY the candidate's trial view —
+        # on the normal path they're identical; under preemption the trial
+        # has victims removed and THAT is what must be matched against.
+        term_index = self._term_index(state, pod)
+
+        def trial_hit(term) -> bool:
+            return any(
+                term.selects(p.metadata.labels, p.metadata.namespace, own_ns)
+                for p in node_info.pods
+            )
+
         for term in pod.spec.pod_affinity:
             domain = node_labels.get(term.topology_key)
             if domain is None:
                 return Status.unschedulable(
                     f"node has no {term.topology_key} label", self.name
                 )
-            matched_any = False
-            matched_here = False
-            for n_labels, pods_ in by_node.values():
-                for p in pods_:
-                    if term.selects(p.metadata.labels, p.metadata.namespace, own_ns):
-                        matched_any = True
-                        if n_labels.get(term.topology_key) == domain:
-                            matched_here = True
-                            break
-                if matched_here:
-                    break
+            node_hit, domain_hits, total_hits = term_index[id(term)]
+            cand_pub = 1 if node_hit.get(node_info.name) else 0
+            here = trial_hit(term)
+            matched_here = here or domain_hits.get(domain, 0) - cand_pub > 0
             if not matched_here:
+                matched_any = here or total_hits - cand_pub > 0
                 # bootstrap: a self-affine group's first replica
                 if not matched_any and term.selects(
                     pod.metadata.labels, own_ns, own_ns
@@ -548,16 +586,14 @@ class InterPodAffinityFit:
             domain = node_labels.get(term.topology_key)
             if domain is None:
                 continue  # no domain -> nothing to collide with (upstream)
-            for n_labels, pods_ in by_node.values():
-                if n_labels.get(term.topology_key) != domain:
-                    continue
-                for p in pods_:
-                    if term.selects(p.metadata.labels, p.metadata.namespace, own_ns):
-                        return Status.unschedulable(
-                            f"anti-affinity: matching pod already in "
-                            f"{term.topology_key}={domain}",
-                            self.name,
-                        )
+            node_hit, domain_hits, total_hits = term_index[id(term)]
+            cand_pub = 1 if node_hit.get(node_info.name) else 0
+            if trial_hit(term) or domain_hits.get(domain, 0) - cand_pub > 0:
+                return Status.unschedulable(
+                    f"anti-affinity: matching pod already in "
+                    f"{term.topology_key}={domain}",
+                    self.name,
+                )
         return Status.ok()
 
 

@@ -278,6 +278,7 @@ class Preemptor:
             ]
             overlay.pop(PodTopologySpreadFit._CACHE_KEY, None)
             overlay.pop(InterPodAffinityFit._CACHE_KEY, None)
+            overlay.pop(InterPodAffinityFit._TERM_CACHE_KEY, None)
             return overlay
 
         def feasible(trial: NodeInfo) -> bool:

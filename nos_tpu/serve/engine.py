@@ -421,6 +421,12 @@ class Engine:
                     break
                 self._emit(b, int(tokens[j, b]))
             self._retire(b)
+        # Idle rows still ride every chunk; pinning them at 0 keeps their
+        # scatter writes in-bounds forever (re-admission overwrites the row).
+        for b in range(self.slots_n):
+            if self._slots[b] is None:
+                self._pos[b] = 0
+                self._rope[b] = 0
 
     def _retire(self, b: int) -> None:
         slot = self._slots[b]
@@ -433,3 +439,8 @@ class Engine:
             self._temp[b] = 0.0
             self._topk[b] = 0
             self._topp[b] = 1.0
+            # retired rows must stop scatter-writing past max_len and stop
+            # attending stale K/V: rewind and invalidate the cache row
+            self._pos[b] = 0
+            self._rope[b] = 0
+            self._key_valid[b, :] = False
