@@ -561,30 +561,40 @@ def run_control_plane_bench() -> dict:
                 key = (pod.metadata.namespace, pod.metadata.name)
                 finish_at[key] = t_stream + rng.uniform(0.3, 1.5)
                 job_chips[key] = res.tpu_chips_in(res.compute_pod_request(pod))
+        idle_samples = []  # (t0, t1, idle chips, pending chips)
+        t_prev = t_stream
         while time.monotonic() - t_stream < STREAM_S:
             now = time.monotonic()
+            running_now = pending_now = 0
+            # One store scan per tick: the submitter competes with the
+            # control plane for the same (possibly single) core.
             for pod in all_pods():
                 key = (pod.metadata.namespace, pod.metadata.name)
+                chips_ = job_chips.get(key)
+                if chips_ is None:
+                    chips_ = res.tpu_chips_in(res.compute_pod_request(pod))
                 if pod.status.phase == PodPhase.RUNNING and pod.spec.node_name:
                     bound_at.setdefault(key, now)
-                if (
-                    pod.status.phase == PodPhase.RUNNING
-                    and now >= finish_at.get(key, now + 1e9)
-                ):
-                    def fin(p):
-                        p.status.phase = PodPhase.SUCCEEDED
+                    if now >= finish_at.get(key, now + 1e9):
+                        def fin(p):
+                            p.status.phase = PodPhase.SUCCEEDED
 
-                    cluster.store.patch_merge(
-                        "Pod", pod.metadata.name, pod.metadata.namespace, fin
-                    )
-                    finished_at[key] = now
-                    stream_done["n"] += 1
-            backlog = sum(
-                res.tpu_chips_in(res.compute_pod_request(p))
-                for p in all_pods()
-                if p.status.phase == PodPhase.PENDING
-            )
-            while backlog < 8:
+                        cluster.store.patch_merge(
+                            "Pod", pod.metadata.name, pod.metadata.namespace, fin
+                        )
+                        finished_at[key] = now
+                        stream_done["n"] += 1
+                    else:
+                        running_now += chips_
+                elif pod.status.phase == PodPhase.PENDING:
+                    pending_now += chips_
+            idle_samples.append((t_prev, now, TOTAL - running_now, pending_now))
+            t_prev = now
+            backlog = pending_now
+            # Half a cluster of queued demand: enough that a full-board job
+            # draining its reserved node never single-handedly starves the
+            # submitter (a loaded cluster's queue is deeper than one job).
+            while backlog < 16:
                 chips = rng.choice([1, 2, 2, 4, 4, 4, 8])
                 name = submit(chips)
                 finish_at[("bench", name)] = now + rng.uniform(2.0, 5.0)
@@ -617,6 +627,46 @@ def run_control_plane_bench() -> dict:
         log(f"phase2 stream: {util:.1f}% event-integrated utilization over "
             f"{w1 - w0:.1f}s steady window, {stream_done['n']} jobs "
             f"completed; per-second %: {series}")
+        # Per-size bind-wait distribution: how long did jobs of each size
+        # pend before binding (the submitter creates them pre-bound only
+        # in the fill phase)?
+        waits_by_size: dict = {}
+        for key, chips in job_chips.items():
+            if key in bound_at and key in created_at:
+                waits_by_size.setdefault(chips, []).append(
+                    bound_at[key] - created_at[key]
+                )
+        for chips in sorted(waits_by_size):
+            ws = sorted(waits_by_size[chips])
+            log(
+                f"phase2 waits {chips}-chip jobs: n={len(ws)} "
+                f"p50={statistics.median(ws):.2f}s max={ws[-1]:.2f}s "
+                f"sum={sum(ws):.1f}s "
+                f"all={[round(w, 2) for w in ws]}"
+            )
+        # Idle attribution: idle chip-seconds while pending demand existed
+        # (scheduling/carve inefficiency) vs while the submitter's backlog
+        # was empty of schedulable demand (workload starvation).
+        ineff = starv = 0.0
+        for t0, t1, idle, pend in idle_samples:
+            dt = max(0.0, min(t1, w1) - max(t0, w0))
+            if dt <= 0:
+                continue
+            covered = min(idle, pend)
+            ineff += covered * dt
+            starv += (idle - covered) * dt
+        denom = (w1 - w0) * TOTAL
+        log(
+            f"phase2 idle attribution: {100.0 * ineff / denom:.1f}% "
+            f"idle-with-pending-demand (scheduling inefficiency), "
+            f"{100.0 * starv / denom:.1f}% idle-no-pending-demand "
+            f"(submitter starvation)"
+        )
+        log(
+            f"phase2 control events: {m.BOARD_RESERVATIONS.value} board "
+            f"reservations, {m.DIVERGENCE_REPLANS.value} divergence "
+            f"replans, {m.PLANS_APPLIED.value} plans applied"
+        )
         delete_all_pods()
 
         # ---- Phase 3: contention + quota borrowing + preemption.
@@ -725,7 +775,7 @@ def main() -> None:
     if "--tpu-child" in sys.argv:
         run_tpu_child()
         return
-    tpu = run_tpu_bench_subprocess()
+    tpu = {} if "--control-plane-only" in sys.argv else run_tpu_bench_subprocess()
     cp = run_control_plane_bench()
     util = cp.get("utilization_pct", 0.0)
     line = {

@@ -65,11 +65,16 @@ def build_partitioner(
     # are kept co-located by the gang pre-pass running per node pool's
     # nodes in sequence — a cross-pool split inside a single plan resolves
     # via permit-timeout + replan, the level-triggered backstop).
+    from nos_tpu.scheduler.plugins.reservation import BoardReservation
     from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
 
     sim_framework = Framework(
         pre_filter_plugins=[capacity],
-        filter_plugins=vanilla_filter_plugins() + [MultihostIciFilter(store)],
+        filter_plugins=vanilla_filter_plugins()
+        # Simulation fidelity (SURVEY §7): the planner must not carve for
+        # pods the real scheduler would reject — including pods a board
+        # reservation keeps off a draining node.
+        + [MultihostIciFilter(store), BoardReservation(store)],
     )
 
     controller = PartitionerController(
@@ -96,6 +101,45 @@ def build_partitioner(
         )
     )
     manager.add(Controller("state-pod", store, pod_ctrl.reconcile, [Watch(kind="Pod")]))
+
+    # Actuation-divergence feedback: when an agent acknowledges a plan but
+    # reports a geometry that differs from spec (the clamp path), replan
+    # immediately instead of waiting out the next pod batch window.
+    from nos_tpu.util.predicates import annotations_changed_or_added
+
+    manager.add(
+        Controller(
+            "partitioner-divergence",
+            store,
+            controller.reconcile_node_divergence,
+            [
+                Watch(
+                    kind="Node",
+                    predicate=lambda e: e.type != "DELETED"
+                    and annotations_changed_or_added(e),
+                )
+            ],
+        )
+    )
+
+    # Capacity-freed feedback: a bound pod finishing (or deleted) frees
+    # chips; with pods still pending, replan immediately rather than
+    # letting the freed chips idle until the next batch window.
+    def _freed_capacity_predicate(e):
+        obj = e.object
+        return bool(obj.spec.node_name) and (
+            e.type == "DELETED"
+            or obj.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        )
+
+    manager.add(
+        Controller(
+            "partitioner-capacity-freed",
+            store,
+            controller.reconcile_capacity_freed,
+            [Watch(kind="Pod", predicate=_freed_capacity_predicate)],
+        )
+    )
 
     # Multi-host slice expansion: a plain-chip request exceeding one board
     # becomes a gang of per-host board slices (BASELINE config #5; the

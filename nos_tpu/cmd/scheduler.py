@@ -23,13 +23,28 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
         retry_seconds=config.retry_seconds,
     )
 
-    def node_event_mapper(event):
-        # A node change (new slices advertised) can unblock any pending pod.
+    def pending_pod_requests():
         return [
             Request(name=p.metadata.name, namespace=p.metadata.namespace)
             for p in store.list("Pod")
             if p.status.phase == PodPhase.PENDING and not p.spec.node_name
         ]
+
+    def node_event_mapper(event):
+        # A node change (new slices advertised) can unblock any pending pod.
+        return pending_pod_requests()
+
+    def pod_freed_mapper(event):
+        # A bound pod finishing (or deleted) frees its slice: retry pending
+        # pods immediately instead of waiting out the retry backoff — a
+        # same-shaped pending pod binds onto the freed slice with no replan.
+        obj = event.object
+        if bool(obj.spec.node_name) and (
+            event.type == "DELETED"
+            or obj.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        ):
+            return pending_pod_requests()
+        return []
 
     manager.add(
         Controller(
@@ -42,6 +57,7 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
                     predicate=lambda e: e.type != "DELETED"
                     and e.object.status.phase == PodPhase.PENDING,
                 ),
+                Watch(kind="Pod", mapper=pod_freed_mapper),
                 Watch(kind="Node", mapper=node_event_mapper),
             ],
         )

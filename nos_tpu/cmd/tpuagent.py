@@ -39,16 +39,21 @@ def build_tpuagent(
             return [Request(name=node_name)]
         return []
 
-    manager.add(
-        Controller(
-            f"tpuagent-reporter-{node_name}",
-            store,
-            reporter.reconcile,
-            [
-                Watch(kind="Node", predicate=matching_name(node_name)),
-                Watch(kind="Pod", mapper=pod_on_node_mapper),
-            ],
-        )
+    reporter_controller = Controller(
+        f"tpuagent-reporter-{node_name}",
+        store,
+        reporter.reconcile,
+        [
+            Watch(kind="Node", predicate=matching_name(node_name)),
+            Watch(kind="Pod", mapper=pod_on_node_mapper),
+        ],
+    )
+    manager.add(reporter_controller)
+    # Report immediately after every apply: a clamped-to-no-op apply changes
+    # no devices (no plugin restart, no node event), so without this nudge
+    # its ack would wait out the full report interval.
+    shared.add_apply_listener(
+        lambda _plan_id: reporter_controller.queue.add(Request(name=node_name))
     )
     manager.add(
         Controller(

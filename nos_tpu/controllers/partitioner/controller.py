@@ -65,6 +65,9 @@ class PartitionerController:
         # Which extended resources this mode's planning can serve (per-mode
         # SliceFilter analogue); defaults to the tpu mode's slice resources.
         self.tracked_resource_fn = tracked_resource_fn or ClusterSnapshot.is_tracked_resource
+        # Divergence memo: node name -> spec plan id already replanned for,
+        # so one infeasible plan triggers exactly one immediate replan.
+        self._diverged: dict = {}
 
     # ----------------------------------------------------- pod reconcile
 
@@ -92,10 +95,10 @@ class PartitionerController:
                 pod.namespaced_name, self.kind, delay,
             )
             return Result(requeue_after=delay)
-        if self._waiting_for_nodes_to_report_plan():
-            # Never plan on state the agents have not confirmed
-            # (partitioner_controller.go:118-122).
-            return Result(requeue_after=1.0)
+        # Nodes whose agents have not confirmed their current plan are
+        # FROZEN in the snapshot (per-node generalization of the global
+        # gate at partitioner_controller.go:118-122) — batching proceeds;
+        # the planner simply cannot carve an in-flight node again.
         log.debug("%s: added to %s batch", pod.namespaced_name, self.kind)
         self.batcher.add(pod.namespaced_name)
         return None
@@ -106,17 +109,65 @@ class PartitionerController:
         request = res.compute_pod_request(pod)
         return any(self.tracked_resource_fn(name) for name in request)
 
-    # ------------------------------------------------------- plan gate
+    # ------------------------------------------------- divergence watch
 
-    def _waiting_for_nodes_to_report_plan(self) -> bool:
-        for node in self.store.list("Node"):
-            if not kind_matches(node, self.kind):
-                continue
-            spec_plan = node.metadata.annotations.get(annot.SPEC_PARTITIONING_PLAN)
-            status_plan = node.metadata.annotations.get(annot.STATUS_PARTITIONING_PLAN)
-            if spec_plan and spec_plan != status_plan:
-                return True
-        return False
+    def reconcile_node_divergence(self, req: Request) -> Optional[Result]:
+        """Node annotation events: when an agent has acknowledged the
+        current plan (handshake complete) but its reported geometry does
+        not match spec — the actuator clamped an infeasible spec — replan
+        IMMEDIATELY from the reported truth instead of waiting out the
+        next pod batch window. Extends the reference's plan gate
+        (partitioner_controller.go:118-122,212-232), which only knows
+        "reported yet?", with "reported *what was asked*?"."""
+        node = self.store.try_get("Node", req.name)
+        if node is None:
+            self._diverged.pop(req.name, None)
+            return None
+        if not kind_matches(node, self.kind):
+            return None
+        ann = node.metadata.annotations
+        spec_plan = ann.get(annot.SPEC_PARTITIONING_PLAN)
+        status_plan = ann.get(annot.STATUS_PARTITIONING_PLAN)
+        if not spec_plan or spec_plan != status_plan:
+            return None  # handshake in flight; the plan gate handles it
+        spec, status = annot.parse_node_annotations(ann)
+        if annot.spec_matches_status(spec, status):
+            self._diverged.pop(req.name, None)
+            return None
+        if self._diverged.get(req.name) == spec_plan:
+            return None  # already replanned once for this stale plan
+        self._diverged[req.name] = spec_plan
+        metrics.DIVERGENCE_REPLANS.inc()
+        log.info(
+            "partitioner: %s reports geometry diverging from plan %s "
+            "(spec clamped as infeasible); replanning now",
+            req.name,
+            spec_plan,
+        )
+        self.batcher.fire_now()
+        return None
+
+    # --------------------------------------------- capacity-freed watch
+
+    def reconcile_capacity_freed(self, req: Request) -> Optional[Result]:
+        """A pod that consumed tracked capacity reached a terminal phase
+        (or was deleted): if pods are still pending, replan NOW instead of
+        waiting out the batch window — freed chips idling for a window
+        length on every job transition is the single largest utilization
+        tax in a steady stream of short jobs."""
+        for pod in self.fetch_pending_pods():
+            if podutil.extra_resources_could_help_scheduling(
+                pod
+            ) and self._requests_tracked_resources(pod):
+                log.debug(
+                    "partitioner: capacity freed by %s with %s pending; "
+                    "firing batch now",
+                    req.namespaced_name,
+                    pod.namespaced_name,
+                )
+                self.batcher.fire_now()
+                return None
+        return None
 
     # ------------------------------------------------------ batch loop
 
@@ -139,12 +190,6 @@ class PartitionerController:
             if batch is None:
                 continue
             try:
-                if self._waiting_for_nodes_to_report_plan():
-                    # Re-add so the batch fires again once agents catch up.
-                    time.sleep(0.1)
-                    for item in batch:
-                        self.batcher.add(item)
-                    continue
                 self.process_pending_pods()
                 # Level-triggered retry: a pod whose first plan attempt
                 # could not help emits no further events (the scheduler
@@ -176,7 +221,11 @@ class PartitionerController:
         pending = self.fetch_pending_pods()
         if not pending:
             return 0
-        snapshot = self.snapshot_taker.take_snapshot(self.cluster_state)
+        # Snapshot from the live store: pending pods come from the store,
+        # so bindings/usage must too, or the plan races fresh binds.
+        snapshot = self.snapshot_taker.take_snapshot(
+            self.cluster_state, store=self.store
+        )
         current = snapshot.partitioning_state()
         desired = self.planner.plan(snapshot, pending)
         plan = PartitioningPlan(desired_state=desired, id=self.plan_id_fn())

@@ -39,6 +39,10 @@ class TpuActuator:
         self.device_plugin = device_plugin
         self.node_name = node_name
         self.shared = shared
+        # Clamp-log throttle: (plan_id, board, profile) keys already logged
+        # at error level; repeats (same stale spec re-reconciled until the
+        # control plane replans) drop to debug. Reset on plan-id change.
+        self._clamp_logged: set = set()
 
     def reconcile(self, req: Request) -> Optional[Result]:
         if req.name != self.node_name:
@@ -69,7 +73,15 @@ class TpuActuator:
         for op in plan.creates:
             board = creates_by_board.setdefault(op.board_index, {})
             board[op.profile] = board.get(op.profile, 0) + op.quantity
-        self._clamp_to_board_capacity(node, plan, creates_by_board)
+        self._clamp_to_board_capacity(node, plan, plan_id, creates_by_board)
+        if not plan.deletes and not creates_by_board:
+            # The whole plan was clamped away: spec is infeasible against
+            # current device state. Nothing changed on the node, so do NOT
+            # restart the device plugin; acknowledge the plan (the reporter
+            # will publish the true geometry, and the partitioner's
+            # divergence watch replans from it).
+            self.shared.on_apply(plan_id)
+            return None
         for board_index, profiles in sorted(creates_by_board.items()):
             # One batch per board: chip-placement-aware backends solve all
             # of a board's creates together (order-independent).
@@ -85,7 +97,9 @@ class TpuActuator:
         self.shared.on_apply(plan_id)
         return None
 
-    def _clamp_to_board_capacity(self, node, plan, creates_by_board: dict) -> None:
+    def _clamp_to_board_capacity(
+        self, node, plan, plan_id: str, creates_by_board: dict
+    ) -> None:
         """Refuse creates that would exceed a board's physical chips.
 
         The control plane can ask for an impossible geometry when it planned
@@ -130,7 +144,18 @@ class TpuActuator:
                 per = Topology(profile).chips
                 fit = max(0, min(profiles[profile], budget // per))
                 if fit < profiles[profile]:
-                    log.error(
+                    clamp_key = (plan_id, board_index, profile)
+                    if {k[0] for k in self._clamp_logged} - {plan_id}:
+                        self._clamp_logged = {
+                            k for k in self._clamp_logged if k[0] == plan_id
+                        }
+                    level = (
+                        log.debug
+                        if clamp_key in self._clamp_logged
+                        else log.error
+                    )
+                    self._clamp_logged.add(clamp_key)
+                    level(
                         "actuator: %s board %d: spec wants %dx %s but only "
                         "%d chips remain; clamping to %d (stale plan, will "
                         "re-converge)",

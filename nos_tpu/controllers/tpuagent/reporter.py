@@ -61,6 +61,15 @@ class TpuReporter:
             # Devices converged to spec: acknowledge the plan, ungating the
             # control-plane partitioner.
             desired_status[annot.STATUS_PARTITIONING_PLAN] = spec_plan
+        elif spec_plan and self.shared.last_applied_plan_id == spec_plan:
+            # The actuator finished acting on this plan but the result
+            # diverges from spec (infeasible creates clamped). Withholding
+            # the ack would wedge the plan gate until the spec happens to
+            # become feasible — chips sit idle meanwhile. Acknowledge
+            # instead: spec-plan == status-plan with geometry mismatch is
+            # exactly the signal the partitioner's divergence watch
+            # replans from.
+            desired_status[annot.STATUS_PARTITIONING_PLAN] = spec_plan
         else:
             existing = node.metadata.annotations.get(annot.STATUS_PARTITIONING_PLAN)
             if existing is not None:

@@ -11,6 +11,14 @@ class SharedState:
         self._lock = threading.Lock()
         self._reported_since_last_apply = False
         self.last_applied_plan_id = ""
+        self._apply_listeners: list = []
+
+    def add_apply_listener(self, fn) -> None:
+        """fn(plan_id) runs after every apply — the agent wiring uses it to
+        trigger an immediate report so the plan ack never waits out the
+        report interval (critical for the no-op clamp path, which changes
+        no devices and so generates no node event of its own)."""
+        self._apply_listeners.append(fn)
 
     def on_report(self) -> None:
         with self._lock:
@@ -20,6 +28,8 @@ class SharedState:
         with self._lock:
             self._reported_since_last_apply = False
             self.last_applied_plan_id = plan_id
+        for fn in list(self._apply_listeners):
+            fn(plan_id)
 
     def at_least_one_report_since_last_apply(self) -> bool:
         with self._lock:

@@ -36,11 +36,17 @@ def _gang_of(pod: Pod):
 
 
 def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
-    """Priority first, then smallest slice request, then namespace/name
-    (reference core/util.go:34-71): high-priority pods get first pick and
-    small slices pack tighter."""
+    """Priority first, then LARGEST slice request, then namespace/name.
 
-    def smallest_slice_chips(pod: Pod) -> int:
+    Deliberate deviation from the reference (core/util.go:34-71 sorts
+    smallest-first "to pack tighter"): on TPU hosts the scarce commodity
+    is the contiguous full board — first-fit-DESCENDING places the
+    board-sized requests while whole boards are still free, then fills the
+    remainder with small slices. Smallest-first hands a freed board to
+    fragment-sized pods and forces the next full-board pod to drain a
+    node all over again."""
+
+    def largest_slice_chips(pod: Pod) -> int:
         request = res.compute_pod_request(pod)
         chips = [
             Topology(constants.tpu_slice_topology(name)).chips
@@ -50,13 +56,13 @@ def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
         plain = int(request.get(constants.RESOURCE_TPU, 0))
         if plain:
             chips.append(plain)
-        return min(chips) if chips else 0
+        return max(chips) if chips else 0
 
     return sorted(
         pods,
         key=lambda p: (
             -p.spec.priority,
-            smallest_slice_chips(p),
+            -largest_slice_chips(p),
             p.metadata.namespace,
             p.metadata.name,
         ),
@@ -68,13 +74,16 @@ class Planner:
         self.framework = framework
 
     def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
-        tracker = SliceTracker(snapshot, pending_pods)
+        # Pool draw order == claim pre-pass order (first-fit-descending):
+        # the tracker and the pre-pass must agree on WHICH pods the
+        # existing free slices serve, or a pod could end up neither
+        # claim-placed nor carved for this round.
+        candidates = sort_candidate_pods(pending_pods)
+        tracker = SliceTracker(snapshot, candidates)
         if tracker.empty:
             # Nothing is lacking — current geometry already serves every
             # pending pod (planner.go:80-83).
             return snapshot.partitioning_state()
-
-        candidates = sort_candidate_pods(pending_pods)
 
         # Gang fidelity (SURVEY §7 pitfall): a gang member carved for in
         # isolation wastes a slice the gang can never use. Trial-plan on a
@@ -89,13 +98,12 @@ class Planner:
         if any(_gang_of(p) for p in candidates):
             trial = _copy.deepcopy(snapshot)
             trial_tracker = SliceTracker(trial, candidates)
-            # Members the CURRENT geometry already serves draw from the
-            # free pool and never enter the tracker — they count as
-            # placeable alongside the trial's re-carve placements.
-            servable = [p for p in candidates if p not in trial_tracker]
+            # _plan_pass claim-places members the current geometry already
+            # serves AND simulates re-carve placements; both land in
+            # trial_placed, so it is the complete placeability set.
             trial_placed = self._plan_pass(trial, trial_tracker, candidates, quiet=True)
             excluded = self._half_formable_gangs(
-                snapshot, candidates, trial_placed + servable
+                snapshot, candidates, trial_placed
             )
         if excluded:
             log.info(
@@ -123,6 +131,20 @@ class Planner:
         quiet: bool = False,
     ) -> List[Pod]:
         placed: List[Pod] = []
+        # Claim pre-pass (TPU-first addition, no reference analogue): pods
+        # that existing free slices fully serve will bind onto them without
+        # any carve — place them in the snapshot FIRST, so the carve loop
+        # below sees their slices as used and can never destroy a free
+        # slice a pending pod is entitled to. Without this, a freed full
+        # board gets fragmented for small lack while the full-board pod
+        # about to bind there goes back to waiting for a drain.
+        for pod in candidates:
+            if pod in tracker:
+                continue
+            for node_name in snapshot.get_candidate_nodes():
+                if self._try_add_pod(snapshot, node_name, pod):
+                    placed.append(pod)
+                    break
         for node_name in snapshot.get_candidate_nodes():
             if tracker.empty:
                 break

@@ -29,6 +29,13 @@ class SnapshotNode:
 
     partitionable: object  # PartitionableNode protocol (e.g. tpu.TpuNode)
     pods: List[Pod] = field(default_factory=list)
+    # True while the node's agent has not yet acknowledged its current
+    # spec plan: its geometry is mid-change, so the planner must not carve
+    # it again (per-node generalization of the reference's GLOBAL
+    # "all nodes reported" gate, partitioner_controller.go:118-122 —
+    # global gating stalls every other node's replan behind one
+    # in-flight actuation).
+    frozen: bool = False
 
     @property
     def name(self) -> str:
@@ -96,13 +103,29 @@ class ClusterSnapshot:
         )
 
     def get_candidate_nodes(self) -> List[str]:
-        """Nodes whose geometry could still change or serve slices, sorted by
-        name for determinism (snapshot.go:119-130)."""
-        return sorted(
+        """Nodes whose geometry could still change or serve slices.
+
+        Best-fit order — fewest free chips first, name for determinism —
+        instead of the reference's plain name order (snapshot.go:119-130):
+        small lacking slices carve out of already-fragmented nodes, so
+        whole free boards survive for board-sized requests."""
+
+        def free_chips(node) -> int:
+            from nos_tpu.tpu.topology import Topology
+
+            return sum(
+                Topology(profile).chips * qty
+                for profile, qty in node.partitionable.free_slices().items()
+            )
+
+        return [
             name
-            for name, node in self._nodes.items()
-            if node.partitionable.has_free_capacity()
-        )
+            for name, node in sorted(
+                self._nodes.items(),
+                key=lambda kv: (free_chips(kv[1]), kv[0]),
+            )
+            if node.partitionable.has_free_capacity() and not node.frozen
+        ]
 
     def free_slice_resources(self) -> ResourceList:
         """Cluster-wide free slices as a ResourceList."""

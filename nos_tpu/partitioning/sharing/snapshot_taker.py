@@ -17,13 +17,29 @@ from nos_tpu.tpu.sharing import SharingNode
 
 
 class SharingSnapshotTaker:
-    def take_snapshot(self, state: ClusterState) -> ClusterSnapshot:
+    def take_snapshot(self, state: ClusterState, store=None) -> ClusterSnapshot:
+        from nos_tpu.partitioning.tpu.snapshot_taker import (
+            _plan_in_flight,
+            live_cluster_view,
+        )
+
+        if store is not None:
+            view = live_cluster_view(store)
+        else:
+            view = {
+                name: (info.node, list(info.pods))
+                for name, info in state.get_nodes().items()
+            }
         nodes: Dict[str, SnapshotNode] = {}
-        for name, info in state.get_nodes().items():
-            if not is_sharing_partitioning_enabled(info.node):
+        for name, (node, pods) in view.items():
+            if not is_sharing_partitioning_enabled(node):
                 continue
-            sharing_node = SharingNode(info.node, owned=True)
+            sharing_node = SharingNode(node, owned=True)
             if not sharing_node.is_sharing_node:
                 continue
-            nodes[name] = SnapshotNode(partitionable=sharing_node, pods=list(info.pods))
+            nodes[name] = SnapshotNode(
+                partitionable=sharing_node,
+                pods=list(pods),
+                frozen=_plan_in_flight(node),
+            )
         return ClusterSnapshot(nodes, codec=SharedSliceCodec())

@@ -8,23 +8,63 @@ from __future__ import annotations
 
 from typing import Dict
 
+from nos_tpu.api.v1alpha1 import annotations as annot
 from nos_tpu.api.v1alpha1.labels import is_tpu_partitioning_enabled
 from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
 from nos_tpu.partitioning.core.state import ClusterState
 from nos_tpu.tpu.node import TpuNode
 
 
+def _plan_in_flight(node) -> bool:
+    """True while the node's agent has not acknowledged the current spec
+    plan — its geometry is mid-change and must not be re-carved (per-node
+    form of the reference's global gate, partitioner_controller.go:118)."""
+    ann = node.metadata.annotations
+    spec_plan = ann.get(annot.SPEC_PARTITIONING_PLAN)
+    return bool(spec_plan) and spec_plan != ann.get(
+        annot.STATUS_PARTITIONING_PLAN
+    )
+
+
+def live_cluster_view(store) -> "Dict[str, tuple]":
+    """node name -> (node, [bound pods]) straight from the store.
+
+    The reference snapshots its informer cache, which IS the live store
+    (client-go shared informers). Our ClusterState is a separately-updated
+    copy, so planning from it adds a staleness window the reference never
+    had — plans computed there race fresh binds and get clamped by the
+    agent. Planning from the store closes the window."""
+    out: Dict[str, tuple] = {}
+    for node in store.list("Node"):
+        out[node.metadata.name] = (node, [])
+    for pod in store.list("Pod"):
+        if pod.spec.node_name in out and pod.status.phase in ("Pending", "Running"):
+            out[pod.spec.node_name][1].append(pod)
+    return out
+
+
 class TpuSnapshotTaker:
-    def take_snapshot(self, state: ClusterState) -> ClusterSnapshot:
+    def take_snapshot(self, state: ClusterState, store=None) -> ClusterSnapshot:
+        if store is not None:
+            view = live_cluster_view(store)
+        else:
+            view = {
+                name: (info.node, list(info.pods))
+                for name, info in state.get_nodes().items()
+            }
         nodes: Dict[str, SnapshotNode] = {}
-        for name, info in state.get_nodes().items():
-            if not is_tpu_partitioning_enabled(info.node):
+        for name, (node, pods) in view.items():
+            if not is_tpu_partitioning_enabled(node):
                 continue
-            tpu_node = TpuNode(info.node, owned=True)
+            tpu_node = TpuNode(node, owned=True)
             if not tpu_node.is_tpu_node:
                 continue
             # Plan against live pod bindings, not the reporter's (possibly
             # stale) used/free split — see rebuild_usage_from_pods.
-            tpu_node.rebuild_usage_from_pods(info.pods)
-            nodes[name] = SnapshotNode(partitionable=tpu_node, pods=list(info.pods))
+            tpu_node.rebuild_usage_from_pods(pods)
+            nodes[name] = SnapshotNode(
+                partitionable=tpu_node,
+                pods=list(pods),
+                frozen=_plan_in_flight(node),
+            )
         return ClusterSnapshot(nodes)

@@ -1,0 +1,222 @@
+"""Board reservation: drain-aware scheduling for full-board requests.
+
+A pod whose request spans a whole physical board (e.g. 8 chips on a v5e
+2x4 host) can starve indefinitely on a busy cluster: a board only drains
+by luck, because every freed fragment is immediately re-carved for
+smaller pending pods, and the planner cannot migrate running workloads
+(neither can the reference — its planner only re-shapes FREE devices,
+internal/partitioning/core/planner.go). Upstream kube attacks the
+analogous problem with nominated nodes; preemption does not apply here
+(equal priorities). The TPU answer is an explicit drain reservation:
+
+- When a full-board pod is unschedulable and NO node has enough
+  re-carvable headroom (physical chips minus chips held by running pods),
+  the scheduler reserves the node closest to draining by writing
+  ``nos.nebuly.com/reserved-for: <ns/name>`` (+ ``reserved-at``) on it.
+- The filter keeps every other pod off a validly reserved node — in the
+  real scheduler AND in the partitioner's simulation framework, so the
+  planner never carves for other pods there either (SURVEY §7
+  "simulation fidelity").
+- The board drains, the partitioner re-carves it for the holder, the
+  holder binds, and the bind releases the reservation.
+- A TTL bounds leakage when the holder vanishes without an event; a
+  reservation whose holder is no longer a pending unbound pod is invalid
+  immediately.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import Pod, PodPhase
+from nos_tpu.kube.store import KubeStore, NotFoundError
+from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
+from nos_tpu.tpu.known import board_layout
+from nos_tpu.tpu.topology import Topology
+from nos_tpu.util import metrics
+from nos_tpu.util import resources as res
+
+log = logging.getLogger("nos_tpu.scheduler")
+
+RESERVED_FOR = annot.PREFIX + "reserved-for"
+RESERVED_AT = annot.PREFIX + "reserved-at"
+
+_VALID_CACHE_KEY = "board_reservation_valid"
+
+
+class BoardReservation:
+    name = "BoardReservation"
+
+    def __init__(
+        self,
+        store: KubeStore,
+        ttl_seconds: float = 30.0,
+        min_wait_seconds: float = 10.0,
+    ) -> None:
+        self.store = store
+        self.ttl = ttl_seconds
+        # Reservation is a starvation safety net, not a fast path: a drain
+        # deliberately idles chips, and measured on the steady-stream bench
+        # it costs ~8 utilization points when applied to every full-board
+        # pod. First-fit-descending planning + best-fit node ordering land
+        # full-board pods organically in the common case; only a pod that
+        # has ALREADY waited this long gets a node drained for it.
+        self.min_wait = min_wait_seconds
+
+    # ------------------------------------------------------------ filter
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        ann = node_info.node.metadata.annotations
+        if RESERVED_FOR not in ann:
+            return Status.ok()
+        cache: Dict[str, Optional[str]] = state.setdefault(_VALID_CACHE_KEY, {})
+        name = node_info.name
+        if name not in cache:
+            cache[name] = self._valid_holder(node_info.node)
+        holder = cache[name]
+        if holder is not None and holder != pod.namespaced_name:
+            return Status.unschedulable(
+                f"node draining, reserved for {holder}", self.name
+            )
+        return Status.ok()
+
+    def _valid_holder(self, node) -> Optional[str]:
+        holder = node.metadata.annotations.get(RESERVED_FOR, "")
+        if not holder:
+            return None
+        try:
+            ts = float(node.metadata.annotations.get(RESERVED_AT, "0") or 0)
+        except ValueError:
+            ts = 0.0
+        if time.time() - ts > self.ttl:
+            return None
+        ns, _, name = holder.partition("/")
+        pod = self.store.try_get("Pod", name, ns)
+        if (
+            pod is None
+            or pod.spec.node_name
+            or pod.status.phase != PodPhase.PENDING
+        ):
+            return None
+        return holder
+
+    # ----------------------------------------------------------- reserve
+
+    def try_reserve(self, pod: Pod, node_infos: Dict[str, NodeInfo]) -> bool:
+        """Called when `pod` came out of a cycle unschedulable with no
+        preemption nomination. Reserves at most one node; no-op unless the
+        request is fragmentation-prone (>= a full board) and genuinely
+        blocked (no node has re-carvable headroom)."""
+        age = time.time() - pod.metadata.creation_timestamp
+        if age < self.min_wait:
+            return False
+        needed = res.tpu_chips_in(res.compute_pod_request(pod))
+        if needed <= 0:
+            return False
+        key = pod.namespaced_name
+        # Single-drain policy: at most one node drains cluster-wide.
+        # Full-board pods queue through the one drained board (and reuse
+        # it back-to-back); concurrent drains multiply idle chips for no
+        # extra throughput.
+        other_drain = False
+        best = None  # (running chips, name, node)
+        for info in sorted(node_infos.values(), key=lambda i: i.name):
+            node = info.node
+            if node.metadata.labels.get(labels.PARTITIONING_LABEL) not in (
+                labels.PartitioningKind.TPU,
+                labels.PartitioningKind.HYBRID,
+            ):
+                continue
+            capacity = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+            if capacity < needed:
+                continue
+            layouts = board_layout(
+                node.metadata.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, ""),
+                capacity,
+            )
+            if not layouts:
+                continue
+            board_chips = max(Topology(t).chips for t in layouts)
+            if needed < board_chips:
+                # Sub-board fragments re-carve out of normal churn; a
+                # reservation would idle chips for nothing.
+                continue
+            running = sum(
+                res.tpu_chips_in(res.compute_pod_request(p)) for p in info.pods
+            )
+            if capacity - running >= needed:
+                # Enough re-carvable headroom already exists somewhere:
+                # the partitioner will serve this pod without a drain.
+                return False
+            if any(p.status.phase == PodPhase.PENDING for p in info.pods):
+                # A pending pod on the node means an in-flight gang/assume
+                # claim: the node is contested, not draining — reserving it
+                # would deadlock two formations against each other.
+                continue
+            holder = self._valid_holder(node)
+            if holder == key:
+                # Already reserved by this pod; refresh the TTL when half
+                # spent so a slow drain is not stolen mid-way.
+                try:
+                    ts = float(
+                        node.metadata.annotations.get(RESERVED_AT, "0") or 0
+                    )
+                except ValueError:
+                    ts = 0.0
+                if time.time() - ts > self.ttl / 2:
+                    self._annotate(node.metadata.name, key)
+                return True
+            if holder is not None:
+                other_drain = True
+                continue  # validly held by another pod
+            if best is None or (running, info.name) < best[:2]:
+                best = (running, info.name, node)
+        if best is None or other_drain:
+            return False
+        _, node_name, _ = best
+        self._annotate(node_name, key)
+        metrics.BOARD_RESERVATIONS.inc()
+        log.info(
+            "scheduler: reserved %s for %s (%d chips need a drained board)",
+            node_name,
+            key,
+            needed,
+        )
+        return True
+
+    def _annotate(self, node_name: str, holder: str) -> None:
+        try:
+            self.store.patch_annotations(
+                "Node",
+                node_name,
+                "",
+                {RESERVED_FOR: holder, RESERVED_AT: str(time.time())},
+            )
+        except NotFoundError:
+            pass
+
+    # ----------------------------------------------------------- release
+
+    def release_for(self, pod: Pod) -> None:
+        """Clear any reservation held by `pod` (called on bind; deletion
+        and phase changes fall back to holder-validity + TTL)."""
+        key = pod.namespaced_name
+        for node in self.store.list("Node"):
+            if node.metadata.annotations.get(RESERVED_FOR) == key:
+                try:
+                    self.store.patch_annotations(
+                        "Node",
+                        node.metadata.name,
+                        "",
+                        {RESERVED_FOR: None, RESERVED_AT: None},
+                    )
+                except NotFoundError:
+                    pass
+                log.info(
+                    "scheduler: released reservation of %s held by %s",
+                    node.metadata.name,
+                    key,
+                )

@@ -43,11 +43,15 @@ def new_framework(
 ) -> "tuple[Framework, CapacityScheduling, GangScheduling]":
     """Default plugin wiring (the in-tree registry + nos plugins, reference
     cmd/gpupartitioner/gpupartitioner.go:294-318 and cmd/scheduler)."""
+    from nos_tpu.scheduler.plugins.reservation import BoardReservation
+
     capacity = CapacityScheduling(store)
     gang = GangScheduling(store, wait_timeout_seconds=gang_timeout_seconds)
+    reservation = BoardReservation(store)
     framework = Framework(
         pre_filter_plugins=[capacity],
-        filter_plugins=vanilla_filter_plugins() + [MultihostIciFilter(store, gang)],
+        filter_plugins=vanilla_filter_plugins()
+        + [MultihostIciFilter(store, gang), reservation],
         post_filter_plugins=[capacity],
         reserve_plugins=[capacity],
         permit_plugins=[gang],
@@ -58,6 +62,7 @@ def new_framework(
         ],
     )
     capacity.framework = framework  # preemption re-runs the filters
+    framework.reservation = reservation
     return framework, capacity, gang
 
 
@@ -74,6 +79,7 @@ class Scheduler:
         self.framework = framework
         self.capacity = capacity
         self.gang = gang
+        self.reservation = getattr(framework, "reservation", None)
         self.retry = retry_seconds
         self.pods_scheduled = 0
         # Assume cache: pods reserved on a node but not yet bound (gang
@@ -137,6 +143,11 @@ class Scheduler:
                 self._set_nominated(pod, nominated)
                 # Victims are terminating; retry shortly.
                 return Result(requeue_after=self.retry / 2)
+            if self.reservation is not None:
+                # Fragmentation-blocked full-board pod: reserve the node
+                # closest to draining so the board frees deterministically
+                # instead of by luck (no-op for sub-board requests).
+                self.reservation.try_reserve(pod, node_infos)
             self._mark_unschedulable(
                 pod, "; ".join(s.message for s in filtered.values()) or "no nodes"
             )
@@ -174,6 +185,8 @@ class Scheduler:
         for bind_pod, node_name in to_bind:
             self._assumed.pop(bind_pod.namespaced_name, None)
             self._bind(bind_pod, node_name)
+            if self.reservation is not None:
+                self.reservation.release_for(bind_pod)
         metrics.SCHEDULE_LATENCY.observe(time.monotonic() - start)
         if self.gang is not None and len(to_bind) > 1:
             metrics.GANGS_SCHEDULED.inc()

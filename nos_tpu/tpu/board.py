@@ -19,6 +19,7 @@ from nos_tpu.tpu.geometry import (
     geometry_subtract,
 )
 from nos_tpu.tpu.known import KNOWN_ACCELERATORS, allowed_geometries
+from nos_tpu.tpu.topology import Topology
 
 
 class TpuBoard:
@@ -106,14 +107,22 @@ class TpuBoard:
         # that already holds free slices of a wanted profile must aim for
         # free + wanted of it — scoring against `wanted` alone would count
         # its own free slices as new supply and refuse to carve.
+        # Scoring is CHIP-weighted — a deviation from the reference's
+        # slice count (pkg/gpu/mig/gpu.go:158-212): counting slices makes
+        # a free full board prefer carving eight 1x1s over one wanted
+        # full-board slice whenever more small slices are lacking, and
+        # board-sized slices are the scarce commodity on TPU hosts.
         def provided(geometry: Geometry) -> int:
             free_after = geometry_subtract(geometry, self.used)
             return sum(
                 min(free_after.get(p, 0), self.free.get(p, 0) + n)
+                * Topology(p).chips
                 for p, n in wanted.items()
             )
 
-        current_score = sum(self.free.get(p, 0) for p in wanted)
+        current_score = sum(
+            self.free.get(p, 0) * Topology(p).chips for p in wanted
+        )
         best: Optional[Geometry] = None
         best_score = current_score
         for candidate in allowed_geometries(self.accelerator, self.board_topology):

@@ -156,6 +156,14 @@ REGISTRY = MetricsRegistry()
 PLANS_APPLIED = REGISTRY.counter(
     "nos_tpu_partitioning_plans_applied_total", "Partitioning plans actuated"
 )
+DIVERGENCE_REPLANS = REGISTRY.counter(
+    "nos_tpu_partitioning_divergence_replans_total",
+    "Immediate replans triggered by actuation diverging from spec",
+)
+BOARD_RESERVATIONS = REGISTRY.counter(
+    "nos_tpu_board_reservations_total",
+    "Nodes reserved to drain for full-board pods",
+)
 SLICES_CREATED = REGISTRY.counter(
     "nos_tpu_slices_created_total", "TPU slices carved by agents"
 )

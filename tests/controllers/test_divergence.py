@@ -1,0 +1,178 @@
+"""Actuation-divergence feedback: a clamped (infeasible) plan must not
+wedge planning until the next batch window — the partitioner replans the
+moment an agent acknowledges a plan whose reported geometry differs from
+spec (extends the plan gate of partitioner_controller.go:118-122,212-232).
+"""
+import time
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.controllers.partitioner.controller import PartitionerController
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState
+from nos_tpu.util import metrics
+
+from tests.factory import build_tpu_node
+
+
+def make_controller(store):
+    controller = PartitionerController(
+        store=store,
+        cluster_state=ClusterState(),
+        snapshot_taker=None,
+        planner=None,
+        actuator=None,
+        batch_timeout_seconds=60.0,
+        batch_idle_seconds=60.0,
+    )
+    return controller
+
+
+def set_annotations(store, name, spec_geoms, status_free, spec_plan, status_plan):
+    def mutate(n):
+        n.metadata.annotations.update(annot.spec_from_geometries(spec_geoms))
+        n.metadata.annotations.update(
+            annot.status_from_devices(free=status_free, used={})
+        )
+        n.metadata.annotations[annot.SPEC_PARTITIONING_PLAN] = spec_plan
+        n.metadata.annotations[annot.STATUS_PARTITIONING_PLAN] = status_plan
+
+    store.patch_merge("Node", name, None, mutate)
+
+
+class TestDivergenceWatch:
+    def test_acked_divergent_node_fires_immediate_replan(self):
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1"))
+        c = make_controller(store)
+        # Agent acked plan p1 but reports one 2x2 where spec wants two.
+        set_annotations(
+            store, "n1", {0: {"2x2": 2}}, {0: {"2x2": 1}}, "p1", "p1"
+        )
+        c.batcher.start()
+        try:
+            before = metrics.DIVERGENCE_REPLANS.value
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.5) == []  # immediate empty trigger
+            assert metrics.DIVERGENCE_REPLANS.value == before + 1
+            # Same stale plan: only one immediate replan, no hot loop.
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.2) is None
+        finally:
+            c.batcher.stop()
+
+    def test_handshake_in_flight_defers_to_plan_gate(self):
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1"))
+        c = make_controller(store)
+        set_annotations(
+            store, "n1", {0: {"2x2": 2}}, {0: {"2x2": 1}}, "p2", "p1"
+        )
+        c.batcher.start()
+        try:
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.2) is None
+        finally:
+            c.batcher.stop()
+
+    def test_converged_node_clears_memo(self):
+        store = KubeStore()
+        store.create(build_tpu_node(name="n1"))
+        c = make_controller(store)
+        set_annotations(
+            store, "n1", {0: {"2x2": 2}}, {0: {"2x2": 1}}, "p1", "p1"
+        )
+        c.batcher.start()
+        try:
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.5) == []
+            # Convergence (e.g. after the replan) clears the memo, so a
+            # LATER divergence on a new plan fires again.
+            set_annotations(
+                store, "n1", {0: {"2x2": 2}}, {0: {"2x2": 2}}, "p2", "p2"
+            )
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.2) is None
+            assert "n1" not in c._diverged
+            set_annotations(
+                store, "n1", {0: {"2x4": 1}}, {0: {"2x2": 2}}, "p3", "p3"
+            )
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.5) == []
+        finally:
+            c.batcher.stop()
+
+    def test_non_tpu_node_ignored(self):
+        store = KubeStore()
+        node = build_tpu_node(name="n1", partitioning=None)
+        store.create(node)
+        c = make_controller(store)
+        c.batcher.start()
+        try:
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.2) is None
+        finally:
+            c.batcher.stop()
+
+
+class TestDivergenceRecoveryEndToEnd:
+    def test_infeasible_spec_recovers_within_report_interval(self):
+        """A stale infeasible spec (planned against lagging state) must not
+        starve a pending pod until pods finish: agent clamps + acks,
+        reporter publishes truth, divergence watch replans, pod schedules.
+        Batch windows are set prohibitively long so only the divergence
+        path can explain a prompt schedule."""
+        from nos_tpu.api.config import GpuPartitionerConfig, TpuAgentConfig
+        from nos_tpu.cmd import build_cluster
+        from nos_tpu.kube.objects import PodPhase
+
+        from tests.factory import build_pod
+
+        cluster = build_cluster(
+            partitioner_config=GpuPartitionerConfig(
+                batch_window_timeout_seconds=30.0,
+                batch_window_idle_seconds=30.0,
+            )
+        )
+        cluster.add_tpu_node(
+            build_tpu_node(name="tpu-1"),
+            agent_config=TpuAgentConfig(report_config_interval_seconds=0.1),
+        )
+        cluster.start()
+        try:
+            # Seed an infeasible spec directly (planned against state that
+            # lagged): 2x 2x4 = 16 chips on an 8-chip host.
+            def set_stale(n):
+                n.metadata.annotations.update(
+                    {
+                        **annot.spec_from_geometries({0: {"2x4": 2}}),
+                        annot.SPEC_PARTITIONING_PLAN: "stale-1",
+                    }
+                )
+
+            cluster.store.patch_merge("Node", "tpu-1", None, set_stale)
+            # A pending pod that the stale spec can never serve as carved
+            # (it COULD be served by one 2x4, but the clamp keeps only
+            # what fits; the pod needs a fresh feasible plan).
+            cluster.store.create(
+                build_pod("train", {constants.RESOURCE_TPU: 4}, ns="ml")
+            )
+            deadline = time.monotonic() + 10.0
+            scheduled = None
+            while time.monotonic() < deadline:
+                pod = cluster.store.try_get("Pod", "train", "ml")
+                if (
+                    pod is not None
+                    and pod.status.phase == PodPhase.RUNNING
+                    and pod.spec.node_name
+                ):
+                    scheduled = time.monotonic()
+                    break
+                time.sleep(0.05)
+            assert scheduled is not None, (
+                "pod never scheduled; node annotations: %s"
+                % cluster.store.get("Node", "tpu-1").metadata.annotations
+            )
+        finally:
+            cluster.stop()

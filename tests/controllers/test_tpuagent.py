@@ -166,6 +166,72 @@ class TestCapacityClamp:
         # Used devices were never deleted.
         assert geometry[0].get("2x2", 0) == 2
 
+    def test_fully_clamped_plan_skips_plugin_restart_and_acks(self):
+        """A spec clamped to a complete no-op must not churn the device
+        plugin, must still acknowledge the plan id (so the control-plane
+        gate opens and the divergence watch can replan), and must not
+        spam error logs on every agent reconcile."""
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        restarts = {"n": 0}
+        real_restart = plugin.restart
+
+        def counting_restart(node_name):
+            restarts["n"] += 1
+            real_restart(node_name)
+
+        plugin.restart = counting_restart
+        pool.create("n1", 0, "2x2", 2)
+        store.create(build_pod("a", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+        store.create(build_pod("b", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+
+        def set_spec(n):
+            n.metadata.annotations.update(
+                {
+                    # board is full with used 2x2s: the extra 1x2s can never fit
+                    **annot.spec_from_geometries({0: {"2x2": 2, "1x2": 2}}),
+                    annot.SPEC_PARTITIONING_PLAN: "p1",
+                }
+            )
+
+        store.patch_merge("Node", "n1", None, set_spec)
+        for _ in range(5):
+            shared.on_report()
+            actuator.reconcile(Request(name="n1"))
+        assert restarts["n"] == 0, "no device change -> no plugin restart"
+        assert pool.geometry("n1")[0] == {"2x2": 2}
+        # Plan acknowledged: the reporter will publish status plan == spec.
+        reporter.reconcile(Request(name="n1"))
+        node = store.get("Node", "n1")
+        assert node.metadata.annotations[annot.STATUS_PARTITIONING_PLAN] == "p1"
+
+    def test_clamp_log_throttled_per_plan(self, caplog):
+        import logging
+
+        store, pool, client, plugin, shared, reporter, actuator = make_agent_env()
+        pool.create("n1", 0, "2x2", 2)
+        store.create(build_pod("a", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+        store.create(build_pod("b", {slice_res("2x2"): 1}, node="n1", phase="Running"))
+
+        def set_spec(n):
+            n.metadata.annotations.update(
+                {
+                    **annot.spec_from_geometries({0: {"2x2": 2, "1x2": 2}}),
+                    annot.SPEC_PARTITIONING_PLAN: "p1",
+                }
+            )
+
+        store.patch_merge("Node", "n1", None, set_spec)
+        with caplog.at_level(logging.ERROR, logger="nos_tpu.tpuagent"):
+            for _ in range(6):
+                shared.on_report()
+                actuator.reconcile(Request(name="n1"))
+        clamp_errors = [
+            r for r in caplog.records if "clamping" in r.getMessage()
+        ]
+        assert len(clamp_errors) <= 2, (
+            f"{len(clamp_errors)} error-level clamp logs for one stale plan"
+        )
+
 
 class TestKubeletAdmission:
     """The sim kubelet arbitrates admission against device truth — the

@@ -30,14 +30,17 @@ def snapshot_of(*nodes, pods_by_node=None):
 
 
 class TestSortCandidatePods:
-    def test_priority_desc_then_smallest_slice(self):
+    def test_priority_desc_then_largest_slice(self):
+        # First-fit-descending (TPU-first deviation from the reference's
+        # smallest-first): board-sized requests place while boards are
+        # still whole.
         small = build_pod("small", {slice_res("1x1"): 1})
         big = build_pod("big", {slice_res("2x4"): 1})
-        vip = build_pod("vip", {slice_res("2x4"): 1}, priority=100)
+        vip = build_pod("vip", {slice_res("1x1"): 1}, priority=100)
         assert [p.metadata.name for p in sort_candidate_pods([big, small, vip])] == [
             "vip",
-            "small",
             "big",
+            "small",
         ]
 
     def test_name_tiebreak(self):
@@ -143,9 +146,12 @@ class TestPlannerRegressions:
         plan = Planner(make_framework()).plan(snap, pods)
         geometry = {b.board_index: b.resources for b in plan["n1"].boards}
         assert geometry[0].get(slice_res("2x2"), 0) == 2
-        # p0 is served by the pre-existing free slice (the real scheduler
-        # places it); only p1 needed planning.
-        assert [p.metadata.name for p in snap.get_node("n1").pods] == ["p1"]
+        # p0 is claim-placed onto the pre-existing free slice (so the carve
+        # pass cannot destroy it); p1's slice was carved, and its simulated
+        # placement follows.
+        assert sorted(
+            p.metadata.name for p in snap.get_node("n1").pods
+        ) == ["p0", "p1"]
 
     def test_pod_wanting_more_than_net_delta_triggers_carve(self):
         ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})

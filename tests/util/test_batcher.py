@@ -50,3 +50,24 @@ class TestBatcher:
             assert b.ready(timeout=0.1) is None
         finally:
             b.stop()
+
+    def test_fire_now_bypasses_windows(self):
+        b = Batcher(timeout_seconds=60.0, idle_seconds=60.0)
+        b.start()
+        try:
+            b.add("x")
+            b.fire_now()
+            assert b.ready(timeout=0.5) == ["x"]
+        finally:
+            b.stop()
+
+    def test_fire_now_delivers_empty_trigger(self):
+        # Consumers treat the batch as a wakeup and re-fetch work
+        # themselves, so an empty release must still be delivered.
+        b = Batcher(timeout_seconds=60.0, idle_seconds=60.0)
+        b.start()
+        try:
+            b.fire_now()
+            assert b.ready(timeout=0.5) == []
+        finally:
+            b.stop()
