@@ -38,6 +38,14 @@ import sys
 import time
 
 TPU_CHILD_TIMEOUT_S = 900.0
+# Staged bring-up: before committing to the 900 s full child, run a tiny
+# probe child that only does `jax.devices()`. The tunneled-TPU claim leg
+# can hang indefinitely when the relay is wedged (observed r03/r04: two
+# rounds lost to a 900 s init hang); the probe bounds that failure mode to
+# PROBE_ATTEMPTS x PROBE_TIMEOUT_S and gives an honest, specific error.
+PROBE_TIMEOUT_S = 150.0  # first contact on a tunneled chip can take >60 s
+PROBE_ATTEMPTS = 3
+PROBE_BACKOFF_S = 20.0
 
 
 def log(msg: str) -> None:
@@ -351,9 +359,86 @@ def run_tpu_child() -> None:
     print(json.dumps(result), flush=True)
 
 
+def run_probe_child() -> None:
+    """Minimal backend probe: import jax, list devices, print one JSON line.
+
+    Runs in its own interpreter so a hung `jax.devices()` (wedged tunnel
+    relay) is killable without poisoning the parent."""
+    import jax
+
+    forced = os.environ.get("NOS_BENCH_PLATFORM")
+    if forced:
+        # In-process update, not env: this image's sitecustomize re-points
+        # jax_platforms at the remote-TPU plugin after import.
+        jax.config.update("jax_platforms", forced)
+    t0 = time.monotonic()
+    devs = jax.devices()
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "init_s": round(time.monotonic() - t0, 1),
+                "backend": jax.default_backend(),
+                "device_kind": devs[0].device_kind,
+                "n_devices": len(devs),
+            }
+        ),
+        flush=True,
+    )
+
+
+def probe_backend() -> dict:
+    """Run the probe child up to PROBE_ATTEMPTS times with backoff.
+
+    Returns the probe's JSON dict on success, else {"error": ...}. A wedged
+    claim fails here in minutes instead of consuming the full-child 900 s
+    budget (and tells the operator it was INIT that failed, not the bench)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--probe-child"]
+    last_err = "unknown"
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        log(f"[bench] backend probe attempt {attempt}/{PROBE_ATTEMPTS} "
+            f"(timeout {PROBE_TIMEOUT_S:.0f}s)")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                cmd,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                timeout=PROBE_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode == 0:
+                out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+                log(f"[bench] probe ok in {time.monotonic()-t0:.1f}s: "
+                    f"{out.get('backend')}/{out.get('device_kind')}")
+                return out
+            tail = proc.stderr.decode(errors="replace").strip().splitlines()
+            last_err = (f"probe exited rc={proc.returncode}: "
+                        f"{' | '.join(tail[-3:]) if tail else 'no stderr'}")
+        except subprocess.TimeoutExpired:
+            # Do NOT retry a timed-out probe: the kill landed mid-claim, and
+            # a killed claim is exactly what wedges the tunneled chip for
+            # hours — more attempts only deepen the wedge.
+            return {"error": f"backend probe timed out after "
+                             f"{PROBE_TIMEOUT_S:.0f}s (jax.devices() hung: "
+                             "tunnel/claim wedged?)"}
+        except Exception as e:  # torn output etc.
+            last_err = f"probe parse failed: {e}"
+        log(f"[bench] probe attempt {attempt} failed: {last_err}")
+        if attempt < PROBE_ATTEMPTS:
+            time.sleep(PROBE_BACKOFF_S)
+    return {"error": f"backend probe failed {PROBE_ATTEMPTS}x: {last_err}"}
+
+
 def run_tpu_bench_subprocess() -> dict:
-    """Spawn the model bench in a fresh interpreter (before any threads),
-    bounded by TPU_CHILD_TIMEOUT_S; returns its JSON dict or an error."""
+    """Staged accelerator bench: cheap probe first, then the full child.
+
+    The probe (jax.devices() only, short timeout, retried with backoff)
+    keeps a wedged tunnel from eating the whole 900 s budget; only a
+    healthy backend earns the full model-step child."""
+    probe = probe_backend()
+    if "error" in probe:
+        return {"error": probe["error"]}
     cmd = [sys.executable, os.path.abspath(__file__), "--tpu-child"]
     log(f"[bench] launching model-step child (timeout {TPU_CHILD_TIMEOUT_S:.0f}s)")
     try:
@@ -461,6 +546,7 @@ def run_control_plane_bench() -> dict:
             spec=PodSpec(
                 containers=[Container(requests={constants.RESOURCE_TPU: chips})],
                 priority=priority,
+                scheduler_name=constants.SCHEDULER_NAME,
             ),
         )
         created_at[(ns, name)] = time.monotonic()
@@ -774,6 +860,9 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if "--tpu-child" in sys.argv:
         run_tpu_child()
+        return
+    if "--probe-child" in sys.argv:
+        run_probe_child()
         return
     tpu = {} if "--control-plane-only" in sys.argv else run_tpu_bench_subprocess()
     cp = run_control_plane_bench()

@@ -66,7 +66,10 @@ def submit(store, name, ns, chips):
     store.create(
         Pod(
             metadata=ObjectMeta(name=name, namespace=ns),
-            spec=PodSpec(containers=[Container(requests={constants.RESOURCE_TPU: chips})]),
+            spec=PodSpec(
+                containers=[Container(requests={constants.RESOURCE_TPU: chips})],
+                scheduler_name=constants.SCHEDULER_NAME,
+            ),
         )
     )
 

@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from nos_tpu.api.v1alpha1 import constants
+
 
 class ConfigError(ValueError):
     pass
@@ -73,6 +75,12 @@ class SchedulerConfig:
     manager: ManagerConfig = field(default_factory=ManagerConfig)
     retry_seconds: float = 0.5
     gang_wait_timeout_seconds: float = 30.0
+    # Pods opt in by setting spec.schedulerName to this value; everything
+    # else is left to the cluster's default scheduler (reference
+    # cmd/scheduler/scheduler.go:43-59 — the nos profile is one profile of
+    # upstream kube-scheduler, selected per pod by schedulerName). Empty
+    # string = handle every pod (single-scheduler sims only).
+    scheduler_name: str = constants.SCHEDULER_NAME
 
     def validate(self) -> None:
         if self.retry_seconds <= 0:

@@ -52,6 +52,7 @@ def configs_from(config: dict):
     scheduler = SchedulerConfig(
         retry_seconds=s.get("retrySeconds", 0.5),
         gang_wait_timeout_seconds=s.get("gangWaitTimeoutSeconds", 30.0),
+        scheduler_name=s.get("schedulerName", constants.SCHEDULER_NAME),
     )
     agent = TpuAgentConfig(
         report_config_interval_seconds=a.get("reportConfigIntervalSeconds", 10.0)

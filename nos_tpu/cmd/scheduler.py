@@ -21,13 +21,16 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
         capacity=capacity,
         gang=gang,
         retry_seconds=config.retry_seconds,
+        scheduler_name=config.scheduler_name,
     )
 
     def pending_pod_requests():
         return [
             Request(name=p.metadata.name, namespace=p.metadata.namespace)
             for p in store.list("Pod")
-            if p.status.phase == PodPhase.PENDING and not p.spec.node_name
+            if p.status.phase == PodPhase.PENDING
+            and not p.spec.node_name
+            and scheduler.responsible_for(p)
         ]
 
     def node_event_mapper(event):
@@ -55,7 +58,8 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
                 Watch(
                     kind="Pod",
                     predicate=lambda e: e.type != "DELETED"
-                    and e.object.status.phase == PodPhase.PENDING,
+                    and e.object.status.phase == PodPhase.PENDING
+                    and scheduler.responsible_for(e.object),
                 ),
                 Watch(kind="Pod", mapper=pod_freed_mapper),
                 Watch(kind="Node", mapper=node_event_mapper),

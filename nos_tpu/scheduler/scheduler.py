@@ -74,11 +74,16 @@ class Scheduler:
         capacity: Optional[CapacityScheduling] = None,
         gang: Optional[GangScheduling] = None,
         retry_seconds: float = 0.5,
+        scheduler_name: str = "",
     ) -> None:
         self.store = store
         self.framework = framework
         self.capacity = capacity
         self.gang = gang
+        # Non-empty: only pods whose spec.schedulerName matches are ours;
+        # the rest belong to the default scheduler (coexistence, reference
+        # cmd/scheduler/scheduler.go:43-59). Empty: claim everything.
+        self.scheduler_name = scheduler_name
         self.reservation = getattr(framework, "reservation", None)
         self.retry = retry_seconds
         self.pods_scheduled = 0
@@ -89,10 +94,20 @@ class Scheduler:
 
     # --------------------------------------------------------- reconcile
 
+    def responsible_for(self, pod: Pod) -> bool:
+        return (
+            not self.scheduler_name
+            or pod.spec.scheduler_name == self.scheduler_name
+        )
+
     def reconcile(self, req: Request) -> Optional[Result]:
         self._handle_gang_timeouts()
         pod = self.store.try_get("Pod", req.name, req.namespace)
         if pod is None:
+            return None
+        if not self.responsible_for(pod):
+            # Another scheduler's pod: binding it here would double-bind
+            # against the cluster's default scheduler.
             return None
         if pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
             if self.capacity is not None:

@@ -46,13 +46,22 @@ def build_node(name="node", alloc=None):
     )
 
 
-def build_pod(name, requests=None, ns="default", priority=0, phase=PodPhase.PENDING, node=""):
+def build_pod(
+    name,
+    requests=None,
+    ns="default",
+    priority=0,
+    phase=PodPhase.PENDING,
+    node="",
+    scheduler=constants.SCHEDULER_NAME,
+):
     pod = Pod(
         metadata=ObjectMeta(name=name, namespace=ns),
         spec=PodSpec(
             containers=[Container(requests=dict(requests or {}))],
             priority=priority,
             node_name=node,
+            scheduler_name=scheduler,
         ),
     )
     pod.status.phase = phase

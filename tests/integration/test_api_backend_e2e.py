@@ -51,7 +51,10 @@ def tpu_node(name, pool="pool-a"):
 def chip_pod(name, ns, chips):
     return Pod(
         metadata=ObjectMeta(name=name, namespace=ns),
-        spec=PodSpec(containers=[Container(requests={constants.RESOURCE_TPU: chips})]),
+        spec=PodSpec(
+            containers=[Container(requests={constants.RESOURCE_TPU: chips})],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
     )
 
 

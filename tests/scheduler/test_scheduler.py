@@ -592,3 +592,71 @@ class TestInterPodAffinity:
         )]
         sched_pod(s, store, pod)
         assert store.get("Pod", "web-1", "default").spec.node_name == "n-a"
+
+
+class TestSchedulerNameCoexistence:
+    """The nos scheduler only claims pods that opt in via
+    spec.schedulerName (reference cmd/scheduler/scheduler.go:43-59: the
+    nos profile is one kube-scheduler profile, selected per pod) —
+    deployed beside the default scheduler it must never double-bind."""
+
+    def make_named_scheduler(self, store):
+        fw, capacity, gang = new_framework(store, gang_timeout_seconds=0.3)
+        return Scheduler(
+            store, fw, capacity=capacity, gang=gang, retry_seconds=0.05,
+            scheduler_name=constants.SCHEDULER_NAME,
+        )
+
+    def test_ignores_default_scheduler_pods(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 4}))
+        s = self.make_named_scheduler(store)
+        result = sched_pod(
+            s, store, build_pod("foreign", {"cpu": 1}, scheduler="default-scheduler")
+        )
+        pod = store.get("Pod", "foreign", "default")
+        assert pod.spec.node_name == ""          # left for the default scheduler
+        assert not pod.unschedulable()           # and not marked by us either
+        assert result is None                    # no retry churn on foreign pods
+
+    def test_schedules_opted_in_pods(self):
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 4}))
+        s = self.make_named_scheduler(store)
+        sched_pod(s, store, build_pod("ours", {"cpu": 1}))  # factory default opts in
+        assert store.get("Pod", "ours", "default").spec.node_name == "n1"
+
+    def test_coexists_with_competing_default_scheduler(self):
+        """A simulated default scheduler binds its own pods concurrently;
+        capacity accounting on both sides stays consistent and no pod is
+        bound twice."""
+        store = KubeStore()
+        store.create(build_node("n1", alloc={"cpu": 4}))
+        nos = self.make_named_scheduler(store)
+
+        # Competitor: a second (unfiltered-by-name) scheduler playing the
+        # default one — it claims only default-scheduler pods.
+        competitor = Scheduler(
+            store, new_framework(store, gang_timeout_seconds=0.3)[0],
+            retry_seconds=0.05, scheduler_name="default-scheduler",
+        )
+
+        ours = build_pod("ours", {"cpu": 2})
+        theirs = build_pod("theirs", {"cpu": 2}, scheduler="default-scheduler")
+        store.create(ours)
+        store.create(theirs)
+
+        # Each scheduler sweeps every pending pod (as its informer would).
+        for s in (nos, competitor, nos, competitor):
+            for p in list(store.list("Pod")):
+                if p.status.phase == PodPhase.PENDING and not p.spec.node_name:
+                    s.reconcile(Request(name=p.metadata.name,
+                                        namespace=p.metadata.namespace))
+
+        assert store.get("Pod", "ours", "default").spec.node_name == "n1"
+        assert store.get("Pod", "theirs", "default").spec.node_name == "n1"
+        # Node holds 4 cpu, both 2-cpu pods fit exactly — a double-bind or
+        # shared-capacity miscount would have left one unschedulable.
+        third = build_pod("overflow", {"cpu": 1})
+        sched_pod(nos, store, third)
+        assert store.get("Pod", "overflow", "default").unschedulable()
