@@ -43,9 +43,12 @@ TPU_CHILD_TIMEOUT_S = 900.0
 # can hang indefinitely when the relay is wedged (observed r03/r04: two
 # rounds lost to a 900 s init hang); the probe bounds that failure mode to
 # PROBE_ATTEMPTS x PROBE_TIMEOUT_S and gives an honest, specific error.
-PROBE_TIMEOUT_S = 150.0  # first contact on a tunneled chip can take >60 s
+PROBE_TIMEOUT_S = float(os.environ.get("NOS_BENCH_PROBE_TIMEOUT_S", "240"))
 PROBE_ATTEMPTS = 3
 PROBE_BACKOFF_S = 20.0
+# A probe child that dies in under this many seconds failed
+# deterministically (import error, bad platform) — retrying is waste.
+PROBE_FAST_FAIL_S = 10.0
 
 
 def log(msg: str) -> None:
@@ -415,6 +418,10 @@ def probe_backend() -> dict:
             tail = proc.stderr.decode(errors="replace").strip().splitlines()
             last_err = (f"probe exited rc={proc.returncode}: "
                         f"{' | '.join(tail[-3:]) if tail else 'no stderr'}")
+            if time.monotonic() - t0 < PROBE_FAST_FAIL_S:
+                # Sub-second/seconds death = deterministic failure
+                # (ImportError, bad platform) — identical on retry.
+                return {"error": f"backend probe failed fast: {last_err}"}
         except subprocess.TimeoutExpired:
             # Do NOT retry a timed-out probe: the kill landed mid-claim, and
             # a killed claim is exactly what wedges the tunneled chip for

@@ -38,8 +38,14 @@ class GpuPartitionerConfig:
     scheduler_config_file: str = ""
     device_plugin_config_map: str = "nos-device-plugin-config"
     device_plugin_delay_seconds: float = 0.0
+    # Fairness aging for the planner's first-fit-descending sort: each
+    # second a pod pends grows its effective size by this many chips, so
+    # the smallest requests cannot be re-sorted last forever. 0 disables.
+    aging_chips_per_second: float = 1.0
 
     def validate(self) -> None:
+        if self.aging_chips_per_second < 0:
+            raise ConfigError("aging_chips_per_second must be >= 0")
         if self.batch_window_timeout_seconds <= 0:
             raise ConfigError("batch_window_timeout_seconds must be > 0")
         if self.batch_window_idle_seconds < 0:

@@ -81,7 +81,7 @@ def build_partitioner(
         store=store,
         cluster_state=cluster_state,
         snapshot_taker=TpuSnapshotTaker(),
-        planner=Planner(sim_framework),
+        planner=Planner(sim_framework, aging_chips_per_second=config.aging_chips_per_second),
         actuator=Actuator(tpu_partitioner),
         kind="tpu",
         batch_timeout_seconds=config.batch_window_timeout_seconds,
@@ -189,7 +189,7 @@ def build_partitioner(
         store=store,
         cluster_state=cluster_state,
         snapshot_taker=SharingSnapshotTaker(),
-        planner=Planner(sim_framework),
+        planner=Planner(sim_framework, aging_chips_per_second=config.aging_chips_per_second),
         actuator=Actuator(sharing_partitioner),
         kind="sharing",
         batch_timeout_seconds=config.batch_window_timeout_seconds,

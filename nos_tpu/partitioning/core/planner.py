@@ -35,8 +35,13 @@ def _gang_of(pod: Pod):
     return gang_of(pod)
 
 
-def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
-    """Priority first, then LARGEST slice request, then namespace/name.
+def sort_candidate_pods(
+    pods: Iterable[Pod],
+    aging_chips_per_second: float = 1.0,
+    pending_since: "dict | None" = None,
+) -> List[Pod]:
+    """Priority first, then LARGEST effective slice request, then age,
+    then namespace/name.
 
     Deliberate deviation from the reference (core/util.go:34-71 sorts
     smallest-first "to pack tighter"): on TPU hosts the scarce commodity
@@ -44,7 +49,22 @@ def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
     board-sized requests while whole boards are still free, then fills the
     remainder with small slices. Smallest-first hands a freed board to
     fragment-sized pods and forces the next full-board pod to drain a
-    node all over again."""
+    node all over again.
+
+    Pure FFD starves the smallest requests under sustained load (every
+    round re-sorts them last), so time spent PASSED OVER ages a pod's
+    EFFECTIVE size upward at `aging_chips_per_second`: a 1-chip pod left
+    behind across re-plans eventually sorts with — then ahead of — the
+    board-sized arrivals. `pending_since` maps namespaced_name -> the
+    monotonic instant the planner FIRST considered the pod (tracked by
+    Planner across plan() calls); a pod's first consideration is age 0, so
+    arrival-time spread inside one batch window never turns the sort into
+    FIFO and fresh batches keep the pure largest-first packing order.
+    Aging never crosses an explicit priority boundary."""
+    import time as _time
+
+    now = _time.monotonic()
+    pending_since = pending_since or {}
 
     def largest_slice_chips(pod: Pod) -> int:
         request = res.compute_pod_request(pod)
@@ -58,11 +78,15 @@ def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
             chips.append(plain)
         return max(chips) if chips else 0
 
+    def effective_chips(pod: Pod) -> float:
+        age = max(0.0, now - pending_since.get(pod.namespaced_name, now))
+        return largest_slice_chips(pod) + age * aging_chips_per_second
+
     return sorted(
         pods,
         key=lambda p: (
             -p.spec.priority,
-            -largest_slice_chips(p),
+            -effective_chips(p),
             p.metadata.namespace,
             p.metadata.name,
         ),
@@ -70,15 +94,44 @@ def sort_candidate_pods(pods: Iterable[Pod]) -> List[Pod]:
 
 
 class Planner:
-    def __init__(self, framework: Framework) -> None:
+    def __init__(
+        self, framework: Framework, aging_chips_per_second: float = 1.0
+    ) -> None:
         self.framework = framework
+        self.aging_chips_per_second = aging_chips_per_second
+        # namespaced_name -> (first_seen, last_seen) monotonic instants.
+        # Age for the fairness sort is measured from first_seen — time
+        # passed over across plan() calls — never from creation time (a
+        # 60s batch window would otherwise make every sort FIFO). Entries
+        # survive absence from individual batches (batches are
+        # event-triggered subsets; dropping on absence would reset a
+        # starved pod's age) and are pruned only after _PENDING_TTL_S
+        # without a sighting (pod bound or deleted).
+        self._pending_seen: dict = {}
+        self._PENDING_TTL_S = 600.0
 
     def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
         # Pool draw order == claim pre-pass order (first-fit-descending):
         # the tracker and the pre-pass must agree on WHICH pods the
         # existing free slices serve, or a pod could end up neither
         # claim-placed nor carved for this round.
-        candidates = sort_candidate_pods(pending_pods)
+        import time as _time
+
+        now = _time.monotonic()
+        for pod in pending_pods:
+            key = pod.namespaced_name
+            first, _ = self._pending_seen.get(key, (now, now))
+            self._pending_seen[key] = (first, now)
+        self._pending_seen = {
+            k: v
+            for k, v in self._pending_seen.items()
+            if now - v[1] <= self._PENDING_TTL_S
+        }
+        candidates = sort_candidate_pods(
+            pending_pods,
+            aging_chips_per_second=self.aging_chips_per_second,
+            pending_since={k: v[0] for k, v in self._pending_seen.items()},
+        )
         tracker = SliceTracker(snapshot, candidates)
         if tracker.empty:
             # Nothing is lacking — current geometry already serves every

@@ -84,6 +84,7 @@ class Scheduler:
         # the rest belong to the default scheduler (coexistence, reference
         # cmd/scheduler/scheduler.go:43-59). Empty: claim everything.
         self.scheduler_name = scheduler_name
+        self._skip_logged: set = set()
         self.reservation = getattr(framework, "reservation", None)
         self.retry = retry_seconds
         self.pods_scheduled = 0
@@ -107,7 +108,20 @@ class Scheduler:
             return None
         if not self.responsible_for(pod):
             # Another scheduler's pod: binding it here would double-bind
-            # against the cluster's default scheduler.
+            # against the cluster's default scheduler. Logged once per pod
+            # so a manifest missing schedulerName is diagnosable rather
+            # than silently pending forever.
+            if (
+                pod.status.phase == PodPhase.PENDING
+                and pod.namespaced_name not in self._skip_logged
+            ):
+                self._skip_logged.add(pod.namespaced_name)
+                log.info(
+                    "scheduler: ignoring %s (schedulerName=%r, ours=%r)",
+                    pod.namespaced_name,
+                    pod.spec.scheduler_name,
+                    self.scheduler_name,
+                )
             return None
         if pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
             if self.capacity is not None:

@@ -48,6 +48,54 @@ class TestSortCandidatePods:
         b = build_pod("b", {slice_res("1x1"): 1})
         assert [p.metadata.name for p in sort_candidate_pods([b, a])] == ["a", "b"]
 
+    def test_aging_promotes_starved_small_pod(self):
+        # A 1-chip pod passed over for 8.5s of planner rounds (at 1
+        # chip/s) must outrank a just-arrived 8-chip pod — FFD can't
+        # re-sort it last forever.
+        import time
+
+        old_small = build_pod("old-small", {slice_res("1x1"): 1})
+        fresh_big = build_pod("fresh-big", {slice_res("2x4"): 1})
+        since = {old_small.namespaced_name: time.monotonic() - 8.5}
+        order = [
+            p.metadata.name
+            for p in sort_candidate_pods([fresh_big, old_small], pending_since=since)
+        ]
+        assert order == ["old-small", "fresh-big"]
+        # Aging disabled: pure FFD order.
+        order = [
+            p.metadata.name
+            for p in sort_candidate_pods(
+                [fresh_big, old_small], aging_chips_per_second=0.0,
+                pending_since=since,
+            )
+        ]
+        assert order == ["fresh-big", "old-small"]
+
+    def test_first_consideration_is_not_aged(self):
+        # Absent a pending_since entry (first time the planner sees the
+        # pod), age is 0 regardless of creation time — arrival spread
+        # inside one batch window must not FIFO-ify the packing order.
+        import time
+
+        old_small = build_pod("old-small", {slice_res("1x1"): 1})
+        old_small.metadata.creation_timestamp = time.time() - 3600
+        fresh_big = build_pod("fresh-big", {slice_res("2x4"): 1})
+        order = [p.metadata.name for p in sort_candidate_pods([old_small, fresh_big])]
+        assert order == ["fresh-big", "old-small"]
+
+    def test_aging_never_crosses_priority(self):
+        import time
+
+        old_small = build_pod("old-small", {slice_res("1x1"): 1})
+        vip = build_pod("vip", {slice_res("1x1"): 1}, priority=1)
+        since = {old_small.namespaced_name: time.monotonic() - 3600}
+        order = [
+            p.metadata.name
+            for p in sort_candidate_pods([old_small, vip], pending_since=since)
+        ]
+        assert order == ["vip", "old-small"]
+
 
 class TestPlanner:
     def test_carves_virgin_node_for_pending_pod(self):
