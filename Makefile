@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-unit test-integration bench examples native lint \
+.PHONY: all test test-fast test-unit test-integration incluster-e2e kind-e2e bench examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -16,11 +16,30 @@ all: native test
 test:
 	$(PY) -m pytest tests/ -q
 
+# Fast tier: the control plane (seconds per dir). The ML/JAX tier
+# (tests/models tests/ops tests/parallel) compiles real programs and runs
+# in CI's nightly job instead.
+test-fast:
+	$(PY) -m pytest tests/api tests/cmd tests/controllers tests/device \
+	    tests/kube tests/partitioning tests/scheduler tests/tpu tests/util \
+	    tests/integration tests/data -q
+
 test-unit:
 	$(PY) -m pytest tests/ -q --ignore=tests/integration
 
 test-integration:
 	$(PY) -m pytest tests/integration -q
+
+# Hardware-free in-cluster dry run: real component processes against the
+# sim apiserver over HTTP (see hack/kind/README.md for the real-kind tier).
+incluster-e2e:
+	PYTHONPATH=. $(PY) hack/incluster_e2e.py
+
+kind-e2e:
+	kind create cluster --name nos-tpu --config hack/kind/cluster.yaml
+	helm install nos-tpu helm-charts/nos-tpu -f hack/kind/values.yaml
+	kubectl apply -f hack/kind/smoke-pod.yaml
+	kubectl wait pod/tpu-smoke --for=jsonpath='{.spec.nodeName}' --timeout=120s
 
 bench:
 	$(PY) bench.py
@@ -41,6 +60,7 @@ native:
 
 lint:
 	$(PY) -m compileall -q nos_tpu tests bench.py __graft_entry__.py
+	$(PY) tools/lint.py
 	$(PY) -c "import yaml,glob; [list(yaml.safe_load_all(open(f).read())) for f in glob.glob('config/**/*.yaml', recursive=True)]; print('config/ yaml ok')"
 
 ## Images ----------------------------------------------------------------

@@ -305,10 +305,8 @@ def run_tpu_child() -> None:
             # chips) amortizes over the chunk
             eng = Engine(params, config, max_slots=slots, max_len=256,
                          ticks_per_sync=16)
-            ids = [
+            for _ in range(n_req):
                 eng.submit(GenRequest(prompt=[7] * 120, max_new_tokens=gen_len))
-                for _ in range(n_req)
-            ]
             start = time.monotonic()
             results = eng.run()
             wall = time.monotonic() - start

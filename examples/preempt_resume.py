@@ -28,7 +28,6 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
     ).strip()
 
 import jax
-import jax.numpy as jnp
 
 jax.config.update("jax_platforms", "cpu")
 

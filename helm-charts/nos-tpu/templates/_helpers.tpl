@@ -30,3 +30,60 @@ leaderElection:
   leaseDurationSeconds: {{ .Values.leaderElection.leaseDurationSeconds }}
   renewPeriodSeconds: {{ .Values.leaderElection.renewPeriodSeconds }}
 {{- end }}
+
+{{/* Metrics protection (reference helm-charts/nos/values.yaml:40-55):
+     a kube-rbac-proxy sidecar fronting the health/metrics port. The
+     component binds loopback; only the proxy's authenticated 8443 is
+     exposed. Sidecar-free alternative: metricsAuth.secretName mounts a
+     bearer token the in-process server enforces on /metrics. */}}
+{{- define "nos-tpu.kubeRbacProxySidecar" -}}
+{{- if .Values.kubeRbacProxy.enabled }}
+- name: kube-rbac-proxy
+  image: "{{ .Values.kubeRbacProxy.image.repository }}:{{ .Values.kubeRbacProxy.image.tag }}"
+  imagePullPolicy: {{ .Values.kubeRbacProxy.image.pullPolicy }}
+  args:
+    - --secure-listen-address=0.0.0.0:8443
+    - --upstream=http://127.0.0.1:8082/
+    - --logtostderr=true
+    {{- if gt (int .Values.kubeRbacProxy.logLevel) 0 }}
+    - --v={{ .Values.kubeRbacProxy.logLevel }}
+    {{- end }}
+  ports:
+    - containerPort: 8443
+      name: https-metrics
+      protocol: TCP
+  resources:
+    {{- toYaml .Values.kubeRbacProxy.resources | nindent 4 }}
+{{- end }}
+{{- end }}
+
+{{/* Manager stanza. With the rbac proxy, /metrics moves to a
+     loopback-only listener (8082) the sidecar fronts while healthz/readyz
+     stay on pod-IP:8081 for kubelet probes; with metricsAuth, the
+     in-process server enforces the mounted bearer token per scrape. */}}
+{{- define "nos-tpu.managerConfig" -}}
+manager:
+  healthProbePort: 8081
+{{- if .Values.kubeRbacProxy.enabled }}
+  metricsLoopbackPort: 8082
+{{- end }}
+{{- if .Values.metricsAuth.secretName }}
+  metricsAuthTokenFile: /var/run/nos-tpu-metrics-auth/token
+{{- end }}
+{{- end }}
+
+{{/* Volume + mount for the metricsAuth token secret. */}}
+{{- define "nos-tpu.metricsAuthVolume" -}}
+{{- if .Values.metricsAuth.secretName }}
+- name: metrics-auth
+  secret:
+    secretName: {{ .Values.metricsAuth.secretName }}
+{{- end }}
+{{- end }}
+{{- define "nos-tpu.metricsAuthMount" -}}
+{{- if .Values.metricsAuth.secretName }}
+- name: metrics-auth
+  mountPath: /var/run/nos-tpu-metrics-auth
+  readOnly: true
+{{- end }}
+{{- end }}

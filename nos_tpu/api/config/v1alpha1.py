@@ -42,6 +42,9 @@ class GpuPartitionerConfig:
     # second a pod pends grows its effective size by this many chips, so
     # the smallest requests cannot be re-sorted last forever. 0 disables.
     aging_chips_per_second: float = 1.0
+    # Plan only for pods this scheduler profile will bind (must match
+    # SchedulerConfig.scheduler_name); empty = all pods.
+    scheduler_name: str = constants.SCHEDULER_NAME
 
     def validate(self) -> None:
         if self.aging_chips_per_second < 0:

@@ -8,7 +8,7 @@ others, and those over-quota pods are preemptible (SURVEY.md §1 item 2).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import List
 
 from nos_tpu.kube.objects import ObjectMeta, ResourceList
 

@@ -87,10 +87,27 @@ def run_component(
         port = manager_cfg.get("healthProbePort", 8081)
     # Bind all interfaces by default: kubelet probes the pod IP, not
     # loopback (override via manager.healthProbeHost for local runs).
+    # manager.metricsLoopbackPort (kube-rbac-proxy mode) moves /metrics to
+    # its own loopback listener for the sidecar while probes stay on the
+    # pod IP; manager.metricsAuthTokenFile enforces a bearer token
+    # re-read per scrape (Secret rotation works without restart; a
+    # missing file fails closed with 401, never open).
+    metrics_token: "str | object" = ""
+    token_file = manager_cfg.get("metricsAuthTokenFile", "")
+    if token_file:
+        def metrics_token():  # noqa: F811 — provider shadows the default
+            try:
+                with open(token_file) as fh:
+                    return fh.read().strip()
+            except OSError:
+                return None
+    metrics_port = manager_cfg.get("metricsLoopbackPort")
     health = HealthServer(
         port=port,
         ready_check=ready_check,
         host=manager_cfg.get("healthProbeHost", "0.0.0.0"),
+        metrics_token=metrics_token,
+        metrics_loopback_port=int(metrics_port) if metrics_port else None,
     )
     bound = health.start()
     logging.info("%s: health/metrics on 127.0.0.1:%d", name, bound)

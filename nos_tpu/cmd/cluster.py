@@ -8,7 +8,7 @@ sim kubelet, and the sim device layer.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from nos_tpu.api.config import (
     GpuPartitionerConfig,
@@ -16,7 +16,6 @@ from nos_tpu.api.config import (
     SchedulerConfig,
     TpuAgentConfig,
 )
-from nos_tpu.api.v1alpha1.labels import PARTITIONING_LABEL, PartitioningKind
 from nos_tpu.cmd.operator import build_operator
 from nos_tpu.cmd.partitioner import build_partitioner
 from nos_tpu.cmd.scheduler import build_scheduler

@@ -86,6 +86,7 @@ def build_partitioner(
         kind="tpu",
         batch_timeout_seconds=config.batch_window_timeout_seconds,
         batch_idle_seconds=config.batch_window_idle_seconds,
+        scheduler_name=config.scheduler_name,
         plan_id_fn=plan_id_fn,
     )
 
@@ -194,6 +195,7 @@ def build_partitioner(
         kind="sharing",
         batch_timeout_seconds=config.batch_window_timeout_seconds,
         batch_idle_seconds=config.batch_window_idle_seconds,
+        scheduler_name=config.scheduler_name,
         plan_id_fn=plan_id_fn,
         tracked_resource_fn=sharing_codec.is_tracked,
     )

@@ -49,6 +49,7 @@ def configs_from(config: dict):
         device_plugin_delay_seconds=p.get("devicePluginDelaySeconds", 0.0),
         scheduler_config_file=p.get("schedulerConfigFile", ""),
         aging_chips_per_second=p.get("agingChipsPerSecond", 1.0),
+        scheduler_name=p.get("schedulerName", constants.SCHEDULER_NAME),
     )
     scheduler = SchedulerConfig(
         retry_seconds=s.get("retrySeconds", 0.5),

@@ -2,6 +2,8 @@
 (reference cmd/scheduler/scheduler.go:43-59)."""
 from __future__ import annotations
 
+import logging
+
 from nos_tpu.api.config import SchedulerConfig
 from nos_tpu.kube.controller import Controller, Manager, Request, Watch
 from nos_tpu.kube.objects import PodPhase
@@ -23,6 +25,30 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
         retry_seconds=config.retry_seconds,
         scheduler_name=config.scheduler_name,
     )
+
+    logged_foreign: set = set()
+
+    def _claim_or_log_foreign(pod) -> bool:
+        # The watch filter is where a foreign pod is actually dropped in
+        # the deployed scheduler (reconcile never sees it), so the
+        # diagnosability log for a manifest missing schedulerName must
+        # live HERE — once per pod, or the misconfiguration pends
+        # silently forever.
+        if scheduler.responsible_for(pod):
+            return True
+        if pod.namespaced_name not in logged_foreign:
+            if len(logged_foreign) >= 4096:
+                # Bounded memory in a hot watch path: foreign pods churn
+                # forever in a busy cluster. Clearing re-logs at worst.
+                logged_foreign.clear()
+            logged_foreign.add(pod.namespaced_name)
+            logging.getLogger("nos_tpu.scheduler").info(
+                "scheduler: ignoring %s (schedulerName=%r, ours=%r)",
+                pod.namespaced_name,
+                pod.spec.scheduler_name,
+                scheduler.scheduler_name,
+            )
+        return False
 
     def pending_pod_requests():
         return [
@@ -59,7 +85,7 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
                     kind="Pod",
                     predicate=lambda e: e.type != "DELETED"
                     and e.object.status.phase == PodPhase.PENDING
-                    and scheduler.responsible_for(e.object),
+                    and _claim_or_log_foreign(e.object),
                 ),
                 Watch(kind="Pod", mapper=pod_freed_mapper),
                 Watch(kind="Node", mapper=node_event_mapper),

@@ -12,7 +12,7 @@ overlapping per-namespace quotas (compositeelasticquota_controller.go:110-137).
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from nos_tpu.api.v1alpha1 import labels as labels_api
 from nos_tpu.kube.controller import Request, Result

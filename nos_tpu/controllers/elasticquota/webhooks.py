@@ -8,7 +8,6 @@ by another CEQ. Additionally min ≤ max where both are set.
 from __future__ import annotations
 
 from nos_tpu.kube.store import AdmissionError, KubeStore
-from nos_tpu.util import resources as res
 
 
 def _validate_min_max(spec) -> None:

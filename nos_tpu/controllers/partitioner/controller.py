@@ -47,6 +47,7 @@ class PartitionerController:
         batch_idle_seconds: float = 10.0,
         plan_id_fn=lambda: str(int(time.time() * 1000)),
         tracked_resource_fn=None,
+        scheduler_name: str = "",
     ) -> None:
         self.store = store
         self.cluster_state = cluster_state
@@ -54,6 +55,9 @@ class PartitionerController:
         self.planner = planner
         self.actuator = actuator
         self.kind = kind
+        # Non-empty: plan only for pods this scheduler profile will bind
+        # (matches SchedulerConfig.scheduler_name); empty claims all.
+        self.scheduler_name = scheduler_name
         self.batcher: Batcher[str] = Batcher(batch_timeout_seconds, batch_idle_seconds)
         self.plan_id_fn = plan_id_fn
         self._stop = threading.Event()
@@ -209,11 +213,25 @@ class PartitionerController:
     # ------------------------------------------------------- processing
 
     def fetch_pending_pods(self) -> List[Pod]:
-        """All pending unbound pods (reference :202-210 via field indexers)."""
+        """All pending unbound pods OUR scheduler can bind (reference
+        :202-210 via field indexers).
+
+        Pods with a foreign spec.schedulerName are excluded: the named
+        scheduler never binds them, so planning for them would let them
+        age without bound in the fairness sort and capture carved slices
+        they can never use. The stronger unschedulable-only gate the
+        batcher uses cannot be applied here — gang members waiting in
+        Permit carry no Unschedulable condition, and dropping them from
+        the candidates would deadlock a half-formed gang's remaining
+        carves."""
         return [
             p
             for p in self.store.list_by_index("Pod", constants.INDEX_POD_PHASE, "Pending")
             if not p.spec.node_name
+            and (
+                not self.scheduler_name
+                or p.spec.scheduler_name == self.scheduler_name
+            )
         ]
 
     def process_pending_pods(self) -> int:

@@ -37,7 +37,7 @@ from typing import List, Optional
 
 from nos_tpu.api.v1alpha1 import constants, labels
 from nos_tpu.kube.controller import Request, Result
-from nos_tpu.kube.objects import Container, ObjectMeta, OwnerReference, Pod, PodPhase
+from nos_tpu.kube.objects import ObjectMeta, OwnerReference, Pod, PodPhase
 from nos_tpu.kube.store import AlreadyExistsError, KubeStore, NotFoundError
 from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
 from nos_tpu.tpu.known import (

@@ -13,7 +13,7 @@ import threading
 from typing import Dict, List
 
 from nos_tpu.api.v1alpha1 import constants
-from nos_tpu.device.types import DeviceStatus, TpuSliceDevice
+from nos_tpu.device.types import TpuSliceDevice
 from nos_tpu.kube.objects import PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError
 from nos_tpu.tpu.topology import Topology
@@ -128,7 +128,7 @@ class DevicePluginAdvertiser:
     def restart(self, node_name: str) -> None:
         geometry = self.geometry_fn(node_name)
         try:
-            node = self.store.get("Node", node_name)
+            self.store.get("Node", node_name)  # existence probe only
         except NotFoundError:
             return
 

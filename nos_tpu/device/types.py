@@ -3,7 +3,7 @@ pkg/gpu/device.go Device/DeviceList)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable
 
 
 class DeviceStatus:

@@ -27,7 +27,7 @@ import copy
 import logging
 import threading
 import time
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List
 
 from nos_tpu.kube import serde
 from nos_tpu.kube.apiclient import ApiError, KubeApiClient

@@ -6,7 +6,7 @@ whole network is convs + elementwise — ideal XLA fusion fodder.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Tuple
 
 import jax

@@ -118,8 +118,10 @@ class Planner:
         import time as _time
 
         now = _time.monotonic()
-        for pod in pending_pods:
-            key = pod.namespaced_name
+        # Key includes the uid: a recreated pod with a reused name is a NEW
+        # pod and must start at age 0, not inherit its predecessor's boost.
+        live = {(p.namespaced_name, p.metadata.uid) for p in pending_pods}
+        for key in live:
             first, _ = self._pending_seen.get(key, (now, now))
             self._pending_seen[key] = (first, now)
         self._pending_seen = {
@@ -127,11 +129,27 @@ class Planner:
             for k, v in self._pending_seen.items()
             if now - v[1] <= self._PENDING_TTL_S
         }
+        pending_since = {
+            k[0]: v[0] for k, v in self._pending_seen.items() if k in live
+        }
         candidates = sort_candidate_pods(
             pending_pods,
             aging_chips_per_second=self.aging_chips_per_second,
-            pending_since={k: v[0] for k, v in self._pending_seen.items()},
+            pending_since=pending_since,
         )
+        # Pods aging has materially promoted (>= 2.5 effective chips of
+        # boost): they get the dedicated-carve rescue in _plan_pass —
+        # without it, a starved small pod sorts first yet never wins chips,
+        # because the free pool only serves exact profiles (a free 2x2
+        # cannot serve a 1-chip pod) and freed regions are always claimed
+        # whole by exact-fit pods before any carve happens.
+        aged = {
+            p.namespaced_name
+            for p in candidates
+            if (now - pending_since.get(p.namespaced_name, now))
+            * self.aging_chips_per_second
+            >= 2.5
+        }
         tracker = SliceTracker(snapshot, candidates)
         if tracker.empty:
             # Nothing is lacking — current geometry already serves every
@@ -154,7 +172,9 @@ class Planner:
             # _plan_pass claim-places members the current geometry already
             # serves AND simulates re-carve placements; both land in
             # trial_placed, so it is the complete placeability set.
-            trial_placed = self._plan_pass(trial, trial_tracker, candidates, quiet=True)
+            trial_placed = self._plan_pass(
+                trial, trial_tracker, candidates, quiet=True, aged=aged
+            )
             excluded = self._half_formable_gangs(
                 snapshot, candidates, trial_placed
             )
@@ -173,7 +193,7 @@ class Planner:
             if tracker.empty:
                 return snapshot.partitioning_state()
 
-        self._plan_pass(snapshot, tracker, candidates)
+        self._plan_pass(snapshot, tracker, candidates, aged=aged)
         return snapshot.partitioning_state()
 
     def _plan_pass(
@@ -182,8 +202,53 @@ class Planner:
         tracker: SliceTracker,
         candidates: List[Pod],
         quiet: bool = False,
+        aged: "set | None" = None,
     ) -> List[Pod]:
         placed: List[Pod] = []
+        # Aged-rescue pass, BEFORE anyone claims free slices: a starved
+        # pod the fairness aging promoted gets a carve aimed at exactly
+        # its profile while contested free regions are still free. Sort
+        # order is the entitlement order — running this first means an
+        # aged 1-chip pod converts the free 2x2 an exact-fit 4-chip pod
+        # would otherwise claim, and THAT pod waits a round instead
+        # (the inversion aging exists to produce).
+        #
+        # ONE successful rescue per plan: each conversion fragments a free
+        # region only smaller profiles can reuse, so batching several per
+        # round costs utilization; plans run every batch window and the
+        # queue drains one aged pod per round. Failed attempts don't
+        # consume the budget (an unrescuable aged pod must not block the
+        # rescuable one behind it) but are capped to bound fork work.
+        rescued = attempts = 0
+        for pod in candidates:
+            if not aged or rescued >= 1 or attempts >= 3:
+                break
+            if pod.namespaced_name not in aged or pod not in tracker:
+                continue
+            attempts += 1
+            for node_name in snapshot.get_candidate_nodes():
+                node = snapshot.get_node(node_name)
+                accelerator = getattr(node.partitionable, "accelerator", "")
+                snapshot.fork()
+                if not node.partitionable.update_geometry_for(
+                    tracker.lacking_for(pod, accelerator)
+                ):
+                    snapshot.revert()
+                    continue
+                if self._try_add_pod(snapshot, node_name, pod):
+                    tracker.remove(pod)
+                    placed.append(pod)
+                    rescued += 1
+                    snapshot.commit()
+                    if not quiet:
+                        log.info(
+                            "planner: node %s re-carved (aged rescue) for %s",
+                            node_name,
+                            pod.namespaced_name,
+                        )
+                    break
+                snapshot.revert()
+
         # Claim pre-pass (TPU-first addition, no reference analogue): pods
         # that existing free slices fully serve will bind onto them without
         # any carve — place them in the snapshot FIRST, so the carve loop
@@ -224,6 +289,7 @@ class Planner:
                     log.info("planner: node %s re-carved for pending pods", node_name)
             else:
                 snapshot.revert()
+
         return placed
 
     @staticmethod

@@ -63,5 +63,20 @@ class SliceTracker:
             total = res.sum_resources(total, entry)
         return total
 
+    def lacking_for(self, pod: Pod, accelerator: str = "") -> ResourceList:
+        """One pod's lacking resources, plain chips converted to the
+        accelerator's slice profile (same convention as lacking_totals) —
+        what a dedicated carve for exactly this pod should aim at."""
+        entry = dict(self._lacking.get(_pod_key(pod), {}))
+        plain = int(entry.pop(constants.RESOURCE_TPU, 0))
+        if plain > 0 and accelerator:
+            profile = profile_for_chips(plain, accelerator)
+            if profile is not None:
+                name = constants.tpu_slice_resource(profile)
+                entry[name] = entry.get(name, 0) + 1
+        elif plain > 0:
+            entry[constants.RESOURCE_TPU] = plain
+        return entry
+
     def remove(self, pod: Pod) -> None:
         self._lacking.pop(_pod_key(pod), None)

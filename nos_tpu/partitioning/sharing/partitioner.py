@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import time
-from typing import Dict, Optional
+from typing import Optional
 
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.api.v1alpha1.labels import TPU_DEVICE_PLUGIN_CONFIG_LABEL

@@ -17,13 +17,11 @@ from __future__ import annotations
 
 import logging
 import math
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set
 
-from nos_tpu.api.v1alpha1 import labels as labels_api
 from nos_tpu.kube.objects import Pod, PodPhase, ResourceList
 from nos_tpu.kube.store import KubeStore
-from nos_tpu.scheduler.framework import CycleState, NodeInfo, Status
-from nos_tpu.util import pod as podutil
+from nos_tpu.scheduler.framework import CycleState, Status
 from nos_tpu.util import resources as res
 
 log = logging.getLogger("nos_tpu.scheduler.capacity")

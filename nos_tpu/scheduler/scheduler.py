@@ -14,7 +14,6 @@ import logging
 import time
 from typing import Dict, List, Optional
 
-from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.objects import Pod, PodCondition, PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError

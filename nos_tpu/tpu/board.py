@@ -9,7 +9,7 @@ serves lacking slice profiles without destroying used slices
 from __future__ import annotations
 
 import copy
-from typing import Dict, List, Optional
+from typing import Optional
 
 from nos_tpu.tpu.geometry import (
     Geometry,

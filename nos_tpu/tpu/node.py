@@ -11,7 +11,7 @@ recompute the node's scalar resources after a geometry change
 from __future__ import annotations
 
 import copy
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from nos_tpu.api.v1alpha1 import annotations as annot
 from nos_tpu.api.v1alpha1 import constants, labels

@@ -20,27 +20,64 @@ class HealthServer:
         port: int = 8081,
         ready_check: Optional[Callable[[], bool]] = None,
         host: str = "127.0.0.1",
+        metrics_token: "str | Callable[[], Optional[str]]" = "",
+        metrics_loopback_port: Optional[int] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
         self.host = host
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        # metrics_token non-empty (or a provider callable): /metrics
+        # requires `Authorization: Bearer <token>` (the reference protects
+        # metrics behind a kube-rbac-proxy TokenReview sidecar,
+        # helm-charts/nos/values.yaml:40-55; a shared bearer token is the
+        # sidecar-free equivalent — the chart supports BOTH, see
+        # values.yaml kubeRbacProxy / metricsAuth). A provider returning
+        # None fails CLOSED (401) — a missing/rotating Secret must not
+        # silently expose metrics. healthz/readyz stay open: the kubelet
+        # probes unauthenticated.
+        self.metrics_token = metrics_token
+        # Set (kube-rbac-proxy mode): /metrics moves to its own
+        # loopback-only listener for the sidecar to front, while
+        # healthz/readyz keep serving on (host, port) for kubelet probes —
+        # one listener for both would either expose metrics or break the
+        # probes.
+        self.metrics_loopback_port = metrics_loopback_port
+        self._servers: list = []
+        self._threads: list = []
 
-    def start(self) -> int:
-        """Starts serving; returns the bound port (0 picks a free one)."""
+    def _make_handler(self, serve_health: bool, serve_metrics: bool):
         ready_check = self.ready_check
+        metrics_token = self.metrics_token
+
+        auth_enabled = bool(metrics_token)  # provider callable or token set
+
+        def current_token() -> Optional[str]:
+            if callable(metrics_token):
+                return metrics_token()
+            return metrics_token
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                if self.path == "/healthz":
+                if self.path == "/healthz" and serve_health:
                     self._respond(200, "ok")
-                elif self.path == "/readyz":
+                elif self.path == "/readyz" and serve_health:
                     if ready_check():
                         self._respond(200, "ok")
                     else:
                         self._respond(503, "not ready")
-                elif self.path == "/metrics":
+                elif self.path == "/metrics" and serve_metrics:
+                    if auth_enabled:
+                        token = current_token()
+                        # Fail CLOSED on a missing or empty token (file
+                        # vanished or emptied mid-rotation) — never serve
+                        # unauthenticated because the credential source
+                        # degraded.
+                        if not token or (
+                            self.headers.get("Authorization", "")
+                            != f"Bearer {token}"
+                        ):
+                            self._respond(401, "unauthorized")
+                            return
                     self._respond(200, REGISTRY.render(), "text/plain; version=0.0.4")
                 else:
                     self._respond(404, "not found")
@@ -56,16 +93,36 @@ class HealthServer:
             def log_message(self, *args) -> None:  # silence request logging
                 pass
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="health", daemon=True
+        return Handler
+
+    def start(self) -> int:
+        """Starts serving; returns the bound health port (0 picks a free
+        one)."""
+        split = self.metrics_loopback_port is not None
+        main = ThreadingHTTPServer(
+            (self.host, self.port),
+            self._make_handler(serve_health=True, serve_metrics=not split),
         )
-        self._thread.start()
-        return self._server.server_address[1]
+        self._servers = [main]
+        if split:
+            self._servers.append(
+                ThreadingHTTPServer(
+                    ("127.0.0.1", self.metrics_loopback_port),
+                    self._make_handler(serve_health=False, serve_metrics=True),
+                )
+            )
+        self._threads = []
+        for i, server in enumerate(self._servers):
+            thread = threading.Thread(
+                target=server.serve_forever, name=f"health-{i}", daemon=True
+            )
+            thread.start()
+            self._threads.append(thread)
+        return main.server_address[1]
 
     def stop(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        for server in self._servers:
+            server.shutdown()
+            server.server_close()
+        for thread in self._threads:
+            thread.join(timeout=2.0)

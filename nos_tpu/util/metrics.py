@@ -9,7 +9,7 @@ scheduled, schedule latency, preemptions, gang completions.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 
 class Counter:

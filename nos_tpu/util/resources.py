@@ -7,7 +7,6 @@ can be expressed in one unit — GPU-memory GB there, TPU chips here).
 """
 from __future__ import annotations
 
-from typing import Dict
 
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.objects import Pod, ResourceList

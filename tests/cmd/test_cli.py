@@ -4,7 +4,7 @@ from nos_tpu.cmd.metricsexporter import collect_metrics, export
 from nos_tpu.cmd.run import configs_from, load_config, seed_node
 from nos_tpu.kube.store import KubeStore
 
-from tests.factory import build_pod, build_tpu_node
+from tests.factory import build_tpu_node
 
 
 class TestMetricsExporter:

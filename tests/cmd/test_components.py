@@ -166,3 +166,32 @@ class TestManifests:
             dockerfile = REPO / "build" / component / "Dockerfile"
             assert dockerfile.is_file(), component
             assert "ENTRYPOINT" in dockerfile.read_text()
+
+    def test_chart_template_includes_resolve(self):
+        """Every `include "x"` in the chart has a matching `define "x"` —
+        the closest thing to `helm lint` this image can run."""
+        import re
+
+        templates = REPO / "helm-charts/nos-tpu/templates"
+        sources = [p.read_text() for p in templates.rglob("*")
+                   if p.is_file() and p.suffix in (".yaml", ".tpl", ".txt")]
+        text = "\n".join(sources)
+        defined = set(re.findall(r'\{\{-?\s*define\s+"([^"]+)"', text))
+        included = set(re.findall(r'include\s+"([^"]+)"', text))
+        missing = included - defined
+        assert not missing, f"chart includes without defines: {missing}"
+
+    def test_values_cover_template_references(self):
+        """Top-level .Values.<key> references in templates exist in
+        values.yaml (catches renamed/missing value blocks)."""
+        import re
+
+        chart = REPO / "helm-charts/nos-tpu"
+        values = yaml.safe_load((chart / "values.yaml").read_text())
+        text = "\n".join(
+            p.read_text() for p in (chart / "templates").rglob("*")
+            if p.is_file() and p.suffix in (".yaml", ".tpl", ".txt")
+        )
+        roots = set(re.findall(r"\.Values\.([A-Za-z0-9_]+)", text))
+        missing = {r for r in roots if r not in values}
+        assert not missing, f"templates reference undefined values: {missing}"

@@ -2,7 +2,7 @@
 GC, and the hard ICI-domain filter."""
 import pytest
 
-from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.controllers.partitioner.multihost import (
     MULTIHOST_ROLE_LABEL,
     MULTIHOST_TOPOLOGY_ANNOTATION,
@@ -248,7 +248,6 @@ class TestWorkerWireFidelity:
         from nos_tpu.kube.apistore import KubeApiStore
         from nos_tpu.kube.controller import Request
         from tests.kube.stub_apiserver import StubApiServer
-        from nos_tpu.kube import serde
 
         with StubApiServer() as api:
             store = KubeApiStore(

@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from nos_tpu.data import BatchLoader, pack_documents, prefetch_to_device
 

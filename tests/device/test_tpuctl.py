@@ -1,6 +1,5 @@
 """Native tpuctl library tests: build it, then exercise the C++ slice
 placement and state management through the ctypes binding."""
-import os
 import threading
 
 import pytest

@@ -8,7 +8,6 @@ without double-booking chips.
 """
 import time
 
-import pytest
 
 from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
 from nos_tpu.api.v1alpha1 import annotations as annot

@@ -11,11 +11,10 @@ import time
 import pytest
 
 from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
-from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
 from nos_tpu.cmd import build_cluster
 from nos_tpu.controllers.partitioner.multihost import (
-    MULTIHOST_ROLE_LABEL,
     MULTIHOST_TOPOLOGY_ANNOTATION,
 )
 from nos_tpu.kube.objects import ObjectMeta, PodPhase

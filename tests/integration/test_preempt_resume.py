@@ -11,7 +11,6 @@ eviction, the workload side is the TPU build's own ground.
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

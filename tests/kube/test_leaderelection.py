@@ -4,7 +4,6 @@ resourceVersion race on the apiserver."""
 import threading
 import time
 
-import pytest
 
 from nos_tpu.kube.leaderelection import LeaderElector
 from nos_tpu.kube.store import KubeStore

@@ -5,7 +5,6 @@ through both stacks on identical weights — bitwise-independent
 implementations agreeing on logits is the strongest correctness evidence
 the model code has.
 """
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -208,7 +208,7 @@ class TestEngineMetrics:
         tick0 = metrics.SERVE_TICKS.value
         active0 = metrics.SERVE_SLOT_TICKS_ACTIVE.value
         eng = Engine(params, config, max_slots=2, max_len=64)
-        ids = [
+        _ids = [
             eng.submit(GenRequest(
                 prompt=rand_prompt(jax.random.key(90 + i), 5, config.vocab_size),
                 max_new_tokens=4,

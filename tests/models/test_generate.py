@@ -7,7 +7,6 @@ import pytest
 from nos_tpu.models.generate import (
     decode_step,
     generate,
-    init_kv_cache,
     prefill,
     reference_generate,
 )
@@ -336,7 +335,7 @@ class TestDecodeChunk:
             )
 
     def test_write_mask_redirects_to_trash_slot(self, setup):
-        from nos_tpu.models.generate import decode_chunk, init_kv_cache
+        from nos_tpu.models.generate import decode_chunk
 
         config, params, prompt = setup
         b, s = prompt.shape

@@ -5,7 +5,6 @@ import pytest
 from nos_tpu.models.llama import (
     init_llama_params,
     llama_forward,
-    llama_loss,
     tiny_config,
 )
 from nos_tpu.models.resnet import (

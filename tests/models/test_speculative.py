@@ -5,7 +5,6 @@ sequence equals the target's own greedy output — a perfect draft only
 makes it faster, a terrible draft only makes it slower.
 """
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -8,7 +8,6 @@ pin the memory contract and the numerics.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from nos_tpu.models.llama import init_llama_params, tiny_config
 from nos_tpu.parallel.mesh import mesh_from_devices

@@ -413,3 +413,56 @@ class TestPlannerGangFidelity:
         planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
         planner.plan(snap, [pending])
         assert [p.metadata.name for p in snap.get_node("n2").pods] == ["m1"]
+
+
+class TestAgedRescue:
+    """The aged-rescue pass: a starved small pod must win a dedicated
+    carve of a contested free region BEFORE exact-fit pods claim it."""
+
+    def aged_planner(self, *pods, age=10.0):
+        import time
+
+        planner = Planner(make_framework())
+        now = time.monotonic()
+        for pod in pods:
+            planner._pending_seen[(pod.namespaced_name, pod.metadata.uid)] = (
+                now - age,
+                now,
+            )
+        return planner
+
+    def test_aged_small_pod_wins_contested_free_slice(self):
+        # One free 2x2 (rest of the board used), a fresh 4-chip pod that
+        # fits it exactly, and a 1-chip pod aged past the rescue
+        # threshold. Without the rescue the 2x2 goes whole to the 4-chip
+        # pod every round (the free pool cannot serve 1 chip) and the
+        # 1-chip pod starves forever.
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={0: {"2x2": 1}})
+        used_pod = build_pod("holder", {slice_res("2x2"): 1}, node="n1", phase="Running")
+        snap = snapshot_of(
+            build_tpu_node(name="n1", annotations=ann),
+            pods_by_node={"n1": [used_pod]},
+        )
+        starved = build_pod("starved", {constants.RESOURCE_TPU: 1}, ns="ml")
+        fresh = build_pod("fresh", {constants.RESOURCE_TPU: 4}, ns="ml")
+        planner = self.aged_planner(starved)
+        planner.plan(snap, [starved, fresh])
+        placed = [p.metadata.name for p in snap.get_node("n1").pods]
+        assert "starved" in placed, placed
+
+    def test_fresh_small_pod_does_not_trigger_rescue(self):
+        # Same shape but nobody is aged: pure FFD gives the free 2x2 to
+        # the exact-fit 4-chip pod and the 1-chip pod waits (the normal
+        # packing order the rescue must NOT disturb).
+        ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={0: {"2x2": 1}})
+        used_pod = build_pod("holder", {slice_res("2x2"): 1}, node="n1", phase="Running")
+        snap = snapshot_of(
+            build_tpu_node(name="n1", annotations=ann),
+            pods_by_node={"n1": [used_pod]},
+        )
+        small = build_pod("small", {constants.RESOURCE_TPU: 1}, ns="ml")
+        fresh = build_pod("fresh", {constants.RESOURCE_TPU: 4}, ns="ml")
+        planner = Planner(make_framework())
+        planner.plan(snap, [small, fresh])
+        placed = [p.metadata.name for p in snap.get_node("n1").pods]
+        assert "fresh" in placed and "small" not in placed, placed

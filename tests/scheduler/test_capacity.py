@@ -1,4 +1,4 @@
-from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.api.v1alpha1.elasticquota import (
     CompositeElasticQuota,
     CompositeElasticQuotaSpec,

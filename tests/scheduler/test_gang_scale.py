@@ -3,7 +3,6 @@ chip contention, two gangs racing one slice, and preemption evicting a
 full gang including still-pending members."""
 import time
 
-import pytest
 
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec

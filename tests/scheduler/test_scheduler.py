@@ -42,7 +42,6 @@ class TestBasicScheduling:
         assert result is not None and result.requeue_after > 0
 
     def test_prefers_exact_slice_fit(self):
-        from nos_tpu.api.v1alpha1 import annotations as annot
         store = KubeStore()
         # n-exact advertises a free 2x2; n-big advertises a 2x4.
         exact = build_tpu_node(name="n-exact")
@@ -90,7 +89,7 @@ class TestPreemptionFlow:
         borrower.metadata.labels[labels.CAPACITY_LABEL] = labels.CAPACITY_OVER_QUOTA
         store.create(borrower)
         s = make_scheduler(store)
-        result = sched_pod(s, store, build_pod("p", {CHIPS: 4}, ns="team-a"))
+        sched_pod(s, store, build_pod("p", {CHIPS: 4}, ns="team-a"))
         # borrower evicted, node nominated
         assert store.try_get("Pod", "borrower", "team-b") is None
         assert store.get("Pod", "p", "team-a").status.nominated_node_name == "n1"

@@ -1,4 +1,3 @@
-import pytest
 
 from nos_tpu.api.v1alpha1 import annotations as annot
 from nos_tpu.api.v1alpha1 import constants, labels

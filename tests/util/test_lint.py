@@ -1,0 +1,133 @@
+"""tools/lint.py self-tests: each check fires, and the known
+false-positive traps (format specs, closures, class attributes,
+subscript-target loads) stay quiet."""
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "nos_lint",
+    os.path.join(os.path.dirname(__file__), "..", "..", "tools", "lint.py"),
+)
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+
+def findings_for(tmp_path, source):
+    path = tmp_path / "case.py"
+    path.write_text(source)
+    return [(f.code, f.line) for f in lint.lint_file(str(path))]
+
+
+def codes_for(tmp_path, source):
+    return {c for c, _ in findings_for(tmp_path, source)}
+
+
+class TestChecksFire:
+    def test_unused_import(self, tmp_path):
+        assert codes_for(tmp_path, "import os\n") == {"F401"}
+
+    def test_unused_from_import(self, tmp_path):
+        assert codes_for(tmp_path, "from os import path\n") == {"F401"}
+
+    def test_redefinition(self, tmp_path):
+        src = "def f():\n    pass\ndef f():\n    pass\n"
+        assert codes_for(tmp_path, src) == {"F811"}
+
+    def test_unused_local(self, tmp_path):
+        src = "def f():\n    x = 1\n    return 2\n"
+        assert codes_for(tmp_path, src) == {"F841"}
+
+    def test_mutable_default(self, tmp_path):
+        assert codes_for(tmp_path, "def f(a=[]):\n    return a\n") == {"B006"}
+
+    def test_bare_except(self, tmp_path):
+        src = "try:\n    pass\nexcept:\n    pass\n"
+        assert codes_for(tmp_path, src) == {"E722"}
+
+    def test_fstring_no_placeholder(self, tmp_path):
+        assert codes_for(tmp_path, 'x = f"plain"\nprint(x)\n') == {"F541"}
+
+    def test_todo_marker(self, tmp_path):
+        marker = "TO" + "DO"  # split so this file stays lint-clean
+        assert codes_for(tmp_path, f"# {marker}: later\n") == {"T100"}
+
+    def test_syntax_error(self, tmp_path):
+        assert codes_for(tmp_path, "def f(:\n") == {"E999"}
+
+
+class TestNoFalsePositives:
+    def test_format_spec_not_f541(self, tmp_path):
+        assert codes_for(tmp_path, 'def f(x):\n    return f"{x:.3f}"\n') == set()
+
+    def test_closure_usage_counts(self, tmp_path):
+        src = (
+            "def f():\n"
+            "    mesh = 1\n"
+            "    def g():\n"
+            "        return mesh\n"
+            "    return g\n"
+        )
+        assert codes_for(tmp_path, src) == set()
+
+    def test_class_attribute_not_local(self, tmp_path):
+        src = (
+            "def f():\n"
+            "    class H:\n"
+            "        protocol_version = 'HTTP/1.1'\n"
+            "    return H\n"
+        )
+        assert codes_for(tmp_path, src) == set()
+
+    def test_subscript_target_loads_count(self, tmp_path):
+        src = (
+            "def f(result):\n"
+            "    tag = 'k'\n"
+            "    result[f'x_{tag}'] = 1\n"
+        )
+        assert codes_for(tmp_path, src) == set()
+
+    def test_underscore_local_ignored(self, tmp_path):
+        assert codes_for(tmp_path, "def f():\n    _x = 1\n    return 2\n") == set()
+
+    def test_dunder_all_counts_as_usage(self, tmp_path):
+        src = "from os import path\n__all__ = ['path']\n"
+        assert codes_for(tmp_path, src) == set()
+
+    def test_init_py_exempt_from_f401(self, tmp_path):
+        path = tmp_path / "__init__.py"
+        path.write_text("from os import path\n")
+        assert [f.code for f in lint.lint_file(str(path))] == []
+
+    def test_property_setter_not_f811(self, tmp_path):
+        src = (
+            "class C:\n"
+            "    @property\n"
+            "    def x(self):\n"
+            "        return 1\n"
+            "    @x.setter\n"
+            "    def x(self, v):\n"
+            "        pass\n"
+        )
+        assert codes_for(tmp_path, src) == set()
+
+
+class TestNoqa:
+    def test_bare_noqa(self, tmp_path):
+        assert codes_for(tmp_path, "import os  # noqa\n") == set()
+
+    def test_coded_noqa_matching(self, tmp_path):
+        assert codes_for(tmp_path, "import os  # noqa: F401\n") == set()
+
+    def test_coded_noqa_other_code_still_fires(self, tmp_path):
+        assert codes_for(tmp_path, "import os  # noqa: E722\n") == {"F401"}
+
+
+class TestRepoIsClean:
+    def test_repo_lint_clean(self):
+        repo = os.path.join(os.path.dirname(__file__), "..", "..")
+        findings = []
+        for target in lint.DEFAULT_TARGETS:
+            full = os.path.join(repo, target)
+            for path in lint.iter_py([full]):
+                findings.extend(lint.lint_file(path))
+        assert not findings, "\n".join(str(f) for f in findings)

@@ -124,3 +124,63 @@ class TestSubsystemCounters:
             assert metrics.LEADER_TRANSITIONS.value == before + 1
         finally:
             elector.stop()
+
+
+class TestMetricsAuth:
+    def test_metrics_token_enforced(self):
+        import http.client
+
+        from nos_tpu.util.health import HealthServer
+
+        server = HealthServer(port=0, metrics_token="s3cret")
+        port = server.start()
+        try:
+            def get(path, token=None):
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+                headers = {"Authorization": f"Bearer {token}"} if token else {}
+                conn.request("GET", path, headers=headers)
+                return conn.getresponse().status
+
+            assert get("/metrics") == 401           # no token
+            assert get("/metrics", "wrong") == 401  # bad token
+            assert get("/metrics", "s3cret") == 200
+            assert get("/healthz") == 200           # probes stay open
+            assert get("/readyz") == 200
+        finally:
+            server.stop()
+
+    def test_empty_token_provider_fails_closed(self):
+        import http.client
+
+        from nos_tpu.util.health import HealthServer
+
+        server = HealthServer(port=0, metrics_token=lambda: "")
+        port = server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("GET", "/metrics")
+            assert conn.getresponse().status == 401  # degraded secret != open
+        finally:
+            server.stop()
+
+    def test_split_metrics_listener(self):
+        import http.client
+
+        from nos_tpu.util.health import HealthServer
+
+        server = HealthServer(port=0, metrics_loopback_port=0)
+        # port 0 for the loopback listener too: pick free ports
+        health_port = server.start()
+        metrics_port = server._servers[1].server_address[1]
+        try:
+            def get(port, path):
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+                conn.request("GET", path)
+                return conn.getresponse().status
+
+            assert get(health_port, "/healthz") == 200
+            assert get(health_port, "/metrics") == 404  # moved off probes port
+            assert get(metrics_port, "/metrics") == 200
+            assert get(metrics_port, "/healthz") == 404
+        finally:
+            server.stop()

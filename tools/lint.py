@@ -1,0 +1,267 @@
+"""AST-based linter for nos_tpu (ruff/pyflakes are not in this image).
+
+Checks, per file:
+  F401  unused import              (skipped in __init__.py re-export surfaces)
+  F811  redefinition in same scope (function/class defined twice)
+  F841  unused local variable      (assigned once, never read, not _-prefixed)
+  B006  mutable default argument   (list/dict/set literal or call)
+  E722  bare except
+  F541  f-string without placeholders
+  T100  TODO/FIXME/XXX marker
+
+Usage: python tools/lint.py [paths...]   (default: nos_tpu tests examples
+bench.py __graft_entry__.py). Exits 1 if any finding. A `# noqa` on the
+offending line suppresses it; `# noqa: F401` suppresses one code.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+DEFAULT_TARGETS = ["nos_tpu", "tests", "examples", "bench.py", "__graft_entry__.py"]
+MARKER_RE = re.compile(r"\b(TODO|FIXME|XXX)\b")
+NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.IGNORECASE)
+
+
+class Finding:
+    def __init__(self, path: str, line: int, code: str, msg: str) -> None:
+        self.path, self.line, self.code, self.msg = path, line, code, msg
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.msg}"
+
+
+def _suppressed(source_lines: list, finding: Finding) -> bool:
+    if not (1 <= finding.line <= len(source_lines)):
+        return False
+    m = NOQA_RE.search(source_lines[finding.line - 1])
+    if not m:
+        return False
+    codes = m.group("codes")
+    if not codes:
+        return True
+    return finding.code in {c.strip() for c in codes.split(",")}
+
+
+class _ScopeVisitor(ast.NodeVisitor):
+    """Collects findings that need scope awareness (F401/F811/F841)."""
+
+    def __init__(self, path: str, is_init: bool) -> None:
+        self.path = path
+        self.is_init = is_init
+        self.findings: list = []
+        # module-level import bindings: name -> (lineno, qualname-ish)
+        self.imports: dict = {}
+        self.used_names: set = set()
+        self.module_dunder_all: set = set()
+
+    # ---- imports
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = node.lineno
+        self.generic_visit(node)
+
+    # ---- usage
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # `foo.bar` marks `foo` used via the Name child; nothing extra.
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # __all__ entries count as usage (re-export surface).
+        for target in node.targets:
+            if isinstance(target, ast.Name) and target.id == "__all__":
+                for elt in getattr(node.value, "elts", []):
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        self.module_dunder_all.add(elt.value)
+        self.generic_visit(node)
+
+    # ---- function-level checks
+    def _check_function(self, node) -> None:
+        # B006 mutable defaults
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and isinstance(default.func, ast.Name)
+                and default.func.id in ("list", "dict", "set")
+            ):
+                self.findings.append(
+                    Finding(self.path, default.lineno, "B006",
+                            "mutable default argument")
+                )
+        # F841 unused locals: single-target simple assigns in this scope
+        assigned: dict = {}
+        used: set = set()
+
+        class LocalWalk(ast.NodeVisitor):
+            """Assignments from THIS scope only; usage from everywhere
+            below it (nested defs/lambdas may close over our locals)."""
+
+            def __init__(self, top: bool = True) -> None:
+                self.top = top
+
+            def visit_FunctionDef(self, n):
+                LocalWalk(top=False).generic_visit(n)  # usage only
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, n):
+                LocalWalk(top=False).generic_visit(n)
+
+            def visit_ClassDef(self, n):
+                # Class-body assigns are attribute definitions, not
+                # function locals; still collect usage inside.
+                LocalWalk(top=False).generic_visit(n)
+
+            def visit_Assign(self, n):
+                if (
+                    self.top
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                ):
+                    name = n.targets[0].id
+                    if not name.startswith("_"):
+                        assigned.setdefault(name, n.targets[0].lineno)
+                # Visit everything: Store-ctx Names are ignored by
+                # visit_Name, and non-Name targets (subscripts, attrs)
+                # contain Loads that must count as usage.
+                for child in ast.iter_child_nodes(n):
+                    self.visit(child)
+
+            def visit_Name(self, n):
+                if isinstance(n.ctx, (ast.Load, ast.Del)):
+                    used.add(n.id)
+
+            def generic_visit(self, n):
+                for child in ast.iter_child_nodes(n):
+                    self.visit(child)
+
+        walker = LocalWalk()
+        for stmt in node.body:
+            walker.visit(stmt)
+        for name, lineno in assigned.items():
+            if name not in used:
+                self.findings.append(
+                    Finding(self.path, lineno, "F841",
+                            f"local variable {name!r} assigned but never used")
+                )
+
+    def visit_FunctionDef(self, node) -> None:
+        self._check_function(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # ---- other checks
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.findings.append(
+                Finding(self.path, node.lineno, "E722", "bare except"))
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.findings.append(
+                Finding(self.path, node.lineno, "F541",
+                        "f-string without placeholders"))
+        # A placeholder's format spec (`{x:.3f}`) is itself a JoinedStr
+        # with no FormattedValue — visiting it would false-positive F541.
+        for value in node.values:
+            if isinstance(value, ast.FormattedValue):
+                self.visit(value.value)
+            else:
+                self.visit(value)
+
+    # ---- redefinitions (same body scope, def/class only)
+    def _check_redefs(self, body, where: str) -> None:
+        seen: dict = {}
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                has_decorators = bool(stmt.decorator_list)
+                if stmt.name in seen and not has_decorators and not seen[stmt.name]:
+                    self.findings.append(
+                        Finding(self.path, stmt.lineno, "F811",
+                                f"redefinition of {stmt.name!r} ({where})"))
+                seen[stmt.name] = has_decorators  # properties/overloads ok
+            if isinstance(stmt, ast.ClassDef):
+                self._check_redefs(stmt.body, f"class {stmt.name}")
+
+    def finish(self, tree: ast.Module) -> None:
+        self._check_redefs(tree.body, "module")
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_redefs(node.body, f"def {node.name}")
+        if not self.is_init:
+            for name, lineno in self.imports.items():
+                if name in self.used_names or name in self.module_dunder_all:
+                    continue
+                if name == "annotations":  # from __future__
+                    continue
+                self.findings.append(
+                    Finding(self.path, lineno, "F401",
+                            f"{name!r} imported but unused"))
+
+
+def lint_file(path: str) -> list:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    visitor = _ScopeVisitor(path, os.path.basename(path) == "__init__.py")
+    visitor.visit(tree)
+    visitor.finish(tree)
+    for i, line in enumerate(lines, 1):
+        m = MARKER_RE.search(line)
+        if m:
+            visitor.findings.append(
+                Finding(path, i, "T100", f"{m.group(1)} marker"))
+    return [f for f in visitor.findings if not _suppressed(lines, f)]
+
+
+def iter_py(targets) -> list:
+    out = []
+    for target in targets:
+        if os.path.isfile(target):
+            out.append(target)
+            continue
+        for root, dirs, files in os.walk(target):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(root, f) for f in files if f.endswith(".py"))
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    targets = (argv or sys.argv[1:]) or DEFAULT_TARGETS
+    findings = []
+    n_files = 0
+    for path in iter_py(targets):
+        n_files += 1
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    print(f"lint: {n_files} files, {len(findings)} findings", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
