@@ -20,3 +20,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the ML tier's wall time is dominated by
+# XLA compiles of the same programs every run (the 8-stage pipeline tests
+# alone cost minutes). Cache survives across runs (and is keyed by HLO,
+# so shape/code changes miss safely). Override with
+# NOS_TEST_CC_DIR="" to disable.
+_cc_dir = os.environ.get("NOS_TEST_CC_DIR", "/tmp/nos-tpu-test-jax-cache")
+if _cc_dir:
+    jax.config.update("jax_compilation_cache_dir", _cc_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

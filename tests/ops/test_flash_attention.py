@@ -146,8 +146,12 @@ class TestFlashBackward:
         params = init_llama_params(jax.random.key(0), dense_cfg)
         tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, dense_cfg.vocab_size)
 
-        l_d, g_d = jax.value_and_grad(lambda p: llama_loss(p, tokens, dense_cfg))(params)
-        l_f, g_f = jax.value_and_grad(lambda p: llama_loss(p, tokens, flash_cfg))(params)
+        l_d, g_d = jax.jit(
+            jax.value_and_grad(lambda p: llama_loss(p, tokens, dense_cfg))
+        )(params)
+        l_f, g_f = jax.jit(
+            jax.value_and_grad(lambda p: llama_loss(p, tokens, flash_cfg))
+        )(params)
         assert abs(float(l_d) - float(l_f)) < 2e-2
         wq_d = jnp.asarray(g_d["layers"][0]["wq"], jnp.float32)
         wq_f = jnp.asarray(g_f["layers"][0]["wq"], jnp.float32)
@@ -161,12 +165,17 @@ class TestFlashBackward:
         cfg_r = tiny_config(remat=True)
         params = init_llama_params(jax.random.key(0), cfg)
         tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
-        l, g = jax.value_and_grad(lambda p: llama_loss(p, tokens, cfg))(params)
-        l_r, g_r = jax.value_and_grad(lambda p: llama_loss(p, tokens, cfg_r))(params)
-        assert float(l) == float(l_r)
+        l, g = jax.jit(jax.value_and_grad(lambda p: llama_loss(p, tokens, cfg)))(params)
+        l_r, g_r = jax.jit(
+            jax.value_and_grad(lambda p: llama_loss(p, tokens, cfg_r))
+        )(params)
+        assert abs(float(l) - float(l_r)) < 1e-4
         a = jnp.asarray(g["layers"][0]["wq"], jnp.float32)
         b = jnp.asarray(g_r["layers"][0]["wq"], jnp.float32)
-        assert jnp.allclose(a, b, atol=1e-6), float(jnp.abs(a - b).max())
+        # Under jit, XLA fuses the remat recomputation differently from
+        # the primal pass, so bf16 grads differ by a few ulps (the exact
+        # 1e-6 match only held op-by-op on the eager path).
+        assert jnp.allclose(a, b, atol=2e-3), float(jnp.abs(a - b).max())
 
 
 class TestBlockPartials:

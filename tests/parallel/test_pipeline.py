@@ -43,7 +43,9 @@ class TestPipelineForward:
         config, params, tokens = setup(n_layers=4)
         mesh = mesh_from_devices((pp,), ("pp",), jax.devices()[:pp])
         stacked = stack_layer_params(params)
-        got = pipeline_llama_forward(stacked, tokens, config, mesh)
+        got = jax.jit(
+            lambda p, t: pipeline_llama_forward(p, t, config, mesh)
+        )(stacked, tokens)
         want = llama_forward(params, tokens, config)
         assert_logits_match(got, want)
 
@@ -51,7 +53,9 @@ class TestPipelineForward:
         config, params, tokens = setup(n_layers=2)
         mesh = mesh_from_devices((2,), ("pp",), jax.devices()[:2])
         stacked = stack_layer_params(params)
-        got = pipeline_llama_forward(stacked, tokens, config, mesh, n_microbatches=8)
+        got = jax.jit(
+            lambda p, t: pipeline_llama_forward(p, t, config, mesh, n_microbatches=8)
+        )(stacked, tokens)
         want = llama_forward(params, tokens, config)
         assert_logits_match(got, want)
 
@@ -59,7 +63,9 @@ class TestPipelineForward:
         config, params, tokens = setup(n_layers=4)
         mesh = mesh_from_devices((2, 4), ("dp", "pp"))
         stacked = stack_layer_params(params)
-        got = pipeline_llama_forward(stacked, tokens, config, mesh)
+        got = jax.jit(
+            lambda p, t: pipeline_llama_forward(p, t, config, mesh)
+        )(stacked, tokens)
         want = llama_forward(params, tokens, config)
         assert_logits_match(got, want)
 
@@ -70,7 +76,9 @@ class TestPipelineForward:
         params = init_llama_params(jax.random.key(0), config)
         tokens = jax.random.randint(jax.random.key(1), (4, 8), 0, config.vocab_size)
         mesh = mesh_from_devices((2,), ("pp",), jax.devices()[:2])
-        got = pipeline_llama_forward(stack_layer_params(params), tokens, config, mesh)
+        got = jax.jit(
+            lambda p, t: pipeline_llama_forward(p, t, config, mesh)
+        )(stack_layer_params(params), tokens)
         want = llama_forward(params, tokens, config)
         assert jnp.allclose(got, want, atol=1e-5), float(jnp.abs(got - want).max())
 
@@ -89,8 +97,8 @@ class TestPipelineTraining:
         sharding = pipeline_param_sharding(mesh, config)
         stacked = jax.device_put(stacked, sharding)
 
-        loss, grads = jax.value_and_grad(
-            lambda p: pipeline_llama_loss(p, tokens, config, mesh)
+        loss, grads = jax.jit(
+            jax.value_and_grad(lambda p: pipeline_llama_loss(p, tokens, config, mesh))
         )(stacked)
         seq_loss = llama_loss(params, tokens, config)
         assert abs(float(loss) - float(seq_loss)) < 2e-2
@@ -136,7 +144,9 @@ class TestPipelineTraining:
         config, params, tokens = setup(n_layers=2)
         mesh = mesh_from_devices((2, 2), ("dp", "pp"))
         stacked = stack_layer_params(params)
-        got = pipeline_llama_loss(stacked, tokens, config, mesh)
+        got = jax.jit(
+            lambda p, t: pipeline_llama_loss(p, t, config, mesh)
+        )(stacked, tokens)
         want = llama_loss(params, tokens, config)
         assert abs(float(got) - float(want)) < 2e-2
 
@@ -150,6 +160,8 @@ class TestPipelineTraining:
         params = init_llama_params(jax.random.key(0), config)
         tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, config.vocab_size)
         mesh = mesh_from_devices((2,), ("pp",), jax.devices()[:2])
-        got = pipeline_llama_loss(stack_layer_params(params), tokens, config, mesh)
+        got = jax.jit(
+            lambda p, t: pipeline_llama_loss(p, t, config, mesh)
+        )(stack_layer_params(params), tokens)
         want = next_token_nll(llama_forward(params, tokens, config), tokens)
         assert abs(float(got) - float(want)) < 2e-2, (float(got), float(want))

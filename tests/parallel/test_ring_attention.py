@@ -95,9 +95,9 @@ class TestSequenceParallelTraining:
         config = tiny_config()
         params = init_llama_params(jax.random.key(0), config)
         tokens = jax.random.randint(jax.random.key(2), (2, 16), 0, config.vocab_size)
-        dense = llama_loss(params, tokens, config)
+        dense = jax.jit(lambda p, t: llama_loss(p, t, config))(params, tokens)
         mesh = mesh_from_devices((1, 4, 1), ("dp", "sp", "tp"), jax.devices()[:4])
-        ring = llama_loss(params, tokens, config, mesh)
+        ring = jax.jit(lambda p, t: llama_loss(p, t, config, mesh))(params, tokens)
         assert abs(float(dense) - float(ring)) < 2e-2
 
 
@@ -110,7 +110,7 @@ class TestRingFlashAttention:
 
         q, k, v = random_qkv(jax.random.key(30), b=2, s=32, hq=4, hkv=2, hd=16)
         mesh = mesh_from_devices((4,), ("sp",), jax.devices()[:4])
-        got = ring_flash_attention(q, k, v, mesh)
+        got = jax.jit(lambda q, k, v: ring_flash_attention(q, k, v, mesh))(q, k, v)
         want = dense_reference(q, k, v, causal=True)
         assert jnp.allclose(got, want, atol=1e-4), float(jnp.abs(got - want).max())
 
@@ -119,7 +119,9 @@ class TestRingFlashAttention:
 
         q, k, v = random_qkv(jax.random.key(31), b=1, s=16, hq=2, hkv=2, hd=8)
         mesh = mesh_from_devices((4,), ("sp",), jax.devices()[:4])
-        got = ring_flash_attention(q, k, v, mesh, causal=False)
+        got = jax.jit(
+            lambda q, k, v: ring_flash_attention(q, k, v, mesh, causal=False)
+        )(q, k, v)
         want = dense_reference(q, k, v, causal=False)
         assert jnp.allclose(got, want, atol=1e-4)
 
@@ -147,7 +149,7 @@ class TestRingFlashAttention:
 
         q, k, v = random_qkv(jax.random.key(34), b=2, s=16, hq=4, hkv=4, hd=8)
         mesh = mesh_from_devices((2, 2, 2), ("dp", "sp", "tp"))
-        got = ring_flash_attention(q, k, v, mesh)
+        got = jax.jit(lambda q, k, v: ring_flash_attention(q, k, v, mesh))(q, k, v)
         want = dense_reference(q, k, v, causal=True)
         assert jnp.allclose(got, want, atol=1e-4)
 
@@ -163,7 +165,9 @@ class TestRingFlashAttention:
         tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, dense_cfg.vocab_size)
         mesh = mesh_from_devices((2, 2, 2), ("dp", "sp", "tp"))
 
-        l_d, g_d = jax.value_and_grad(lambda p: llama_loss(p, tokens, dense_cfg))(params)
+        l_d, g_d = jax.jit(
+            jax.value_and_grad(lambda p: llama_loss(p, tokens, dense_cfg))
+        )(params)
         l_f, g_f = jax.jit(
             jax.value_and_grad(lambda p: llama_loss(p, tokens, flash_cfg, mesh))
         )(params)
