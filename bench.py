@@ -248,6 +248,86 @@ def run_tpu_child() -> None:
             log(f"[tpu-child] fwd flash failed: {type(e).__name__}: {str(e)[:200]}")
         snapshot()
 
+        # ---- raw attention-op bench: kernel vs XLA dense on exactly the
+        # model's attention shape, across block sizes. Isolates the kernel
+        # from the rest of the model (r02 measured whole-model flash at
+        # 0.90x dense at 2x1024 — this pinpoints whether the kernel or
+        # the surrounding program is at fault, and which (blk_q, blk_k)
+        # the default should be on this chip generation).
+        try:
+            from nos_tpu.ops.flash_attention import flash_attention
+
+            ab, as_, ahq, ahkv, ahd = (
+                result.get("train_batch", 8) or 8,
+                result.get("train_seq", 2048) or 2048,
+                config.n_heads,
+                config.n_kv_heads,
+                config.d_model // config.n_heads,
+            )
+            kq = jax.random.normal(
+                jax.random.key(1), (ab, as_, ahq, ahd), jnp.bfloat16
+            )
+            kk = jax.random.normal(
+                jax.random.key(2), (ab, as_, ahkv, ahd), jnp.bfloat16
+            )
+            kv = jax.random.normal(
+                jax.random.key(3), (ab, as_, ahkv, ahd), jnp.bfloat16
+            )
+
+            def time_op(fn, iters=20):
+                out = fn(kq, kk, kv)
+                jax.block_until_ready(out)
+                start = time.monotonic()
+                for _ in range(iters):
+                    out = fn(kq, kk, kv)
+                jax.block_until_ready(out)
+                return (time.monotonic() - start) / iters * 1000
+
+            def dense_ref(q, k, v):
+                # The model's dense path: repeat kv heads, causal softmax.
+                g = ahq // ahkv
+                kr = jnp.repeat(k, g, axis=2)
+                vr = jnp.repeat(v, g, axis=2)
+                logits = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
+                ) / (ahd ** 0.5)
+                mask = jnp.tril(jnp.ones((as_, as_), bool))
+                logits = jnp.where(mask[None, None], logits, -jnp.inf)
+                probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+                return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+
+            d_ms = time_op(jax.jit(dense_ref))
+            result["attn_dense_ms"] = round(d_ms, 2)
+            log(f"[tpu-child] attn dense: {d_ms:.2f} ms @ {ab}x{as_}")
+            best = None
+            for bq, bk in ((128, 256), (256, 256), (256, 512), (512, 512), (512, 1024)):
+                if bq > as_ or bk > as_:
+                    continue
+                try:
+                    f_ms = time_op(
+                        jax.jit(
+                            lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                                q, k, v, blk_q=bq, blk_k=bk
+                            )
+                        )
+                    )
+                    result[f"attn_flash_{bq}x{bk}_ms"] = round(f_ms, 2)
+                    log(f"[tpu-child] attn flash {bq}x{bk}: {f_ms:.2f} ms "
+                        f"({d_ms / f_ms:.2f}x dense)")
+                    if best is None or f_ms < best[1]:
+                        best = ((bq, bk), f_ms)
+                except Exception as e:
+                    log(f"[tpu-child] attn flash {bq}x{bk} failed: "
+                        f"{type(e).__name__}: {str(e)[:120]}")
+            if best is not None:
+                result["attn_flash_best_blocks"] = f"{best[0][0]}x{best[0][1]}"
+                result["attn_flash_best_ms"] = round(best[1], 2)
+                result["attn_flash_vs_dense"] = round(d_ms / best[1], 3)
+            del kq, kk, kv
+            snapshot()
+        except Exception as e:
+            log(f"[tpu-child] attn-op bench failed: {type(e).__name__}: {str(e)[:160]}")
+
         # ---- serving: KV-cache autoregressive decode throughput (the
         # per-token cost a slice tenant sees; memory-bandwidth-bound).
         # Runs BEFORE the long-context sweep: its compiled executables and
