@@ -16,8 +16,10 @@ Layout notes (verified by the torch-vs-JAX logits parity test):
 - Rotary embedding conventions match (the half-split "neox" rotation with
   per-half frequency tables), so Q/K convert untouched.
 - GQA head ordering matches (kv-head-major query heads).
-- ``lm_head`` may be tied to the embedding (``tie_word_embeddings``); the
-  converter materializes it either way.
+- ``lm_head`` may be tied to the embedding (``tie_word_embeddings`` /
+  Gemma): the config records ``tie_embeddings`` and the params tree then
+  carries NO lm_head — every forward path unembeds through
+  ``params["embed"].T`` (llama._unembed_weight).
 """
 from __future__ import annotations
 
@@ -55,13 +57,35 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
                 "guess scaled-RoPE parameters"
             )
         rope_scaling = ("llama3",) + tuple(float(scaling[k]) for k in required)
+    # Gemma is the same decoder skeleton with four dialect switches:
+    # gelu gated MLP, (1 + w) RMSNorm, sqrt(d_model)-scaled embeddings,
+    # always-tied unembedding — plus an explicit head_dim (Gemma-7B's 256
+    # does not equal hidden/heads).
+    model_type = getattr(hf_config, "model_type", "llama")
+    is_gemma = model_type == "gemma"
     head_dim = getattr(hf_config, "head_dim", None)
     derived = hf_config.hidden_size // hf_config.num_attention_heads
+    qk_head_dim = None
     if head_dim not in (None, derived):
-        raise ValueError(
-            f"head_dim={head_dim} != hidden_size/num_heads={derived}: "
-            "unsupported layout"
-        )
+        if is_gemma:
+            qk_head_dim = int(head_dim)
+        else:
+            raise ValueError(
+                f"head_dim={head_dim} != hidden_size/num_heads={derived}: "
+                "unsupported layout"
+            )
+    hidden_act = getattr(hf_config, "hidden_act", None) or getattr(
+        hf_config, "hidden_activation", None
+    ) or "silu"
+    if hidden_act in ("gelu_pytorch_tanh", "gelu_new") or (
+        hidden_act == "gelu" and is_gemma
+    ):
+        # tanh-approximate GELU (plain "gelu" is a legacy alias only in
+        # Gemma configs — elsewhere it means exact erf GELU, which this
+        # stack does not implement; refuse rather than silently differ).
+        hidden_act = "gelu"
+    elif hidden_act != "silu":
+        raise ValueError(f"unsupported hidden_act={hidden_act!r}")
     # Mistral-family sliding window (the arch is otherwise Llama-shaped;
     # the same converter serves both). transformers uses None for "full".
     sliding = getattr(hf_config, "sliding_window", None)
@@ -78,6 +102,12 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
         rope_scaling=rope_scaling,
         norm_eps=float(hf_config.rms_norm_eps),
         sliding_window=int(sliding) if sliding else None,
+        hidden_act=hidden_act,
+        norm_offset=is_gemma,
+        scale_embeddings=is_gemma,
+        tie_embeddings=is_gemma
+        or bool(getattr(hf_config, "tie_word_embeddings", False)),
+        qk_head_dim=qk_head_dim,
         dtype=dtype,
     )
 
@@ -107,13 +137,20 @@ def params_from_hf_state_dict(state_dict, config: LlamaConfig) -> Params:
     params: Params = {
         "embed": embed,
         "final_norm": take("model.norm.weight", _v),
-        "lm_head": (
-            take("lm_head.weight", _t)
-            if "lm_head.weight" in sd
-            else embed.T  # tied embeddings: one conversion, transposed view
-        ),
         "layers": [],
     }
+    if c.tie_embeddings:
+        # Tied unembedding: no separate matrix — the forward's _unembed
+        # reuses params["embed"].T. Consume the checkpoint's lm_head copy
+        # if one exists (some exports materialize it anyway).
+        if "lm_head.weight" in sd:
+            consumed.add("lm_head.weight")
+    else:
+        params["lm_head"] = (
+            take("lm_head.weight", _t)
+            if "lm_head.weight" in sd
+            else embed.T  # tied checkpoint but untied config: materialize
+        )
     for i in range(c.n_layers):
         prefix = f"model.layers.{i}."
         params["layers"].append(

@@ -30,6 +30,7 @@ from nos_tpu.models.llama import (
     _rms_norm,
     _rope,
     _rope_at,
+    _unembed,
     _window_causal_mask,
     llama_forward,
 )
@@ -55,7 +56,7 @@ def _ffn(h: jax.Array, layer: Params, config: LlamaConfig) -> jax.Array:
         from nos_tpu.models.moe import moe_mlp
 
         return moe_mlp(layer["moe"], h, config.moe_config())
-    return _mlp(h, layer)
+    return _mlp(h, layer, config.hidden_act)
 
 
 def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None):
@@ -125,7 +126,7 @@ def prefill(
             "sliding_window does not support left-padded prompts; batch "
             "via the engine's chunked admission instead"
         )
-    x = _embed_rows(params["embed"], tokens, c.dtype)
+    x = _embed_rows(params["embed"], tokens, c.dtype, c.embed_scale)
     if pad_id is None:
         cos, sin = _rope(s, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
         cos_b = sin_b = None
@@ -146,7 +147,7 @@ def prefill(
         return _apply_rope(arr, cos_b, sin_b)  # rank-4: per-row tables
 
     for i, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
+        h = _rms_norm(x, layer["attn_norm"], c.norm_eps, c.norm_offset)
         hd = c.head_dim
         q = _mm(h, layer["wq"]).reshape(b, s, c.n_heads, hd)
         k = _mm(h, layer["wk"]).reshape(b, s, c.n_kv_heads, hd)
@@ -185,9 +186,9 @@ def prefill(
                 b, s, c.n_heads * hd
             )
         x = x + _mm(attn, layer["wo"])
-        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
-    x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return _mm(x, params["lm_head"]).astype(jnp.float32), cache
+        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset), layer, c)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
+    return _unembed(params, x).astype(jnp.float32), cache
 
 
 def decode_step(
@@ -214,7 +215,7 @@ def decode_step(
     b = token.shape[0]
     hd = c.head_dim
     per_row = getattr(pos, "ndim", 0) == 1
-    x = _embed_rows(params["embed"], token, c.dtype)[:, None, :]  # [B, 1, D]
+    x = _embed_rows(params["embed"], token, c.dtype, c.embed_scale)[:, None, :]  # [B, 1, D]
     if rope_pos is None and per_row:
         rope_pos = pos
     if rope_pos is None:
@@ -232,7 +233,7 @@ def decode_step(
     rows = jnp.arange(b)
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):
-        h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
+        h = _rms_norm(x, layer["attn_norm"], c.norm_eps, c.norm_offset)
         q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, hd)
         k = _mm(h, layer["wk"]).reshape(b, 1, c.n_kv_heads, hd)
         v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
@@ -247,9 +248,9 @@ def decode_step(
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
         x = x + _mm(attn, layer["wo"])
-        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
-    x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return _mm(x[:, 0], params["lm_head"]).astype(jnp.float32), new_cache
+        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset), layer, c)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
+    return _unembed(params, x[:, 0]).astype(jnp.float32), new_cache
 
 
 def decode_chunk(
@@ -277,7 +278,7 @@ def decode_chunk(
     c = config
     b, m = tokens.shape
     hd = c.head_dim
-    x = _embed_rows(params["embed"], tokens, c.dtype)  # [B, m, D]
+    x = _embed_rows(params["embed"], tokens, c.dtype, c.embed_scale)  # [B, m, D]
     offsets = jnp.arange(m, dtype=pos.dtype)
     posmat = pos[:, None] + offsets[None, :]  # [B, m]
     cos, sin = _rope_at(
@@ -295,7 +296,7 @@ def decode_chunk(
 
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):
-        h = _rms_norm(x, layer["attn_norm"], c.norm_eps)
+        h = _rms_norm(x, layer["attn_norm"], c.norm_eps, c.norm_offset)
         q = _mm(h, layer["wq"]).reshape(b, m, c.n_heads, hd)
         k = _mm(h, layer["wk"]).reshape(b, m, c.n_kv_heads, hd)
         v = _mm(h, layer["wv"]).reshape(b, m, c.n_kv_heads, hd)
@@ -306,9 +307,9 @@ def decode_chunk(
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, frontier, c)
         x = x + _mm(attn, layer["wo"])
-        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps), layer, c)
-    x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    return _mm(x, params["lm_head"]).astype(jnp.float32), new_cache
+        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset), layer, c)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
+    return _unembed(params, x).astype(jnp.float32), new_cache
 
 
 def _nucleus_cutoff(sorted_desc: jax.Array, top_p) -> jax.Array:

@@ -47,6 +47,21 @@ class LlamaConfig:
     # Masking-only (the KV cache is not ring-buffered), and dense-path
     # only — the flash kernel and ring attention reject it loudly.
     sliding_window: Any = None
+    # Gemma-style knobs (all default to the Llama behavior):
+    # gated-MLP activation — "silu" (Llama/Mistral) or "gelu"
+    # (Gemma's gelu_pytorch_tanh).
+    hidden_act: str = "silu"
+    # RMSNorm weight parameterization: True multiplies by (1 + w)
+    # (Gemma stores norm weights near zero), False by w.
+    norm_offset: bool = False
+    # Multiply embeddings by sqrt(d_model) after lookup (Gemma).
+    scale_embeddings: bool = False
+    # Tie the unembedding to the input embedding (logits = x @ embed.T);
+    # params carry no separate lm_head.
+    tie_embeddings: bool = False
+    # Explicit attention head dim when it differs from d_model/n_heads
+    # (Gemma-7B: 256 heads dim at d_model 3072 / 16 heads). None derives.
+    qk_head_dim: Any = None
     # n_experts > 0 swaps every MLP for a routed mixture-of-experts
     # (nos_tpu/models/moe.py) with experts sharded over the ep mesh axis.
     n_experts: int = 0
@@ -69,7 +84,21 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.qk_head_dim is not None:
+            return int(self.qk_head_dim)
         return self.d_model // self.n_heads
+
+    @property
+    def embed_scale(self):
+        """Post-lookup embedding multiplier, or None (Gemma scales by
+        sqrt(d_model) in the model's working dtype)."""
+        if not self.scale_embeddings:
+            return None
+        import numpy as _np
+
+        # bf16-rounded like the reference implementations (HF casts the
+        # scalar to the embedding dtype before multiplying).
+        return jnp.asarray(_np.sqrt(self.d_model), self.dtype)
 
 
 def tiny_config(**overrides) -> LlamaConfig:
@@ -91,7 +120,36 @@ def llama_3_8b_config() -> LlamaConfig:
     return LlamaConfig()
 
 
+def gemma_2b_config() -> LlamaConfig:
+    """Gemma-2B: same decoder skeleton, four dialect switches (gelu gated
+    MLP, (1 + w) RMSNorm, sqrt(d_model)-scaled embeddings, tied
+    unembedding) plus MQA (1 kv head) and an explicit 256 head dim."""
+    return LlamaConfig(
+        vocab_size=256000,
+        d_model=2048,
+        n_layers=18,
+        n_heads=8,
+        n_kv_heads=1,
+        d_ff=16384,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        hidden_act="gelu",
+        norm_offset=True,
+        scale_embeddings=True,
+        tie_embeddings=True,
+        qk_head_dim=256,
+    )
+
+
 # ------------------------------------------------------------------- init
+
+
+def _norm_init(c: LlamaConfig) -> jax.Array:
+    # Identity norm at init: 1 for plain weights, 0 under the (1 + w)
+    # offset parameterization.
+    return jnp.zeros((c.d_model,), c.dtype) if c.norm_offset else jnp.ones(
+        (c.d_model,), c.dtype
+    )
 
 
 def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
@@ -105,19 +163,22 @@ def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
 
     params: Params = {
         "embed": dense(next(keys), (c.vocab_size, c.d_model), c.d_model),
-        "final_norm": jnp.ones((c.d_model,), c.dtype),
-        "lm_head": dense(next(keys), (c.d_model, c.vocab_size), c.d_model),
+        "final_norm": _norm_init(c),
         "layers": [],
     }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (c.d_model, c.vocab_size), c.d_model)
+    else:
+        next(keys)  # keep downstream layer key streams stable
     hd = c.head_dim
     for _ in range(c.n_layers):
         layer = {
-            "attn_norm": jnp.ones((c.d_model,), c.dtype),
+            "attn_norm": _norm_init(c),
             "wq": dense(next(keys), (c.d_model, c.n_heads * hd), c.d_model),
             "wk": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
             "wv": dense(next(keys), (c.d_model, c.n_kv_heads * hd), c.d_model),
             "wo": dense(next(keys), (c.n_heads * hd, c.d_model), c.n_heads * hd),
-            "mlp_norm": jnp.ones((c.d_model,), c.dtype),
+            "mlp_norm": _norm_init(c),
         }
         if c.n_experts > 0:
             from nos_tpu.models.moe import init_moe_params
@@ -149,18 +210,51 @@ def _mm(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
-def _embed_rows(embed, tokens: jax.Array, dtype) -> jax.Array:
+def _embed_rows(embed, tokens: jax.Array, dtype, scale=None) -> jax.Array:
     from nos_tpu.models.quantize import QuantizedEmbedding
 
     if isinstance(embed, QuantizedEmbedding):
-        return embed.lookup(tokens, dtype)
-    return embed[tokens]
+        rows = embed.lookup(tokens, dtype)
+    else:
+        rows = embed[tokens]
+    if scale is not None:
+        rows = rows * scale
+    return rows
 
 
-def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def _rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: bool = False
+) -> jax.Array:
     x32 = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    if offset:
+        # Gemma's (1 + w) parameterization: weights sit near 0, so the
+        # add must happen in float32 (HF computes `* (1 + w.float())`
+        # before the downcast — doing it in bf16 would quantize away
+        # small weights in steps of ~2^-7 around 1.0).
+        return ((x32 * rms) * (weight.astype(jnp.float32) + 1.0)).astype(x.dtype)
     return (x32 * rms).astype(x.dtype) * weight
+
+
+def _unembed_weight(params: Params):
+    """The [d_model, vocab] unembedding operand for _mm; tied models reuse
+    the embedding. A quantized tied embedding transposes into the exact
+    QuantizedLinear layout — per-vocab-row scales become per-output-column
+    scales — so int8 logits never materialize a dequantized table."""
+    if "lm_head" in params:
+        return params["lm_head"]
+    embed = params["embed"]
+    from nos_tpu.models.quantize import QuantizedEmbedding, QuantizedLinear
+
+    if isinstance(embed, QuantizedEmbedding):
+        return QuantizedLinear(q=embed.q.T, scale=embed.scale)
+    return embed.T
+
+
+def _unembed(params: Params, x: jax.Array) -> jax.Array:
+    """Final projection to vocab logits; tied models reuse the embedding
+    matrix (no lm_head in params)."""
+    return _mm(x, _unembed_weight(params))
 
 
 def _llama3_scaled_freqs(freqs: jax.Array, scaling) -> jax.Array:
@@ -280,8 +374,12 @@ def _attention(
     return _mm(out, layer["wo"])
 
 
-def _mlp(x: jax.Array, layer: Params) -> jax.Array:
-    return _mm(jax.nn.silu(_mm(x, layer["w_gate"])) * _mm(x, layer["w_up"]), layer["w_down"])
+_ACTS = {"silu": jax.nn.silu, "gelu": lambda x: jax.nn.gelu(x, approximate=True)}
+
+
+def _mlp(x: jax.Array, layer: Params, act: str = "silu") -> jax.Array:
+    gate = _ACTS[act](_mm(x, layer["w_gate"]))
+    return _mm(gate * _mm(x, layer["w_up"]), layer["w_down"])
 
 
 def llama_forward(
@@ -299,14 +397,15 @@ def llama_forward(
     additionally returns the summed MoE load-balancing loss (0 for dense).
     """
     c = config
-    x = _embed_rows(params["embed"], tokens, c.dtype)
+    x = _embed_rows(params["embed"], tokens, c.dtype, c.embed_scale)
     # Position tables depend only on (seq_len, head_dim): one per forward.
     cos, sin = _rope(tokens.shape[1], c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     def block(x, layer):
         x = x + _attention(
-            _rms_norm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin, mesh
+            _rms_norm(x, layer["attn_norm"], c.norm_eps, c.norm_offset),
+            layer, c, cos, sin, mesh,
         )
-        h = _rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        h = _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset)
         if "moe" in layer:
             from nos_tpu.models.moe import moe_mlp
 
@@ -318,7 +417,7 @@ def llama_forward(
                 delta = moe_mlp(layer["moe"], h, c.moe_config(), mesh)
                 aux = jnp.zeros((), jnp.float32)
         else:
-            delta = _mlp(h, layer)
+            delta = _mlp(h, layer, c.hidden_act)
             aux = jnp.zeros((), jnp.float32)
         return x + delta, aux
 
@@ -330,8 +429,8 @@ def llama_forward(
     for layer in params["layers"]:
         x, aux = block(x, layer)
         aux_total = aux_total + aux
-    x = _rms_norm(x, params["final_norm"], c.norm_eps)
-    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
+    logits = _unembed(params, x).astype(jnp.float32)
     if with_aux:
         return logits, aux_total
     return logits

@@ -132,9 +132,10 @@ def quantize_params(params: Params) -> Params:
     out: Params = {
         "embed": quantize_embedding(params["embed"]),
         "final_norm": params["final_norm"],
-        "lm_head": quantize_linear(params["lm_head"]),
         "layers": [],
     }
+    if "lm_head" in params:  # absent for tied-unembedding models
+        out["lm_head"] = quantize_linear(params["lm_head"])
     for layer in params["layers"]:
         q_layer: Params = {}
         for key, value in layer.items():

@@ -31,6 +31,7 @@ from nos_tpu.models.llama import (
     _mlp,
     _mm,
     _rms_norm,
+    _unembed_weight,
     _rope,
 )
 
@@ -72,13 +73,16 @@ def _block(carry_x, layer: Params, config: LlamaConfig, cos, sin):
     threaded through the pipeline — add it as a separate regularizer if
     routing collapse matters for your run)."""
     x = carry_x
-    x = x + _attention(_rms_norm(x, layer["attn_norm"], config.norm_eps), layer, config, cos, sin)
-    h = _rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    x = x + _attention(
+        _rms_norm(x, layer["attn_norm"], config.norm_eps, config.norm_offset),
+        layer, config, cos, sin,
+    )
+    h = _rms_norm(x, layer["mlp_norm"], config.norm_eps, config.norm_offset)
     if "moe" in layer:
         from nos_tpu.models.moe import moe_mlp
 
         return x + moe_mlp(layer["moe"], h, config.moe_config(), None)
-    return x + _mlp(h, layer)
+    return x + _mlp(h, layer, config.hidden_act)
 
 
 def _stage_apply(local_layers: Params, x, config: LlamaConfig, cos, sin):
@@ -160,7 +164,7 @@ def _prepare_pipeline_inputs(params: Params, tokens: jax.Array, config: LlamaCon
     if b % m:
         raise ValueError(f"batch {b} does not divide {m} microbatches")
 
-    x = _embed_rows(params["embed"], tokens, c.dtype)
+    x = _embed_rows(params["embed"], tokens, c.dtype, c.embed_scale)
     cos, sin = _rope(s_len, c.head_dim, c.rope_theta, c.dtype, c.rope_scaling)
     x_mb = x.reshape(m, b // m, s_len, c.d_model)
 
@@ -196,8 +200,8 @@ def pipeline_llama_forward(
     )(params["layers"], x_mb)
 
     y = y_mb.reshape(b, s_len, c.d_model)
-    y = _rms_norm(y, params["final_norm"], c.norm_eps)
-    return _mm(y, params["lm_head"]).astype(jnp.float32)
+    y = _rms_norm(y, params["final_norm"], c.norm_eps, c.norm_offset)
+    return _mm(y, _unembed_weight(params)).astype(jnp.float32)
 
 
 def pipeline_llama_loss(
@@ -229,7 +233,7 @@ def pipeline_llama_loss(
         stage_idx = jax.lax.axis_index("pp")
         ys = _pipeline_schedule(layers, xm, c, cos, sin, n_stages=n_stages)
         y = ys.reshape(-1, s_len, c.d_model)  # microbatch order == batch order
-        h = _rms_norm(y, final_norm, c.norm_eps)
+        h = _rms_norm(y, final_norm, c.norm_eps, c.norm_offset)
         logits = _mm(h, lm_head).astype(jnp.float32)
         local_loss = next_token_nll(logits, tm.reshape(-1, s_len))
         # Only the last stage computed real activations: one scalar hop.
@@ -246,4 +250,4 @@ def pipeline_llama_loss(
         in_specs=(layer_specs, P(), P(), data_spec, data_spec),
         out_specs=P(),
         check_vma=False,
-    )(params["layers"], params["final_norm"], params["lm_head"], x_mb, toks_mb)
+    )(params["layers"], params["final_norm"], _unembed_weight(params), x_mb, toks_mb)

@@ -48,12 +48,14 @@ def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
         layer["w_gate"] = _ns(mesh, "dp", "tp")
         layer["w_up"] = _ns(mesh, "dp", "tp")
         layer["w_down"] = _ns(mesh, "tp", "dp")
-    return {
+    tree = {
         "embed": _ns(mesh, "tp", "dp"),
         "final_norm": _ns(mesh),
-        "lm_head": _ns(mesh, "dp", "tp"),
         "layers": [dict(layer) for _ in range(config.n_layers)],
     }
+    if not config.tie_embeddings:
+        tree["lm_head"] = _ns(mesh, "dp", "tp")
+    return tree
 
 
 def llama_quantized_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
@@ -98,12 +100,14 @@ def llama_quantized_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
         layer["w_gate"] = lin("dp", "tp")
         layer["w_up"] = lin("dp", "tp")
         layer["w_down"] = lin("tp", "dp")
-    return {
+    tree = {
         "embed": QuantizedEmbedding(q=_ns(mesh, "tp", "dp"), scale=_ns(mesh, "tp")),
         "final_norm": _ns(mesh),
-        "lm_head": lin("dp", "tp"),
         "layers": [dict(layer) for _ in range(config.n_layers)],
     }
+    if not config.tie_embeddings:
+        tree["lm_head"] = lin("dp", "tp")
+    return tree
 
 
 def llama_data_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,186 @@
+"""Gemma family: the same decoder skeleton as Llama with four dialect
+switches — gelu gated MLP, (1 + w) RMSNorm, sqrt(d_model)-scaled
+embeddings, tied unembedding — plus MQA and an explicit head dim.
+A randomly initialized tiny transformers Gemma is the parity oracle
+(same strategy as tests/models/test_convert.py for Llama; reference has
+no model stack, SURVEY.md §5)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from nos_tpu.models.convert import config_from_hf, params_from_hf_state_dict
+from nos_tpu.models.generate import generate
+from nos_tpu.models.llama import (
+    gemma_2b_config,
+    init_llama_params,
+    llama_forward,
+    llama_loss,
+    tiny_config,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_gemma():
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(0)
+    config = GemmaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=1,     # Gemma-2B-style MQA
+        head_dim=32,               # != hidden/heads (=16): explicit dim
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        attention_dropout=0.0,
+        hidden_act="gelu_pytorch_tanh",
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    model = GemmaForCausalLM(config)
+    model.eval()
+    return model
+
+
+def gemma_tiny_config(**overrides):
+    """Gemma dialect on test-sized dims."""
+    defaults = dict(
+        hidden_act="gelu",
+        norm_offset=True,
+        scale_embeddings=True,
+        tie_embeddings=True,
+    )
+    defaults.update(overrides)
+    return tiny_config(**defaults)
+
+
+class TestGemmaParity:
+    def test_config_mapping(self, hf_gemma):
+        config = config_from_hf(hf_gemma.config, jnp.float32)
+        assert config.hidden_act == "gelu"
+        assert config.norm_offset and config.scale_embeddings
+        assert config.tie_embeddings
+        assert config.head_dim == 32 and config.n_kv_heads == 1
+
+    def test_logits_match_torch(self, hf_gemma):
+        config = config_from_hf(hf_gemma.config, jnp.float32)
+        params = params_from_hf_state_dict(hf_gemma.state_dict(), config)
+        assert "lm_head" not in params  # tied: no separate matrix
+        tokens_np = np.array([[1, 5, 9, 42, 17, 99, 3, 64]], dtype=np.int64)
+        with torch.no_grad():
+            want = hf_gemma(torch.from_numpy(tokens_np)).logits.numpy()
+        got = np.asarray(llama_forward(params, jnp.asarray(tokens_np), config))
+        np.testing.assert_allclose(got, want, atol=3e-4)
+
+    def test_greedy_generation_matches_torch(self, hf_gemma):
+        config = config_from_hf(hf_gemma.config, jnp.float32)
+        params = params_from_hf_state_dict(hf_gemma.state_dict(), config)
+        prompt_np = np.array([[2, 11, 23, 5]], dtype=np.int64)
+        with torch.no_grad():
+            want = hf_gemma.generate(
+                torch.from_numpy(prompt_np),
+                max_new_tokens=6,
+                do_sample=False,
+                num_beams=1,
+            ).numpy()[:, prompt_np.shape[1]:]
+        got = np.asarray(
+            generate(params, jnp.asarray(prompt_np), config, max_new_tokens=6)
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+class TestGemmaDialect:
+    def test_flagship_config_shapes(self):
+        # 2B init is too big for a unit test; config invariants only.
+        config = gemma_2b_config()
+        assert config.head_dim == 256
+        assert config.n_kv_heads == 1
+        assert config.tie_embeddings and config.scale_embeddings
+        assert config.norm_offset and config.hidden_act == "gelu"
+
+    def test_tied_params_have_no_lm_head(self):
+        config = gemma_tiny_config()
+        params = init_llama_params(jax.random.key(0), config)
+        assert "lm_head" not in params
+        logits = llama_forward(params, jnp.zeros((2, 8), jnp.int32), config)
+        assert logits.shape == (2, 8, config.vocab_size)
+
+    def test_trains_end_to_end(self):
+        config = gemma_tiny_config(dtype=jnp.float32)
+        params = init_llama_params(jax.random.key(0), config)
+        tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, config.vocab_size)
+        loss, grads = jax.jit(
+            jax.value_and_grad(lambda p: llama_loss(p, tokens, config))
+        )(params)
+        assert jnp.isfinite(loss)
+        # tied: embedding grads accumulate both embed and unembed terms
+        assert float(jnp.abs(grads["embed"]).max()) > 0
+
+    def test_kv_generation_matches_forward_argmax(self):
+        config = gemma_tiny_config(dtype=jnp.float32)
+        params = init_llama_params(jax.random.key(0), config)
+        prompt = jnp.asarray([[3, 7, 11, 2]], jnp.int32)
+        out = generate(params, prompt, config, max_new_tokens=4)
+        # oracle: recompute each step with the cache-free forward
+        seq = prompt
+        for _ in range(4):
+            logits = llama_forward(params, seq, config)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 4:]))
+
+    def test_quantized_tied_serving(self):
+        from nos_tpu.models.quantize import quantize_params, weight_bytes
+
+        config = gemma_tiny_config(dtype=jnp.float32)
+        params = init_llama_params(jax.random.key(0), config)
+        qparams = quantize_params(params)
+        assert "lm_head" not in qparams
+        assert weight_bytes(qparams) < weight_bytes(params)
+        prompt = jnp.asarray([[3, 7, 11, 2]], jnp.int32)
+        out = generate(params, prompt, config, max_new_tokens=4)
+        qout = generate(qparams, prompt, config, max_new_tokens=4)
+        assert np.asarray(out).shape == np.asarray(qout).shape
+
+    def test_quantized_tied_pipeline_forward(self):
+        """Regression (review): tied + quantized params through the
+        pipeline path must not crash on the transposed unembedding."""
+        from nos_tpu.models.quantize import quantize_params
+        from nos_tpu.parallel.mesh import mesh_from_devices
+        from nos_tpu.parallel.pipeline import (
+            pipeline_llama_forward,
+            stack_layer_params,
+        )
+
+        config = gemma_tiny_config(dtype=jnp.float32, n_layers=2)
+        params = init_llama_params(jax.random.key(0), config)
+        qparams = quantize_params(params)
+        mesh = mesh_from_devices((2,), ("pp",), jax.devices()[:2])
+        stacked = dict(qparams)
+        stacked["layers"] = stack_layer_params(params)["layers"]  # bf16 layers
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        # full tree quantized layers don't stack (pytree leaves differ);
+        # exercise the unembed path with the plain stacked tree + tied
+        # quantized embed/unembed.
+        stacked["embed"] = qparams["embed"]
+        logits = pipeline_llama_forward(stacked, tokens, config, mesh)
+        assert logits.shape == (2, 8, config.vocab_size)
+
+    def test_gemma_bf16_norm_offset_not_quantized_away(self):
+        """Regression (review): (1 + w) must be applied in f32 — in bf16 a
+        0.01 norm weight would round into ~0.0078 steps around 1.0."""
+        from nos_tpu.models.llama import _rms_norm
+
+        x = jnp.full((1, 4, 64), 3.0, jnp.bfloat16)
+        w_small = jnp.full((64,), 0.01, jnp.bfloat16)
+        with_offset = _rms_norm(x, w_small, 1e-6, offset=True)
+        plain = _rms_norm(x, jnp.zeros((64,), jnp.bfloat16), 1e-6, offset=True)
+        # the 1% weight must actually move the output
+        assert float(jnp.abs(
+            with_offset.astype(jnp.float32) - plain.astype(jnp.float32)
+        ).max()) > 0
