@@ -1,0 +1,173 @@
+"""Chaos: repeated whole-control-plane crashes under live load.
+
+Goes beyond the reference (SURVEY §5: no fault-injection harness there).
+The invariants a dynamic-partitioning control plane must keep through
+arbitrary crash/restart points:
+
+1. **No double-booking** — at every moment, the chips of RUNNING pods on
+   a node fit its boards (checked via the sim kubelet's OutOfTpu
+   admission: a violation turns a pod FAILED, and we assert none are).
+2. **Convergence** — once crashes stop, every surviving pending pod is
+   eventually served (the level-triggered reconcile pattern rebuilds all
+   in-memory state from the store + tpuctl disk).
+3. **Monotone progress** — pods that were RUNNING before a crash are
+   still booked after restart (no orphaned silicon).
+"""
+import random
+import time
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import build_pod, build_tpu_node
+
+FAST = dict(
+    partitioner_config=GpuPartitionerConfig(
+        batch_window_timeout_seconds=0.25, batch_window_idle_seconds=0.05
+    ),
+    scheduler_config=SchedulerConfig(retry_seconds=0.1),
+)
+AGENT = TpuAgentConfig(report_config_interval_seconds=0.1)
+
+
+def wait_for(predicate, timeout=25.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def boot(store, tmp_path, n_nodes=2):
+    cluster = build_cluster(
+        store=store, device_backend="tpuctl", tpuctl_dir=str(tmp_path), **FAST
+    )
+    for i in range(n_nodes):
+        name = f"tpu-{i}"
+        if store.try_get("Node", name) is None:
+            cluster.add_tpu_node(build_tpu_node(name=name), agent_config=AGENT)
+        else:  # restart over a surviving store: node objects persist
+            cluster.start_agent(name, agent_config=AGENT)
+    cluster.start()
+    return cluster
+
+
+class TestChaos:
+    def test_survives_repeated_crashes_under_load(self, tmp_path):
+        rng = random.Random(7)
+        store = KubeStore()
+        cluster = boot(store, tmp_path)
+        submitted = 0
+
+        def submit_wave(n):
+            nonlocal submitted
+            for _ in range(n):
+                submitted += 1
+                store.create(
+                    build_pod(
+                        f"job-{submitted}",
+                        {constants.RESOURCE_TPU: rng.choice([1, 2, 4, 8])},
+                        ns="ml",
+                    )
+                )
+
+        def pods():
+            return store.list("Pod", namespace="ml")
+
+        def finish_some():
+            # complete a random subset of running pods (frees slices so
+            # post-crash planners must re-carve)
+            for pod in pods():
+                if pod.status.phase == PodPhase.RUNNING and rng.random() < 0.5:
+                    def fin(p):
+                        p.status.phase = PodPhase.SUCCEEDED
+
+                    store.patch_merge("Pod", pod.metadata.name, "ml", fin)
+
+        try:
+            # Three crash cycles, each at a different point in the flow:
+            # mid-fill, right after a wave lands, and mid-drain.
+            for cycle in range(3):
+                submit_wave(4)
+                # let some (maybe all, maybe none) of the wave schedule
+                time.sleep(rng.uniform(0.1, 1.0))
+                cluster.stop()  # CRASH: memory dies, store+disk survive
+
+                # Bookings at the moment of death; the restarted suite
+                # must preserve every one of them (invariant 3).
+                down_bookings = {
+                    p.metadata.name: p.spec.node_name
+                    for p in pods()
+                    if p.status.phase == PodPhase.RUNNING and p.spec.node_name
+                }
+                cluster = boot(store, tmp_path)
+                time.sleep(0.5)  # give the reborn suite room to misbehave
+                for name, node_name in down_bookings.items():
+                    pod = store.get("Pod", name, "ml")
+                    assert pod.status.phase == PodPhase.RUNNING, (cycle, name)
+                    assert pod.spec.node_name == node_name, (cycle, name)
+                if cycle == 1:
+                    finish_some()
+
+            # Chaos over: demand exceeds the 16 chips, so convergence
+            # means the queue DRAINS — finishing the running generation
+            # must let the next pending pods bind, every round, until
+            # nothing pends (a stalled round = lost capacity somewhere).
+            def pending():
+                return [p for p in pods() if p.status.phase == PodPhase.PENDING]
+
+            rounds = 0
+            while pending():
+                rounds += 1
+                assert rounds <= 20, [
+                    (p.metadata.name, p.status.phase) for p in pending()
+                ]
+                before = len(pending())
+                for pod in pods():
+                    if pod.status.phase == PodPhase.RUNNING:
+                        def fin(p):
+                            p.status.phase = PodPhase.SUCCEEDED
+
+                        store.patch_merge("Pod", pod.metadata.name, "ml", fin)
+                assert wait_for(
+                    lambda: len(pending()) < before or not pending(), timeout=20.0
+                ), [(p.metadata.name, p.status.phase) for p in pending()]
+            # Invariant 1: the kubelet's double-booking guard never fired.
+            assert not any(p.status.phase == PodPhase.FAILED for p in pods())
+            assert getattr(cluster.kubelet, "admission_rejects", 0) == 0
+            # Invariant 3: every running pod kept its node through crashes.
+            for pod in pods():
+                if pod.status.phase == PodPhase.RUNNING:
+                    assert pod.spec.node_name, pod.metadata.name
+        finally:
+            cluster.stop()
+
+    def test_rapid_restart_storm_keeps_capacity_accounting(self, tmp_path):
+        """Five boot/kill cycles with zero dwell: restart storms must not
+        leak slice bookings on disk (each boot rebuilds from tpuctl state
+        and must come to the same answer)."""
+        store = KubeStore()
+        cluster = boot(store, tmp_path, n_nodes=1)
+        store.create(build_pod("steady", {constants.RESOURCE_TPU: 4}, ns="ml"))
+        assert wait_for(
+            lambda: store.get("Pod", "steady", "ml").status.phase
+            == PodPhase.RUNNING
+        )
+        try:
+            for _ in range(5):
+                cluster.stop()
+                cluster = boot(store, tmp_path, n_nodes=1)
+            # the steady pod stays booked, and the other half of the board
+            # is still usable (no leaked bookings after 5 restarts)
+            assert store.get("Pod", "steady", "ml").status.phase == PodPhase.RUNNING
+            store.create(build_pod("late", {constants.RESOURCE_TPU: 4}, ns="ml"))
+            assert wait_for(
+                lambda: store.get("Pod", "late", "ml").status.phase
+                == PodPhase.RUNNING
+            ), store.get("Pod", "late", "ml").status
+        finally:
+            cluster.stop()
