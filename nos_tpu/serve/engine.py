@@ -93,6 +93,7 @@ class Engine:
         ticks_per_sync: int = 8,
         prefill_chunk: int = 256,
         seed: int = 0,
+        prefix_cache_entries: int = 0,
     ) -> None:
         self.params = params
         self.config = config
@@ -103,6 +104,16 @@ class Engine:
         # decode_chunk pieces (O(chunk x T) peak attention memory instead
         # of the one-shot prefill's O(bucket^2)).
         self.prefill_chunk = max(8, prefill_chunk)
+        # Prefix cache (chunked path only — its positions are
+        # physical==logical, so K/V for a shared prompt prefix is exact
+        # for every request repeating it; the padded path's left-pad
+        # breaks that alignment). LRU over completed chunk-boundary
+        # prefixes; 0 disables. Prefill is deterministic, so a hit is
+        # bitwise identical to recomputation — greedy parity holds.
+        self.prefix_cache_entries = prefix_cache_entries
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[tuple, list]" = OrderedDict()
         c = config
         self._cache = [
             {
@@ -196,6 +207,39 @@ class Engine:
             ]
 
         self._splice = jax.jit(_splice, donate_argnums=(0,))
+
+        def _prefix_restore(row_cache, entry):
+            # same rationale as _splice: donated, fused writes — eager
+            # per-layer dynamic_update_slice would copy the whole row
+            # cache through HBM 2*n_layers times per cache hit
+            return [
+                {
+                    key: jax.lax.dynamic_update_slice(
+                        layer[key], cached[key], (0, 0, 0, 0)
+                    )
+                    for key in ("k", "v")
+                }
+                for layer, cached in zip(row_cache, entry)
+            ]
+
+        self._prefix_restore = jax.jit(_prefix_restore, donate_argnums=(0,))
+
+        def _prefix_snapshot(row_cache, store_at):
+            return [
+                {
+                    key: jax.lax.dynamic_slice(
+                        layer[key],
+                        (0, 0, 0, 0),
+                        (1, store_at, *layer[key].shape[2:]),
+                    )
+                    for key in ("k", "v")
+                }
+                for layer in row_cache
+            ]
+
+        self._prefix_snapshot = jax.jit(
+            _prefix_snapshot, static_argnums=(1,)
+        )
 
     # ---------------------------------------------------------- frontend
 
@@ -307,7 +351,25 @@ class Engine:
         n = min(self.prefill_chunk, self._bucket(length))
         row_cache = init_kv_cache(c, 1, self.max_len + 1)
         logits = None
-        for start in range(0, length, n):
+        # Longest cached prefix at one of THIS request's chunk
+        # boundaries; the final piece always recomputes (its logits seed
+        # generation), so only boundaries strictly before the last piece
+        # qualify.
+        resume = 0
+        if self.prefix_cache_entries > 0:
+            boundary = ((length - 1) // n) * n
+            while boundary > 0:
+                key = tuple(prompt[:boundary])
+                entry = self._prefix_cache.get(key)
+                if entry is not None:
+                    self._prefix_cache.move_to_end(key)
+                    row_cache = self._prefix_restore(row_cache, entry)
+                    resume = boundary
+                    metrics.SERVE_PREFIX_HITS.inc()
+                    metrics.SERVE_PREFIX_TOKENS_REUSED.inc(boundary)
+                    break
+                boundary -= n
+        for start in range(resume, length, n):
             piece = prompt[start:start + n]
             real = len(piece)
             piece = piece + [0] * (n - real)
@@ -319,6 +381,16 @@ class Engine:
                 jnp.asarray([piece], jnp.int32),
                 mask,
             )
+        if self.prefix_cache_entries > 0:
+            store_at = ((length - 1) // n) * n
+            if store_at > 0:
+                key = tuple(prompt[:store_at])
+                if key not in self._prefix_cache:
+                    self._prefix_cache[key] = self._prefix_snapshot(
+                        row_cache, store_at
+                    )
+                    while len(self._prefix_cache) > self.prefix_cache_entries:
+                        self._prefix_cache.popitem(last=False)
         last_idx = (length - 1) % n
         first = int(jnp.argmax(logits[0, last_idx]))
         self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))

@@ -211,6 +211,14 @@ SERVE_SLOT_TICKS_ACTIVE = REGISTRY.counter(
     "Per-slot ticks spent on live requests (active / (ticks*slots) = "
     "batch occupancy)",
 )
+SERVE_PREFIX_HITS = REGISTRY.counter(
+    "nos_tpu_serve_prefix_cache_hits_total",
+    "Chunked admissions that reused a cached prompt-prefix K/V",
+)
+SERVE_PREFIX_TOKENS_REUSED = REGISTRY.counter(
+    "nos_tpu_serve_prefix_tokens_reused_total",
+    "Prompt tokens whose prefill was skipped via the prefix cache",
+)
 SERVE_QUEUE_DEPTH = REGISTRY.gauge(
     "nos_tpu_serve_queue_depth", "Requests waiting for a free slot"
 )

@@ -224,3 +224,57 @@ class TestEngineMetrics:
         tick_delta = metrics.SERVE_TICKS.value - tick0
         active_delta = metrics.SERVE_SLOT_TICKS_ACTIVE.value - active0
         assert 0 < active_delta <= tick_delta * 2
+
+
+class TestPrefixCache:
+    """Prefix caching (chunked path): shared prompt prefixes skip their
+    prefill, bitwise-identically — greedy outputs must not change."""
+
+    def test_shared_prefix_matches_solo_and_counts_hits(self, setup):
+        from nos_tpu.util import metrics
+
+        config, params = setup
+        hits0 = metrics.SERVE_PREFIX_HITS.value
+        reused0 = metrics.SERVE_PREFIX_TOKENS_REUSED.value
+        eng = Engine(params, config, max_slots=2, max_len=128,
+                     prefill_chunk=16, prefix_cache_entries=4)
+        system = rand_prompt(jax.random.key(70), 40, config.vocab_size)
+        prompts = [system + rand_prompt(jax.random.key(71 + i), 5,
+                                        config.vocab_size) for i in range(3)]
+        ids = [eng.submit(GenRequest(prompt=p, max_new_tokens=4))
+               for p in prompts]
+        results = eng.run()
+        for rid, p in zip(ids, prompts):
+            assert results[rid] == solo(params, config, p, 4), f"request {rid}"
+        # prompts share the first 2 chunk boundaries (40 tokens -> 32
+        # aligned); later admissions must have hit
+        assert metrics.SERVE_PREFIX_HITS.value - hits0 >= 2
+        assert metrics.SERVE_PREFIX_TOKENS_REUSED.value - reused0 >= 2 * 32
+
+    def test_padded_path_unaffected(self, setup):
+        config, params = setup
+        eng = Engine(params, config, max_slots=2, max_len=64,
+                     prefix_cache_entries=4)
+        p = rand_prompt(jax.random.key(80), 6, config.vocab_size)
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=3))
+        assert eng.run()[rid] == solo(params, config, p, 3)
+        assert not eng._prefix_cache  # short prompts take the padded path
+
+    def test_lru_eviction_bounds_entries(self, setup):
+        config, params = setup
+        eng = Engine(params, config, max_slots=1, max_len=128,
+                     prefill_chunk=16, prefix_cache_entries=2)
+        for i in range(4):  # 4 distinct long prompts -> 4 insertions
+            p = rand_prompt(jax.random.key(90 + i), 40, config.vocab_size)
+            eng.submit(GenRequest(prompt=p, max_new_tokens=2))
+            eng.run()
+        assert len(eng._prefix_cache) <= 2
+
+    def test_disabled_by_default(self, setup):
+        config, params = setup
+        eng = Engine(params, config, max_slots=1, max_len=128,
+                     prefill_chunk=16)
+        p = rand_prompt(jax.random.key(95), 40, config.vocab_size)
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=3))
+        assert eng.run()[rid] == solo(params, config, p, 3)
+        assert not eng._prefix_cache
