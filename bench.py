@@ -323,6 +323,41 @@ def run_tpu_child() -> None:
                 result["attn_flash_best_blocks"] = f"{best[0][0]}x{best[0][1]}"
                 result["attn_flash_best_ms"] = round(best[1], 2)
                 result["attn_flash_vs_dense"] = round(d_ms / best[1], 3)
+
+            # backward too: training runs the custom_vjp, whose cost can
+            # differ wildly from the forward (dq + dk/dv are two more
+            # kernel passes). Same scalarization for both sides.
+            def grad_time(attn_fn):
+                f = jax.jit(
+                    jax.grad(
+                        lambda q, k, v: jnp.sum(
+                            attn_fn(q, k, v).astype(jnp.float32)
+                        ),
+                        argnums=(0, 1, 2),
+                    )
+                )
+                out = f(kq, kk, kv)
+                jax.block_until_ready(out)
+                start = time.monotonic()
+                for _ in range(10):
+                    out = f(kq, kk, kv)
+                jax.block_until_ready(out)
+                return (time.monotonic() - start) / 10 * 1000
+
+            try:
+                bwd_d = grad_time(dense_ref)
+                result["attn_dense_bwd_ms"] = round(bwd_d, 2)
+                if best is not None:
+                    bq, bk = best[0]
+                    bwd_f = grad_time(
+                        lambda q, k, v: flash_attention(q, k, v, blk_q=bq, blk_k=bk)
+                    )
+                    result["attn_flash_bwd_ms"] = round(bwd_f, 2)
+                    result["attn_flash_bwd_vs_dense"] = round(bwd_d / bwd_f, 3)
+                    log(f"[tpu-child] attn bwd: dense {bwd_d:.2f} ms, "
+                        f"flash {bwd_f:.2f} ms ({bwd_d / bwd_f:.2f}x)")
+            except Exception as e:
+                log(f"[tpu-child] attn bwd failed: {type(e).__name__}: {str(e)[:120]}")
             del kq, kk, kv
             snapshot()
         except Exception as e:
