@@ -12,7 +12,15 @@ Run:  python examples/finetune_lora.py  [--real-weights /path/to/hf]
 """
 import argparse
 
+import os
+
+# Platform decided BEFORE anything touches the default backend (an
+# ambient TPU plugin would otherwise win — and hang if unreachable).
+_PLATFORM = os.environ.get("NOS_EXAMPLE_PLATFORM", "cpu")
+
 import jax
+
+jax.config.update("jax_platforms", _PLATFORM)
 import jax.numpy as jnp
 
 from nos_tpu.models.generate import generate

@@ -3,20 +3,29 @@
 Demonstrates the pieces working together on whatever backend is present
 (real TPU chip, or the virtual CPU mesh for a dry run):
 
-  1. int8 weight-only quantization (halved HBM, ~1.7x decode on v5e),
+  1. int8 weight-only quantization (halved HBM; decode is
+     weight-bandwidth-bound, so bytes read through to tokens/s),
   2. tensor-parallel sharding of the quantized weights over a mesh,
   3. the continuous-batching Engine multiplexing mixed-length requests,
   4. one-off sampled generation with top-k / nucleus filtering.
 
 Run:  python examples/serve_llama.py  [--real-weights /path/to/hf]
+(NOS_EXAMPLE_PLATFORM=tpu for real chips; default is the CPU backend.)
 With --real-weights, loads a HuggingFace Llama checkpoint via
 nos_tpu.models.convert; otherwise serves a randomly initialized tiny
 model (the mechanics, not the prose, are the demo).
 """
 import argparse
+import os
 import time
 
+# Platform decided BEFORE anything touches the default backend (an
+# ambient TPU plugin would otherwise win — and hang if unreachable).
+_PLATFORM = os.environ.get("NOS_EXAMPLE_PLATFORM", "cpu")
+
 import jax
+
+jax.config.update("jax_platforms", _PLATFORM)
 import jax.numpy as jnp
 
 from nos_tpu.models.generate import generate
