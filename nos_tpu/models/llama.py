@@ -47,6 +47,12 @@ class LlamaConfig:
     # Masking-only (the KV cache is not ring-buffered), and dense-path
     # only — the flash kernel and ring attention reject it loudly.
     sliding_window: Any = None
+    # Sequence-parallel strategy when the mesh has an sp axis: "ring"
+    # (K/V rotation via ppermute, O(S/n) resident sequence) or "ulysses"
+    # (two all_to_alls scatter heads / gather sequence — needs head
+    # counts divisible by the sp degree; see parallel/ulysses.py for the
+    # memory/comm trade).
+    sp_strategy: str = "ring"
     # Gemma-style knobs (all default to the Llama behavior):
     # gated-MLP activation — "silu" (Llama/Mistral) or "gelu"
     # (Gemma's gelu_pytorch_tanh).
@@ -310,6 +316,28 @@ def _window_causal_mask(s: int, sliding_window) -> jax.Array:
     return causal
 
 
+def gqa_dense_attention(q, k, v, mask=None) -> jax.Array:
+    """Grouped-query dense attention, q [B,S,Hq,hd], k/v [B,S,Hkv,hd] ->
+    [B,S,Hq,hd]. ``mask`` is a [Sq,Skv] bool (True = attend); None = full.
+    The ONE copy of the GQA einsum pattern — the dense model branch and
+    the Ulysses SP path both call it, so masking/scaling fixes land once.
+    """
+    b, s, hq, hd = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, s, hkv, group, hd)
+    scores = jnp.einsum(
+        "bsKgh,btKh->bKgst", qg, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)
+    if mask is not None:
+        # -1e30, not -inf: a fully masked row (never happens causally, but
+        # callers may pass stricter masks) must soft-max to garbage-but-
+        # finite instead of NaN.
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, hq, hd)
+
+
 def _attention(
     x: jax.Array,
     layer: Params,
@@ -336,12 +364,29 @@ def _attention(
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         if c.sliding_window is not None:
             raise ValueError(
-                "sliding_window is not implemented for ring attention"
+                "sliding_window is not implemented for sequence-parallel "
+                "attention (ring or ulysses)"
             )
-        # Sequence-parallel path: exact blockwise attention with K/V blocks
-        # rotating over the sp ring (nos_tpu/parallel/ring_attention.py).
-        # attention="flash" runs the Pallas kernel per ring block with the
-        # hand-written ring backward; "dense" keeps the portable jnp ring.
+        if c.sp_strategy not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sp_strategy {c.sp_strategy!r}; expected 'ring' "
+                "or 'ulysses'"
+            )
+        # Sequence-parallel path, strategy per config.sp_strategy:
+        # "ring" — exact blockwise attention with K/V blocks rotating
+        # over the sp ring (nos_tpu/parallel/ring_attention.py);
+        # attention="flash" runs the Pallas kernel per ring block with
+        # the hand-written ring backward, "dense" the portable jnp ring.
+        # "ulysses" — all_to_all head-scatter/sequence-gather
+        # (nos_tpu/parallel/ulysses.py), full-sequence attention per head
+        # group (kernel or dense per config.attention).
+        if c.sp_strategy == "ulysses":
+            from nos_tpu.parallel.ulysses import ulysses_attention
+
+            return _mm(
+                ulysses_attention(q, k, v, mesh, causal=True, attention=c.attention),
+                layer["wo"],
+            )
         from nos_tpu.parallel.ring_attention import (
             ring_attention,
             ring_flash_attention,
@@ -361,17 +406,8 @@ def _attention(
         )
         return _mm(out.reshape(b, s, c.n_heads * hd), layer["wo"])
 
-    # GQA: expand kv heads to query heads by grouping queries.
-    group = c.n_heads // c.n_kv_heads
-    q = q.reshape(b, s, c.n_kv_heads, group, hd)
-    scores = jnp.einsum(
-        "bsKgh,btKh->bKgst", q, k, preferred_element_type=jnp.float32
-    ) / math.sqrt(hd)
-    causal = _window_causal_mask(s, c.sliding_window)
-    scores = jnp.where(causal[None, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bKgst,btKh->bsKgh", probs, v).reshape(b, s, c.n_heads * hd)
-    return _mm(out, layer["wo"])
+    out = gqa_dense_attention(q, k, v, _window_causal_mask(s, c.sliding_window))
+    return _mm(out.reshape(b, s, c.n_heads * hd), layer["wo"])
 
 
 _ACTS = {"silu": jax.nn.silu, "gelu": lambda x: jax.nn.gelu(x, approximate=True)}

@@ -1,0 +1,120 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second of the two classic long-context strategies (the first,
+K/V-rotation ring attention, lives in nos_tpu/parallel/ring_attention.py;
+the reference has no model stack — SURVEY.md §5 maps its scale axis to
+slice topology, and this is the workload-side counterpart).
+
+Where the ring keeps queries resident and rotates K/V blocks in n-1
+neighbor hops (`ppermute` riding contiguous ICI), Ulysses trades TWO
+`all_to_all` collectives for zero rotation: scatter the head axis across
+the ``sp`` devices while gathering the full sequence, run ordinary
+causal attention per head group on the whole sequence, then invert the
+exchange. Comm volume is O(S·H·hd/n) per device either way, but Ulysses
+does it in 2 balanced collectives instead of n-1 dependent steps — the
+better fit when n is large relative to the per-hop latency, or when the
+single-chip flash kernel on a full sequence beats n accumulator merges.
+The trade: each device must hold the FULL sequence for H/n heads, so
+activation memory is O(S·H·hd/n) vs the ring's O(S/n·H·hd) — Ulysses
+scales context by shrinking heads-per-device, the ring by shrinking
+resident sequence.
+
+Exact (no approximation): both paths produce dense-attention results to
+float tolerance, pinned by tests against the same oracle as the ring.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nos_tpu.parallel.ring_attention import _ring_shard_map
+
+
+def _dense_causal(q, k, v, causal):
+    """Grouped-query attention on a full local sequence — delegates to
+    the model stack's single GQA einsum (llama.gqa_dense_attention), so
+    masking/scaling fixes land once."""
+    from nos_tpu.models.llama import gqa_dense_attention
+
+    s = q.shape[1]
+    mask = None
+    if causal:
+        pos = jnp.arange(s)
+        mask = pos[None, :] <= pos[:, None]
+    return gqa_dense_attention(q, k, v, mask)
+
+
+def _ulysses_local(q, k, v, axis_name, causal, use_flash, interpret):
+    """Local block: heads scatter / sequence gather, full-sequence
+    attention, inverse exchange. q [b, S/n, Hq_loc, hd]."""
+    # Scatter heads (split axis 2 into n), gather sequence (concat axis 1):
+    # -> [b, S, Hq_loc/n, hd]. One balanced all_to_all over the sp axis.
+    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    if use_flash:
+        from nos_tpu.ops import flash_attention
+
+        out = flash_attention(q, k, v, causal=causal, interpret=interpret)
+    else:
+        out = _dense_causal(q, k, v, causal)
+    # Inverse: scatter sequence, gather heads -> [b, S/n, Hq_loc, hd].
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = "tp",
+    attention: str = "dense",
+) -> jax.Array:
+    """Exact attention with q/k/v [B, S, H, hd] sequence-sharded over
+    ``axis_name``; same calling convention as ``ring_attention`` (returns
+    [B, S, Hq·hd]). ``attention="flash"`` runs the Pallas kernel on the
+    gathered full sequence — differentiable end to end (all_to_all and
+    the kernel's custom_vjp both transpose cleanly).
+
+    Constraints (raise, never silently mis-group): per-device Q and KV
+    head counts must divide by the sp degree, and each head chunk must
+    span whole GQA groups so query heads keep their own K/V.
+    """
+    names = mesh.axis_names
+    if axis_name not in names:
+        raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
+    n = mesh.shape[axis_name]
+    tp = mesh.shape[head_axis] if head_axis in names else 1
+    hq, hkv = q.shape[2], k.shape[2]
+    hq_loc, hkv_loc = hq // tp, hkv // tp
+    if hq_loc % n or hkv_loc % n:
+        raise ValueError(
+            f"ulysses needs per-device head counts divisible by sp={n} "
+            f"(q {hq_loc}, kv {hkv_loc}); use ring attention for this shape"
+        )
+    # (No separate GQA-group check needed: hq_loc % n == 0 and
+    # hkv_loc % n == 0 already force every head chunk to span whole
+    # groups — chunk size hq_loc/n is (hq/hkv) * hkv_loc/n.)
+    interpret = jax.default_backend() == "cpu"
+    local = partial(
+        _ulysses_local,
+        axis_name=axis_name,
+        causal=causal,
+        use_flash=attention == "flash",
+        interpret=interpret,
+    )
+    wrapped, _ = _ring_shard_map(
+        local, mesh, axis_name, batch_axis, head_axis, out_rank4=True
+    )
+    b, s = q.shape[0], q.shape[1]
+    return wrapped(q, k, v).reshape(b, s, hq * q.shape[3])
