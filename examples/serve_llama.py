@@ -56,22 +56,30 @@ def main() -> None:
         f"({weight_bytes(params)/dense_bytes:.2f}x of bf16)"
     )
 
-    engine = Engine(params, config, max_slots=args.slots, max_len=args.max_len)
+    engine = Engine(
+        params, config, max_slots=args.slots, max_len=args.max_len,
+        prefill_chunk=16, prefix_cache_entries=4,
+    )
     rng = jax.random.key(0)
+    # Requests share a "system prompt": with prefix caching on, only the
+    # first admission prefills it — later ones hit the prefix LRU.
+    rng, sub = jax.random.split(rng)
+    system = jax.random.randint(sub, (40,), 1, config.vocab_size).tolist()
     ids = []
     for i in range(args.slots * 2):
         rng, sub = jax.random.split(rng)
         n = int(jax.random.randint(sub, (), 4, 24))
-        prompt = jax.random.randint(sub, (n,), 1, config.vocab_size)
-        ids.append(
-            engine.submit(GenRequest(prompt=prompt.tolist(), max_new_tokens=16))
-        )
+        prompt = system + jax.random.randint(sub, (n,), 1, config.vocab_size).tolist()
+        ids.append(engine.submit(GenRequest(prompt=prompt, max_new_tokens=16)))
     start = time.monotonic()
     results = engine.run()
     wall = time.monotonic() - start
     total = sum(len(t) for t in results.values())
+    from nos_tpu.util import metrics as m
+
     print(f"engine: {len(ids)} requests, {total} tokens in {wall:.2f}s "
-          f"({total/wall:.1f} tok/s across {args.slots} slots)")
+          f"({total/wall:.1f} tok/s across {args.slots} slots, "
+          f"{int(m.SERVE_PREFIX_HITS.value)} prefix-cache hits)")
 
     sampled = generate(
         params,
