@@ -112,12 +112,6 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache capacity {max_len}")
-    if c.sliding_window is not None and c.attention == "flash":
-        # same loud contract as llama_forward: no silent dense fallback
-        raise ValueError(
-            "sliding_window is dense-path only (the flash kernel has no "
-            "window support); use attention='dense'"
-        )
     if c.sliding_window is not None and pad_id is not None:
         # left padding decouples physical cache slots from logical
         # positions; the window mask runs over physical slots, so the
@@ -164,11 +158,12 @@ def prefill(
         # kernel (O(blk) VMEM) when the config asks for it, matching the
         # training path's dispatch. Padded batches need per-key masks the
         # kernel does not take, so they use the dense path.
-        if c.attention == "flash" and pad_id is None and c.sliding_window is None:
+        if c.attention == "flash" and pad_id is None:
             from nos_tpu.ops import flash_attention
 
             attn = flash_attention(
-                q, k, v, causal=True, interpret=jax.default_backend() == "cpu"
+                q, k, v, causal=True, window=c.sliding_window,
+                interpret=jax.default_backend() == "cpu",
             ).reshape(b, s, c.n_heads * hd)
         else:
             group = c.n_heads // c.n_kv_heads

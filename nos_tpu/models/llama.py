@@ -44,8 +44,10 @@ class LlamaConfig:
     remat: bool = False
     # Sliding-window attention (Mistral-style): each query attends only
     # the last `sliding_window` positions. None = full causal attention.
-    # Masking-only (the KV cache is not ring-buffered), and dense-path
-    # only — the flash kernel and ring attention reject it loudly.
+    # Masking-only (the KV cache is not ring-buffered). Served by the
+    # dense path AND the flash kernel (which skips blocks fully past the
+    # band — O(S·W) compute at long context); the sequence-parallel
+    # paths (ring/ulysses) still reject it loudly.
     sliding_window: Any = None
     # Sequence-parallel strategy when the mesh has an sp axis: "ring"
     # (K/V rotation via ppermute, O(S/n) resident sequence) or "ulysses"
@@ -356,11 +358,7 @@ def _attention(
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
 
-    if c.sliding_window is not None and c.attention == "flash":
-        raise ValueError(
-            "sliding_window is dense-path only (the flash kernel has no "
-            "window support); use attention='dense'"
-        )
+
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         if c.sliding_window is not None:
             raise ValueError(
@@ -402,7 +400,8 @@ def _attention(
         from nos_tpu.ops import flash_attention
 
         out = flash_attention(
-            q, k, v, causal=True, interpret=jax.default_backend() == "cpu"
+            q, k, v, causal=True, window=c.sliding_window,
+            interpret=jax.default_backend() == "cpu",
         )
         return _mm(out.reshape(b, s, c.n_heads * hd), layer["wo"])
 

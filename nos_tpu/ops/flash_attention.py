@@ -43,10 +43,27 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _causal_mask(blk_q: int, blk_k: int, q_start, k_start):
+def _block_needed(blk_q: int, blk_k: int, q_start, k_start, causal, window):
+    """Whether a (q block, k block) pair can contribute any unmasked
+    entry. ONE definition for all three kernels — forward and backward
+    must agree on block coverage or gradients silently go wrong."""
+    if not causal:
+        return True
+    needed = k_start <= q_start + blk_q - 1  # not fully in the future
+    if window is not None:
+        needed = needed & (k_start + blk_k - 1 >= q_start - window + 1)
+    return needed
+
+
+def _causal_mask(blk_q: int, blk_k: int, q_start, k_start, window=None):
+    """Causal (and optionally banded) mask: key <= query, and with
+    ``window`` set, query - key < window — the Mistral sliding band."""
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
     kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-    return kv_pos <= q_pos
+    mask = kv_pos <= q_pos
+    if window is not None:
+        mask = mask & (q_pos - kv_pos < window)
+    return mask
 
 
 def _smem_scalar_spec():
@@ -64,7 +81,7 @@ def _dimsem(n: int = 3):
 
 def _fwd_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, blk_q: int, blk_k: int, causal: bool, scale: float,
+    *, blk_q: int, blk_k: int, causal: bool, scale: float, window=None,
 ):
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -79,7 +96,9 @@ def _fwd_kernel(
 
     # Causal: blocks fully in the future contribute nothing — skip the MXU
     # work (the DMA was already pipelined; compute is the bottleneck).
-    needed = True if not causal else k_start <= q_start + blk_q - 1
+    # A sliding window also skips blocks fully PAST the band: for long
+    # sequences the grid degenerates to O(S·W) compute instead of O(S²).
+    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -94,7 +113,9 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [blk_q, blk_k] f32
         if causal:
-            s = jnp.where(_causal_mask(blk_q, blk_k, q_start, k_start), s, -jnp.inf)
+            s = jnp.where(
+                _causal_mask(blk_q, blk_k, q_start, k_start, window), s, -jnp.inf
+            )
         m_prev = m_scr[...]
         blk_max = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, blk_max)
@@ -124,12 +145,13 @@ def _fwd_kernel(
         )
 
 
-def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale):
+def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, window=None):
     b, hq, sq, hd = qt.shape
     skv = kt.shape[2]
     grid = (b, hq, sq // blk_q, skv // blk_k)
     kernel = functools.partial(
-        _fwd_kernel, blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale
+        _fwd_kernel, blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale,
+        window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -168,7 +190,7 @@ def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, inter
 # ----------------------------------------------------------------- backward
 
 
-def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, k_start):
+def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, k_start, window=None):
     """Shared backward block math: recompute p from lse, form ds.
 
     lse/delta arrive as [blk_q, 1] f32 column stats and broadcast. Inputs
@@ -182,7 +204,7 @@ def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, 
     finite = jnp.isfinite(lse)
     p = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse, 0.0)), 0.0)
     if causal:
-        p = jnp.where(_causal_mask(blk_q, blk_k, q_start, k_start), p, 0.0)
+        p = jnp.where(_causal_mask(blk_q, blk_k, q_start, k_start, window), p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -192,7 +214,7 @@ def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, 
 
 def _dq_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, blk_q: int, blk_k: int, causal: bool, scale: float,
+    *, blk_q: int, blk_k: int, causal: bool, scale: float, window=None,
 ):
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -203,7 +225,7 @@ def _dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    needed = True if not causal else k_start <= q_start + blk_q - 1
+    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -214,7 +236,7 @@ def _dq_kernel(
         _, ds = _bwd_p_ds(
             q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
             blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale,
-            q_start=q_start, k_start=k_start,
+            q_start=q_start, k_start=k_start, window=window,
         )
         dq_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -228,7 +250,7 @@ def _dq_kernel(
 def _dkv_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
-    *, blk_q: int, blk_k: int, causal: bool, scale: float,
+    *, blk_q: int, blk_k: int, causal: bool, scale: float, window=None,
 ):
     qi = pl.program_id(3)
     n_q = pl.num_programs(3)
@@ -240,7 +262,7 @@ def _dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    needed = True if not causal else k_start <= q_start + blk_q - 1
+    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -251,7 +273,7 @@ def _dkv_kernel(
         p, ds = _bwd_p_ds(
             q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
             blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale,
-            q_start=q_start, k_start=k_start,
+            q_start=q_start, k_start=k_start, window=window,
         )
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -266,12 +288,12 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, grad_dtype=None):
+def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, grad_dtype=None, window=None):
     b, hq, sq, hd = qt.shape
     skv = kt.shape[2]
     dq_dtype = grad_dtype or qt.dtype
     dkv_dtype = grad_dtype or kt.dtype
-    kwargs = dict(blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale)
+    kwargs = dict(blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale, window=window)
     offs = (jnp.asarray([q_off], jnp.int32), jnp.asarray([kv_off], jnp.int32))
     q_spec = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
@@ -331,13 +353,13 @@ def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, bl
 # --------------------------------------------------------------- custom_vjp
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, blk_q, blk_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, blk_q, blk_k, interpret, window):
+    out, _ = _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
+def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, window):
     b, s, hq, hd = q.shape
     group = hq // k.shape[2]
     scale = 1.0 / math.sqrt(hd)
@@ -347,13 +369,13 @@ def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret):
     vt = v.transpose(0, 2, 1, 3)
     ot, lse = _fwd_pallas(
         qt, kt, vt, 0, 0, causal=causal, blk_q=blk_q, blk_k=blk_k,
-        group=group, interpret=interpret, scale=scale,
+        group=group, interpret=interpret, scale=scale, window=window,
     )
     out = ot.transpose(0, 2, 1, 3)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, blk_q, blk_k, interpret, res, do):
+def _flash_bwd(causal, blk_q, blk_k, interpret, window, res, do):
     q, k, v, out, lse = res
     delta = _delta(do, out)
     dq, dk, dv = _bwd_pallas(
@@ -366,7 +388,7 @@ def _flash_bwd(causal, blk_q, blk_k, interpret, res, do):
         0, 0,
         causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=q.shape[2] // k.shape[2], interpret=interpret,
-        scale=1.0 / math.sqrt(q.shape[3]),
+        scale=1.0 / math.sqrt(q.shape[3]), window=window,
     )
     return (
         dq.transpose(0, 2, 1, 3),
@@ -403,6 +425,7 @@ def flash_attention(
     blk_q: int = 256,
     blk_k: int = 512,
     interpret: bool = False,
+    window: "int | None" = None,
 ) -> jax.Array:
     """q [B, S, Hq, hd], k/v [B, S, Hkv, hd] → [B, S, Hq, hd].
 
@@ -410,6 +433,11 @@ def flash_attention(
     (block sizes clamp down to S for short sequences). Differentiable:
     the custom_vjp backward recomputes attention blockwise from the saved
     logsumexp — O(S) memory end to end.
+
+    ``window`` (requires causal): Mistral-style sliding band — query i
+    attends keys (i-window, i]. Blocks fully past the band are SKIPPED,
+    so long-sequence compute degenerates to O(S·window) instead of O(S²)
+    — banding is where the blockwise grid beats dense masking outright.
 
     Default blocks (256 q × 512 kv) keep each MXU dot large enough to
     amortize grid overhead while staying far under VMEM with double
@@ -419,11 +447,16 @@ def flash_attention(
     hkv = k.shape[2]
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     # Clamp block sizes to the largest divisor of S: arbitrary prompt
     # lengths work, power-of-two lengths keep full MXU-shaped blocks.
     blk_q = _divisor_block(s, blk_q)
     blk_k = _divisor_block(s, blk_k)
-    return _flash(q, k, v, causal, blk_q, blk_k, interpret)
+    return _flash(q, k, v, causal, blk_q, blk_k, interpret, window)
 
 
 # ---------------------------------------------------------- block partials

@@ -93,12 +93,21 @@ class TestSlidingWindow:
         got = generate(params, jnp.asarray(prompt_np), config, max_new_tokens=8)
         np.testing.assert_array_equal(np.asarray(got), want)
 
-    def test_flash_with_window_rejected(self):
-        config = tiny_config(sliding_window=4, attention="flash")
-        params = init_llama_params(jax.random.key(0), config)
-        tokens = jnp.zeros((1, 8), jnp.int32)
-        with pytest.raises(ValueError):
-            llama_forward(params, tokens, config)
+    def test_flash_window_matches_dense_window(self):
+        # The kernel's banded mask must agree with the dense windowed path
+        # on the whole forward (the band is where blockwise skipping beats
+        # dense masking at long context).
+        dense_cfg = tiny_config(sliding_window=4, dtype=jnp.float32)
+        flash_cfg = tiny_config(
+            sliding_window=4, attention="flash", dtype=jnp.float32
+        )
+        params = init_llama_params(jax.random.key(0), dense_cfg)
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 16), 0, dense_cfg.vocab_size
+        )
+        want = llama_forward(params, tokens, dense_cfg)
+        got = llama_forward(params, tokens, flash_cfg)
+        assert jnp.allclose(got, want, atol=2e-4), float(jnp.abs(got - want).max())
 
     def test_left_padded_prefill_rejected(self):
         config = tiny_config(sliding_window=4)
