@@ -44,10 +44,11 @@ class LlamaConfig:
     remat: bool = False
     # Sliding-window attention (Mistral-style): each query attends only
     # the last `sliding_window` positions. None = full causal attention.
-    # Masking-only (the KV cache is not ring-buffered). Served by the
-    # dense path AND the flash kernel (which skips blocks fully past the
-    # band — O(S·W) compute at long context); the sequence-parallel
-    # paths (ring/ulysses) still reject it loudly.
+    # Masking-only (the KV cache is not ring-buffered). Served by every
+    # training/forward path: dense, the flash kernel, and BOTH
+    # sequence-parallel strategies — banded blocks fully past the window
+    # are skipped (kernel grid and ring hops alike), so long-context
+    # compute is O(S·W), not O(S²).
     sliding_window: Any = None
     # Sequence-parallel strategy when the mesh has an sp axis: "ring"
     # (K/V rotation via ppermute, O(S/n) resident sequence) or "ulysses"
@@ -360,11 +361,6 @@ def _attention(
 
 
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        if c.sliding_window is not None:
-            raise ValueError(
-                "sliding_window is not implemented for sequence-parallel "
-                "attention (ring or ulysses)"
-            )
         if c.sp_strategy not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_strategy {c.sp_strategy!r}; expected 'ring' "
@@ -382,7 +378,10 @@ def _attention(
             from nos_tpu.parallel.ulysses import ulysses_attention
 
             return _mm(
-                ulysses_attention(q, k, v, mesh, causal=True, attention=c.attention),
+                ulysses_attention(
+                    q, k, v, mesh, causal=True, attention=c.attention,
+                    window=c.sliding_window,
+                ),
                 layer["wo"],
             )
         from nos_tpu.parallel.ring_attention import (
@@ -391,8 +390,16 @@ def _attention(
         )
 
         if c.attention == "flash":
-            return _mm(ring_flash_attention(q, k, v, mesh, causal=True), layer["wo"])
-        return _mm(ring_attention(q, k, v, mesh, causal=True), layer["wo"])
+            return _mm(
+                ring_flash_attention(
+                    q, k, v, mesh, causal=True, window=c.sliding_window
+                ),
+                layer["wo"],
+            )
+        return _mm(
+            ring_attention(q, k, v, mesh, causal=True, window=c.sliding_window),
+            layer["wo"],
+        )
 
     if c.attention == "flash":
         # Single-chip blockwise attention on the MXU (nos_tpu/ops/); the

@@ -43,6 +43,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def validate_window(causal: bool, window) -> None:
+    """Shared contract for every windowed-attention entry point (the
+    single-chip kernel and both SP strategies): a window silently ignored
+    under causal=False, or a 0-width band NaN-ing the softmax, must be a
+    loud error everywhere."""
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+
+
 def _block_needed(blk_q: int, blk_k: int, q_start, k_start, causal, window):
     """Whether a (q block, k block) pair can contribute any unmasked
     entry. ONE definition for all three kernels — forward and backward
@@ -447,11 +459,7 @@ def flash_attention(
     hkv = k.shape[2]
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
-    if window is not None:
-        if not causal:
-            raise ValueError("window requires causal=True")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
+    validate_window(causal, window)
     # Clamp block sizes to the largest divisor of S: arbitrary prompt
     # lengths work, power-of-two lengths keep full MXU-shaped blocks.
     blk_q = _divisor_block(s, blk_q)
@@ -473,6 +481,7 @@ def flash_attention_block(
     blk_q: int = 256,
     blk_k: int = 512,
     interpret: bool = False,
+    window: "int | None" = None,
 ):
     """Forward PARTIALS of q [B, Sq, Hq, hd] against one K/V block
     [B, Skv, Hkv, hd] whose global positions start at the (possibly
@@ -495,6 +504,7 @@ def flash_attention_block(
         q_offset, kv_offset,
         causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=group, interpret=interpret, scale=1.0 / math.sqrt(hd),
+        window=window,
     )
     return ot.transpose(0, 2, 1, 3), lse
 
@@ -529,6 +539,7 @@ def flash_block_grads(
     interpret: bool = False,
     grad_dtype=None,
     delta: jax.Array = None,
+    window: "int | None" = None,
 ):
     """Per-block gradients matching ``flash_attention_block``: the
     contribution of THIS K/V block to (dq, dk, dv), given the MERGED
@@ -557,7 +568,7 @@ def flash_block_grads(
         causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=hq // k.shape[2], interpret=interpret,
         scale=1.0 / math.sqrt(hd),
-        grad_dtype=grad_dtype,
+        grad_dtype=grad_dtype, window=window,
     )
     return (
         dq.transpose(0, 2, 1, 3),

@@ -27,7 +27,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal):
+def _block_skippable(kv_idx, my_idx, sq, skv, causal, window):
+    """Whether a ring block is fully masked for this device's queries —
+    the exact inverse of the kernel's block-coverage predicate
+    (ops/flash_attention._block_needed), reused so the ring's lax.cond
+    skips can never disagree with kernel block coverage."""
+    from nos_tpu.ops.flash_attention import _block_needed
+
+    if not causal:
+        return jnp.asarray(False)
+    return jnp.logical_not(
+        _block_needed(sq, skv, my_idx * sq, kv_idx * skv, causal, window)
+    )
+
+
+def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal, window=None):
     """One ring step: fold the current K/V block into the accumulators.
 
     q [B,Sq,Kv,g,hd] grouped queries; k/v [B,Skv,Kv,hd]; accumulators in
@@ -45,6 +59,8 @@ def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal):
         q_pos = q_offset + jnp.arange(sq)
         kv_pos = kv_offset + jnp.arange(skv)
         mask = kv_pos[None, :] <= q_pos[:, None]  # [Sq, Skv]
+        if window is not None:
+            mask = mask & (q_pos[:, None] - kv_pos[None, :] < window)
         scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
 
     block_max = jnp.max(scores, axis=-1)  # [B,Kv,g,Sq]
@@ -64,7 +80,7 @@ def _online_block_update(q, k, v, m, l, acc, q_offset, kv_offset, causal):
     return new_m, new_l, new_acc
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: bool):
+def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: bool, window=None):
     """The per-device block: local q stays, k/v rotate around the ring.
 
     ``n_shards`` is static (the mesh axis size) so the ring unrolls into a
@@ -87,15 +103,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, n_shards: int, causal: boo
     def update(k_blk, v_blk, m, l, acc, kv_idx):
         def run():
             return _online_block_update(
-                qg, k_blk, v_blk, m, l, acc, q_offset, kv_idx * k_blk.shape[1], causal
+                qg, k_blk, v_blk, m, l, acc, q_offset, kv_idx * k_blk.shape[1],
+                causal, window,
             )
 
         if not causal:
             return run()
-        # Fully-future blocks are entirely masked: skip their FLOPs inside
-        # the cond (the ring stays synchronous, so this saves compute, not
-        # steps).
-        return jax.lax.cond(kv_idx > my_idx, lambda: (m, l, acc), run)
+        # Fully-masked blocks (future, or past the sliding band) skip
+        # their FLOPs inside the cond (the ring stays synchronous, so
+        # this saves compute, not steps).
+        skip = _block_skippable(kv_idx, my_idx, sq, k_blk.shape[1], causal, window)
+        return jax.lax.cond(skip, lambda: (m, l, acc), run)
 
     # Own block first, then n-1 permute-and-update rounds: the last
     # exchanged block is consumed, never a wasted hop.
@@ -149,15 +167,21 @@ def ring_attention(
     causal: bool = True,
     batch_axis: Optional[str] = "dp",
     head_axis: Optional[str] = "tp",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with q/k/v [B, S, H, hd] sequence-sharded over
     ``axis_name``. Returns [B, S, Hq·hd]. Axis names absent from the mesh
     are ignored, so the same call works on ('dp','tp'), ('sp',), or
     ('dp','sp','tp') meshes.
     """
+    from nos_tpu.ops.flash_attention import validate_window
+
+    validate_window(causal, window)
+
     def build(sa):
         return partial(
-            _ring_attention_local, axis_name=sa, n_shards=mesh.shape[sa], causal=causal
+            _ring_attention_local, axis_name=sa, n_shards=mesh.shape[sa],
+            causal=causal, window=window,
         )
 
     names = mesh.axis_names
@@ -173,7 +197,7 @@ def ring_attention(
 # ------------------------------------------------------- kernel-backed ring
 
 
-def _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret):
+def _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret, window=None):
     """Forward ring with the Pallas flash kernel per K/V block: local q
     stays resident, blocks rotate, (out, lse) partials merge exactly
     (ops/flash_attention.py block APIs)."""
@@ -188,7 +212,8 @@ def _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret):
 
     def block(k_blk, v_blk, kv_idx):
         return flash_attention_block(
-            q, k_blk, v_blk, q_off, kv_idx * sq, causal=causal, interpret=interpret
+            q, k_blk, v_blk, q_off, kv_idx * sq, causal=causal,
+            interpret=interpret, window=window,
         )
 
     def folded(out, lse, k_blk, v_blk, kv_idx):
@@ -198,8 +223,10 @@ def _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret):
 
         if not causal:
             return run()
-        # Fully-future blocks contribute nothing: skip their kernels.
-        return jax.lax.cond(kv_idx > my_idx, lambda: (out, lse), run)
+        # Fully-masked blocks (future, or past the band) contribute
+        # nothing: skip their kernels.
+        skip = _block_skippable(kv_idx, my_idx, sq, sq, causal, window)
+        return jax.lax.cond(skip, lambda: (out, lse), run)
 
     out, lse = block(k, v, my_idx)
     # Carry the partial in f32 across the ring (one rounding at the END,
@@ -221,7 +248,7 @@ def _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret):
     return out.astype(q.dtype), lse
 
 
-def _ring_flash_bwd_local(q, k, v, out, lse, do, axis_name, n, causal, interpret):
+def _ring_flash_bwd_local(q, k, v, out, lse, do, axis_name, n, causal, interpret, window=None):
     """Backward ring: K/V blocks make a FULL revolution carrying their
     gradient accumulators with them, so after n hops each block's dk/dv
     arrives back at its owner fully aggregated; dq accumulates locally.
@@ -243,7 +270,7 @@ def _ring_flash_bwd_local(q, k, v, out, lse, do, axis_name, n, causal, interpret
         return flash_block_grads(
             q, k_blk, v_blk, out, lse, do, q_off, kv_idx * sq,
             causal=causal, interpret=interpret,
-            grad_dtype=jnp.float32, delta=delta,
+            grad_dtype=jnp.float32, delta=delta, window=window,
         )
 
     def step(carry, i):
@@ -259,8 +286,9 @@ def _ring_flash_bwd_local(q, k, v, out, lse, do, axis_name, n, causal, interpret
             )
 
         if causal:
+            skip = _block_skippable(kv_idx, my_idx, sq, sq, causal, window)
             dk_acc, dv_acc, dq = jax.lax.cond(
-                kv_idx > my_idx, lambda: (dk_acc, dv_acc, dq), run
+                skip, lambda: (dk_acc, dv_acc, dq), run
             )
         else:
             dk_acc, dv_acc, dq = run()
@@ -278,24 +306,28 @@ def _ring_flash_bwd_local(q, k, v, out, lse, do, axis_name, n, causal, interpret
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def make_ring_flash_local(axis_name: str, n: int, causal: bool, interpret: bool):
+def make_ring_flash_local(axis_name: str, n: int, causal: bool, interpret: bool, window=None):
     """The shard_map-body ring-flash attention with a hand-written ring
     backward (Pallas kernels are forward primitives; autodiff cannot see
     through them, so the vjp replays the ring explicitly)."""
 
     @jax.custom_vjp
     def ring_flash(q, k, v):
-        out, _ = _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret)
+        out, _ = _ring_flash_fwd_local(
+            q, k, v, axis_name, n, causal, interpret, window
+        )
         return out
 
     def fwd(q, k, v):
-        out, lse = _ring_flash_fwd_local(q, k, v, axis_name, n, causal, interpret)
+        out, lse = _ring_flash_fwd_local(
+            q, k, v, axis_name, n, causal, interpret, window
+        )
         return out, (q, k, v, out, lse)
 
     def bwd(res, do):
         q, k, v, out, lse = res
         return _ring_flash_bwd_local(
-            q, k, v, out, lse, do, axis_name, n, causal, interpret
+            q, k, v, out, lse, do, axis_name, n, causal, interpret, window
         )
 
     ring_flash.defvjp(fwd, bwd)
@@ -313,10 +345,14 @@ def ring_flash_attention(
     batch_axis: Optional[str] = "dp",
     head_axis: Optional[str] = "tp",
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """``ring_attention`` with the Pallas flash kernel doing each block's
     math: same exactness contract, kernel-rate compute, O(blk) VMEM. The
     jnp path remains as the portable fallback (and the oracle in tests)."""
+    from nos_tpu.ops.flash_attention import validate_window
+
+    validate_window(causal, window)
     if q.shape[2] % k.shape[2]:
         raise ValueError(
             f"q heads {q.shape[2]} not a multiple of kv heads {k.shape[2]}"
@@ -327,7 +363,7 @@ def ring_flash_attention(
         raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    fn = make_ring_flash_local(sa0, mesh.shape[sa0], causal, interpret)
+    fn = make_ring_flash_local(sa0, mesh.shape[sa0], causal, interpret, window)
     wrapped, _ = _ring_shard_map(
         fn, mesh, axis_name, batch_axis, head_axis, out_rank4=True
     )

@@ -28,27 +28,22 @@ from functools import partial
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from nos_tpu.parallel.ring_attention import _ring_shard_map
 
 
-def _dense_causal(q, k, v, causal):
+def _dense_causal(q, k, v, causal, window=None):
     """Grouped-query attention on a full local sequence — delegates to
     the model stack's single GQA einsum (llama.gqa_dense_attention), so
     masking/scaling fixes land once."""
-    from nos_tpu.models.llama import gqa_dense_attention
+    from nos_tpu.models.llama import _window_causal_mask, gqa_dense_attention
 
-    s = q.shape[1]
-    mask = None
-    if causal:
-        pos = jnp.arange(s)
-        mask = pos[None, :] <= pos[:, None]
+    mask = _window_causal_mask(q.shape[1], window) if causal else None
     return gqa_dense_attention(q, k, v, mask)
 
 
-def _ulysses_local(q, k, v, axis_name, causal, use_flash, interpret):
+def _ulysses_local(q, k, v, axis_name, causal, use_flash, interpret, window=None):
     """Local block: heads scatter / sequence gather, full-sequence
     attention, inverse exchange. q [b, S/n, Hq_loc, hd]."""
     # Scatter heads (split axis 2 into n), gather sequence (concat axis 1):
@@ -59,9 +54,11 @@ def _ulysses_local(q, k, v, axis_name, causal, use_flash, interpret):
     if use_flash:
         from nos_tpu.ops import flash_attention
 
-        out = flash_attention(q, k, v, causal=causal, interpret=interpret)
+        out = flash_attention(
+            q, k, v, causal=causal, interpret=interpret, window=window
+        )
     else:
-        out = _dense_causal(q, k, v, causal)
+        out = _dense_causal(q, k, v, causal, window)
     # Inverse: scatter sequence, gather heads -> [b, S/n, Hq_loc, hd].
     return jax.lax.all_to_all(
         out, axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -79,6 +76,7 @@ def ulysses_attention(
     batch_axis: Optional[str] = "dp",
     head_axis: Optional[str] = "tp",
     attention: str = "dense",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with q/k/v [B, S, H, hd] sequence-sharded over
     ``axis_name``; same calling convention as ``ring_attention`` (returns
@@ -90,6 +88,9 @@ def ulysses_attention(
     head counts must divide by the sp degree, and each head chunk must
     span whole GQA groups so query heads keep their own K/V.
     """
+    from nos_tpu.ops.flash_attention import validate_window
+
+    validate_window(causal, window)
     names = mesh.axis_names
     if axis_name not in names:
         raise ValueError(f"mesh {names} has no sequence axis {axis_name!r}")
@@ -112,6 +113,7 @@ def ulysses_attention(
         causal=causal,
         use_flash=attention == "flash",
         interpret=interpret,
+        window=window,
     )
     wrapped, _ = _ring_shard_map(
         local, mesh, axis_name, batch_axis, head_axis, out_rank4=True

@@ -125,3 +125,19 @@ class TestUlyssesModelIntegration:
         tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, config.vocab_size)
         state, loss = step(state, tokens)
         assert jnp.isfinite(loss)
+
+    def test_window_contracts_enforced(self):
+        # Shared contract (review): SP entries reject the same invalid
+        # windows the kernel does — no silent ignore, no 0/0 NaN.
+        from nos_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_flash_attention,
+        )
+
+        q, k, v = random_qkv(jax.random.key(9), b=1, s=16, hq=4, hkv=4, hd=8)
+        mesh = mesh_from_devices((4,), ("sp",), jax.devices()[:4])
+        for fn in (ulysses_attention, ring_attention, ring_flash_attention):
+            with pytest.raises(ValueError, match="causal"):
+                fn(q, k, v, mesh, causal=False, window=4)
+            with pytest.raises(ValueError, match=">= 1"):
+                fn(q, k, v, mesh, window=0)
