@@ -495,6 +495,24 @@ def run_tpu_child() -> None:
                 result[f"fwd_flash_{tag}_ms"] = round(f_ms, 2)
             if d_ms is not None and f_ms is not None:
                 result[f"flash_speedup_{tag}"] = round(d_ms / f_ms, 3)
+            # Mistral-style banded attention: the kernel skips blocks past
+            # the window, so compute is O(S·W) — the headline long-context
+            # win over full-causal flash.
+            try:
+                w_ms = bench_fwd(
+                    dataclasses.replace(
+                        config, attention="flash", sliding_window=1024
+                    ),
+                    f"flash-w1024@{long_seq}",
+                    long_toks,
+                    iters=8,
+                )
+                result[f"fwd_flash_w1k_{tag}_ms"] = round(w_ms, 2)
+                if f_ms is not None:
+                    result[f"window_vs_full_{tag}"] = round(f_ms / w_ms, 3)
+            except Exception as e:
+                log(f"[tpu-child] flash-w1024@{long_seq} failed: "
+                    f"{type(e).__name__}: {str(e)[:160]}")
             snapshot()
 
     print(json.dumps(result), flush=True)
