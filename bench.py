@@ -410,6 +410,33 @@ def run_tpu_child() -> None:
             del qparams
             snapshot()
 
+            # int4 group-wise: a QUARTER of bf16's weight bytes — decode
+            # bandwidth should read through again if the nibble unpack
+            # fuses ahead of the MXU dot. Own try/except: an int4-only
+            # failure must not cost the engine/prefix numbers downstream.
+            try:
+                from nos_tpu.models.quantize import quantize_params_int4
+
+                q4params = jax.jit(quantize_params_int4)(params)
+                ratio4 = weight_bytes(q4params) / max(1, weight_bytes(params))
+                jax.block_until_ready(gen(q4params, prompt))
+                start = time.monotonic()
+                for _ in range(iters):
+                    out = gen(q4params, prompt)
+                jax.block_until_ready(out)
+                tok_s_q4 = new_tokens * iters / (time.monotonic() - start)
+                result["decode_int4_tokens_per_s"] = round(tok_s_q4, 1)
+                result["int4_weight_bytes_ratio"] = round(ratio4, 3)
+                result["int4_decode_speedup"] = round(tok_s_q4 / tok_s, 3)
+                log(f"[tpu-child] decode int4: {tok_s_q4:.1f} tok/s "
+                    f"({result['int4_decode_speedup']}x, "
+                    f"weights {ratio4:.2f}x bytes)")
+                del q4params
+            except Exception as e:
+                log(f"[tpu-child] int4 decode failed: "
+                    f"{type(e).__name__}: {str(e)[:160]}")
+            snapshot()
+
             # continuous batching: decode is weight-bandwidth-bound, so
             # batched slots share each weight read — aggregate tok/s should
             # approach slots x single-stream.

@@ -210,9 +210,9 @@ def _mm(x: jax.Array, w) -> jax.Array:
     """x @ w, dispatching on the weight leaf: dense bf16, int8
     QuantizedLinear (serving), or LoraLinear (adapter fine-tuning)."""
     from nos_tpu.models.lora import LoraLinear
-    from nos_tpu.models.quantize import QuantizedLinear
+    from nos_tpu.models.quantize import QuantizedLinear, QuantizedLinear4
 
-    if isinstance(w, QuantizedLinear):
+    if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
         return w.matmul(x)
     if isinstance(w, LoraLinear):
         return w.matmul(x)

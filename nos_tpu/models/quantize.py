@@ -51,6 +51,42 @@ class QuantizedLinear:
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
+class QuantizedLinear4:
+    """int4 weight [in, out] packed two-per-byte along the contraction
+    axis (q [in/2, out] uint8: low nibble = even row, high = odd), with
+    GROUP-WISE scales [in/group, out] (f32) — per-channel alone is too
+    coarse at 4 bits; group-wise along the contraction axis is the
+    standard int4 recipe. Decode reads a QUARTER of bf16's bytes; the
+    nibble unpack is VPU shift/mask work fused ahead of the MXU dot."""
+
+    q: jax.Array       # [in//2, out] uint8, two nibbles per byte
+    scale: jax.Array   # [in//group, out] f32
+    group: int
+
+    def _dequant(self, dtype) -> jax.Array:
+        lo = (self.q & 0xF).astype(jnp.int8) - 8          # [in/2, out]
+        hi = (self.q >> 4).astype(jnp.int8) - 8
+        half, out = self.q.shape
+        w = jnp.stack([lo, hi], axis=1).reshape(2 * half, out)  # interleave
+        scales = jnp.repeat(self.scale, self.group, axis=0)     # [in, out]
+        return w.astype(dtype) * scales.astype(dtype)
+
+    def matmul(self, x: jax.Array) -> jax.Array:
+        # The dequant materializes into the dot's operand stream (XLA
+        # fuses the shift/mask/scale into the tile load); HBM traffic is
+        # the packed nibbles + scales only.
+        return x @ self._dequant(x.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.group,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, group=aux[0])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
 class QuantizedEmbedding:
     """int8 table [vocab, d] + per-row scale [vocab] (f32); dequant after
     the gather so only the looked-up rows widen."""
@@ -111,6 +147,32 @@ def quantize_linear(w: jax.Array) -> QuantizedLinear:
     return QuantizedLinear(q=q, scale=scale)
 
 
+def quantize_linear4(w: jax.Array, group: int = 128) -> QuantizedLinear4:
+    """[in, out] weight → packed int4 with one scale per (group, output
+    column). ``group`` clamps to a divisor of the (even) contraction dim."""
+    d_in, d_out = w.shape
+    if d_in % 2:
+        raise ValueError(f"int4 packing needs an even contraction dim, got {d_in}")
+    # Largest EVEN divisor of d_in that is <= the requested group (pairs
+    # must not straddle groups — lo/hi nibbles share a byte; 2 always
+    # works since d_in is even).
+    group = min(group, d_in)
+    group -= group % 2
+    while d_in % group:
+        group -= 2
+    w32 = w.astype(jnp.float32).reshape(d_in // group, group, d_out)
+    absmax = jnp.max(jnp.abs(w32), axis=1)               # [groups, out]
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(
+        jnp.round(w32 / scale[:, None, :]), -7, 7
+    ).astype(jnp.int8).reshape(d_in, d_out)
+    u = (q + 8).astype(jnp.uint8)                        # [0, 15]
+    lo = u[0::2]
+    hi = u[1::2]
+    packed = (lo | (hi << 4)).astype(jnp.uint8)          # [in/2, out]
+    return QuantizedLinear4(q=packed, scale=scale, group=group)
+
+
 def quantize_embedding(w: jax.Array) -> QuantizedEmbedding:
     """[vocab, d] table → int8 with one scale per vocab row."""
     q, scale = _absmax_quantize(w, axis=1)
@@ -123,24 +185,24 @@ def quantize_expert_stack(w: jax.Array) -> QuantizedExpertStack:
     return QuantizedExpertStack(q=q, scale=scale)
 
 
-def quantize_params(params: Params) -> Params:
-    """Llama param tree → serving tree with every dense matmul weight, the
-    embedding table, and MoE expert stacks int8-quantized. Norm vectors
-    stay in the model dtype (tiny, and RMSNorm is scale-sensitive); the
-    MoE router stays float32 (routing is precision-sensitive).
-    """
+def _quantize_tree(params: Params, linear_fn) -> Params:
+    """THE param-tree walk for weight-only quantization, parameterized by
+    the dense-linear quantizer (int8 or int4) — embed stays row-gatherable
+    int8, norms keep the model dtype (tiny, and RMSNorm is
+    scale-sensitive), the MoE router stays float32 (routing is
+    precision-sensitive) and expert stacks stay int8."""
     out: Params = {
         "embed": quantize_embedding(params["embed"]),
         "final_norm": params["final_norm"],
         "layers": [],
     }
     if "lm_head" in params:  # absent for tied-unembedding models
-        out["lm_head"] = quantize_linear(params["lm_head"])
+        out["lm_head"] = linear_fn(params["lm_head"])
     for layer in params["layers"]:
         q_layer: Params = {}
         for key, value in layer.items():
             if key in _LINEAR_KEYS:
-                q_layer[key] = quantize_linear(value)
+                q_layer[key] = linear_fn(value)
             elif key == "moe":
                 q_layer[key] = {
                     "router": value["router"],
@@ -154,12 +216,30 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
+def quantize_params(params: Params) -> Params:
+    """Llama param tree → int8 serving tree (see _quantize_tree)."""
+    return _quantize_tree(params, quantize_linear)
+
+
+def quantize_params_int4(params: Params, group: int = 128) -> Params:
+    """Llama param tree → int4 serving tree: dense matmul weights as
+    packed group-quantized nibbles (QUARTER of bf16's bytes); the
+    embedding stays int8 (gather rows can't read packed pairs cheaply)
+    and MoE expert stacks stay int8 — int4's group bookkeeping per
+    expert isn't worth it at their size (see _quantize_tree)."""
+    return _quantize_tree(
+        params, lambda w: quantize_linear4(w, group)
+    )
+
+
 def dequantize_params(params: Params, dtype=jnp.bfloat16) -> Params:
     """Inverse of quantize_params (up to rounding): expands every quantized
     leaf back to a dense weight — the fake-quant oracle tests compare the
     int8 forward against, and the escape hatch back to training dtype."""
 
     def expand(leaf):
+        if isinstance(leaf, QuantizedLinear4):
+            return leaf._dequant(dtype)
         if isinstance(leaf, QuantizedLinear):
             return (leaf.q.astype(jnp.float32) * leaf.scale[None, :]).astype(dtype)
         if isinstance(leaf, QuantizedEmbedding):
@@ -172,7 +252,9 @@ def dequantize_params(params: Params, dtype=jnp.bfloat16) -> Params:
         expand,
         params,
         is_leaf=lambda x: isinstance(
-            x, (QuantizedLinear, QuantizedEmbedding, QuantizedExpertStack)
+            x,
+            (QuantizedLinear, QuantizedLinear4, QuantizedEmbedding,
+             QuantizedExpertStack),
         ),
     )
 

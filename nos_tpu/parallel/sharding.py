@@ -58,20 +58,42 @@ def llama_param_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
     return tree
 
 
-def llama_quantized_sharding(mesh: Mesh, config: LlamaConfig) -> Dict[str, Any]:
-    """Sharding tree matching quantize_params' output: each int8 weight
-    shards like its dense original, and its per-output-channel scale
-    shards along the same axis as the output dimension (per-vocab-row for
+def llama_quantized_sharding(
+    mesh: Mesh, config: LlamaConfig, bits: int = 8, group: int = 128
+) -> Dict[str, Any]:
+    """Sharding tree matching quantize_params' (bits=8) or
+    quantize_params_int4's (bits=4, same ``group``) output: each
+    quantized weight shards like its dense original, and its scales
+    shard along the same axis as the output dimension (per-vocab-row for
     the embedding), so dequantization stays local — no collective touches
     the scales. Structure mirrors the quantized pytree (QuantizedLinear /
-    QuantizedEmbedding nodes whose leaves are NamedShardings), which is
-    exactly what ``jax.device_put(qparams, sharding_tree)`` wants."""
-    from nos_tpu.models.quantize import QuantizedEmbedding, QuantizedLinear
+    QuantizedLinear4 / QuantizedEmbedding nodes whose leaves are
+    NamedShardings — int4 aux ``group`` must match the quantizer's),
+    which is exactly what ``jax.device_put(qparams, sharding_tree)``
+    wants."""
+    from nos_tpu.models.quantize import (
+        QuantizedEmbedding,
+        QuantizedLinear,
+        QuantizedLinear4,
+    )
 
-    def lin(in_axis, out_axis):
-        return QuantizedLinear(
-            q=_ns(mesh, in_axis, out_axis), scale=_ns(mesh, out_axis)
-        )
+    if bits == 8:
+        def lin(in_axis, out_axis):
+            return QuantizedLinear(
+                q=_ns(mesh, in_axis, out_axis), scale=_ns(mesh, out_axis)
+            )
+    elif bits == 4:
+        def lin(in_axis, out_axis):
+            # q [in/2, out] packs along the contraction dim — same axes as
+            # the dense weight; scale [groups, out] shards its group dim
+            # with the contraction axis (groups tile that dim).
+            return QuantizedLinear4(
+                q=_ns(mesh, in_axis, out_axis),
+                scale=_ns(mesh, in_axis, out_axis),
+                group=group,
+            )
+    else:
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
 
     layer = {
         "attn_norm": _ns(mesh),

@@ -211,6 +211,27 @@ class TestShardedServing:
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_tp_sharded_int4_generate_identically(self, setup):
+        """Same contract at 4 bits: packed nibbles shard along the
+        (halved) contraction axis, group scales alongside it."""
+        from nos_tpu.models.quantize import quantize_params_int4
+        from nos_tpu.parallel.mesh import mesh_from_devices
+        from nos_tpu.parallel.sharding import llama_quantized_sharding
+
+        config, params, prompt = setup
+        q4 = quantize_params_int4(params, group=16)
+        # jit both sides: eager-vs-jit bf16 fusion drift (unrelated to
+        # int4 — dequant and matmul are bitwise equal under sharding) can
+        # flip near-tied argmaxes in the tiny test vocab.
+        gen6 = jax.jit(lambda p, t: generate(p, t, config, max_new_tokens=6))
+        want = gen6(q4, prompt)
+        mesh = mesh_from_devices((1, 4), ("dp", "tp"), jax.devices()[:4])
+        sharded = jax.device_put(
+            q4, llama_quantized_sharding(mesh, config, bits=4, group=16)
+        )
+        got = gen6(sharded, prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
 
 class TestSamplingFilters:
     def test_top_k_one_equals_greedy(self, setup):

@@ -161,3 +161,70 @@ class TestMoeQuantization:
             np.asarray(want, np.float32), np.asarray(got, np.float32),
             atol=2e-2, rtol=2e-2,
         )
+
+
+class TestInt4:
+    """int4 group-wise weight quantization: packing round-trip, byte
+    budget, fake-quant oracle parity, and end-to-end serving."""
+
+    def test_pack_unpack_roundtrip(self):
+        from nos_tpu.models.quantize import quantize_linear4
+
+        w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
+        q = quantize_linear4(w, group=16)
+        assert q.q.shape == (32, 32) and q.q.dtype == jnp.uint8
+        assert q.scale.shape == (4, 32)
+        deq = q._dequant(jnp.float32)
+        # 4-bit absmax per group of 16: worst-case step is absmax/7
+        err = jnp.abs(deq - w)
+        bound = jnp.repeat(q.scale, q.group, axis=0) * 0.5 + 1e-6
+        assert bool(jnp.all(err <= bound)), float((err - bound).max())
+
+    def test_matmul_matches_dequant_oracle(self):
+        from nos_tpu.models.quantize import quantize_linear4
+
+        w = jax.random.normal(jax.random.key(1), (64, 48), jnp.float32)
+        x = jax.random.normal(jax.random.key(2), (4, 64), jnp.float32)
+        q = quantize_linear4(w, group=32)
+        got = q.matmul(x)
+        want = x @ q._dequant(jnp.float32)
+        assert jnp.allclose(got, want, atol=1e-5)
+
+    def test_weight_bytes_quarter_of_bf16(self):
+        from nos_tpu.models.quantize import quantize_params_int4
+
+        config = tiny_config()
+        params = init_llama_params(jax.random.key(0), config)
+        q4 = quantize_params_int4(params, group=32)
+        lin = q4["layers"][0]["wq"]
+        dense_bytes = config.d_model * config.d_model * 2  # bf16 wq
+        packed = lin.q.size * 1 + lin.scale.size * 4
+        assert packed < dense_bytes * 0.6  # nibbles + group scales
+
+    def test_int4_generation_matches_fake_quant_oracle(self):
+        from nos_tpu.models.generate import generate
+        from nos_tpu.models.quantize import dequantize_params, quantize_params_int4
+
+        config = tiny_config(dtype=jnp.float32)
+        params = init_llama_params(jax.random.key(0), config)
+        q4 = quantize_params_int4(params, group=16)
+        prompt = jnp.asarray([[3, 7, 11, 2]], jnp.int32)
+        got = generate(q4, prompt, config, max_new_tokens=6)
+        oracle = generate(
+            dequantize_params(q4, jnp.float32), prompt, config, max_new_tokens=6
+        )
+        assert jnp.array_equal(got, oracle)
+
+    def test_int4_tied_gemma_serves(self):
+        from nos_tpu.models.generate import generate
+        from nos_tpu.models.quantize import quantize_params_int4
+
+        config = tiny_config(
+            dtype=jnp.float32, hidden_act="gelu", norm_offset=True,
+            scale_embeddings=True, tie_embeddings=True,
+        )
+        params = init_llama_params(jax.random.key(0), config)
+        q4 = quantize_params_int4(params, group=16)
+        assert "lm_head" not in q4
+        out = generate(q4, jnp.asarray([[3, 7]], jnp.int32), config, max_new_tokens=4)
+        assert out.shape == (1, 4)
