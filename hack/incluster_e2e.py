@@ -16,10 +16,12 @@ path is proven with the pieces we can run for real:
   agents) admits bound pods and flips them Running.
 
 Flow: boot apiserver -> write kubeconfig + per-component YAML -> spawn
-operator, partitioner, scheduler, one tpuagent per node -> create 2 TPU
-nodes + an ElasticQuota -> submit chip pods (schedulerName opt-in) ->
-assert every pod goes Running over the wire, health endpoints answer, and
-all children exit 0 on SIGTERM.
+operator, partitioner, scheduler, one tpuagent per tpu-mode node and a
+sharingagent for the sharing-mode node -> create 2 TPU nodes + 1 sharing
+node + an ElasticQuota -> submit chip pods AND an HBM-fraction pod
+(schedulerName opt-in) -> assert every pod goes Running over the wire
+(the shared pod via the ConfigMap + label-flip actuation style), health
+endpoints answer, and all children exit 0 on SIGTERM.
 
 Run: `make incluster-e2e` (or PYTHONPATH=. python hack/incluster_e2e.py).
 """
@@ -56,8 +58,10 @@ from nos_tpu.sim.apiserver import StubApiServer  # noqa: E402
 from nos_tpu.sim.kubelet import SimKubelet  # noqa: E402
 
 NODES = ("kind-worker", "kind-worker2")
+SHARING_NODE = "kind-worker3"
 HEALTH_PORTS = {"operator": 18181, "partitioner": 18182, "scheduler": 18183,
-                "tpuagent-kind-worker": 18184, "tpuagent-kind-worker2": 18185}
+                "tpuagent-kind-worker": 18184, "tpuagent-kind-worker2": 18185,
+                "sharingagent-kind-worker3": 18186}
 
 
 def write_configs(tmp: str, server_url: str) -> dict:
@@ -101,6 +105,9 @@ contexts:
         emit(f"tpuagent-{node}",
              "agent:\n  reportConfigIntervalSeconds: 0.2\ndeviceBackend: sim\n",
              HEALTH_PORTS[f"tpuagent-{node}"])
+    emit(f"sharingagent-{SHARING_NODE}",
+         "agent:\n  reportConfigIntervalSeconds: 0.2\n",
+         HEALTH_PORTS[f"sharingagent-{SHARING_NODE}"])
     return configs
 
 
@@ -114,13 +121,13 @@ def spawn(component: str, config_path: str, node: str = "") -> subprocess.Popen:
     )
 
 
-def tpu_node(name: str) -> Node:
+def tpu_node(name: str, partitioning: str = "tpu") -> Node:
     alloc = {constants.RESOURCE_TPU: 8, "cpu": 64, "memory": 256}
     return Node(
         metadata=ObjectMeta(name=name, labels={
             labels.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
             labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
-            labels.PARTITIONING_LABEL: "tpu",
+            labels.PARTITIONING_LABEL: partitioning,
         }),
         status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
     )
@@ -131,6 +138,18 @@ def chip_pod(name: str, chips: int, ns: str = "ml") -> Pod:
         metadata=ObjectMeta(name=name, namespace=ns),
         spec=PodSpec(
             containers=[Container(requests={constants.RESOURCE_TPU: chips})],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+
+
+def shared_pod(name: str, ns: str = "ml") -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            containers=[
+                Container(requests={constants.tpu_shared_resource(8): 1})
+            ],
             scheduler_name=constants.SCHEDULER_NAME,
         ),
     )
@@ -174,6 +193,35 @@ def main() -> int:
         mgr = Manager(store)
         mgr.add(Controller("sim-kubelet", store, kubelet.reconcile,
                            [Watch(kind="Pod")]))
+        # Sharing-mode node-side stand-in: the sim device plugin reads the
+        # plugin ConfigMap when a node's config label flips and
+        # re-advertises tpu-mem resources (what the real TPU device plugin
+        # daemonset does; the chart's second actuation style).
+        from nos_tpu.api.v1alpha1.labels import TPU_DEVICE_PLUGIN_CONFIG_LABEL
+        from nos_tpu.device.sharing import SimSharedDevicePlugin
+        from nos_tpu.kube.controller import Request
+
+        shared_plugin = SimSharedDevicePlugin(store)
+
+        def configmap_to_labeled_nodes(event):
+            return [
+                Request(name=n.metadata.name)
+                for n in store.list("Node")
+                if TPU_DEVICE_PLUGIN_CONFIG_LABEL in n.metadata.labels
+            ]
+
+        mgr.add(Controller(
+            "sim-shared-device-plugin", store, shared_plugin.reconcile,
+            [
+                Watch(
+                    kind="Node",
+                    predicate=lambda e: e.type != "DELETED"
+                    and TPU_DEVICE_PLUGIN_CONFIG_LABEL
+                    in e.object.metadata.labels,
+                ),
+                Watch(kind="ConfigMap", mapper=configmap_to_labeled_nodes),
+            ],
+        ))
         mgr.start()
 
         try:
@@ -183,10 +231,15 @@ def main() -> int:
                 procs[f"tpuagent-{node}"] = spawn(
                     "tpuagent", configs[f"tpuagent-{node}"], node=node
                 )
+            procs[f"sharingagent-{SHARING_NODE}"] = spawn(
+                "sharingagent", configs[f"sharingagent-{SHARING_NODE}"],
+                node=SHARING_NODE,
+            )
             print(f"[e2e] spawned {len(procs)} component processes")
 
             for node in NODES:
                 store.create(tpu_node(node))
+            store.create(tpu_node(SHARING_NODE, partitioning="sharing"))
             # min == the full cluster: with a single quota there is no
             # other namespace to borrow unused guarantees from, so demand
             # beyond min would (correctly) be rejected by CapacityScheduling.
@@ -199,10 +252,14 @@ def main() -> int:
             ))
 
             # Mixed shapes: a board, a half board, two singles -> forces a
-            # real carve on both nodes.
-            pods = [("board", 8), ("half", 4), ("one-a", 1), ("one-b", 1)]
+            # real carve on both nodes. Plus an HBM-fraction pod that must
+            # ride the SHARING actuation style (ConfigMap + label flip).
+            pods = [("board", 8), ("half", 4), ("one-a", 1), ("one-b", 1),
+                    ("shared-infer", 0)]
             for name, chips in pods:
-                store.create(chip_pod(name, chips))
+                store.create(
+                    shared_pod(name) if chips == 0 else chip_pod(name, chips)
+                )
 
             def all_running() -> bool:
                 for name, _ in pods:
@@ -233,6 +290,20 @@ def main() -> int:
                 print("[e2e] FAIL: pods did not all reach Running")
                 return 1
             print("[e2e] all pods Running over the wire")
+            shared = store.get("Pod", "shared-infer", "ml")
+            if shared.spec.node_name != SHARING_NODE:
+                print(f"[e2e] FAIL: shared pod on {shared.spec.node_name!r}, "
+                      f"expected {SHARING_NODE}")
+                return 1
+            from nos_tpu.api.v1alpha1.labels import (
+                TPU_DEVICE_PLUGIN_CONFIG_LABEL as _CFG_LABEL,
+            )
+
+            node3 = store.get("Node", SHARING_NODE)
+            if _CFG_LABEL not in node3.metadata.labels:
+                print("[e2e] FAIL: sharing node never got its config label")
+                return 1
+            print("[e2e] sharing-mode actuation proven (ConfigMap + label flip)")
 
             bad_health = [n for n, p in HEALTH_PORTS.items() if not healthz_ok(p)]
             if bad_health:
