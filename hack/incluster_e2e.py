@@ -59,9 +59,13 @@ from nos_tpu.sim.kubelet import SimKubelet  # noqa: E402
 
 NODES = ("kind-worker", "kind-worker2")
 SHARING_NODE = "kind-worker3"
+# Hybrid: slice carving AND HBM sharing on ONE node — both agents run.
+HYBRID_NODE = "kind-worker4"
 HEALTH_PORTS = {"operator": 18181, "partitioner": 18182, "scheduler": 18183,
                 "tpuagent-kind-worker": 18184, "tpuagent-kind-worker2": 18185,
-                "sharingagent-kind-worker3": 18186}
+                "sharingagent-kind-worker3": 18186,
+                "tpuagent-kind-worker4": 18187,
+                "sharingagent-kind-worker4": 18188}
 
 
 def write_configs(tmp: str, server_url: str) -> dict:
@@ -105,9 +109,12 @@ contexts:
         emit(f"tpuagent-{node}",
              "agent:\n  reportConfigIntervalSeconds: 0.2\ndeviceBackend: sim\n",
              HEALTH_PORTS[f"tpuagent-{node}"])
-    emit(f"sharingagent-{SHARING_NODE}",
-         "agent:\n  reportConfigIntervalSeconds: 0.2\n",
-         HEALTH_PORTS[f"sharingagent-{SHARING_NODE}"])
+    for name in (f"sharingagent-{SHARING_NODE}", f"sharingagent-{HYBRID_NODE}"):
+        emit(name, "agent:\n  reportConfigIntervalSeconds: 0.2\n",
+             HEALTH_PORTS[name])
+    emit(f"tpuagent-{HYBRID_NODE}",
+         "agent:\n  reportConfigIntervalSeconds: 0.2\ndeviceBackend: sim\n",
+         HEALTH_PORTS[f"tpuagent-{HYBRID_NODE}"])
     return configs
 
 
@@ -235,25 +242,65 @@ def main() -> int:
                 "sharingagent", configs[f"sharingagent-{SHARING_NODE}"],
                 node=SHARING_NODE,
             )
+            # Hybrid node: BOTH daemons, like the chart's daemonsets would
+            # co-schedule on a hybrid-labeled node.
+            procs[f"tpuagent-{HYBRID_NODE}"] = spawn(
+                "tpuagent", configs[f"tpuagent-{HYBRID_NODE}"], node=HYBRID_NODE
+            )
+            procs[f"sharingagent-{HYBRID_NODE}"] = spawn(
+                "sharingagent", configs[f"sharingagent-{HYBRID_NODE}"],
+                node=HYBRID_NODE,
+            )
             print(f"[e2e] spawned {len(procs)} component processes")
 
             for node in NODES:
                 store.create(tpu_node(node))
             store.create(tpu_node(SHARING_NODE, partitioning="sharing"))
-            # min == the full cluster: with a single quota there is no
-            # other namespace to borrow unused guarantees from, so demand
-            # beyond min would (correctly) be rejected by CapacityScheduling.
+            hybrid = tpu_node(HYBRID_NODE, partitioning="hybrid")
+            hybrid.metadata.labels[labels.SHARED_CHIPS_LABEL] = "4"
+            hybrid.metadata.labels["e2e/pin"] = "hybrid"
+            store.create(hybrid)
+            # min == the full chip inventory (2 tpu nodes + the hybrid
+            # node's carvable half): with a single quota there is no other
+            # namespace to borrow unused guarantees from, so demand beyond
+            # min would (correctly) be rejected by CapacityScheduling.
             store.create(ElasticQuota(
                 metadata=ObjectMeta(name="eq-ml", namespace="ml"),
                 spec=ElasticQuotaSpec(
-                    min={constants.RESOURCE_TPU_CHIPS: 16},
-                    max={constants.RESOURCE_TPU_CHIPS: 16},
+                    min={constants.RESOURCE_TPU_CHIPS: 24},
+                    max={constants.RESOURCE_TPU_CHIPS: 24},
                 ),
             ))
 
             # Mixed shapes: a board, a half board, two singles -> forces a
             # real carve on both nodes. Plus an HBM-fraction pod that must
             # ride the SHARING actuation style (ConfigMap + label flip).
+            # The hybrid node's carvable half takes hyb-slice (its 4
+            # non-shared chips = one 2x2), its shared half hyb-infer; both
+            # are PINNED there via nodeSelector and submitted FIRST — the
+            # unpinned pods below can legally land on the hybrid node too
+            # (a sharing/hybrid node's free capacity serves anyone), and
+            # the point is proving ONE node serves both actuation styles.
+            for name in ("hyb-slice", "hyb-infer"):
+                pod = chip_pod(name, 4) if name == "hyb-slice" else shared_pod(name)
+                pod.spec.node_selector = {"e2e/pin": "hybrid"}
+                store.create(pod)
+
+            def hyb_running() -> bool:
+                return all(
+                    store.get("Pod", n, "ml").status.phase == PodPhase.RUNNING
+                    for n in ("hyb-slice", "hyb-infer")
+                )
+
+            if not wait_for(hyb_running, timeout=60.0):
+                for n in ("hyb-slice", "hyb-infer"):
+                    p = store.get("Pod", n, "ml")
+                    print(f"[e2e]   {n}: {p.status.phase} "
+                          f"{[c.message for c in p.status.conditions]}")
+                print("[e2e] FAIL: hybrid-pinned pods did not run")
+                return 1
+            print("[e2e] hybrid node served a slice AND an HBM fraction")
+
             pods = [("board", 8), ("half", 4), ("one-a", 1), ("one-b", 1),
                     ("shared-infer", 0)]
             for name, chips in pods:
@@ -275,10 +322,13 @@ def main() -> int:
                 node = pod.spec.node_name if pod else ""
                 print(f"[e2e]   pod {name}: {phase} on {node!r}")
             if not ok:
-                for node in NODES:
+                for node in NODES + (SHARING_NODE, HYBRID_NODE):
                     n = store.try_get("Node", node)
                     print(f"[e2e]   node {node} allocatable: "
                           f"{n.status.allocatable if n else None}")
+                    if n is not None and node in (SHARING_NODE, HYBRID_NODE):
+                        print(f"[e2e]     labels: {n.metadata.labels}")
+                        print(f"[e2e]     annotations: {n.metadata.annotations}")
                 for name, _ in pods:
                     pod = store.try_get("Pod", name, "ml")
                     if pod is not None:
@@ -291,10 +341,11 @@ def main() -> int:
                 return 1
             print("[e2e] all pods Running over the wire")
             shared = store.get("Pod", "shared-infer", "ml")
-            if shared.spec.node_name != SHARING_NODE:
+            if shared.spec.node_name not in (SHARING_NODE, HYBRID_NODE):
                 print(f"[e2e] FAIL: shared pod on {shared.spec.node_name!r}, "
-                      f"expected {SHARING_NODE}")
+                      "expected a sharing-capable node")
                 return 1
+
             from nos_tpu.api.v1alpha1.labels import (
                 TPU_DEVICE_PLUGIN_CONFIG_LABEL as _CFG_LABEL,
             )
