@@ -428,14 +428,23 @@ def _divisor_block(s: int, blk: int) -> int:
     return blk
 
 
+def default_blocks(window: "int | None") -> "tuple[int, int]":
+    """Measured-best default (blk_q, blk_k) on v5e (BENCH_r05_tpu.json
+    attn sweep @ 8x2048: 512x1024 is 3.03x dense vs 1.48x for 128x256).
+    Windowed configs keep 256x512: blk_k at or below half the typical
+    window preserves block-skip granularity inside the band, which is
+    where O(S*W) comes from."""
+    return (256, 512) if window is not None else (512, 1024)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     *,
     causal: bool = True,
-    blk_q: int = 256,
-    blk_k: int = 512,
+    blk_q: "int | None" = None,
+    blk_k: "int | None" = None,
     interpret: bool = False,
     window: "int | None" = None,
 ) -> jax.Array:
@@ -451,19 +460,19 @@ def flash_attention(
     so long-sequence compute degenerates to O(S·window) instead of O(S²)
     — banding is where the blockwise grid beats dense masking outright.
 
-    Default blocks (256 q × 512 kv) keep each MXU dot large enough to
-    amortize grid overhead while staying far under VMEM with double
-    buffering.
+    Block sizes default by shape (see ``default_blocks``); pass
+    ``blk_q``/``blk_k`` to override.
     """
     b, s, hq, hd = q.shape
     hkv = k.shape[2]
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
     validate_window(causal, window)
+    auto_q, auto_k = default_blocks(window)
     # Clamp block sizes to the largest divisor of S: arbitrary prompt
     # lengths work, power-of-two lengths keep full MXU-shaped blocks.
-    blk_q = _divisor_block(s, blk_q)
-    blk_k = _divisor_block(s, blk_k)
+    blk_q = _divisor_block(s, auto_q if blk_q is None else blk_q)
+    blk_k = _divisor_block(s, auto_k if blk_k is None else blk_k)
     return _flash(q, k, v, causal, blk_q, blk_k, interpret, window)
 
 

@@ -84,11 +84,12 @@ def llama_quantized_sharding(
             )
     elif bits == 4:
         def lin(in_axis, out_axis):
-            # q [in/2, out] packs along the contraction dim — same axes as
-            # the dense weight; scale [groups, out] shards its group dim
-            # with the contraction axis (groups tile that dim).
+            # q [G, group/2, out]: groups tile the contraction dim, so the
+            # group axis shards like the dense weight's contraction axis
+            # (rows within a group stay together — the packed nibble pair
+            # lives in one byte); scale [G, out] shards alongside.
             return QuantizedLinear4(
-                q=_ns(mesh, in_axis, out_axis),
+                q=_ns(mesh, in_axis, None, out_axis),
                 scale=_ns(mesh, in_axis, out_axis),
                 group=group,
             )

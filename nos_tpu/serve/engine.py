@@ -141,6 +141,11 @@ class Engine:
         self._queue: List[GenRequest] = []
         self._done: List[Completion] = []
         self._ids = itertools.count()
+        # (slot, device-scalar token) pairs from admissions this round;
+        # resolved with ONE host sync per step (each eager int() pull is
+        # a full network RTT on tunneled chips — r05 on-chip measurement
+        # had per-admission pulls eating ~3/4 of steady-state wall time).
+        self._pending_first: List[tuple] = []
         self.ticks = 0
         metrics.SERVE_SLOTS.set(max_slots)
 
@@ -331,9 +336,9 @@ class Engine:
         self._key_valid[b, :pad] = False
         self._key_valid[b, pad:] = True
         self._set_sampling(b, request)
-        tok = self._first_token(b, request, argmax=int(first[0]), raw=first_logits)
-        self._last[b] = tok
-        self._emit(b, tok)
+        self._pending_first.append(
+            (b, self._first_token(b, request, argmax=first[0], raw=first_logits))
+        )
 
     def _admit_chunked(self, b: int, request: GenRequest) -> None:
         """Long-prompt admission: ingest the prompt through fixed-size
@@ -392,7 +397,7 @@ class Engine:
                     while len(self._prefix_cache) > self.prefix_cache_entries:
                         self._prefix_cache.popitem(last=False)
         last_idx = (length - 1) % n
-        first = int(jnp.argmax(logits[0, last_idx]))
+        first = jnp.argmax(logits[0, last_idx]).astype(jnp.int32)
         self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))
         slot = _Slot(request=request)
         self._slots[b] = slot
@@ -400,24 +405,27 @@ class Engine:
         self._rope[b] = length
         self._key_valid[b, :] = True
         self._set_sampling(b, request)
-        tok = self._first_token(b, request, argmax=first, raw=logits[0, last_idx][None])
-        self._last[b] = tok
-        self._emit(b, tok)
+        self._pending_first.append(
+            (b, self._first_token(b, request, argmax=first,
+                                  raw=logits[0, last_idx][None]))
+        )
 
     def _set_sampling(self, b: int, request: GenRequest) -> None:
         self._temp[b] = request.temperature
         self._topk[b] = request.top_k
         self._topp[b] = request.top_p
 
-    def _first_token(self, b: int, request: GenRequest, argmax: int, raw) -> int:
-        """First generated token from the admission logits, and the slot's
-        key chain: both derive from fold_in(engine seed, request id) ONLY,
-        so a request's sampled stream survives any co-tenancy."""
+    def _first_token(self, b: int, request: GenRequest, argmax, raw):
+        """First generated token from the admission logits as a DEVICE
+        scalar (step() resolves all of a round's admissions in one host
+        sync), and the slot's key chain: both derive from fold_in(engine
+        seed, request id) ONLY, so a request's sampled stream survives
+        any co-tenancy."""
         req_key = jax.random.fold_in(self._base_key, request.id)
         carry, sub = jax.random.split(req_key)
         self._row_keys = self._row_keys.at[b].set(carry)
         if request.temperature <= 0:
-            return argmax
+            return jnp.asarray(argmax, jnp.int32)
         tok = pick_tokens_per_row(
             jnp.asarray(raw, jnp.float32).reshape(1, -1),
             jnp.asarray([request.temperature], jnp.float32),
@@ -425,7 +433,18 @@ class Engine:
             jnp.asarray([request.top_p], jnp.float32),
             sub[None],
         )
-        return int(tok[0])
+        return tok[0].astype(jnp.int32)
+
+    def _resolve_admissions(self) -> None:
+        """ONE device->host pull for every admission this round: emit each
+        pending first token and free any slot it already satisfies."""
+        if not self._pending_first:
+            return
+        toks = np.asarray(jnp.stack([t for _, t in self._pending_first]))
+        for (b, _), tok in zip(self._pending_first, toks):
+            self._last[b] = int(tok)
+            self._emit(b, int(tok))
+        self._pending_first.clear()
 
     def _emit(self, b: int, token: int) -> None:
         """Append one token; marks (but does not free) a finished slot —
@@ -446,6 +465,8 @@ class Engine:
         for b in range(self.slots_n):
             if self._slots[b] is None and self._queue:
                 self._admit(b, self._queue.pop(0))
+        self._resolve_admissions()
+        for b in range(self.slots_n):
             # Admission can satisfy a whole request (max_new_tokens=1, or
             # an immediate EOS from prefill): free before decoding.
             self._retire(b)

@@ -172,7 +172,7 @@ class TestInt4:
 
         w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
         q = quantize_linear4(w, group=16)
-        assert q.q.shape == (32, 32) and q.q.dtype == jnp.uint8
+        assert q.q.shape == (4, 8, 32) and q.q.dtype == jnp.uint8
         assert q.scale.shape == (4, 32)
         deq = q._dequant(jnp.float32)
         # 4-bit absmax per group of 16: worst-case step is absmax/7
