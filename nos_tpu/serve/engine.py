@@ -161,10 +161,12 @@ class Engine:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (cache, pos + 1, nxt, rope + 1), nxt
 
-            (cache, _, _, _), toks = jax.lax.scan(
+            (cache, pos, last, rope), toks = jax.lax.scan(
                 tick, (cache, pos, last, rope), None, length=ticks
             )
-            return toks, cache  # toks [ticks, B]
+            # Control state returns as DEVICE arrays so step() can chain
+            # chunk dispatches back-to-back without a host round-trip.
+            return toks, cache, pos, last, rope  # toks [ticks, B]
 
         def _decode_sampled(
             params, cache, pos, last, rope, key_valid, temp, topk, topp, keys
@@ -179,10 +181,10 @@ class Engine:
                 nxt = pick_tokens_per_row(logits, temp, topk, topp, both[:, 1])
                 return (cache, pos + 1, nxt, rope + 1, both[:, 0]), nxt
 
-            (cache, _, _, _, keys), toks = jax.lax.scan(
+            (cache, pos, last, rope, keys), toks = jax.lax.scan(
                 tick, (cache, pos, last, rope, keys), None, length=ticks
             )
-            return toks, cache, keys
+            return toks, cache, pos, last, rope, keys
 
         # Two programs so the default all-greedy workload never pays the
         # sampling sorts; step() picks by whether any live slot samples.
@@ -287,12 +289,50 @@ class Engine:
         return request.id
 
     def run(self) -> Dict[int, List[int]]:
-        """Drain queue + slots; returns {request id: generated tokens}."""
+        """Drain queue + slots; returns {request id: generated tokens}.
+
+        Chains decode chunks between host syncs: a sync is only useful
+        when its outcome can change a scheduling decision — a slot
+        freeing while requests wait to be admitted, or the drain ending.
+        Chunks until then are computable from the remaining budgets
+        (exactly, when no live request can EOS early), so that many
+        dispatches go out back-to-back and the device→host pull — a full
+        network RTT per sync on tunneled chips — amortizes over the whole
+        horizon instead of taxing every chunk."""
         while self._queue or any(s is not None for s in self._slots):
-            self.step()
+            self.step(chunks=None)
         out = {c.id: c.tokens for c in self._done}
         self._done.clear()
         return out
+
+    def _sync_horizon(self, pending: frozenset = frozenset()) -> int:
+        """Decode chunks until the next host decision point. A request
+        with an eos_id can finish any tick, so its horizon is its budget
+        only when nothing is queued behind it (a late EOS then wastes
+        ride-along ticks, never admission latency); with a queue it
+        bounds the horizon to one chunk so the freed slot turns over.
+        ``pending``: slots whose admission first-token is deferred into
+        this round's pull — already spent from the budget, not yet in
+        ``out``."""
+        t = self.ticks_per_sync
+        horizons = []
+        for b, s in enumerate(self._slots):
+            if s is None or s.done:
+                continue
+            spent = len(s.out) + (1 if b in pending else 0)
+            rem = max(1, s.request.max_new_tokens - spent)
+            budget = -(-rem // t)
+            if s.request.eos_id is not None:
+                # An EOS can land any tick; decoding the full budget
+                # blind would turn an early finish into worst-case wall
+                # time. A few chunks per sync keeps the RTT amortization
+                # while bounding post-EOS waste; with a queue behind it,
+                # every chunk matters for slot turnover.
+                budget = min(budget, 1 if self._queue else 4)
+            horizons.append(budget)
+        if not horizons:
+            return 1
+        return min(horizons) if self._queue else max(horizons)
 
     # ---------------------------------------------------------- scheduling
 
@@ -446,6 +486,18 @@ class Engine:
             self._emit(b, int(tok))
         self._pending_first.clear()
 
+    def _must_resolve_eagerly(self) -> bool:
+        """A pending first token must be pulled BEFORE decoding only when
+        its value can change scheduling: a budget of 1 (slot frees
+        without decoding) or an eos_id (prefill's token may end the
+        request). Otherwise resolution defers into the round's single
+        end-of-chunk pull — admissions then cost zero extra round-trips."""
+        for b, _ in self._pending_first:
+            req = self._slots[b].request
+            if req.max_new_tokens == 1 or req.eos_id is not None:
+                return True
+        return False
+
     def _emit(self, b: int, token: int) -> None:
         """Append one token; marks (but does not free) a finished slot —
         chunk processing frees at the boundary."""
@@ -459,43 +511,80 @@ class Engine:
 
     # ------------------------------------------------------------- tick
 
-    def step(self) -> None:
-        """One scheduling round: admit into free slots, then run one
-        ticks_per_sync decode chunk in a single device dispatch."""
+    def step(self, chunks: "int | None" = 1) -> None:
+        """One scheduling round: admit into free slots, then run
+        ``chunks`` ticks_per_sync decode chunks back-to-back with ONE
+        device→host sync at the end (None: pick the horizon from the
+        admitted slots' budgets, see _sync_horizon). Each chunk's control
+        state (pos, last token, rope) feeds the next dispatch as device
+        arrays, so chaining costs zero extra round-trips; host mirrors
+        advance arithmetically. A slot whose request completes
+        mid-horizon rides the remaining chunks harmlessly (scatter writes
+        past its frontier drop, its surplus tokens are trimmed
+        host-side)."""
         for b in range(self.slots_n):
             if self._slots[b] is None and self._queue:
                 self._admit(b, self._queue.pop(0))
-        self._resolve_admissions()
-        for b in range(self.slots_n):
-            # Admission can satisfy a whole request (max_new_tokens=1, or
-            # an immediate EOS from prefill): free before decoding.
-            self._retire(b)
+        deferred: List[tuple] = []
+        if self._pending_first and self._must_resolve_eagerly():
+            self._resolve_admissions()
+            for b in range(self.slots_n):
+                # Admission can satisfy a whole request (max_new_tokens=1,
+                # or an immediate EOS from prefill): free before decoding.
+                self._retire(b)
+        else:
+            # No admission can finish on its first token: its resolve
+            # merges into this round's end-of-chunk pull.
+            deferred = self._pending_first
+            self._pending_first = []
         if not any(s is not None for s in self._slots):
             return
-        self.ticks += 1
+        pending_b = frozenset(b for b, _ in deferred)
+        chunks = (
+            self._sync_horizon(pending_b) if chunks is None else max(1, chunks)
+        )
+        self.ticks += chunks
+        pos = jnp.asarray(self._pos)
+        last = jnp.asarray(self._last)
+        rope = jnp.asarray(self._rope)
+        key_valid = jnp.asarray(self._key_valid)
+        for b, tok in deferred:
+            # Traced scalar index: ONE compiled set-program serves every
+            # slot and admission count (a vectorized stack/scatter would
+            # compile per distinct admission count — on tunneled
+            # backends each new executable costs whole seconds).
+            last = last.at[jnp.asarray(b, jnp.int32)].set(tok)
+        admit_last = last
+        tok_chunks = []
         if (self._temp > 0).any():
-            toks, self._cache, self._row_keys = self._decode_sampled(
-                self.params,
-                self._cache,
-                jnp.asarray(self._pos),
-                jnp.asarray(self._last),
-                jnp.asarray(self._rope),
-                jnp.asarray(self._key_valid),
-                jnp.asarray(self._temp),
-                jnp.asarray(self._topk),
-                jnp.asarray(self._topp),
-                self._row_keys,
-            )
+            temp = jnp.asarray(self._temp)
+            topk = jnp.asarray(self._topk)
+            topp = jnp.asarray(self._topp)
+            keys = self._row_keys
+            for _ in range(chunks):
+                toks, self._cache, pos, last, rope, keys = self._decode_sampled(
+                    self.params, self._cache, pos, last, rope,
+                    key_valid, temp, topk, topp, keys,
+                )
+                tok_chunks.append(toks)
+            self._row_keys = keys
         else:
-            toks, self._cache = self._decode_greedy(
-                self.params,
-                self._cache,
-                jnp.asarray(self._pos),
-                jnp.asarray(self._last),
-                jnp.asarray(self._rope),
-                jnp.asarray(self._key_valid),
-            )
-        tokens = np.asarray(toks)  # [ticks_per_sync, B]
+            for _ in range(chunks):
+                toks, self._cache, pos, last, rope = self._decode_greedy(
+                    self.params, self._cache, pos, last, rope, key_valid,
+                )
+                tok_chunks.append(toks)
+        # ONE transfer for the whole round: the chunk token arrays (and
+        # any deferred admission firsts) come back in a single
+        # device_get — no on-device concat (that would compile a new
+        # program per distinct chunk count).
+        if deferred:
+            first_row, *np_chunks = jax.device_get([admit_last] + tok_chunks)
+            for b, _ in deferred:
+                self._emit(b, int(first_row[b]))
+        else:
+            np_chunks = jax.device_get(tok_chunks)
+        tokens = np.concatenate(np_chunks)  # [chunks * ticks_per_sync, B]
         ticks = tokens.shape[0]
         active_slots = sum(1 for s in self._slots if s is not None)
         metrics.SERVE_TICKS.inc(ticks)
