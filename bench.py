@@ -442,37 +442,46 @@ def run_tpu_child() -> None:
             # approach slots x single-stream.
             from nos_tpu.serve import Engine, GenRequest
 
-            slots, n_req, gen_len = 4, 8, 64
-            # 16 ticks/sync: dispatch latency (a network RTT on tunneled
-            # chips) amortizes over the chunk
-            eng = Engine(params, config, max_slots=slots, max_len=256,
-                         ticks_per_sync=16)
-            # Warm the engine's compiled programs (prefill bucket, decode
-            # scan, splice) with one throwaway request: serving replicas
-            # compile once per process but serve for hours, so the
-            # steady-state tokens/s is the capacity number. Cold-start is
-            # recorded separately.
-            t_cold = time.monotonic()
-            eng.submit(GenRequest(prompt=[7] * 120, max_new_tokens=gen_len))
-            eng.run()
-            result["serve_cold_start_s"] = round(time.monotonic() - t_cold, 1)
-            for _ in range(n_req):
+            gen_len = 64
+
+            def bench_engine(slots, n_req, key_prefix):
+                """Cold-start one engine (warm-up request compiles the
+                prefill bucket, decode scan, splice — serving replicas
+                compile once per process but serve for hours, so the
+                steady-state tokens/s is the capacity number), then time
+                n_req same-shape requests; records under key_prefix."""
+                # 16 ticks/sync: dispatch latency (a network RTT on
+                # tunneled chips) amortizes over the chunk
+                eng = Engine(params, config, max_slots=slots, max_len=256,
+                             ticks_per_sync=16)
+                t_cold = time.monotonic()
                 eng.submit(GenRequest(prompt=[7] * 120, max_new_tokens=gen_len))
-            start = time.monotonic()
-            results = eng.run()
-            wall = time.monotonic() - start
-            total = sum(len(t) for t in results.values())
-            result["serve_slots"] = slots
-            result["serve_tokens_per_s"] = round(total / wall, 1)
-            result["serve_vs_single_stream"] = round(
-                (total / wall) / tok_s, 3
-            )
-            log(f"[tpu-child] engine: {total} tokens / {wall:.1f}s = "
-                f"{total/wall:.1f} tok/s across {slots} slots "
-                f"({result['serve_vs_single_stream']}x single-stream, "
-                f"cold start {result['serve_cold_start_s']}s)")
-            del eng
-            snapshot()
+                eng.run()
+                cold_s = round(time.monotonic() - t_cold, 1)
+                for _ in range(n_req):
+                    eng.submit(
+                        GenRequest(prompt=[7] * 120, max_new_tokens=gen_len)
+                    )
+                start = time.monotonic()
+                total = sum(len(t) for t in eng.run().values())
+                wall = time.monotonic() - start
+                result[f"{key_prefix}_slots"] = slots
+                result[f"{key_prefix}_cold_start_s"] = cold_s
+                result[f"{key_prefix}_tokens_per_s"] = round(total / wall, 1)
+                result[f"{key_prefix}_vs_single_stream"] = round(
+                    (total / wall) / tok_s, 3
+                )
+                log(f"[tpu-child] engine x{slots} slots: {total} tokens / "
+                    f"{wall:.1f}s = {total/wall:.1f} tok/s "
+                    f"({result[f'{key_prefix}_vs_single_stream']}x "
+                    f"single-stream, cold start {cold_s}s)")
+                snapshot()
+
+            bench_engine(4, 8, "serve")
+            # Slot scaling: decode shares each weight read across rows,
+            # so doubling slots should nearly double aggregate tokens/s
+            # until KV-cache bandwidth catches up.
+            bench_engine(8, 16, "serve8")
 
             # prefix caching: same aggregate workload but a long shared
             # system prompt and the chunked path + LRU cache — measures

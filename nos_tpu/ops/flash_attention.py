@@ -67,6 +67,70 @@ def _block_needed(blk_q: int, blk_k: int, q_start, k_start, causal, window):
     return needed
 
 
+def _kv_block_span(qi, blk_q: int, blk_k: int, window):
+    """Inclusive (lo, hi) kv-block index range q block ``qi`` can touch
+    under causal (+ optional sliding-window) masking with ZERO offsets.
+    Drives the compact grid: the inner kv step walks [lo, lo+steps) and
+    clamps to hi, so steps past the band re-request the SAME block —
+    Pallas elides the copy when consecutive grid steps map to identical
+    block indices, which turns the skipped blocks' HBM traffic (the
+    bulk of a bandwidth-bound attention) into nothing, not just their
+    MXU work. r05 on-chip: windowed flash was SLOWER than full-causal
+    at 4k/8k because pl.when skipped only compute while every K/V block
+    still streamed."""
+    hi = (qi * blk_q + blk_q - 1) // blk_k
+    if window is None:
+        lo = hi * 0
+    else:
+        lo = jnp.maximum(0, (qi * blk_q - window + 1) // blk_k)
+    return lo, hi
+
+
+def _q_block_span(kb, blk_q: int, blk_k: int, window, n_q: int):
+    """Inclusive (lo, hi) q-block index range kv block ``kb`` feeds —
+    the dkv-kernel mirror of _kv_block_span (zero offsets)."""
+    lo = (kb * blk_k) // blk_q
+    if window is None:
+        hi = lo * 0 + (n_q - 1)
+    else:
+        hi = jnp.minimum(n_q - 1, (kb * blk_k + blk_k + window - 2) // blk_q)
+    return lo, hi
+
+
+def _compact_step(i, lo, hi):
+    """Remapped block index + validity for compact inner step ``i``
+    walking the inclusive [lo, hi] span. THE one definition of the
+    remap — kernels and BlockSpec index maps must agree exactly, or a
+    kernel computes a mask for a block the pipeline never fetched.
+    Clamped steps repeat ``hi`` (Pallas elides the re-copy) and must be
+    compute-skipped via the returned validity."""
+    raw = lo + i
+    return jnp.minimum(raw, hi), raw <= hi
+
+
+def _static_zero(off) -> bool:
+    """True only for a compile-time zero offset — the precondition for
+    the compact grid (its spans assume global positions start at 0). A
+    traced offset (ring-attention block partials) can never qualify."""
+    try:
+        return int(off) == 0
+    except TypeError:
+        return False
+
+
+def _compact_kv_steps(n_k: int, blk_q: int, blk_k: int, window) -> int:
+    """Static inner-grid extent covering any q block's kv span."""
+    if window is None:
+        return n_k
+    return min(n_k, (blk_q + window - 2) // blk_k + 2)
+
+
+def _compact_q_steps(n_q: int, blk_q: int, blk_k: int, window) -> int:
+    if window is None:
+        return n_q
+    return min(n_q, (blk_k + window - 2) // blk_q + 2)
+
+
 def _causal_mask(blk_q: int, blk_k: int, q_start, k_start, window=None):
     """Causal (and optionally banded) mask: key <= query, and with
     ``window`` set, query - key < window — the Mistral sliding band."""
@@ -94,11 +158,21 @@ def _dimsem(n: int = 3):
 def _fwd_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, blk_q: int, blk_k: int, causal: bool, scale: float, window=None,
+    compact: bool = False,
 ):
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * blk_q + qoff_ref[0]
-    k_start = ki * blk_k + koff_ref[0]
+    if compact:
+        # Same remap as the BlockSpec index_map: step ki visits block
+        # min(lo+ki, hi); clamped steps are duplicates (no DMA) and
+        # compute-skipped below.
+        lo, hi_blk = _kv_block_span(pl.program_id(2), blk_q, blk_k, window)
+        kb, in_span = _compact_step(ki, lo, hi_blk)
+        k_start = kb * blk_k
+    else:
+        k_start = ki * blk_k + koff_ref[0]
+        in_span = True
 
     @pl.when(ki == 0)
     def _init():
@@ -107,10 +181,10 @@ def _fwd_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # Causal: blocks fully in the future contribute nothing — skip the MXU
-    # work (the DMA was already pipelined; compute is the bottleneck).
+    # work (compact grids also skip their DMA via the index remap above).
     # A sliding window also skips blocks fully PAST the band: for long
     # sequences the grid degenerates to O(S·W) compute instead of O(S²).
-    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window)
+    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window) & in_span
 
     @pl.when(needed)
     def _compute():
@@ -157,14 +231,26 @@ def _fwd_kernel(
         )
 
 
-def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, window=None):
+def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, window=None, compact=False):
     b, hq, sq, hd = qt.shape
     skv = kt.shape[2]
-    grid = (b, hq, sq // blk_q, skv // blk_k)
+    n_k = skv // blk_k
+    compact = (
+        compact and causal and _static_zero(q_off) and _static_zero(kv_off)
+    )
+    steps = _compact_kv_steps(n_k, blk_q, blk_k, window) if compact else n_k
+    grid = (b, hq, sq // blk_q, steps)
     kernel = functools.partial(
         _fwd_kernel, blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale,
-        window=window,
+        window=window, compact=compact,
     )
+    if compact:
+        def kv_map(bi, hi, qi, ki):
+            lo, hi_blk = _kv_block_span(qi, blk_q, blk_k, window)
+            return (bi, hi // group, _compact_step(ki, lo, hi_blk)[0], 0)
+    else:
+        def kv_map(bi, hi, qi, ki):
+            return (bi, hi // group, ki, 0)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -172,12 +258,8 @@ def _fwd_pallas(qt, kt, vt, q_off, kv_off, *, causal, blk_q, blk_k, group, inter
             _smem_scalar_spec(),
             _smem_scalar_spec(),
             pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
-            ),
+            pl.BlockSpec((1, 1, blk_k, hd), kv_map),
+            pl.BlockSpec((1, 1, blk_k, hd), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -227,17 +309,24 @@ def _bwd_p_ds(q, k, v, do, lse, delta, *, blk_q, blk_k, causal, scale, q_start, 
 def _dq_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     *, blk_q: int, blk_k: int, causal: bool, scale: float, window=None,
+    compact: bool = False,
 ):
     ki = pl.program_id(3)
     n_k = pl.num_programs(3)
     q_start = pl.program_id(2) * blk_q + qoff_ref[0]
-    k_start = ki * blk_k + koff_ref[0]
+    if compact:
+        lo, hi_blk = _kv_block_span(pl.program_id(2), blk_q, blk_k, window)
+        kb, in_span = _compact_step(ki, lo, hi_blk)
+        k_start = kb * blk_k
+    else:
+        k_start = ki * blk_k + koff_ref[0]
+        in_span = True
 
     @pl.when(ki == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window)
+    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window) & in_span
 
     @pl.when(needed)
     def _compute():
@@ -263,18 +352,27 @@ def _dkv_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
     *, blk_q: int, blk_k: int, causal: bool, scale: float, window=None,
+    compact: bool = False, n_q_total: int = 0,
 ):
     qi = pl.program_id(3)
     n_q = pl.num_programs(3)
-    q_start = qi * blk_q + qoff_ref[0]
     k_start = pl.program_id(2) * blk_k + koff_ref[0]
+    if compact:
+        lo, hi_blk = _q_block_span(
+            pl.program_id(2), blk_q, blk_k, window, n_q_total
+        )
+        qb, in_span = _compact_step(qi, lo, hi_blk)
+        q_start = qb * blk_q
+    else:
+        q_start = qi * blk_q + qoff_ref[0]
+        in_span = True
 
     @pl.when(qi == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window)
+    needed = _block_needed(blk_q, blk_k, q_start, k_start, causal, window) & in_span
 
     @pl.when(needed)
     def _compute():
@@ -300,22 +398,37 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, grad_dtype=None, window=None):
+def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, blk_k, group, interpret, scale, grad_dtype=None, window=None, compact=False):
     b, hq, sq, hd = qt.shape
     skv = kt.shape[2]
+    compact = (
+        compact and causal and _static_zero(q_off) and _static_zero(kv_off)
+    )
     dq_dtype = grad_dtype or qt.dtype
     dkv_dtype = grad_dtype or kt.dtype
     kwargs = dict(blk_q=blk_q, blk_k=blk_k, causal=causal, scale=scale, window=window)
     offs = (jnp.asarray([q_off], jnp.int32), jnp.asarray([kv_off], jnp.int32))
     q_spec = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-    kv_spec = pl.BlockSpec(
-        (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
-    )
+    if compact:
+        def _kv_idx(qi, ki):
+            lo, hi_blk = _kv_block_span(qi, blk_q, blk_k, window)
+            return _compact_step(ki, lo, hi_blk)[0]
+
+        kv_spec = pl.BlockSpec(
+            (1, 1, blk_k, hd),
+            lambda bi, hi, qi, ki: (bi, hi // group, _kv_idx(qi, ki), 0),
+        )
+        kv_steps = _compact_kv_steps(skv // blk_k, blk_q, blk_k, window)
+    else:
+        kv_spec = pl.BlockSpec(
+            (1, 1, blk_k, hd), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+        )
+        kv_steps = skv // blk_k
     row_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **kwargs),
-        grid=(b, hq, sq // blk_q, skv // blk_k),
+        functools.partial(_dq_kernel, compact=compact, **kwargs),
+        grid=(b, hq, sq // blk_q, kv_steps),
         in_specs=[
             _smem_scalar_spec(), _smem_scalar_spec(),
             q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec,
@@ -331,15 +444,34 @@ def _bwd_pallas(qt, kt, vt, dot, lse, delta, q_off, kv_off, *, causal, blk_q, bl
 
     # dk/dv: stream Q blocks (innermost) per K/V block. Accumulated per
     # QUERY head ([B, Hq, Skv, hd]); the GQA group-sum happens outside.
-    q_spec_t = pl.BlockSpec((1, 1, blk_q, hd), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    n_q = sq // blk_q
+    if compact:
+        def _q_idx(ki, qi):
+            lo, hi_blk = _q_block_span(ki, blk_q, blk_k, window, n_q)
+            return _compact_step(qi, lo, hi_blk)[0]
+
+        q_spec_t = pl.BlockSpec(
+            (1, 1, blk_q, hd), lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)
+        )
+        row_spec_t = pl.BlockSpec(
+            (1, 1, blk_q, 1), lambda bi, hi, ki, qi: (bi, hi, _q_idx(ki, qi), 0)
+        )
+        q_steps = _compact_q_steps(n_q, blk_q, blk_k, window)
+    else:
+        q_spec_t = pl.BlockSpec(
+            (1, 1, blk_q, hd), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+        )
+        row_spec_t = pl.BlockSpec(
+            (1, 1, blk_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+        )
+        q_steps = n_q
     kv_spec_t = pl.BlockSpec(
         (1, 1, blk_k, hd), lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)
     )
-    row_spec_t = pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
     dkv_out = pl.BlockSpec((1, 1, blk_k, hd), lambda bi, hi, ki, qi: (bi, hi, ki, 0))
     dkh, dvh = pl.pallas_call(
-        functools.partial(_dkv_kernel, **kwargs),
-        grid=(b, hq, skv // blk_k, sq // blk_q),
+        functools.partial(_dkv_kernel, compact=compact, n_q_total=n_q, **kwargs),
+        grid=(b, hq, skv // blk_k, q_steps),
         in_specs=[
             _smem_scalar_spec(), _smem_scalar_spec(),
             q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t,
@@ -382,6 +514,7 @@ def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, window):
     ot, lse = _fwd_pallas(
         qt, kt, vt, 0, 0, causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=group, interpret=interpret, scale=scale, window=window,
+        compact=True,
     )
     out = ot.transpose(0, 2, 1, 3)
     return out, (q, k, v, out, lse)
@@ -400,7 +533,7 @@ def _flash_bwd(causal, blk_q, blk_k, interpret, window, res, do):
         0, 0,
         causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=q.shape[2] // k.shape[2], interpret=interpret,
-        scale=1.0 / math.sqrt(q.shape[3]), window=window,
+        scale=1.0 / math.sqrt(q.shape[3]), window=window, compact=True,
     )
     return (
         dq.transpose(0, 2, 1, 3),
@@ -431,10 +564,11 @@ def _divisor_block(s: int, blk: int) -> int:
 def default_blocks(window: "int | None") -> "tuple[int, int]":
     """Measured-best default (blk_q, blk_k) on v5e (BENCH_r05_tpu.json
     attn sweep @ 8x2048: 512x1024 is 3.03x dense vs 1.48x for 128x256).
-    Windowed configs keep 256x512: blk_k at or below half the typical
-    window preserves block-skip granularity inside the band, which is
-    where O(S*W) comes from."""
-    return (256, 512) if window is not None else (512, 1024)
+    Windowed configs use 512x512: under the compact grid each q block
+    streams ceil((blk_q + W - 1)/blk_k)+1 kv blocks, so for a ~1k
+    window 512x512 moves the fewest K/V bytes per q block while keeping
+    full-width MXU q tiles."""
+    return (512, 512) if window is not None else (512, 1024)
 
 
 def flash_attention(

@@ -26,7 +26,29 @@ jax.config.update("jax_platforms", "cpu")
 # alone cost minutes). Cache survives across runs (and is keyed by HLO,
 # so shape/code changes miss safely). Override with
 # NOS_TEST_CC_DIR="" to disable.
+#
+# The dir is suffixed with a host-CPU fingerprint: XLA:CPU caches AOT
+# executables whose machine features must match the loading host — a
+# cache written on a different machine (shared /tmp images, CI runners)
+# reloads with "feature mismatch ... could lead to SIGILL" errors.
 _cc_dir = os.environ.get("NOS_TEST_CC_DIR", "/tmp/nos-tpu-test-jax-cache")
+if _cc_dir and "NOS_TEST_CC_DIR" not in os.environ:
+    import hashlib
+    import platform
+
+    try:
+        # x86 lists CPU features under "flags", ARM under "Features";
+        # volatile lines (cpu MHz) must stay out or the cache splits
+        # on every boot.
+        with open("/proc/cpuinfo") as fh:
+            flags = "".join(
+                ln for ln in fh
+                if ln.lower().startswith(("flags", "features"))
+            )
+    except OSError:
+        flags = ""
+    flags = flags or platform.processor() or platform.machine()
+    _cc_dir += "-" + hashlib.sha256(str(flags).encode()).hexdigest()[:12]
 if _cc_dir:
     jax.config.update("jax_compilation_cache_dir", _cc_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

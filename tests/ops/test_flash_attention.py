@@ -320,3 +320,81 @@ class TestSlidingWindowKernel:
             flash_attention(q, k, v, causal=False, window=4, interpret=True)
         with pytest.raises(ValueError, match=">= 1"):
             flash_attention(q, k, v, window=0, interpret=True)
+
+
+class TestCompactGridSpans:
+    """The compact grid's span math must cover exactly the blocks
+    _block_needed marks needed (zero offsets) — a missed block is a
+    silently wrong output, an extra block only wasted DMA. Exhaustive
+    check over block/window geometries."""
+
+    @pytest.mark.parametrize("blk_q,blk_k,window", [
+        (8, 8, None), (8, 16, None), (16, 8, None),
+        (8, 8, 5), (8, 16, 12), (16, 8, 3), (8, 32, 9), (32, 8, 40),
+    ])
+    def test_kv_span_covers_needed_blocks(self, blk_q, blk_k, window):
+        from nos_tpu.ops.flash_attention import (
+            _block_needed,
+            _compact_kv_steps,
+            _kv_block_span,
+        )
+
+        s = 128
+        n_q, n_k = s // blk_q, s // blk_k
+        steps = _compact_kv_steps(n_k, blk_q, blk_k, window)
+        for qi in range(n_q):
+            lo, hi = jax.tree.map(int, _kv_block_span(qi, blk_q, blk_k, window))
+            visited = {min(lo + t, hi) for t in range(steps) if lo + t <= hi}
+            needed = {
+                ki for ki in range(n_k)
+                if bool(_block_needed(
+                    blk_q, blk_k, qi * blk_q, ki * blk_k, True, window
+                ))
+            }
+            assert needed <= visited, (
+                f"qi={qi}: needed {sorted(needed)} not covered by "
+                f"visited {sorted(visited)} (lo={lo} hi={hi} steps={steps})"
+            )
+            # clamped duplicates beyond hi never enter the span
+            assert all(lo <= b_ <= hi for b_ in visited)
+
+    @pytest.mark.parametrize("blk_q,blk_k,window", [
+        (8, 8, None), (8, 16, 12), (16, 8, 3), (8, 32, 9), (32, 8, 40),
+    ])
+    def test_q_span_covers_needed_blocks(self, blk_q, blk_k, window):
+        from nos_tpu.ops.flash_attention import (
+            _block_needed,
+            _compact_q_steps,
+            _q_block_span,
+        )
+
+        s = 128
+        n_q, n_k = s // blk_q, s // blk_k
+        steps = _compact_q_steps(n_q, blk_q, blk_k, window)
+        for kb in range(n_k):
+            lo, hi = jax.tree.map(
+                int, _q_block_span(kb, blk_q, blk_k, window, n_q)
+            )
+            visited = {min(lo + t, hi) for t in range(steps) if lo + t <= hi}
+            needed = {
+                qi for qi in range(n_q)
+                if bool(_block_needed(
+                    blk_q, blk_k, qi * blk_q, kb * blk_k, True, window
+                ))
+            }
+            assert needed <= visited, (
+                f"kb={kb}: needed {sorted(needed)} not covered by "
+                f"visited {sorted(visited)}"
+            )
+
+    def test_traced_offsets_disable_compact(self):
+        """Block partials (ring attention) pass traced offsets; the
+        compact precondition (zero global offsets) must gate off."""
+        from nos_tpu.ops.flash_attention import _static_zero
+
+        assert _static_zero(0)
+        assert not _static_zero(64)
+        assert _static_zero(jnp.asarray(0))  # concrete zero IS static
+        seen = []
+        jax.jit(lambda off: seen.append(_static_zero(off)))(jnp.asarray(0))
+        assert seen == [False]  # a tracer can never qualify
