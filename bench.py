@@ -477,7 +477,8 @@ def run_tpu_child() -> None:
                     f"single-stream, cold start {cold_s}s)")
                 snapshot()
 
-            bench_engine(4, 8, "serve")
+            slots, n_req = 4, 8
+            bench_engine(slots, n_req, "serve")
             # Slot scaling: decode shares each weight read across rows,
             # so doubling slots should nearly double aggregate tokens/s
             # until KV-cache bandwidth catches up.

@@ -94,9 +94,14 @@ class Engine:
         prefill_chunk: int = 256,
         seed: int = 0,
         prefix_cache_entries: int = 0,
+        mesh=None,
     ) -> None:
         self.params = params
         self.config = config
+        # Tensor-parallel serving (serve/sharded.py): params arrive
+        # sharded (shard_for_serving) and the KV cache shards its head
+        # axis here; everything else is ordinary SPMD propagation.
+        self.mesh = mesh
         self.slots_n = max_slots
         self.max_len = max_len
         self.ticks_per_sync = max(1, ticks_per_sync)
@@ -115,10 +120,20 @@ class Engine:
 
         self._prefix_cache: "OrderedDict[tuple, list]" = OrderedDict()
         c = config
+        if mesh is not None:
+            from nos_tpu.serve.sharded import kv_cache_sharding
+
+            ns = kv_cache_sharding(mesh, config)
+            # device= allocates each shard in place — a cache sized to
+            # the whole mesh must never materialize unsharded on one chip
+            def _zeros(shape, dtype):
+                return jnp.zeros(shape, dtype, device=ns)
+        else:
+            _zeros = jnp.zeros
         self._cache = [
             {
-                "k": jnp.zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
-                "v": jnp.zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
+                "k": _zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
+                "v": _zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
             }
             for _ in range(c.n_layers)
         ]

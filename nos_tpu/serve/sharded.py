@@ -1,0 +1,91 @@
+"""Tensor-parallel serving: one Engine spanning a tp device mesh.
+
+A carved multi-chip slice (the partitioner's product) serves one model
+replica larger or faster than a single chip allows. The engine's host
+scheduling loop is unchanged — tensor parallelism enters purely through
+array placement: params shard Megatron-style (parallel/sharding.py) and
+the KV cache shards its HEAD axis over tp, so every attention head's
+cache row lives with the chips that compute it. XLA inserts the one
+per-layer psum on the residual path from the NamedShardings; decode,
+prefill, splice, and sampling all run SPMD with zero code changes in
+the engine (the reference has no serving stack — SURVEY.md §5 maps the
+workload layer to the TPU build's own ground).
+
+Usage::
+
+    mesh = mesh_from_devices((tp,), ("tp",), jax.devices()[:tp])
+    params = shard_for_serving(params, mesh, config)
+    eng = Engine(params, config, mesh=mesh, ...)
+
+Works with dense bf16 trees and int8/int4 quantized trees
+(quantize_params / quantize_params_int4) alike.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from nos_tpu.models.llama import LlamaConfig
+
+
+def kv_cache_sharding(mesh: Mesh, config: LlamaConfig) -> NamedSharding:
+    """KV cache rows [slots, max_len, Hkv, hd] shard the head axis over
+    tp — attention is head-local, so cache reads/writes never cross
+    chips. tp must divide the KV head count (GQA replicates query heads
+    onto their KV shard automatically via the wq sharding)."""
+    from nos_tpu.parallel.mesh import partition_spec
+
+    tp = mesh.shape.get("tp", 1)
+    if config.n_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_kv_heads={config.n_kv_heads} "
+            f"(head-sharded KV cache)"
+        )
+    return NamedSharding(mesh, partition_spec(mesh, None, None, "tp", None))
+
+
+def _is_quantized(params: Dict[str, Any]) -> bool:
+    from nos_tpu.models.quantize import (
+        QuantizedEmbedding,
+        QuantizedLinear,
+        QuantizedLinear4,
+    )
+
+    return isinstance(
+        params.get("embed"),
+        (QuantizedLinear, QuantizedLinear4, QuantizedEmbedding),
+    )
+
+
+def shard_for_serving(
+    params: Dict[str, Any], mesh: Mesh, config: LlamaConfig
+) -> Dict[str, Any]:
+    """device_put the param tree with its serving sharding: dense trees
+    use the Megatron rules, quantized trees the scale-aware rules (the
+    int4 group size is read off the tree so packing and placement can't
+    disagree)."""
+    from nos_tpu.models.quantize import QuantizedLinear4
+    from nos_tpu.parallel.sharding import (
+        llama_param_sharding,
+        llama_quantized_sharding,
+    )
+
+    if _is_quantized(params):
+        q4 = [
+            leaf
+            for leaf in jax.tree.leaves(
+                params, is_leaf=lambda x: isinstance(x, QuantizedLinear4)
+            )
+            if isinstance(leaf, QuantizedLinear4)
+        ]
+        if q4:
+            sharding = llama_quantized_sharding(
+                mesh, config, bits=4, group=q4[0].group
+            )
+        else:
+            sharding = llama_quantized_sharding(mesh, config, bits=8)
+    else:
+        sharding = llama_param_sharding(mesh, config)
+    return jax.device_put(params, sharding)
