@@ -105,6 +105,11 @@ class Engine:
         self.slots_n = max_slots
         self.max_len = max_len
         self.ticks_per_sync = max(1, ticks_per_sync)
+        # Tokens a slot is guaranteed per inner dispatch — what
+        # _sync_horizon divides budgets by. Subclasses with a different
+        # decode round (SpecEngine: k+1 per speculative round) override
+        # this instead of the horizon policy.
+        self._tokens_per_sync = self.ticks_per_sync
         # Prompts whose bucket exceeds this ingest via fixed-size
         # decode_chunk pieces (O(chunk x T) peak attention memory instead
         # of the one-shot prefill's O(bucket^2)).
@@ -265,8 +270,10 @@ class Engine:
 
     # ---------------------------------------------------------- frontend
 
-    def submit(self, request: GenRequest) -> int:
-        request.id = next(self._ids)
+    def _validate_submit(self, request: GenRequest, need: int) -> None:
+        """Shared submit-time contract: degenerate requests fail loudly
+        here, never mid-batch. ``need`` is the engine-specific worst-case
+        physical frontier the request can reach before its slot frees."""
         if not request.prompt:
             # an empty prompt has no admission logits: the chunked path
             # would crash mid-run and the padded path would emit garbage
@@ -276,12 +283,20 @@ class Engine:
             # honored as a budget
             raise ValueError("max_new_tokens must be >= 1")
         if len(request.prompt) > self.max_len:
-            # _bucket clamps to max_len, so the chunk math below would
+            # _bucket clamps to max_len, so downstream chunk math would
             # wave an over-long prompt through and crash mid-run instead.
             raise ValueError(
                 f"prompt length {len(request.prompt)} > engine max_len "
                 f"{self.max_len}"
             )
+        if need > self.max_len:
+            raise ValueError(
+                f"request needs {need} cache slots > engine max_len "
+                f"{self.max_len}"
+            )
+
+    def submit(self, request: GenRequest) -> int:
+        request.id = next(self._ids)
         # Decode advances in whole chunks; a slot's physical frontier can
         # reach the admission frontier + ceil((max_new-1)/ticks)*ticks
         # before it frees. The admission frontier is the pow2 bucket on
@@ -293,12 +308,7 @@ class Engine:
         bucket = self._bucket(len(request.prompt))
         chunked = bucket > self.prefill_chunk or self.config.sliding_window is not None
         frontier = len(request.prompt) if chunked else bucket
-        need = frontier + chunks * t
-        if need > self.max_len:
-            raise ValueError(
-                f"request needs {need} cache slots (bucketed prompt + "
-                f"chunked decode) > engine max_len {self.max_len}"
-            )
+        self._validate_submit(request, frontier + chunks * t)
         self._queue.append(request)
         metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         return request.id
@@ -329,7 +339,7 @@ class Engine:
         ``pending``: slots whose admission first-token is deferred into
         this round's pull — already spent from the budget, not yet in
         ``out``."""
-        t = self.ticks_per_sync
+        t = self._tokens_per_sync
         horizons = []
         for b, s in enumerate(self._slots):
             if s is None or s.done:
@@ -429,18 +439,9 @@ class Engine:
                     metrics.SERVE_PREFIX_TOKENS_REUSED.inc(boundary)
                     break
                 boundary -= n
-        for start in range(resume, length, n):
-            piece = prompt[start:start + n]
-            real = len(piece)
-            piece = piece + [0] * (n - real)
-            mask = jnp.asarray([[True] * real + [False] * (n - real)])
-            logits, row_cache = self._ingest(
-                self.params,
-                row_cache,
-                jnp.asarray([start], jnp.int32),
-                jnp.asarray([piece], jnp.int32),
-                mask,
-            )
+        logits, row_cache = self._ingest_pieces(
+            self._ingest, self.params, row_cache, prompt, n, resume
+        )
         if self.prefix_cache_entries > 0:
             store_at = ((length - 1) // n) * n
             if store_at > 0:
@@ -464,6 +465,27 @@ class Engine:
             (b, self._first_token(b, request, argmax=first,
                                   raw=logits[0, last_idx][None]))
         )
+
+    @staticmethod
+    def _ingest_pieces(ingest, params, row_cache, prompt, n, resume=0):
+        """THE prompt-chunking loop: slice n-token pieces from ``resume``,
+        RIGHT-pad the final piece with its writes masked to the row
+        cache's sacrificial trailing slot. Target and draft (SpecEngine)
+        ingestion share this so their piece math can never diverge."""
+        logits = None
+        for start in range(resume, len(prompt), n):
+            piece = prompt[start:start + n]
+            real = len(piece)
+            piece = piece + [0] * (n - real)
+            mask = jnp.asarray([[True] * real + [False] * (n - real)])
+            logits, row_cache = ingest(
+                params,
+                row_cache,
+                jnp.asarray([start], jnp.int32),
+                jnp.asarray([piece], jnp.int32),
+                mask,
+            )
+        return logits, row_cache
 
     def _set_sampling(self, b: int, request: GenRequest) -> None:
         self._temp[b] = request.temperature

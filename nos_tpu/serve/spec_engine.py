@@ -1,0 +1,203 @@
+"""Speculative continuous batching: draft lookahead inside the engine.
+
+Combines the two serving accelerators: continuous batching (all slots
+share each target weight read) and speculative decoding (each target
+read commits up to k+1 tokens per row). Every scheduling round runs ONE
+jitted speculative round over the whole batch — the draft scans k
+cheap steps, the target verifies the chain in one ``decode_chunk``, and
+per-row acceptance advances each slot at its own pace (models/
+speculative.py holds the round math; this module gives it slots,
+admission, and the sync-horizon chaining of serve/engine.py).
+
+Differences from the base Engine, all forced by the round math:
+- Admission is ALWAYS chunked (physical == logical positions; the
+  speculative round has no left-pad notion), and each admission also
+  ingests the prompt into a per-slot DRAFT KV cache — the draft cache
+  invariant (holds every committed token but the last) starts true.
+- Greedy only: speculative acceptance is defined against the target's
+  argmax. ``temperature > 0`` is rejected at submit.
+- A slot's physical frontier can overshoot its budget by up to k per
+  round, so capacity is prompt + budget + k + 1 (enforced at submit);
+  finished riders clamp at max_len - k - 1 exactly like
+  ``speculative_generate``.
+
+The per-round accepted counts are data-dependent, so the host cannot
+mirror positions arithmetically: each horizon's single pull returns the
+device positions alongside the committed tokens.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nos_tpu.models.generate import decode_chunk, init_kv_cache
+from nos_tpu.models.llama import LlamaConfig
+from nos_tpu.models.speculative import _spec_round
+from nos_tpu.serve.engine import Engine, GenRequest
+from nos_tpu.util import metrics
+
+
+class SpecEngine(Engine):
+    """Engine whose decode path is speculative rounds over a draft model.
+
+    ``run``/``submit``/``step`` keep the base contracts; completions are
+    the TARGET's greedy tokens (up to chunk-vs-step float drift on
+    near-tied argmaxes — the speculative contract), so a good draft only
+    adds speed and a bad one only costs it. ``stats()`` reports rounds
+    and mean accepted drafts per active row-round."""
+
+    def __init__(
+        self,
+        params,
+        config: LlamaConfig,
+        draft_params,
+        draft_config: LlamaConfig,
+        k: int = 4,
+        **kwargs,
+    ) -> None:
+        super().__init__(params, config, **kwargs)
+        self.d_params = draft_params
+        self.d_config = draft_config
+        self.k = k
+        # One speculative round commits 1..k+1 tokens per row;
+        # _sync_horizon chains the GUARANTEED round count, so the
+        # divisor is the full-acceptance commit size.
+        self._tokens_per_sync = k + 1
+        # The deepest draft write (the d_k ingest at pos+k) lands at
+        # max_len-1: live rows by the submit-time capacity check, riders
+        # by step()'s clamp to max_len-k-1. Same length as the target's.
+        self._d_cache = init_kv_cache(draft_config, self.slots_n, self.max_len)
+        self._round = jax.jit(
+            _spec_round(params, draft_params, config, draft_config, k),
+            donate_argnums=(0, 1),
+        )
+
+        def _d_ingest(d_params, row_cache, start, piece, mask):
+            return decode_chunk(
+                d_params, row_cache, start, piece, draft_config,
+                write_mask=mask,
+            )
+
+        self._d_ingest = jax.jit(_d_ingest, donate_argnums=(1,))
+
+        def _d_splice(cache, row_cache, b):
+            return [
+                {
+                    key: jax.lax.dynamic_update_slice(
+                        layer[key],
+                        row[key][:, : self.max_len],
+                        (b, 0, 0, 0),
+                    )
+                    for key in ("k", "v")
+                }
+                for layer, row in zip(cache, row_cache)
+            ]
+
+        self._d_splice = jax.jit(_d_splice, donate_argnums=(0,))
+        self.rounds = 0
+        self._accepted_total = 0
+        self._active_row_rounds = 0
+
+    # ---------------------------------------------------------- frontend
+
+    def submit(self, request: GenRequest) -> int:
+        if request.temperature > 0:
+            raise ValueError(
+                "speculative acceptance is defined against the target's "
+                "argmax; sampling requests need the base Engine"
+            )
+        request.id = next(self._ids)
+        self._validate_submit(
+            request, len(request.prompt) + request.max_new_tokens + self.k + 1
+        )
+        self._queue.append(request)
+        metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
+        return request.id
+
+    def stats(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "mean_accepted": self._accepted_total
+            / max(1, self._active_row_rounds),
+        }
+
+    # -------------------------------------------------------- admission
+
+    def _admit(self, b: int, request: GenRequest) -> None:
+        # Chunked target admission (physical == logical, prefix cache
+        # applies); then the SAME prompt ingests into the draft row
+        # through the shared piece loop.
+        self._admit_chunked(b, request)
+        prompt = list(request.prompt)
+        n = min(self.prefill_chunk, self._bucket(len(prompt)))
+        row = init_kv_cache(self.d_config, 1, self.max_len + 1)
+        _, row = self._ingest_pieces(
+            self._d_ingest, self.d_params, row, prompt, n
+        )
+        self._d_cache = self._d_splice(
+            self._d_cache, row, jnp.asarray(b, jnp.int32)
+        )
+
+    # ------------------------------------------------------------- tick
+
+    def step(self, chunks: "int | None" = 1) -> None:
+        for b in range(self.slots_n):
+            if self._slots[b] is None and self._queue:
+                self._admit(b, self._queue.pop(0))
+        # Speculative rounds sync every horizon anyway (counts are
+        # data-dependent); admission firsts always resolve eagerly.
+        self._resolve_admissions()
+        for b in range(self.slots_n):
+            self._retire(b)
+        if not any(s is not None for s in self._slots):
+            return
+        rounds = self._sync_horizon() if chunks is None else max(1, chunks)
+        self.ticks += rounds
+        self.rounds += rounds
+        pos = jnp.asarray(self._pos)
+        last = jnp.asarray(self._last)
+        outs: List[jax.Array] = []
+        counts: List[jax.Array] = []
+        for _ in range(rounds):
+            # Finished riders advance up to k+1 per round; the clamp
+            # keeps their chunk writes in-bounds (live rows never reach
+            # it by the submit-time capacity check).
+            pos = jnp.minimum(pos, self.max_len - self.k - 1)
+            (self._cache, self._d_cache, pos, last,
+             _, out, count) = self._round(
+                self._cache, self._d_cache, pos, last
+            )
+            outs.append(out)
+            counts.append(count)
+        pulled = jax.device_get([pos, last] + outs + counts)
+        pos_np, last_np = pulled[0], pulled[1]
+        outs_np = pulled[2:2 + rounds]
+        counts_np = pulled[2 + rounds:]
+        live = [b for b in range(self.slots_n) if self._slots[b] is not None]
+        metrics.SERVE_TICKS.inc(rounds)
+        metrics.SERVE_SLOT_TICKS_ACTIVE.inc(rounds * len(live))
+        metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
+        self._pos = pos_np.astype(np.int32).copy()
+        self._rope = self._pos.copy()  # chunked path: logical == physical
+        self._last = last_np.astype(np.int32).copy()
+        for r in range(rounds):
+            for b in live:
+                slot = self._slots[b]
+                if slot.done:
+                    continue
+                self._active_row_rounds += 1
+                committed = int(counts_np[r][b])
+                self._accepted_total += committed - 1
+                for j in range(committed):
+                    if slot.done:
+                        break
+                    self._emit(b, int(outs_np[r][b, j]))
+        for b in live:
+            self._retire(b)
+        for b in range(self.slots_n):
+            if self._slots[b] is None:
+                self._pos[b] = 0
+                self._rope[b] = 0

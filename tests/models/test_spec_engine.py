@@ -1,0 +1,118 @@
+"""Speculative continuous batching vs the plain engine oracle.
+
+The contract stacks both invisibilities: batching must be invisible
+(any slot mix yields each request's solo tokens) AND speculation must
+be invisible (committed tokens are the TARGET's greedy stream — the
+draft only changes speed). So every SpecEngine completion is compared
+against the base Engine on the same target params; f32 keeps
+chunk-vs-step reduction drift far below any argmax gap.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.serve import Engine, GenRequest, SpecEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = tiny_config(dtype=jnp.float32)
+    target = init_llama_params(jax.random.key(0), config)
+    draft_cfg = tiny_config(n_layers=1, dtype=jnp.float32)
+    draft = init_llama_params(jax.random.key(7), draft_cfg)
+    return config, target, draft_cfg, draft
+
+
+def rand_prompt(key, n, vocab):
+    return np.asarray(jax.random.randint(key, (n,), 1, vocab)).tolist()
+
+
+def run_workload(eng, reqs):
+    ids = [eng.submit(GenRequest(**r)) for r in reqs]
+    got = eng.run()
+    return [got[rid] for rid in ids]
+
+
+class TestSpecEngine:
+    def test_matches_plain_engine_mixed_workload(self, setup):
+        config, target, draft_cfg, draft = setup
+        reqs = [
+            dict(prompt=rand_prompt(jax.random.key(200 + i), n, config.vocab_size),
+                 max_new_tokens=m)
+            for i, (n, m) in enumerate(((5, 9), (17, 4), (8, 12), (3, 7), (11, 6)))
+        ]
+        base = Engine(target, config, max_slots=2, max_len=64, ticks_per_sync=4)
+        want = run_workload(base, [dict(r) for r in reqs])
+        spec = SpecEngine(target, config, draft, draft_cfg, k=3,
+                          max_slots=2, max_len=64)
+        got = run_workload(spec, [dict(r) for r in reqs])
+        assert got == want
+        st = spec.stats()
+        assert st["rounds"] > 0 and 0.0 <= st["mean_accepted"] <= 3.0
+
+    def test_perfect_draft_accepts_everything(self, setup):
+        config, target, _, _ = setup
+        p = rand_prompt(jax.random.key(210), 6, config.vocab_size)
+        spec = SpecEngine(target, config, target, config, k=4,
+                          max_slots=1, max_len=64)
+        rid = spec.submit(GenRequest(prompt=p, max_new_tokens=11))
+        got = spec.run()[rid]
+        base = Engine(target, config, max_slots=1, max_len=64)
+        rid2 = base.submit(GenRequest(prompt=p, max_new_tokens=11))
+        assert got == base.run()[rid2]
+        # target-as-draft: every draft matches, so acceptance is k
+        assert spec.stats()["mean_accepted"] == pytest.approx(4.0, abs=1.0)
+
+    def test_eos_mid_round_trims(self, setup):
+        config, target, draft_cfg, draft = setup
+        p = rand_prompt(jax.random.key(220), 7, config.vocab_size)
+        base = Engine(target, config, max_slots=1, max_len=64)
+        rid = base.submit(GenRequest(prompt=p, max_new_tokens=12))
+        free = base.run()[rid]
+        cut = next(i for i in range(2, 12) if free[i] not in free[:i])
+        spec = SpecEngine(target, config, draft, draft_cfg, k=3,
+                          max_slots=1, max_len=64)
+        rid = spec.submit(
+            GenRequest(prompt=p, max_new_tokens=12, eos_id=free[cut])
+        )
+        assert spec.run()[rid] == free[:cut + 1]
+
+    def test_slot_reuse_staggered(self, setup):
+        config, target, draft_cfg, draft = setup
+        p1 = rand_prompt(jax.random.key(230), 4, config.vocab_size)
+        p2 = rand_prompt(jax.random.key(231), 9, config.vocab_size)
+        p3 = rand_prompt(jax.random.key(232), 6, config.vocab_size)
+        base = Engine(target, config, max_slots=2, max_len=64)
+        b1 = base.submit(GenRequest(prompt=p1, max_new_tokens=3))
+        b2 = base.submit(GenRequest(prompt=p2, max_new_tokens=10))
+        b3 = base.submit(GenRequest(prompt=p3, max_new_tokens=5))
+        want = base.run()
+        spec = SpecEngine(target, config, draft, draft_cfg, k=2,
+                          max_slots=2, max_len=64)
+        s1 = spec.submit(GenRequest(prompt=p1, max_new_tokens=3))
+        s2 = spec.submit(GenRequest(prompt=p2, max_new_tokens=10))
+        spec.step()  # first round; third request arrives mid-flight
+        s3 = spec.submit(GenRequest(prompt=p3, max_new_tokens=5))
+        got = spec.run()
+        assert [got[s1], got[s2], got[s3]] == [want[b1], want[b2], want[b3]]
+
+    def test_sampling_rejected(self, setup):
+        config, target, draft_cfg, draft = setup
+        spec = SpecEngine(target, config, draft, draft_cfg,
+                          max_slots=1, max_len=64)
+        with pytest.raises(ValueError, match="argmax"):
+            spec.submit(GenRequest(prompt=[3], max_new_tokens=4,
+                                   temperature=0.5))
+
+    def test_capacity_accounts_for_overshoot(self, setup):
+        config, target, draft_cfg, draft = setup
+        spec = SpecEngine(target, config, draft, draft_cfg, k=4,
+                          max_slots=1, max_len=32)
+        # 20 + 8 + 4 + 1 = 33 > 32: must reject at submit
+        with pytest.raises(ValueError, match="cache slots"):
+            spec.submit(GenRequest(prompt=[1] * 20, max_new_tokens=8))
+        # 18 + 8 + 4 + 1 = 31 <= 32: fits, and completes
+        rid = spec.submit(GenRequest(prompt=[1] * 18, max_new_tokens=8))
+        assert len(spec.run()[rid]) == 8
