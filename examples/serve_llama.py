@@ -5,9 +5,12 @@ Demonstrates the pieces working together on whatever backend is present
 
   1. int8 weight-only quantization (halved HBM; decode is
      weight-bandwidth-bound, so bytes read through to tokens/s),
-  2. tensor-parallel sharding of the quantized weights over a mesh,
+  2. tensor-parallel sharding of the quantized weights over a mesh
+     (Engine(mesh=...) + shard_for_serving: head-sharded KV cache),
   3. the continuous-batching Engine multiplexing mixed-length requests,
-  4. one-off sampled generation with top-k / nucleus filtering.
+  4. speculative continuous batching (SpecEngine: a truncated draft
+     verifies k tokens per target read),
+  5. one-off sampled generation with top-k / nucleus filtering.
 
 Run:  python examples/serve_llama.py  [--real-weights /path/to/hf]
 (NOS_EXAMPLE_PLATFORM=tpu for real chips; default is the CPU backend.)
@@ -56,9 +59,20 @@ def main() -> None:
         f"({weight_bytes(params)/dense_bytes:.2f}x of bf16)"
     )
 
+    mesh = None
+    engine_params = params
+    if len(jax.devices()) > 1 and config.n_kv_heads % 2 == 0:
+        from nos_tpu.parallel.mesh import mesh_from_devices
+        from nos_tpu.serve import shard_for_serving
+
+        mesh = mesh_from_devices((2,), ("tp",), jax.devices()[:2])
+        engine_params = shard_for_serving(params, mesh, config)
+        print("tensor-parallel over 2 devices "
+              "(Megatron params + head-sharded KV cache)")
+
     engine = Engine(
-        params, config, max_slots=args.slots, max_len=args.max_len,
-        prefill_chunk=16, prefix_cache_entries=4,
+        engine_params, config, max_slots=args.slots, max_len=args.max_len,
+        prefill_chunk=16, prefix_cache_entries=4, mesh=mesh,
     )
     rng = jax.random.key(0)
     # Requests share a "system prompt": with prefix caching on, only the
@@ -80,6 +94,28 @@ def main() -> None:
     print(f"engine: {len(ids)} requests, {total} tokens in {wall:.2f}s "
           f"({total/wall:.1f} tok/s across {args.slots} slots, "
           f"{int(m.SERVE_PREFIX_HITS.value)} prefix-cache hits)")
+
+    # Speculative continuous batching: a 1-layer truncation of the
+    # target drafts k tokens per round; acceptance is exact, so the
+    # stats line is the whole story (a real deployment uses a distilled
+    # draft checkpoint).
+    if not args.real_weights:
+        from nos_tpu.serve import SpecEngine
+
+        draft_cfg = tiny_config(n_layers=1)
+        draft = init_llama_params(jax.random.key(1), draft_cfg)
+        spec = SpecEngine(
+            params, config, draft, draft_cfg, k=4,
+            max_slots=2, max_len=args.max_len,
+        )
+        for _ in range(4):
+            rng, sub = jax.random.split(rng)
+            prompt = jax.random.randint(sub, (12,), 1, config.vocab_size).tolist()
+            spec.submit(GenRequest(prompt=prompt, max_new_tokens=16))
+        spec.run()
+        st = spec.stats()
+        print(f"speculative engine: {st['rounds']} rounds, "
+              f"mean accepted {st['mean_accepted']:.2f}/4 drafts per round")
 
     sampled = generate(
         params,
