@@ -348,3 +348,21 @@ class TestPrefixCache:
         rid = eng.submit(GenRequest(prompt=p, max_new_tokens=3))
         assert eng.run()[rid] == solo(params, config, p, 3)
         assert not eng._prefix_cache
+
+
+class TestMoEServing:
+    def test_moe_params_match_solo_generation(self, setup):
+        """Routed-MoE checkpoints serve through the slot engine: decode
+        dispatches each block's FFN to the mixture, and the tokens must
+        equal a solo generate() run on the same params (f32 keeps the
+        routing argmaxes clear of reduction-order drift)."""
+        config = tiny_config(n_experts=4, dtype=jnp.float32)
+        params = init_llama_params(jax.random.key(3), config)
+        eng = Engine(params, config, max_slots=2, max_len=64,
+                     ticks_per_sync=4)
+        p = rand_prompt(jax.random.key(4), 6, config.vocab_size)
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=6))
+        rid2 = eng.submit(GenRequest(prompt=p[:3], max_new_tokens=4))
+        got = eng.run()
+        assert got[rid] == solo(params, config, p, 6)
+        assert got[rid2] == solo(params, config, p[:3], 4)

@@ -116,3 +116,28 @@ class TestSpecEngine:
         # 18 + 8 + 4 + 1 = 31 <= 32: fits, and completes
         rid = spec.submit(GenRequest(prompt=[1] * 18, max_new_tokens=8))
         assert len(spec.run()[rid]) == 8
+
+
+class TestSpecEngineComposition:
+    def test_tp_sharded_spec_engine_parity(self, setup):
+        """Speculation composes with tensor parallelism: sharded target
+        AND draft trees, head-sharded target cache (the draft cache
+        stays replicated — the draft is small by design). Tokens must
+        match the unsharded SpecEngine exactly."""
+        from nos_tpu.parallel.mesh import mesh_from_devices
+        from nos_tpu.serve import shard_for_serving
+
+        config, target, draft_cfg, draft = setup
+        p = rand_prompt(jax.random.key(240), 6, config.vocab_size)
+        base = SpecEngine(target, config, draft, draft_cfg, k=3,
+                          max_slots=2, max_len=64)
+        r0 = base.submit(GenRequest(prompt=p, max_new_tokens=8))
+        want = base.run()[r0]
+        mesh = mesh_from_devices((2,), ("tp",), jax.devices()[:2])
+        spec = SpecEngine(
+            shard_for_serving(target, mesh, config), config,
+            shard_for_serving(draft, mesh, draft_cfg), draft_cfg,
+            k=3, max_slots=2, max_len=64, mesh=mesh,
+        )
+        r1 = spec.submit(GenRequest(prompt=p, max_new_tokens=8))
+        assert spec.run()[r1] == want
