@@ -49,13 +49,19 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Cache:
     ]
 
 
-def _ffn(h: jax.Array, layer: Params, config: LlamaConfig) -> jax.Array:
+def _ffn(
+    h: jax.Array, layer: Params, config: LlamaConfig, token_mask=None
+) -> jax.Array:
     """Dense MLP or routed MoE, matching llama_forward's block dispatch so
-    MoE checkpoints serve through the same cache path."""
+    MoE checkpoints serve through the same cache path. ``token_mask``
+    keeps padding columns out of the MoE capacity race (a dense MLP is
+    per-token, so pads can't affect neighbors there)."""
     if "moe" in layer:
         from nos_tpu.models.moe import moe_mlp
 
-        return moe_mlp(layer["moe"], h, config.moe_config())
+        return moe_mlp(
+            layer["moe"], h, config.moe_config(), token_mask=token_mask
+        )
     return _mlp(h, layer, config.hidden_act)
 
 
@@ -181,7 +187,10 @@ def prefill(
                 b, s, c.n_heads * hd
             )
         x = x + _mm(attn, layer["wo"])
-        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset), layer, c)
+        x = x + _ffn(
+            _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset),
+            layer, c, token_mask=token_valid,
+        )
     x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
     return _unembed(params, x).astype(jnp.float32), cache
 
@@ -194,6 +203,7 @@ def decode_step(
     config: LlamaConfig,
     rope_pos: jax.Array = None,
     key_valid: jax.Array = None,
+    row_valid: jax.Array = None,
 ) -> Tuple[jax.Array, Cache]:
     """One token at (traced) physical cache slot ``pos`` → (logits
     [B, vocab], cache with K/V written at pos).
@@ -205,11 +215,20 @@ def decode_step(
 
     ``pos`` may also be per-row [B] (continuous batching: every slot
     decodes at its own depth) — K/V writes become row scatters and the
-    attention frontier is per-row; rope defaults to ``pos`` itself."""
+    attention frontier is per-row; rope defaults to ``pos`` itself.
+
+    ``row_valid`` [B] marks rows carrying a REAL token (continuous
+    batching: idle/ridden slots are garbage); masked rows are kept out
+    of the MoE expert-capacity race so a dead row can never displace a
+    live one. Defaults to "has any valid key" when ``key_valid`` is
+    given (the engine zeroes a retired row's key_valid)."""
     c = config
     b = token.shape[0]
     hd = c.head_dim
     per_row = getattr(pos, "ndim", 0) == 1
+    if row_valid is None and key_valid is not None:
+        row_valid = jnp.any(key_valid, axis=1)
+    ffn_mask = None if row_valid is None else row_valid[:, None]
     x = _embed_rows(params["embed"], token, c.dtype, c.embed_scale)[:, None, :]  # [B, 1, D]
     if rope_pos is None and per_row:
         rope_pos = pos
@@ -243,7 +262,10 @@ def decode_step(
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
         x = x + _mm(attn, layer["wo"])
-        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset), layer, c)
+        x = x + _ffn(
+            _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset),
+            layer, c, token_mask=ffn_mask,
+        )
     x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
     return _unembed(params, x[:, 0]).astype(jnp.float32), new_cache
 
@@ -255,6 +277,7 @@ def decode_chunk(
     tokens: jax.Array,
     config: LlamaConfig,
     write_mask: jax.Array = None,
+    row_valid: jax.Array = None,
 ) -> Tuple[jax.Array, Cache]:
     """``m`` tokens at per-row physical slots ``pos``..``pos+m-1`` →
     (logits [B, m, vocab], cache with the chunk's K/V written).
@@ -266,9 +289,14 @@ def decode_chunk(
     sequential O(T) steps.
 
     ``pos`` is [B] (per-row, like the engine's decode). ``write_mask``
-    [B, m] skips K/V writes for padding positions by redirecting them to
-    the cache's LAST slot — callers using it must size the cache with a
-    sacrificial trailing slot their frontier never reaches.
+    [B, m] marks PADDING positions: their K/V writes redirect to the
+    cache's LAST slot (callers must size the cache with a sacrificial
+    trailing slot their frontier never reaches) AND, on MoE models,
+    they claim no expert capacity and emit zero from the mixture — pads
+    must be invisible to real tokens in every sense, not just the
+    cache. ``row_valid`` [B] additionally masks WHOLE rows from the MoE
+    capacity race (continuous batching: finished slots riding the
+    chunk).
     """
     c = config
     b, m = tokens.shape
@@ -286,6 +314,10 @@ def decode_chunk(
         write_pos = jnp.where(write_mask, posmat, t_cache - 1)
     else:
         write_pos = posmat
+    ffn_mask = write_mask
+    if row_valid is not None:
+        row_col = row_valid[:, None] & jnp.ones((1, m), bool)
+        ffn_mask = row_col if ffn_mask is None else (ffn_mask & row_col)
     rows = jnp.arange(b)[:, None]
     frontier = posmat + 1  # [B, m]: query i sees keys < pos+i+1
 
@@ -302,7 +334,10 @@ def decode_chunk(
         new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(q, ck, cv, frontier, c)
         x = x + _mm(attn, layer["wo"])
-        x = x + _ffn(_rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset), layer, c)
+        x = x + _ffn(
+            _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset),
+            layer, c, token_mask=ffn_mask,
+        )
     x = _rms_norm(x, params["final_norm"], c.norm_eps, c.norm_offset)
     return _unembed(params, x).astype(jnp.float32), new_cache
 

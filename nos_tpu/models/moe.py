@@ -92,6 +92,7 @@ def moe_mlp(
     config: MoeConfig,
     mesh: Optional[Any] = None,
     return_aux: bool = False,
+    token_mask: Optional[jax.Array] = None,
 ):
     """x [B, S, d] → [B, S, d] through top-k routed experts.
 
@@ -99,12 +100,18 @@ def moe_mlp(
     ``E · Σ_e f_e · P_e`` (dispatch fraction × mean router probability per
     expert) — add it to the training loss or the router collapses onto few
     experts and static capacity drops most tokens.
+
+    ``token_mask`` [B, S] excludes padding columns entirely: masked
+    tokens claim NO expert capacity (a pad must never displace a real
+    token — the serving engine's batching-invisibility contract), output
+    zero, and stay out of the aux-loss statistics.
     """
     c = config
     b, s, d = x.shape
     t = b * s
     cap = capacity_per_expert(t, c)
     flat = x.reshape(t, d)
+    tmask = None if token_mask is None else token_mask.reshape(t)
 
     # ---- routing (float32)
     logits = flat.astype(jnp.float32) @ params["router"]  # [T, E]
@@ -116,8 +123,16 @@ def moe_mlp(
     pair_e = top_e.reshape(t * c.top_k)  # [P]
     pair_w = top_p.reshape(t * c.top_k)
     onehot = jax.nn.one_hot(pair_e, c.n_experts, dtype=jnp.int32)  # [P, E]
+    pair_mask = None if tmask is None else jnp.repeat(tmask, c.top_k)
+    if pair_mask is not None:
+        # zeroed rows don't advance any expert's running count, so pads
+        # are invisible to the capacity race; their own pos collapses to
+        # 0 — the keep &= mask below discards them regardless
+        onehot = onehot * pair_mask[:, None].astype(onehot.dtype)
     pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)  # [P]
     keep = pos < cap
+    if pair_mask is not None:
+        keep = keep & pair_mask
     pos = jnp.minimum(pos, cap - 1)
 
     # ---- dispatch [E, C, d]
@@ -148,9 +163,15 @@ def moe_mlp(
     # Load-balance loss (Switch): E · Σ_e f_e·P_e with f_e the fraction of
     # tokens whose TOP-1 choice is expert e and P_e the mean router
     # probability. Uniform routing scores 1.0; collapse scores ~E.
-    top1_frac = jnp.mean(
-        jax.nn.one_hot(top_e[:, 0], c.n_experts, dtype=jnp.float32), axis=0
-    )
-    mean_prob = jnp.mean(probs, axis=0)
+    # Masked (padding) tokens are excluded from both statistics.
+    top1 = jax.nn.one_hot(top_e[:, 0], c.n_experts, dtype=jnp.float32)
+    if tmask is None:
+        top1_frac = jnp.mean(top1, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+    else:
+        w = tmask.astype(jnp.float32)[:, None]
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        top1_frac = jnp.sum(top1 * w, axis=0) / denom
+        mean_prob = jnp.sum(probs * w, axis=0) / denom
     aux = c.n_experts * jnp.sum(top1_frac * mean_prob)
     return out, aux

@@ -45,13 +45,15 @@ def _spec_round(
 ):
     """Builds the jitted one-round function (closure over static configs)."""
 
-    def round_fn(t_cache, d_cache, pos, last):
+    def round_fn(t_cache, d_cache, pos, last, row_valid=None):
         b = last.shape[0]
 
         # 1. draft k tokens (writes K/V for [last, d_1..d_{k-1}])
         def draft_tick(carry, _):
             cache, p, tok = carry
-            logits, cache = decode_step(d_params, cache, p, tok, d_config)
+            logits, cache = decode_step(
+                d_params, cache, p, tok, d_config, row_valid=row_valid
+            )
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (cache, p + 1, nxt), nxt
 
@@ -62,7 +64,9 @@ def _spec_round(
 
         # 2. target verifies the whole chain in one chunk
         chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # [B, k+1]
-        logits, t_cache = decode_chunk(t_params, t_cache, pos, chunk, t_config)
+        logits, t_cache = decode_chunk(
+            t_params, t_cache, pos, chunk, t_config, row_valid=row_valid
+        )
         targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
 
         # 3. longest matching prefix: accept while d_{i+1} == t_i
@@ -82,7 +86,10 @@ def _spec_round(
         count = accepted + 1
 
         # 4. ingest d_k's K/V so full acceptance leaves no draft-cache hole
-        _, d_cache = decode_step(d_params, d_cache, pos + k, drafts[:, -1], d_config)
+        _, d_cache = decode_step(
+            d_params, d_cache, pos + k, drafts[:, -1], d_config,
+            row_valid=row_valid,
+        )
 
         return t_cache, d_cache, pos + count, bonus, drafts, out, count
 
@@ -138,10 +145,12 @@ def speculative_generate(
         ]
         # Finished rows keep riding the batch while pos advances up to k+1
         # per round; clamp so their k+1 chunk writes stay inside max_len
-        # (active rows never reach the clamp by the max_len sizing above).
+        # (active rows never reach the clamp by the max_len sizing above),
+        # and keep them out of the MoE expert-capacity race (row_valid) —
+        # a ridden row's garbage tokens must never displace a live one.
         pos = jnp.minimum(pos, max_len - k - 1)
         t_cache, d_cache, pos, last, _, out, count = round_fn(
-            t_cache, d_cache, pos, last
+            t_cache, d_cache, pos, last, jnp.asarray(active)
         )
         rounds += 1
         out_np = np.asarray(out)

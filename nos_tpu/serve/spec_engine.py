@@ -159,6 +159,13 @@ class SpecEngine(Engine):
         self.rounds += rounds
         pos = jnp.asarray(self._pos)
         last = jnp.asarray(self._last)
+        # Idle slots must not claim MoE expert capacity (their rows are
+        # garbage); a slot finishing MID-horizon keeps its flag for the
+        # remaining chained rounds — bounded, and exact whenever
+        # capacity is overflow-free (the serving contract).
+        row_valid = jnp.asarray(
+            [s is not None and not s.done for s in self._slots]
+        )
         outs: List[jax.Array] = []
         counts: List[jax.Array] = []
         for _ in range(rounds):
@@ -168,7 +175,7 @@ class SpecEngine(Engine):
             pos = jnp.minimum(pos, self.max_len - self.k - 1)
             (self._cache, self._d_cache, pos, last,
              _, out, count) = self._round(
-                self._cache, self._d_cache, pos, last
+                self._cache, self._d_cache, pos, last, row_valid
             )
             outs.append(out)
             counts.append(count)

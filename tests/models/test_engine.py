@@ -355,8 +355,15 @@ class TestMoEServing:
         """Routed-MoE checkpoints serve through the slot engine: decode
         dispatches each block's FFN to the mixture, and the tokens must
         equal a solo generate() run on the same params (f32 keeps the
-        routing argmaxes clear of reduction-order drift)."""
-        config = tiny_config(n_experts=4, dtype=jnp.float32)
+        routing argmaxes clear of reduction-order drift). Capacity is
+        overflow-free (factor 4): static capacity depends on the call's
+        token count, so the padded-prefill and solo paths only promise
+        exact equality when no expert overflows — the documented serving
+        contract. Pad columns never claim capacity at ANY factor
+        (moe_mlp token_mask; pinned separately in test_moe.py)."""
+        config = tiny_config(
+            n_experts=4, dtype=jnp.float32, moe_capacity_factor=4.0
+        )
         params = init_llama_params(jax.random.key(3), config)
         eng = Engine(params, config, max_slots=2, max_len=64,
                      ticks_per_sync=4)
@@ -366,3 +373,17 @@ class TestMoEServing:
         got = eng.run()
         assert got[rid] == solo(params, config, p, 6)
         assert got[rid2] == solo(params, config, p[:3], 4)
+
+    def test_idle_slots_claim_no_expert_capacity(self, setup):
+        """DEFAULT capacity factor, one request in a 4-slot engine: the
+        3 idle rows decode garbage and must not compete for expert
+        capacity (decode_step derives a row mask from key_valid), so
+        the lone tenant matches solo exactly even where capacity
+        binds."""
+        config = tiny_config(n_experts=4, dtype=jnp.float32)
+        params = init_llama_params(jax.random.key(5), config)
+        p = rand_prompt(jax.random.key(6), 8, config.vocab_size)
+        eng = Engine(params, config, max_slots=4, max_len=64,
+                     ticks_per_sync=4)
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=8))
+        assert eng.run()[rid] == solo(params, config, p, 8)

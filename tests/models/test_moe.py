@@ -131,3 +131,53 @@ class TestExpertParallelTraining:
         step2, shard2 = make_train_step(mesh_ep, config)
         _, loss2 = step2(shard2(init_llama_params(jax.random.key(0), config)), tokens)
         assert abs(float(loss1) - float(loss2)) < 3e-2
+
+
+class TestTokenMask:
+    """token_mask: padding columns are invisible to the mixture — no
+    capacity claims, zero output, no aux-loss contribution."""
+
+    def test_masked_columns_output_zero_and_dont_perturb_real_tokens(self):
+        cfg = MoeConfig(d_model=16, d_ff=32, n_experts=4, top_k=2,
+                        capacity_factor=8.0, dtype=jnp.float32)
+        params = init_moe_params(jax.random.key(0), cfg)
+        h = jax.random.normal(jax.random.key(1), (1, 6, 16), jnp.float32)
+        mask = jnp.asarray([[True, True, True, True, False, False]])
+        out = moe_mlp(params, h, cfg, token_mask=mask)
+        assert jnp.all(out[0, 4:] == 0), "masked columns must output zero"
+        # overflow-free capacity: real tokens must be bit-identical to a
+        # call that never saw the pad columns
+        out_ref = moe_mlp(params, h[:, :4], cfg)
+        assert jnp.array_equal(out[0, :4], out_ref[0]), (
+            "pad columns perturbed real tokens"
+        )
+
+    def test_pads_claim_no_capacity_when_it_binds(self):
+        """With capacity 1 and pads routed FIRST (cumsum order), an
+        unmasked pad would displace the real token behind it; the mask
+        must keep the real token dispatched."""
+        cfg = MoeConfig(d_model=16, d_ff=32, n_experts=2, top_k=1,
+                        capacity_factor=0.01, dtype=jnp.float32)  # cap=1
+        params = init_moe_params(jax.random.key(2), cfg)
+        h = jax.random.normal(jax.random.key(3), (1, 3, 16), jnp.float32)
+        # duplicate column 2's embedding into cols 0/1 so all three route
+        # to the same expert; cols 0/1 are pads
+        h = h.at[:, 0].set(h[:, 2]).at[:, 1].set(h[:, 2])
+        mask = jnp.asarray([[False, False, True]])
+        out = moe_mlp(params, h, cfg, token_mask=mask)
+        unpadded = moe_mlp(params, h[:, 2:], cfg)
+        assert jnp.array_equal(out[0, 2], unpadded[0, 0]), (
+            "pad displaced the real token from expert capacity"
+        )
+        assert jnp.any(out[0, 2] != 0)
+
+    def test_aux_loss_excludes_masked_tokens(self):
+        cfg = MoeConfig(d_model=16, d_ff=32, n_experts=4, top_k=2,
+                        capacity_factor=8.0, dtype=jnp.float32)
+        params = init_moe_params(jax.random.key(4), cfg)
+        h = jax.random.normal(jax.random.key(5), (1, 6, 16), jnp.float32)
+        mask = jnp.asarray([[True] * 4 + [False] * 2])
+        _, aux_masked = moe_mlp(params, h, cfg, return_aux=True,
+                                token_mask=mask)
+        _, aux_ref = moe_mlp(params, h[:, :4], cfg, return_aux=True)
+        assert jnp.allclose(aux_masked, aux_ref, atol=1e-6)
