@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +61,13 @@ class GenRequest:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    # Streaming: called as on_token(request_id, token) for each emitted
+    # token, from the host thread at sync points. A streaming slot
+    # bounds the sync horizon (like eos_id), so bursts are at most a
+    # few ticks_per_sync chunks — tune ticks_per_sync down for lower
+    # streaming latency, up for throughput. Trimmed surplus (post-EOS /
+    # post-budget ride-along) is never delivered.
+    on_token: Optional[Callable[[int, int], None]] = None
     id: int = -1
 
 
@@ -347,12 +354,15 @@ class Engine:
             spent = len(s.out) + (1 if b in pending else 0)
             rem = max(1, s.request.max_new_tokens - spent)
             budget = -(-rem // t)
-            if s.request.eos_id is not None:
+            if s.request.eos_id is not None or s.request.on_token is not None:
                 # An EOS can land any tick; decoding the full budget
                 # blind would turn an early finish into worst-case wall
                 # time. A few chunks per sync keeps the RTT amortization
                 # while bounding post-EOS waste; with a queue behind it,
-                # every chunk matters for slot turnover.
+                # every chunk matters for slot turnover. Streaming
+                # (on_token) slots take the same bound — tokens only
+                # reach the host at syncs, so an unbounded horizon would
+                # deliver the whole completion in one terminal burst.
                 budget = min(budget, 1 if self._queue else 4)
             horizons.append(budget)
         if not horizons:
@@ -541,6 +551,8 @@ class Engine:
         slot = self._slots[b]
         slot.out.append(token)
         req = slot.request
+        if req.on_token is not None:
+            req.on_token(req.id, token)
         if len(slot.out) >= req.max_new_tokens or (
             req.eos_id is not None and token == req.eos_id
         ):

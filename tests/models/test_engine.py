@@ -387,3 +387,75 @@ class TestMoEServing:
                      ticks_per_sync=4)
         rid = eng.submit(GenRequest(prompt=p, max_new_tokens=8))
         assert eng.run()[rid] == solo(params, config, p, 8)
+
+
+class TestStreaming:
+    def test_on_token_streams_exactly_the_final_tokens(self, setup):
+        """Streamed tokens equal the returned completion — order
+        preserved, trimmed ride-along surplus never delivered — for both
+        the base engine and the speculative engine."""
+        from nos_tpu.serve import SpecEngine
+
+        config, params = setup
+        p = rand_prompt(jax.random.key(70), 6, config.vocab_size)
+        for make in (
+            lambda cb: Engine(params, config, max_slots=2, max_len=64,
+                              ticks_per_sync=4),
+            lambda cb: SpecEngine(
+                params, config, params, config, k=3,
+                max_slots=2, max_len=64,
+            ),
+        ):
+            streamed = {}
+            eng = make(None)
+            def cb(rid, tok):
+                streamed.setdefault(rid, []).append(tok)
+            r1 = eng.submit(GenRequest(prompt=p, max_new_tokens=9,
+                                       on_token=cb))
+            r2 = eng.submit(GenRequest(prompt=p[:3], max_new_tokens=5,
+                                       on_token=cb))
+            got = eng.run()
+            assert streamed[r1] == got[r1] and len(got[r1]) == 9
+            assert streamed[r2] == got[r2] and len(got[r2]) == 5
+
+    def test_on_token_with_eos_stops_stream(self, setup):
+        config, params = setup
+        p = rand_prompt(jax.random.key(71), 5, config.vocab_size)
+        ref = Engine(params, config, max_slots=1, max_len=64,
+                     ticks_per_sync=2)
+        r0 = ref.submit(GenRequest(prompt=p, max_new_tokens=10))
+        free = ref.run()[r0]
+        cut = next(i for i in range(2, 10) if free[i] not in free[:i])
+        streamed = []
+        eng = Engine(params, config, max_slots=1, max_len=64,
+                     ticks_per_sync=2)
+        rid = eng.submit(GenRequest(
+            prompt=p, max_new_tokens=10, eos_id=free[cut],
+            on_token=lambda _, t: streamed.append(t),
+        ))
+        assert eng.run()[rid] == streamed == free[:cut + 1]
+
+    def test_streaming_bounds_sync_horizon(self, setup):
+        """A streaming slot must not receive its whole completion in one
+        terminal burst: with queue empty the horizon caps at 4 chunks,
+        so a 32-token budget at ticks_per_sync=2 syncs at least 4
+        times."""
+        config, params = setup
+        p = rand_prompt(jax.random.key(72), 4, config.vocab_size)
+        bursts = []
+        eng = Engine(params, config, max_slots=1, max_len=64,
+                     ticks_per_sync=2)
+        seen = 0
+        orig_step = eng.step
+        def counting_step(chunks=1):
+            nonlocal seen
+            orig_step(chunks=chunks)
+            live = [s for s in eng._slots if s is not None]
+            n = sum(len(s.out) for s in live) + seen
+            bursts.append(n)
+        eng.step = counting_step
+        eng.submit(GenRequest(prompt=p, max_new_tokens=32,
+                              on_token=lambda r, t: None))
+        eng.run()
+        # >= 4 decode syncs (32 tokens / (4 chunks * 2 ticks) = 4)
+        assert len(bursts) >= 4, bursts
