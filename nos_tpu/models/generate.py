@@ -65,13 +65,23 @@ def _ffn(
     return _mlp(h, layer, config.hidden_act)
 
 
-def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None):
+def _cache_attention(
+    q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None,
+    rolling: int = 0,
+):
     """q [B, S, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
     ``n_valid`` positions. ``n_valid`` may be a scalar (one shared
     frontier), [B] (per-row frontiers — continuous batching), or [B, S]
     (per-query frontiers — multi-token chunk decode, where query i sees
     keys [0, pos+i+1)). ``key_valid`` [B, T] additionally masks slots
-    that hold padding (left-padded batches)."""
+    that hold padding (left-padded batches).
+
+    ``rolling`` = C > 0 switches to the ROLLING sliding-window layout:
+    physical slot s holds logical position l_s = (f-1) - ((f-1-s) mod C)
+    for frontier f (the most recent logical ≡ s mod C), ``n_valid`` stays
+    the LOGICAL frontier, and validity is l_s ≥ 0 within the window —
+    an unbounded stream attends its last W keys from C cache slots.
+    Slots ≥ C (the sacrificial pad-write slot) are never valid."""
     c = config
     b, s, hq, hd = q.shape
     t = cache_k.shape[1]
@@ -89,11 +99,21 @@ def _cache_attention(q, cache_k, cache_v, n_valid, config: LlamaConfig, key_vali
         frontier = n_valid[:, None, None, None, None]
     else:
         frontier = n_valid
-    valid = iota < frontier
-    if c.sliding_window is not None:
-        # the query at frontier f-1 sees keys (f-1-W, f-1]; cache slots ==
-        # logical positions on the unpadded serving path this supports
-        valid = valid & (iota >= frontier - c.sliding_window)
+    if rolling:
+        if c.sliding_window is None:
+            raise ValueError("rolling cache requires sliding_window")
+        f1 = frontier - 1
+        # logical position held by each physical slot (negative mod
+        # stays well-defined: f1 - s may be negative only for slots the
+        # l_s >= 0 check rejects anyway)
+        ls = f1 - jnp.mod(f1 - iota, rolling)
+        valid = (ls >= 0) & (ls > f1 - c.sliding_window) & (iota < rolling)
+    else:
+        valid = iota < frontier
+        if c.sliding_window is not None:
+            # the query at frontier f-1 sees keys (f-1-W, f-1]; cache
+            # slots == logical positions on the unpadded serving path
+            valid = valid & (iota >= frontier - c.sliding_window)
     if key_valid is not None:
         valid = valid & key_valid[:, None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
@@ -204,6 +224,7 @@ def decode_step(
     rope_pos: jax.Array = None,
     key_valid: jax.Array = None,
     row_valid: jax.Array = None,
+    rolling: bool = False,
 ) -> Tuple[jax.Array, Cache]:
     """One token at (traced) physical cache slot ``pos`` → (logits
     [B, vocab], cache with K/V written at pos).
@@ -221,7 +242,11 @@ def decode_step(
     batching: idle/ridden slots are garbage); masked rows are kept out
     of the MoE expert-capacity race so a dead row can never displace a
     live one. Defaults to "has any valid key" when ``key_valid`` is
-    given (the engine zeroes a retired row's key_valid)."""
+    given (the engine zeroes a retired row's key_valid).
+
+    ``rolling`` (sliding-window configs, per-row ``pos``): physical
+    slot = logical pos mod C with C = cache_len - 1, so a stream of any
+    length serves from O(window) cache (see _cache_attention)."""
     c = config
     b = token.shape[0]
     hd = c.head_dim
@@ -229,6 +254,9 @@ def decode_step(
     if row_valid is None and key_valid is not None:
         row_valid = jnp.any(key_valid, axis=1)
     ffn_mask = None if row_valid is None else row_valid[:, None]
+    cap = cache[0]["k"].shape[1] - 1 if rolling else 0
+    if rolling and not per_row:
+        raise ValueError("rolling decode needs per-row positions")
     x = _embed_rows(params["embed"], token, c.dtype, c.embed_scale)[:, None, :]  # [B, 1, D]
     if rope_pos is None and per_row:
         rope_pos = pos
@@ -254,13 +282,16 @@ def decode_step(
         q = rope1(q)
         k = rope1(k)
         if per_row:
-            ck = kv["k"].at[rows, pos].set(k[:, 0].astype(c.dtype))
-            cv = kv["v"].at[rows, pos].set(v[:, 0].astype(c.dtype))
+            wslot = pos % cap if rolling else pos
+            ck = kv["k"].at[rows, wslot].set(k[:, 0].astype(c.dtype))
+            cv = kv["v"].at[rows, wslot].set(v[:, 0].astype(c.dtype))
         else:
             ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
             cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
-        attn = _cache_attention(q, ck, cv, pos + 1, c, key_valid=key_valid)
+        attn = _cache_attention(
+            q, ck, cv, pos + 1, c, key_valid=key_valid, rolling=cap
+        )
         x = x + _mm(attn, layer["wo"])
         x = x + _ffn(
             _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset),
@@ -278,6 +309,7 @@ def decode_chunk(
     config: LlamaConfig,
     write_mask: jax.Array = None,
     row_valid: jax.Array = None,
+    rolling: bool = False,
 ) -> Tuple[jax.Array, Cache]:
     """``m`` tokens at per-row physical slots ``pos``..``pos+m-1`` →
     (logits [B, m, vocab], cache with the chunk's K/V written).
@@ -296,7 +328,10 @@ def decode_chunk(
     must be invisible to real tokens in every sense, not just the
     cache. ``row_valid`` [B] additionally masks WHOLE rows from the MoE
     capacity race (continuous batching: finished slots riding the
-    chunk).
+    chunk). ``rolling``: modular sliding-window layout over C =
+    cache_len - 1 slots (slot C stays the pad target); requires
+    C ≥ window + m so a chunk's writes never evict keys its own
+    queries still need.
     """
     c = config
     b, m = tokens.shape
@@ -310,10 +345,12 @@ def decode_chunk(
     cos = cos.reshape(b, m, 1, -1)
     sin = sin.reshape(b, m, 1, -1)
     t_cache = cache[0]["k"].shape[1]
+    cap = t_cache - 1 if rolling else 0
+    real_pos = posmat % cap if rolling else posmat
     if write_mask is not None:
-        write_pos = jnp.where(write_mask, posmat, t_cache - 1)
+        write_pos = jnp.where(write_mask, real_pos, t_cache - 1)
     else:
-        write_pos = posmat
+        write_pos = real_pos
     ffn_mask = write_mask
     if row_valid is not None:
         row_col = row_valid[:, None] & jnp.ones((1, m), bool)
@@ -332,7 +369,7 @@ def decode_chunk(
         ck = kv["k"].at[rows, write_pos].set(k.astype(c.dtype))
         cv = kv["v"].at[rows, write_pos].set(v.astype(c.dtype))
         new_cache.append({"k": ck, "v": cv})
-        attn = _cache_attention(q, ck, cv, frontier, c)
+        attn = _cache_attention(q, ck, cv, frontier, c, rolling=cap)
         x = x + _mm(attn, layer["wo"])
         x = x + _ffn(
             _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset),

@@ -102,6 +102,7 @@ class Engine:
         seed: int = 0,
         prefix_cache_entries: int = 0,
         mesh=None,
+        rolling: bool = False,
     ) -> None:
         self.params = params
         self.config = config
@@ -109,6 +110,32 @@ class Engine:
         # sharded (shard_for_serving) and the KV cache shards its head
         # axis here; everything else is ordinary SPMD propagation.
         self.mesh = mesh
+        # Rolling sliding-window cache: physical slot = logical position
+        # mod C (C = max_len - 1; the last slot stays the chunked
+        # ingest's pad target), so prompt + budget are UNBOUNDED — a
+        # stream of any length serves from O(window) HBM. Requires a
+        # sliding_window config; incompatible with the prefix cache
+        # (cached segments assume physical == logical).
+        self.rolling = rolling
+        if rolling:
+            if config.sliding_window is None:
+                raise ValueError("rolling cache requires a sliding_window config")
+            if prefix_cache_entries:
+                raise ValueError(
+                    "prefix cache assumes physical == logical positions; "
+                    "disable it with rolling=True"
+                )
+            if max_len - 1 < config.sliding_window + 8:
+                # 8 = the minimum ingest piece width (_bucket floor)
+                raise ValueError(
+                    f"rolling cache needs max_len - 1 >= sliding_window + 8 "
+                    f"({max_len - 1} < {config.sliding_window + 8})"
+                )
+            # a chunk's writes must never evict keys its own queries
+            # still need: C >= window + piece width
+            prefill_chunk = min(
+                prefill_chunk, max_len - 1 - config.sliding_window
+            )
         self.slots_n = max_slots
         self.max_len = max_len
         self.ticks_per_sync = max(1, ticks_per_sync)
@@ -184,6 +211,7 @@ class Engine:
                 logits, cache = decode_step(
                     params, cache, pos, last, config,
                     rope_pos=rope, key_valid=key_valid,
+                    rolling=self.rolling,
                 )
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return (cache, pos + 1, nxt, rope + 1), nxt
@@ -203,6 +231,7 @@ class Engine:
                 logits, cache = decode_step(
                     params, cache, pos, last, config,
                     rope_pos=rope, key_valid=key_valid,
+                    rolling=self.rolling,
                 )
                 both = jax.vmap(jax.random.split)(keys)  # [B, 2] keys
                 nxt = pick_tokens_per_row(logits, temp, topk, topp, both[:, 1])
@@ -221,7 +250,8 @@ class Engine:
 
         def _ingest(params, row_cache, start, piece, mask):
             return decode_chunk(
-                params, row_cache, start, piece, config, write_mask=mask
+                params, row_cache, start, piece, config, write_mask=mask,
+                rolling=self.rolling,
             )
 
         self._ingest = jax.jit(_ingest, donate_argnums=(1,))
@@ -289,6 +319,10 @@ class Engine:
             # admission always emits the prefill token, so 0 cannot be
             # honored as a budget
             raise ValueError("max_new_tokens must be >= 1")
+        if self.rolling:
+            # the rolling layout bounds nothing: any prompt ingests
+            # through C-bounded pieces and any budget decodes in place
+            return
         if len(request.prompt) > self.max_len:
             # _bucket clamps to max_len, so downstream chunk math would
             # wave an over-long prompt through and crash mid-run instead.
@@ -354,6 +388,11 @@ class Engine:
             spent = len(s.out) + (1 if b in pending else 0)
             rem = max(1, s.request.max_new_tokens - spent)
             budget = -(-rem // t)
+            if self.rolling:
+                # rolling budgets are unbounded — without a cap one
+                # step() would queue the whole completion's dispatches
+                # and sync nothing until it finishes
+                budget = min(budget, 16)
             if s.request.eos_id is not None or s.request.on_token is not None:
                 # An EOS can land any tick; decoding the full budget
                 # blind would turn an early finish into worst-case wall
@@ -372,10 +411,12 @@ class Engine:
     # ---------------------------------------------------------- scheduling
 
     def _bucket(self, n: int) -> int:
+        # the rolling layout never one-shot-prefills, so its bucket only
+        # sizes ingest pieces — cap at the (C - window)-bounded chunk
         b = 8
         while b < n:
             b *= 2
-        return min(b, self.max_len)
+        return min(b, self.prefill_chunk if self.rolling else self.max_len)
 
     def _prefill_for(self, bucket: int):
         """One compiled prefill per prompt-length bucket."""
@@ -429,7 +470,12 @@ class Engine:
         # short prompts (windowed configs route here too) use bucket-sized
         # pieces, not the full prefill_chunk width
         n = min(self.prefill_chunk, self._bucket(length))
-        row_cache = init_kv_cache(c, 1, self.max_len + 1)
+        # rolling rows match the batch layout exactly (modulus C =
+        # max_len - 1, pad slot max_len - 1); the physical==logical
+        # layout keeps its sacrificial slot OUTSIDE max_len instead
+        row_cache = init_kv_cache(
+            c, 1, self.max_len if self.rolling else self.max_len + 1
+        )
         logits = None
         # Longest cached prefix at one of THIS request's chunk
         # boundaries; the final piece always recomputes (its logits seed

@@ -58,6 +58,11 @@ class SpecEngine(Engine):
         k: int = 4,
         **kwargs,
     ) -> None:
+        if kwargs.get("rolling"):
+            raise ValueError(
+                "rolling cache is not supported with speculation (the "
+                "round's chunk verify assumes physical == logical)"
+            )
         super().__init__(params, config, **kwargs)
         self.d_params = draft_params
         self.d_config = draft_config

@@ -1,0 +1,109 @@
+"""Rolling sliding-window cache: O(window) memory for unbounded streams.
+
+Oracle is the NON-rolling windowed engine with a cache big enough to
+hold everything physically: the rolling layout changes storage only —
+attention semantics (last-W keys) are identical, so tokens must match
+exactly. The headline test serves prompt+budget several times the
+rolling engine's max_len.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.serve import Engine, GenRequest, SpecEngine
+
+
+W = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = tiny_config(dtype=jnp.float32, sliding_window=W)
+    params = init_llama_params(jax.random.key(0), config)
+    return config, params
+
+
+def rand_prompt(key, n, vocab):
+    return np.asarray(jax.random.randint(key, (n,), 1, vocab)).tolist()
+
+
+def big_oracle(params, config, reqs, max_len=512):
+    eng = Engine(params, config, max_slots=2, max_len=max_len,
+                 ticks_per_sync=4, prefill_chunk=8)
+    ids = [eng.submit(GenRequest(**r)) for r in reqs]
+    got = eng.run()
+    return [got[i] for i in ids]
+
+
+class TestRollingCache:
+    def test_matches_physical_layout_within_bounds(self, setup):
+        """Workload that fits BOTH layouts: rolling must be invisible."""
+        config, params = setup
+        reqs = [
+            dict(prompt=rand_prompt(jax.random.key(i), n, config.vocab_size),
+                 max_new_tokens=m)
+            for i, (n, m) in enumerate(((5, 9), (20, 6), (11, 12)))
+        ]
+        want = big_oracle(params, config, [dict(r) for r in reqs])
+        eng = Engine(params, config, max_slots=2, max_len=33,
+                     ticks_per_sync=4, prefill_chunk=8, rolling=True)
+        ids = [eng.submit(GenRequest(**r)) for r in reqs]
+        got = eng.run()
+        assert [got[i] for i in ids] == want
+
+    def test_stream_far_past_max_len(self, setup):
+        """The point of the feature: 40-token prompt + 150 generated
+        through a 33-slot cache (window 16) — logical positions reach
+        ~6x the physical cache."""
+        config, params = setup
+        p = rand_prompt(jax.random.key(9), 40, config.vocab_size)
+        want = big_oracle(
+            params, config, [dict(prompt=p, max_new_tokens=150)],
+            max_len=512,
+        )[0]
+        eng = Engine(params, config, max_slots=1, max_len=33,
+                     ticks_per_sync=4, prefill_chunk=8, rolling=True)
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=150))
+        got = eng.run()[rid]
+        assert len(got) == 150
+        assert got == want
+
+    def test_slot_reuse_and_mixed_depths(self, setup):
+        """Requests retiring and re-admitting into wrapped rows: the
+        fresh tenant's ingest overwrites whatever logical residue the
+        previous stream left."""
+        config, params = setup
+        prompts = [rand_prompt(jax.random.key(20 + i), 6 + 7 * i,
+                               config.vocab_size) for i in range(5)]
+        reqs = [dict(prompt=p, max_new_tokens=30 + 5 * i)
+                for i, p in enumerate(prompts)]
+        want = big_oracle(params, config, [dict(r) for r in reqs])
+        eng = Engine(params, config, max_slots=2, max_len=33,
+                     ticks_per_sync=4, prefill_chunk=8, rolling=True)
+        ids = [eng.submit(GenRequest(**r)) for r in reqs]
+        got = eng.run()
+        assert [got[i] for i in ids] == want
+
+    def test_validation(self, setup):
+        config, params = setup
+        # needs a window config
+        dense_cfg = tiny_config(dtype=jnp.float32)
+        with pytest.raises(ValueError, match="sliding_window"):
+            Engine(init_llama_params(jax.random.key(1), dense_cfg),
+                   dense_cfg, max_len=64, rolling=True)
+        # cache must exceed window + minimum piece
+        with pytest.raises(ValueError, match="max_len"):
+            Engine(params, config, max_len=W + 4, rolling=True)
+        # prefix cache is physical==logical only
+        with pytest.raises(ValueError, match="prefix"):
+            Engine(params, config, max_len=64, rolling=True,
+                   prefix_cache_entries=2)
+        # speculation excluded
+        draft_cfg = tiny_config(n_layers=1, dtype=jnp.float32,
+                                sliding_window=W)
+        with pytest.raises(ValueError, match="rolling"):
+            SpecEngine(params, config,
+                       init_llama_params(jax.random.key(2), draft_cfg),
+                       draft_cfg, max_len=64, rolling=True)
