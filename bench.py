@@ -567,6 +567,49 @@ def run_tpu_child() -> None:
                     f"{type(e).__name__}: {str(e)[:160]}")
             snapshot()
 
+        # ---- rolling sliding-window serving: a windowed stream decodes
+        # from an O(window) cache (physical slot = logical mod C). The
+        # physical-layout engine needs prompt+budget cache slots; rolling
+        # reads a fraction of the K/V per attention step, so long-stream
+        # tokens/s should rise with the smaller working set.
+        try:
+            from nos_tpu.serve import Engine, GenRequest
+
+            wcfg = dataclasses.replace(config, sliding_window=1024)
+            prompt, new = [7] * 256, 768
+            times = {}
+            for name, kw in (
+                ("physical", dict(max_len=len(prompt) + new + 8)),
+                # C = 1280 = window + ingest piece (the minimum legal)
+                ("rolling", dict(max_len=1024 + 257, rolling=True)),
+            ):
+                eng = Engine(params, wcfg, max_slots=1, ticks_per_sync=16,
+                             prefill_chunk=256, **kw)
+                eng.submit(GenRequest(prompt=prompt, max_new_tokens=new))
+                eng.run()  # warm compile
+                eng.submit(GenRequest(prompt=prompt, max_new_tokens=new))
+                start = time.monotonic()
+                eng.run()
+                times[name] = time.monotonic() - start
+                del eng
+            result["serve_window_tokens_per_s"] = round(
+                new / times["physical"], 1
+            )
+            result["serve_rolling_tokens_per_s"] = round(
+                new / times["rolling"], 1
+            )
+            result["rolling_vs_physical"] = round(
+                times["physical"] / times["rolling"], 3
+            )
+            log(f"[tpu-child] rolling serve: {new/times['rolling']:.1f} "
+                f"tok/s from a {1024 + 257}-slot cache vs "
+                f"{new/times['physical']:.1f} tok/s physical "
+                f"({result['rolling_vs_physical']}x)")
+        except Exception as e:
+            log(f"[tpu-child] rolling serve failed: "
+                f"{type(e).__name__}: {str(e)[:160]}")
+        snapshot()
+
     print(json.dumps(result), flush=True)
 
 
