@@ -208,13 +208,14 @@ def init_llama_params(key: jax.Array, config: LlamaConfig) -> Params:
 
 def _mm(x: jax.Array, w) -> jax.Array:
     """x @ w, dispatching on the weight leaf: dense bf16, int8
-    QuantizedLinear (serving), or LoraLinear (adapter fine-tuning)."""
-    from nos_tpu.models.lora import LoraLinear
+    QuantizedLinear (serving), LoraLinear (adapter fine-tuning), or
+    MultiLoraLinear (per-row multi-tenant adapter serving)."""
+    from nos_tpu.models.lora import LoraLinear, MultiLoraLinear
     from nos_tpu.models.quantize import QuantizedLinear, QuantizedLinear4
 
     if isinstance(w, (QuantizedLinear, QuantizedLinear4)):
         return w.matmul(x)
-    if isinstance(w, LoraLinear):
+    if isinstance(w, (LoraLinear, MultiLoraLinear)):
         return w.matmul(x)
     return x @ w
 

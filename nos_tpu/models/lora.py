@@ -68,6 +68,116 @@ class LoraLinear:
         return cls(*children, scale=aux)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class MultiLoraLinear:
+    """Frozen base weight + N STACKED adapters with a per-ROW selector:
+    row b of the batch applies adapter ``idx[b]`` — the multi-tenant
+    serving form (S-LoRA style), where every slot of a continuous-
+    batching engine can run a different fine-tune against one shared
+    base. Adapter 0 is reserved as the identity (zero delta).
+
+    The gathered [B, in, r] adapter operands ride the MXU as batched
+    rank-r matmuls next to the shared dense base matmul; the full
+    [in, out] delta never materializes."""
+
+    w: jax.Array      # [in, out] shared base
+    a: jax.Array      # [N, in, r]
+    b: jax.Array      # [N, r, out]
+    idx: jax.Array    # [B] int32: row -> adapter id
+    scale: float = 1.0
+
+    def matmul(self, x: jax.Array) -> jax.Array:
+        if x.ndim != 3:
+            raise ValueError(
+                f"MultiLoraLinear needs [B, S, d] activations, got {x.shape}"
+            )
+        base = x @ self.w
+        a_sel = self.a[self.idx].astype(x.dtype)   # [B, in, r]
+        b_sel = self.b[self.idx].astype(x.dtype)   # [B, r, out]
+        delta = jnp.einsum("bsi,bir->bsr", x, a_sel)
+        delta = jnp.einsum("bsr,bro->bso", delta, b_sel)
+        return base + self.scale * delta
+
+    def tree_flatten(self):
+        return (self.w, self.a, self.b, self.idx), self.scale
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, scale=aux)
+
+
+def stack_lora_adapters(
+    params: Params, adapter_trees, lora: LoraConfig, rows: int = 1
+) -> Params:
+    """Base params + a LIST of adapter trees → serving tree whose
+    targeted projections are MultiLoraLinear nodes. Adapter ids are
+    1-based (id 0 = identity, stacked as zeros); every adapter must
+    share the LoraConfig (rank/targets/scale). ``rows`` sizes the
+    per-row selector (the engine's slot count), initialized to 0."""
+    if not adapter_trees:
+        raise ValueError(
+            "stack_lora_adapters needs at least one adapter tree "
+            "(a base-only engine doesn't need the stacked form)"
+        )
+    for ad in adapter_trees:
+        _check_layer_counts(params, ad)
+    idx = jnp.zeros((rows,), jnp.int32)
+    out = dict(params)
+    out["layers"] = []
+    for li, base_layer in enumerate(params["layers"]):
+        layer = dict(base_layer)
+        for t in lora.targets:
+            if t not in layer:
+                raise ValueError(
+                    f"LoRA target {t!r} absent from layer (MoE layers have "
+                    "no dense MLP projections)"
+                )
+            a_stack = jnp.stack(
+                [jnp.zeros_like(adapter_trees[0]["layers"][li][t]["a"])]
+                + [ad["layers"][li][t]["a"] for ad in adapter_trees]
+            )
+            b_stack = jnp.stack(
+                [jnp.zeros_like(adapter_trees[0]["layers"][li][t]["b"])]
+                + [ad["layers"][li][t]["b"] for ad in adapter_trees]
+            )
+            layer[t] = MultiLoraLinear(
+                w=layer[t], a=a_stack, b=b_stack, idx=idx, scale=lora.scale
+            )
+        out["layers"].append(layer)
+    return out
+
+
+def with_adapter_rows(params: Params, idx) -> Params:
+    """Same tree with every MultiLoraLinear's row selector replaced by
+    ``idx`` (shape sets the batch rows) — the engine points decode at
+    its slots' adapters and admission at a single row, without copying
+    any weight."""
+    idx = jnp.asarray(idx, jnp.int32)
+
+    def swap(leaf):
+        if isinstance(leaf, MultiLoraLinear):
+            return MultiLoraLinear(
+                w=leaf.w, a=leaf.a, b=leaf.b, idx=idx, scale=leaf.scale
+            )
+        return leaf
+
+    return jax.tree_util.tree_map(
+        swap, params, is_leaf=lambda x: isinstance(x, MultiLoraLinear)
+    )
+
+
+def n_adapters(params: Params) -> int:
+    """Stacked adapter count (including the identity at id 0), or 0 for
+    trees without MultiLoraLinear nodes."""
+    for leaf in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, MultiLoraLinear)
+    ):
+        if isinstance(leaf, MultiLoraLinear):
+            return leaf.a.shape[0]
+    return 0
+
+
 def init_lora_params(key: jax.Array, config, lora: LoraConfig) -> Params:
     """Adapter tree mirroring params['layers']: per layer, per target,
     {'a': [in, r] (scaled normal), 'b': [r, out] (ZEROS — the delta starts

@@ -68,6 +68,9 @@ class GenRequest:
     # streaming latency, up for throughput. Trimmed surplus (post-EOS /
     # post-budget ride-along) is never delivered.
     on_token: Optional[Callable[[int, int], None]] = None
+    # Multi-tenant LoRA (engine built over stack_lora_adapters): which
+    # stacked adapter this request's rows apply; 0 = the bare base.
+    adapter: int = 0
     id: int = -1
 
 
@@ -191,6 +194,15 @@ class Engine:
         self._row_keys = jax.vmap(
             lambda i: jax.random.fold_in(jax.random.key(seed), i)
         )(jnp.arange(max_slots))
+        # Multi-tenant LoRA: with MultiLoraLinear nodes in the tree,
+        # each slot selects its request's adapter; decode passes the
+        # tree re-pointed at the slots' ids (weight arrays shared by
+        # reference — only the [B] selector leaf changes per admission).
+        from nos_tpu.models.lora import n_adapters
+
+        self._n_adapters = n_adapters(params)
+        self._adapter_rows = np.zeros(max_slots, np.int32)
+        self._decode_tree = None  # cached re-pointed tree; None = dirty
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._queue: List[GenRequest] = []
         self._done: List[Completion] = []
@@ -319,6 +331,11 @@ class Engine:
             # admission always emits the prefill token, so 0 cannot be
             # honored as a budget
             raise ValueError("max_new_tokens must be >= 1")
+        if request.adapter and not (0 <= request.adapter < max(1, self._n_adapters)):
+            raise ValueError(
+                f"adapter {request.adapter} out of range: the tree stacks "
+                f"{self._n_adapters} adapters (0 = base)"
+            )
         if self.rolling:
             # the rolling layout bounds nothing: any prompt ingests
             # through C-bounded pieces and any budget decodes in place
@@ -353,6 +370,24 @@ class Engine:
         self._queue.append(request)
         metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         return request.id
+
+    def _decode_params(self):
+        """The param tree decode dispatches on: with stacked LoRA
+        adapters, re-pointed at the slots' adapter ids (weights shared
+        by reference — only the [slots] selector leaf changes)."""
+        if not self._n_adapters:
+            return self.params
+        from nos_tpu.models.lora import with_adapter_rows
+
+        return with_adapter_rows(self.params, self._adapter_rows)
+
+    def _admission_params(self, adapter: int):
+        """Single-row variant for prefill/ingest programs (B = 1)."""
+        if not self._n_adapters:
+            return self.params
+        from nos_tpu.models.lora import with_adapter_rows
+
+        return with_adapter_rows(self.params, [adapter])
 
     def run(self) -> Dict[int, List[int]]:
         """Drain queue + slots; returns {request id: generated tokens}.
@@ -443,7 +478,11 @@ class Engine:
         padded = jnp.asarray(
             [[PAD_ID] * pad + list(request.prompt)], jnp.int32
         )
-        first, first_logits, row_cache = self._prefill_for(bucket)(self.params, padded)
+        first, first_logits, row_cache = self._prefill_for(bucket)(
+            self._admission_params(request.adapter), padded
+        )
+        self._adapter_rows[b] = request.adapter
+        self._decode_tree = None
         self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))
         slot = _Slot(request=request)
         self._slots[b] = slot
@@ -485,7 +524,7 @@ class Engine:
         if self.prefix_cache_entries > 0:
             boundary = ((length - 1) // n) * n
             while boundary > 0:
-                key = tuple(prompt[:boundary])
+                key = (request.adapter, tuple(prompt[:boundary]))
                 entry = self._prefix_cache.get(key)
                 if entry is not None:
                     self._prefix_cache.move_to_end(key)
@@ -496,12 +535,15 @@ class Engine:
                     break
                 boundary -= n
         logits, row_cache = self._ingest_pieces(
-            self._ingest, self.params, row_cache, prompt, n, resume
+            self._ingest, self._admission_params(request.adapter),
+            row_cache, prompt, n, resume,
         )
+        self._adapter_rows[b] = request.adapter
+        self._decode_tree = None
         if self.prefix_cache_entries > 0:
             store_at = ((length - 1) // n) * n
             if store_at > 0:
-                key = tuple(prompt[:store_at])
+                key = (request.adapter, tuple(prompt[:store_at]))
                 if key not in self._prefix_cache:
                     self._prefix_cache[key] = self._prefix_snapshot(
                         row_cache, store_at
@@ -656,17 +698,19 @@ class Engine:
             topk = jnp.asarray(self._topk)
             topp = jnp.asarray(self._topp)
             keys = self._row_keys
+            dec_params = self._decode_params()
             for _ in range(chunks):
                 toks, self._cache, pos, last, rope, keys = self._decode_sampled(
-                    self.params, self._cache, pos, last, rope,
+                    dec_params, self._cache, pos, last, rope,
                     key_valid, temp, topk, topp, keys,
                 )
                 tok_chunks.append(toks)
             self._row_keys = keys
         else:
+            dec_params = self._decode_params()
             for _ in range(chunks):
                 toks, self._cache, pos, last, rope = self._decode_greedy(
-                    self.params, self._cache, pos, last, rope, key_valid,
+                    dec_params, self._cache, pos, last, rope, key_valid,
                 )
                 tok_chunks.append(toks)
         # ONE transfer for the whole round: the chunk token arrays (and
@@ -721,3 +765,5 @@ class Engine:
             self._pos[b] = 0
             self._rope[b] = 0
             self._key_valid[b, :] = False
+            self._adapter_rows[b] = 0
+            self._decode_tree = None

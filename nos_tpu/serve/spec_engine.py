@@ -63,6 +63,14 @@ class SpecEngine(Engine):
                 "rolling cache is not supported with speculation (the "
                 "round's chunk verify assumes physical == logical)"
             )
+        from nos_tpu.models.lora import n_adapters
+
+        if n_adapters(params) or n_adapters(draft_params):
+            raise ValueError(
+                "multi-tenant LoRA is not supported with speculation "
+                "(the jitted round closes over the param tree at init, "
+                "so per-admission adapter re-pointing cannot reach it)"
+            )
         super().__init__(params, config, **kwargs)
         self.d_params = draft_params
         self.d_config = draft_config
