@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -106,6 +107,14 @@ def _compact_step(i, lo, hi):
     compute-skipped via the returned validity."""
     raw = lo + i
     return jnp.minimum(raw, hi), raw <= hi
+
+
+# Kill-switch for the compact banded grid (NOS_FLASH_COMPACT=0): the
+# remapped index maps are exercised in interpret mode by tests, but a
+# Mosaic toolchain that rejects them should not take the whole flash
+# path down — flipping this env restores the full rectangular grid
+# (correct, just with the skipped blocks' DMA back).
+_COMPACT_DEFAULT = os.environ.get("NOS_FLASH_COMPACT", "1") != "0"
 
 
 def _static_zero(off) -> bool:
@@ -514,7 +523,7 @@ def _flash_fwd(q, k, v, causal, blk_q, blk_k, interpret, window):
     ot, lse = _fwd_pallas(
         qt, kt, vt, 0, 0, causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=group, interpret=interpret, scale=scale, window=window,
-        compact=True,
+        compact=_COMPACT_DEFAULT,
     )
     out = ot.transpose(0, 2, 1, 3)
     return out, (q, k, v, out, lse)
@@ -533,7 +542,8 @@ def _flash_bwd(causal, blk_q, blk_k, interpret, window, res, do):
         0, 0,
         causal=causal, blk_q=blk_q, blk_k=blk_k,
         group=q.shape[2] // k.shape[2], interpret=interpret,
-        scale=1.0 / math.sqrt(q.shape[3]), window=window, compact=True,
+        scale=1.0 / math.sqrt(q.shape[3]), window=window,
+        compact=_COMPACT_DEFAULT,
     )
     return (
         dq.transpose(0, 2, 1, 3),
