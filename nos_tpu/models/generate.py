@@ -39,14 +39,48 @@ Params = Dict[str, Any]
 Cache = List[Dict[str, jax.Array]]
 
 
-def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Cache:
-    """Per-layer K/V buffers [B, max_len, Hkv, hd] in the model dtype."""
+def init_kv_cache(
+    config: LlamaConfig, batch: int, max_len: int, quant: bool = False
+) -> Cache:
+    """Per-layer K/V buffers [B, max_len, Hkv, hd] in the model dtype.
+
+    ``quant``: int8 storage with per-(row, slot, head) f32 absmax scales
+    — HALF the cache HBM (the long-context ceiling and the decode read
+    bandwidth). Dequantization folds into attention (scores × k_scale;
+    probs × v_scale before the value matmul), so the widened cache never
+    materializes. Lossy: ~0.4% RMS per read, standard KV-quant
+    discipline — prompt prefill still attends its own K/V exactly."""
     c = config
     shape = (batch, max_len, c.n_kv_heads, c.head_dim)
+    if not quant:
+        return [
+            {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+            for _ in range(c.n_layers)
+        ]
+    sshape = shape[:-1]
     return [
-        {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+        {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32),
+        }
         for _ in range(c.n_layers)
     ]
+
+
+def _kv_quantized(cache: Cache) -> bool:
+    return bool(cache) and "k_scale" in cache[0]
+
+
+def _quantize_kv(vec: jax.Array):
+    """[..., hd] → (int8 [..., hd], f32 scale [...]): symmetric absmax
+    over the head dim — one scale per written K/V vector."""
+    v32 = vec.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(v32), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(v32 / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def _ffn(
@@ -67,7 +101,7 @@ def _ffn(
 
 def _cache_attention(
     q, cache_k, cache_v, n_valid, config: LlamaConfig, key_valid=None,
-    rolling: int = 0,
+    rolling: int = 0, k_scale=None, v_scale=None,
 ):
     """q [B, S, Hq, hd] against cache [B, T, Hkv, hd], masked to the first
     ``n_valid`` positions. ``n_valid`` may be a scalar (one shared
@@ -87,9 +121,17 @@ def _cache_attention(
     t = cache_k.shape[1]
     group = c.n_heads // c.n_kv_heads
     qg = q.reshape(b, s, c.n_kv_heads, group, hd)
+    if k_scale is not None:
+        # int8 cache: the astype RELIES on XLA fusing the int8->bf16
+        # convert into the dot's operand load (the int8-weight recipe) —
+        # fused, the widened keys never round-trip HBM; per-vector
+        # scales apply POST-score either way
+        cache_k = cache_k.astype(qg.dtype)
     scores = jnp.einsum(
         "bsKgh,btKh->bKgst", qg, cache_k, preferred_element_type=jnp.float32
     )
+    if k_scale is not None:
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     scores = scores / math.sqrt(hd)
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 1, t), 4)
     ndim = getattr(n_valid, "ndim", 0)
@@ -118,13 +160,20 @@ def _cache_attention(
         valid = valid & key_valid[:, None, None, None, :]
     scores = jnp.where(valid, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if v_scale is not None:
+        # fold the value dequant into the probabilities: Σ_t p·v8·scale
+        # = Σ_t (p·scale)·v8 — elementwise on probs, no widened values
+        probs = probs * v_scale.transpose(0, 2, 1).astype(probs.dtype)[
+            :, :, None, None, :
+        ]
+        cache_v = cache_v.astype(q.dtype)
     out = jnp.einsum("bKgst,btKh->bsKgh", probs, cache_v)
     return out.reshape(b, s, c.n_heads * hd)
 
 
 def prefill(
     params: Params, tokens: jax.Array, config: LlamaConfig, max_len: int,
-    pad_id: int = None,
+    pad_id: int = None, quant: bool = False,
 ) -> Tuple[jax.Array, Cache]:
     """Full forward over the prompt; returns (logits [B, S, vocab], cache
     holding the prompt's K/V in positions [0, S)).
@@ -160,7 +209,7 @@ def prefill(
         cos_b = cos_b.reshape(b, s, -1)[:, :, None, :]  # [B, S, 1, hd/2]
         sin_b = sin_b.reshape(b, s, -1)[:, :, None, :]
         cos = sin = None
-    cache = init_kv_cache(c, b, max_len)
+    cache = init_kv_cache(c, b, max_len, quant=quant)
     def rope(arr):
         if pad_id is None:
             return _apply_rope(arr, cos, sin)
@@ -174,12 +223,30 @@ def prefill(
         v = _mm(h, layer["wv"]).reshape(b, s, c.n_kv_heads, hd)
         q = rope(q)
         k = rope(k)
-        cache[i]["k"] = jax.lax.dynamic_update_slice(
-            cache[i]["k"], k.astype(c.dtype), (0, 0, 0, 0)
-        )
-        cache[i]["v"] = jax.lax.dynamic_update_slice(
-            cache[i]["v"], v.astype(c.dtype), (0, 0, 0, 0)
-        )
+        if quant:
+            # store quantized for later decode reads; the prompt's OWN
+            # attention below still runs on the exact fresh K/V
+            k8, kvec_s = _quantize_kv(k)
+            v8, vvec_s = _quantize_kv(v)
+            cache[i]["k"] = jax.lax.dynamic_update_slice(
+                cache[i]["k"], k8, (0, 0, 0, 0)
+            )
+            cache[i]["v"] = jax.lax.dynamic_update_slice(
+                cache[i]["v"], v8, (0, 0, 0, 0)
+            )
+            cache[i]["k_scale"] = jax.lax.dynamic_update_slice(
+                cache[i]["k_scale"], kvec_s, (0, 0, 0)
+            )
+            cache[i]["v_scale"] = jax.lax.dynamic_update_slice(
+                cache[i]["v_scale"], vvec_s, (0, 0, 0)
+            )
+        else:
+            cache[i]["k"] = jax.lax.dynamic_update_slice(
+                cache[i]["k"], k.astype(c.dtype), (0, 0, 0, 0)
+            )
+            cache[i]["v"] = jax.lax.dynamic_update_slice(
+                cache[i]["v"], v.astype(c.dtype), (0, 0, 0, 0)
+            )
         # causal attention within the prompt; long prompts ride the flash
         # kernel (O(blk) VMEM) when the config asks for it, matching the
         # training path's dispatch. Padded batches need per-key masks the
@@ -257,6 +324,7 @@ def decode_step(
     cap = cache[0]["k"].shape[1] - 1 if rolling else 0
     if rolling and not per_row:
         raise ValueError("rolling decode needs per-row positions")
+    quant = _kv_quantized(cache)
     x = _embed_rows(params["embed"], token, c.dtype, c.embed_scale)[:, None, :]  # [B, 1, D]
     if rope_pos is None and per_row:
         rope_pos = pos
@@ -281,16 +349,34 @@ def decode_step(
         v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = rope1(q)
         k = rope1(k)
-        if per_row:
+        ks = vs = None
+        if quant:
+            k8, kvec_s = _quantize_kv(k[:, 0] if per_row else k)
+            v8, vvec_s = _quantize_kv(v[:, 0] if per_row else v)
+            if per_row:
+                wslot = pos % cap if rolling else pos
+                ck = kv["k"].at[rows, wslot].set(k8)
+                cv = kv["v"].at[rows, wslot].set(v8)
+                ks = kv["k_scale"].at[rows, wslot].set(kvec_s)
+                vs = kv["v_scale"].at[rows, wslot].set(vvec_s)
+            else:
+                ck = jax.lax.dynamic_update_slice(kv["k"], k8, (0, pos, 0, 0))
+                cv = jax.lax.dynamic_update_slice(kv["v"], v8, (0, pos, 0, 0))
+                ks = jax.lax.dynamic_update_slice(kv["k_scale"], kvec_s, (0, pos, 0))
+                vs = jax.lax.dynamic_update_slice(kv["v_scale"], vvec_s, (0, pos, 0))
+            new_cache.append({"k": ck, "v": cv, "k_scale": ks, "v_scale": vs})
+        elif per_row:
             wslot = pos % cap if rolling else pos
             ck = kv["k"].at[rows, wslot].set(k[:, 0].astype(c.dtype))
             cv = kv["v"].at[rows, wslot].set(v[:, 0].astype(c.dtype))
+            new_cache.append({"k": ck, "v": cv})
         else:
             ck = jax.lax.dynamic_update_slice(kv["k"], k.astype(c.dtype), (0, pos, 0, 0))
             cv = jax.lax.dynamic_update_slice(kv["v"], v.astype(c.dtype), (0, pos, 0, 0))
-        new_cache.append({"k": ck, "v": cv})
+            new_cache.append({"k": ck, "v": cv})
         attn = _cache_attention(
-            q, ck, cv, pos + 1, c, key_valid=key_valid, rolling=cap
+            q, ck, cv, pos + 1, c, key_valid=key_valid, rolling=cap,
+            k_scale=ks, v_scale=vs,
         )
         x = x + _mm(attn, layer["wo"])
         x = x + _ffn(
@@ -357,6 +443,7 @@ def decode_chunk(
         ffn_mask = row_col if ffn_mask is None else (ffn_mask & row_col)
     rows = jnp.arange(b)[:, None]
     frontier = posmat + 1  # [B, m]: query i sees keys < pos+i+1
+    quant = _kv_quantized(cache)
 
     new_cache: Cache = []
     for layer, kv in zip(params["layers"], cache):
@@ -366,10 +453,22 @@ def decode_chunk(
         v = _mm(h, layer["wv"]).reshape(b, m, c.n_kv_heads, hd)
         q = _apply_rope(q, cos, sin)
         k = _apply_rope(k, cos, sin)
-        ck = kv["k"].at[rows, write_pos].set(k.astype(c.dtype))
-        cv = kv["v"].at[rows, write_pos].set(v.astype(c.dtype))
-        new_cache.append({"k": ck, "v": cv})
-        attn = _cache_attention(q, ck, cv, frontier, c, rolling=cap)
+        ks = vs = None
+        if quant:
+            k8, kvec_s = _quantize_kv(k)
+            v8, vvec_s = _quantize_kv(v)
+            ck = kv["k"].at[rows, write_pos].set(k8)
+            cv = kv["v"].at[rows, write_pos].set(v8)
+            ks = kv["k_scale"].at[rows, write_pos].set(kvec_s)
+            vs = kv["v_scale"].at[rows, write_pos].set(vvec_s)
+            new_cache.append({"k": ck, "v": cv, "k_scale": ks, "v_scale": vs})
+        else:
+            ck = kv["k"].at[rows, write_pos].set(k.astype(c.dtype))
+            cv = kv["v"].at[rows, write_pos].set(v.astype(c.dtype))
+            new_cache.append({"k": ck, "v": cv})
+        attn = _cache_attention(
+            q, ck, cv, frontier, c, rolling=cap, k_scale=ks, v_scale=vs
+        )
         x = x + _mm(attn, layer["wo"])
         x = x + _ffn(
             _rms_norm(x, layer["mlp_norm"], c.norm_eps, c.norm_offset),

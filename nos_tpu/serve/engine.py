@@ -106,6 +106,7 @@ class Engine:
         prefix_cache_entries: int = 0,
         mesh=None,
         rolling: bool = False,
+        kv_quant: bool = False,
     ) -> None:
         self.params = params
         self.config = config
@@ -120,6 +121,16 @@ class Engine:
         # sliding_window config; incompatible with the prefix cache
         # (cached segments assume physical == logical).
         self.rolling = rolling
+        # int8 KV cache: half the cache HBM and decode read bandwidth;
+        # dequant folds into attention (see models/generate.init_kv_cache).
+        # Lossy by design — tokens can drift from the bf16-cache engine
+        # on near-tie logits, the standard KV-quant tradeoff.
+        self.kv_quant = kv_quant
+        if kv_quant and mesh is not None:
+            raise ValueError(
+                "kv_quant + mesh is not wired (the scale arrays need "
+                "their own head-sharding rules); pick one"
+            )
         if rolling:
             if config.sliding_window is None:
                 raise ValueError("rolling cache requires a sliding_window config")
@@ -162,23 +173,25 @@ class Engine:
 
         self._prefix_cache: "OrderedDict[tuple, list]" = OrderedDict()
         c = config
+        from nos_tpu.models.generate import init_kv_cache
+
         if mesh is not None:
             from nos_tpu.serve.sharded import kv_cache_sharding
 
             ns = kv_cache_sharding(mesh, config)
             # device= allocates each shard in place — a cache sized to
             # the whole mesh must never materialize unsharded on one chip
-            def _zeros(shape, dtype):
-                return jnp.zeros(shape, dtype, device=ns)
+            self._cache = [
+                {
+                    key: jnp.zeros(arr.shape, arr.dtype, device=ns)
+                    for key, arr in layer.items()
+                }
+                for layer in init_kv_cache(c, max_slots, max_len)
+            ]
         else:
-            _zeros = jnp.zeros
-        self._cache = [
-            {
-                "k": _zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
-                "v": _zeros((max_slots, max_len, c.n_kv_heads, c.head_dim), c.dtype),
-            }
-            for _ in range(c.n_layers)
-        ]
+            self._cache = init_kv_cache(
+                c, max_slots, max_len, quant=kv_quant
+            )
         # Host-side control state (tiny; device round-trips once per tick).
         self._pos = np.zeros(max_slots, np.int32)  # next physical write slot
         self._rope = np.zeros(max_slots, np.int32)  # logical position (no pads)
@@ -271,13 +284,16 @@ class Engine:
         def _splice(cache, row_cache, b):
             # donated in-place row writes: without this, each of the
             # 2*n_layers eager dynamic_update_slice calls would copy the
-            # whole batch cache through HBM per admission
+            # whole batch cache through HBM per admission. Iterates the
+            # layer's keys rank-aware so int8 caches' 3-D scale planes
+            # splice alongside the K/V.
             return [
                 {
                     key: jax.lax.dynamic_update_slice(
-                        layer[key], row[key][:, : self.max_len], (b, 0, 0, 0)
+                        layer[key], row[key][:, : self.max_len],
+                        (b,) + (0,) * (layer[key].ndim - 1),
                     )
-                    for key in ("k", "v")
+                    for key in layer
                 }
                 for layer, row in zip(cache, row_cache)
             ]
@@ -291,9 +307,9 @@ class Engine:
             return [
                 {
                     key: jax.lax.dynamic_update_slice(
-                        layer[key], cached[key], (0, 0, 0, 0)
+                        layer[key], cached[key], (0,) * layer[key].ndim
                     )
-                    for key in ("k", "v")
+                    for key in layer
                 }
                 for layer, cached in zip(row_cache, entry)
             ]
@@ -305,10 +321,10 @@ class Engine:
                 {
                     key: jax.lax.dynamic_slice(
                         layer[key],
-                        (0, 0, 0, 0),
+                        (0,) * layer[key].ndim,
                         (1, store_at, *layer[key].shape[2:]),
                     )
-                    for key in ("k", "v")
+                    for key in layer
                 }
                 for layer in row_cache
             ]
@@ -459,7 +475,10 @@ class Engine:
             cfg = self.config
 
             def _pre(params, prompt):
-                logits, cache = prefill(params, prompt, cfg, bucket, pad_id=PAD_ID)
+                logits, cache = prefill(
+                    params, prompt, cfg, bucket, pad_id=PAD_ID,
+                    quant=self.kv_quant,
+                )
                 first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                 return first, logits[:, -1], cache
 
@@ -513,7 +532,8 @@ class Engine:
         # max_len - 1, pad slot max_len - 1); the physical==logical
         # layout keeps its sacrificial slot OUTSIDE max_len instead
         row_cache = init_kv_cache(
-            c, 1, self.max_len if self.rolling else self.max_len + 1
+            c, 1, self.max_len if self.rolling else self.max_len + 1,
+            quant=self.kv_quant,
         )
         logits = None
         # Longest cached prefix at one of THIS request's chunk

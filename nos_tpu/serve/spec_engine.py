@@ -63,6 +63,12 @@ class SpecEngine(Engine):
                 "rolling cache is not supported with speculation (the "
                 "round's chunk verify assumes physical == logical)"
             )
+        if kwargs.get("kv_quant"):
+            raise ValueError(
+                "int8 KV cache is not wired for speculation (acceptance "
+                "compares target logits tick-for-tick; quantization "
+                "noise would silently change what 'match' means)"
+            )
         from nos_tpu.models.lora import n_adapters
 
         if n_adapters(params) or n_adapters(draft_params):
