@@ -410,6 +410,56 @@ def run_tpu_child() -> None:
             del qparams
             snapshot()
 
+            # int8 KV cache: at batch 8 x 4k context the per-step cache
+            # stream (~2 GB bf16) rivals the weight bytes, so halving it
+            # should show in tokens/s — the short-prompt decode above
+            # cannot (its KV is noise next to 2 GB of weights).
+            try:
+                from nos_tpu.models.generate import decode_step, prefill
+
+                def _ctx_decode(quant):
+                    b, ctx, steps = 8, 4096, 32
+                    toks = jnp.zeros((b, ctx), jnp.int32)
+                    fcfg = dataclasses.replace(config, attention="flash")
+
+                    def run(params, toks):
+                        logits, cache = prefill(
+                            params, toks, fcfg, ctx + steps, quant=quant
+                        )
+                        first = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+
+                        def tick(carry, i):
+                            cache, tok = carry
+                            lg, cache = decode_step(
+                                params, cache, ctx + i, tok, fcfg
+                            )
+                            return (cache, jnp.argmax(lg, -1).astype(jnp.int32)), ()
+
+                        (cache, last), _ = jax.lax.scan(
+                            tick, (cache, first), jnp.arange(steps)
+                        )
+                        return last
+
+                    fn = jax.jit(run)
+                    jax.block_until_ready(fn(params, toks))
+                    start = time.monotonic()
+                    out = fn(params, toks)
+                    jax.block_until_ready(out)
+                    return b * steps / (time.monotonic() - start)
+
+                t_full = _ctx_decode(False)
+                t_q = _ctx_decode(True)
+                result["decode_ctx4k_tokens_per_s"] = round(t_full, 1)
+                result["decode_ctx4k_kvq_tokens_per_s"] = round(t_q, 1)
+                result["kv_quant_decode_speedup"] = round(t_q / t_full, 3)
+                log(f"[tpu-child] decode @8x4k ctx: {t_full:.1f} tok/s bf16 "
+                    f"KV, {t_q:.1f} tok/s int8 KV "
+                    f"({result['kv_quant_decode_speedup']}x)")
+            except Exception as e:
+                log(f"[tpu-child] kv-quant decode failed: "
+                    f"{type(e).__name__}: {str(e)[:160]}")
+            snapshot()
+
             # int4 group-wise: a QUARTER of bf16's weight bytes — decode
             # bandwidth should read through again if the nibble unpack
             # fuses ahead of the MXU dot. Own try/except: an int4-only
