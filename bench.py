@@ -136,6 +136,11 @@ def run_tpu_child() -> None:
             (16, 2048, "flash", True),   # 2x tokens amortize the remat tax
             (8, 2048, "flash", True),
             (4, 2048, "flash", True),
+            # If every flash attempt failed, suspect the compact banded
+            # grid (untested Mosaic toolchains): flip it off and retry
+            # before surrendering to dense.
+            (0, 0, "compact_off", False),
+            (8, 2048, "flash", False),
             (2, 1024, "dense", False),
         ]
         train_iters, fwd_iters = 10, 20
@@ -166,6 +171,14 @@ def run_tpu_child() -> None:
 
     state = None
     for batch, seq, attn, remat in batch_candidates:
+        if attn == "compact_off":
+            from nos_tpu.ops import flash_attention as _fa
+
+            _fa.set_compact(False)
+            jax.clear_caches()
+            log("[tpu-child] disabling the compact flash grid and "
+                "retrying (all flash attempts failed)")
+            continue
         tokens = jnp.zeros((batch, seq), jnp.int32)
         try:
             t_cfg = dataclasses.replace(config, attention=attn, remat=remat)

@@ -112,9 +112,17 @@ def _compact_step(i, lo, hi):
 # Kill-switch for the compact banded grid (NOS_FLASH_COMPACT=0): the
 # remapped index maps are exercised in interpret mode by tests, but a
 # Mosaic toolchain that rejects them should not take the whole flash
-# path down — flipping this env restores the full rectangular grid
-# (correct, just with the skipped blocks' DMA back).
+# path down — flipping this env (or calling set_compact(False) and
+# jax.clear_caches()) restores the full rectangular grid (correct,
+# just with the skipped blocks' DMA back).
 _COMPACT_DEFAULT = os.environ.get("NOS_FLASH_COMPACT", "1") != "0"
+
+
+def set_compact(enabled: bool) -> None:
+    """Runtime flip of the compact-grid default (callers must
+    jax.clear_caches() to drop already-traced programs)."""
+    global _COMPACT_DEFAULT
+    _COMPACT_DEFAULT = bool(enabled)
 
 
 def _static_zero(off) -> bool:
