@@ -172,8 +172,11 @@ def run_tpu_child() -> None:
     state = None
     for batch, seq, attn, remat in batch_candidates:
         if attn == "compact_off":
-            from nos_tpu.ops import flash_attention as _fa
+            import importlib
 
+            # nos_tpu.ops re-exports the flash_attention FUNCTION, which
+            # shadows the module on every `import ... as` form
+            _fa = importlib.import_module("nos_tpu.ops.flash_attention")
             _fa.set_compact(False)
             jax.clear_caches()
             log("[tpu-child] disabling the compact flash grid and "
