@@ -37,7 +37,10 @@ import subprocess
 import sys
 import time
 
-TPU_CHILD_TIMEOUT_S = 900.0
+TPU_CHILD_TIMEOUT_S = 1200.0  # the child snapshots after every section,
+# so a timeout still salvages everything completed; the budget covers
+# the full section list (train, sweeps, decode+quant, ctx4k, engine x2,
+# prefix, long-context, rolling) with tunnel-compile headroom
 # Staged bring-up: before committing to the 900 s full child, run a tiny
 # probe child that only does `jax.devices()`. The tunneled-TPU claim leg
 # can hang indefinitely when the relay is wedged (observed r03/r04: two
