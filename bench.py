@@ -41,7 +41,7 @@ TPU_CHILD_TIMEOUT_S = 1200.0  # the child snapshots after every section,
 # so a timeout still salvages everything completed; the budget covers
 # the full section list (train, sweeps, decode+quant, ctx4k, engine x2,
 # prefix, long-context, rolling) with tunnel-compile headroom
-# Staged bring-up: before committing to the 900 s full child, run a tiny
+# Staged bring-up: before committing to the TPU_CHILD_TIMEOUT_S full child, run a tiny
 # probe child that only does `jax.devices()`. The tunneled-TPU claim leg
 # can hang indefinitely when the relay is wedged (observed r03/r04: two
 # rounds lost to a 900 s init hang); the probe bounds that failure mode to
@@ -721,7 +721,7 @@ def probe_backend() -> dict:
     """Run the probe child up to PROBE_ATTEMPTS times with backoff.
 
     Returns the probe's JSON dict on success, else {"error": ...}. A wedged
-    claim fails here in minutes instead of consuming the full-child 900 s
+    claim fails here in minutes instead of consuming the full child's TPU_CHILD_TIMEOUT_S
     budget (and tells the operator it was INIT that failed, not the bench)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--probe-child"]
     last_err = "unknown"
@@ -768,7 +768,7 @@ def run_tpu_bench_subprocess() -> dict:
     """Staged accelerator bench: cheap probe first, then the full child.
 
     The probe (jax.devices() only, short timeout, retried with backoff)
-    keeps a wedged tunnel from eating the whole 900 s budget; only a
+    keeps a wedged tunnel from eating the whole child budget; only a
     healthy backend earns the full model-step child."""
     probe = probe_backend()
     if "error" in probe:
