@@ -551,6 +551,7 @@ def generate(
     top_p: float = 1.0,
     pad_id: Optional[int] = None,
     eos_id: Optional[int] = None,
+    kv_quant: bool = False,
 ) -> jax.Array:
     """prompt [B, S] → generated tokens [B, max_new_tokens].
 
@@ -562,11 +563,14 @@ def generate(
     each row's RoPE counts only its real tokens, so the batched output
     equals row-by-row unpadded generation. With ``eos_id``, a row that
     emits it keeps emitting ``eos_id`` for the rest of the (static-length)
-    scan — trim on the first occurrence."""
+    scan — trim on the first occurrence. ``kv_quant`` stores the cache
+    as int8 (half the HBM; lossy decode reads — see init_kv_cache)."""
     c = config
     b, s = prompt.shape
     max_len = s + max_new_tokens
-    logits, cache = prefill(params, prompt, c, max_len, pad_id=pad_id)
+    logits, cache = prefill(
+        params, prompt, c, max_len, pad_id=pad_id, quant=kv_quant
+    )
     if rng is None:
         rng = jax.random.key(0)
 

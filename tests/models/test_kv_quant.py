@@ -95,3 +95,23 @@ class TestKvQuant:
         with pytest.raises(ValueError, match="KV cache"):
             SpecEngine(params, config, draft, draft_cfg, max_len=64,
                        kv_quant=True)
+
+    def test_solo_generate_kv_quant(self, setup):
+        """generate(kv_quant=True) runs the whole solo path on an int8
+        cache (decode_step auto-detects); lengths and vocab bounds hold,
+        and the stream tracks the full-precision run closely."""
+        config, params = setup
+        prompt = jnp.asarray(
+            [np.random.RandomState(7).randint(1, 256, 12).tolist()],
+            jnp.int32,
+        )
+        from nos_tpu.models.generate import generate
+
+        full = np.asarray(generate(params, prompt, config, max_new_tokens=10))
+        q8 = np.asarray(
+            generate(params, prompt, config, max_new_tokens=10, kv_quant=True)
+        )
+        assert q8.shape == (1, 10)
+        assert ((0 <= q8) & (q8 < config.vocab_size)).all()
+        agree = (full == q8).mean()
+        assert agree >= 0.5, f"only {agree:.0%} token agreement"
