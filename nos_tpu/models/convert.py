@@ -89,7 +89,19 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
     # Mistral-family sliding window (the arch is otherwise Llama-shaped;
     # the same converter serves both). transformers uses None for "full".
     sliding = getattr(hf_config, "sliding_window", None)
+    # Mixtral: Mistral attention + a routed MoE MLP per block. Routing
+    # parity note: Mixtral computes top-k over router logits THEN
+    # softmaxes the survivors; this stack softmaxes all experts then
+    # renormalizes the top-k — identical math (softmax is monotonic and
+    # the renormalization cancels the common denominator).
+    n_experts = 0
+    moe_top_k = 2
+    if model_type == "mixtral":
+        n_experts = int(getattr(hf_config, "num_local_experts"))
+        moe_top_k = int(getattr(hf_config, "num_experts_per_tok", 2))
     return LlamaConfig(
+        n_experts=n_experts,
+        moe_top_k=moe_top_k,
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
         n_layers=hf_config.num_hidden_layers,
@@ -153,19 +165,56 @@ def params_from_hf_state_dict(state_dict, config: LlamaConfig) -> Params:
         )
     for i in range(c.n_layers):
         prefix = f"model.layers.{i}."
-        params["layers"].append(
-            {
-                "attn_norm": take(prefix + "input_layernorm.weight", _v),
-                "wq": take(prefix + "self_attn.q_proj.weight", _t),
-                "wk": take(prefix + "self_attn.k_proj.weight", _t),
-                "wv": take(prefix + "self_attn.v_proj.weight", _t),
-                "wo": take(prefix + "self_attn.o_proj.weight", _t),
-                "mlp_norm": take(prefix + "post_attention_layernorm.weight", _v),
-                "w_gate": take(prefix + "mlp.gate_proj.weight", _t),
-                "w_up": take(prefix + "mlp.up_proj.weight", _t),
-                "w_down": take(prefix + "mlp.down_proj.weight", _t),
+        layer = {
+            "attn_norm": take(prefix + "input_layernorm.weight", _v),
+            "wq": take(prefix + "self_attn.q_proj.weight", _t),
+            "wk": take(prefix + "self_attn.k_proj.weight", _t),
+            "wv": take(prefix + "self_attn.v_proj.weight", _t),
+            "wo": take(prefix + "self_attn.o_proj.weight", _t),
+            "mlp_norm": take(prefix + "post_attention_layernorm.weight", _v),
+        }
+        if c.n_experts > 0:
+            # Mixtral block-sparse MoE: gate.weight [E, d] is the router
+            # (kept float32 — routing is precision-sensitive); per-expert
+            # w1/w3/w2 are the gated-SiLU projections, stacked [E, ...]
+            # for the batched expert einsum.
+            moe_prefix = prefix + "block_sparse_moe."
+
+            def stack_experts(name):
+                # stack on HOST, one device transfer: per-expert
+                # device_put + jnp.stack would hold two full copies of
+                # every stacked tensor at peak
+                consumed.update(
+                    f"{moe_prefix}experts.{e}.{name}.weight"
+                    for e in range(c.n_experts)
+                )
+                return jnp.asarray(
+                    np.stack([
+                        np.asarray(
+                            sd[f"{moe_prefix}experts.{e}.{name}.weight"]
+                            .detach().cpu().float().numpy().T
+                        )
+                        for e in range(c.n_experts)
+                    ]),
+                    dt,
+                )
+
+            layer["moe"] = {
+                "router": take(
+                    moe_prefix + "gate.weight",
+                    lambda w, _dt: _t(w, jnp.float32),
+                ),
+                "w_gate": stack_experts("w1"),
+                "w_up": stack_experts("w3"),
+                "w_down": stack_experts("w2"),
             }
-        )
+        else:
+            layer.update(
+                w_gate=take(prefix + "mlp.gate_proj.weight", _t),
+                w_up=take(prefix + "mlp.up_proj.weight", _t),
+                w_down=take(prefix + "mlp.down_proj.weight", _t),
+            )
+        params["layers"].append(layer)
     # Anything left over (attention/MLP biases, adapters, …) is a weight
     # this forward would NOT apply — dropping it silently would serve a
     # different model. Rotary frequency buffers are derived state, not
@@ -187,8 +236,10 @@ def load_hf_llama(model_or_path, dtype=jnp.bfloat16) -> Tuple[Params, LlamaConfi
     """(params, config) from a transformers model instance or a local /
     hub checkpoint path."""
     if isinstance(model_or_path, str):
-        from transformers import LlamaForCausalLM
+        # Auto resolves the family (Llama / Mistral / Mixtral / Gemma);
+        # config_from_hf then accepts or rejects the architecture.
+        from transformers import AutoModelForCausalLM
 
-        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+        model_or_path = AutoModelForCausalLM.from_pretrained(model_or_path)
     config = config_from_hf(model_or_path.config, dtype)
     return params_from_hf_state_dict(model_or_path.state_dict(), config), config
