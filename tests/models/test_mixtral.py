@@ -95,3 +95,19 @@ class TestMixtralConversion:
                      max_new_tokens=6)
         )[0].tolist()
         assert eng.run()[rid] == solo
+
+    def test_int8_quantized_mixtral_serves(self, converted):
+        """Converted Mixtral + weight-only int8 (expert stacks quantize
+        per-(expert, channel)) through the engine."""
+        from nos_tpu.models.quantize import quantize_params
+        from nos_tpu.serve import Engine, GenRequest
+
+        params, config = converted
+        qparams = quantize_params(params)
+        eng = Engine(qparams, config, max_slots=2, max_len=64,
+                     ticks_per_sync=4)
+        p = np.random.RandomState(9).randint(1, 128, 6).tolist()
+        rid = eng.submit(GenRequest(prompt=p, max_new_tokens=5))
+        got = eng.run()[rid]
+        assert len(got) == 5
+        assert all(0 <= t < config.vocab_size for t in got)
