@@ -97,6 +97,21 @@ def main() -> None:
           f"({total/wall:.1f} tok/s across {args.slots} slots, "
           f"{int(m.SERVE_PREFIX_HITS.value)} prefix-cache hits)")
 
+    # Rolling sliding-window cache: a Mistral-style config serves a
+    # stream far past the cache's physical length from O(window) HBM.
+    if not args.real_weights:
+        import dataclasses
+
+        wcfg = dataclasses.replace(config, sliding_window=16)
+        wparams = init_llama_params(jax.random.key(4), wcfg)
+        roll = Engine(wparams, wcfg, max_slots=1, max_len=33,
+                      ticks_per_sync=8, prefill_chunk=8, rolling=True)
+        rid = roll.submit(GenRequest(prompt=[3, 1, 4, 1, 5] * 8,
+                                     max_new_tokens=120))
+        n = len(roll.run()[rid])
+        print(f"rolling window: {40 + n} logical positions served through "
+              f"a 33-slot cache (window 16)")
+
     # Speculative continuous batching: a 1-layer truncation of the
     # target drafts k tokens per round; acceptance is exact, so the
     # stats line is the whole story (a real deployment uses a distilled
