@@ -28,7 +28,8 @@ def base():
     return config, params
 
 
-def run_fuzz(eng, config, rng, n_req, adapters=0, mid_run_submits=True):
+def run_fuzz(eng, config, rng, n_req, adapters=0, mid_run_submits=True,
+             allow_sampling=True):
     streamed = {}
 
     def cb(rid, tok):
@@ -43,7 +44,7 @@ def run_fuzz(eng, config, rng, n_req, adapters=0, mid_run_submits=True):
         )
         if rng.random() < 0.3:
             req.eos_id = int(rng.integers(1, config.vocab_size))
-        if rng.random() < 0.3:
+        if allow_sampling and rng.random() < 0.3:
             req.temperature = float(rng.random() * 1.2)
             req.top_k = int(rng.integers(0, 50))
             req.top_p = float(0.5 + rng.random() * 0.5)
@@ -120,3 +121,16 @@ class TestEngineFuzz:
         eng = Engine(params, config, max_slots=2, max_len=64,
                      ticks_per_sync=4, prefill_chunk=8, kv_quant=True)
         run_fuzz(eng, config, rng, n_req=6)
+
+    def test_spec_engine(self, base):
+        """Speculative engine under a randomized greedy workload (spec
+        rejects sampling at submit; eos/streaming/mid-run all apply)."""
+        from nos_tpu.serve import SpecEngine
+
+        config, params = base
+        draft_cfg = tiny_config(n_layers=1, dtype=jnp.float32)
+        draft = init_llama_params(jax.random.key(77), draft_cfg)
+        rng = np.random.default_rng(17)
+        eng = SpecEngine(params, config, draft, draft_cfg, k=3,
+                         max_slots=2, max_len=64)
+        run_fuzz(eng, config, rng, n_req=6, allow_sampling=False)
