@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration incluster-e2e kind-e2e bench examples native lint \
+.PHONY: all test test-fast test-unit test-integration incluster-e2e kind-e2e bench bench-planner examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -43,6 +43,12 @@ kind-e2e:
 
 bench:
 	$(PY) bench.py
+
+# Partitioner plan() latency: CoW snapshot engine vs the deepcopy
+# baseline, synthetic clusters, CPU-only. Appends JSON lines with
+# --output; see BENCH_planner.json for the committed numbers.
+bench-planner:
+	JAX_PLATFORMS=cpu $(PY) bench_planner.py
 
 ## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
 ## for real chips) -------------------------------------------------------

@@ -1,0 +1,195 @@
+"""Planner microbenchmark: plan() latency + fork throughput, CPU-only.
+
+Synthetic clusters (no real TPU, no kube apiserver): N v5e nodes in mixed
+fill states × P pending pods drawn from a realistic request mix. Each
+iteration builds a fresh snapshot (plan() mutates it) and times one
+plan() call. Two snapshot engines:
+
+  cow       — the journaled copy-on-write ClusterSnapshot (default engine)
+  deepcopy  — DeepcopyClusterSnapshot, the pre-CoW semantics (full node-map
+              deepcopy per fork, cluster-walk free pool), kept in-tree as
+              the measurable baseline
+
+Output: one JSON line per (engine, nodes, pods) config with p50/p95 plan
+latency (ms) and forks/sec, e.g.
+
+  make bench-planner
+  python bench_planner.py --quick
+  python bench_planner.py --output BENCH_planner.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.partitioning.core import ClusterSnapshot, DeepcopyClusterSnapshot, Planner, SnapshotNode
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit, NodeSelectorFit
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.kube.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec
+from nos_tpu.tpu.node import TpuNode
+
+V5E = "tpu-v5-lite-podslice"
+ENGINES = {"cow": ClusterSnapshot, "deepcopy": DeepcopyClusterSnapshot}
+
+
+def build_node(name: str, annotations=None) -> Node:
+    alloc = {constants.RESOURCE_TPU: 8, "cpu": 8, "memory": 128}
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                labels.GKE_TPU_ACCELERATOR_LABEL: V5E,
+                labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+                labels.PARTITIONING_LABEL: "tpu",
+            },
+            annotations=annotations or {},
+        ),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def build_pod(name: str, requests: dict) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="bench"),
+        spec=PodSpec(
+            containers=[Container(requests=dict(requests))],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+
+
+def make_cluster(n_nodes: int, snapshot_cls):
+    """Deterministic mixed-fill cluster: 1/3 virgin boards, 1/3 with one
+    free 2x2, 1/3 half-used — enough fragmentation that the planner forks
+    real carve trials instead of shortcutting."""
+    nodes = {}
+    for i in range(n_nodes):
+        style = i % 3
+        if style == 0:
+            ann = None
+        elif style == 1:
+            ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+        else:
+            ann = annot.status_from_devices(free={}, used={0: {"2x2": 1, "1x1": 2}})
+        name = f"node-{i:04d}"
+        nodes[name] = SnapshotNode(partitionable=TpuNode(build_node(name, ann)))
+    return snapshot_cls(nodes)
+
+
+def make_pending(n_pods: int):
+    """Request mix: small slices, board slices, plain chips — and demand
+    deliberately exceeding supply so the carve loop runs to exhaustion
+    (the worst-case path the latency target is about)."""
+    mixes = [
+        {constants.tpu_slice_resource("1x1"): 1},
+        {constants.tpu_slice_resource("2x2"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.RESOURCE_TPU: 4},
+        {constants.RESOURCE_TPU: 1},
+    ]
+    return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
+
+
+def bench_config(engine: str, n_nodes: int, n_pods: int, repeats: int) -> dict:
+    snapshot_cls = ENGINES[engine]
+    latencies = []
+    forks = 0
+    for rep in range(repeats + 1):  # rep 0 is untimed warm-up
+        snapshot = make_cluster(n_nodes, snapshot_cls)
+        # Count forks engine-independently (the deepcopy baseline skips the
+        # CoW metrics counters by design).
+        if rep > 0:
+            inner_fork = snapshot.fork
+
+            def counting_fork(inner_fork=inner_fork):
+                nonlocal forks
+                forks += 1
+                inner_fork()
+
+            snapshot.fork = counting_fork
+        planner = Planner(
+            Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+        )
+        pods = make_pending(n_pods)
+        started = time.perf_counter()
+        planner.plan(snapshot, pods)
+        if rep > 0:
+            latencies.append(time.perf_counter() - started)
+    total = sum(latencies)
+    quantiles = (
+        statistics.quantiles(latencies, n=20) if len(latencies) > 1 else latencies * 2
+    )
+    return {
+        "bench": "bench_planner",
+        "engine": engine,
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "repeats": repeats,
+        "p50_plan_ms": round(statistics.median(latencies) * 1e3, 2),
+        "p95_plan_ms": round(quantiles[-1] * 1e3, 2),
+        "forks_per_sec": round(forks / total, 1) if total else None,
+        "forks_total": forks,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--engines", default="cow,deepcopy")
+    parser.add_argument(
+        "--configs",
+        default="16x50,64x200,256x400",
+        help="comma-separated nodesxpods pairs",
+    )
+    parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument("--quick", action="store_true", help="16x50 only, 2 repeats")
+    parser.add_argument("--output", default="", help="also append JSON lines to file")
+    args = parser.parse_args()
+
+    configs = [tuple(map(int, c.split("x"))) for c in args.configs.split(",")]
+    repeats = args.repeats
+    if args.quick:
+        configs, repeats = [(16, 50)], 2
+
+    results = []
+    for engine in args.engines.split(","):
+        for n_nodes, n_pods in configs:
+            # The deepcopy baseline at full scale is exactly the collapse
+            # this bench exists to document; cap its largest run so the
+            # suite still finishes.
+            reps = repeats if not (engine == "deepcopy" and n_nodes >= 256) else max(
+                1, repeats // 2
+            )
+            result = bench_config(engine, n_nodes, n_pods, reps)
+            results.append(result)
+            print(json.dumps(result), flush=True)
+
+    raw = list(results)
+    for a in raw:
+        for b in raw:
+            if (
+                a["engine"] == "cow"
+                and b["engine"] == "deepcopy"
+                and (a["nodes"], a["pending_pods"]) == (b["nodes"], b["pending_pods"])
+                and a["p50_plan_ms"]
+            ):
+                speedup = {
+                    "bench": "bench_planner_speedup",
+                    "nodes": a["nodes"],
+                    "pending_pods": a["pending_pods"],
+                    "p50_speedup": round(b["p50_plan_ms"] / a["p50_plan_ms"], 2),
+                }
+                results.append(speedup)
+                print(json.dumps(speedup), flush=True)
+
+    if args.output:
+        with open(args.output, "a") as fh:
+            for result in results:
+                fh.write(json.dumps(result) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ from __future__ import annotations
 import copy
 import queue
 import threading
+from copy import deepcopy as _deepcopy
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -137,7 +138,13 @@ class KubeStore:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         filter_fn: Optional[Callable[[Any], bool]] = None,
+        copy: bool = True,
     ) -> List[Any]:
+        """List objects of `kind`. ``copy=False`` returns the stored
+        objects themselves for read-only consumers (the planner's live
+        cluster view): safe because every store write replaces the stored
+        object instead of mutating it — but callers must not write through.
+        """
         with self._lock:
             out = []
             for (k_kind, k_ns, _), obj in self._objects.items():
@@ -151,7 +158,7 @@ class KubeStore:
                     continue
                 if filter_fn and not filter_fn(obj):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(_deepcopy(obj) if copy else obj)
             out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
             return out
 

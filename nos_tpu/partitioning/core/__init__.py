@@ -6,7 +6,11 @@ from nos_tpu.partitioning.core.partition_state import (
     partitioning_state_equal,
 )
 from nos_tpu.partitioning.core.state import ClusterState
-from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
+from nos_tpu.partitioning.core.snapshot import (
+    ClusterSnapshot,
+    DeepcopyClusterSnapshot,
+    SnapshotNode,
+)
 from nos_tpu.partitioning.core.tracker import SliceTracker
 from nos_tpu.partitioning.core.planner import Planner
 from nos_tpu.partitioning.core.actuator import Actuator
@@ -16,6 +20,7 @@ __all__ = [
     "BoardPartitioning",
     "ClusterSnapshot",
     "ClusterState",
+    "DeepcopyClusterSnapshot",
     "NodePartitioning",
     "PartitioningPlan",
     "PartitioningState",

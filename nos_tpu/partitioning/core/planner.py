@@ -7,11 +7,18 @@ scheduler framework (PreFilter + Filter, planner.go:178-207), and commit the
 fork only if at least one pod landed — otherwise revert. A cheap
 lacking-slices shortcut (planner.go:155-175) avoids the framework run when
 the cluster still cannot serve the pod at all.
+
+All forking rides the snapshot's copy-on-write journal (snapshot.py): a
+candidate-node trial costs one touched-node clone, and the gang trial is a
+nested fork around a whole ``_plan_pass`` instead of a full snapshot
+deepcopy. Geometry carves go through ``snapshot.update_geometry_for`` so
+the journal and the incremental free pool both see them.
 """
 from __future__ import annotations
 
 import logging
-from typing import Iterable, List
+import time
+from typing import Dict, Iterable, List, Tuple
 
 from nos_tpu.kube.objects import Pod
 from nos_tpu.partitioning.core.partition_state import PartitioningState
@@ -22,14 +29,17 @@ from nos_tpu.scheduler.framework import (
     Framework,
     TOPOLOGY_NODE_INFOS_KEY,
 )
+from nos_tpu.util import metrics
 from nos_tpu.util import resources as res
 from nos_tpu.api.v1alpha1 import constants
-from nos_tpu.tpu.topology import Topology
+from nos_tpu.tpu.topology import topology_chips
 
 log = logging.getLogger("nos_tpu.partitioning")
 
 
 def _gang_of(pod: Pod):
+    # Lazy import: scheduler.plugins.gang pulls the KubeStore stack, which
+    # the planner's own dependents don't otherwise need.
     from nos_tpu.scheduler.plugins.gang import gang_of
 
     return gang_of(pod)
@@ -61,15 +71,13 @@ def sort_candidate_pods(
     arrival-time spread inside one batch window never turns the sort into
     FIFO and fresh batches keep the pure largest-first packing order.
     Aging never crosses an explicit priority boundary."""
-    import time as _time
-
-    now = _time.monotonic()
+    now = time.monotonic()
     pending_since = pending_since or {}
 
     def largest_slice_chips(pod: Pod) -> int:
         request = res.compute_pod_request(pod)
         chips = [
-            Topology(constants.tpu_slice_topology(name)).chips
+            topology_chips(constants.tpu_slice_topology(name))
             for name in request
             if constants.is_tpu_slice_resource(name)
         ]
@@ -109,15 +117,27 @@ class Planner:
         # without a sighting (pod bound or deleted).
         self._pending_seen: dict = {}
         self._PENDING_TTL_S = 600.0
+        # (uid, namespaced_name, accelerator) -> normalized simulation pod.
+        # One pod is trialed against many candidate nodes per plan();
+        # normalization only depends on the pod spec and the node's
+        # generation, so the deepcopy+rewrite is done once per pair.
+        # Cleared at every plan() start — pods are immutable within a run.
+        self._sim_pod_cache: Dict[Tuple[str, str, str], Pod] = {}
 
     def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
+        started = time.monotonic()
+        try:
+            return self._plan(snapshot, pending_pods)
+        finally:
+            metrics.PLAN_DURATION.observe(time.monotonic() - started)
+
+    def _plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
         # Pool draw order == claim pre-pass order (first-fit-descending):
         # the tracker and the pre-pass must agree on WHICH pods the
         # existing free slices serve, or a pod could end up neither
         # claim-placed nor carved for this round.
-        import time as _time
-
-        now = _time.monotonic()
+        now = time.monotonic()
+        self._sim_pod_cache.clear()
         # Key includes the uid: a recreated pod with a reused name is a NEW
         # pod and must start at age 0, not inherit its predecessor's boost.
         live = {(p.namespaced_name, p.metadata.uid) for p in pending_pods}
@@ -158,23 +178,25 @@ class Planner:
 
         # Gang fidelity (SURVEY §7 pitfall): a gang member carved for in
         # isolation wastes a slice the gang can never use. Trial-plan on a
-        # scratch copy first; any gang that cannot FULLY form (running
+        # journaled fork first; any gang that cannot FULLY form (running
         # members + trial placements < size) contributes no pods to the
         # real plan, so no board is re-carved for a half-formable gang.
-        # The trial (a full deepcopy + simulation pass) only runs when a
-        # gang pod is actually in the batch.
-        import copy as _copy
-
+        # The trial (an outer fork around a full simulation pass — the
+        # inner per-node forks nest inside it) only runs when a gang pod
+        # is actually in the batch.
         excluded: set = set()
         if any(_gang_of(p) for p in candidates):
-            trial = _copy.deepcopy(snapshot)
-            trial_tracker = SliceTracker(trial, candidates)
+            snapshot.fork()
+            trial_tracker = SliceTracker(snapshot, candidates)
             # _plan_pass claim-places members the current geometry already
             # serves AND simulates re-carve placements; both land in
             # trial_placed, so it is the complete placeability set.
             trial_placed = self._plan_pass(
-                trial, trial_tracker, candidates, quiet=True, aged=aged
+                snapshot, trial_tracker, candidates, quiet=True, aged=aged
             )
+            snapshot.revert()
+            # Counted against the PRISTINE snapshot (post-revert): trial
+            # placements must not double as already-bound members.
             excluded = self._half_formable_gangs(
                 snapshot, candidates, trial_placed
             )
@@ -227,11 +249,12 @@ class Planner:
                 continue
             attempts += 1
             for node_name in snapshot.get_candidate_nodes():
-                node = snapshot.get_node(node_name)
-                accelerator = getattr(node.partitionable, "accelerator", "")
+                accelerator = getattr(
+                    snapshot.get_node(node_name).partitionable, "accelerator", ""
+                )
                 snapshot.fork()
-                if not node.partitionable.update_geometry_for(
-                    tracker.lacking_for(pod, accelerator)
+                if not snapshot.update_geometry_for(
+                    node_name, tracker.lacking_for(pod, accelerator)
                 ):
                     snapshot.revert()
                     continue
@@ -266,11 +289,12 @@ class Planner:
         for node_name in snapshot.get_candidate_nodes():
             if tracker.empty:
                 break
-            node = snapshot.get_node(node_name)
-            accelerator = getattr(node.partitionable, "accelerator", "")
+            accelerator = getattr(
+                snapshot.get_node(node_name).partitionable, "accelerator", ""
+            )
             snapshot.fork()
-            changed = node.partitionable.update_geometry_for(
-                tracker.lacking_totals(accelerator)
+            changed = snapshot.update_geometry_for(
+                node_name, tracker.lacking_totals(accelerator)
             )
             if not changed:
                 snapshot.revert()
@@ -363,14 +387,19 @@ class Planner:
         status = self.framework.run_filter_plugins(state, sim_pod, node.sim_node_info())
         return status.success
 
-    @staticmethod
-    def _simulation_pod(snapshot: ClusterSnapshot, pod: Pod, accelerator: str) -> Pod:
+    def _simulation_pod(self, snapshot: ClusterSnapshot, pod: Pod, accelerator: str) -> Pod:
         """Pod with its TPU request normalized to the candidate node's own
         generation, matching the slice-denominated allocatable of the
-        simulated node view."""
+        simulated node view. Cached per (pod, generation) across the many
+        node trials of one plan() call."""
+        key = (pod.metadata.uid, pod.namespaced_name, accelerator)
+        cached = self._sim_pod_cache.get(key)
+        if cached is not None:
+            return cached
         sim = pod.deepcopy()
         for container in sim.spec.containers:
             container.requests = snapshot.normalize_request(container.requests, accelerator)
         for container in sim.spec.init_containers:
             container.requests = snapshot.normalize_request(container.requests, accelerator)
+        self._sim_pod_cache[key] = sim
         return sim

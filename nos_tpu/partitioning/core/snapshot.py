@@ -4,6 +4,30 @@ Reference internal/partitioning/core/snapshot.go:43-190: copy-on-write over
 map[nodeName]PartitionableNode; GetLackingSlices(pod) = pod request minus
 cluster-wide free resources; GetCandidateNodes = nodes with free capacity
 sorted by name.
+
+Fork is a real copy-on-write journal, matching the reference's semantics
+instead of the deepcopy-the-world port it replaced: ``fork()`` pushes an
+empty per-fork journal, the first touch of a node under a fork clones ONLY
+that ``SnapshotNode`` into the journal (``plan_clone`` on the partitionable
+— board/chip state is copied, the kube Node object is shared), ``revert()``
+restores the journaled originals and ``commit()`` folds the journal into
+the parent fork (or drops it at top level). Fork cost is therefore
+proportional to nodes actually touched in a trial — typically one — not to
+cluster size. Forks nest, which is what lets the planner run its gang
+trial as a journaled fork around a whole ``_plan_pass`` instead of
+deepcopying the entire snapshot.
+
+Contract for mutations while forked: go through the snapshot-level
+mutators (``update_geometry_for`` / ``add_pod``) or mutate a node obtained
+from ``get_node()`` *after* the fork started (``get_node`` journals on
+access). Mutating a node reference captured before ``fork()`` bypasses the
+journal and cannot be reverted.
+
+The cluster-wide free-slice pool is maintained incrementally: computed
+once on first use, then adjusted by the delta each geometry carve or pod
+placement produces on the touched node, and checkpointed/restored across
+fork/revert — ``get_lacking_slices`` (called per pod × node trial) no
+longer walks every node.
 """
 from __future__ import annotations
 
@@ -20,6 +44,8 @@ from nos_tpu.partitioning.core.partition_state import (
     PartitioningState,
 )
 from nos_tpu.scheduler.framework import NodeInfo
+from nos_tpu.tpu.topology import topology_chips
+from nos_tpu.util import metrics
 from nos_tpu.util import resources as res
 
 
@@ -52,6 +78,16 @@ class SnapshotNode:
         self.pods.append(pod)
         return True
 
+    def plan_clone(self) -> "SnapshotNode":
+        """Journal backup: clone the mutable planning state (partitionable
+        geometry + the pods list — Pod objects themselves are never mutated
+        by planning, so they are shared)."""
+        part = self.partitionable
+        clone = part.plan_clone() if hasattr(part, "plan_clone") else copy.deepcopy(part)
+        return SnapshotNode(
+            partitionable=clone, pods=list(self.pods), frozen=self.frozen
+        )
+
 
 class ClusterSnapshot:
     def __init__(
@@ -59,48 +95,105 @@ class ClusterSnapshot:
     ) -> None:
         self._nodes = nodes
         self.codec: SliceCodec = codec or TpuSliceCodec()
-        self._backup: Optional[Dict[str, SnapshotNode]] = None
+        # Fork journal stack: one dict per live fork, node name -> backup
+        # SnapshotNode cloned at first touch under that fork.
+        self._journals: List[Dict[str, SnapshotNode]] = []
+        # Free-pool checkpoint per live fork (None = pool not yet computed
+        # when the fork started, so revert just re-invalidates it).
+        self._pool_backups: List[Optional[ResourceList]] = []
+        self._free_pool: Optional[ResourceList] = None
+        self._accel_cache: Optional[List[str]] = None
         self._sim_cache: Optional[List[NodeInfo]] = None
         self._anti_cache: Optional[bool] = None
 
     # ------------------------------------------------------ fork/commit
 
+    @property
+    def forked(self) -> bool:
+        return bool(self._journals)
+
     def fork(self) -> None:
-        if self._backup is not None:
-            raise RuntimeError("snapshot already forked")
-        self._backup = copy.deepcopy(self._nodes)
+        """Start a (nestable) copy-on-write trial."""
+        self._journals.append({})
+        self._pool_backups.append(
+            dict(self._free_pool) if self._free_pool is not None else None
+        )
         self._sim_cache = None
         self._anti_cache = None
+        metrics.SNAPSHOT_FORKS.inc()
 
     def commit(self) -> None:
-        self._backup = None
+        """Keep the current fork's mutations. Inside a parent fork the
+        journal folds upward (a backup the parent lacks is also the node's
+        state at the parent's fork point — it would have been journaled in
+        the parent had it been touched earlier), so an outer revert still
+        undoes committed inner trials."""
+        if not self._journals:
+            raise RuntimeError("snapshot not forked")
+        journal = self._journals.pop()
+        self._pool_backups.pop()
+        if self._journals:
+            parent = self._journals[-1]
+            for name, backup in journal.items():
+                parent.setdefault(name, backup)
         self._sim_cache = None
         self._anti_cache = None
+        metrics.SNAPSHOT_COMMITS.inc()
+        metrics.FORK_NODES_COPIED.set(len(journal))
 
     def revert(self) -> None:
-        if self._backup is None:
+        """Discard the current fork's mutations by restoring the journaled
+        node backups and the free-pool checkpoint."""
+        if not self._journals:
             raise RuntimeError("snapshot not forked")
-        self._nodes = self._backup
-        self._backup = None
+        journal = self._journals.pop()
+        for name, backup in journal.items():
+            self._nodes[name] = backup
+        self._free_pool = self._pool_backups.pop()
         self._sim_cache = None
         self._anti_cache = None
+        metrics.SNAPSHOT_REVERTS.inc()
+        metrics.FORK_NODES_COPIED.set(len(journal))
+
+    def _touch(self, name: str) -> None:
+        """Journal `name` under the innermost fork before its first
+        mutation (no-op outside forks or when already journaled)."""
+        if not self._journals:
+            return
+        journal = self._journals[-1]
+        if name in journal:
+            return
+        node = self._nodes.get(name)
+        if node is None:
+            return
+        journal[name] = node.plan_clone()
+        metrics.SNAPSHOT_NODES_COPIED.inc()
 
     # --------------------------------------------------------- queries
 
     def get_node(self, name: str) -> Optional[SnapshotNode]:
+        # Journal on access while forked: callers are allowed to mutate the
+        # returned node directly (legacy contract), and a clone here is
+        # cheap — board dicts plus a pods pointer-list.
+        self._touch(name)
         return self._nodes.get(name)
 
     def get_nodes(self) -> Dict[str, SnapshotNode]:
         return self._nodes
 
     def accelerators(self) -> List[str]:
-        return sorted(
-            {
-                n.partitionable.accelerator
-                for n in self._nodes.values()
-                if getattr(n.partitionable, "accelerator", "")
-            }
-        )
+        """Accelerator generations present. Cached for the snapshot's
+        lifetime — the node set is fixed after construction and geometry
+        mutations never change a node's generation."""
+        if self._accel_cache is None:
+            self._accel_cache = sorted(
+                {
+                    n.partitionable.accelerator
+                    for n in self._nodes.values()
+                    if getattr(n.partitionable, "accelerator", "")
+                }
+            )
+        return self._accel_cache
 
     def get_candidate_nodes(self) -> List[str]:
         """Nodes whose geometry could still change or serve slices.
@@ -111,10 +204,8 @@ class ClusterSnapshot:
         whole free boards survive for board-sized requests."""
 
         def free_chips(node) -> int:
-            from nos_tpu.tpu.topology import Topology
-
             return sum(
-                Topology(profile).chips * qty
+                topology_chips(profile) * qty
                 for profile, qty in node.partitionable.free_slices().items()
             )
 
@@ -127,14 +218,41 @@ class ClusterSnapshot:
             if node.partitionable.has_free_capacity() and not node.frozen
         ]
 
-    def free_slice_resources(self) -> ResourceList:
-        """Cluster-wide free slices as a ResourceList."""
+    def _compute_free_pool(self) -> ResourceList:
         total: ResourceList = {}
         for node in self._nodes.values():
             for profile, qty in node.partitionable.free_slices().items():
                 name = self.codec.resource(profile)
                 total[name] = total.get(name, 0) + qty
         return total
+
+    def free_slice_resources(self) -> ResourceList:
+        """Cluster-wide free slices as a ResourceList (a private copy —
+        callers mutate it via take_from_pool). Maintained incrementally by
+        the snapshot-level mutators; invalidate_free_pool() forces a
+        recompute after out-of-band node mutations."""
+        if self._free_pool is None:
+            self._free_pool = self._compute_free_pool()
+        return dict(self._free_pool)
+
+    def invalidate_free_pool(self) -> None:
+        self._free_pool = None
+
+    def _apply_free_delta(self, before: "Dict[str, int]", node: SnapshotNode) -> None:
+        """Fold the change in one node's free slices into the cluster pool."""
+        if self._free_pool is None:
+            return
+        after = node.partitionable.free_slices()
+        for profile in set(before) | set(after):
+            delta = after.get(profile, 0) - before.get(profile, 0)
+            if not delta:
+                continue
+            name = self.codec.resource(profile)
+            updated = self._free_pool.get(name, 0) + delta
+            if updated:
+                self._free_pool[name] = updated
+            else:
+                self._free_pool.pop(name, None)
 
     @staticmethod
     def is_tracked_resource(name: str) -> bool:
@@ -175,9 +293,8 @@ class ClusterSnapshot:
     def sim_node_infos(self) -> List[NodeInfo]:
         """Every node's sim view, for predicates needing cluster-wide
         context (topology spread, inter-pod affinity). Cached until the
-        next fork/commit/revert/add_pod — the planner's mutation points.
-        The planner's geometry re-carve right after fork() is covered
-        because fork invalidates and nothing reads between the two."""
+        next fork/commit/revert or node mutation — the planner's mutation
+        points all invalidate it."""
         if self._sim_cache is None:
             self._sim_cache = [n.sim_node_info() for n in self._nodes.values()]
         return self._sim_cache
@@ -198,12 +315,29 @@ class ClusterSnapshot:
 
     # -------------------------------------------------------- mutation
 
+    def update_geometry_for(self, node_name: str, lacking: ResourceList) -> bool:
+        """Re-carve one node toward `lacking`, journaled and with the free
+        pool kept incremental. The planner's carve entry point."""
+        node = self._nodes.get(node_name)
+        if node is None:
+            return False
+        self._touch(node_name)
+        before = dict(node.partitionable.free_slices())
+        changed = node.partitionable.update_geometry_for(lacking)
+        if changed:
+            self._apply_free_delta(before, node)
+            self._sim_cache = None
+        return changed
+
     def add_pod(self, node_name: str, pod: Pod) -> bool:
         node = self._nodes.get(node_name)
         if node is None:
             return False
+        self._touch(node_name)
+        before = dict(node.partitionable.free_slices())
         added = node.add_pod(pod)
         if added:
+            self._apply_free_delta(before, node)
             self._sim_cache = None
             self._anti_cache = None
         return added
@@ -225,3 +359,53 @@ class ClusterSnapshot:
             ]
             out[name] = NodePartitioning(boards=boards)
         return out
+
+
+class DeepcopyClusterSnapshot(ClusterSnapshot):
+    """The pre-CoW fork semantics: deepcopy the whole node map per fork and
+    recompute every cluster-wide aggregate on demand.
+
+    Kept as the oracle for the CoW property tests and as the measurable
+    baseline for ``bench_planner`` — byte-for-byte the same observable
+    behavior as ClusterSnapshot, at the old O(cluster) cost per trial.
+    """
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._deep_stack: List[Dict[str, SnapshotNode]] = []
+
+    def fork(self) -> None:
+        self._deep_stack.append(copy.deepcopy(self._nodes))
+        self._sim_cache = None
+        self._anti_cache = None
+
+    def commit(self) -> None:
+        if not self._deep_stack:
+            raise RuntimeError("snapshot not forked")
+        self._deep_stack.pop()
+        self._sim_cache = None
+        self._anti_cache = None
+
+    def revert(self) -> None:
+        if not self._deep_stack:
+            raise RuntimeError("snapshot not forked")
+        self._nodes = self._deep_stack.pop()
+        self._sim_cache = None
+        self._anti_cache = None
+
+    @property
+    def forked(self) -> bool:
+        return bool(self._deep_stack)
+
+    def _touch(self, name: str) -> None:  # deepcopy fork needs no journal
+        return
+
+    def accelerators(self) -> List[str]:
+        self._accel_cache = None
+        return super().accelerators()
+
+    def free_slice_resources(self) -> ResourceList:
+        return self._compute_free_pool()
+
+    def _apply_free_delta(self, before, node) -> None:  # always recomputed
+        return

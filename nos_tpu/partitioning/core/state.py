@@ -4,11 +4,18 @@ Reference internal/partitioning/state/state.go:29-222: NodeInfo per node,
 pod→node bindings, and a count of nodes per partitioning kind so controllers
 can cheaply check whether a mode is enabled at all
 (partitioner_controller.go:83 IsPartitioningEnabled).
+
+Two read paths: ``get_node``/``get_nodes`` hand out deepcopies the caller
+may mutate freely, while ``read_view`` is the copy-on-read path for the
+snapshot takers — it copies only the containers (dict + pod lists) and
+shares the Node/Pod objects, which is safe because the state never mutates
+a stored object in place (updates replace whole objects; ``remove_pod``
+rebinds the list). One reconcile no longer deepcopies the whole cluster.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from nos_tpu.api.v1alpha1 import labels as labels_api
 from nos_tpu.kube.objects import Node, Pod
@@ -20,9 +27,26 @@ class ClusterState:
         self._lock = threading.RLock()
         self._nodes: Dict[str, NodeInfo] = {}
         self._bindings: Dict[str, str] = {}  # "ns/name" -> node name
+        # node name -> pod keys bound there; the reverse of _bindings, so
+        # node deletion is O(pods on that node) instead of a rebuild of
+        # the whole bindings dict.
+        self._node_pods: Dict[str, Set[str]] = {}
         self._kind_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------ updates
+
+    def _bind(self, key: str, node_name: str) -> None:
+        previous = self._bindings.get(key)
+        if previous is not None and previous != node_name:
+            self._node_pods.get(previous, set()).discard(key)
+        self._bindings[key] = node_name
+        self._node_pods.setdefault(node_name, set()).add(key)
+
+    def _unbind(self, key: str) -> Optional[str]:
+        node_name = self._bindings.pop(key, None)
+        if node_name is not None:
+            self._node_pods.get(node_name, set()).discard(key)
+        return node_name
 
     def update_node(self, node: Node, pods: List[Pod]) -> None:
         with self._lock:
@@ -32,7 +56,7 @@ class ClusterState:
             info = NodeInfo(node=node.deepcopy())
             for pod in pods:
                 info.add_pod(pod.deepcopy())
-                self._bindings[pod.namespaced_name] = node.metadata.name
+                self._bind(pod.namespaced_name, node.metadata.name)
             self._nodes[node.metadata.name] = info
             self._add_kind(node)
 
@@ -42,9 +66,8 @@ class ClusterState:
             if info is None:
                 return
             self._remove_kind(info.node)
-            self._bindings = {
-                k: v for k, v in self._bindings.items() if v != node_name
-            }
+            for key in self._node_pods.pop(node_name, set()):
+                self._bindings.pop(key, None)
 
     def update_pod_usage(self, pod: Pod) -> None:
         """Track a pod's binding on node events (reference
@@ -55,21 +78,20 @@ class ClusterState:
             previous = self._bindings.get(key)
             if previous and previous != node_name and previous in self._nodes:
                 self._nodes[previous].remove_pod(pod)
-                del self._bindings[key]
+                self._unbind(key)
             if not node_name or node_name not in self._nodes:
                 return
             info = self._nodes[node_name]
             info.remove_pod(pod)  # replace stale copy
             if pod.status.phase in ("Succeeded", "Failed"):
-                self._bindings.pop(key, None)
+                self._unbind(key)
                 return
             info.add_pod(pod.deepcopy())
-            self._bindings[key] = node_name
+            self._bind(key, node_name)
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:
-            key = pod.namespaced_name
-            node_name = self._bindings.pop(key, None)
+            node_name = self._unbind(pod.namespaced_name)
             if node_name and node_name in self._nodes:
                 self._nodes[node_name].remove_pod(pod)
 
@@ -88,6 +110,18 @@ class ClusterState:
                 name: NodeInfo(
                     node=info.node.deepcopy(), pods=[p.deepcopy() for p in info.pods]
                 )
+                for name, info in self._nodes.items()
+            }
+
+    def read_view(self) -> Dict[str, NodeInfo]:
+        """Point-in-time READ-ONLY view sharing the stored Node/Pod objects
+        (containers copied under the lock). Consumers must not mutate the
+        objects — the snapshot takers qualify: TpuNode/SharingNode with
+        ``owned=True`` never write through to the kube Node, and
+        ``to_sim_node`` deepcopies before rewriting allocatable."""
+        with self._lock:
+            return {
+                name: NodeInfo(node=info.node, pods=list(info.pods))
                 for name, info in self._nodes.items()
             }
 

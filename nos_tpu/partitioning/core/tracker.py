@@ -42,32 +42,14 @@ class SliceTracker:
     def pods_with_lacking_slices(self) -> List[str]:
         return sorted(self._lacking)
 
-    def lacking_totals(self, accelerator: str = "") -> ResourceList:
-        """Aggregate lacking resources. With `accelerator`, each pod's
-        plain-chip lack is converted to that generation's slice profile
-        (per pod — two 4-chip pods are two 2x2 slices, not one 2x4), so a
-        candidate node of that generation knows what to carve."""
-        total: ResourceList = {}
-        for lacking in self._lacking.values():
-            entry = dict(lacking)
-            plain = int(entry.pop(constants.RESOURCE_TPU, 0))
-            if plain > 0 and accelerator:
-                profile = profile_for_chips(plain, accelerator)
-                if profile is not None:
-                    name = constants.tpu_slice_resource(profile)
-                    entry[name] = entry.get(name, 0) + 1
-                # None: bigger than any single-board profile — multi-host
-                # gang territory, nothing a board carve can serve.
-            elif plain > 0:
-                entry[constants.RESOURCE_TPU] = plain
-            total = res.sum_resources(total, entry)
-        return total
-
-    def lacking_for(self, pod: Pod, accelerator: str = "") -> ResourceList:
-        """One pod's lacking resources, plain chips converted to the
-        accelerator's slice profile (same convention as lacking_totals) —
-        what a dedicated carve for exactly this pod should aim at."""
-        entry = dict(self._lacking.get(_pod_key(pod), {}))
+    @staticmethod
+    def _convert_plain(lacking: ResourceList, accelerator: str) -> ResourceList:
+        """Convert one pod's plain-chip lack to the accelerator's slice
+        profile (per pod — two 4-chip pods are two 2x2 slices, not one
+        2x4). A profile_for_chips miss means the request is bigger than any
+        single-board profile — multi-host gang territory, nothing a board
+        carve can serve — so the plain lack is dropped for that node."""
+        entry = dict(lacking)
         plain = int(entry.pop(constants.RESOURCE_TPU, 0))
         if plain > 0 and accelerator:
             profile = profile_for_chips(plain, accelerator)
@@ -77,6 +59,21 @@ class SliceTracker:
         elif plain > 0:
             entry[constants.RESOURCE_TPU] = plain
         return entry
+
+    def lacking_totals(self, accelerator: str = "") -> ResourceList:
+        """Aggregate lacking resources. With `accelerator`, each pod's
+        plain-chip lack is converted to that generation's slice profile, so
+        a candidate node of that generation knows what to carve."""
+        total: ResourceList = {}
+        for lacking in self._lacking.values():
+            total = res.sum_resources(total, self._convert_plain(lacking, accelerator))
+        return total
+
+    def lacking_for(self, pod: Pod, accelerator: str = "") -> ResourceList:
+        """One pod's lacking resources, plain chips converted to the
+        accelerator's slice profile (same convention as lacking_totals) —
+        what a dedicated carve for exactly this pod should aim at."""
+        return self._convert_plain(self._lacking.get(_pod_key(pod), {}), accelerator)
 
     def remove(self, pod: Pod) -> None:
         self._lacking.pop(_pod_key(pod), None)

@@ -26,9 +26,10 @@ class SharingSnapshotTaker:
         if store is not None:
             view = live_cluster_view(store)
         else:
+            # Copy-on-read path — see TpuSnapshotTaker.
             view = {
                 name: (info.node, list(info.pods))
-                for name, info in state.get_nodes().items()
+                for name, info in state.read_view().items()
             }
         nodes: Dict[str, SnapshotNode] = {}
         for name, (node, pods) in view.items():

@@ -33,11 +33,16 @@ def live_cluster_view(store) -> "Dict[str, tuple]":
     (client-go shared informers). Our ClusterState is a separately-updated
     copy, so planning from it adds a staleness window the reference never
     had — plans computed there race fresh binds and get clamped by the
-    agent. Planning from the store closes the window."""
+    agent. Planning from the store closes the window.
+
+    Read without copying: the store replaces objects on every write and
+    never mutates them in place, and the planning pipeline treats them as
+    read-only (owned nodes deepcopy before any rewrite), so the per-
+    reconcile deepcopy of every Node and Pod is pure waste."""
     out: Dict[str, tuple] = {}
-    for node in store.list("Node"):
+    for node in store.list("Node", copy=False):
         out[node.metadata.name] = (node, [])
-    for pod in store.list("Pod"):
+    for pod in store.list("Pod", copy=False):
         if pod.spec.node_name in out and pod.status.phase in ("Pending", "Running"):
             out[pod.spec.node_name][1].append(pod)
     return out
@@ -48,9 +53,13 @@ class TpuSnapshotTaker:
         if store is not None:
             view = live_cluster_view(store)
         else:
+            # Copy-on-read: shares the state's Node/Pod objects; this
+            # pipeline only reads them (owned TpuNodes deepcopy before any
+            # node rewrite), so deepcopying the cluster per reconcile is
+            # pure waste.
             view = {
                 name: (info.node, list(info.pods))
-                for name, info in state.get_nodes().items()
+                for name, info in state.read_view().items()
             }
         nodes: Dict[str, SnapshotNode] = {}
         for name, (node, pods) in view.items():

@@ -19,7 +19,7 @@ from nos_tpu.tpu.geometry import (
     geometry_subtract,
 )
 from nos_tpu.tpu.known import KNOWN_ACCELERATORS, allowed_geometries
-from nos_tpu.tpu.topology import Topology
+from nos_tpu.tpu.topology import topology_chips
 
 
 class TpuBoard:
@@ -49,9 +49,7 @@ class TpuBoard:
 
     @property
     def chips(self) -> int:
-        from nos_tpu.tpu.topology import Topology
-
-        return Topology(self.board_topology).chips
+        return topology_chips(self.board_topology)
 
     @property
     def used_chips(self) -> int:
@@ -69,6 +67,18 @@ class TpuBoard:
 
     def clone(self) -> "TpuBoard":
         return copy.deepcopy(self)
+
+    def plan_clone(self) -> "TpuBoard":
+        """Cheap clone for snapshot fork journals: the only state a planning
+        trial mutates is used/free, so copying those two small dicts (the
+        constructor already does) is a full clone."""
+        return TpuBoard(
+            index=self.index,
+            accelerator=self.accelerator,
+            used=self.used,
+            free=self.free,
+            board_topology=self.board_topology,
+        )
 
     # ---------------------------------------------------------- mutation
 
@@ -116,12 +126,12 @@ class TpuBoard:
             free_after = geometry_subtract(geometry, self.used)
             return sum(
                 min(free_after.get(p, 0), self.free.get(p, 0) + n)
-                * Topology(p).chips
+                * topology_chips(p)
                 for p, n in wanted.items()
             )
 
         current_score = sum(
-            self.free.get(p, 0) * Topology(p).chips for p in wanted
+            self.free.get(p, 0) * topology_chips(p) for p in wanted
         )
         best: Optional[Geometry] = None
         best_score = current_score

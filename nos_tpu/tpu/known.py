@@ -11,6 +11,7 @@ overridden wholesale for exotic deployments.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Dict, List, Optional, Tuple
 
 from nos_tpu.tpu.geometry import Geometry
@@ -128,9 +129,14 @@ def board_layout(accelerator: str, capacity_chips: int) -> List[str]:
     capacity no combination models (or 0 — device plugin not registered
     yet) yields [] so the planner never carves phantom chips.
     """
+    return list(_board_layout(accelerator, capacity_chips))
+
+
+@lru_cache(maxsize=4096)
+def _board_layout(accelerator: str, capacity_chips: int) -> Tuple[str, ...]:
     spec = KNOWN_ACCELERATORS.get(accelerator)
     if spec is None or capacity_chips <= 0:
-        return []
+        return ()
     layouts: List[str] = []
     remaining = capacity_chips
     while remaining >= spec.board_chips:
@@ -143,12 +149,13 @@ def board_layout(accelerator: str, capacity_chips: int) -> List[str]:
             if Topology(s).chips == remaining
         ]
         if not exact:
-            return []
+            return ()
         # Largest-area shapes are equal here; pick deterministic first.
         layouts.append(sorted(exact)[0])
-    return layouts
+    return tuple(layouts)
 
 
+@lru_cache(maxsize=4096)
 def profile_for_chips(chips: int, accelerator: str) -> Optional[str]:
     """Smallest slice profile of `accelerator` with ≥ `chips` chips.
 

@@ -102,6 +102,20 @@ class TpuNode:
     def clone(self) -> "TpuNode":
         return copy.deepcopy(self)
 
+    def plan_clone(self) -> "TpuNode":
+        """Cheap clone for snapshot fork journals. Planning mutates only
+        board used/free state, never the underlying kube Node (to_sim_node
+        deepcopies before rewriting), so the Node object is shared and only
+        the boards are copied — this is what makes CoW fork cost
+        proportional to touched nodes, not cluster object graphs."""
+        clone = object.__new__(TpuNode)
+        clone.name = self.name
+        clone.node = self.node
+        clone.accelerator = self.accelerator
+        clone.consistent = self.consistent
+        clone.boards = [b.plan_clone() for b in self.boards]
+        return clone
+
     # ---------------------------------------------------------- mutation
 
     def update_geometry_for(self, lacking_slices: ResourceList) -> bool:

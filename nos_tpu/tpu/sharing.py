@@ -62,6 +62,13 @@ class SharedChip:
     def has_free_capacity(self) -> bool:
         return bool(self.free) or self.spare_memory_gb() >= constants.MIN_SHARED_SLICE_GB
 
+    def plan_clone(self) -> "SharedChip":
+        """Cheap clone for snapshot fork journals (used/free are the only
+        mutable state; the constructor copies both dicts)."""
+        return SharedChip(
+            index=self.index, hbm_gb=self.hbm_gb, used=self.used, free=self.free
+        )
+
     # ---------------------------------------------------------- mutation
 
     def _create(self, profile: str, quantity: int = 1) -> int:
@@ -223,6 +230,18 @@ class SharingNode:
 
     def clone(self) -> "SharingNode":
         return copy.deepcopy(self)
+
+    def plan_clone(self) -> "SharingNode":
+        """Cheap clone for snapshot fork journals — chip used/free state is
+        copied, the kube Node (never mutated by planning) is shared. See
+        TpuNode.plan_clone."""
+        clone = object.__new__(SharingNode)
+        clone.name = self.name
+        clone.node = self.node
+        clone.accelerator = self.accelerator
+        clone.consistent = self.consistent
+        clone.chips = [c.plan_clone() for c in self.chips]
+        return clone
 
     # ---------------------------------------------------------- mutation
 

@@ -61,6 +61,22 @@ class Topology:
         return sorted(set(itertools.permutations(self.dims)))
 
 
+@lru_cache(maxsize=4096)
+def parse_topology(spec: str) -> Topology:
+    """Memoized Topology parse. Profile strings recur endlessly in the
+    planner's hot paths (every free slice of every node per candidate
+    scan), and Topology is immutable after construction, so instances are
+    safe to share."""
+    return Topology(spec)
+
+
+@lru_cache(maxsize=4096)
+def topology_chips(spec: str) -> int:
+    """Chip count of a profile string, memoized — the single most frequent
+    topology query in the partitioning engine."""
+    return parse_topology(spec).chips
+
+
 def _cells(dims: Tuple[int, ...]) -> List[Tuple[int, ...]]:
     return list(itertools.product(*(range(d) for d in dims)))
 

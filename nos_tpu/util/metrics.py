@@ -182,6 +182,34 @@ GANGS_SCHEDULED = REGISTRY.counter(
 SCHEDULE_LATENCY = REGISTRY.histogram(
     "nos_tpu_schedule_latency_seconds", "Per-pod scheduling cycle latency"
 )
+
+# Partitioner planning loop (the nos_scheduling_latency north star). The
+# fork/revert/commit counters plus the nodes-copied gauge make the CoW
+# snapshot's touched-node economics visible in scraped metrics: nodes
+# copied per fork should hover near 1 regardless of cluster size, and a
+# regression back toward O(cluster) copying shows up immediately.
+PLAN_DURATION = REGISTRY.histogram(
+    "nos_tpu_plan_duration_seconds",
+    "Planner.plan() wall time per invocation",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
+SNAPSHOT_FORKS = REGISTRY.counter(
+    "nos_tpu_snapshot_forks_total", "Snapshot forks started by the planner"
+)
+SNAPSHOT_COMMITS = REGISTRY.counter(
+    "nos_tpu_snapshot_commits_total", "Snapshot forks committed (trial kept)"
+)
+SNAPSHOT_REVERTS = REGISTRY.counter(
+    "nos_tpu_snapshot_reverts_total", "Snapshot forks reverted (trial discarded)"
+)
+SNAPSHOT_NODES_COPIED = REGISTRY.counter(
+    "nos_tpu_snapshot_nodes_copied_total",
+    "SnapshotNodes cloned into fork journals (CoW touched-node copies)",
+)
+FORK_NODES_COPIED = REGISTRY.gauge(
+    "nos_tpu_snapshot_fork_nodes_copied",
+    "Nodes cloned by the most recently ended fork (commit or revert)",
+)
 MULTIHOST_EXPANSIONS = REGISTRY.counter(
     "nos_tpu_multihost_expansions_total",
     "Oversized chip requests expanded into multi-host slice gangs",

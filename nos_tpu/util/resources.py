@@ -11,7 +11,7 @@ from __future__ import annotations
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.objects import Pod, ResourceList
 from nos_tpu.tpu.known import profile_for_chips
-from nos_tpu.tpu.topology import Topology
+from nos_tpu.tpu.topology import topology_chips
 
 
 def sum_resources(a: ResourceList, b: ResourceList) -> ResourceList:
@@ -62,7 +62,7 @@ def tpu_chips_in(request: ResourceList) -> int:
     chips = int(request.get(constants.RESOURCE_TPU, 0))
     for name, qty in request.items():
         if constants.is_tpu_slice_resource(name):
-            chips += Topology(constants.tpu_slice_topology(name)).chips * int(qty)
+            chips += topology_chips(constants.tpu_slice_topology(name)) * int(qty)
     return chips
 
 

@@ -1,0 +1,34 @@
+"""Perf smoke for the CoW planner (slow tier; tier-1 runs -m 'not slow').
+
+Guards the headline of the copy-on-write snapshot engine: a 64-node /
+200-pending-pod plan() — the BENCH_planner.json reference config, ~90ms
+p50 on a dev box — must stay well under a generous wall-clock bound even
+on loaded CI. The deepcopy baseline at this scale is ~0.9s/plan, so the
+bound also catches an accidental return to O(cluster) forking.
+"""
+import time
+
+import pytest
+
+from bench_planner import make_cluster, make_pending
+from nos_tpu.partitioning.core import ClusterSnapshot, Planner
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit, NodeSelectorFit
+
+PLAN_BOUND_SECONDS = 30.0
+
+
+@pytest.mark.slow
+def test_plan_64_nodes_200_pods_within_bound():
+    planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+    # Warm parse/profile caches so the bound measures plan(), not imports.
+    planner.plan(make_cluster(8, ClusterSnapshot), make_pending(10))
+
+    snapshot = make_cluster(64, ClusterSnapshot)
+    pods = make_pending(200)
+    started = time.perf_counter()
+    plan = planner.plan(snapshot, pods)
+    elapsed = time.perf_counter() - started
+
+    assert elapsed < PLAN_BOUND_SECONDS, f"plan() took {elapsed:.2f}s"
+    assert plan is not None
+    assert not snapshot.forked

@@ -36,16 +36,51 @@ class TestForkCommitRevert:
         snap.commit()
         assert snap.get_node("n1").partitionable.geometry() == {0: {"2x2": 2}}
 
-    def test_double_fork_raises(self):
+    def test_nested_fork_revert_restores_each_level(self):
+        # Forks nest (the gang trial wraps a whole plan pass in an outer
+        # fork): inner revert restores the inner fork point, outer revert
+        # restores the pristine state — including inner COMMITTED work.
         snap = snapshot_of(build_tpu_node(name="n1"))
         snap.fork()
-        with pytest.raises(RuntimeError):
-            snap.fork()
+        assert snap.update_geometry_for("n1", {slice_res("2x4"): 1})
+        snap.fork()
+        assert snap.update_geometry_for("n1", {slice_res("2x2"): 2})
+        snap.revert()
+        assert snap.get_node("n1").partitionable.geometry() == {0: {"2x4": 1}}
+        snap.fork()
+        assert snap.update_geometry_for("n1", {slice_res("2x2"): 2})
+        snap.commit()
+        assert snap.get_node("n1").partitionable.geometry() == {0: {"2x2": 2}}
+        snap.revert()
+        assert snap.get_node("n1").partitionable.geometry() == {0: {}}
 
     def test_revert_without_fork_raises(self):
         snap = snapshot_of(build_tpu_node(name="n1"))
         with pytest.raises(RuntimeError):
             snap.revert()
+
+    def test_commit_without_fork_raises(self):
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        with pytest.raises(RuntimeError):
+            snap.commit()
+
+    def test_free_pool_tracks_fork_lifecycle(self):
+        # The incremental free pool must match a from-scratch recompute
+        # across carve → revert and carve → commit.
+        snap = snapshot_of(build_tpu_node(name="n1"))
+        assert snap.free_slice_resources() == {}
+        snap.fork()
+        assert snap.update_geometry_for("n1", {slice_res("2x2"): 2})
+        assert snap.free_slice_resources() == {slice_res("2x2"): 2}
+        snap.revert()
+        assert snap.free_slice_resources() == {}
+        snap.fork()
+        assert snap.update_geometry_for("n1", {slice_res("2x2"): 2})
+        snap.commit()
+        assert snap.free_slice_resources() == {slice_res("2x2"): 2}
+        pod = build_pod("p", {slice_res("2x2"): 1})
+        assert snap.add_pod("n1", pod)
+        assert snap.free_slice_resources() == {slice_res("2x2"): 1}
 
 
 class TestLackingSlices:

@@ -1,0 +1,196 @@
+"""CoW snapshot engine vs deepcopy oracle.
+
+Property test: randomized fork → mutate (geometry carve, add_pod) →
+revert/commit sequences applied in lockstep to the journaled
+ClusterSnapshot and to DeepcopyClusterSnapshot (the pre-CoW semantics kept
+as an oracle). After every fork-ending op — and at the end — the two must
+be byte-for-byte equivalent on every observable: geometry, free pool,
+placed pods, candidate order, and the projected PartitioningState.
+
+Plus a plan() regression: the full planner, run on both snapshot
+implementations over randomized clusters and pending-pod batches
+(including gangs), must produce identical PartitioningState and identical
+placements.
+"""
+import random
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.partitioning.core import (
+    ClusterSnapshot,
+    DeepcopyClusterSnapshot,
+    Planner,
+    SnapshotNode,
+    partitioning_state_equal,
+)
+from nos_tpu.scheduler.framework import Framework, NodeResourcesFit, NodeSelectorFit
+from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+PROFILES = ["1x1", "1x2", "2x2", "2x4"]
+
+
+def build_cluster(rng, snapshot_cls):
+    """Deterministic cluster from `rng`'s current state — call twice with
+    identically-seeded rngs to get twin clusters."""
+    nodes = {}
+    for i in range(rng.randint(3, 6)):
+        name = f"n{i}"
+        style = rng.random()
+        if style < 0.4:
+            annotations = None  # virgin board
+        elif style < 0.7:
+            annotations = annot.status_from_devices(
+                free={0: {rng.choice(PROFILES): 1}}, used={}
+            )
+        else:
+            annotations = annot.status_from_devices(
+                free={0: {"2x2": 1}}, used={0: {"2x2": 1}}
+            )
+        node = build_tpu_node(name=name, annotations=annotations)
+        nodes[name] = SnapshotNode(partitionable=TpuNode(node))
+    return snapshot_cls(nodes)
+
+
+def canonical(snap):
+    """Full observable state, in a canonically-ordered form."""
+    out = {}
+    for name in sorted(snap.get_nodes()):
+        node = snap.get_nodes()[name]
+        out[name] = (
+            sorted(
+                (i, tuple(sorted(g.items())))
+                for i, g in node.partitionable.geometry().items()
+            ),
+            tuple(sorted(node.partitionable.free_slices().items())),
+            tuple(p.namespaced_name for p in node.pods),
+            node.frozen,
+        )
+    return (
+        out,
+        tuple(sorted(snap.free_slice_resources().items())),
+        tuple(snap.get_candidate_nodes()),
+    )
+
+
+def assert_equivalent(cow, oracle, context=""):
+    assert canonical(cow) == canonical(oracle), context
+    assert partitioning_state_equal(
+        cow.partitioning_state(), oracle.partitioning_state()
+    ), context
+
+
+def random_lacking(rng):
+    return {slice_res(rng.choice(PROFILES)): rng.randint(1, 2)}
+
+
+class TestCowPropertyVsDeepcopyOracle:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_fork_mutate_revert_sequences(self, seed):
+        rng_ops = random.Random(seed)
+        cow = build_cluster(random.Random(1000 + seed), ClusterSnapshot)
+        oracle = build_cluster(random.Random(1000 + seed), DeepcopyClusterSnapshot)
+        assert_equivalent(cow, oracle, f"seed={seed} initial")
+
+        depth = 0
+        pod_serial = 0
+        for step in range(60):
+            context = f"seed={seed} step={step}"
+            roll = rng_ops.random()
+            if roll < 0.2 and depth < 3:
+                cow.fork()
+                oracle.fork()
+                depth += 1
+            elif roll < 0.35 and depth > 0:
+                cow.revert()
+                oracle.revert()
+                depth -= 1
+                assert_equivalent(cow, oracle, context + " after revert")
+            elif roll < 0.45 and depth > 0:
+                cow.commit()
+                oracle.commit()
+                depth -= 1
+                assert_equivalent(cow, oracle, context + " after commit")
+            elif roll < 0.75:
+                name = f"n{rng_ops.randint(0, 7)}"  # may not exist: both no-op
+                lacking = random_lacking(rng_ops)
+                assert cow.update_geometry_for(
+                    name, dict(lacking)
+                ) == oracle.update_geometry_for(name, dict(lacking)), context
+            else:
+                name = f"n{rng_ops.randint(0, 7)}"
+                profile = rng_ops.choice(PROFILES)
+                pod_serial += 1
+                pod = build_pod(f"p{pod_serial}", {slice_res(profile): 1})
+                assert cow.add_pod(name, pod) == oracle.add_pod(
+                    name, pod.deepcopy()
+                ), context
+            # Interleave reads so caches exist when forks end.
+            cow.get_lacking_slices(build_pod("probe", {slice_res("2x2"): 1}))
+            oracle.get_lacking_slices(build_pod("probe", {slice_res("2x2"): 1}))
+
+        while depth > 0:
+            cow.revert()
+            oracle.revert()
+            depth -= 1
+        assert_equivalent(cow, oracle, f"seed={seed} final")
+
+    def test_direct_node_mutation_after_fork_is_reverted(self):
+        # Legacy contract: a node obtained from get_node() AFTER fork() may
+        # be mutated directly; get_node journals on access.
+        cow = build_cluster(random.Random(7), ClusterSnapshot)
+        oracle = build_cluster(random.Random(7), DeepcopyClusterSnapshot)
+        for snap in (cow, oracle):
+            snap.fork()
+            node = snap.get_node("n0")
+            node.partitionable.update_geometry_for({slice_res("1x1"): 4})
+            node.add_pod(build_pod("direct", {slice_res("1x1"): 1}))
+            snap.revert()
+        assert_equivalent(cow, oracle, "after direct-mutation revert")
+
+
+def make_planner():
+    return Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+
+
+def random_pending_pods(rng):
+    pods = []
+    for i in range(rng.randint(2, 10)):
+        style = rng.random()
+        if style < 0.5:
+            req = {slice_res(rng.choice(PROFILES)): 1}
+        elif style < 0.8:
+            req = {constants.RESOURCE_TPU: rng.choice([1, 2, 4, 8])}
+        else:
+            req = {slice_res("1x1"): 1, "cpu": 1}
+        pod = build_pod(f"pend-{i}", req, priority=rng.choice([0, 0, 0, 10]))
+        if rng.random() < 0.25:
+            pod.metadata.labels["nos.nebuly.com/gang"] = f"g{rng.randint(0, 1)}"
+            pod.metadata.labels["nos.nebuly.com/gang-size"] = str(rng.randint(1, 3))
+        pods.append(pod)
+    return pods
+
+
+class TestPlanOutputUnchangedVsDeepcopyBaseline:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_plan_identical_on_random_scenarios(self, seed):
+        cow = build_cluster(random.Random(2000 + seed), ClusterSnapshot)
+        base = build_cluster(random.Random(2000 + seed), DeepcopyClusterSnapshot)
+        pods = random_pending_pods(random.Random(3000 + seed))
+        plan_cow = make_planner().plan(cow, [p.deepcopy() for p in pods])
+        plan_base = make_planner().plan(base, [p.deepcopy() for p in pods])
+        assert partitioning_state_equal(plan_cow, plan_base), f"seed={seed}"
+        placed_cow = {
+            n: [p.namespaced_name for p in node.pods]
+            for n, node in cow.get_nodes().items()
+        }
+        placed_base = {
+            n: [p.namespaced_name for p in node.pods]
+            for n, node in base.get_nodes().items()
+        }
+        assert placed_cow == placed_base, f"seed={seed}"
+        # No fork left dangling by the planner.
+        assert not cow.forked and not base.forked
