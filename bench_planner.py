@@ -136,6 +136,26 @@ def bench_config(engine: str, n_nodes: int, n_pods: int, repeats: int) -> dict:
     }
 
 
+def export_sample_trace(path: str) -> None:
+    """One traced plan() over the 16x50 config, exported as Chrome
+    trace-event JSON — the 'open this in Perfetto' artifact next to the
+    latency numbers."""
+    from nos_tpu.util.tracing import TRACER
+
+    TRACER.reset()
+    snapshot = make_cluster(16, ClusterSnapshot)
+    planner = Planner(
+        Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+    )
+    planner.plan(snapshot, make_pending(50))
+    traces = TRACER.store.list()
+    if not traces:
+        return
+    with open(path, "w") as fh:
+        json.dump(traces[0].to_chrome(), fh, indent=2)
+    print(f"sample trace -> {path}", flush=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--engines", default="cow,deepcopy")
@@ -147,6 +167,12 @@ def main() -> None:
     parser.add_argument("--repeats", type=int, default=5)
     parser.add_argument("--quick", action="store_true", help="16x50 only, 2 repeats")
     parser.add_argument("--output", default="", help="also append JSON lines to file")
+    parser.add_argument(
+        "--trace-output",
+        default="",
+        help="write a sample plan() trace (Chrome trace-event JSON) here; "
+        "defaults to <output-stem>_trace.json when --output is set",
+    )
     args = parser.parse_args()
 
     configs = [tuple(map(int, c.split("x"))) for c in args.configs.split(",")]
@@ -189,6 +215,12 @@ def main() -> None:
         with open(args.output, "a") as fh:
             for result in results:
                 fh.write(json.dumps(result) + "\n")
+    trace_path = args.trace_output
+    if not trace_path and args.output:
+        stem = args.output[:-5] if args.output.endswith(".json") else args.output
+        trace_path = f"{stem}_trace.json"
+    if trace_path:
+        export_sample_trace(trace_path)
 
 
 if __name__ == "__main__":

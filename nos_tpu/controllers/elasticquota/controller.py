@@ -62,6 +62,15 @@ class _QuotaReconcilerBase:
         return pods
 
     def _reconcile_quota(self, quota, namespaces: List[str]) -> None:
+        from nos_tpu.util.tracing import TRACER
+
+        with TRACER.span(
+            "elasticquota.reconcile",
+            quota=f"{quota.metadata.namespace}/{quota.metadata.name}",
+        ):
+            self._reconcile_quota_traced(quota, namespaces)
+
+    def _reconcile_quota_traced(self, quota, namespaces: List[str]) -> None:
         pods = sort_pods_for_quota(self._running_pods(namespaces))
         min_resources = quota.spec.min
         used: ResourceList = {}

@@ -30,6 +30,7 @@ from nos_tpu.partitioning.core import (
 from nos_tpu.util import metrics
 from nos_tpu.util import pod as podutil
 from nos_tpu.util.batcher import Batcher
+from nos_tpu.util.tracing import TRACER
 
 log = logging.getLogger("nos_tpu.partitioner")
 
@@ -104,6 +105,15 @@ class PartitionerController:
         # gate at partitioner_controller.go:118-122) — batching proceeds;
         # the planner simply cannot carve an in-flight node again.
         log.debug("%s: added to %s batch", pod.namespaced_name, self.kind)
+        # First observation starts the pod's journey trace (observe→bind);
+        # the scheduler and the batch processor parent their stages on it.
+        root = TRACER.journey_root(
+            ("pod", pod.namespaced_name),
+            "pod.journey",
+            pod=pod.namespaced_name,
+            namespace=pod.metadata.namespace,
+        )
+        root.add_event("partitioner.observed", kind=self.kind)
         self.batcher.add(pod.namespaced_name)
         return None
 
@@ -239,15 +249,29 @@ class PartitionerController:
         pending = self.fetch_pending_pods()
         if not pending:
             return 0
-        # Snapshot from the live store: pending pods come from the store,
-        # so bindings/usage must too, or the plan races fresh binds.
-        snapshot = self.snapshot_taker.take_snapshot(
-            self.cluster_state, store=self.store
-        )
-        current = snapshot.partitioning_state()
-        desired = self.planner.plan(snapshot, pending)
-        plan = PartitioningPlan(desired_state=desired, id=self.plan_id_fn())
-        applied = self.actuator.apply(current, plan)
+        # One batch serves N pods but a span belongs to one trace: the
+        # processing stages are parented on the FIRST pending pod's journey
+        # (batch-mates still correlate through the shared plan id
+        # attribute on their own scheduler cycles).
+        journey = TRACER.journey(("pod", pending[0].namespaced_name))
+        with TRACER.attach(journey):
+            with TRACER.span(
+                "partitioner.process", kind=self.kind, pending=len(pending)
+            ) as proc:
+                # Snapshot from the live store: pending pods come from the
+                # store, so bindings/usage must too, or the plan races
+                # fresh binds.
+                with TRACER.span("snapshot.take"):
+                    snapshot = self.snapshot_taker.take_snapshot(
+                        self.cluster_state, store=self.store
+                    )
+                current = snapshot.partitioning_state()
+                desired = self.planner.plan(snapshot, pending)
+                plan = PartitioningPlan(desired_state=desired, id=self.plan_id_fn())
+                proc.set_attributes(plan_id=plan.id)
+                with TRACER.span("partitioner.actuate", plan_id=plan.id):
+                    applied = self.actuator.apply(current, plan)
+                proc.set_attributes(nodes_repartitioned=applied)
         if applied:
             self.plans_applied += 1
             self.nodes_repartitioned += applied

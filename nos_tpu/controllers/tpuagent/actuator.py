@@ -7,6 +7,7 @@ restart the device plugin when devices changed.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import Optional, Protocol
 
@@ -17,6 +18,7 @@ from nos_tpu.device.client import TpuClient
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.store import KubeStore, NotFoundError
 from nos_tpu.util import metrics
+from nos_tpu.util.tracing import NOOP_SPAN, TRACER
 
 log = logging.getLogger("nos_tpu.tpuagent")
 
@@ -65,36 +67,55 @@ class TpuActuator:
             self.shared.on_apply(plan_id)
             return None
 
-        for device in plan.deletes:
-            self.client.delete_slice(self.node_name, device.device_id)
-            metrics.SLICES_DELETED.inc()
-            log.info("actuator: %s deleted %s", self.node_name, device.device_id)
-        creates_by_board: dict = {}
-        for op in plan.creates:
-            board = creates_by_board.setdefault(op.board_index, {})
-            board[op.profile] = board.get(op.profile, 0) + op.quantity
-        self._clamp_to_board_capacity(node, plan, plan_id, creates_by_board)
-        if not plan.deletes and not creates_by_board:
-            # The whole plan was clamped away: spec is infeasible against
-            # current device state. Nothing changed on the node, so do NOT
-            # restart the device plugin; acknowledge the plan (the reporter
-            # will publish the true geometry, and the partitioner's
-            # divergence watch replans from it).
-            self.shared.on_apply(plan_id)
-            return None
-        for board_index, profiles in sorted(creates_by_board.items()):
-            # One batch per board: chip-placement-aware backends solve all
-            # of a board's creates together (order-independent).
-            self.client.create_slices_batch(self.node_name, board_index, profiles)
-            metrics.SLICES_CREATED.inc(sum(profiles.values()))
-            log.info(
-                "actuator: %s created %s on board %d",
-                self.node_name,
-                profiles,
-                board_index,
+        # The control plane's actuator linked the apply span under
+        # ("reconfig", node, plan_id); parenting on it stitches this
+        # agent-side reconfig into the originating pod's trace. No link
+        # (agent-only tests, repeat reconciles of the same plan): no span.
+        parent = TRACER.linked(("reconfig", self.node_name, plan_id))
+        ctx = (
+            TRACER.span(
+                "tpuagent.reconfig", parent=parent,
+                node=self.node_name, plan_id=plan_id,
             )
-        self.device_plugin.restart(self.node_name)
-        self.shared.on_apply(plan_id)
+            if parent is not None
+            else contextlib.nullcontext(NOOP_SPAN)
+        )
+        with ctx as span:
+            for device in plan.deletes:
+                self.client.delete_slice(self.node_name, device.device_id)
+                metrics.SLICES_DELETED.labels(profile=device.profile).inc()
+                log.info("actuator: %s deleted %s", self.node_name, device.device_id)
+            creates_by_board: dict = {}
+            for op in plan.creates:
+                board = creates_by_board.setdefault(op.board_index, {})
+                board[op.profile] = board.get(op.profile, 0) + op.quantity
+            self._clamp_to_board_capacity(node, plan, plan_id, creates_by_board)
+            if not plan.deletes and not creates_by_board:
+                # The whole plan was clamped away: spec is infeasible against
+                # current device state. Nothing changed on the node, so do NOT
+                # restart the device plugin; acknowledge the plan (the reporter
+                # will publish the true geometry, and the partitioner's
+                # divergence watch replans from it).
+                span.set_attributes(clamped=True)
+                self.shared.on_apply(plan_id)
+                return None
+            created = 0
+            for board_index, profiles in sorted(creates_by_board.items()):
+                # One batch per board: chip-placement-aware backends solve all
+                # of a board's creates together (order-independent).
+                self.client.create_slices_batch(self.node_name, board_index, profiles)
+                for profile, qty in profiles.items():
+                    metrics.SLICES_CREATED.labels(profile=profile).inc(qty)
+                    created += qty
+                log.info(
+                    "actuator: %s created %s on board %d",
+                    self.node_name,
+                    profiles,
+                    board_index,
+                )
+            span.set_attributes(deleted=len(plan.deletes), created=created)
+            self.device_plugin.restart(self.node_name)
+            self.shared.on_apply(plan_id)
         return None
 
     def _clamp_to_board_capacity(

@@ -16,6 +16,7 @@ from nos_tpu.partitioning.core.partition_state import (
     _node_key,
     partitioning_state_equal,
 )
+from nos_tpu.util.tracing import TRACER
 
 log = logging.getLogger("nos_tpu.partitioning")
 
@@ -54,6 +55,13 @@ class Actuator:
                 node_partitioning
             ):
                 continue  # this node already matches
-            self.partitioner.apply_partitioning(node_name, plan.id, node_partitioning)
+            with TRACER.span("actuator.apply_node", node=node_name) as span:
+                # The agent picks the plan up asynchronously from the node
+                # annotation; the link carries the trace across that gap so
+                # the tpuagent's reconfig span lands in the same trace.
+                TRACER.link(("reconfig", node_name, plan.id), span)
+                self.partitioner.apply_partitioning(
+                    node_name, plan.id, node_partitioning
+                )
             applied += 1
         return applied

@@ -31,6 +31,7 @@ from nos_tpu.scheduler.framework import (
 )
 from nos_tpu.util import metrics
 from nos_tpu.util import resources as res
+from nos_tpu.util.tracing import TRACER
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.tpu.topology import topology_chips
 
@@ -126,12 +127,19 @@ class Planner:
 
     def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
         started = time.monotonic()
-        try:
-            return self._plan(snapshot, pending_pods)
-        finally:
-            metrics.PLAN_DURATION.observe(time.monotonic() - started)
+        with TRACER.span(
+            "partitioner.plan",
+            pending_pods=len(pending_pods),
+            nodes=len(snapshot.get_nodes()),
+        ) as span:
+            try:
+                return self._plan(snapshot, pending_pods, span)
+            finally:
+                metrics.PLAN_DURATION.observe(time.monotonic() - started)
 
-    def _plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
+    def _plan(
+        self, snapshot: ClusterSnapshot, pending_pods: List[Pod], span=None
+    ) -> PartitioningState:
         # Pool draw order == claim pre-pass order (first-fit-descending):
         # the tracker and the pre-pass must agree on WHICH pods the
         # existing free slices serve, or a pod could end up neither
@@ -216,6 +224,15 @@ class Planner:
                 return snapshot.partitioning_state()
 
         self._plan_pass(snapshot, tracker, candidates, aged=aged)
+        if span is not None:
+            # The recompute-vs-incremental delta for lacking_totals: with
+            # the incremental cache, recomputes stay at one per accelerator
+            # per pass while calls scale with candidate nodes.
+            span.set_attributes(
+                totals_calls=tracker.totals_calls,
+                totals_recomputes=tracker.totals_recomputes,
+                totals_incremental=tracker.totals_calls - tracker.totals_recomputes,
+            )
         return snapshot.partitioning_state()
 
     def _plan_pass(
@@ -252,25 +269,34 @@ class Planner:
                 accelerator = getattr(
                     snapshot.get_node(node_name).partitionable, "accelerator", ""
                 )
-                snapshot.fork()
-                if not snapshot.update_geometry_for(
-                    node_name, tracker.lacking_for(pod, accelerator)
-                ):
-                    snapshot.revert()
-                    continue
-                if self._try_add_pod(snapshot, node_name, pod):
-                    tracker.remove(pod)
-                    placed.append(pod)
-                    rescued += 1
-                    snapshot.commit()
-                    if not quiet:
-                        log.info(
-                            "planner: node %s re-carved (aged rescue) for %s",
-                            node_name,
-                            pod.namespaced_name,
+                with TRACER.span(
+                    "plan.trial", node=node_name, rescue=True
+                ) as trial:
+                    snapshot.fork()
+                    if not snapshot.update_geometry_for(
+                        node_name, tracker.lacking_for(pod, accelerator)
+                    ):
+                        trial.set_attributes(
+                            committed=False, nodes_copied=snapshot.revert()
                         )
-                    break
-                snapshot.revert()
+                        continue
+                    if self._try_add_pod(snapshot, node_name, pod):
+                        tracker.remove(pod)
+                        placed.append(pod)
+                        rescued += 1
+                        trial.set_attributes(
+                            committed=True, nodes_copied=snapshot.commit()
+                        )
+                        if not quiet:
+                            log.info(
+                                "planner: node %s re-carved (aged rescue) for %s",
+                                node_name,
+                                pod.namespaced_name,
+                            )
+                        break
+                    trial.set_attributes(
+                        committed=False, nodes_copied=snapshot.revert()
+                    )
 
         # Claim pre-pass (TPU-first addition, no reference analogue): pods
         # that existing free slices fully serve will bind onto them without
@@ -292,27 +318,40 @@ class Planner:
             accelerator = getattr(
                 snapshot.get_node(node_name).partitionable, "accelerator", ""
             )
-            snapshot.fork()
-            changed = snapshot.update_geometry_for(
-                node_name, tracker.lacking_totals(accelerator)
-            )
-            if not changed:
-                snapshot.revert()
-                continue
-            added_any = False
-            for pod in candidates:
-                if pod not in tracker:
+            with TRACER.span("plan.trial", node=node_name) as trial:
+                snapshot.fork()
+                changed = snapshot.update_geometry_for(
+                    node_name, tracker.lacking_totals(accelerator)
+                )
+                if not changed:
+                    trial.set_attributes(
+                        committed=False, nodes_copied=snapshot.revert()
+                    )
                     continue
-                if self._try_add_pod(snapshot, node_name, pod):
-                    tracker.remove(pod)
-                    placed.append(pod)
-                    added_any = True
-            if added_any:
-                snapshot.commit()
-                if not quiet:
-                    log.info("planner: node %s re-carved for pending pods", node_name)
-            else:
-                snapshot.revert()
+                added_any = False
+                placed_here = 0
+                for pod in candidates:
+                    if pod not in tracker:
+                        continue
+                    if self._try_add_pod(snapshot, node_name, pod):
+                        tracker.remove(pod)
+                        placed.append(pod)
+                        added_any = True
+                        placed_here += 1
+                if added_any:
+                    trial.set_attributes(
+                        committed=True,
+                        pods_placed=placed_here,
+                        nodes_copied=snapshot.commit(),
+                    )
+                    if not quiet:
+                        log.info(
+                            "planner: node %s re-carved for pending pods", node_name
+                        )
+                else:
+                    trial.set_attributes(
+                        committed=False, nodes_copied=snapshot.revert()
+                    )
 
         return placed
 
@@ -381,11 +420,17 @@ class Planner:
             # invisible to the simulation — the real scheduler still
             # enforces them at bind time.
             state[TOPOLOGY_NODE_INFOS_KEY] = snapshot.sim_node_infos()
-        status = self.framework.run_pre_filter_plugins(state, sim_pod)
-        if not status.success:
-            return False
-        status = self.framework.run_filter_plugins(state, sim_pod, node.sim_node_info())
-        return status.success
+        # The simulation runs the framework once per (pod, node) trial —
+        # thousands of times per plan — so per-plugin spans are suppressed
+        # here; the plan.trial spans carry the aggregate story.
+        with TRACER.suppress_plugins():
+            status = self.framework.run_pre_filter_plugins(state, sim_pod)
+            if not status.success:
+                return False
+            status = self.framework.run_filter_plugins(
+                state, sim_pod, node.sim_node_info()
+            )
+            return status.success
 
     def _simulation_pod(self, snapshot: ClusterSnapshot, pod: Pod, accelerator: str) -> Pod:
         """Pod with its TPU request normalized to the candidate node's own

@@ -122,12 +122,14 @@ class ClusterSnapshot:
         self._anti_cache = None
         metrics.SNAPSHOT_FORKS.inc()
 
-    def commit(self) -> None:
+    def commit(self) -> int:
         """Keep the current fork's mutations. Inside a parent fork the
         journal folds upward (a backup the parent lacks is also the node's
         state at the parent's fork point — it would have been journaled in
         the parent had it been touched earlier), so an outer revert still
-        undoes committed inner trials."""
+        undoes committed inner trials. Returns the number of nodes the
+        ended fork had cloned — the trial's CoW cost, which the planner
+        records on its trial spans."""
         if not self._journals:
             raise RuntimeError("snapshot not forked")
         journal = self._journals.pop()
@@ -140,10 +142,12 @@ class ClusterSnapshot:
         self._anti_cache = None
         metrics.SNAPSHOT_COMMITS.inc()
         metrics.FORK_NODES_COPIED.set(len(journal))
+        return len(journal)
 
-    def revert(self) -> None:
+    def revert(self) -> int:
         """Discard the current fork's mutations by restoring the journaled
-        node backups and the free-pool checkpoint."""
+        node backups and the free-pool checkpoint. Returns the ended
+        fork's cloned-node count, as commit() does."""
         if not self._journals:
             raise RuntimeError("snapshot not forked")
         journal = self._journals.pop()
@@ -154,6 +158,7 @@ class ClusterSnapshot:
         self._anti_cache = None
         metrics.SNAPSHOT_REVERTS.inc()
         metrics.FORK_NODES_COPIED.set(len(journal))
+        return len(journal)
 
     def _touch(self, name: str) -> None:
         """Journal `name` under the innermost fork before its first
@@ -379,19 +384,21 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._sim_cache = None
         self._anti_cache = None
 
-    def commit(self) -> None:
+    def commit(self) -> int:
         if not self._deep_stack:
             raise RuntimeError("snapshot not forked")
         self._deep_stack.pop()
         self._sim_cache = None
         self._anti_cache = None
+        return len(self._nodes)
 
-    def revert(self) -> None:
+    def revert(self) -> int:
         if not self._deep_stack:
             raise RuntimeError("snapshot not forked")
         self._nodes = self._deep_stack.pop()
         self._sim_cache = None
         self._anti_cache = None
+        return len(self._nodes)
 
     @property
     def forked(self) -> bool:

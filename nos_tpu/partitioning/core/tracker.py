@@ -11,7 +11,7 @@ from typing import Dict, Iterable, List
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.objects import Pod, ResourceList
 from nos_tpu.tpu.known import profile_for_chips
-from nos_tpu.util import resources as res
+from nos_tpu.util import metrics, resources as res
 
 
 def _pod_key(pod: Pod) -> str:
@@ -26,6 +26,13 @@ class SliceTracker:
         lets N pods hide behind one free slice and deadlocks the planner.)
         """
         self._lacking: Dict[str, ResourceList] = {}
+        # Per-accelerator totals, maintained incrementally: computed once
+        # on first request, then kept current by remove() subtracting the
+        # departing pod's converted contribution (the carve loop used to
+        # re-sum every pod's lack per candidate node — ROADMAP item).
+        self._totals_cache: Dict[str, ResourceList] = {}
+        self.totals_calls = 0
+        self.totals_recomputes = 0
         pool = snapshot.free_slice_resources()
         for pod in pods:
             lacking = snapshot.take_from_pool(pool, res.compute_pod_request(pod))
@@ -63,11 +70,23 @@ class SliceTracker:
     def lacking_totals(self, accelerator: str = "") -> ResourceList:
         """Aggregate lacking resources. With `accelerator`, each pod's
         plain-chip lack is converted to that generation's slice profile, so
-        a candidate node of that generation knows what to carve."""
+        a candidate node of that generation knows what to carve.
+
+        Served from a per-accelerator cache that remove() keeps current, so
+        repeated calls inside the carve loop are O(profiles) rather than
+        O(pending pods)."""
+        self.totals_calls += 1
+        cached = self._totals_cache.get(accelerator)
+        if cached is not None:
+            metrics.TRACKER_TOTALS_INCREMENTAL.inc()
+            return dict(cached)
+        self.totals_recomputes += 1
+        metrics.TRACKER_TOTALS_RECOMPUTES.inc()
         total: ResourceList = {}
         for lacking in self._lacking.values():
             total = res.sum_resources(total, self._convert_plain(lacking, accelerator))
-        return total
+        self._totals_cache[accelerator] = total
+        return dict(total)
 
     def lacking_for(self, pod: Pod, accelerator: str = "") -> ResourceList:
         """One pod's lacking resources, plain chips converted to the
@@ -76,4 +95,16 @@ class SliceTracker:
         return self._convert_plain(self._lacking.get(_pod_key(pod), {}), accelerator)
 
     def remove(self, pod: Pod) -> None:
-        self._lacking.pop(_pod_key(pod), None)
+        lacking = self._lacking.pop(_pod_key(pod), None)
+        if lacking is None:
+            return
+        # Keep every cached total current by subtracting this pod's
+        # converted contribution (cheaper than invalidating: the carve loop
+        # calls lacking_totals again right after each placement).
+        for accelerator, total in self._totals_cache.items():
+            for name, amount in self._convert_plain(lacking, accelerator).items():
+                remaining = total.get(name, 0) - amount
+                if remaining > 0:
+                    total[name] = remaining
+                else:
+                    total.pop(name, None)

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Protocol, Sequence
 
 from nos_tpu.kube.objects import Node, Pod, ResourceList, Taint
 from nos_tpu.util import resources as res
+from nos_tpu.util.tracing import TRACER
 
 
 class StatusCode:
@@ -172,39 +173,54 @@ class Framework:
         self.permit_plugins = list(permit_plugins)
         self.score_plugins = list(score_plugins)
 
+    # Every runner wraps each plugin call in TRACER.plugin_span: a no-op
+    # unless a scheduling-cycle span is active (bare framework calls and
+    # the planner's suppressed simulation add zero spans), otherwise one
+    # child span per plugin so a trace shows where the cycle's time went.
+
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
         for p in self.pre_filter_plugins:
-            status = p.pre_filter(state, pod)
-            if not status.success:
-                status.plugin = status.plugin or p.name
-                return status
+            with TRACER.plugin_span(f"plugin.{p.name}", point="pre_filter") as sp:
+                status = p.pre_filter(state, pod)
+                if not status.success:
+                    status.plugin = status.plugin or p.name
+                    sp.set_attributes(rejected=True, message=status.message)
+                    return status
         return Status.ok()
 
     def run_filter_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         for p in self.filter_plugins:
-            status = p.filter(state, pod, node_info)
-            if not status.success:
-                status.plugin = status.plugin or p.name
-                return status
+            with TRACER.plugin_span(
+                f"plugin.{p.name}", point="filter", node=node_info.name
+            ) as sp:
+                status = p.filter(state, pod, node_info)
+                if not status.success:
+                    status.plugin = status.plugin or p.name
+                    sp.set_attributes(rejected=True, message=status.message)
+                    return status
         return Status.ok()
 
     def run_post_filter_plugins(
         self, state: CycleState, pod: Pod, filtered_nodes: Dict[str, Status]
     ) -> Optional[str]:
         for p in self.post_filter_plugins:
-            nominated = p.post_filter(state, pod, filtered_nodes)
-            if nominated:
-                return nominated
+            with TRACER.plugin_span(f"plugin.{p.name}", point="post_filter") as sp:
+                nominated = p.post_filter(state, pod, filtered_nodes)
+                if nominated:
+                    sp.set_attributes(nominated=nominated)
+                    return nominated
         return None
 
     def run_reserve_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for i, p in enumerate(self.reserve_plugins):
-            status = p.reserve(state, pod, node_name)
-            if not status.success:
-                for done in self.reserve_plugins[:i]:
-                    done.unreserve(state, pod, node_name)
-                status.plugin = status.plugin or p.name
-                return status
+            with TRACER.plugin_span(f"plugin.{p.name}", point="reserve") as sp:
+                status = p.reserve(state, pod, node_name)
+                if not status.success:
+                    for done in self.reserve_plugins[:i]:
+                        done.unreserve(state, pod, node_name)
+                    status.plugin = status.plugin or p.name
+                    sp.set_attributes(rejected=True, message=status.message)
+                    return status
         return Status.ok()
 
     def run_unreserve_plugins(self, state: CycleState, pod: Pod, node_name: str) -> None:
@@ -212,14 +228,22 @@ class Framework:
             p.unreserve(state, pod, node_name)
 
     def run_score_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
-        return sum(p.score(state, pod, node_info) for p in self.score_plugins)
+        total = 0
+        for p in self.score_plugins:
+            with TRACER.plugin_span(
+                f"plugin.{p.name}", point="score", node=node_info.name
+            ):
+                total += p.score(state, pod, node_info)
+        return total
 
     def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for p in self.permit_plugins:
-            status = p.permit(state, pod, node_name)
-            if not status.success:
-                status.plugin = status.plugin or p.name
-                return status
+            with TRACER.plugin_span(f"plugin.{p.name}", point="permit") as sp:
+                status = p.permit(state, pod, node_name)
+                if not status.success:
+                    status.plugin = status.plugin or p.name
+                    sp.set_attributes(code=status.code, message=status.message)
+                    return status
         return Status.ok()
 
 

@@ -202,7 +202,9 @@ class Preemptor:
             )
             try:
                 self.store.delete("Pod", victim.metadata.name, victim.metadata.namespace)
-                metrics.PREEMPTIONS.inc()
+                metrics.PREEMPTIONS.labels(
+                    namespace=victim.metadata.namespace
+                ).inc()
             except NotFoundError:
                 pass
         return node_name

@@ -33,6 +33,7 @@ from nos_tpu.scheduler.plugins.gang import GangScheduling
 from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
 from nos_tpu.scheduler.plugins.topology import IciTopologyScoring
 from nos_tpu.util import metrics
+from nos_tpu.util.tracing import TRACER
 
 log = logging.getLogger("nos_tpu.scheduler")
 
@@ -136,6 +137,23 @@ class Scheduler:
     # ------------------------------------------------------------ cycle
 
     def schedule_one(self, pod: Pod) -> Optional[Result]:
+        # The journey root may already exist (partitioner observed the pod
+        # first); otherwise this cycle starts it. Parenting the cycle span
+        # on it stitches the scheduler's repeated attempts into the one
+        # trace that answers "where did the pod's wait go".
+        root = TRACER.journey_root(
+            ("pod", pod.namespaced_name),
+            "pod.journey",
+            pod=pod.namespaced_name,
+            namespace=pod.metadata.namespace,
+        )
+        with TRACER.span(
+            "scheduler.cycle", parent=root, pod=pod.namespaced_name
+        ) as cycle:
+            result = self._schedule_cycle(pod, cycle)
+        return result
+
+    def _schedule_cycle(self, pod: Pod, cycle) -> Optional[Result]:
         start = time.monotonic()
         state = CycleState()
         # Published before ANY extension point: the PreFilter-failure
@@ -143,8 +161,18 @@ class Scheduler:
         # and those need the same cluster view as the normal filter pass.
         node_infos = self._node_infos()
         state[TOPOLOGY_NODE_INFOS_KEY] = list(node_infos.values())
-        status = self.framework.run_pre_filter_plugins(state, pod)
+        # The CapacityScheduling PreFilter IS the elastic-quota admission
+        # decision, so the span carries the quota stage name.
+        with TRACER.span("quota.admission") as quota_span:
+            status = self.framework.run_pre_filter_plugins(state, pod)
+            if not status.success:
+                quota_span.set_attributes(
+                    rejected=True, plugin=status.plugin, message=status.message
+                )
         if not status.success:
+            metrics.FILTER_REJECTIONS.labels(
+                plugin=status.plugin or "PreFilter"
+            ).inc()
             # PreFilter rejection (e.g. quota max) still gets a preemption
             # attempt — evicting victims may change the quota math
             # (capacity_scheduling.go PostFilter runs on any failure).
@@ -158,15 +186,24 @@ class Scheduler:
 
         feasible: List[NodeInfo] = []
         filtered: Dict[str, Status] = {}
-        for info in node_infos.values():
-            node_status = self.framework.run_filter_plugins(state, pod, info)
-            if node_status.success:
-                feasible.append(info)
-            else:
-                filtered[info.name] = node_status
+        with TRACER.span("scheduler.filter", nodes=len(node_infos)) as filter_span:
+            for info in node_infos.values():
+                node_status = self.framework.run_filter_plugins(state, pod, info)
+                if node_status.success:
+                    feasible.append(info)
+                else:
+                    filtered[info.name] = node_status
+                    metrics.FILTER_REJECTIONS.labels(
+                        plugin=node_status.plugin or "Filter"
+                    ).inc()
+            filter_span.set_attributes(feasible=len(feasible))
 
         if not feasible:
-            nominated = self.framework.run_post_filter_plugins(state, pod, filtered)
+            with TRACER.span("scheduler.post_filter") as pf_span:
+                nominated = self.framework.run_post_filter_plugins(
+                    state, pod, filtered
+                )
+                pf_span.set_attributes(nominated=nominated or "")
             if nominated:
                 self._set_nominated(pod, nominated)
                 # Victims are terminating; retry shortly.
@@ -181,16 +218,23 @@ class Scheduler:
             )
             return Result(requeue_after=self.retry)
 
-        best = max(
-            feasible,
-            key=lambda info: (self.framework.run_score_plugins(state, pod, info), info.name),
-        )
-        status = self.framework.run_reserve_plugins(state, pod, best.name)
+        with TRACER.span("scheduler.score", feasible=len(feasible)) as score_span:
+            best = max(
+                feasible,
+                key=lambda info: (
+                    self.framework.run_score_plugins(state, pod, info),
+                    info.name,
+                ),
+            )
+            score_span.set_attributes(best=best.name)
+        with TRACER.span("scheduler.reserve", node=best.name):
+            status = self.framework.run_reserve_plugins(state, pod, best.name)
         if not status.success:
             self._mark_unschedulable(pod, status.message)
             return Result(requeue_after=self.retry)
 
-        permit = self.framework.run_permit_plugins(state, pod, best.name)
+        with TRACER.span("scheduler.permit", node=best.name):
+            permit = self.framework.run_permit_plugins(state, pod, best.name)
         if permit.code == StatusCode.WAIT:
             # Gang forming: reservation held, pod stays pending but its
             # claim on the node must be visible to later cycles.
@@ -210,12 +254,15 @@ class Scheduler:
                 to_bind = released
                 if all(key[0].namespaced_name != pod.namespaced_name for key in released):
                     to_bind.append((pod, best.name))
-        for bind_pod, node_name in to_bind:
-            self._assumed.pop(bind_pod.namespaced_name, None)
-            self._bind(bind_pod, node_name)
-            if self.reservation is not None:
-                self.reservation.release_for(bind_pod)
-        metrics.SCHEDULE_LATENCY.observe(time.monotonic() - start)
+        with TRACER.span("scheduler.bind", pods=len(to_bind)):
+            for bind_pod, node_name in to_bind:
+                self._assumed.pop(bind_pod.namespaced_name, None)
+                self._bind(bind_pod, node_name)
+                if self.reservation is not None:
+                    self.reservation.release_for(bind_pod)
+        metrics.SCHEDULE_LATENCY.labels(namespace=pod.metadata.namespace).observe(
+            time.monotonic() - start
+        )
         if self.gang is not None and len(to_bind) > 1:
             metrics.GANGS_SCHEDULED.inc()
         return None
@@ -255,7 +302,15 @@ class Scheduler:
         except NotFoundError:
             return
         self.pods_scheduled += 1
-        metrics.PODS_SCHEDULED.inc()
+        metrics.PODS_SCHEDULED.labels(namespace=pod.metadata.namespace).inc()
+        # Binding completes the journey: the root span's duration IS
+        # time-to-schedulable. The kubelet's admission runs after bind —
+        # a link lets it append its span to the already-stored trace.
+        journey_key = ("pod", pod.namespaced_name)
+        root = TRACER.journey(journey_key)
+        if root is not None:
+            TRACER.link(("admit", pod.namespaced_name), root)
+        TRACER.end_journey(journey_key, node=node_name)
         log.info("scheduler: bound %s to %s", pod.namespaced_name, node_name)
 
     def _mark_unschedulable(self, pod: Pod, message: str) -> None:

@@ -24,7 +24,9 @@ from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.objects import Pod, PodCondition, PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError
 from nos_tpu.util import resources as res
+from nos_tpu.util.tracing import NOOP_SPAN, TRACER
 
+import contextlib
 import logging
 
 log = logging.getLogger("nos_tpu.kubelet")
@@ -46,7 +48,19 @@ class SimKubelet:
         if not pod.spec.node_name or pod.status.phase != PodPhase.PENDING:
             return None
 
-        if not self._admit(pod):
+        # The journey ended at bind; its trace is already stored. The link
+        # the scheduler left lets this post-bind span append to it (the
+        # tracer supports late spans on stored traces).
+        parent = TRACER.linked(("admit", pod.namespaced_name))
+        ctx = (
+            TRACER.span("kubelet.admit", parent=parent, node=pod.spec.node_name)
+            if parent is not None
+            else contextlib.nullcontext(NOOP_SPAN)
+        )
+        with ctx as span:
+            admitted = self._admit(pod)
+            span.set_attributes(admitted=admitted)
+        if not admitted:
             self.admission_rejects += 1
             log.warning(
                 "kubelet: rejecting %s on %s: slice demand exceeds devices "

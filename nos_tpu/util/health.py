@@ -7,11 +7,14 @@ endpoints for an in-process component set.
 """
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs, urlsplit
 
 from nos_tpu.util.metrics import REGISTRY
+from nos_tpu.util.tracing import TRACER
 
 
 class HealthServer:
@@ -57,28 +60,54 @@ class HealthServer:
             return metrics_token
 
         class Handler(BaseHTTPRequestHandler):
+            def _authorized(self) -> bool:
+                if not auth_enabled:
+                    return True
+                token = current_token()
+                # Fail CLOSED on a missing or empty token (file vanished
+                # or emptied mid-rotation) — never serve unauthenticated
+                # because the credential source degraded.
+                return bool(token) and (
+                    self.headers.get("Authorization", "") == f"Bearer {token}"
+                )
+
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                if self.path == "/healthz" and serve_health:
+                url = urlsplit(self.path)
+                path = url.path
+                if path == "/healthz" and serve_health:
                     self._respond(200, "ok")
-                elif self.path == "/readyz" and serve_health:
+                elif path == "/readyz" and serve_health:
                     if ready_check():
                         self._respond(200, "ok")
                     else:
                         self._respond(503, "not ready")
-                elif self.path == "/metrics" and serve_metrics:
-                    if auth_enabled:
-                        token = current_token()
-                        # Fail CLOSED on a missing or empty token (file
-                        # vanished or emptied mid-rotation) — never serve
-                        # unauthenticated because the credential source
-                        # degraded.
-                        if not token or (
-                            self.headers.get("Authorization", "")
-                            != f"Bearer {token}"
-                        ):
-                            self._respond(401, "unauthorized")
-                            return
+                elif path == "/metrics" and serve_metrics:
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
                     self._respond(200, REGISTRY.render(), "text/plain; version=0.0.4")
+                elif path == "/debug/traces" and serve_metrics:
+                    # Same credential as /metrics: trace attributes carry
+                    # pod names and namespaces, as sensitive as the series.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    wanted = parse_qs(url.query).get("id", [None])[0]
+                    if wanted:
+                        trace = TRACER.store.get(wanted)
+                        if trace is None:
+                            self._respond(404, "unknown trace id")
+                            return
+                        body = json.dumps(trace.to_chrome(), indent=2)
+                    else:
+                        body = json.dumps(TRACER.store.summaries(), indent=2)
+                    self._respond(200, body, "application/json")
+                elif path == "/debug/vars" and serve_metrics:
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    body = json.dumps(REGISTRY.snapshot(), indent=2, sort_keys=True)
+                    self._respond(200, body, "application/json")
                 else:
                     self._respond(404, "not found")
 

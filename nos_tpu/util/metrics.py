@@ -5,6 +5,12 @@ domain counters — called out as a gap in SURVEY.md §5 ("no 'slices
 created' counter") that the TPU build should fill. This registry backs the
 north-star measurements: plans applied, slices created/deleted, pods
 scheduled, schedule latency, preemptions, gang completions.
+
+Metrics are label *families*: ``counter(name).labels(profile="2x2")``
+returns a child series rendered as ``name{profile="2x2"}``. A family's
+un-labeled parent still works (the pre-label call sites and tests), and
+label values are escaped per the Prometheus text exposition format
+(backslash, double quote, newline).
 """
 from __future__ import annotations
 
@@ -12,41 +18,119 @@ import threading
 from typing import Dict, Optional, Tuple
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: ``\\`` → ``\\\\``,
+    ``"`` → ``\\"``, newline → ``\\n``."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def render_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{key}="{escape_label_value(value)}"' for key, value in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
 class Counter:
-    def __init__(self, name: str, help_text: str) -> None:
+    TYPE = "counter"
+
+    def __init__(
+        self, name: str, help_text: str, label_values: Optional[Dict[str, str]] = None
+    ) -> None:
         self.name = name
         self.help = help_text
         self._value = 0.0
         self._lock = threading.Lock()
+        # Family support: parent holds children keyed by sorted label
+        # items; a child holds its own label values and no children.
+        self._label_values: Dict[str, str] = dict(label_values or {})
+        self._children: Dict[Tuple, "Counter"] = {}
+        self._touched = False
+
+    def _new_child(self, label_values: Dict[str, str]) -> "Counter":
+        return type(self)(self.name, self.help, label_values)
+
+    def labels(self, **label_values: str) -> "Counter":
+        """Child series for this label set (created on first use)."""
+        if self._label_values:
+            raise ValueError(f"{self.name}: labels() on an already-labeled child")
+        key = tuple(sorted((k, str(v)) for k, v in label_values.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child({k: str(v) for k, v in label_values.items()})
+                self._children[key] = child
+            return child
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
+            self._touched = True
 
     @property
     def value(self) -> float:
         with self._lock:
             return self._value
 
+    @property
+    def total(self) -> float:
+        """Own value plus every labeled child — the family aggregate."""
+        with self._lock:
+            children = list(self._children.values())
+            own = self._value
+        return own + sum(c.value for c in children)
+
+    def _sorted_children(self):
+        with self._lock:
+            return [child for _, child in sorted(self._children.items())]
+
+    def _sample_lines(self) -> list:
+        lines = []
+        with self._lock:
+            bare = self._touched or not self._children
+            value = self._value
+            labels = render_labels(self._label_values)
+        if bare:
+            lines.append(f"{self.name}{labels} {value}")
+        return lines
+
     def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} counter\n"
-            f"{self.name} {self.value}\n"
-        )
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.TYPE}",
+        ]
+        lines.extend(self._sample_lines())
+        for child in self._sorted_children():
+            with child._lock:
+                labels = render_labels(child._label_values)
+                lines.append(f"{child.name}{labels} {child._value}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        with self._lock:
+            bare = self._touched or not self._children
+            value = self._value
+            suffix = render_labels(self._label_values)
+        if bare:
+            out[f"{self.name}{suffix}"] = value
+        for child in self._sorted_children():
+            child.snapshot_into(out)
 
 
 class Gauge(Counter):
+    TYPE = "gauge"
+
     def set(self, value: float) -> None:
         with self._lock:
             self._value = float(value)
-
-    def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value}\n"
-        )
+            self._touched = True
 
 
 class Histogram:
@@ -57,7 +141,13 @@ class Histogram:
     # stay exact forever.
     WINDOW = 1024
 
-    def __init__(self, name: str, help_text: str, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+        label_values: Optional[Dict[str, str]] = None,
+    ) -> None:
         from collections import deque
 
         self.name = name
@@ -68,9 +158,29 @@ class Histogram:
         self._count = 0
         self._recent = deque(maxlen=self.WINDOW)
         self._lock = threading.Lock()
+        self._label_values: Dict[str, str] = dict(label_values or {})
+        self._children: Dict[Tuple, "Histogram"] = {}
+        self._touched = False
+
+    def labels(self, **label_values: str) -> "Histogram":
+        if self._label_values:
+            raise ValueError(f"{self.name}: labels() on an already-labeled child")
+        key = tuple(sorted((k, str(v)) for k, v in label_values.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = Histogram(
+                    self.name,
+                    self.help,
+                    self.buckets,
+                    {k: str(v) for k, v in label_values.items()},
+                )
+                self._children[key] = child
+            return child
 
     def observe(self, value: float) -> None:
         with self._lock:
+            self._touched = True
             self._sum += value
             self._count += 1
             self._recent.append(value)
@@ -85,6 +195,11 @@ class Histogram:
         with self._lock:
             return self._count
 
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
     def percentile(self, p: float) -> Optional[float]:
         with self._lock:
             if not self._recent:
@@ -93,21 +208,54 @@ class Histogram:
             index = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
             return ordered[index]
 
-    def render(self) -> str:
+    def _sorted_children(self):
         with self._lock:
-            lines = [
-                f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} histogram",
-            ]
+            return [child for _, child in sorted(self._children.items())]
+
+    def _sample_lines(self) -> list:
+        with self._lock:
+            if not (self._touched or not self._children):
+                return []
+            lines = []
+            base = dict(self._label_values)
             cumulative = 0
             for bound, count in zip(self.buckets, self._counts):
                 cumulative += count
-                lines.append(f'{self.name}_bucket{{le="{bound}"}} {cumulative}')
+                labels = render_labels({**base, "le": str(bound)})
+                lines.append(f"{self.name}_bucket{labels} {cumulative}")
             cumulative += self._counts[-1]
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-            lines.append(f"{self.name}_sum {self._sum}")
-            lines.append(f"{self.name}_count {self._count}")
-            return "\n".join(lines) + "\n"
+            labels = render_labels({**base, "le": "+Inf"})
+            lines.append(f"{self.name}_bucket{labels} {cumulative}")
+            suffix = render_labels(base)
+            lines.append(f"{self.name}_sum{suffix} {self._sum}")
+            lines.append(f"{self.name}_count{suffix} {self._count}")
+            return lines
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        lines.extend(self._sample_lines())
+        for child in self._sorted_children():
+            lines.extend(child._sample_lines())
+        return "\n".join(lines) + "\n"
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        with self._lock:
+            bare = self._touched or not self._children
+            suffix = render_labels(self._label_values)
+            count = self._count
+            total = self._sum
+        if bare:
+            out[f"{self.name}_count{suffix}"] = count
+            out[f"{self.name}_sum{suffix}"] = total
+            for p, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+                quantile = self.percentile(p)
+                if quantile is not None:
+                    out[f"{self.name}_{key}{suffix}"] = quantile
+        for child in self._sorted_children():
+            child.snapshot_into(out)
 
 
 class MetricsRegistry:
@@ -136,17 +284,14 @@ class MetricsRegistry:
         return "".join(m.render() for m in sorted(metrics, key=lambda m: m.name))
 
     def snapshot(self) -> Dict[str, float]:
+        """Flat name→value map (labeled series keyed ``name{k="v"}``;
+        histograms expand to ``_count``/``_sum``/``_p50``/``_p95``/``_p99``)
+        — the JSON shape /debug/vars serves."""
         with self._lock:
             metrics = dict(self._metrics)
         out: Dict[str, float] = {}
-        for name, metric in metrics.items():
-            if isinstance(metric, Histogram):
-                out[f"{name}_count"] = metric.count
-                p50 = metric.percentile(50)
-                if p50 is not None:
-                    out[f"{name}_p50"] = p50
-            else:
-                out[name] = metric.value
+        for metric in metrics.values():
+            metric.snapshot_into(out)
         return out
 
 
@@ -165,22 +310,28 @@ BOARD_RESERVATIONS = REGISTRY.counter(
     "Nodes reserved to drain for full-board pods",
 )
 SLICES_CREATED = REGISTRY.counter(
-    "nos_tpu_slices_created_total", "TPU slices carved by agents"
+    "nos_tpu_slices_created_total", "TPU slices carved by agents (by profile)"
 )
 SLICES_DELETED = REGISTRY.counter(
-    "nos_tpu_slices_deleted_total", "TPU slices destroyed by agents"
+    "nos_tpu_slices_deleted_total", "TPU slices destroyed by agents (by profile)"
 )
 PODS_SCHEDULED = REGISTRY.counter(
-    "nos_tpu_pods_scheduled_total", "Pods bound by the scheduler"
+    "nos_tpu_pods_scheduled_total", "Pods bound by the scheduler (by namespace)"
 )
 PREEMPTIONS = REGISTRY.counter(
-    "nos_tpu_preemptions_total", "Pods evicted by quota preemption"
+    "nos_tpu_preemptions_total",
+    "Pods evicted by quota preemption (by victim namespace)",
 )
 GANGS_SCHEDULED = REGISTRY.counter(
     "nos_tpu_gangs_scheduled_total", "Gangs released for binding"
 )
 SCHEDULE_LATENCY = REGISTRY.histogram(
-    "nos_tpu_schedule_latency_seconds", "Per-pod scheduling cycle latency"
+    "nos_tpu_schedule_latency_seconds",
+    "Per-pod scheduling cycle latency (by namespace)",
+)
+FILTER_REJECTIONS = REGISTRY.counter(
+    "nos_tpu_scheduler_filter_rejections_total",
+    "Scheduling-cycle rejections by the plugin that refused (by plugin)",
 )
 
 # Partitioner planning loop (the nos_scheduling_latency north star). The
@@ -209,6 +360,14 @@ SNAPSHOT_NODES_COPIED = REGISTRY.counter(
 FORK_NODES_COPIED = REGISTRY.gauge(
     "nos_tpu_snapshot_fork_nodes_copied",
     "Nodes cloned by the most recently ended fork (commit or revert)",
+)
+TRACKER_TOTALS_RECOMPUTES = REGISTRY.counter(
+    "nos_tpu_tracker_totals_recomputes_total",
+    "SliceTracker lacking_totals cache misses (full per-accelerator sums)",
+)
+TRACKER_TOTALS_INCREMENTAL = REGISTRY.counter(
+    "nos_tpu_tracker_totals_incremental_total",
+    "SliceTracker lacking_totals calls served from the incremental cache",
 )
 MULTIHOST_EXPANSIONS = REGISTRY.counter(
     "nos_tpu_multihost_expansions_total",

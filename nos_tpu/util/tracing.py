@@ -1,0 +1,553 @@
+"""Request-scoped tracing for the pod-lifecycle pipeline.
+
+The north-star metric — time from pending Pod to bound slice — was a single
+histogram with no decomposition: a slow cycle could not be attributed to
+quota checks, planner fork trials, actuation, or device-plugin reconfig.
+This module adds Dapper-style spans over the in-process control plane:
+
+- ``Span``: trace/span/parent ids, attributes, events, wall+perf clocks.
+- Propagation rides ``contextvars``: a component opens a child span with
+  ``TRACER.span(...)`` and the active span is picked up implicitly, no
+  argument plumbing through the scheduler framework or the planner.
+  Threads don't inherit contextvars, so cross-thread handoffs use
+  ``TRACER.attach(span)`` (explicit re-parenting in the worker) or a
+  journey/link lookup (below).
+- The pending-Pod *journey* spans several controller threads connected by
+  store events, not call stacks, so correlation is keyed: a journey root
+  span is registered under ``("pod", namespaced_name)`` by whichever
+  controller observes the pod first, later stages look it up
+  (``journey``/``journey_root``) and parent onto it, and the scheduler ends
+  it at bind. Asynchronous actuation handoffs (spec annotation → tpuagent)
+  are correlated through ``link``/``linked`` with an explicit key carried
+  by the plan id.
+- Completed traces land in a bounded in-memory ``TraceStore`` ring,
+  exportable as Chrome trace-event JSON (loadable in Perfetto / Chrome
+  ``about:tracing``) and as a compact per-stage summary.
+
+Everything is bounded: spans per trace, events per span, live journeys,
+links, and stored traces all have caps, so a long-running scheduler can
+leave tracing on. With ``TRACER.enabled = False`` every entry point
+short-circuits to a shared no-op span (the overhead guard in
+``tests/partitioning/test_planner_perf.py`` keeps that path honest).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import logging
+import threading
+import time
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_ids = itertools.count(1)
+
+
+def _new_id(prefix: str) -> str:
+    return f"{prefix}{next(_ids):x}"
+
+
+_current_span: ContextVar[Optional["Span"]] = ContextVar(
+    "nos_tpu_current_span", default=None
+)
+# Planner simulation runs the scheduler framework thousands of times per
+# plan(); per-plugin spans there are volume without information. The
+# planner raises this flag around its trials; framework plugin spans check
+# it (their own spans — trial spans — stay on).
+_plugins_suppressed: ContextVar[bool] = ContextVar(
+    "nos_tpu_plugin_spans_suppressed", default=False
+)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[Tuple[float, str, Dict[str, Any]]] = field(default_factory=list)
+    start_wall: float = 0.0
+    start_perf: float = 0.0
+    duration_s: Optional[float] = None
+    thread: str = ""
+    status: str = "ok"
+
+    MAX_EVENTS = 128
+
+    @property
+    def ended(self) -> bool:
+        return self.duration_s is not None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def set_attributes(self, **attributes: Any) -> None:
+        self.attributes.update(attributes)
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        if len(self.events) < self.MAX_EVENTS:
+            self.events.append((time.time(), name, attributes))
+
+    def to_chrome_events(self) -> List[Dict[str, Any]]:
+        """Chrome trace-event 'X' (complete) record plus one 'i' (instant)
+        record per span event — the JSON shape Perfetto loads directly."""
+        args = dict(self.attributes)
+        args["span_id"] = self.span_id
+        if self.parent_id:
+            args["parent_id"] = self.parent_id
+        args["status"] = self.status
+        out = [
+            {
+                "name": self.name,
+                "cat": "nos_tpu",
+                "ph": "X",
+                "ts": round(self.start_wall * 1e6, 1),
+                "dur": round((self.duration_s or 0.0) * 1e6, 1),
+                "pid": 1,
+                "tid": self.thread or "main",
+                "args": args,
+            }
+        ]
+        for when, name, attributes in self.events:
+            out.append(
+                {
+                    "name": name,
+                    "cat": "nos_tpu.event",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round(when * 1e6, 1),
+                    "pid": 1,
+                    "tid": self.thread or "main",
+                    "args": dict(attributes),
+                }
+            )
+        return out
+
+
+class _NoopSpan(Span):
+    """Shared sink for disabled tracing: every mutator is a no-op, so hot
+    paths can call set_attribute/add_event unconditionally."""
+
+    def __init__(self) -> None:
+        super().__init__(name="noop", trace_id="", span_id="")
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def set_attributes(self, **attributes: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+@dataclass
+class Trace:
+    """A finalized trace: the root plus every span that ended under it."""
+
+    trace_id: str
+    spans: List[Span]
+    dropped_spans: int = 0
+
+    @property
+    def root(self) -> Optional[Span]:
+        for span in self.spans:
+            if span.parent_id is None:
+                return span
+        return self.spans[0] if self.spans else None
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact stage breakdown: direct children of the root aggregated
+        by span name — the "where did the 2.3 s go" answer."""
+        root = self.root
+        stages: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        if root is not None:
+            for span in self.spans:
+                if span.parent_id == root.span_id:
+                    stages[span.name] = stages.get(span.name, 0.0) + (
+                        span.duration_s or 0.0
+                    )
+                    counts[span.name] = counts.get(span.name, 0) + 1
+        return {
+            "trace_id": self.trace_id,
+            "root": root.name if root else "",
+            "attributes": dict(root.attributes) if root else {},
+            "status": root.status if root else "",
+            "start": root.start_wall if root else 0.0,
+            "duration_s": round(root.duration_s or 0.0, 6) if root else 0.0,
+            "spans": len(self.spans),
+            "dropped_spans": self.dropped_spans,
+            "stages": {
+                name: {"total_s": round(total, 6), "count": counts[name]}
+                for name, total in sorted(stages.items())
+            },
+        }
+
+    def to_chrome(self) -> Dict[str, Any]:
+        events: List[Dict[str, Any]] = []
+        for span in self.spans:
+            events.extend(span.to_chrome_events())
+        return {
+            "displayTimeUnit": "ms",
+            "otherData": {"trace_id": self.trace_id},
+            "traceEvents": events,
+        }
+
+
+class TraceStore:
+    """Bounded ring of completed traces, newest kept, with id lookup."""
+
+    def __init__(self, capacity: int = 256) -> None:
+        from collections import OrderedDict
+
+        self.capacity = max(1, capacity)
+        self._traces: "OrderedDict[str, Trace]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def add(self, trace: Trace) -> None:
+        with self._lock:
+            self._traces[trace.trace_id] = trace
+            self._traces.move_to_end(trace.trace_id)
+            while len(self._traces) > self.capacity:
+                self._traces.popitem(last=False)
+
+    def get(self, trace_id: str) -> Optional[Trace]:
+        with self._lock:
+            return self._traces.get(trace_id)
+
+    def list(self) -> List[Trace]:
+        """Newest first."""
+        with self._lock:
+            return list(reversed(self._traces.values()))
+
+    def summaries(self) -> List[Dict[str, Any]]:
+        return [t.summary() for t in self.list()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+class _ActiveTrace:
+    __slots__ = ("spans", "dropped", "open_spans")
+
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+        self.dropped = 0
+        self.open_spans = 0
+
+
+class Tracer:
+    # Per-trace span cap: the planner can fork hundreds of trials per
+    # plan(); beyond this the trace keeps counting but stops keeping spans.
+    MAX_SPANS_PER_TRACE = 4096
+    # Live journey cap: journeys for pods that never bind are force-ended
+    # oldest-first past this, so abandoned pods cannot leak roots.
+    MAX_JOURNEYS = 512
+    MAX_LINKS = 1024
+
+    def __init__(self, capacity: int = 256) -> None:
+        self.enabled = True
+        self.store = TraceStore(capacity)
+        self._lock = threading.Lock()
+        # trace_id -> accumulating spans for traces whose root is open.
+        self._active: Dict[str, _ActiveTrace] = {}
+        # journey key -> open root span (insertion-ordered for eviction).
+        self._journeys: Dict[Any, Span] = {}
+        # link key -> span (cross-thread hand-off parents).
+        self._links: Dict[Any, Span] = {}
+
+    # ------------------------------------------------------- span lifecycle
+
+    def start_span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        **attributes: Any,
+    ) -> Span:
+        if not self.enabled:
+            return NOOP_SPAN
+        if parent is None:
+            parent = _current_span.get()
+        if parent is NOOP_SPAN:
+            parent = None
+        elif parent is not None and parent.ended:
+            # An ended parent is still a valid anchor (linked hand-offs
+            # outlive the linking span) as long as its trace is reachable —
+            # active or stored. Evicted trace: start fresh.
+            with self._lock:
+                reachable = parent.trace_id in self._active
+            if not reachable and self.store.get(parent.trace_id) is None:
+                parent = None
+        if parent is None:
+            trace_id = _new_id("t")
+            parent_id = None
+        else:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_new_id("s"),
+            parent_id=parent_id,
+            attributes=dict(attributes),
+            start_wall=time.time(),
+            start_perf=time.perf_counter(),
+            thread=threading.current_thread().name,
+        )
+        if parent_id is None:
+            with self._lock:
+                self._active[trace_id] = _ActiveTrace()
+                self._active[trace_id].open_spans += 1
+        else:
+            with self._lock:
+                active = self._active.get(trace_id)
+                if active is not None:
+                    active.open_spans += 1
+        return span
+
+    def end_span(self, span: Span, status: Optional[str] = None) -> None:
+        if span is NOOP_SPAN or span.ended:
+            return
+        span.duration_s = time.perf_counter() - span.start_perf
+        if status is not None:
+            span.status = status
+        with self._lock:
+            active = self._active.get(span.trace_id)
+            if active is not None:
+                active.open_spans = max(0, active.open_spans - 1)
+                if len(active.spans) < self.MAX_SPANS_PER_TRACE:
+                    active.spans.append(span)
+                else:
+                    active.dropped += 1
+                if span.parent_id is None:
+                    self._finalize_locked(span.trace_id)
+                return
+        # Late span: its trace already finalized (e.g. kubelet admission
+        # landing after the journey ended at bind) — append to the stored
+        # trace so the export still shows it.
+        stored = self.store.get(span.trace_id)
+        if stored is not None:
+            if len(stored.spans) < self.MAX_SPANS_PER_TRACE:
+                stored.spans.append(span)
+            else:
+                stored.dropped_spans += 1
+
+    def _finalize_locked(self, trace_id: str) -> None:
+        active = self._active.pop(trace_id, None)
+        if active is None or not active.spans:
+            return
+        self.store.add(
+            Trace(trace_id=trace_id, spans=active.spans, dropped_spans=active.dropped)
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Optional[Span] = None, **attributes: Any):
+        """Context manager: open a span (implicitly parented on the active
+        one unless ``parent`` is given), make it current, end it on exit.
+        An exception marks status=error and re-raises."""
+        span = self.start_span(name, parent=parent, **attributes)
+        if span is NOOP_SPAN:
+            yield span
+            return
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException:
+            self.end_span(span, status="error")
+            raise
+        finally:
+            _current_span.reset(token)
+            self.end_span(span)
+
+    def plugin_span(self, name: str, **attributes: Any):
+        """Span for a scheduler-framework plugin call: no-ops while the
+        planner's simulation suppression is active or no trace is open (a
+        bare framework call outside any cycle should not mint root
+        traces)."""
+        if (
+            not self.enabled
+            or _plugins_suppressed.get()
+            or _current_span.get() is None
+        ):
+            return contextlib.nullcontext(NOOP_SPAN)
+        return self.span(name, **attributes)
+
+    def current(self) -> Optional[Span]:
+        span = _current_span.get()
+        return None if span is NOOP_SPAN else span
+
+    @contextlib.contextmanager
+    def attach(self, span: Optional[Span]):
+        """Make ``span`` the current span in this thread/context — the
+        cross-thread propagation primitive (contextvars do not cross
+        thread starts)."""
+        token = _current_span.set(span)
+        try:
+            yield span
+        finally:
+            _current_span.reset(token)
+
+    @contextlib.contextmanager
+    def suppress_plugins(self):
+        token = _plugins_suppressed.set(True)
+        try:
+            yield
+        finally:
+            _plugins_suppressed.reset(token)
+
+    # ------------------------------------------------------------ journeys
+
+    def journey_root(self, key: Any, name: str, **attributes: Any) -> Span:
+        """Get-or-create the root span registered under ``key`` — the
+        observe→bind trace anchor a later stage parents onto."""
+        if not self.enabled:
+            return NOOP_SPAN
+        with self._lock:
+            existing = self._journeys.get(key)
+            if existing is not None and not existing.ended:
+                return existing
+        span = self.start_span(name, parent=NOOP_SPAN, **attributes)
+        # parent=NOOP forces a fresh root even when called under an
+        # unrelated active span (a controller's own reconcile span).
+        with self._lock:
+            raced = self._journeys.get(key)
+            if raced is not None and not raced.ended:
+                # Lost a creation race: keep the registered root, finalize
+                # ours as an empty trace (no spans recorded yet).
+                self._active.pop(span.trace_id, None)
+                return raced
+            self._journeys[key] = span
+            evict = [
+                k
+                for k in list(self._journeys)[
+                    : max(0, len(self._journeys) - self.MAX_JOURNEYS)
+                ]
+            ]
+        for stale in evict:
+            self.end_journey(stale, status="abandoned")
+        return span
+
+    def journey(self, key: Any) -> Optional[Span]:
+        with self._lock:
+            span = self._journeys.get(key)
+        if span is None or span.ended:
+            return None
+        return span
+
+    def end_journey(
+        self, key: Any, status: str = "ok", **attributes: Any
+    ) -> Optional[Span]:
+        with self._lock:
+            span = self._journeys.pop(key, None)
+        if span is None or span is NOOP_SPAN:
+            return None
+        span.set_attributes(**attributes)
+        self.end_span(span, status=status)
+        return span
+
+    # --------------------------------------------------------------- links
+
+    def link(self, key: Any, span: Optional[Span]) -> None:
+        """Register ``span`` as the parent for a future out-of-context
+        continuation (e.g. node spec annotation → tpuagent reconcile)."""
+        if span is None or span is NOOP_SPAN or not self.enabled:
+            return
+        with self._lock:
+            self._links[key] = span
+            while len(self._links) > self.MAX_LINKS:
+                self._links.pop(next(iter(self._links)))
+
+    def linked(self, key: Any, pop: bool = True) -> Optional[Span]:
+        with self._lock:
+            return self._links.pop(key, None) if pop else self._links.get(key)
+
+    # --------------------------------------------------------------- admin
+
+    def reset(self) -> None:
+        """Test hook: drop all live and stored traces."""
+        with self._lock:
+            self._active.clear()
+            self._journeys.clear()
+            self._links.clear()
+        self.store.clear()
+
+
+# The process-wide tracer (the metrics.REGISTRY analogue).
+TRACER = Tracer()
+
+
+# ------------------------------------------------------------------ logging
+
+
+class TraceContextFilter(logging.Filter):
+    """Injects the active trace/span id into every record, so existing
+    ``nos_tpu.*`` log lines correlate with traces without touching any
+    call site. Plain formatters can reference ``%(trace_id)s``."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        span = _current_span.get()
+        if span is None or span is NOOP_SPAN:
+            record.trace_id = ""
+            record.span_id = ""
+        else:
+            record.trace_id = span.trace_id
+            record.span_id = span.span_id
+        return True
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line: ts, level, logger, message, trace/span id
+    (when a span is active), and exception text when present."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            entry["trace_id"] = trace_id
+            entry["span_id"] = getattr(record, "span_id", "")
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        return json.dumps(entry, default=str)
+
+
+def configure_logging(
+    json_format: bool = False,
+    level: Optional[int] = None,
+    stream=None,
+    logger_name: str = "nos_tpu",
+) -> logging.Handler:
+    """Attach a handler carrying the trace-context filter (and optionally
+    the JSON formatter) to the ``nos_tpu`` logger tree. Returns the handler
+    so callers/tests can detach it."""
+    logger = logging.getLogger(logger_name)
+    handler = logging.StreamHandler(stream)
+    handler.addFilter(TraceContextFilter())
+    if json_format:
+        handler.setFormatter(JsonLogFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s [%(trace_id)s] %(message)s"
+            )
+        )
+    if level is not None:
+        logger.setLevel(level)
+    logger.addHandler(handler)
+    return handler

@@ -1,0 +1,201 @@
+"""Acceptance for the observability PR: one scheduled Pod produces one
+trace whose root covers observe→bind with child spans for quota, every
+scheduler plugin, the plan (per-trial CoW cost), actuation, and the
+agent reconfig — and the trace/metrics are reachable over HTTP behind
+bearer auth."""
+import http.client
+import json
+import time
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import PodPhase
+from nos_tpu.util.health import HealthServer
+from nos_tpu.util.tracing import TRACER
+
+from tests.factory import build_pod, build_tpu_node
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    TRACER.reset()
+    yield
+    TRACER.reset()
+
+
+@pytest.fixture
+def cluster():
+    c = build_cluster()
+    yield c
+    c.stop()
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def find_pod_trace(pod_key):
+    for trace in TRACER.store.list():
+        root = trace.root
+        if (
+            root is not None
+            and root.name == "pod.journey"
+            and root.attributes.get("pod") == pod_key
+        ):
+            return trace
+    return None
+
+
+def schedule_one(cluster, name="train", ns="ml"):
+    cluster.add_tpu_node(build_tpu_node(name="tpu-1"))
+    cluster.start()
+    cluster.store.create(build_pod(name, {constants.RESOURCE_TPU: 4}, ns=ns))
+
+    def running():
+        pod = cluster.store.try_get("Pod", name, ns)
+        return pod is not None and pod.status.phase == PodPhase.RUNNING
+
+    assert wait_for(running), f"{ns}/{name} never reached Running"
+    assert wait_for(lambda: find_pod_trace(f"{ns}/{name}") is not None), (
+        "no finalized pod.journey trace for the scheduled pod"
+    )
+    return find_pod_trace(f"{ns}/{name}")
+
+
+class TestPodJourneyTrace:
+    def test_single_pod_produces_full_journey_trace(self, cluster):
+        trace = schedule_one(cluster)
+        root = trace.root
+        assert root.ended
+        assert root.attributes["namespace"] == "ml"
+        assert root.attributes["node"] == "tpu-1"  # stamped at bind
+        assert any(e[1] == "partitioner.observed" for e in root.events)
+
+        names = {s.name for s in trace.spans}
+        required = {
+            "quota.admission",
+            "scheduler.cycle",
+            "scheduler.filter",
+            "scheduler.score",
+            "scheduler.bind",
+            "partitioner.process",
+            "snapshot.take",
+            "partitioner.plan",
+            "plan.trial",
+            "partitioner.actuate",
+            "actuator.apply_node",
+            "tpuagent.reconfig",
+        }
+        missing = required - names
+        assert not missing, f"journey trace missing spans: {sorted(missing)}"
+        # Every span belongs to the one trace rooted at pod.journey.
+        assert {s.trace_id for s in trace.spans} == {root.trace_id}
+
+    def test_each_scheduler_plugin_gets_a_child_span(self, cluster):
+        trace = schedule_one(cluster)
+        plugin_spans = {s.name for s in trace.spans if s.name.startswith("plugin.")}
+        # The default wiring: pre-filter capacity, the vanilla filters, and
+        # the nos-specific filter plugins all show up by name.
+        for expected in (
+            "plugin.CapacityScheduling",
+            "plugin.NodeResourcesFit",
+            "plugin.NodeSelector",
+            "plugin.TaintToleration",
+            "plugin.NodeUnschedulable",
+            "plugin.MultihostIci",
+            "plugin.BoardReservation",
+        ):
+            assert expected in plugin_spans, (
+                f"{expected} not in {sorted(plugin_spans)}"
+            )
+        points = {
+            s.attributes.get("point")
+            for s in trace.spans
+            if s.name.startswith("plugin.")
+        }
+        assert {"pre_filter", "filter"} <= points
+
+    def test_plan_trials_carry_cow_copy_cost(self, cluster):
+        trace = schedule_one(cluster)
+        trials = [s for s in trace.spans if s.name == "plan.trial"]
+        assert trials, "plan ran without recording carve trials"
+        for trial in trials:
+            assert "nodes_copied" in trial.attributes
+            assert trial.attributes["nodes_copied"] >= 0
+            assert "committed" in trial.attributes
+        plan = next(s for s in trace.spans if s.name == "partitioner.plan")
+        assert plan.attributes["totals_calls"] == (
+            plan.attributes["totals_recomputes"]
+            + plan.attributes["totals_incremental"]
+        )
+
+    def test_kubelet_admission_appends_after_bind(self, cluster):
+        trace = schedule_one(cluster)
+
+        def admitted_span_present():
+            t = find_pod_trace("ml/train")
+            return t is not None and any(
+                s.name == "kubelet.admit" and s.attributes.get("admitted") is True
+                for s in t.spans
+            )
+
+        # The journey ends at bind; the sim kubelet's admission span lands
+        # on the already-stored trace via the scheduler's link.
+        assert wait_for(admitted_span_present), (
+            "kubelet.admit never appended to the stored trace: %s"
+            % sorted({s.name for s in trace.spans})
+        )
+
+
+class TestObservabilityOverHttp:
+    @staticmethod
+    def _get(port, path, token=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+
+    def test_trace_export_and_labeled_metrics(self, cluster):
+        trace = schedule_one(cluster)
+        server = HealthServer(port=0, metrics_token="tok")
+        port = server.start()
+        try:
+            assert self._get(port, "/debug/traces")[0] == 401
+            status, body = self._get(port, "/debug/traces", "tok")
+            assert status == 200
+            assert any(
+                s["trace_id"] == trace.trace_id for s in json.loads(body)
+            )
+
+            status, body = self._get(
+                port, f"/debug/traces?id={trace.trace_id}", "tok"
+            )
+            assert status == 200
+            chrome = json.loads(body)
+            assert chrome["otherData"]["trace_id"] == trace.trace_id
+            events = chrome["traceEvents"]
+            assert {e["name"] for e in events} >= {
+                "pod.journey",
+                "scheduler.cycle",
+                "partitioner.plan",
+            }
+            assert all(
+                {"name", "ph", "ts", "pid", "tid"} <= set(e) for e in events
+            )
+
+            status, body = self._get(port, "/metrics", "tok")
+            assert status == 200
+            # The agent carved a 2x2 for the 4-chip request: the slice
+            # counter serves a per-profile labeled series.
+            assert 'nos_tpu_slices_created_total{profile="2x2"}' in body
+            assert 'nos_tpu_pods_scheduled_total{namespace="ml"}' in body
+        finally:
+            server.stop()

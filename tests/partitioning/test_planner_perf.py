@@ -32,3 +32,47 @@ def test_plan_64_nodes_200_pods_within_bound():
     assert elapsed < PLAN_BOUND_SECONDS, f"plan() took {elapsed:.2f}s"
     assert plan is not None
     assert not snapshot.forked
+
+
+@pytest.mark.slow
+def test_tracing_overhead_within_allowance():
+    """The planner is instrumented (a span per carve trial, suppressed
+    plugin spans in simulation). With TRACER.enabled=False those calls are
+    shared no-ops — that run is the baseline — and turning tracing on must
+    stay within a modest allowance of it. Median-of-5 on the 64-node
+    config keeps CI noise below the 15% bar."""
+    import statistics
+
+    from nos_tpu.util.tracing import TRACER
+
+    planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+    planner.plan(make_cluster(8, ClusterSnapshot), make_pending(10))  # warm-up
+
+    def timed_runs(runs=5):
+        samples = []
+        for _ in range(runs):
+            snapshot = make_cluster(64, ClusterSnapshot)
+            pods = make_pending(200)
+            started = time.perf_counter()
+            planner.plan(snapshot, pods)
+            samples.append(time.perf_counter() - started)
+        return statistics.median(samples)
+
+    TRACER.reset()
+    enabled_prev = TRACER.enabled
+    try:
+        TRACER.enabled = False
+        baseline = timed_runs()
+        TRACER.enabled = True
+        traced = timed_runs()
+    finally:
+        TRACER.enabled = enabled_prev
+        TRACER.reset()
+
+    assert baseline < PLAN_BOUND_SECONDS
+    assert traced < PLAN_BOUND_SECONDS
+    overhead = (traced / baseline) - 1.0 if baseline else 0.0
+    assert overhead < 0.15, (
+        f"traced plan() {traced:.3f}s is {overhead:.1%} over the disabled "
+        f"baseline {baseline:.3f}s — per-trial span cost has grown"
+    )

@@ -131,3 +131,36 @@ class TestRepoIsClean:
             for path in lint.iter_py([full]):
                 findings.extend(lint.lint_file(path))
         assert not findings, "\n".join(str(f) for f in findings)
+
+
+class TestMetricsDocDrift:
+    """Every registered metric is namespaced and documented — a new metric
+    that skips docs/en/docs/telemetry.md fails CI here, not in review."""
+
+    @staticmethod
+    def _registered_names():
+        import re
+
+        repo = os.path.join(os.path.dirname(__file__), "..", "..")
+        with open(os.path.join(repo, "nos_tpu", "util", "metrics.py")) as fh:
+            source = fh.read()
+        return re.findall(
+            r"REGISTRY\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"", source
+        )
+
+    def test_every_metric_has_namespace_prefix(self):
+        names = self._registered_names()
+        assert names, "metric extraction regex found nothing"
+        bad = [n for n in names if not n.startswith("nos_tpu_")]
+        assert not bad, f"metrics missing nos_tpu_ prefix: {bad}"
+
+    def test_every_metric_is_documented(self):
+        repo = os.path.join(os.path.dirname(__file__), "..", "..")
+        with open(
+            os.path.join(repo, "docs", "en", "docs", "telemetry.md")
+        ) as fh:
+            doc = fh.read()
+        missing = [n for n in self._registered_names() if n not in doc]
+        assert not missing, (
+            f"metrics not mentioned in docs/en/docs/telemetry.md: {missing}"
+        )

@@ -1,7 +1,11 @@
+import json
+import re
 import urllib.request
 
+import pytest
+
 from nos_tpu.util.health import HealthServer
-from nos_tpu.util.metrics import MetricsRegistry
+from nos_tpu.util.metrics import MetricsRegistry, escape_label_value
 
 
 class TestRegistry:
@@ -42,6 +46,84 @@ class TestRegistry:
         assert snap["a"] == 4
         assert snap["b_count"] == 1
         assert snap["b_p50"] == 1.0
+
+    def test_snapshot_sum_and_high_percentiles(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat")
+        for i in range(100):
+            h.observe(i / 100.0)
+        snap = r.snapshot()
+        assert snap["lat_count"] == 100
+        assert snap["lat_sum"] == pytest.approx(sum(i / 100.0 for i in range(100)))
+        assert snap["lat_p50"] == pytest.approx(0.5, abs=0.02)
+        assert snap["lat_p95"] == pytest.approx(0.95, abs=0.02)
+        assert snap["lat_p99"] == pytest.approx(0.99, abs=0.02)
+
+
+class TestLabeledMetrics:
+    def test_counter_labels_render_as_series(self):
+        r = MetricsRegistry()
+        c = r.counter("slices_total", "h")
+        c.labels(profile="2x2x1").inc(3)
+        c.labels(profile="1x1").inc()
+        text = r.render()
+        assert 'slices_total{profile="2x2x1"} 3.0' in text
+        assert 'slices_total{profile="1x1"} 1.0' in text
+        # HELP/TYPE once per family, not per child
+        assert text.count("# TYPE slices_total counter") == 1
+        # family never incremented bare: no unlabeled sample
+        assert "\nslices_total 0" not in text
+
+    def test_labels_get_or_create_same_child(self):
+        r = MetricsRegistry()
+        c = r.counter("x_total")
+        assert c.labels(a="1") is c.labels(a="1")
+        assert c.labels(a="1") is not c.labels(a="2")
+        with pytest.raises(ValueError):
+            c.labels(a="1").labels(b="2")
+
+    def test_family_total_aggregates_children(self):
+        r = MetricsRegistry()
+        c = r.counter("y_total")
+        c.labels(ns="a").inc(2)
+        c.labels(ns="b").inc(3)
+        assert c.total == 5.0
+        c.inc()  # bare sample still works alongside children
+        assert c.total == 6.0
+        assert "y_total 1.0" in r.render()
+
+    def test_gauge_labels(self):
+        r = MetricsRegistry()
+        g = r.gauge("depth")
+        g.labels(queue="q1").set(7)
+        text = r.render()
+        assert 'depth{queue="q1"} 7.0' in text
+        assert "# TYPE depth gauge" in text
+
+    def test_histogram_labels_render_buckets_per_series(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        h.labels(ns="ml").observe(0.5)
+        text = r.render()
+        assert 'lat_seconds_bucket{le="0.1",ns="ml"} 0' in text
+        assert 'lat_seconds_bucket{le="1.0",ns="ml"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf",ns="ml"} 1' in text
+        assert 'lat_seconds_sum{ns="ml"} 0.5' in text
+        assert 'lat_seconds_count{ns="ml"} 1' in text
+        assert text.count("# TYPE lat_seconds histogram") == 1
+        snap = r.snapshot()
+        assert snap['lat_seconds_count{ns="ml"}'] == 1
+
+    def test_label_value_escaping(self):
+        assert escape_label_value('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+        r = MetricsRegistry()
+        c = r.counter("esc_total")
+        c.labels(ns='we"ird\\ns\nx').inc()
+        text = r.render()
+        assert 'esc_total{ns="we\\"ird\\\\ns\\nx"} 1.0' in text
+        # escaped newline must not split the sample line
+        line = next(l for l in text.splitlines() if l.startswith("esc_total{"))
+        assert line.endswith("1.0")
 
 
 class TestHealthServer:
@@ -184,3 +266,120 @@ class TestMetricsAuth:
             assert get(metrics_port, "/healthz") == 404
         finally:
             server.stop()
+
+
+def _get(port, path, token=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode()
+
+
+class TestDebugEndpoints:
+    """/debug/traces and /debug/vars share the /metrics bearer auth."""
+
+    def test_debug_endpoints_require_token(self):
+        server = HealthServer(port=0, metrics_token="s3cret")
+        port = server.start()
+        try:
+            assert _get(port, "/debug/traces")[0] == 401
+            assert _get(port, "/debug/vars")[0] == 401
+            assert _get(port, "/debug/traces", "wrong")[0] == 401
+            assert _get(port, "/debug/traces", "s3cret")[0] == 200
+            assert _get(port, "/debug/vars", "s3cret")[0] == 200
+        finally:
+            server.stop()
+
+    def test_debug_traces_summaries_and_full_export(self):
+        from nos_tpu.util.tracing import TRACER
+
+        TRACER.reset()
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            with TRACER.span("pod.journey", pod="ns/p"):
+                with TRACER.span("scheduler.cycle"):
+                    pass
+            status, body = _get(port, "/debug/traces")
+            assert status == 200
+            summaries = json.loads(body)
+            assert summaries[0]["root"] == "pod.journey"
+            assert summaries[0]["stages"]["scheduler.cycle"]["count"] == 1
+            trace_id = summaries[0]["trace_id"]
+            status, body = _get(port, f"/debug/traces?id={trace_id}")
+            assert status == 200
+            chrome = json.loads(body)
+            assert chrome["otherData"]["trace_id"] == trace_id
+            assert {e["name"] for e in chrome["traceEvents"] if e["ph"] == "X"} == {
+                "pod.journey",
+                "scheduler.cycle",
+            }
+            assert _get(port, "/debug/traces?id=nope")[0] == 404
+        finally:
+            server.stop()
+            TRACER.reset()
+
+    def test_debug_vars_is_the_registry_snapshot(self):
+        from nos_tpu.util import metrics
+
+        metrics.PLANS_APPLIED.inc()
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/vars")
+            assert status == 200
+            snap = json.loads(body)
+            assert snap["nos_tpu_partitioning_plans_applied_total"] >= 1
+        finally:
+            server.stop()
+
+
+# One sample line of the Prometheus text exposition format: metric name,
+# optional {labels} with escaped values, then a number.
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*\})?"
+    r" ([+-]?Inf|[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?)$"
+)
+
+
+class TestTextFormatConformance:
+    def test_served_metrics_parse(self):
+        from nos_tpu.util import metrics
+
+        # Ensure at least one labeled family is present in the scrape.
+        metrics.SLICES_CREATED.labels(profile="2x2x1").inc()
+        metrics.SCHEDULE_LATENCY.labels(namespace="ml").observe(0.05)
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            status, body = _get(port, "/metrics")
+        finally:
+            server.stop()
+        assert status == 200
+        seen_types = {}
+        samples = 0
+        for line in body.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, mtype = line.split(" ", 3)
+                assert mtype in ("counter", "gauge", "histogram"), line
+                assert name not in seen_types, f"duplicate TYPE for {name}"
+                seen_types[name] = mtype
+                continue
+            assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+            samples += 1
+        assert samples > 0
+        assert 'nos_tpu_slices_created_total{profile="2x2x1"}' in body
+        assert 'nos_tpu_schedule_latency_seconds_count{namespace="ml"}' in body
+        assert (
+            'nos_tpu_schedule_latency_seconds_bucket{le="0.1",namespace="ml"}'
+            in body
+        )

@@ -1,0 +1,307 @@
+"""Tracing subsystem: spans, propagation, ring buffer, exports, logging."""
+import io
+import json
+import logging
+import threading
+
+import pytest
+
+from nos_tpu.util.tracing import (
+    JsonLogFormatter,
+    NOOP_SPAN,
+    TraceContextFilter,
+    Tracer,
+    TRACER,
+    configure_logging,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_global_tracer():
+    TRACER.reset()
+    TRACER.enabled = True
+    yield
+    TRACER.reset()
+    TRACER.enabled = True
+
+
+class TestSpanNesting:
+    def test_child_inherits_trace_and_parent(self):
+        tracer = Tracer()
+        with tracer.span("root") as root:
+            with tracer.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+                assert tracer.current() is child
+            assert tracer.current() is root
+        assert tracer.current() is None
+
+    def test_trace_finalizes_when_root_ends(self):
+        tracer = Tracer()
+        with tracer.span("root"):
+            with tracer.span("child"):
+                pass
+            assert len(tracer.store) == 0  # root still open
+        assert len(tracer.store) == 1
+        trace = tracer.store.list()[0]
+        assert {s.name for s in trace.spans} == {"root", "child"}
+        assert trace.root.name == "root"
+
+    def test_exception_marks_error_status(self):
+        tracer = Tracer()
+        with pytest.raises(ValueError):
+            with tracer.span("boom"):
+                raise ValueError("x")
+        trace = tracer.store.list()[0]
+        assert trace.root.status == "error"
+
+    def test_disabled_tracer_is_noop(self):
+        tracer = Tracer()
+        tracer.enabled = False
+        with tracer.span("root") as span:
+            assert span is NOOP_SPAN
+            span.set_attribute("k", "v")  # must not blow up or record
+            span.add_event("e")
+        assert len(tracer.store) == 0
+        assert not NOOP_SPAN.attributes and not NOOP_SPAN.events
+
+    def test_attributes_and_events(self):
+        tracer = Tracer()
+        with tracer.span("root", pod="ns/p") as span:
+            span.set_attributes(extra=1)
+            span.add_event("observed", kind="tpu")
+        root = tracer.store.list()[0].root
+        assert root.attributes == {"pod": "ns/p", "extra": 1}
+        assert root.events[0][1] == "observed"
+
+
+class TestThreadPropagation:
+    def test_contextvars_do_not_cross_threads_without_attach(self):
+        tracer = Tracer()
+        seen = {}
+
+        def worker():
+            seen["current"] = tracer.current()
+
+        with tracer.span("root"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["current"] is None
+
+    def test_attach_propagates_across_threads(self):
+        tracer = Tracer()
+        done = threading.Event()
+
+        def worker(root):
+            with tracer.attach(root):
+                with tracer.span("worker-stage"):
+                    pass
+            done.set()
+
+        with tracer.span("root") as root:
+            t = threading.Thread(target=worker, args=(root,))
+            t.start()
+            done.wait(2.0)
+            t.join(2.0)
+        trace = tracer.store.list()[0]
+        names = {s.name for s in trace.spans}
+        assert "worker-stage" in names
+        worker_span = next(s for s in trace.spans if s.name == "worker-stage")
+        assert worker_span.trace_id == root.trace_id
+        assert worker_span.parent_id == root.span_id
+
+
+class TestRingBuffer:
+    def test_store_evicts_oldest(self):
+        tracer = Tracer(capacity=3)
+        ids = []
+        for i in range(5):
+            with tracer.span(f"r{i}") as s:
+                ids.append(s.trace_id)
+        assert len(tracer.store) == 3
+        assert tracer.store.get(ids[0]) is None
+        assert tracer.store.get(ids[1]) is None
+        assert tracer.store.get(ids[4]) is not None
+        # newest first
+        assert [t.root.name for t in tracer.store.list()] == ["r4", "r3", "r2"]
+
+    def test_span_cap_drops_and_counts(self):
+        tracer = Tracer()
+        tracer.MAX_SPANS_PER_TRACE = 4
+        with tracer.span("root"):
+            for i in range(6):
+                with tracer.span(f"c{i}"):
+                    pass
+        trace = tracer.store.list()[0]
+        assert len(trace.spans) == 4
+        # 6 children + root = 7 ended spans, 4 kept.
+        assert trace.dropped_spans == 3
+
+
+class TestChromeExport:
+    def test_chrome_shape(self):
+        tracer = Tracer()
+        with tracer.span("root", pod="ns/p") as root:
+            root.add_event("observed")
+            with tracer.span("child"):
+                pass
+        trace = tracer.store.list()[0]
+        out = trace.to_chrome()
+        assert out["displayTimeUnit"] == "ms"
+        assert out["otherData"]["trace_id"] == trace.trace_id
+        events = out["traceEvents"]
+        complete = [e for e in events if e["ph"] == "X"]
+        instants = [e for e in events if e["ph"] == "i"]
+        assert {e["name"] for e in complete} == {"root", "child"}
+        assert [e["name"] for e in instants] == ["observed"]
+        for e in complete:
+            assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid", "args"}
+            assert e["dur"] >= 0
+        root_event = next(e for e in complete if e["name"] == "root")
+        assert root_event["args"]["pod"] == "ns/p"
+        json.dumps(out)  # must be JSON-serializable
+
+    def test_summary_stage_breakdown(self):
+        tracer = Tracer()
+        with tracer.span("root"):
+            for _ in range(2):
+                with tracer.span("stage-a"):
+                    pass
+            with tracer.span("stage-b"):
+                with tracer.span("grandchild"):
+                    pass
+        summary = tracer.store.list()[0].summary()
+        assert summary["root"] == "root"
+        assert summary["stages"]["stage-a"]["count"] == 2
+        assert summary["stages"]["stage-b"]["count"] == 1
+        assert "grandchild" not in summary["stages"]  # direct children only
+
+
+class TestJourneysAndLinks:
+    def test_journey_root_is_get_or_create(self):
+        tracer = Tracer()
+        a = tracer.journey_root(("pod", "ns/p"), "pod.journey")
+        b = tracer.journey_root(("pod", "ns/p"), "pod.journey")
+        assert a is b
+        tracer.end_journey(("pod", "ns/p"), node="n1")
+        assert tracer.journey(("pod", "ns/p")) is None
+        trace = tracer.store.get(a.trace_id)
+        assert trace.root.attributes["node"] == "n1"
+
+    def test_stage_parents_onto_journey_root(self):
+        tracer = Tracer()
+        root = tracer.journey_root(("pod", "ns/p"), "pod.journey")
+        with tracer.span("scheduler.cycle", parent=root) as cycle:
+            assert cycle.parent_id == root.span_id
+        tracer.end_journey(("pod", "ns/p"))
+        names = {s.name for s in tracer.store.get(root.trace_id).spans}
+        assert names == {"pod.journey", "scheduler.cycle"}
+
+    def test_link_carries_trace_across_handoff(self):
+        tracer = Tracer()
+        root = tracer.journey_root(("pod", "ns/p"), "pod.journey")
+        with tracer.span("actuator.apply_node", parent=root) as apply_span:
+            tracer.link(("reconfig", "n1", "plan-1"), apply_span)
+        parent = tracer.linked(("reconfig", "n1", "plan-1"))
+        assert parent is apply_span
+        # pop semantics: a second reconcile of the same plan gets nothing
+        assert tracer.linked(("reconfig", "n1", "plan-1")) is None
+        with tracer.span("tpuagent.reconfig", parent=parent) as reconfig:
+            assert reconfig.trace_id == root.trace_id
+        tracer.end_journey(("pod", "ns/p"))
+        names = {s.name for s in tracer.store.get(root.trace_id).spans}
+        assert "tpuagent.reconfig" in names
+
+    def test_late_span_appends_to_stored_trace(self):
+        tracer = Tracer()
+        root = tracer.journey_root(("pod", "ns/p"), "pod.journey")
+        tracer.end_journey(("pod", "ns/p"))  # trace finalized + stored
+        with tracer.span("kubelet.admit", parent=root):
+            pass
+        names = {s.name for s in tracer.store.get(root.trace_id).spans}
+        assert "kubelet.admit" in names
+
+    def test_journey_eviction_is_bounded(self):
+        tracer = Tracer()
+        tracer.MAX_JOURNEYS = 4
+        roots = [
+            tracer.journey_root(("pod", f"ns/p{i}"), "pod.journey")
+            for i in range(7)
+        ]
+        live = [i for i in range(7) if tracer.journey(("pod", f"ns/p{i}"))]
+        assert len(live) <= 4
+        assert roots[0].ended  # oldest force-ended as abandoned
+        assert roots[0].status == "abandoned"
+
+
+class TestPluginSpanGating:
+    def test_plugin_span_needs_active_cycle(self):
+        tracer = Tracer()
+        with tracer.plugin_span("plugin.X") as span:
+            assert span is NOOP_SPAN  # no cycle open: no root minted
+        assert len(tracer.store) == 0
+
+    def test_plugin_span_suppressed_in_simulation(self):
+        tracer = Tracer()
+        with tracer.span("partitioner.plan"):
+            with tracer.suppress_plugins():
+                with tracer.plugin_span("plugin.X") as span:
+                    assert span is NOOP_SPAN
+            with tracer.plugin_span("plugin.Y") as span:
+                assert span is not NOOP_SPAN
+        names = {s.name for s in tracer.store.list()[0].spans}
+        assert names == {"partitioner.plan", "plugin.Y"}
+
+
+class TestLoggingIntegration:
+    def test_filter_injects_trace_id(self):
+        tracer = Tracer()
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        logger = logging.getLogger("nos_tpu.test_tracing")
+        logger.setLevel(logging.INFO)
+        handler = Capture()
+        handler.addFilter(TraceContextFilter())
+        logger.addHandler(handler)
+        try:
+            # The global contextvar is tracer-independent, so a local
+            # Tracer's span is still visible to the filter.
+            with tracer.span("root") as span:
+                logger.info("inside")
+            logger.info("outside")
+        finally:
+            logger.removeHandler(handler)
+        assert records[0].trace_id == span.trace_id
+        assert records[0].span_id == span.span_id
+        assert records[1].trace_id == ""
+
+    def test_json_formatter_emits_trace_fields(self):
+        stream = io.StringIO()
+        handler = configure_logging(
+            json_format=True, stream=stream, logger_name="nos_tpu.test_tracing_json"
+        )
+        logger = logging.getLogger("nos_tpu.test_tracing_json")
+        logger.setLevel(logging.INFO)
+        tracer = Tracer()
+        try:
+            with tracer.span("root") as span:
+                logger.info("hello %s", "world")
+        finally:
+            logger.removeHandler(handler)
+        entry = json.loads(stream.getvalue().strip())
+        assert entry["message"] == "hello world"
+        assert entry["level"] == "INFO"
+        assert entry["trace_id"] == span.trace_id
+        assert entry["span_id"] == span.span_id
+
+    def test_json_formatter_without_span_omits_trace_id(self):
+        out = JsonLogFormatter().format(
+            logging.LogRecord("n", logging.INFO, "p", 1, "m", (), None)
+        )
+        entry = json.loads(out)
+        assert "trace_id" not in entry
