@@ -10,8 +10,15 @@ plan() call. Two snapshot engines:
               deepcopy per fork, cluster-walk free pool), kept in-tree as
               the measurable baseline
 
-Output: one JSON line per (engine, nodes, pods) config with p50/p95 plan
-latency (ms) and forks/sec, e.g.
+The cow engine additionally runs in two verdict-cache modes (on/off, the
+planner's ``verdict_cache_enabled`` knob), so the equivalence-class filter
+cache's contribution is measured separately from the CoW fork win; cached
+rows carry the hit/miss/bypass tallies. The deepcopy engine always runs
+cache-off (it exists to show the pre-optimization cost) and is skipped
+entirely at >= 1024 nodes, where a single plan() takes minutes.
+
+Output: one JSON line per (engine, cache mode, nodes, pods) config with
+p50/p95 plan latency (ms) and forks/sec, e.g.
 
   make bench-planner
   python bench_planner.py --quick
@@ -94,10 +101,13 @@ def make_pending(n_pods: int):
     return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
 
 
-def bench_config(engine: str, n_nodes: int, n_pods: int, repeats: int) -> dict:
+def bench_config(
+    engine: str, n_nodes: int, n_pods: int, repeats: int, cache_on: bool = True
+) -> dict:
     snapshot_cls = ENGINES[engine]
     latencies = []
     forks = 0
+    hits = misses = bypasses = 0
     for rep in range(repeats + 1):  # rep 0 is untimed warm-up
         snapshot = make_cluster(n_nodes, snapshot_cls)
         # Count forks engine-independently (the deepcopy baseline skips the
@@ -112,20 +122,24 @@ def bench_config(engine: str, n_nodes: int, n_pods: int, repeats: int) -> dict:
 
             snapshot.fork = counting_fork
         planner = Planner(
-            Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+            Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]),
+            verdict_cache_enabled=cache_on,
         )
         pods = make_pending(n_pods)
         started = time.perf_counter()
         planner.plan(snapshot, pods)
         if rep > 0:
             latencies.append(time.perf_counter() - started)
+            h, m, b = planner.verdict_cache_stats()
+            hits, misses, bypasses = hits + h, misses + m, bypasses + b
     total = sum(latencies)
     quantiles = (
         statistics.quantiles(latencies, n=20) if len(latencies) > 1 else latencies * 2
     )
-    return {
+    row = {
         "bench": "bench_planner",
         "engine": engine,
+        "verdict_cache": "on" if cache_on else "off",
         "nodes": n_nodes,
         "pending_pods": n_pods,
         "repeats": repeats,
@@ -134,6 +148,13 @@ def bench_config(engine: str, n_nodes: int, n_pods: int, repeats: int) -> dict:
         "forks_per_sec": round(forks / total, 1) if total else None,
         "forks_total": forks,
     }
+    if cache_on:
+        eligible = hits + misses
+        row["cache_hits"] = hits
+        row["cache_misses"] = misses
+        row["cache_bypasses"] = bypasses
+        row["cache_hit_rate"] = round(hits / eligible, 4) if eligible else None
+    return row
 
 
 def export_sample_trace(path: str) -> None:
@@ -161,7 +182,7 @@ def main() -> None:
     parser.add_argument("--engines", default="cow,deepcopy")
     parser.add_argument(
         "--configs",
-        default="16x50,64x200,256x400",
+        default="16x50,64x200,256x400,1024x800",
         help="comma-separated nodesxpods pairs",
     )
     parser.add_argument("--repeats", type=int, default=5)
@@ -182,31 +203,49 @@ def main() -> None:
 
     results = []
     for engine in args.engines.split(","):
+        # cow runs with the verdict cache on AND off (the off rows are the
+        # like-for-like before/after for the cache); deepcopy is the
+        # pre-everything baseline and only runs cache-off.
+        cache_modes = (True, False) if engine == "cow" else (False,)
         for n_nodes, n_pods in configs:
+            if engine == "deepcopy" and n_nodes >= 1024:
+                # A single deepcopy plan() at 1024 nodes takes minutes —
+                # the collapse is already documented by the 256-node row.
+                continue
             # The deepcopy baseline at full scale is exactly the collapse
             # this bench exists to document; cap its largest run so the
             # suite still finishes.
             reps = repeats if not (engine == "deepcopy" and n_nodes >= 256) else max(
                 1, repeats // 2
             )
-            result = bench_config(engine, n_nodes, n_pods, reps)
-            results.append(result)
-            print(json.dumps(result), flush=True)
+            for cache_on in cache_modes:
+                result = bench_config(engine, n_nodes, n_pods, reps, cache_on)
+                results.append(result)
+                print(json.dumps(result), flush=True)
 
     raw = list(results)
     for a in raw:
+        if not (a["engine"] == "cow" and a["verdict_cache"] == "on" and a["p50_plan_ms"]):
+            continue
         for b in raw:
-            if (
-                a["engine"] == "cow"
-                and b["engine"] == "deepcopy"
-                and (a["nodes"], a["pending_pods"]) == (b["nodes"], b["pending_pods"])
-                and a["p50_plan_ms"]
-            ):
+            if (a["nodes"], a["pending_pods"]) != (b["nodes"], b["pending_pods"]):
+                continue
+            if b["engine"] == "deepcopy":
                 speedup = {
                     "bench": "bench_planner_speedup",
                     "nodes": a["nodes"],
                     "pending_pods": a["pending_pods"],
                     "p50_speedup": round(b["p50_plan_ms"] / a["p50_plan_ms"], 2),
+                }
+                results.append(speedup)
+                print(json.dumps(speedup), flush=True)
+            elif b["engine"] == "cow" and b["verdict_cache"] == "off":
+                speedup = {
+                    "bench": "bench_planner_cache_speedup",
+                    "nodes": a["nodes"],
+                    "pending_pods": a["pending_pods"],
+                    "p50_speedup": round(b["p50_plan_ms"] / a["p50_plan_ms"], 2),
+                    "cache_hit_rate": a.get("cache_hit_rate"),
                 }
                 results.append(speedup)
                 print(json.dumps(speedup), flush=True)

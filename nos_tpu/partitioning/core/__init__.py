@@ -12,6 +12,7 @@ from nos_tpu.partitioning.core.snapshot import (
     SnapshotNode,
 )
 from nos_tpu.partitioning.core.tracker import SliceTracker
+from nos_tpu.partitioning.core.verdict_cache import VerdictCache
 from nos_tpu.partitioning.core.planner import Planner
 from nos_tpu.partitioning.core.actuator import Actuator
 
@@ -27,5 +28,6 @@ __all__ = [
     "Planner",
     "SliceTracker",
     "SnapshotNode",
+    "VerdictCache",
     "partitioning_state_equal",
 ]

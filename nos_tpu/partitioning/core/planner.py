@@ -13,21 +13,43 @@ candidate-node trial costs one touched-node clone, and the gang trial is a
 nested fork around a whole ``_plan_pass`` instead of a full snapshot
 deepcopy. Geometry carves go through ``snapshot.update_geometry_for`` so
 the journal and the incremental free pool both see them.
+
+The simulation itself is memoized through an equivalence-class verdict
+cache (verdict_cache.py, upstream kube-scheduler's equivalence-cache idea):
+a PreFilter+Filter verdict for the ``verdict_cacheable`` plugin subset is
+keyed by (pod signature, node name, node mutation version) — the snapshot's
+never-repeating mutation clock makes the node half of the key O(1) to read
+and exact to invalidate, and a reverted trial restores pre-fork versions so
+earlier entries become valid again. Lookups are bypassed whenever the pod
+or the snapshot carries affinity/topology-spread state (those verdicts read
+cross-node context), and plugins that never opted in (external-store
+filters) run fresh on every trial after the cached subset. Supporting
+memos with the same exactness guarantee: lacking-slices booleans and
+candidate-node order keyed by the snapshot-wide ``state_version``, and
+simulated NodeInfo views keyed by (node, version). All of it is per-plan
+state, rebuilt at every ``plan()`` entry.
 """
 from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from nos_tpu.kube.objects import Pod
 from nos_tpu.partitioning.core.partition_state import PartitioningState
-from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot, SnapshotNode
 from nos_tpu.partitioning.core.tracker import SliceTracker
+from nos_tpu.partitioning.core.verdict_cache import (
+    VerdictCache,
+    needs_cluster_context,
+    pod_signature,
+)
 from nos_tpu.scheduler.framework import (
     CycleState,
     Framework,
+    NodeInfo,
     TOPOLOGY_NODE_INFOS_KEY,
+    is_verdict_cacheable,
 )
 from nos_tpu.util import metrics
 from nos_tpu.util import resources as res
@@ -47,7 +69,7 @@ def _gang_of(pod: Pod):
 
 
 def sort_candidate_pods(
-    pods: Iterable[Pod],
+    pods: "Iterable[Pod]",
     aging_chips_per_second: float = 1.0,
     pending_since: "dict | None" = None,
 ) -> List[Pod]:
@@ -87,27 +109,44 @@ def sort_candidate_pods(
             chips.append(plain)
         return max(chips) if chips else 0
 
-    def effective_chips(pod: Pod) -> float:
+    # Explicit decorate-sort-undecorate: the request walk + topology
+    # parsing behind the effective-chips number runs exactly once per pod
+    # — the reference's sort.Slice less-func re-derives it per COMPARISON
+    # (core/util.go:34-71), an O(n log n) blowup this port must not
+    # inherit through a key closure someone later turns into a cmp.
+    keyed: List[Tuple[tuple, Pod]] = []
+    for pod in pods:
         age = max(0.0, now - pending_since.get(pod.namespaced_name, now))
-        return largest_slice_chips(pod) + age * aging_chips_per_second
-
-    return sorted(
-        pods,
-        key=lambda p: (
-            -p.spec.priority,
-            -effective_chips(p),
-            p.metadata.namespace,
-            p.metadata.name,
-        ),
-    )
+        effective = largest_slice_chips(pod) + age * aging_chips_per_second
+        keyed.append(
+            (
+                (
+                    -pod.spec.priority,
+                    -effective,
+                    pod.metadata.namespace,
+                    pod.metadata.name,
+                ),
+                pod,
+            )
+        )
+    keyed.sort(key=lambda kv: kv[0])
+    return [pod for _, pod in keyed]
 
 
 class Planner:
     def __init__(
-        self, framework: Framework, aging_chips_per_second: float = 1.0
+        self,
+        framework: Framework,
+        aging_chips_per_second: float = 1.0,
+        verdict_cache_enabled: bool = True,
+        reuse_gang_trial: bool = True,
     ) -> None:
         self.framework = framework
         self.aging_chips_per_second = aging_chips_per_second
+        # Both knobs exist so the bench and the equivalence tests can run
+        # the exact pre-cache code path as the oracle.
+        self.verdict_cache_enabled = verdict_cache_enabled
+        self.reuse_gang_trial = reuse_gang_trial
         # namespaced_name -> (first_seen, last_seen) monotonic instants.
         # Age for the fairness sort is measured from first_seen — time
         # passed over across plan() calls — never from creation time (a
@@ -118,12 +157,65 @@ class Planner:
         # without a sighting (pod bound or deleted).
         self._pending_seen: dict = {}
         self._PENDING_TTL_S = 600.0
-        # (uid, namespaced_name, accelerator) -> normalized simulation pod.
-        # One pod is trialed against many candidate nodes per plan();
-        # normalization only depends on the pod spec and the node's
-        # generation, so the deepcopy+rewrite is done once per pair.
-        # Cleared at every plan() start — pods are immutable within a run.
-        self._sim_pod_cache: Dict[Tuple[str, str, str], Pod] = {}
+        # Per-plan memo state; (re)built whenever the snapshot identity
+        # changes so direct _try_add_pod/_can_schedule calls (tests) are
+        # as correct as the plan() entry point.
+        self._cache_snapshot: Optional[ClusterSnapshot] = None
+        self._reset_plan_caches(None)
+
+    # ------------------------------------------------------ plan caches
+
+    def _reset_plan_caches(self, snapshot: Optional[ClusterSnapshot]) -> None:
+        self._cache_snapshot = snapshot
+        self._verdict_cache = VerdictCache()
+        # (id(pod), accelerator) -> (pod, sim pod, verdict-cache
+        # signature, needs-cross-node-context flag). One pod is trialed
+        # against many candidate nodes per plan(); normalization only
+        # depends on the pod spec and the node's generation, so the
+        # deepcopy+rewrite+signature is done once per pair. Pods are
+        # immutable within a run; keying on object identity skips the
+        # uid/namespaced-name tuple build on the per-trial hot path, and
+        # the pinned pod reference keeps the id from being recycled.
+        self._sim_pod_cache: Dict[Tuple[int, str], tuple] = {}
+        # (node name, node.version) -> simulated NodeInfo. to_sim_node()
+        # deepcopies the kube Node per call; the version key pins geometry
+        # and placements exactly, so one view serves every trial the node
+        # reaches unchanged (including after a revert restores it).
+        self._node_info_cache: Dict[Tuple[str, int], NodeInfo] = {}
+        # (request signature, snapshot.state_version) -> bool("still
+        # lacking"). _try_add_pod only branches on truthiness, and every
+        # free-pool change bumps state_version, so the bool is exact.
+        self._lacking_cache: Dict[Tuple[tuple, int], bool] = {}
+        # id(pod) -> (pod, sorted compute_pod_request items); the pod ref
+        # pins the id.
+        self._request_cache: Dict[int, tuple] = {}
+        # snapshot.state_version -> candidate-node order (the claim
+        # pre-pass asks once per pod; unchanged state means unchanged
+        # order).
+        self._candidate_cache: Optional[Tuple[int, List[str]]] = None
+        # The verdict cache memoizes only the opted-in plugin subset; the
+        # rest runs fresh on every trial, after the cached conjunction.
+        framework = self.framework
+        self._cacheable_pre = [
+            p for p in framework.pre_filter_plugins if is_verdict_cacheable(p)
+        ]
+        self._uncacheable_pre = [
+            p for p in framework.pre_filter_plugins if not is_verdict_cacheable(p)
+        ]
+        self._cacheable_filters = [
+            p for p in framework.filter_plugins if is_verdict_cacheable(p)
+        ]
+        self._uncacheable_filters = [
+            p for p in framework.filter_plugins if not is_verdict_cacheable(p)
+        ]
+
+    def _ensure_plan_caches(self, snapshot: ClusterSnapshot) -> None:
+        # Identity check, not equality: memo keys embed this snapshot's
+        # mutation-clock values, which mean nothing against another one.
+        if snapshot is not self._cache_snapshot:
+            self._reset_plan_caches(snapshot)
+
+    # ----------------------------------------------------------- entry
 
     def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
         started = time.monotonic()
@@ -132,10 +224,48 @@ class Planner:
             pending_pods=len(pending_pods),
             nodes=len(snapshot.get_nodes()),
         ) as span:
+            # Unconditional rebuild even for a repeated snapshot object:
+            # out-of-band mutations between plan() calls (controller
+            # refreshes) don't all pass through the stamped mutators.
+            self._reset_plan_caches(snapshot)
             try:
                 return self._plan(snapshot, pending_pods, span)
             finally:
                 metrics.PLAN_DURATION.observe(time.monotonic() - started)
+                self._flush_cache_stats(span)
+
+    def verdict_cache_stats(self) -> Tuple[int, int, int]:
+        """(hits, misses, bypasses) accumulated by the most recent plan()
+        — valid until the next plan() resets the per-plan caches."""
+        return self._verdict_cache.stats()
+
+    def _flush_cache_stats(self, span=None) -> None:
+        """Per-lookup counting happens on unlocked ints owned by the
+        VerdictCache; the thread-safe labeled metric family is touched
+        once per plan() here, not thousands of times on the trial path."""
+        hits, misses, bypasses = self._verdict_cache.stats()
+        if hits:
+            metrics.PLAN_VERDICT_CACHE.labels(event="hit").inc(hits)
+        if misses:
+            metrics.PLAN_VERDICT_CACHE.labels(event="miss").inc(misses)
+        if bypasses:
+            metrics.PLAN_VERDICT_CACHE.labels(event="bypass").inc(bypasses)
+        if span is not None:
+            span.set_attributes(
+                verdict_cache_hits=hits,
+                verdict_cache_misses=misses,
+                verdict_cache_bypasses=bypasses,
+            )
+
+    def _trial_cache_delta(self, before: Tuple[int, int, int]) -> dict:
+        """plan.trial span attributes: this trial's share of the plan-wide
+        hit/miss/bypass counters."""
+        hits, misses, bypasses = self._verdict_cache.stats()
+        return {
+            "cache_hits": hits - before[0],
+            "cache_misses": misses - before[1],
+            "cache_bypasses": bypasses - before[2],
+        }
 
     def _plan(
         self, snapshot: ClusterSnapshot, pending_pods: List[Pod], span=None
@@ -145,7 +275,6 @@ class Planner:
         # existing free slices serve, or a pod could end up neither
         # claim-placed nor carved for this round.
         now = time.monotonic()
-        self._sim_pod_cache.clear()
         # Key includes the uid: a recreated pod with a reused name is a NEW
         # pod and must start at age 0, not inherit its predecessor's boost.
         live = {(p.namespaced_name, p.metadata.uid) for p in pending_pods}
@@ -194,6 +323,10 @@ class Planner:
         # is actually in the batch.
         excluded: set = set()
         if any(_gang_of(p) for p in candidates):
+            # Bound-member counts from the PRISTINE snapshot, BEFORE the
+            # fork: trial placements must not double as already-bound
+            # members (and the reuse path below never reverts to recount).
+            sizes, bound_count = self._gang_membership(snapshot, candidates)
             snapshot.fork()
             trial_tracker = SliceTracker(snapshot, candidates)
             # _plan_pass claim-places members the current geometry already
@@ -202,12 +335,28 @@ class Planner:
             trial_placed = self._plan_pass(
                 snapshot, trial_tracker, candidates, quiet=True, aged=aged
             )
+            excluded = self._half_formable_gangs(sizes, bound_count, trial_placed)
+            if not excluded and self.reuse_gang_trial:
+                # No gang was excluded, so the real pass would start from
+                # the same pristine state with the same candidate order —
+                # _plan_pass is deterministic, so its placements would be
+                # bit-identical to the trial's. Keep the trial instead of
+                # paying a second full simulation pass.
+                snapshot.commit()
+                log.info(
+                    "planner: gang trial committed as the real plan "
+                    "(no gang excluded; second pass skipped)"
+                )
+                if span is not None:
+                    span.set_attributes(
+                        gang_trial_reused=True,
+                        totals_calls=trial_tracker.totals_calls,
+                        totals_recomputes=trial_tracker.totals_recomputes,
+                        totals_incremental=trial_tracker.totals_calls
+                        - trial_tracker.totals_recomputes,
+                    )
+                return snapshot.partitioning_state()
             snapshot.revert()
-            # Counted against the PRISTINE snapshot (post-revert): trial
-            # placements must not double as already-bound members.
-            excluded = self._half_formable_gangs(
-                snapshot, candidates, trial_placed
-            )
         if excluded:
             log.info(
                 "planner: gangs %s cannot fully form; excluding their pods",
@@ -243,6 +392,7 @@ class Planner:
         quiet: bool = False,
         aged: "set | None" = None,
     ) -> List[Pod]:
+        self._ensure_plan_caches(snapshot)
         placed: List[Pod] = []
         # Aged-rescue pass, BEFORE anyone claims free slices: a starved
         # pod the fairness aging promoted gets a carve aimed at exactly
@@ -265,10 +415,11 @@ class Planner:
             if pod.namespaced_name not in aged or pod not in tracker:
                 continue
             attempts += 1
-            for node_name in snapshot.get_candidate_nodes():
+            for node_name in self._candidate_nodes(snapshot):
                 accelerator = getattr(
                     snapshot.get_node(node_name).partitionable, "accelerator", ""
                 )
+                stats_before = self._verdict_cache.stats()
                 with TRACER.span(
                     "plan.trial", node=node_name, rescue=True
                 ) as trial:
@@ -277,7 +428,9 @@ class Planner:
                         node_name, tracker.lacking_for(pod, accelerator)
                     ):
                         trial.set_attributes(
-                            committed=False, nodes_copied=snapshot.revert()
+                            committed=False,
+                            nodes_copied=snapshot.revert(),
+                            **self._trial_cache_delta(stats_before),
                         )
                         continue
                     if self._try_add_pod(snapshot, node_name, pod):
@@ -285,7 +438,9 @@ class Planner:
                         placed.append(pod)
                         rescued += 1
                         trial.set_attributes(
-                            committed=True, nodes_copied=snapshot.commit()
+                            committed=True,
+                            nodes_copied=snapshot.commit(),
+                            **self._trial_cache_delta(stats_before),
                         )
                         if not quiet:
                             log.info(
@@ -295,7 +450,9 @@ class Planner:
                             )
                         break
                     trial.set_attributes(
-                        committed=False, nodes_copied=snapshot.revert()
+                        committed=False,
+                        nodes_copied=snapshot.revert(),
+                        **self._trial_cache_delta(stats_before),
                     )
 
         # Claim pre-pass (TPU-first addition, no reference analogue): pods
@@ -308,16 +465,17 @@ class Planner:
         for pod in candidates:
             if pod in tracker:
                 continue
-            for node_name in snapshot.get_candidate_nodes():
+            for node_name in self._candidate_nodes(snapshot):
                 if self._try_add_pod(snapshot, node_name, pod):
                     placed.append(pod)
                     break
-        for node_name in snapshot.get_candidate_nodes():
+        for node_name in self._candidate_nodes(snapshot):
             if tracker.empty:
                 break
             accelerator = getattr(
-                snapshot.get_node(node_name).partitionable, "accelerator", ""
+                snapshot.get_nodes()[node_name].partitionable, "accelerator", ""
             )
+            stats_before = self._verdict_cache.stats()
             with TRACER.span("plan.trial", node=node_name) as trial:
                 snapshot.fork()
                 changed = snapshot.update_geometry_for(
@@ -325,7 +483,9 @@ class Planner:
                 )
                 if not changed:
                     trial.set_attributes(
-                        committed=False, nodes_copied=snapshot.revert()
+                        committed=False,
+                        nodes_copied=snapshot.revert(),
+                        **self._trial_cache_delta(stats_before),
                     )
                     continue
                 added_any = False
@@ -343,6 +503,7 @@ class Planner:
                         committed=True,
                         pods_placed=placed_here,
                         nodes_copied=snapshot.commit(),
+                        **self._trial_cache_delta(stats_before),
                     )
                     if not quiet:
                         log.info(
@@ -350,36 +511,48 @@ class Planner:
                         )
                 else:
                     trial.set_attributes(
-                        committed=False, nodes_copied=snapshot.revert()
+                        committed=False,
+                        nodes_copied=snapshot.revert(),
+                        **self._trial_cache_delta(stats_before),
                     )
 
         return placed
 
     @staticmethod
-    def _half_formable_gangs(
-        snapshot: ClusterSnapshot, candidates: List[Pod], trial_placed: List[Pod]
-    ) -> set:
-        """Gang keys whose running + trial-placed membership < size."""
-        sizes = {}
-        placed_count: dict = {}
+    def _gang_membership(
+        snapshot: ClusterSnapshot, candidates: List[Pod]
+    ) -> Tuple[dict, dict]:
+        """(gang key -> declared size, gang key -> bound-member count) over
+        the snapshot as it stands NOW — callers take it before forking the
+        gang trial so trial placements can't double as bound members."""
+        sizes: dict = {}
         for pod in candidates:
             gang = _gang_of(pod)
             if gang:
                 sizes[gang[0]] = gang[1]
+        bound_count: dict = {}
+        if sizes:
+            # ALL nodes, not just carve candidates: a member running on a
+            # fully-carved node still counts toward gang completeness.
+            for snap_node in snapshot.get_nodes().values():
+                for pod in snap_node.pods:
+                    gang = _gang_of(pod)
+                    if gang:
+                        bound_count[gang[0]] = bound_count.get(gang[0], 0) + 1
+        return sizes, bound_count
+
+    @staticmethod
+    def _half_formable_gangs(
+        sizes: dict, bound_count: dict, trial_placed: List[Pod]
+    ) -> set:
+        """Gang keys whose running + trial-placed membership < size."""
         if not sizes:
             return set()
+        placed_count: dict = {}
         for pod in trial_placed:
             gang = _gang_of(pod)
             if gang:
                 placed_count[gang[0]] = placed_count.get(gang[0], 0) + 1
-        bound_count: dict = {}
-        # ALL nodes, not just carve candidates: a member running on a
-        # fully-carved node still counts toward gang completeness.
-        for snap_node in snapshot.get_nodes().values():
-            for pod in snap_node.pods:
-                gang = _gang_of(pod)
-                if gang:
-                    bound_count[gang[0]] = bound_count.get(gang[0], 0) + 1
         return {
             key
             for key, size in sizes.items()
@@ -388,10 +561,40 @@ class Planner:
 
     # ------------------------------------------------------------------
 
+    def _candidate_nodes(self, snapshot: ClusterSnapshot) -> List[str]:
+        """get_candidate_nodes, memoized on state_version: the best-fit
+        order is a full free-chips sort, and the claim pre-pass asks once
+        per pod while placing nothing most of the time."""
+        cached = self._candidate_cache
+        if cached is not None and cached[0] == snapshot.state_version:
+            return cached[1]
+        names = snapshot.get_candidate_nodes()
+        self._candidate_cache = (snapshot.state_version, names)
+        return names
+
+    def _request_signature(self, pod: Pod) -> tuple:
+        entry = self._request_cache.get(id(pod))
+        if entry is None:
+            entry = (pod, tuple(sorted(res.compute_pod_request(pod).items())))
+            self._request_cache[id(pod)] = entry
+        return entry[1]
+
+    def _has_lacking(self, snapshot: ClusterSnapshot, pod: Pod) -> bool:
+        """bool(get_lacking_slices), memoized on (request signature,
+        state_version) — the shortcut runs per (pod, node) trial and the
+        batch holds few distinct request shapes, so most calls repeat."""
+        key = (self._request_signature(pod), snapshot.state_version)
+        lacking = self._lacking_cache.get(key)
+        if lacking is None:
+            lacking = bool(snapshot.get_lacking_slices(pod))
+            self._lacking_cache[key] = lacking
+        return lacking
+
     def _try_add_pod(self, snapshot: ClusterSnapshot, node_name: str, pod: Pod) -> bool:
+        self._ensure_plan_caches(snapshot)
         # Cheap shortcut: if the cluster still lacks slices for this pod,
         # no point running the scheduler simulation (planner.go:155-175).
-        if snapshot.get_lacking_slices(pod):
+        if self._has_lacking(snapshot, pod):
             return False
         if not self._can_schedule(snapshot, node_name, pod):
             return False
@@ -400,17 +603,70 @@ class Planner:
     def _can_schedule(self, snapshot: ClusterSnapshot, node_name: str, pod: Pod) -> bool:
         """Run the real scheduler plugins against the forked node view
         (planner.go:178-207) so the plan only contains placements the real
-        scheduler would accept."""
-        node = snapshot.get_node(node_name)
+        scheduler would accept — through the verdict cache when the trial
+        is in a cacheable equivalence class."""
+        self._ensure_plan_caches(snapshot)
+        # Read-only node access: get_node() would journal (clone) the node
+        # under an active fork, but the simulation never mutates it — any
+        # actual mutation goes through snapshot.add_pod, which journals.
+        node = snapshot.get_nodes()[node_name]
         accelerator = getattr(node.partitionable, "accelerator", "")
-        sim_pod = self._simulation_pod(snapshot, pod, accelerator)
+        sim_pod, signature, wants_context = self._simulation_pod(
+            snapshot, pod, accelerator
+        )
+        # Cross-node context means no single-node cache key is sound: the
+        # pod's own spread/affinity terms, or ANY placed pod with required
+        # anti-affinity (symmetric terms reject incoming pods). This
+        # condition also covers every cross-node read the cacheable
+        # in-tree plugins can perform — that is the bypass contract their
+        # verdict_cacheable marks rely on.
+        bypass = wants_context or snapshot.has_anti_affinity_pods()
+        if not self.verdict_cache_enabled:
+            return self._run_simulation(snapshot, node, sim_pod, publish=bypass)
+        if bypass:
+            self._verdict_cache.bypasses += 1
+            return self._run_simulation(snapshot, node, sim_pod, publish=True)
+        key = (signature, node_name, node.version)
+        verdict = self._verdict_cache.get(key)
+        if verdict is None:
+            verdict = self._run_simulation(
+                snapshot,
+                node,
+                sim_pod,
+                publish=False,
+                pre=self._cacheable_pre,
+                filters=self._cacheable_filters,
+            )
+            self._verdict_cache.put(key, verdict)
+        if not verdict:
+            return False
+        # Plugins that never opted in (external-store readers) get a
+        # fresh run on every trial; their verdict ANDs with the cached
+        # conjunction, so the split never changes the boolean outcome.
+        if not self._uncacheable_pre and not self._uncacheable_filters:
+            return True
+        return self._run_simulation(
+            snapshot,
+            node,
+            sim_pod,
+            publish=False,
+            pre=self._uncacheable_pre,
+            filters=self._uncacheable_filters,
+        )
+
+    def _run_simulation(
+        self,
+        snapshot: ClusterSnapshot,
+        node: SnapshotNode,
+        sim_pod: Pod,
+        publish: bool,
+        pre: "Optional[list]" = None,
+        filters: "Optional[list]" = None,
+    ) -> bool:
+        """One PreFilter+Filter chain run (full chains when pre/filters are
+        None, else the given subsets) against the node's simulated view."""
         state = CycleState()
-        if (
-            sim_pod.spec.topology_spread_constraints
-            or sim_pod.spec.pod_affinity
-            or sim_pod.spec.pod_anti_affinity
-            or snapshot.has_anti_affinity_pods()
-        ):
+        if publish:
             # Cross-node context for the topology-spread predicate,
             # published the same way the real cycle does (cached on the
             # snapshot across trials). Scope caveat: the snapshot holds
@@ -424,27 +680,43 @@ class Planner:
         # thousands of times per plan — so per-plugin spans are suppressed
         # here; the plan.trial spans carry the aggregate story.
         with TRACER.suppress_plugins():
-            status = self.framework.run_pre_filter_plugins(state, sim_pod)
+            status = self.framework.run_pre_filter_plugins(state, sim_pod, plugins=pre)
             if not status.success:
                 return False
             status = self.framework.run_filter_plugins(
-                state, sim_pod, node.sim_node_info()
+                state, sim_pod, self._node_info(node), plugins=filters
             )
             return status.success
 
-    def _simulation_pod(self, snapshot: ClusterSnapshot, pod: Pod, accelerator: str) -> Pod:
-        """Pod with its TPU request normalized to the candidate node's own
+    def _node_info(self, node: SnapshotNode) -> NodeInfo:
+        """node.sim_node_info() memoized on (name, version): the sim view
+        deepcopies the kube Node, and an untouched (or reverted-back) node
+        serves every trial from one view. Plugins treat NodeInfo as
+        read-only on the filter path, so sharing is safe."""
+        key = (node.name, node.version)
+        info = self._node_info_cache.get(key)
+        if info is None:
+            info = node.sim_node_info()
+            self._node_info_cache[key] = info
+        return info
+
+    def _simulation_pod(
+        self, snapshot: ClusterSnapshot, pod: Pod, accelerator: str
+    ) -> Tuple[Pod, tuple, bool]:
+        """(sim pod, verdict-cache signature, needs-cross-node-context) —
+        the pod with its TPU request normalized to the candidate node's own
         generation, matching the slice-denominated allocatable of the
         simulated node view. Cached per (pod, generation) across the many
         node trials of one plan() call."""
-        key = (pod.metadata.uid, pod.namespaced_name, accelerator)
+        key = (id(pod), accelerator)
         cached = self._sim_pod_cache.get(key)
         if cached is not None:
-            return cached
+            return cached[1], cached[2], cached[3]
         sim = pod.deepcopy()
         for container in sim.spec.containers:
             container.requests = snapshot.normalize_request(container.requests, accelerator)
         for container in sim.spec.init_containers:
             container.requests = snapshot.normalize_request(container.requests, accelerator)
-        self._sim_pod_cache[key] = sim
-        return sim
+        entry = (pod, sim, pod_signature(sim), needs_cluster_context(sim))
+        self._sim_pod_cache[key] = entry
+        return entry[1], entry[2], entry[3]

@@ -28,10 +28,24 @@ once on first use, then adjusted by the delta each geometry carve or pod
 placement produces on the touched node, and checkpointed/restored across
 fork/revert — ``get_lacking_slices`` (called per pod × node trial) no
 longer walks every node.
+
+Mutation versions: every snapshot-level mutation stamps the touched node
+(``SnapshotNode.version``) and the snapshot (``state_version``) with the
+next tick of one shared monotonic clock. Two distinct states can never
+share a version (the clock never repeats), and reverting a fork restores
+the journaled nodes *with their old versions* plus the checkpointed
+``state_version`` — so version-keyed caches (the planner's verdict cache)
+see entries from before the fork become valid again instead of being
+discarded. The versions are only maintained by the snapshot-level
+mutators; mutating a node obtained from ``get_node()`` directly (the
+legacy contract above) leaves them stale, which is safe for the planner
+(it only mutates through the snapshot) but means out-of-band mutators
+must not rely on them.
 """
 from __future__ import annotations
 
 import copy
+import itertools
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -62,6 +76,13 @@ class SnapshotNode:
     # global gating stalls every other node's replan behind one
     # in-flight actuation).
     frozen: bool = False
+    # Monotonic mutation version, stamped from the owning snapshot's
+    # shared clock on every snapshot-level mutation (carve, placement).
+    # (node name, version) pins the node's observable state exactly: the
+    # clock never repeats, and a reverted fork restores the journaled
+    # node together with its pre-fork version, re-validating any
+    # version-keyed cache entries from before the fork.
+    version: int = 0
 
     @property
     def name(self) -> str:
@@ -85,7 +106,10 @@ class SnapshotNode:
         part = self.partitionable
         clone = part.plan_clone() if hasattr(part, "plan_clone") else copy.deepcopy(part)
         return SnapshotNode(
-            partitionable=clone, pods=list(self.pods), frozen=self.frozen
+            partitionable=clone,
+            pods=list(self.pods),
+            frozen=self.frozen,
+            version=self.version,
         )
 
 
@@ -98,13 +122,27 @@ class ClusterSnapshot:
         # Fork journal stack: one dict per live fork, node name -> backup
         # SnapshotNode cloned at first touch under that fork.
         self._journals: List[Dict[str, SnapshotNode]] = []
-        # Free-pool checkpoint per live fork (None = pool not yet computed
-        # when the fork started, so revert just re-invalidates it).
-        self._pool_backups: List[Optional[ResourceList]] = []
+        # Per-fork checkpoint of (free pool, state_version). A pool of
+        # None means it was not yet computed when the fork started, so
+        # revert just re-invalidates it.
+        self._pool_backups: List[tuple] = []
         self._free_pool: Optional[ResourceList] = None
+        # Shared monotonic mutation clock: every mutation stamps the
+        # touched node's version and the snapshot-wide state_version with
+        # the next tick. Never repeats — see the module docstring.
+        self._mutation_clock = itertools.count(1)
+        self.state_version = 0
         self._accel_cache: Optional[List[str]] = None
         self._sim_cache: Optional[List[NodeInfo]] = None
-        self._anti_cache: Optional[bool] = None
+        # Count of placed pods carrying required anti-affinity, maintained
+        # incrementally once computed (None = not yet computed): add_pod
+        # increments it and fork/revert checkpoint it, so
+        # has_anti_affinity_pods() never rescans the cluster per trial.
+        self._anti_count: Optional[int] = None
+        # node name -> (version, free chips, has_free_capacity): the
+        # best-fit candidate sort reads both per node per call, and the
+        # version key keeps entries exact across mutation and revert.
+        self._free_chips_cache: Dict[str, tuple] = {}
 
     # ------------------------------------------------------ fork/commit
 
@@ -116,10 +154,13 @@ class ClusterSnapshot:
         """Start a (nestable) copy-on-write trial."""
         self._journals.append({})
         self._pool_backups.append(
-            dict(self._free_pool) if self._free_pool is not None else None
+            (
+                dict(self._free_pool) if self._free_pool is not None else None,
+                self.state_version,
+                self._anti_count,
+            )
         )
         self._sim_cache = None
-        self._anti_cache = None
         metrics.SNAPSHOT_FORKS.inc()
 
     def commit(self) -> int:
@@ -139,7 +180,6 @@ class ClusterSnapshot:
             for name, backup in journal.items():
                 parent.setdefault(name, backup)
         self._sim_cache = None
-        self._anti_cache = None
         metrics.SNAPSHOT_COMMITS.inc()
         metrics.FORK_NODES_COPIED.set(len(journal))
         return len(journal)
@@ -153,9 +193,10 @@ class ClusterSnapshot:
         journal = self._journals.pop()
         for name, backup in journal.items():
             self._nodes[name] = backup
-        self._free_pool = self._pool_backups.pop()
+        self._free_pool, self.state_version, self._anti_count = (
+            self._pool_backups.pop()
+        )
         self._sim_cache = None
-        self._anti_cache = None
         metrics.SNAPSHOT_REVERTS.inc()
         metrics.FORK_NODES_COPIED.set(len(journal))
         return len(journal)
@@ -200,6 +241,22 @@ class ClusterSnapshot:
             )
         return self._accel_cache
 
+    def _node_free_state(self, name: str, node: SnapshotNode) -> tuple:
+        """(free chips, has_free_capacity) for one node, memoized on its
+        mutation version — the candidate sort reads both for every node on
+        every call, and most nodes are untouched between calls."""
+        cached = self._free_chips_cache.get(name)
+        if cached is not None and cached[0] == node.version:
+            return cached[1], cached[2]
+        part = node.partitionable
+        chips = sum(
+            topology_chips(profile) * qty
+            for profile, qty in part.free_slices().items()
+        )
+        has_free = part.has_free_capacity()
+        self._free_chips_cache[name] = (node.version, chips, has_free)
+        return chips, has_free
+
     def get_candidate_nodes(self) -> List[str]:
         """Nodes whose geometry could still change or serve slices.
 
@@ -207,20 +264,17 @@ class ClusterSnapshot:
         instead of the reference's plain name order (snapshot.go:119-130):
         small lacking slices carve out of already-fragmented nodes, so
         whole free boards survive for board-sized requests."""
-
-        def free_chips(node) -> int:
-            return sum(
-                topology_chips(profile) * qty
-                for profile, qty in node.partitionable.free_slices().items()
-            )
-
+        states = {
+            name: self._node_free_state(name, node)
+            for name, node in self._nodes.items()
+        }
         return [
             name
             for name, node in sorted(
                 self._nodes.items(),
-                key=lambda kv: (free_chips(kv[1]), kv[0]),
+                key=lambda kv: (states[kv[0]][0], kv[0]),
             )
-            if node.partitionable.has_free_capacity() and not node.frozen
+            if states[name][1] and not node.frozen
         ]
 
     def _compute_free_pool(self) -> ResourceList:
@@ -242,6 +296,19 @@ class ClusterSnapshot:
 
     def invalidate_free_pool(self) -> None:
         self._free_pool = None
+        # Out-of-band mutation signal: per-node versions were NOT bumped,
+        # so version-keyed node entries must be dropped wholesale, and
+        # anything keyed on the snapshot-wide state_version must miss.
+        self._free_chips_cache = {}
+        self._anti_count = None
+        self._sim_cache = None
+        self.state_version = next(self._mutation_clock)
+
+    def _stamp(self, node: SnapshotNode) -> None:
+        """Advance the mutation clock onto `node` and the snapshot."""
+        tick = next(self._mutation_clock)
+        node.version = tick
+        self.state_version = tick
 
     def _apply_free_delta(self, before: "Dict[str, int]", node: SnapshotNode) -> None:
         """Fold the change in one node's free slices into the cluster pool."""
@@ -307,16 +374,18 @@ class ClusterSnapshot:
     def has_anti_affinity_pods(self) -> bool:
         """Whether any placed pod carries required anti-affinity — those
         terms are SYMMETRIC (they reject incoming pods), so the simulation
-        must publish the cluster view even for term-less candidates.
-        Cached with the same invalidation points as sim_node_infos — the
-        planner calls this once per (pod, node) trial."""
-        if self._anti_cache is None:
-            self._anti_cache = any(
-                p.spec.pod_anti_affinity
+        must publish the cluster view even for term-less candidates. The
+        planner calls this once per (pod, node) trial, so the count is
+        computed once and then maintained incrementally by add_pod and the
+        fork/revert checkpoints — never rescanned per trial."""
+        if self._anti_count is None:
+            self._anti_count = sum(
+                1
                 for node in self._nodes.values()
                 for p in node.pods
+                if p.spec.pod_anti_affinity
             )
-        return self._anti_cache
+        return self._anti_count > 0
 
     # -------------------------------------------------------- mutation
 
@@ -332,6 +401,7 @@ class ClusterSnapshot:
         if changed:
             self._apply_free_delta(before, node)
             self._sim_cache = None
+            self._stamp(node)
         return changed
 
     def add_pod(self, node_name: str, pod: Pod) -> bool:
@@ -344,7 +414,9 @@ class ClusterSnapshot:
         if added:
             self._apply_free_delta(before, node)
             self._sim_cache = None
-            self._anti_cache = None
+            if self._anti_count is not None and pod.spec.pod_anti_affinity:
+                self._anti_count += 1
+            self._stamp(node)
         return added
 
     # ------------------------------------------------------ projection
@@ -377,27 +449,33 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
-        self._deep_stack: List[Dict[str, SnapshotNode]] = []
+        self._deep_stack: List[tuple] = []
 
     def fork(self) -> None:
-        self._deep_stack.append(copy.deepcopy(self._nodes))
+        self._deep_stack.append((copy.deepcopy(self._nodes), self.state_version))
         self._sim_cache = None
-        self._anti_cache = None
+        self._anti_count = None
+        self._free_chips_cache = {}
 
     def commit(self) -> int:
         if not self._deep_stack:
             raise RuntimeError("snapshot not forked")
         self._deep_stack.pop()
         self._sim_cache = None
-        self._anti_cache = None
+        self._anti_count = None
+        self._free_chips_cache = {}
         return len(self._nodes)
 
     def revert(self) -> int:
         if not self._deep_stack:
             raise RuntimeError("snapshot not forked")
-        self._nodes = self._deep_stack.pop()
+        # The deepcopied backup carries every node's pre-fork version, and
+        # the checkpointed state_version comes back with it — same
+        # re-validation semantics as the CoW journal.
+        self._nodes, self.state_version = self._deep_stack.pop()
         self._sim_cache = None
-        self._anti_cache = None
+        self._anti_count = None
+        self._free_chips_cache = {}
         return len(self._nodes)
 
     @property

@@ -26,6 +26,10 @@ class SliceTracker:
         lets N pods hide behind one free slice and deadlocks the planner.)
         """
         self._lacking: Dict[str, ResourceList] = {}
+        # id(pod) -> (pod, key): namespaced_name is an f-string build per
+        # read, and the carve loop probes membership per (pod, node); the
+        # pinned pod ref keeps the id from being recycled.
+        self._key_cache: Dict[int, tuple] = {}
         # Per-accelerator totals, maintained incrementally: computed once
         # on first request, then kept current by remove() subtracting the
         # departing pod's converted contribution (the carve loop used to
@@ -43,8 +47,15 @@ class SliceTracker:
     def empty(self) -> bool:
         return not self._lacking
 
+    def _key(self, pod: Pod) -> str:
+        entry = self._key_cache.get(id(pod))
+        if entry is None or entry[0] is not pod:
+            entry = (pod, _pod_key(pod))
+            self._key_cache[id(pod)] = entry
+        return entry[1]
+
     def __contains__(self, pod: Pod) -> bool:
-        return _pod_key(pod) in self._lacking
+        return self._key(pod) in self._lacking
 
     def pods_with_lacking_slices(self) -> List[str]:
         return sorted(self._lacking)
@@ -92,10 +103,10 @@ class SliceTracker:
         """One pod's lacking resources, plain chips converted to the
         accelerator's slice profile (same convention as lacking_totals) —
         what a dedicated carve for exactly this pod should aim at."""
-        return self._convert_plain(self._lacking.get(_pod_key(pod), {}), accelerator)
+        return self._convert_plain(self._lacking.get(self._key(pod), {}), accelerator)
 
     def remove(self, pod: Pod) -> None:
-        lacking = self._lacking.pop(_pod_key(pod), None)
+        lacking = self._lacking.pop(self._key(pod), None)
         if lacking is None:
             return
         # Keep every cached total current by subtracting this pod's

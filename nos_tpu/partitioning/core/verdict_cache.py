@@ -1,0 +1,120 @@
+"""Equivalence-class filter-verdict cache for the planner's simulation.
+
+The planner's ``_try_add_pod`` runs the scheduler framework (PreFilter +
+Filter) once per (pod, candidate node) trial — thousands of times per
+``plan()`` — with heavily repeated inputs: a verdict only depends on the
+pod's normalized request/constraint signature and the node's current
+state, so identical trials should hit a cache instead of the plugin
+chain (the upstream kube-scheduler "equivalence cache" idea, scoped to
+one ``plan()`` invocation where it can be made exact).
+
+Key: ``(pod_signature, node name, node version)``.
+
+- ``pod_signature`` hashes every pod field the cacheable predicate set
+  reads: per-container normalized requests, namespace, labels,
+  ``nodeName``, ``nodeSelector``, tolerations, and required node
+  affinity. Two pods with identical signatures are the same trial.
+- The node name pins all static node state (labels, taints,
+  unschedulable) and ``SnapshotNode.version`` pins all mutable state
+  (geometry, placed pods): versions come from a never-repeating clock,
+  so a (name, version) pair can never alias two different states, and a
+  reverted trial restores the pre-fork version — old entries become
+  valid again rather than being discarded.
+
+Bypass: verdicts that read *cross-node* context cannot be keyed by one
+node's state. The planner bypasses the cache when the pod carries
+topology-spread or inter-pod (anti-)affinity terms, or when any placed
+pod has required anti-affinity (symmetric terms reject incoming pods).
+
+A framework plugin participates only if it sets ``verdict_cacheable =
+True`` (the in-tree predicate set does), promising its simulation
+verdict is a pure function of the signed pod fields plus the candidate
+node's own state, with no cross-plugin ``CycleState`` communication.
+Unmarked plugins (e.g. store-backed quota/reservation filters) run fresh
+on every trial, after the cached verdict for the marked ones.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from nos_tpu.kube.objects import Pod
+
+
+def pod_signature(pod: Pod) -> tuple:
+    """Hashable equivalence class of every pod field the cacheable
+    predicate set reads. Computed on the *simulation* pod (requests
+    already normalized to the candidate node's generation), once per
+    (pod, accelerator) and reused across all node trials."""
+    spec = pod.spec
+    meta = pod.metadata
+    affinity = spec.affinity
+    return (
+        tuple(tuple(sorted(c.requests.items())) for c in spec.containers),
+        tuple(tuple(sorted(c.requests.items())) for c in spec.init_containers),
+        meta.namespace,
+        tuple(sorted(meta.labels.items())),
+        spec.node_name,
+        tuple(sorted(spec.node_selector.items())),
+        tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
+        None
+        if affinity is None
+        else tuple(
+            tuple(
+                (r.key, r.operator, tuple(r.values))
+                for r in term.match_expressions
+            )
+            for term in affinity.required_terms
+        ),
+    )
+
+
+def needs_cluster_context(pod: Pod) -> bool:
+    """Whether this pod's own terms make its verdict depend on nodes other
+    than the candidate — the per-pod half of the cache bypass (the
+    snapshot-wide half is ``snapshot.has_anti_affinity_pods()``)."""
+    spec = pod.spec
+    return bool(
+        spec.topology_spread_constraints
+        or spec.pod_affinity
+        or spec.pod_anti_affinity
+    )
+
+
+class VerdictCache:
+    """One plan() invocation's verdict memo plus its hit/miss/bypass
+    ledger. Entries never need eviction: the planner creates a fresh
+    cache per plan(), and within a plan the version keys make stale
+    entries unreachable rather than wrong."""
+
+    __slots__ = ("entries", "hits", "misses", "bypasses")
+
+    def __init__(self) -> None:
+        self.entries: Dict[Tuple[tuple, str, int], bool] = {}
+        self.hits = 0
+        self.misses = 0
+        self.bypasses = 0
+
+    def get(self, key: Tuple[tuple, str, int]) -> Optional[bool]:
+        """Cached verdict, counting the lookup as hit or miss. A miss must
+        be followed by ``put(key, verdict)``."""
+        verdict = self.entries.get(key)
+        if verdict is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return verdict
+
+    def put(self, key: Tuple[tuple, str, int], verdict: bool) -> None:
+        self.entries[key] = verdict
+
+    def stats(self) -> Tuple[int, int, int]:
+        return (self.hits, self.misses, self.bypasses)
+
+    @property
+    def lookups(self) -> int:
+        return self.hits + self.misses + self.bypasses
+
+    def hit_rate(self) -> float:
+        """Hits over cache-eligible lookups (bypasses excluded)."""
+        eligible = self.hits + self.misses
+        return self.hits / eligible if eligible else 0.0

@@ -115,6 +115,18 @@ class PreFilterPlugin(Protocol):
     def pre_filter(self, state: CycleState, pod: Pod) -> Status: ...
 
 
+# Verdict-cache opt-in contract (nos_tpu/partitioning/core/verdict_cache.py):
+# a PreFilter/Filter plugin may set a class attribute
+# ``verdict_cacheable = True`` to promise its SIMULATION verdict is a pure
+# function of (a) the pod fields covered by ``verdict_cache.pod_signature``
+# and (b) the candidate node's own state — no external stores, no
+# cross-plugin CycleState reads, and any cross-NODE reads fully covered by
+# the planner's affinity/topology bypass. Plugins without the attribute
+# (default) always run fresh on every trial.
+def is_verdict_cacheable(plugin) -> bool:
+    return bool(getattr(plugin, "verdict_cacheable", False))
+
+
 class FilterPlugin(Protocol):
     name: str
 
@@ -178,8 +190,16 @@ class Framework:
     # the planner's suppressed simulation add zero spans), otherwise one
     # child span per plugin so a trace shows where the cycle's time went.
 
-    def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
-        for p in self.pre_filter_plugins:
+    def run_pre_filter_plugins(
+        self,
+        state: CycleState,
+        pod: Pod,
+        plugins: Optional[Sequence[PreFilterPlugin]] = None,
+    ) -> Status:
+        # `plugins` narrows the run to a subset (planner's verdict cache
+        # splits the chain into cacheable/uncacheable halves); None runs
+        # the full registered chain.
+        for p in self.pre_filter_plugins if plugins is None else plugins:
             with TRACER.plugin_span(f"plugin.{p.name}", point="pre_filter") as sp:
                 status = p.pre_filter(state, pod)
                 if not status.success:
@@ -188,8 +208,14 @@ class Framework:
                     return status
         return Status.ok()
 
-    def run_filter_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
-        for p in self.filter_plugins:
+    def run_filter_plugins(
+        self,
+        state: CycleState,
+        pod: Pod,
+        node_info: NodeInfo,
+        plugins: Optional[Sequence[FilterPlugin]] = None,
+    ) -> Status:
+        for p in self.filter_plugins if plugins is None else plugins:
             with TRACER.plugin_span(
                 f"plugin.{p.name}", point="filter", node=node_info.name
             ) as sp:
@@ -260,6 +286,9 @@ class NodeResourcesFit:
     """
 
     name = "NodeResourcesFit"
+    # Pure in (signed pod requests, node allocatable + placed pods — both
+    # pinned by the node's mutation version).
+    verdict_cacheable = True
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         from nos_tpu.api.v1alpha1 import constants, labels
@@ -295,6 +324,7 @@ class NodeSelectorFit:
     simulation fidelity)."""
 
     name = "NodeSelector"
+    verdict_cacheable = True  # signed nodeName/nodeSelector vs node labels
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         if pod.spec.node_name and pod.spec.node_name != node_info.name:
@@ -315,6 +345,7 @@ class NodeAffinityFit:
     cmd/gpupartitioner/gpupartitioner.go:294-318)."""
 
     name = "NodeAffinity"
+    verdict_cacheable = True  # signed required terms vs node labels
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         affinity = pod.spec.affinity
@@ -329,6 +360,7 @@ class TaintTolerationFit:
     ignored here like the vanilla filter does)."""
 
     name = "TaintToleration"
+    verdict_cacheable = True  # signed tolerations vs node taints
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         for taint in node_info.node.spec.taints:
@@ -347,6 +379,7 @@ class NodeUnschedulableFit:
     without an explicit unschedulable toleration."""
 
     name = "NodeUnschedulable"
+    verdict_cacheable = True  # node spec.unschedulable vs signed tolerations
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         if not node_info.node.spec.unschedulable:
@@ -385,6 +418,11 @@ class PodTopologySpreadFit:
     """
 
     name = "PodTopologySpread"
+    # Cacheable ONLY because the planner bypasses the verdict cache for any
+    # pod carrying topologySpreadConstraints: on the cached path the plugin
+    # is a constant ok() (no DoNotSchedule constraints to evaluate), so the
+    # cross-node reads it performs otherwise never happen under a cache key.
+    verdict_cacheable = True
     _CACHE_KEY = "pod_topology_spread_counts"
 
     @staticmethod
@@ -469,6 +507,11 @@ class InterPodAffinityFit:
     """
 
     name = "InterPodAffinity"
+    # Cacheable ONLY under the planner's bypass contract: lookups are
+    # skipped while the pod has (anti-)affinity terms OR any placed pod has
+    # required anti-affinity (the symmetric check). On the cached path both
+    # halves are vacuous, so the verdict is the constant ok().
+    verdict_cacheable = True
     _CACHE_KEY = "inter_pod_affinity_index"
     _TERM_CACHE_KEY = "inter_pod_affinity_term_index"
 

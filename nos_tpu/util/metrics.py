@@ -369,6 +369,11 @@ TRACKER_TOTALS_INCREMENTAL = REGISTRY.counter(
     "nos_tpu_tracker_totals_incremental_total",
     "SliceTracker lacking_totals calls served from the incremental cache",
 )
+PLAN_VERDICT_CACHE = REGISTRY.counter(
+    "nos_tpu_plan_verdict_cache_total",
+    "Planner verdict-cache lookups by outcome (event=hit|miss|bypass); "
+    "flushed once per plan() to keep lock traffic off the trial hot path",
+)
 MULTIHOST_EXPANSIONS = REGISTRY.counter(
     "nos_tpu_multihost_expansions_total",
     "Oversized chip requests expanded into multi-host slice gangs",

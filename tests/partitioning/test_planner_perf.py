@@ -35,6 +35,26 @@ def test_plan_64_nodes_200_pods_within_bound():
 
 
 @pytest.mark.slow
+def test_verdict_cache_hit_rate_floor():
+    """The equivalence-class verdict cache carries the simulation-path
+    speedup, and its value is all in the hit rate: the 64x200 reference
+    config measures ~0.86 (BENCH_planner.json). A drop below the floor
+    means the key fragmented (a signature field that varies per trial) or
+    invalidation went too wide (version stamps on untouched nodes)."""
+    planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+    planner.plan(make_cluster(8, ClusterSnapshot), make_pending(10))  # warm-up
+
+    snapshot = make_cluster(64, ClusterSnapshot)
+    planner.plan(snapshot, make_pending(200))
+    hits, misses, bypasses = planner.verdict_cache_stats()
+
+    assert hits + misses > 0, "no cache-eligible trials — workload broke?"
+    assert bypasses == 0, "plain bench pods must never bypass the cache"
+    rate = hits / (hits + misses)
+    assert rate >= 0.75, f"verdict-cache hit rate {rate:.3f} below the 0.75 floor"
+
+
+@pytest.mark.slow
 def test_tracing_overhead_within_allowance():
     """The planner is instrumented (a span per carve trial, suppressed
     plugin spans in simulation). With TRACER.enabled=False those calls are

@@ -1,0 +1,461 @@
+"""Verdict-cache correctness: cached verdicts vs the fresh framework run.
+
+The equivalence-class cache (verdict_cache.py) keys a PreFilter+Filter
+verdict by (pod signature, node name, node mutation version). These tests
+pin the three load-bearing guarantees:
+
+- the mutation clock: every snapshot-level mutation stamps a fresh,
+  never-repeating version on the node and the snapshot, and revert
+  restores the pre-fork versions exactly (re-validating old entries);
+- the property: a cached planner's ``_can_schedule`` answer equals a
+  cache-disabled planner's answer for random (pod, node) probes across
+  randomized fork/commit/revert + geometry-mutation + placement
+  sequences, over pods spanning the signed field set (requests,
+  nodeSelector, tolerations, node affinity) plus bypass-triggering
+  anti-affinity pods;
+- the plan: full plan() with the cache on equals plan() with it off, and
+  the gang-trial-reuse shortcut equals the two-pass path, down to the
+  projected PartitioningState and per-node placements.
+"""
+import random
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import (
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from nos_tpu.partitioning.core import (
+    ClusterSnapshot,
+    Planner,
+    SnapshotNode,
+    VerdictCache,
+    partitioning_state_equal,
+)
+from nos_tpu.partitioning.core.verdict_cache import (
+    needs_cluster_context,
+    pod_signature,
+)
+from nos_tpu.scheduler.framework import (
+    Framework,
+    InterPodAffinityFit,
+    NodeAffinityFit,
+    NodeResourcesFit,
+    NodeSelectorFit,
+    PodTopologySpreadFit,
+    TaintTolerationFit,
+)
+from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import V5E, build_pod, build_tpu_node, slice_res
+
+PROFILES = ["1x1", "1x2", "2x2", "2x4"]
+
+
+def build_cluster(rng, n_min=3, n_max=6):
+    """Deterministic mixed-fill cluster from `rng`'s current state — call
+    twice with identically-seeded rngs to get twin clusters."""
+    nodes = {}
+    for i in range(rng.randint(n_min, n_max)):
+        name = f"n{i}"
+        style = rng.random()
+        if style < 0.4:
+            annotations = None  # virgin board
+        elif style < 0.7:
+            annotations = annot.status_from_devices(
+                free={0: {rng.choice(PROFILES): 1}}, used={}
+            )
+        else:
+            annotations = annot.status_from_devices(
+                free={0: {"2x2": 1}}, used={0: {"2x2": 1}}
+            )
+        node = build_tpu_node(name=name, annotations=annotations)
+        nodes[name] = SnapshotNode(partitionable=TpuNode(node))
+    return ClusterSnapshot(nodes)
+
+
+def node_local_framework():
+    return Framework(
+        filter_plugins=[
+            NodeResourcesFit(),
+            NodeSelectorFit(),
+            NodeAffinityFit(),
+            TaintTolerationFit(),
+        ]
+    )
+
+
+def full_framework():
+    """Every in-tree predicate, including the cross-node ones whose
+    correctness rides on the planner's bypass condition."""
+    return Framework(
+        filter_plugins=[
+            NodeResourcesFit(),
+            NodeSelectorFit(),
+            NodeAffinityFit(),
+            TaintTolerationFit(),
+            PodTopologySpreadFit(),
+            InterPodAffinityFit(),
+        ]
+    )
+
+
+def anti_affinity_term():
+    return PodAffinityTerm(
+        topology_key="kubernetes.io/hostname", match_labels={"app": "db"}
+    )
+
+
+def probe_pods():
+    """Pods spanning the signed field set: request shapes, matching and
+    non-matching nodeSelector, tolerations, required node affinity (both
+    outcomes), and an anti-affinity pod that must bypass the cache."""
+    pods = []
+    for i, req in enumerate(
+        [
+            {slice_res("1x1"): 1},
+            {slice_res("2x2"): 1},
+            {slice_res("2x4"): 1},
+            {constants.RESOURCE_TPU: 4},
+            {constants.RESOURCE_TPU: 1},
+        ]
+    ):
+        pods.append(build_pod(f"req-{i}", req))
+    sel = build_pod("sel-match", {slice_res("1x1"): 1})
+    sel.spec.node_selector = {labels.GKE_TPU_ACCELERATOR_LABEL: V5E}
+    pods.append(sel)
+    miss = build_pod("sel-miss", {slice_res("1x1"): 1})
+    miss.spec.node_selector = {"topology.kubernetes.io/zone": "nowhere"}
+    pods.append(miss)
+    tol = build_pod("tolerant", {slice_res("1x1"): 1})
+    tol.spec.tolerations = [
+        Toleration(key="dedicated", operator="Equal", value="tpu", effect="NoSchedule")
+    ]
+    pods.append(tol)
+    aff = build_pod("aff-match", {slice_res("1x1"): 1})
+    aff.spec.affinity = NodeAffinity(
+        required_terms=[
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(
+                        key=labels.GKE_TPU_ACCELERATOR_LABEL,
+                        operator="In",
+                        values=[V5E],
+                    )
+                ]
+            )
+        ]
+    )
+    pods.append(aff)
+    affmiss = build_pod("aff-miss", {slice_res("1x1"): 1})
+    affmiss.spec.affinity = NodeAffinity(
+        required_terms=[
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(
+                        key=labels.GKE_TPU_ACCELERATOR_LABEL,
+                        operator="In",
+                        values=["some-other-generation"],
+                    )
+                ]
+            )
+        ]
+    )
+    pods.append(affmiss)
+    anti = build_pod("anti", {slice_res("1x1"): 1})
+    anti.spec.pod_anti_affinity = [anti_affinity_term()]
+    pods.append(anti)
+    return pods
+
+
+class TestMutationClock:
+    def test_mutations_stamp_unique_versions(self):
+        snap = build_cluster(random.Random(1))
+        node = snap.get_nodes()["n0"]
+        assert node.version == 0 and snap.state_version == 0
+        assert snap.update_geometry_for("n0", {slice_res("1x1"): 1})
+        v_carve = node.version
+        assert v_carve > 0 and snap.state_version == v_carve
+        assert snap.add_pod("n0", build_pod("p1", {slice_res("1x1"): 1}))
+        v_place = node.version
+        assert v_place > v_carve and snap.state_version == v_place
+
+    def test_revert_restores_versions_exactly(self):
+        snap = build_cluster(random.Random(2))
+        assert snap.update_geometry_for("n0", {slice_res("1x1"): 1})
+        node = snap.get_nodes()["n0"]
+        v_before, sv_before = node.version, snap.state_version
+        snap.fork()
+        assert snap.update_geometry_for("n0", {slice_res("1x2"): 1})
+        assert snap.get_nodes()["n0"].version > v_before
+        snap.revert()
+        assert snap.get_nodes()["n0"].version == v_before
+        assert snap.state_version == sv_before
+
+    def test_commit_keeps_versions(self):
+        snap = build_cluster(random.Random(3))
+        snap.fork()
+        assert snap.update_geometry_for("n0", {slice_res("1x1"): 1})
+        v_mut, sv_mut = snap.get_nodes()["n0"].version, snap.state_version
+        snap.commit()
+        assert snap.get_nodes()["n0"].version == v_mut
+        assert snap.state_version == sv_mut
+
+    def test_versions_never_alias_across_revert(self):
+        # The same mutation replayed after a revert reaches the same
+        # geometry but must get a FRESH version — (name, version) may
+        # never mean two different journal histories.
+        snap = build_cluster(random.Random(4))
+        snap.fork()
+        assert snap.update_geometry_for("n0", {slice_res("1x1"): 1})
+        v_first = snap.get_nodes()["n0"].version
+        snap.revert()
+        snap.fork()
+        assert snap.update_geometry_for("n0", {slice_res("1x1"): 1})
+        v_second = snap.get_nodes()["n0"].version
+        snap.revert()
+        assert v_second != v_first
+
+
+class TestSignatureAndBypass:
+    def test_signature_is_an_equivalence_class_not_an_identity(self):
+        # Same spec, different name/uid -> same trial.
+        a = build_pod("alpha", {slice_res("2x2"): 1})
+        b = build_pod("beta", {slice_res("2x2"): 1})
+        assert pod_signature(a) == pod_signature(b)
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda p: p.spec.containers[0].requests.update({"cpu": 2}),
+            lambda p: p.spec.node_selector.update({"zone": "a"}),
+            lambda p: p.metadata.labels.update({"team": "ml"}),
+            lambda p: p.spec.tolerations.append(
+                Toleration(key="k", operator="Exists", effect="NoSchedule")
+            ),
+            lambda p: setattr(
+                p.spec,
+                "affinity",
+                NodeAffinity(
+                    required_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(key="k", operator="Exists")
+                            ]
+                        )
+                    ]
+                ),
+            ),
+        ],
+    )
+    def test_signature_covers_every_signed_field(self, mutate):
+        base = build_pod("base", {slice_res("2x2"): 1})
+        other = build_pod("base", {slice_res("2x2"): 1})
+        mutate(other)
+        assert pod_signature(base) != pod_signature(other)
+
+    def test_needs_cluster_context(self):
+        plain = build_pod("plain", {slice_res("1x1"): 1})
+        assert not needs_cluster_context(plain)
+        anti = build_pod("anti", {slice_res("1x1"): 1})
+        anti.spec.pod_anti_affinity = [anti_affinity_term()]
+        assert needs_cluster_context(anti)
+        spread = build_pod("spread", {slice_res("1x1"): 1})
+        spread.spec.topology_spread_constraints = [
+            TopologySpreadConstraint(
+                topology_key="kubernetes.io/hostname", match_labels={"app": "x"}
+            )
+        ]
+        assert needs_cluster_context(spread)
+
+    def test_cache_counts_and_hit_rate(self):
+        cache = VerdictCache()
+        key = (("sig",), "n0", 1)
+        assert cache.get(key) is None  # miss
+        cache.put(key, False)
+        assert cache.get(key) is False  # a cached False is a hit, not a miss
+        cache.bypasses += 1
+        assert cache.stats() == (1, 1, 1)
+        assert cache.lookups == 3
+        assert cache.hit_rate() == 0.5
+
+
+class TestCachedVerdictEqualsFreshRun:
+    """The property: across randomized fork/commit/revert + mutation
+    sequences on ONE snapshot, a cache-enabled planner answers every
+    (pod, node) schedulability probe identically to a cache-disabled one
+    running the framework fresh."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_property_random_mutation_sequences(self, seed):
+        rng = random.Random(4000 + seed)
+        snapshot = build_cluster(random.Random(1000 + seed))
+        framework = node_local_framework()
+        cached = Planner(framework, verdict_cache_enabled=True)
+        fresh = Planner(framework, verdict_cache_enabled=False)
+        pods = probe_pods()
+        names = list(snapshot.get_nodes())
+        depth = 0
+        serial = 0
+        for step in range(40):
+            context = f"seed={seed} step={step}"
+            roll = rng.random()
+            if roll < 0.15 and depth < 3:
+                snapshot.fork()
+                depth += 1
+            elif roll < 0.3 and depth > 0:
+                snapshot.revert()
+                depth -= 1
+            elif roll < 0.4 and depth > 0:
+                snapshot.commit()
+                depth -= 1
+            elif roll < 0.7:
+                snapshot.update_geometry_for(
+                    rng.choice(names),
+                    {slice_res(rng.choice(PROFILES)): rng.randint(1, 2)},
+                )
+            else:
+                serial += 1
+                pod = build_pod(
+                    f"placed-{serial}", {slice_res(rng.choice(PROFILES)): 1}
+                )
+                if rng.random() < 0.1:
+                    # Occasionally PLACE an anti-affinity pod: from then on
+                    # (until a revert undoes it) every probe must take the
+                    # snapshot-wide bypass, and the two planners must still
+                    # agree.
+                    pod.spec.pod_anti_affinity = [anti_affinity_term()]
+                snapshot.add_pod(rng.choice(names), pod)
+            for _ in range(3):
+                pod = rng.choice(pods)
+                node_name = rng.choice(names)
+                assert cached._can_schedule(snapshot, node_name, pod) == (
+                    fresh._can_schedule(snapshot, node_name, pod)
+                ), f"{context} pod={pod.metadata.name} node={node_name}"
+        while depth:
+            snapshot.revert()
+            depth -= 1
+        # Every probe must have gone THROUGH the cache layer (hit, miss,
+        # or counted bypass — a seed that places an anti-affinity pod
+        # early legitimately bypasses from then on; the deterministic
+        # hit/bypass assertions live in TestPlanCacheOnOffEquivalence).
+        assert cached._verdict_cache.lookups > 0, f"seed={seed}"
+
+
+def random_pending_pods(rng, with_constraints=False):
+    pods = []
+    for i in range(rng.randint(2, 10)):
+        style = rng.random()
+        if style < 0.5:
+            req = {slice_res(rng.choice(PROFILES)): 1}
+        elif style < 0.8:
+            req = {constants.RESOURCE_TPU: rng.choice([1, 2, 4, 8])}
+        else:
+            req = {slice_res("1x1"): 1, "cpu": 1}
+        pod = build_pod(f"pend-{i}", req, priority=rng.choice([0, 0, 0, 10]))
+        if rng.random() < 0.25:
+            pod.metadata.labels["nos.nebuly.com/gang"] = f"g{rng.randint(0, 1)}"
+            pod.metadata.labels["nos.nebuly.com/gang-size"] = str(rng.randint(1, 3))
+        if with_constraints:
+            style = rng.random()
+            if style < 0.15:
+                pod.spec.node_selector = {labels.GKE_TPU_ACCELERATOR_LABEL: V5E}
+            elif style < 0.25:
+                pod.spec.pod_anti_affinity = [anti_affinity_term()]
+            elif style < 0.35:
+                pod.metadata.labels["app"] = "spreadme"
+                pod.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        topology_key="kubernetes.io/hostname",
+                        match_labels={"app": "spreadme"},
+                    )
+                ]
+        pods.append(pod)
+    return pods
+
+
+def placements(snapshot):
+    return {
+        name: [p.namespaced_name for p in node.pods]
+        for name, node in snapshot.get_nodes().items()
+    }
+
+
+class TestPlanCacheOnOffEquivalence:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_plan_identical_with_and_without_cache(self, seed):
+        on_snap = build_cluster(random.Random(2000 + seed))
+        off_snap = build_cluster(random.Random(2000 + seed))
+        pods = random_pending_pods(random.Random(3000 + seed), with_constraints=True)
+        plan_on = Planner(full_framework(), verdict_cache_enabled=True).plan(
+            on_snap, [p.deepcopy() for p in pods]
+        )
+        plan_off = Planner(full_framework(), verdict_cache_enabled=False).plan(
+            off_snap, [p.deepcopy() for p in pods]
+        )
+        assert partitioning_state_equal(plan_on, plan_off), f"seed={seed}"
+        assert placements(on_snap) == placements(off_snap), f"seed={seed}"
+        assert not on_snap.forked and not off_snap.forked
+
+    def test_plan_records_hits_no_bypass_on_plain_pods(self):
+        snapshot = build_cluster(random.Random(42), n_min=6, n_max=6)
+        planner = Planner(node_local_framework())
+        planner.plan(
+            snapshot,
+            [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(12)],
+        )
+        hits, _, bypasses = planner.verdict_cache_stats()
+        assert hits > 0
+        assert bypasses == 0
+
+    def test_placed_anti_affinity_pod_forces_bypass(self):
+        # Same workload as the hits test above, but with one RUNNING
+        # anti-affinity pod on the cluster: its symmetric terms can reject
+        # any incoming pod, so every trial must bypass the cache.
+        snapshot = build_cluster(random.Random(42), n_min=6, n_max=6)
+        anti = build_pod("anti", {}, node="n0")
+        anti.spec.pod_anti_affinity = [anti_affinity_term()]
+        snapshot.get_nodes()["n0"].pods.append(anti)
+        planner = Planner(full_framework())
+        planner.plan(
+            snapshot,
+            [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(12)],
+        )
+        hits, _, bypasses = planner.verdict_cache_stats()
+        assert bypasses > 0
+        assert hits == 0
+
+
+class TestGangTrialReuse:
+    """Regression for the reuse shortcut: when no gang is excluded the
+    committed trial must be bit-identical to what the two-pass path (trial
+    + revert + fresh real pass) produces."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_reuse_equals_two_pass(self, seed):
+        reuse_snap = build_cluster(random.Random(2000 + seed))
+        twopass_snap = build_cluster(random.Random(2000 + seed))
+        rng = random.Random(3000 + seed)
+        pods = random_pending_pods(rng)
+        # Force at least one gang (also fully-formable: size 1) so the
+        # trial path actually runs on every seed.
+        anchor = build_pod("gang-anchor", {slice_res(rng.choice(PROFILES)): 1})
+        anchor.metadata.labels["nos.nebuly.com/gang"] = "anchor"
+        anchor.metadata.labels["nos.nebuly.com/gang-size"] = "1"
+        pods.append(anchor)
+        plan_reuse = Planner(node_local_framework(), reuse_gang_trial=True).plan(
+            reuse_snap, [p.deepcopy() for p in pods]
+        )
+        plan_twopass = Planner(node_local_framework(), reuse_gang_trial=False).plan(
+            twopass_snap, [p.deepcopy() for p in pods]
+        )
+        assert partitioning_state_equal(plan_reuse, plan_twopass), f"seed={seed}"
+        assert placements(reuse_snap) == placements(twopass_snap), f"seed={seed}"
+        # The reuse path commits the trial fork; nothing may stay forked.
+        assert not reuse_snap.forked and not twopass_snap.forked

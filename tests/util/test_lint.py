@@ -133,6 +133,80 @@ class TestRepoIsClean:
         assert not findings, "\n".join(str(f) for f in findings)
 
 
+class TestHotPathNoDeepcopy:
+    """The planner's per-trial simulation path (thousands of calls per
+    plan()) must stay deepcopy-free — the CoW journal and the version-keyed
+    memos exist precisely so no per-trial code needs a deep copy. The two
+    deliberate, amortized deep copies are NOT on the checked list:
+    SnapshotNode.plan_clone's fallback for partitionables without a
+    plan_clone, and Planner._simulation_pod / TpuNode.to_sim_node, which
+    run once per (pod, generation) / (node, version) behind memos."""
+
+    def test_no_deepcopy_on_simulation_hot_path(self):
+        import ast
+        import inspect
+        import textwrap
+
+        from nos_tpu.partitioning.core.planner import Planner
+        from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+        from nos_tpu.partitioning.core.tracker import SliceTracker
+        from nos_tpu.scheduler.framework import Framework
+        from nos_tpu.tpu.node import TpuNode
+
+        hot_path = {
+            Planner: [
+                "_plan_pass",
+                "_try_add_pod",
+                "_can_schedule",
+                "_run_simulation",
+                "_has_lacking",
+                "_request_signature",
+                "_node_info",
+                "_candidate_nodes",
+            ],
+            ClusterSnapshot: [
+                "fork",
+                "commit",
+                "revert",
+                "_touch",
+                "get_node",
+                "get_candidate_nodes",
+                "_node_free_state",
+                "get_lacking_slices",
+                "free_slice_resources",
+                "_apply_free_delta",
+                "has_anti_affinity_pods",
+                "take_from_pool",
+                "update_geometry_for",
+                "add_pod",
+            ],
+            SliceTracker: [
+                "__contains__",
+                "_key",
+                "_convert_plain",
+                "lacking_totals",
+                "lacking_for",
+                "remove",
+            ],
+            Framework: ["run_pre_filter_plugins", "run_filter_plugins"],
+            TpuNode: ["plan_clone", "add_pod"],
+        }
+        offenders = []
+        for cls, names in hot_path.items():
+            for name in names:
+                fn = getattr(cls, name)
+                tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+                for node in ast.walk(tree):
+                    called = isinstance(node, ast.Attribute) and node.attr == "deepcopy"
+                    named = isinstance(node, ast.Name) and node.id == "deepcopy"
+                    if called or named:
+                        offenders.append(f"{cls.__name__}.{name}")
+                        break
+        assert not offenders, (
+            f"deepcopy reached the simulation hot path: {offenders}"
+        )
+
+
 class TestMetricsDocDrift:
     """Every registered metric is namespaced and documented — a new metric
     that skips docs/en/docs/telemetry.md fails CI here, not in review."""
