@@ -54,6 +54,27 @@ INDEX_POD_PHASE = "status.phase"
 INDEX_POD_NODE = "spec.nodeName"
 INDEX_EQ_NAMESPACE = "spec.namespaces"
 
+# Event reasons — the single source of truth. Every Event written through
+# kube/events.py must use one of these (enforced by a lint test), so
+# operators and e2e assertions can grep a closed vocabulary.
+EVENT_REASON_FAILED_SCHEDULING = "FailedScheduling"
+EVENT_REASON_SCHEDULED = "Scheduled"
+EVENT_REASON_PREEMPTED = "Preempted"
+EVENT_REASON_QUOTA_BORROWED = "QuotaBorrowed"
+EVENT_REASON_QUOTA_RECLAIMED = "QuotaReclaimed"
+EVENT_REASON_PARTITIONING_APPLIED = "PartitioningApplied"
+EVENT_REASON_CARVE_FAILED = "CarveFailed"
+
+EVENT_REASONS = (
+    EVENT_REASON_FAILED_SCHEDULING,
+    EVENT_REASON_SCHEDULED,
+    EVENT_REASON_PREEMPTED,
+    EVENT_REASON_QUOTA_BORROWED,
+    EVENT_REASON_QUOTA_RECLAIMED,
+    EVENT_REASON_PARTITIONING_APPLIED,
+    EVENT_REASON_CARVE_FAILED,
+)
+
 
 def is_tpu_slice_resource(name: str) -> bool:
     return RESOURCE_TPU_SLICE_REGEX.match(name) is not None

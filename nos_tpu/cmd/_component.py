@@ -66,7 +66,10 @@ def run_component(
     stop_event: Optional[threading.Event] = None,
     ready_check: Optional[Callable[[], bool]] = None,
 ) -> int:
-    """`build(manager, config_dict)` wires the component; then serve."""
+    """`build(manager, config_dict)` wires the component; then serve.
+
+    When `build` returns an object with an ``explain`` callable (the
+    scheduler), it is served as ``/debug/explain`` next to /metrics."""
     from nos_tpu.cmd.run import load_config
 
     parser = component_argparser(name)
@@ -79,7 +82,7 @@ def run_component(
 
     store = build_store(config)
     manager = Manager(store=store)
-    build(manager, config)
+    component = build(manager, config)
 
     manager_cfg = config.get("manager") or {}
     port = args.health_port
@@ -108,6 +111,7 @@ def run_component(
         host=manager_cfg.get("healthProbeHost", "0.0.0.0"),
         metrics_token=metrics_token,
         metrics_loopback_port=int(metrics_port) if metrics_port else None,
+        explain_fn=getattr(component, "explain", None),
     )
     bound = health.start()
     logging.info("%s: health/metrics on 127.0.0.1:%d", name, bound)

@@ -10,6 +10,7 @@ from nos_tpu.controllers.elasticquota import (
 )
 from nos_tpu.controllers.elasticquota.controller import pod_to_quota_requests
 from nos_tpu.kube.controller import Controller, Manager, Watch
+from nos_tpu.kube.events import EventRecorder
 
 
 def build_operator(manager: Manager, config: OperatorConfig | None = None) -> None:
@@ -18,8 +19,13 @@ def build_operator(manager: Manager, config: OperatorConfig | None = None) -> No
     store = manager.store
     register_elasticquota_webhooks(store)
 
-    eq = ElasticQuotaReconciler(store, chip_memory_gb=config.tpu_chip_memory_gb)
-    ceq = CompositeElasticQuotaReconciler(store, chip_memory_gb=config.tpu_chip_memory_gb)
+    recorder = EventRecorder(store, component="nos-operator")
+    eq = ElasticQuotaReconciler(
+        store, chip_memory_gb=config.tpu_chip_memory_gb, recorder=recorder
+    )
+    ceq = CompositeElasticQuotaReconciler(
+        store, chip_memory_gb=config.tpu_chip_memory_gb, recorder=recorder
+    )
 
     manager.add(
         Controller(

@@ -46,6 +46,9 @@ def build_partitioner(
     if config.known_tpu_geometries:
         set_known_geometries(config.known_tpu_geometries)
 
+    from nos_tpu.kube.events import EventRecorder
+
+    recorder = EventRecorder(store, component="nos-partitioner")
     cluster_state = ClusterState()
     # Wall-clock ms + monotonic counter: two plans in the same millisecond
     # must not share an id or the spec/status handshake would false-ack.
@@ -88,6 +91,7 @@ def build_partitioner(
         batch_idle_seconds=config.batch_window_idle_seconds,
         scheduler_name=config.scheduler_name,
         plan_id_fn=plan_id_fn,
+        recorder=recorder,
     )
 
     node_ctrl = StateNodeController(store, cluster_state, initializer=initializer)
@@ -198,6 +202,7 @@ def build_partitioner(
         scheduler_name=config.scheduler_name,
         plan_id_fn=plan_id_fn,
         tracked_resource_fn=sharing_codec.is_tracked,
+        recorder=recorder,
     )
     manager.add(
         Controller(

@@ -117,9 +117,12 @@ def main(argv=None) -> int:
     port = args.health_port
     if port is None:
         port = (config.get("manager") or {}).get("healthProbePort", 8081)
-    health = HealthServer(port=port)
+    health = HealthServer(port=port, explain_fn=cluster.scheduler.explain)
     bound = health.start()
-    logging.info("health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics)", bound)
+    logging.info(
+        "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain)",
+        bound,
+    )
 
     cluster.start()
     stop = threading.Event()

@@ -6,6 +6,7 @@ import logging
 
 from nos_tpu.api.config import SchedulerConfig
 from nos_tpu.kube.controller import Controller, Manager, Request, Watch
+from nos_tpu.kube.events import EventRecorder
 from nos_tpu.kube.objects import PodPhase
 from nos_tpu.scheduler.scheduler import Scheduler, new_framework
 
@@ -24,6 +25,7 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
         gang=gang,
         retry_seconds=config.retry_seconds,
         scheduler_name=config.scheduler_name,
+        recorder=EventRecorder(store, component="nos-scheduler"),
     )
 
     logged_foreign: set = set()
@@ -102,6 +104,8 @@ def main(argv=None) -> int:
 
     def build(manager, config):
         _, scheduler_cfg, _ = configs_from(config)
-        build_scheduler(manager, scheduler_cfg)
+        # Returned so run_component serves the scheduler's diagnosis
+        # ledger as /debug/explain.
+        return build_scheduler(manager, scheduler_cfg)
 
     return run_component("scheduler", build, argv)

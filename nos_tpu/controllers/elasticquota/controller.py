@@ -45,11 +45,20 @@ def _filter_to_min(request: ResourceList, min_resources: ResourceList) -> Resour
 
 
 class _QuotaReconcilerBase:
-    def __init__(self, store: KubeStore, chip_memory_gb: int | None = None) -> None:
+    def __init__(
+        self,
+        store: KubeStore,
+        chip_memory_gb: int | None = None,
+        recorder=None,
+    ) -> None:
         from nos_tpu.api.v1alpha1 import constants
 
         self.store = store
         self.chip_memory_gb = chip_memory_gb or constants.DEFAULT_TPU_CHIP_MEMORY_GB
+        # Optional kube/events.py EventRecorder: QuotaBorrowed/QuotaReclaimed
+        # on every capacity-label flip, so "why is my pod a preemption
+        # victim" is answerable from kubectl-style events.
+        self.recorder = recorder
 
     def _running_pods(self, namespaces: List[str]) -> List[Pod]:
         pods: List[Pod] = []
@@ -86,13 +95,15 @@ class _QuotaReconcilerBase:
             desired_label = (
                 labels_api.CAPACITY_IN_QUOTA if in_quota else labels_api.CAPACITY_OVER_QUOTA
             )
-            if pod.metadata.labels.get(labels_api.CAPACITY_LABEL) != desired_label:
+            previous_label = pod.metadata.labels.get(labels_api.CAPACITY_LABEL)
+            if previous_label != desired_label:
                 self.store.patch_labels(
                     "Pod",
                     pod.metadata.name,
                     pod.metadata.namespace,
                     {labels_api.CAPACITY_LABEL: desired_label},
                 )
+                self._record_capacity_flip(quota, pod, in_quota, previous_label)
             used = candidate
 
         if quota.status.used != used:
@@ -101,6 +112,34 @@ class _QuotaReconcilerBase:
 
             self.store.patch_merge(
                 quota.kind, quota.metadata.name, quota.metadata.namespace, mutate
+            )
+
+    def _record_capacity_flip(
+        self, quota, pod: Pod, in_quota: bool, previous_label
+    ) -> None:
+        if self.recorder is None:
+            return
+        from nos_tpu.api.v1alpha1 import constants
+
+        quota_name = f"{quota.metadata.namespace}/{quota.metadata.name}".lstrip("/")
+        if in_quota:
+            # A pod's FIRST labeling as in-quota is the steady state, not a
+            # reclaim — only an over-quota -> in-quota flip is news.
+            if previous_label != labels_api.CAPACITY_OVER_QUOTA:
+                return
+            self.recorder.record(
+                pod,
+                constants.EVENT_REASON_QUOTA_RECLAIMED,
+                f"{pod.namespaced_name} back within {quota.kind} "
+                f"{quota_name} guaranteed quota",
+            )
+        else:
+            self.recorder.record(
+                pod,
+                constants.EVENT_REASON_QUOTA_BORROWED,
+                f"{pod.namespaced_name} running on capacity borrowed over "
+                f"{quota.kind} {quota_name} min (preemptible)",
+                type="Warning",
             )
 
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from nos_tpu.api.v1alpha1 import annotations as annot
 from nos_tpu.api.v1alpha1 import constants
@@ -49,8 +49,16 @@ class PartitionerController:
         plan_id_fn=lambda: str(int(time.time() * 1000)),
         tracked_resource_fn=None,
         scheduler_name: str = "",
+        recorder=None,
     ) -> None:
         self.store = store
+        # Optional kube/events.py EventRecorder: PartitioningApplied when a
+        # plan actuates, CarveFailed (with the planner's lacking-profile
+        # reason) per pod the plan could not serve.
+        self.recorder = recorder
+        # namespaced_name -> last CarveFailed reason recorded; pruned to
+        # the live pending set every cycle so deleted pods don't leak.
+        self._last_carve_reason: Dict[str, str] = {}
         self.cluster_state = cluster_state
         self.snapshot_taker = snapshot_taker
         self.planner = planner
@@ -279,7 +287,45 @@ class PartitionerController:
             log.info(
                 "partitioner: plan %s applied for %d pending pods", plan.id, len(pending)
             )
+        self._record_plan_events(pending, applied)
         return applied
+
+    def _record_plan_events(self, pending: List[Pod], applied: int) -> None:
+        """Event messages carry NO plan id: the id changes every cycle, so
+        embedding it would defeat the recorder's dedup (a fresh Event
+        object per plan) and the flood would drain the pod's rate-limit
+        bucket — silently dropping the one PartitioningApplied that
+        matters. The per-pod reason memo exists for the same budget: a
+        plan loop re-deriving the identical verdict every few hundred ms
+        records nothing until the verdict actually changes."""
+        if self.recorder is None:
+            return
+        unserved = getattr(self.planner, "last_unserved", {})
+        live = {p.namespaced_name for p in pending}
+        self._last_carve_reason = {
+            k: v for k, v in self._last_carve_reason.items() if k in live
+        }
+        for pod in pending:
+            reason = unserved.get(pod.namespaced_name)
+            if reason is not None:
+                if self._last_carve_reason.get(pod.namespaced_name) == reason:
+                    continue
+                self._last_carve_reason[pod.namespaced_name] = reason
+                self.recorder.record(
+                    pod,
+                    constants.EVENT_REASON_CARVE_FAILED,
+                    f"cannot carve slices for {pod.namespaced_name}: {reason}",
+                    type="Warning",
+                )
+            else:
+                self._last_carve_reason.pop(pod.namespaced_name, None)
+                if applied:
+                    self.recorder.record(
+                        pod,
+                        constants.EVENT_REASON_PARTITIONING_APPLIED,
+                        f"re-partitioned {applied} node(s) to serve "
+                        f"{pod.namespaced_name}",
+                    )
 
     def idle(self) -> bool:
         return self.batcher.current_batch_size() == 0

@@ -1,0 +1,180 @@
+"""Kubernetes-style Event recording.
+
+The reference emits operator-facing Events through controller-runtime's
+``record.EventRecorder``, which correlates (dedups + rate-limits) before
+anything hits the apiserver. This is the same contract for the in-process
+suite: ``EventRecorder.record(obj, reason, message)`` writes a
+``v1.Event``-shaped object through whichever store it was given (the
+in-memory KubeStore or the API-backed KubeApiStore — same method surface),
+bumping ``count``/``lastTimestamp`` on an identical (object, reason,
+message) repeat instead of writing a duplicate, and dropping floods
+through a per-object token bucket (burst then steady refill, like
+client-go's EventSourceObjectSpamFilter).
+
+Reasons come from the single constants table in
+``nos_tpu/api/v1alpha1/constants.py`` — an unknown reason raises, and a
+lint test keeps call sites honest.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, List, Optional
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import Event
+from nos_tpu.kube.store import AlreadyExistsError, NotFoundError
+
+# client-go spam-filter defaults: a burst of 25 events per object, then
+# one more every 5 minutes (qps = 1/300).
+DEFAULT_BURST = 25
+DEFAULT_REFILL_PER_SECOND = 1.0 / 300.0
+
+# Correlator state is bounded: beyond this many distinct buckets the
+# oldest-touched half is dropped (worst case: a flood re-earns its burst).
+_MAX_BUCKETS = 4096
+
+
+class _TokenBucket:
+    __slots__ = ("tokens", "last_refill", "last_touch")
+
+    def __init__(self, burst: float, now: float) -> None:
+        self.tokens = burst
+        self.last_refill = now
+        self.last_touch = now
+
+
+class EventRecorder:
+    """Writes deduped, rate-limited ``Event`` objects through a store."""
+
+    def __init__(
+        self,
+        store: Any,
+        component: str = "",
+        burst: int = DEFAULT_BURST,
+        refill_per_second: float = DEFAULT_REFILL_PER_SECOND,
+        clock=time.time,
+    ) -> None:
+        self.store = store
+        self.component = component
+        self.burst = float(burst)
+        self.refill_per_second = refill_per_second
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._buckets = {}
+        self.dropped = 0  # rate-limited records (observable in tests)
+
+    # ------------------------------------------------------------- recording
+
+    def record(
+        self,
+        obj: Any,
+        reason: str,
+        message: str,
+        type: str = "Normal",
+    ) -> Optional[Event]:
+        """Record one occurrence; returns the stored Event or None if the
+        rate limiter dropped it. ``obj`` is any typed object with ``kind``
+        and ``metadata`` (Pod, Node, ElasticQuota, ...)."""
+        if reason not in constants.EVENT_REASONS:
+            raise ValueError(
+                f"event reason {reason!r} is not in "
+                "nos_tpu.api.v1alpha1.constants.EVENT_REASONS"
+            )
+        involved_kind = obj.kind
+        involved_ns = obj.metadata.namespace
+        involved_name = obj.metadata.name
+        if not self._allow(involved_kind, involved_ns, involved_name):
+            with self._lock:
+                self.dropped += 1
+            return None
+
+        now = self.clock()
+        name = self._event_name(
+            involved_kind, involved_ns, involved_name, reason, message
+        )
+        # Events about cluster-scoped objects (Nodes) land in "default",
+        # like the real apiserver's event sink.
+        event_ns = involved_ns or "default"
+
+        def bump(ev: Event) -> None:
+            ev.count += 1
+            ev.last_timestamp = now
+
+        try:
+            return self.store.patch_merge("Event", name, event_ns, bump)
+        except NotFoundError:
+            pass
+        ev = Event(
+            involved_kind=involved_kind,
+            involved_namespace=involved_ns,
+            involved_name=involved_name,
+            reason=reason,
+            message=message,
+            type=type,
+            count=1,
+            first_timestamp=now,
+            last_timestamp=now,
+            source_component=self.component,
+        )
+        ev.metadata.name = name
+        ev.metadata.namespace = event_ns
+        try:
+            return self.store.create(ev)
+        except AlreadyExistsError:
+            # Raced another recorder thread to the first write.
+            return self.store.patch_merge("Event", name, event_ns, bump)
+
+    def events_for(self, obj: Any) -> List[Event]:
+        """All stored Events about ``obj``, oldest first."""
+        kind, ns, name = obj.kind, obj.metadata.namespace, obj.metadata.name
+        out = [
+            e
+            for e in self.store.list("Event", namespace=ns or "default")
+            if e.involved_kind == kind
+            and e.involved_namespace == ns
+            and e.involved_name == name
+        ]
+        out.sort(key=lambda e: e.first_timestamp)
+        return out
+
+    # ---------------------------------------------------------- rate limiter
+
+    def _allow(self, kind: str, ns: str, name: str) -> bool:
+        """One token bucket per involved object: dedup keeps the store
+        small, but a hot reconcile loop can still bump one Event forever —
+        the bucket caps how often that write happens at all."""
+        key = (kind, ns, name)
+        now = self.clock()
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                if len(self._buckets) >= _MAX_BUCKETS:
+                    stale = sorted(
+                        self._buckets.items(), key=lambda kv: kv[1].last_touch
+                    )[: _MAX_BUCKETS // 2]
+                    for k, _ in stale:
+                        del self._buckets[k]
+                bucket = _TokenBucket(self.burst, now)
+                self._buckets[key] = bucket
+            else:
+                elapsed = max(0.0, now - bucket.last_refill)
+                bucket.tokens = min(
+                    self.burst, bucket.tokens + elapsed * self.refill_per_second
+                )
+                bucket.last_refill = now
+            bucket.last_touch = now
+            if bucket.tokens < 1.0:
+                return False
+            bucket.tokens -= 1.0
+            return True
+
+    @staticmethod
+    def _event_name(kind: str, ns: str, name: str, reason: str, message: str) -> str:
+        """Deterministic per-(object, reason, message) name so dedup works
+        across recorder instances and process restarts."""
+        digest = hashlib.sha1(
+            "\x00".join((kind, ns, name, reason, message)).encode()
+        ).hexdigest()[:12]
+        return f"{name}.{digest}"

@@ -323,6 +323,30 @@ class Service:
 
 
 @dataclass
+class Event:
+    """core/v1 Event subset: who it is about (involvedObject), why
+    (reason, from the constants table), what happened (message), and the
+    dedup bookkeeping (count, first/lastTimestamp) the recorder bumps in
+    place of writing a duplicate."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_namespace: str = ""
+    involved_name: str = ""
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    first_timestamp: float = field(default_factory=time.time)
+    last_timestamp: float = field(default_factory=time.time)
+    source_component: str = ""
+    kind: str = "Event"
+
+    def deepcopy(self) -> "Event":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class PodDisruptionBudgetSpec:
     """policy/v1 PDB subset the preemptor consults: a matchLabels selector
     plus exactly one of minAvailable / maxUnavailable (absolute counts)."""
@@ -480,6 +504,22 @@ def _service_deepcopy(s: Service, memo=None) -> Service:
     )
 
 
+def _event_deepcopy(e: Event, memo=None) -> Event:
+    return Event(
+        metadata=_meta_deepcopy(e.metadata),
+        involved_kind=e.involved_kind,
+        involved_namespace=e.involved_namespace,
+        involved_name=e.involved_name,
+        reason=e.reason,
+        message=e.message,
+        type=e.type,
+        count=e.count,
+        first_timestamp=e.first_timestamp,
+        last_timestamp=e.last_timestamp,
+        source_component=e.source_component,
+    )
+
+
 def _pdb_deepcopy(p: PodDisruptionBudget, memo=None) -> PodDisruptionBudget:
     return PodDisruptionBudget(
         metadata=_meta_deepcopy(p.metadata),
@@ -499,3 +539,4 @@ Node.__deepcopy__ = _node_deepcopy
 ConfigMap.__deepcopy__ = _configmap_deepcopy
 Service.__deepcopy__ = _service_deepcopy
 PodDisruptionBudget.__deepcopy__ = _pdb_deepcopy
+Event.__deepcopy__ = _event_deepcopy

@@ -26,6 +26,7 @@ from nos_tpu.api.v1alpha1.elasticquota import (
 )
 from nos_tpu.kube.objects import (
     ConfigMap,
+    Event,
     Service,
     ServicePort,
     ServiceSpec,
@@ -56,6 +57,7 @@ RESOURCES: Dict[str, Tuple[str, str, bool]] = {
     "Node": ("/api/v1", "nodes", False),
     "ConfigMap": ("/api/v1", "configmaps", True),
     "Service": ("/api/v1", "services", True),
+    "Event": ("/api/v1", "events", True),
     "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
     "ElasticQuota": ("/apis/nos.nebuly.com/v1alpha1", "elasticquotas", True),
     "CompositeElasticQuota": (
@@ -70,6 +72,7 @@ API_VERSIONS: Dict[str, str] = {
     "Node": "v1",
     "ConfigMap": "v1",
     "Service": "v1",
+    "Event": "v1",
     "PodDisruptionBudget": "policy/v1",
     "ElasticQuota": "nos.nebuly.com/v1alpha1",
     "CompositeElasticQuota": "nos.nebuly.com/v1alpha1",
@@ -601,6 +604,53 @@ def service_from_wire(d: Dict[str, Any]) -> Service:
     )
 
 
+# -------------------------------------------------------------------- Event
+# Mutable fields (count, lastTimestamp) live TOP-LEVEL on the wire, like
+# real core/v1 Events — there is no status subresource, so the recorder's
+# dedup bump is a plain main-resource merge-PATCH.
+
+
+def event_to_wire(ev: Event) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": meta_to_wire(ev.metadata),
+        "involvedObject": {
+            "kind": ev.involved_kind,
+            "namespace": ev.involved_namespace,
+            "name": ev.involved_name,
+        },
+        "reason": ev.reason,
+        "message": ev.message,
+        "type": ev.type,
+        "count": ev.count,
+    }
+    if ev.first_timestamp:
+        out["firstTimestamp"] = _ts_to_wire(ev.first_timestamp)
+    if ev.last_timestamp:
+        out["lastTimestamp"] = _ts_to_wire(ev.last_timestamp)
+    if ev.source_component:
+        out["source"] = {"component": ev.source_component}
+    return out
+
+
+def event_from_wire(d: Dict[str, Any]) -> Event:
+    involved = d.get("involvedObject") or {}
+    return Event(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        involved_kind=involved.get("kind", ""),
+        involved_namespace=involved.get("namespace", ""),
+        involved_name=involved.get("name", ""),
+        reason=d.get("reason", ""),
+        message=d.get("message", ""),
+        type=d.get("type", "Normal"),
+        count=int(d.get("count") or 1),
+        first_timestamp=_ts_from_wire(d.get("firstTimestamp")) or 0.0,
+        last_timestamp=_ts_from_wire(d.get("lastTimestamp")) or 0.0,
+        source_component=(d.get("source") or {}).get("component", ""),
+    )
+
+
 # ---------------------------------------------------------------------- PDB
 
 
@@ -695,6 +745,7 @@ _TO_WIRE = {
     "Node": node_to_wire,
     "ConfigMap": configmap_to_wire,
     "Service": service_to_wire,
+    "Event": event_to_wire,
     "PodDisruptionBudget": pdb_to_wire,
     "ElasticQuota": eq_to_wire,
     "CompositeElasticQuota": ceq_to_wire,
@@ -705,6 +756,7 @@ _FROM_WIRE = {
     "Node": node_from_wire,
     "ConfigMap": configmap_from_wire,
     "Service": service_from_wire,
+    "Event": event_from_wire,
     "PodDisruptionBudget": pdb_from_wire,
     "ElasticQuota": eq_from_wire,
     "CompositeElasticQuota": ceq_from_wire,

@@ -25,9 +25,16 @@ or the snapshot carries affinity/topology-spread state (those verdicts read
 cross-node context), and plugins that never opted in (external-store
 filters) run fresh on every trial after the cached subset. Supporting
 memos with the same exactness guarantee: lacking-slices booleans and
-candidate-node order keyed by the snapshot-wide ``state_version``, and
-simulated NodeInfo views keyed by (node, version). All of it is per-plan
-state, rebuilt at every ``plan()`` entry.
+candidate-node order keyed by the snapshot-wide ``state_version``,
+simulated NodeInfo views keyed by (node, version), and a carve-futility
+memo keyed by (node, version, lacking signature) that skips fork+carve
+trials ``update_geometry_for`` already proved to be geometry no-ops. All
+of it is per-plan state, rebuilt at every ``plan()`` entry.
+
+Diagnosability: every ``_plan`` exit leaves ``last_unserved`` mapping each
+still-unserved pending pod to a human-readable reason (its lacking slice
+profile, or gang non-formability) — the partitioner controller turns these
+into CarveFailed Events.
 """
 from __future__ import annotations
 
@@ -140,13 +147,19 @@ class Planner:
         aging_chips_per_second: float = 1.0,
         verdict_cache_enabled: bool = True,
         reuse_gang_trial: bool = True,
+        futility_memo_enabled: bool = True,
     ) -> None:
         self.framework = framework
         self.aging_chips_per_second = aging_chips_per_second
-        # Both knobs exist so the bench and the equivalence tests can run
-        # the exact pre-cache code path as the oracle.
+        # All three knobs exist so the bench and the equivalence tests can
+        # run the exact pre-cache code path as the oracle.
         self.verdict_cache_enabled = verdict_cache_enabled
         self.reuse_gang_trial = reuse_gang_trial
+        self.futility_memo_enabled = futility_memo_enabled
+        # namespaced_name -> reason for every pending pod the most recent
+        # _plan could not serve; read by the partitioner controller for
+        # CarveFailed Events. Valid until the next plan() overwrites it.
+        self.last_unserved: Dict[str, str] = {}
         # namespaced_name -> (first_seen, last_seen) monotonic instants.
         # Age for the fairness sort is measured from first_seen — time
         # passed over across plan() calls — never from creation time (a
@@ -193,6 +206,17 @@ class Planner:
         # pre-pass asks once per pod; unchanged state means unchanged
         # order).
         self._candidate_cache: Optional[Tuple[int, List[str]]] = None
+        # (node name, node.version, sorted lacking items) -> reason string:
+        # a carve of THIS node geometry toward THIS lacking profile already
+        # proved a geometry no-op (update_geometry_for returned False), so
+        # the whole fork+carve trial can be skipped. Exact: a failed carve
+        # never stamps the node version, a revert restores pre-fork
+        # versions, and every real geometry/placement change bumps the
+        # version — a hit would replay a bit-identical no-op. Only the
+        # no-geometry-change outcome is memoized; "changed but placed
+        # nobody" depends on the pod set and is not keyed here.
+        self._futility_cache: Dict[Tuple[str, int, tuple], str] = {}
+        self._futility_hits = 0
         # The verdict cache memoizes only the opted-in plugin subset; the
         # rest runs fresh on every trial, after the cached conjunction.
         framework = self.framework
@@ -250,11 +274,14 @@ class Planner:
             metrics.PLAN_VERDICT_CACHE.labels(event="miss").inc(misses)
         if bypasses:
             metrics.PLAN_VERDICT_CACHE.labels(event="bypass").inc(bypasses)
+        if self._futility_hits:
+            metrics.PLAN_CARVE_FUTILITY.inc(self._futility_hits)
         if span is not None:
             span.set_attributes(
                 verdict_cache_hits=hits,
                 verdict_cache_misses=misses,
                 verdict_cache_bypasses=bypasses,
+                carve_futility_hits=self._futility_hits,
             )
 
     def _trial_cache_delta(self, before: Tuple[int, int, int]) -> dict:
@@ -274,6 +301,7 @@ class Planner:
         # the tracker and the pre-pass must agree on WHICH pods the
         # existing free slices serve, or a pod could end up neither
         # claim-placed nor carved for this round.
+        self.last_unserved = {}
         now = time.monotonic()
         # Key includes the uid: a recreated pod with a reused name is a NEW
         # pod and must start at age 0, not inherit its predecessor's boost.
@@ -342,6 +370,9 @@ class Planner:
                 # _plan_pass is deterministic, so its placements would be
                 # bit-identical to the trial's. Keep the trial instead of
                 # paying a second full simulation pass.
+                self.last_unserved = self._unserved_reasons(
+                    trial_tracker, candidates
+                )
                 snapshot.commit()
                 log.info(
                     "planner: gang trial committed as the real plan "
@@ -357,22 +388,36 @@ class Planner:
                     )
                 return snapshot.partitioning_state()
             snapshot.revert()
+        excluded_reasons: Dict[str, str] = {}
         if excluded:
             log.info(
                 "planner: gangs %s cannot fully form; excluding their pods",
                 sorted(excluded),
             )
+            excluded_reasons = {
+                p.namespaced_name: (
+                    f"gang {(_gang_of(p) or ('?',))[0]} cannot fully form; "
+                    "no slices are carved for partial gangs"
+                )
+                for p in candidates
+                if (_gang_of(p) or (None,))[0] in excluded
+            }
             candidates = [
                 p for p in candidates
                 if (_gang_of(p) or (None,))[0] not in excluded
             ]
             if not candidates:
+                self.last_unserved = excluded_reasons
                 return snapshot.partitioning_state()
             tracker = SliceTracker(snapshot, candidates)
             if tracker.empty:
+                self.last_unserved = excluded_reasons
                 return snapshot.partitioning_state()
 
         self._plan_pass(snapshot, tracker, candidates, aged=aged)
+        self.last_unserved = self._unserved_reasons(
+            tracker, candidates, excluded_reasons
+        )
         if span is not None:
             # The recompute-vs-incremental delta for lacking_totals: with
             # the incremental cache, recomputes stay at one per accelerator
@@ -416,17 +461,32 @@ class Planner:
                 continue
             attempts += 1
             for node_name in self._candidate_nodes(snapshot):
-                accelerator = getattr(
-                    snapshot.get_node(node_name).partitionable, "accelerator", ""
+                # Read-only access (get_node would journal under a fork);
+                # the version read pins the futility-memo key PRE-fork.
+                node = snapshot.get_nodes()[node_name]
+                accelerator = getattr(node.partitionable, "accelerator", "")
+                lacking = tracker.lacking_for(pod, accelerator)
+                futility_key = (
+                    node_name,
+                    node.version,
+                    tuple(sorted(lacking.items())),
                 )
+                if (
+                    self.futility_memo_enabled
+                    and futility_key in self._futility_cache
+                ):
+                    self._futility_hits += 1
+                    continue
                 stats_before = self._verdict_cache.stats()
                 with TRACER.span(
                     "plan.trial", node=node_name, rescue=True
                 ) as trial:
                     snapshot.fork()
-                    if not snapshot.update_geometry_for(
-                        node_name, tracker.lacking_for(pod, accelerator)
-                    ):
+                    if not snapshot.update_geometry_for(node_name, lacking):
+                        if self.futility_memo_enabled:
+                            self._futility_cache[futility_key] = (
+                                self._lacking_reason(lacking)
+                            )
                         trial.set_attributes(
                             committed=False,
                             nodes_copied=snapshot.revert(),
@@ -472,16 +532,26 @@ class Planner:
         for node_name in self._candidate_nodes(snapshot):
             if tracker.empty:
                 break
-            accelerator = getattr(
-                snapshot.get_nodes()[node_name].partitionable, "accelerator", ""
+            node = snapshot.get_nodes()[node_name]
+            accelerator = getattr(node.partitionable, "accelerator", "")
+            lacking = tracker.lacking_totals(accelerator)
+            futility_key = (
+                node_name,
+                node.version,
+                tuple(sorted(lacking.items())),
             )
+            if self.futility_memo_enabled and futility_key in self._futility_cache:
+                self._futility_hits += 1
+                continue
             stats_before = self._verdict_cache.stats()
             with TRACER.span("plan.trial", node=node_name) as trial:
                 snapshot.fork()
-                changed = snapshot.update_geometry_for(
-                    node_name, tracker.lacking_totals(accelerator)
-                )
+                changed = snapshot.update_geometry_for(node_name, lacking)
                 if not changed:
+                    if self.futility_memo_enabled:
+                        self._futility_cache[futility_key] = (
+                            self._lacking_reason(lacking)
+                        )
                     trial.set_attributes(
                         committed=False,
                         nodes_copied=snapshot.revert(),
@@ -517,6 +587,33 @@ class Planner:
                     )
 
         return placed
+
+    @staticmethod
+    def _lacking_reason(lacking: dict) -> str:
+        """Canonical human-readable form of a lacking profile — the ONE
+        formatter behind both the futility-memo reason strings and the
+        per-pod unserved reasons, so CarveFailed Events and memoized
+        verdicts read identically for the same profile."""
+        profile = ", ".join(
+            f"{int(qty)}x {name}" for name, qty in sorted(lacking.items())
+        )
+        return f"no node can be re-carved to yield lacking slices ({profile})"
+
+    def _unserved_reasons(
+        self,
+        tracker: SliceTracker,
+        candidates: List[Pod],
+        extra: "Optional[Dict[str, str]]" = None,
+    ) -> Dict[str, str]:
+        """namespaced_name -> reason for every candidate the pass left in
+        the tracker, merged over `extra` (gang-exclusion reasons)."""
+        out: Dict[str, str] = dict(extra or {})
+        for pod in candidates:
+            if pod in tracker:
+                out[pod.namespaced_name] = self._lacking_reason(
+                    tracker.lacking_for(pod)
+                )
+        return out
 
     @staticmethod
     def _gang_membership(

@@ -56,6 +56,53 @@ class CycleState(dict):
 
 
 @dataclass
+class Diagnosis:
+    """Per-node, per-plugin rejection ledger for one failed scheduling
+    cycle — the kube-scheduler Diagnosis analogue. One struct drives every
+    operator surface: the PodScheduled=False condition message, the
+    FailedScheduling Event, the unschedulable metric, and
+    ``/debug/explain`` (which adds the linked trace id)."""
+
+    pod: str = ""  # namespaced name
+    num_nodes: int = 0  # nodes the cycle considered
+    node_statuses: Dict[str, Status] = field(default_factory=dict)
+    trace_id: str = ""
+    timestamp: float = 0.0
+
+    def grouped(self) -> List[tuple]:
+        """(count, plugin, message) per distinct rejection, most-frequent
+        first (ties broken lexically for a deterministic message)."""
+        counts: Dict[tuple, int] = {}
+        for status in self.node_statuses.values():
+            key = (status.plugin, status.message)
+            counts[key] = counts.get(key, 0) + 1
+        return sorted(
+            ((n, plugin, msg) for (plugin, msg), n in counts.items()),
+            key=lambda t: (-t[0], t[1], t[2]),
+        )
+
+    def aggregate_message(self) -> str:
+        """Canonical ``0/N nodes are available: X <reason>, Y <reason>.``"""
+        groups = self.grouped()
+        if not groups:
+            return f"0/{self.num_nodes} nodes are available: no nodes."
+        parts = ", ".join(f"{n} {msg}" for n, _, msg in groups)
+        return f"0/{self.num_nodes} nodes are available: {parts}."
+
+    def to_dict(self) -> Dict:
+        return {
+            "pod": self.pod,
+            "message": self.aggregate_message(),
+            "nodes": {
+                name: {"plugin": s.plugin, "message": s.message}
+                for name, s in sorted(self.node_statuses.items())
+            },
+            "traceId": self.trace_id,
+            "timestamp": self.timestamp,
+        }
+
+
+@dataclass
 class NodeInfo:
     """A node plus everything scheduled onto it — the framework's unit of
     placement state (mirrors framework.NodeInfo cached by the reference's

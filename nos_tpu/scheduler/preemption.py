@@ -207,6 +207,21 @@ class Preemptor:
                 ).inc()
             except NotFoundError:
                 pass
+        recorder = getattr(self.plugin, "recorder", None)
+        if recorder is not None:
+            from nos_tpu.api.v1alpha1 import constants
+
+            recorder.record(
+                pod,
+                constants.EVENT_REASON_PREEMPTED,
+                "Preempted {} on {} to fit {}: {}".format(
+                    len(victims.pods),
+                    node_name,
+                    pod.namespaced_name,
+                    ", ".join(sorted(v.namespaced_name for v in victims.pods)),
+                ),
+                type="Warning",
+            )
         return node_name
 
     # ---------------------------------------------------------- victims

@@ -14,11 +14,13 @@ import logging
 import time
 from typing import Dict, List, Optional
 
+from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.objects import Pod, PodCondition, PodPhase
 from nos_tpu.kube.store import KubeStore, NotFoundError
 from nos_tpu.scheduler.framework import (
     CycleState,
+    Diagnosis,
     Framework,
     NodeInfo,
     PodTopologySpreadScoring,
@@ -36,6 +38,12 @@ from nos_tpu.util import metrics
 from nos_tpu.util.tracing import TRACER
 
 log = logging.getLogger("nos_tpu.scheduler")
+
+
+def _reason_label(message: str) -> str:
+    """Low-cardinality metric label from a rejection message: everything
+    before the first ':' (per-pod quantities live after it)."""
+    return message.split(":", 1)[0].strip() or "unknown"
 
 
 def new_framework(
@@ -75,11 +83,23 @@ class Scheduler:
         gang: Optional[GangScheduling] = None,
         retry_seconds: float = 0.5,
         scheduler_name: str = "",
+        recorder=None,
     ) -> None:
         self.store = store
         self.framework = framework
         self.capacity = capacity
         self.gang = gang
+        # Optional kube/events.py EventRecorder: Scheduled on bind,
+        # FailedScheduling (deduped, count-bumped) on every failed cycle.
+        # Threaded onto the capacity plugin (like framework/reservation
+        # above) so the Preemptor can emit Preempted with its victim list.
+        self.recorder = recorder
+        if capacity is not None and recorder is not None:
+            capacity.recorder = recorder
+        # Latest Diagnosis per pod, served by /debug/explain. Bounded:
+        # oldest entry falls off so a churning cluster can't grow it.
+        self._diagnoses: Dict[str, dict] = {}
+        self._max_diagnoses = 1024
         # Non-empty: only pods whose spec.schedulerName matches are ours;
         # the rest belong to the default scheduler (coexistence, reference
         # cmd/scheduler/scheduler.go:43-59). Empty: claim everything.
@@ -181,7 +201,7 @@ class Scheduler:
             if nominated:
                 self._set_nominated(pod, nominated)
                 return Result(requeue_after=self.retry / 2)
-            self._mark_unschedulable(pod, status.message)
+            self._fail_cycle(pod, self._diagnosis(pod, node_infos, filtered))
             return Result(requeue_after=self.retry)
 
         feasible: List[NodeInfo] = []
@@ -213,9 +233,7 @@ class Scheduler:
                 # closest to draining so the board frees deterministically
                 # instead of by luck (no-op for sub-board requests).
                 self.reservation.try_reserve(pod, node_infos)
-            self._mark_unschedulable(
-                pod, "; ".join(s.message for s in filtered.values()) or "no nodes"
-            )
+            self._fail_cycle(pod, self._diagnosis(pod, node_infos, filtered))
             return Result(requeue_after=self.retry)
 
         with TRACER.span("scheduler.score", feasible=len(feasible)) as score_span:
@@ -230,7 +248,9 @@ class Scheduler:
         with TRACER.span("scheduler.reserve", node=best.name):
             status = self.framework.run_reserve_plugins(state, pod, best.name)
         if not status.success:
-            self._mark_unschedulable(pod, status.message)
+            self._fail_cycle(
+                pod, self._diagnosis(pod, node_infos, {best.name: status})
+            )
             return Result(requeue_after=self.retry)
 
         with TRACER.span("scheduler.permit", node=best.name):
@@ -243,7 +263,9 @@ class Scheduler:
             return Result(requeue_after=self.retry)
         if not permit.success:
             self.framework.run_unreserve_plugins(state, pod, best.name)
-            self._mark_unschedulable(pod, permit.message)
+            self._fail_cycle(
+                pod, self._diagnosis(pod, node_infos, {best.name: permit})
+            )
             return Result(requeue_after=self.retry)
 
         # Bind — and release any gang members waiting on this quorum.
@@ -266,6 +288,52 @@ class Scheduler:
         if self.gang is not None and len(to_bind) > 1:
             metrics.GANGS_SCHEDULED.inc()
         return None
+
+    # --------------------------------------------------------- diagnosis
+
+    @staticmethod
+    def _diagnosis(
+        pod: Pod, node_infos: Dict[str, NodeInfo], filtered: Dict[str, Status]
+    ) -> Diagnosis:
+        return Diagnosis(
+            pod=pod.namespaced_name,
+            num_nodes=len(node_infos),
+            node_statuses=dict(filtered),
+        )
+
+    def _fail_cycle(self, pod: Pod, diagnosis: Diagnosis) -> None:
+        """Every operator surface for one failed cycle, fed by one ledger:
+        metric, FailedScheduling Event, /debug/explain store, the journey
+        trace's `diagnosis` attribute, and the PodScheduled condition.
+        Runs BEFORE _mark_unschedulable's churn guard on purpose — a retry
+        cycle must still bump the deduped Event count."""
+        diagnosis.timestamp = time.time()
+        message = diagnosis.aggregate_message()
+        root = TRACER.journey(("pod", pod.namespaced_name))
+        if root is not None:
+            diagnosis.trace_id = root.trace_id
+            root.set_attributes(diagnosis=message)
+        for count, plugin, msg in diagnosis.grouped():
+            metrics.SCHEDULING_UNSCHEDULABLE.labels(
+                plugin=plugin or "unknown", reason=_reason_label(msg)
+            ).inc(count)
+        self._diagnoses.pop(pod.namespaced_name, None)
+        while len(self._diagnoses) >= self._max_diagnoses:
+            self._diagnoses.pop(next(iter(self._diagnoses)), None)
+        self._diagnoses[pod.namespaced_name] = diagnosis.to_dict()
+        if self.recorder is not None:
+            self.recorder.record(
+                pod,
+                constants.EVENT_REASON_FAILED_SCHEDULING,
+                message,
+                type="Warning",
+            )
+        self._mark_unschedulable(pod, message)
+
+    def explain(self, pod_key: str) -> Optional[dict]:
+        """Latest Diagnosis for `ns/name`, or None — the /debug/explain
+        backend."""
+        return self._diagnoses.get(pod_key)
 
     # ----------------------------------------------------------- helpers
 
@@ -311,6 +379,12 @@ class Scheduler:
         if root is not None:
             TRACER.link(("admit", pod.namespaced_name), root)
         TRACER.end_journey(journey_key, node=node_name)
+        if self.recorder is not None:
+            self.recorder.record(
+                pod,
+                constants.EVENT_REASON_SCHEDULED,
+                f"Successfully assigned {pod.namespaced_name} to {node_name}",
+            )
         log.info("scheduler: bound %s to %s", pod.namespaced_name, node_name)
 
     def _mark_unschedulable(self, pod: Pod, message: str) -> None:

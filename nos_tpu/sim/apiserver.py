@@ -24,6 +24,7 @@ _PLURAL_TO_KIND = {
     "nodes": "Node",
     "configmaps": "ConfigMap",
     "services": "Service",
+    "events": "Event",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "elasticquotas": "ElasticQuota",
     "compositeelasticquotas": "CompositeElasticQuota",

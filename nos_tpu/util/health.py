@@ -25,10 +25,15 @@ class HealthServer:
         host: str = "127.0.0.1",
         metrics_token: "str | Callable[[], Optional[str]]" = "",
         metrics_loopback_port: Optional[int] = None,
+        explain_fn: Optional[Callable[[str], Optional[dict]]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
         self.host = host
+        # /debug/explain?pod=ns/name -> the scheduler's latest Diagnosis
+        # for the pod (per-node per-plugin rejection ledger) as JSON; None
+        # disables the endpoint (components without a scheduler).
+        self.explain_fn = explain_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -51,6 +56,7 @@ class HealthServer:
     def _make_handler(self, serve_health: bool, serve_metrics: bool):
         ready_check = self.ready_check
         metrics_token = self.metrics_token
+        explain_fn = self.explain_fn
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
 
@@ -102,6 +108,27 @@ class HealthServer:
                     else:
                         body = json.dumps(TRACER.store.summaries(), indent=2)
                     self._respond(200, body, "application/json")
+                elif (
+                    path == "/debug/explain"
+                    and serve_metrics
+                    and explain_fn is not None
+                ):
+                    # Same credential as /metrics: the diagnosis carries
+                    # pod names, namespaces, and rejection details.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    pod_key = parse_qs(url.query).get("pod", [None])[0]
+                    if not pod_key:
+                        self._respond(400, "missing ?pod=namespace/name")
+                        return
+                    diagnosis = explain_fn(pod_key)
+                    if diagnosis is None:
+                        self._respond(404, "no diagnosis recorded for pod")
+                        return
+                    self._respond(
+                        200, json.dumps(diagnosis, indent=2), "application/json"
+                    )
                 elif path == "/debug/vars" and serve_metrics:
                     if not self._authorized():
                         self._respond(401, "unauthorized")

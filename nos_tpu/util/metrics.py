@@ -333,6 +333,11 @@ FILTER_REJECTIONS = REGISTRY.counter(
     "nos_tpu_scheduler_filter_rejections_total",
     "Scheduling-cycle rejections by the plugin that refused (by plugin)",
 )
+SCHEDULING_UNSCHEDULABLE = REGISTRY.counter(
+    "nos_tpu_scheduling_unschedulable_total",
+    "Per-node rejections behind failed scheduling cycles, by rejecting "
+    "plugin and normalized reason (the Diagnosis ledger, aggregated)",
+)
 
 # Partitioner planning loop (the nos_scheduling_latency north star). The
 # fork/revert/commit counters plus the nodes-copied gauge make the CoW
@@ -373,6 +378,11 @@ PLAN_VERDICT_CACHE = REGISTRY.counter(
     "nos_tpu_plan_verdict_cache_total",
     "Planner verdict-cache lookups by outcome (event=hit|miss|bypass); "
     "flushed once per plan() to keep lock traffic off the trial hot path",
+)
+PLAN_CARVE_FUTILITY = REGISTRY.counter(
+    "nos_tpu_plan_carve_futility_total",
+    "Carve attempts skipped because a (node version, lacking signature) "
+    "memo already proved them futile; flushed once per plan()",
 )
 MULTIHOST_EXPANSIONS = REGISTRY.counter(
     "nos_tpu_multihost_expansions_total",

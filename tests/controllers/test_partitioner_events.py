@@ -1,0 +1,88 @@
+"""CarveFailed/PartitioningApplied recording: the partitioner's plan loop
+re-derives the same verdict every few hundred ms, so events are recorded
+only when a pod's verdict CHANGES — messages carry no plan id (a per-plan
+id would defeat the recorder's dedup and drain the pod's rate-limit
+bucket, silently dropping the eventual PartitioningApplied)."""
+from nos_tpu.controllers.partitioner.controller import PartitionerController
+from nos_tpu.kube.events import EventRecorder
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState
+
+from tests.factory import build_pod
+
+
+class PlannerStub:
+    def __init__(self):
+        self.last_unserved = {}
+
+
+def make_controller(store, recorder):
+    controller = PartitionerController(
+        store=store,
+        cluster_state=ClusterState(),
+        snapshot_taker=None,
+        planner=PlannerStub(),
+        actuator=None,
+        batch_timeout_seconds=60.0,
+        batch_idle_seconds=60.0,
+        recorder=recorder,
+    )
+    return controller
+
+
+class TestRecordPlanEvents:
+    def setup_method(self):
+        self.store = KubeStore()
+        self.recorder = EventRecorder(self.store, component="test")
+        self.controller = make_controller(self.store, self.recorder)
+        self.pod = build_pod("train", {}, ns="ml")
+
+    def events(self, reason):
+        return [
+            e
+            for e in self.store.list("Event", namespace="ml")
+            if e.reason == reason and e.involved_name == "train"
+        ]
+
+    def test_unchanged_reason_records_once(self):
+        self.controller.planner.last_unserved = {"ml/train": "lacking 2x2"}
+        for _ in range(5):
+            self.controller._record_plan_events([self.pod], applied=0)
+        events = self.events("CarveFailed")
+        assert len(events) == 1
+        assert events[0].count == 1
+        assert events[0].message == "cannot carve slices for ml/train: lacking 2x2"
+
+    def test_changed_reason_records_again(self):
+        self.controller.planner.last_unserved = {"ml/train": "lacking 2x2"}
+        self.controller._record_plan_events([self.pod], applied=0)
+        self.controller.planner.last_unserved = {"ml/train": "lacking 2x4"}
+        self.controller._record_plan_events([self.pod], applied=0)
+        assert len(self.events("CarveFailed")) == 2
+
+    def test_served_pod_gets_applied_event_and_memo_clears(self):
+        self.controller.planner.last_unserved = {"ml/train": "lacking 2x2"}
+        self.controller._record_plan_events([self.pod], applied=0)
+        # The next plan serves the pod by re-partitioning a node.
+        self.controller.planner.last_unserved = {}
+        self.controller._record_plan_events([self.pod], applied=2)
+        applied = self.events("PartitioningApplied")
+        assert len(applied) == 1
+        assert applied[0].message == "re-partitioned 2 node(s) to serve ml/train"
+        # Memo cleared: the same verdict returning later is news again.
+        self.controller.planner.last_unserved = {"ml/train": "lacking 2x2"}
+        self.controller._record_plan_events([self.pod], applied=0)
+        assert self.events("CarveFailed")[0].count == 2
+
+    def test_no_plan_application_means_no_applied_event(self):
+        self.controller.planner.last_unserved = {}
+        self.controller._record_plan_events([self.pod], applied=0)
+        assert self.events("PartitioningApplied") == []
+
+    def test_memo_pruned_to_live_pending_set(self):
+        self.controller.planner.last_unserved = {"ml/train": "lacking 2x2"}
+        self.controller._record_plan_events([self.pod], applied=0)
+        other = build_pod("other", {}, ns="ml")
+        self.controller.planner.last_unserved = {}
+        self.controller._record_plan_events([other], applied=0)
+        assert self.controller._last_carve_reason == {}

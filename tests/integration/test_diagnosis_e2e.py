@@ -1,0 +1,199 @@
+"""Acceptance for the scheduling-diagnosis PR: a pod unschedulable on a
+3-node cluster for two distinct reasons gets (1) the aggregated
+kube-scheduler-style condition message naming per-plugin counts, (2) a
+deduped FailedScheduling Event whose count keeps bumping across retry
+cycles, and (3) a working /debug/explain returning the per-node
+per-plugin ledger with the journey trace id."""
+import http.client
+import json
+import time
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.cmd import build_cluster
+from nos_tpu.kube.objects import Taint
+from nos_tpu.util.health import HealthServer
+from nos_tpu.util.tracing import TRACER
+
+from tests.factory import build_pod, build_tpu_node
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    TRACER.reset()
+    yield
+    TRACER.reset()
+
+
+@pytest.fixture
+def cluster():
+    c = build_cluster()
+    yield c
+    c.stop()
+
+
+def wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+EXPECTED_MESSAGE = (
+    "0/3 nodes are available: "
+    "2 untolerated taint dedicated=infra:NoSchedule, "
+    "1 node is cordoned (unschedulable)."
+)
+
+
+@pytest.fixture
+def stuck_pod(cluster):
+    """3 nodes, none schedulable: two tainted, one cordoned — two
+    DISTINCT per-plugin rejection reasons for one pod."""
+    for name in ("tpu-a", "tpu-b"):
+        node = build_tpu_node(name=name)
+        node.spec.taints.append(
+            Taint(key="dedicated", value="infra", effect="NoSchedule")
+        )
+        cluster.add_tpu_node(node)
+    cordoned = build_tpu_node(name="tpu-c")
+    cordoned.spec.unschedulable = True
+    cluster.add_tpu_node(cordoned)
+    cluster.start()
+    pod = build_pod("stuck", {constants.RESOURCE_TPU: 4}, ns="ml")
+    cluster.store.create(pod)
+    return pod
+
+
+class TestDiagnosisEndToEnd:
+    def test_condition_carries_the_aggregated_per_plugin_message(
+        self, cluster, stuck_pod
+    ):
+        def condition_message():
+            pod = cluster.store.try_get("Pod", "stuck", "ml")
+            for c in pod.status.conditions:
+                if c.type == "PodScheduled" and c.status == "False":
+                    return c.message
+            return None
+
+        assert wait_for(lambda: condition_message() == EXPECTED_MESSAGE), (
+            f"PodScheduled condition message: {condition_message()!r}"
+        )
+
+    def test_failed_scheduling_event_dedups_and_bumps_across_retries(
+        self, cluster, stuck_pod
+    ):
+        def failed_events():
+            return [
+                e
+                for e in cluster.store.list("Event", namespace="ml")
+                if e.reason == "FailedScheduling" and e.involved_name == "stuck"
+            ]
+
+        # Retry cycles keep failing identically: ONE Event object, count
+        # climbing — never a duplicate per cycle.
+        assert wait_for(lambda: any(e.count >= 2 for e in failed_events())), (
+            f"events: {[(e.message, e.count) for e in failed_events()]}"
+        )
+        events = failed_events()
+        assert len(events) == 1
+        assert events[0].type == "Warning"
+        assert events[0].message == EXPECTED_MESSAGE
+        assert events[0].source_component == "nos-scheduler"
+        assert events[0].last_timestamp >= events[0].first_timestamp
+
+    def test_debug_explain_serves_the_per_node_ledger(self, cluster, stuck_pod):
+        assert wait_for(lambda: cluster.scheduler.explain("ml/stuck") is not None)
+        server = HealthServer(
+            port=0, metrics_token="tok", explain_fn=cluster.scheduler.explain
+        )
+        port = server.start()
+        try:
+            assert self._get(port, "/debug/explain?pod=ml/stuck")[0] == 401
+            assert self._get(port, "/debug/explain", "tok")[0] == 400
+            assert (
+                self._get(port, "/debug/explain?pod=ml/unknown", "tok")[0] == 404
+            )
+
+            status, body = self._get(port, "/debug/explain?pod=ml/stuck", "tok")
+            assert status == 200
+            diagnosis = json.loads(body)
+            assert diagnosis["pod"] == "ml/stuck"
+            assert diagnosis["message"] == EXPECTED_MESSAGE
+            nodes = diagnosis["nodes"]
+            assert set(nodes) == {"tpu-a", "tpu-b", "tpu-c"}
+            for name in ("tpu-a", "tpu-b"):
+                assert nodes[name]["plugin"] == "TaintToleration"
+                assert (
+                    nodes[name]["message"]
+                    == "untolerated taint dedicated=infra:NoSchedule"
+                )
+            assert nodes["tpu-c"]["plugin"] == "NodeUnschedulable"
+            assert nodes["tpu-c"]["message"] == "node is cordoned (unschedulable)"
+
+            # The linked trace id is the pod's (still-open) journey root:
+            # the same id /debug/traces will serve once the journey ends.
+            root = TRACER.journey(("pod", "ml/stuck"))
+            assert root is not None
+            assert diagnosis["traceId"] == root.trace_id
+            assert root.attributes.get("diagnosis") == EXPECTED_MESSAGE
+            assert diagnosis["timestamp"] > 0
+        finally:
+            server.stop()
+
+    def test_unschedulable_metric_counts_per_plugin_rejections(
+        self, cluster, stuck_pod
+    ):
+        from nos_tpu.util.metrics import REGISTRY
+
+        def series():
+            snap = REGISTRY.snapshot()
+            return {
+                k: v
+                for k, v in snap.items()
+                if k.startswith("nos_tpu_scheduling_unschedulable_total{")
+            }
+
+        def has_both():
+            s = series()
+            return any("TaintToleration" in k for k in s) and any(
+                "NodeUnschedulable" in k for k in s
+            )
+
+        assert wait_for(has_both), f"series: {series()}"
+        for key, value in series().items():
+            if "TaintToleration" in key:
+                assert 'reason="untolerated taint dedicated=infra' in key
+                assert value >= 2  # two tainted nodes per failed cycle
+            if "NodeUnschedulable" in key:
+                assert 'reason="node is cordoned (unschedulable)"' in key
+
+    @staticmethod
+    def _get(port, path, token=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+
+
+class TestLifecycleEvents:
+    def test_scheduled_event_on_bind(self, cluster):
+        cluster.add_tpu_node(build_tpu_node(name="tpu-1"))
+        cluster.start()
+        cluster.store.create(build_pod("ok", {constants.RESOURCE_TPU: 4}, ns="ml"))
+
+        def scheduled_events():
+            return [
+                e
+                for e in cluster.store.list("Event", namespace="ml")
+                if e.reason == "Scheduled" and e.involved_name == "ok"
+            ]
+
+        assert wait_for(lambda: len(scheduled_events()) == 1)
+        ev = scheduled_events()[0]
+        assert ev.type == "Normal"
+        assert "ml/ok" in ev.message and "tpu-1" in ev.message

@@ -1,0 +1,167 @@
+"""Carve-futility memo correctness: a (node, version, lacking-signature)
+whose carve was a geometry no-op is never re-tried within the same plan,
+and the memoized reason strings are the SAME strings ``last_unserved``
+serves to the partitioner's CarveFailed Events.
+
+The memo's exactness rides on the mutation clock: a failed
+``update_geometry_for`` never stamps a node version and ``revert``
+restores pre-fork versions, so a key that was futile once stays futile
+until the node actually changes."""
+import random
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.partitioning.core import (
+    ClusterSnapshot,
+    Planner,
+    SnapshotNode,
+    partitioning_state_equal,
+)
+from nos_tpu.tpu.node import TpuNode
+from nos_tpu.util.metrics import REGISTRY
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+from tests.partitioning.test_verdict_cache import (
+    build_cluster,
+    full_framework,
+    node_local_framework,
+    placements,
+    random_pending_pods,
+)
+
+
+def snapshot_node(name, annotations=None):
+    node = build_tpu_node(name=name, annotations=annotations)
+    return SnapshotNode(partitionable=TpuNode(node))
+
+
+def fragmented_node(name):
+    """1 free chip, 7 used: a candidate node (free capacity exists) that
+    can never yield a multi-chip slice — every carve toward one is a
+    geometry no-op."""
+    return snapshot_node(
+        name,
+        annot.status_from_devices(
+            free={0: {"1x1": 1}}, used={0: {"2x2": 1, "1x2": 1, "1x1": 1}}
+        ),
+    )
+
+
+def half_used_node(name):
+    """4 free chips as one free 2x2 — re-carvable toward smaller slices."""
+    return snapshot_node(
+        name,
+        annot.status_from_devices(free={0: {"2x2": 1}}, used={0: {"2x2": 1}}),
+    )
+
+
+def gang_pod(name, req):
+    pod = build_pod(name, req)
+    pod.metadata.labels["nos.nebuly.com/gang"] = name
+    pod.metadata.labels["nos.nebuly.com/gang-size"] = "1"
+    return pod
+
+
+class TestFutilityMemoOnOffEquivalence:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_plan_identical_with_and_without_memo(self, seed):
+        on_snap = build_cluster(random.Random(2000 + seed))
+        off_snap = build_cluster(random.Random(2000 + seed))
+        pods = random_pending_pods(random.Random(3000 + seed), with_constraints=True)
+        plan_on = Planner(full_framework(), futility_memo_enabled=True).plan(
+            on_snap, [p.deepcopy() for p in pods]
+        )
+        plan_off = Planner(full_framework(), futility_memo_enabled=False).plan(
+            off_snap, [p.deepcopy() for p in pods]
+        )
+        assert partitioning_state_equal(plan_on, plan_off), f"seed={seed}"
+        assert placements(on_snap) == placements(off_snap), f"seed={seed}"
+        assert not on_snap.forked and not off_snap.forked
+
+
+class TestFutilityMemoHits:
+    """The deterministic repeat-consultation scenario: a size-1 gang forces
+    the two-pass path (reuse disabled), so the fragmented node's futile
+    carve — memoized during the trial pass — is consulted again with the
+    identical (node, version, lacking) key by the real pass."""
+
+    def _cluster(self):
+        return ClusterSnapshot(
+            {"frag-0": fragmented_node("frag-0"), "half-0": half_used_node("half-0")}
+        )
+
+    def test_two_pass_gang_plan_hits_the_memo(self):
+        snapshot = self._cluster()
+        planner = Planner(node_local_framework(), reuse_gang_trial=False)
+        # Best-fit order visits frag-0 (1 free chip) before half-0 (4):
+        # the futile trial on frag-0 happens before the pod lands.
+        assert planner._candidate_nodes(snapshot) == ["frag-0", "half-0"]
+        before = REGISTRY.snapshot().get("nos_tpu_plan_carve_futility_total", 0.0)
+        planner.plan(snapshot, [gang_pod("gm", {slice_res("1x2"): 1})])
+        assert planner._futility_hits == 1
+        assert placements(snapshot)["half-0"] == ["default/gm"]
+        assert not snapshot.forked
+        after = REGISTRY.snapshot().get("nos_tpu_plan_carve_futility_total", 0.0)
+        assert after - before == 1
+
+    def test_memo_off_re_runs_the_futile_trial(self):
+        on_snap = self._cluster()
+        off_snap = self._cluster()
+        pod = gang_pod("gm", {slice_res("1x2"): 1})
+        plan_on = Planner(
+            node_local_framework(), reuse_gang_trial=False, futility_memo_enabled=True
+        ).plan(on_snap, [pod.deepcopy()])
+        off_planner = Planner(
+            node_local_framework(), reuse_gang_trial=False, futility_memo_enabled=False
+        )
+        plan_off = off_planner.plan(off_snap, [pod.deepcopy()])
+        assert off_planner._futility_hits == 0
+        assert partitioning_state_equal(plan_on, plan_off)
+        assert placements(on_snap) == placements(off_snap)
+
+    def test_memoized_reason_is_the_canonical_lacking_reason(self):
+        snapshot = self._cluster()
+        planner = Planner(node_local_framework(), reuse_gang_trial=False)
+        planner.plan(snapshot, [gang_pod("gm", {slice_res("1x2"): 1})])
+        key = ("frag-0", 0, ((slice_res("1x2"), 1),))
+        assert planner._futility_cache[key] == Planner._lacking_reason(
+            {slice_res("1x2"): 1}
+        )
+
+
+class TestLastUnserved:
+    """``last_unserved`` is the planner's diagnosis surface: served pods
+    absent, unserved pods present with the same reason string the memo
+    stores — the partitioner's CarveFailed Events read it verbatim."""
+
+    def test_served_absent_unserved_present_with_lacking_reason(self):
+        snapshot = ClusterSnapshot({"half-0": half_used_node("half-0")})
+        planner = Planner(node_local_framework())
+        ok = build_pod("ok", {slice_res("1x2"): 1}, ns="ml")
+        big = build_pod("big", {slice_res("2x4"): 1}, ns="ml")
+        planner.plan(snapshot, [ok, big])
+        assert placements(snapshot)["half-0"] == ["ml/ok"]
+        assert planner.last_unserved == {
+            "ml/big": Planner._lacking_reason({slice_res("2x4"): 1})
+        }
+
+    def test_half_formable_gang_gets_the_gang_reason(self):
+        snapshot = ClusterSnapshot({"frag-0": fragmented_node("frag-0")})
+        planner = Planner(node_local_framework())
+        planner.plan(snapshot, [gang_pod("gm", {slice_res("2x2"): 1})])
+        assert planner.last_unserved == {
+            "default/gm": (
+                "gang default/gm cannot fully form; "
+                "no slices are carved for partial gangs"
+            )
+        }
+
+    def test_fully_served_plan_leaves_it_empty(self):
+        # The free pool already holds the requested 2x2: nothing lacking,
+        # the plan is a no-op, and the diagnosis surface must say so.
+        snapshot = ClusterSnapshot({"half-0": half_used_node("half-0")})
+        planner = Planner(node_local_framework())
+        planner.plan(snapshot, [build_pod("fit", {slice_res("2x2"): 1}, ns="ml")])
+        assert planner.last_unserved == {}
+        assert not snapshot.forked

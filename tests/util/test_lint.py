@@ -238,3 +238,80 @@ class TestMetricsDocDrift:
         assert not missing, (
             f"metrics not mentioned in docs/en/docs/telemetry.md: {missing}"
         )
+
+
+class TestEventReasonsFromConstants:
+    """Every EventRecorder.record call site passes its reason as a
+    constants.EVENT_REASON_* attribute — never a string literal — so the
+    whitelist in api/v1alpha1/constants.py stays the single source of
+    truth dashboards and the recorder's runtime check key on."""
+
+    @staticmethod
+    def _recorder_record_calls():
+        import ast
+
+        repo = os.path.join(os.path.dirname(__file__), "..", "..")
+        calls = []
+        for path in lint.iter_py([os.path.join(repo, "nos_tpu")]):
+            with open(path) as fh:
+                tree = ast.parse(fh.read())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "record"
+                ):
+                    continue
+                receiver = node.func.value
+                # Only EventRecorder call sites: the receiver is a
+                # `recorder` variable or a `.recorder` attribute (the
+                # threading convention) — sim/apiserver's watch-journal
+                # `state.record(...)` is a different API.
+                is_recorder = (
+                    isinstance(receiver, ast.Name) and receiver.id == "recorder"
+                ) or (
+                    isinstance(receiver, ast.Attribute)
+                    and receiver.attr == "recorder"
+                )
+                if is_recorder:
+                    calls.append((os.path.relpath(path, repo), node))
+        return calls
+
+    def test_every_reason_argument_is_a_constant(self):
+        import ast
+
+        calls = self._recorder_record_calls()
+        # The suite emits events from the scheduler (fail + bind), the
+        # preemptor, the quota controllers, and the partitioner — if this
+        # drops, a call site was lost or renamed out of the check.
+        assert len(calls) >= 7, (
+            f"expected >=7 EventRecorder.record call sites, found {len(calls)}"
+        )
+        offenders = []
+        for path, call in calls:
+            if len(call.args) < 2:
+                offenders.append(f"{path}:{call.lineno} (reason not positional)")
+                continue
+            reason = call.args[1]
+            ok = (
+                isinstance(reason, ast.Attribute)
+                and reason.attr.startswith("EVENT_REASON_")
+                and isinstance(reason.value, ast.Name)
+                and reason.value.id == "constants"
+            )
+            if not ok:
+                offenders.append(f"{path}:{call.lineno}")
+        assert not offenders, (
+            "EventRecorder.record call sites whose reason is not a "
+            f"constants.EVENT_REASON_* attribute: {offenders}"
+        )
+
+    def test_reasons_tuple_covers_every_reason_constant(self):
+        from nos_tpu.api.v1alpha1 import constants
+
+        declared = {
+            value
+            for name, value in vars(constants).items()
+            if name.startswith("EVENT_REASON_")
+        }
+        assert declared == set(constants.EVENT_REASONS)
