@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration incluster-e2e kind-e2e bench bench-planner examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke incluster-e2e kind-e2e bench bench-planner examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -29,6 +29,12 @@ test-unit:
 
 test-integration:
 	$(PY) -m pytest tests/integration -q
+
+# Flight-recorder loop: record a short sim run via the `run` CLI, replay
+# it via the `replay` CLI, and require zero decision drift and zero audit
+# violations. Non-slow — tier-1 exercises the full loop.
+replay-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/record/test_replay_smoke.py -q
 
 # Hardware-free in-cluster dry run: real component processes against the
 # sim apiserver over HTTP (see hack/kind/README.md for the real-kind tier).

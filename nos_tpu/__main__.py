@@ -16,6 +16,7 @@ def main() -> int:
         "tpuagent": "per-node slice reporter+actuator daemon (NODE_NAME)",
         "sharingagent": "per-node sharing reporter daemon (NODE_NAME)",
         "export-metrics": "one-shot installation telemetry snapshot",
+        "replay": "deterministic offline replay of a flight-recorder log",
         "bench": "the utilization benchmark",
     }
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
@@ -37,6 +38,10 @@ def main() -> int:
         from nos_tpu.cmd.metricsexporter import main as export_main
 
         return export_main(argv)
+    if command == "replay":
+        from nos_tpu.cmd.replay import main as replay_main
+
+        return replay_main(argv)
     if command == "bench":
         import os
 

@@ -45,10 +45,16 @@ class GpuPartitionerConfig:
     # Plan only for pods this scheduler profile will bind (must match
     # SchedulerConfig.scheduler_name); empty = all pods.
     scheduler_name: str = constants.SCHEDULER_NAME
+    # Fraction of plans the invariant auditor shadow-recomputes in live
+    # mode (record/audit.py). 0 disables auditing entirely; replay always
+    # audits exhaustively regardless of this rate.
+    audit_sample_rate: float = 0.0
 
     def validate(self) -> None:
         if self.aging_chips_per_second < 0:
             raise ConfigError("aging_chips_per_second must be >= 0")
+        if not 0.0 <= self.audit_sample_rate <= 1.0:
+            raise ConfigError("audit_sample_rate must be in [0, 1]")
         if self.batch_window_timeout_seconds <= 0:
             raise ConfigError("batch_window_timeout_seconds must be > 0")
         if self.batch_window_idle_seconds < 0:

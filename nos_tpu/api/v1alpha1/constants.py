@@ -64,6 +64,7 @@ EVENT_REASON_QUOTA_BORROWED = "QuotaBorrowed"
 EVENT_REASON_QUOTA_RECLAIMED = "QuotaReclaimed"
 EVENT_REASON_PARTITIONING_APPLIED = "PartitioningApplied"
 EVENT_REASON_CARVE_FAILED = "CarveFailed"
+EVENT_REASON_AUDIT_VIOLATION = "AuditViolation"
 
 EVENT_REASONS = (
     EVENT_REASON_FAILED_SCHEDULING,
@@ -73,6 +74,7 @@ EVENT_REASONS = (
     EVENT_REASON_QUOTA_RECLAIMED,
     EVENT_REASON_PARTITIONING_APPLIED,
     EVENT_REASON_CARVE_FAILED,
+    EVENT_REASON_AUDIT_VIOLATION,
 )
 
 

@@ -150,15 +150,20 @@ def build_cluster(
     operator_config: Optional[OperatorConfig] = None,
     device_backend: str = "sim",
     tpuctl_dir: str = "",
+    flight_recorder=None,
 ) -> SimCluster:
     store = store or KubeStore()
     manager = Manager(store=store)
-    build_operator(manager, operator_config)
+    build_operator(manager, operator_config, flight_recorder=flight_recorder)
     partitioner_config = partitioner_config or GpuPartitionerConfig(
         batch_window_timeout_seconds=1.0, batch_window_idle_seconds=0.05
     )
-    partitioner = build_partitioner(manager, partitioner_config)
-    scheduler = build_scheduler(manager, scheduler_config)
+    partitioner = build_partitioner(
+        manager, partitioner_config, flight_recorder=flight_recorder
+    )
+    scheduler = build_scheduler(
+        manager, scheduler_config, flight_recorder=flight_recorder
+    )
     pool = SimDevicePool()
     # Admission arbitrates against the device inventory (ground truth),
     # the backstop for scheduler-vs-repartitioner races — see SimKubelet.

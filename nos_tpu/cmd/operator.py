@@ -13,7 +13,11 @@ from nos_tpu.kube.controller import Controller, Manager, Watch
 from nos_tpu.kube.events import EventRecorder
 
 
-def build_operator(manager: Manager, config: OperatorConfig | None = None) -> None:
+def build_operator(
+    manager: Manager,
+    config: OperatorConfig | None = None,
+    flight_recorder=None,
+) -> None:
     config = config or OperatorConfig()
     config.validate()
     store = manager.store
@@ -21,10 +25,16 @@ def build_operator(manager: Manager, config: OperatorConfig | None = None) -> No
 
     recorder = EventRecorder(store, component="nos-operator")
     eq = ElasticQuotaReconciler(
-        store, chip_memory_gb=config.tpu_chip_memory_gb, recorder=recorder
+        store,
+        chip_memory_gb=config.tpu_chip_memory_gb,
+        recorder=recorder,
+        flight_recorder=flight_recorder,
     )
     ceq = CompositeElasticQuotaReconciler(
-        store, chip_memory_gb=config.tpu_chip_memory_gb, recorder=recorder
+        store,
+        chip_memory_gb=config.tpu_chip_memory_gb,
+        recorder=recorder,
+        flight_recorder=flight_recorder,
     )
 
     manager.add(

@@ -36,8 +36,38 @@ def register_indexers(store) -> None:
         store.add_indexer("Pod", constants.INDEX_POD_NODE, lambda p: [p.spec.node_name])
 
 
+def build_sim_framework(store) -> Framework:
+    """The embedded simulation framework: the same plugin set the real
+    scheduler runs, including CapacityScheduling, so plans are never
+    refused at scheduling time (gpupartitioner.go:294-318 + SURVEY §7
+    "simulation fidelity"). Shared by the live partitioner and the flight
+    replay harness — replayed plans must run the exact plugin set the
+    recorded ones did.
+
+    The sim includes the ICI co-location filter so the planner never
+    carves for a gang member in a pool the scheduler would reject
+    (store-bound members pin the pool; members placed WITHIN one plan
+    are kept co-located by the gang pre-pass running per node pool's
+    nodes in sequence — a cross-pool split inside a single plan resolves
+    via permit-timeout + replan, the level-triggered backstop)."""
+    from nos_tpu.scheduler.plugins.reservation import BoardReservation
+    from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
+
+    capacity = CapacityScheduling(store)
+    return Framework(
+        pre_filter_plugins=[capacity],
+        filter_plugins=vanilla_filter_plugins()
+        # Simulation fidelity (SURVEY §7): the planner must not carve for
+        # pods the real scheduler would reject — including pods a board
+        # reservation keeps off a draining node.
+        + [MultihostIciFilter(store), BoardReservation(store)],
+    )
+
+
 def build_partitioner(
-    manager: Manager, config: GpuPartitionerConfig | None = None
+    manager: Manager,
+    config: GpuPartitionerConfig | None = None,
+    flight_recorder=None,
 ) -> PartitionerController:
     config = config or GpuPartitionerConfig()
     config.validate()
@@ -47,8 +77,20 @@ def build_partitioner(
         set_known_geometries(config.known_tpu_geometries)
 
     from nos_tpu.kube.events import EventRecorder
+    from nos_tpu.record.audit import build_auditor
 
     recorder = EventRecorder(store, component="nos-partitioner")
+    if flight_recorder is not None:
+        # Replay rebuilds planners with the same aging knob — it shapes
+        # the fairness sort every recorded plan used.
+        flight_recorder.record_session_meta(
+            aging_chips_per_second=config.aging_chips_per_second
+        )
+    auditor = build_auditor(
+        sample_rate=config.audit_sample_rate,
+        recorder=recorder,
+        flight_recorder=flight_recorder,
+    )
     cluster_state = ClusterState()
     # Wall-clock ms + monotonic counter: two plans in the same millisecond
     # must not share an id or the spec/status handshake would false-ack.
@@ -57,28 +99,7 @@ def build_partitioner(
     tpu_partitioner = TpuPartitioner(store)
     initializer = TpuNodeInitializer(tpu_partitioner, plan_id_fn)
 
-    # The embedded simulation framework: the same plugin set the real
-    # scheduler runs, including CapacityScheduling, so plans are never
-    # refused at scheduling time (gpupartitioner.go:294-318 + SURVEY §7
-    # "simulation fidelity").
-    capacity = CapacityScheduling(store)
-    # The sim includes the ICI co-location filter so the planner never
-    # carves for a gang member in a pool the scheduler would reject
-    # (store-bound members pin the pool; members placed WITHIN one plan
-    # are kept co-located by the gang pre-pass running per node pool's
-    # nodes in sequence — a cross-pool split inside a single plan resolves
-    # via permit-timeout + replan, the level-triggered backstop).
-    from nos_tpu.scheduler.plugins.reservation import BoardReservation
-    from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
-
-    sim_framework = Framework(
-        pre_filter_plugins=[capacity],
-        filter_plugins=vanilla_filter_plugins()
-        # Simulation fidelity (SURVEY §7): the planner must not carve for
-        # pods the real scheduler would reject — including pods a board
-        # reservation keeps off a draining node.
-        + [MultihostIciFilter(store), BoardReservation(store)],
-    )
+    sim_framework = build_sim_framework(store)
 
     controller = PartitionerController(
         store=store,
@@ -92,6 +113,8 @@ def build_partitioner(
         scheduler_name=config.scheduler_name,
         plan_id_fn=plan_id_fn,
         recorder=recorder,
+        flight_recorder=flight_recorder,
+        auditor=auditor,
     )
 
     node_ctrl = StateNodeController(store, cluster_state, initializer=initializer)
@@ -203,6 +226,8 @@ def build_partitioner(
         plan_id_fn=plan_id_fn,
         tracked_resource_fn=sharing_codec.is_tracked,
         recorder=recorder,
+        flight_recorder=flight_recorder,
+        auditor=auditor,
     )
     manager.add(
         Controller(

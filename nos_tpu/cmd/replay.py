@@ -1,0 +1,52 @@
+"""`python -m nos_tpu replay <record.jsonl>`: deterministic offline replay.
+
+Loads a flight-recorder JSONL export (written by `run --record` or fetched
+from `/debug/record?format=jsonl`), rebuilds the cluster history from the
+recorded deltas, re-runs every scheduler cycle and partitioner plan against
+the state each decision saw live, and exhaustively audits the planner's
+incremental structures after every replayed plan.
+
+Exit code 0 means every replayed decision matched the record and every
+invariant check passed; nonzero means drift or an audit violation — the
+rendered report names each one.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Replay a flight-recorder log and diff decisions"
+    )
+    parser.add_argument("record", help="JSONL flight-recorder export")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from nos_tpu.record import ReplaySession
+    from nos_tpu.record.recorder import load_jsonl
+    from nos_tpu.record.replay import drift_exit_code
+
+    try:
+        records = load_jsonl(args.record)
+    except OSError as exc:
+        print(f"cannot read {args.record}: {exc}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"{args.record}: no records", file=sys.stderr)
+        return 2
+
+    report = ReplaySession(records).run()
+    print(report.render())
+    return drift_exit_code(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,14 @@ from nos_tpu.api.config import (
 )
 from nos_tpu.api.v1alpha1 import constants, labels
 from nos_tpu.cmd.cluster import build_cluster
-from nos_tpu.kube.objects import Node, NodeStatus, ObjectMeta
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
 from nos_tpu.util.health import HealthServer
 
 
@@ -50,6 +57,7 @@ def configs_from(config: dict):
         scheduler_config_file=p.get("schedulerConfigFile", ""),
         aging_chips_per_second=p.get("agingChipsPerSecond", 1.0),
         scheduler_name=p.get("schedulerName", constants.SCHEDULER_NAME),
+        audit_sample_rate=p.get("auditSampleRate", 0.0),
     )
     scheduler = SchedulerConfig(
         retry_seconds=s.get("retrySeconds", 0.5),
@@ -84,10 +92,42 @@ def seed_node(spec: dict) -> Node:
     )
 
 
+def seed_pod(spec: dict) -> Pod:
+    """A pending workload pod from a `pods:` config entry — the smoke-test
+    way to drive the suite end to end without an external client."""
+    requests = {constants.RESOURCE_TPU: int(spec.get("chips", 1))}
+    if "cpu" in spec:
+        requests["cpu"] = spec["cpu"]
+    if "memoryGB" in spec:
+        requests["memory"] = spec["memoryGB"]
+    return Pod(
+        metadata=ObjectMeta(
+            name=spec["name"],
+            namespace=spec.get("namespace", "default"),
+        ),
+        spec=PodSpec(
+            containers=[Container(requests=dict(requests), limits=dict(requests))],
+            scheduler_name=spec.get("schedulerName", constants.SCHEDULER_NAME),
+        ),
+    )
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="Run the nos-tpu suite in-process")
     parser.add_argument("--config", default="", help="YAML component config")
     parser.add_argument("--health-port", type=int, default=None)
+    parser.add_argument(
+        "--record",
+        default="",
+        metavar="PATH",
+        help="flight-recorder JSONL export path (enables recording)",
+    )
+    parser.add_argument(
+        "--run-seconds",
+        type=float,
+        default=None,
+        help="exit after N seconds instead of waiting for a signal",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -98,12 +138,22 @@ def main(argv=None) -> int:
 
     config = load_config(args.config)
     partitioner_cfg, scheduler_cfg, agent_cfg = configs_from(config)
+
+    flight_recorder = None
+    if args.record:
+        from nos_tpu.record import FlightRecorder
+
+        flight_recorder = FlightRecorder()
     cluster = build_cluster(
         partitioner_config=partitioner_cfg,
         scheduler_config=scheduler_cfg,
         device_backend=config.get("deviceBackend", "sim"),
         tpuctl_dir=config.get("tpuctlDir", "/tmp/nos-tpu"),
+        flight_recorder=flight_recorder,
     )
+    if flight_recorder is not None:
+        # Attach BEFORE seeding: node/pod creation deltas are replay inputs.
+        flight_recorder.attach(cluster.store)
     for spec in config.get("nodes", []):
         node = seed_node(spec)
         kind = spec.get("partitioning", "tpu")
@@ -113,15 +163,23 @@ def main(argv=None) -> int:
             cluster.add_hybrid_node(node, agent_cfg)
         else:
             cluster.add_tpu_node(node, agent_cfg)
+    for spec in config.get("pods", []):
+        cluster.store.create(seed_pod(spec))
 
     port = args.health_port
     if port is None:
         port = (config.get("manager") or {}).get("healthProbePort", 8081)
-    health = HealthServer(port=port, explain_fn=cluster.scheduler.explain)
+    health = HealthServer(
+        port=port,
+        explain_fn=cluster.scheduler.explain,
+        record_fn=flight_recorder.records if flight_recorder is not None else None,
+    )
     bound = health.start()
     logging.info(
-        "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain)",
+        "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain"
+        "%s)",
         bound,
+        " /debug/record" if flight_recorder is not None else "",
     )
 
     cluster.start()
@@ -148,10 +206,17 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     logging.info("nos-tpu suite running; Ctrl-C to stop")
     try:
-        stop.wait()
+        if args.run_seconds is not None:
+            stop.wait(args.run_seconds)
+        else:
+            stop.wait()
     finally:
         cluster.stop()
         health.stop()
+        if flight_recorder is not None:
+            flight_recorder.detach()
+            count = flight_recorder.export_jsonl(args.record)
+            logging.info("flight record: %d record(s) -> %s", count, args.record)
     return 0
 
 

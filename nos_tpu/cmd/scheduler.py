@@ -11,7 +11,11 @@ from nos_tpu.kube.objects import PodPhase
 from nos_tpu.scheduler.scheduler import Scheduler, new_framework
 
 
-def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> Scheduler:
+def build_scheduler(
+    manager: Manager,
+    config: SchedulerConfig | None = None,
+    flight_recorder=None,
+) -> Scheduler:
     config = config or SchedulerConfig()
     config.validate()
     store = manager.store
@@ -26,7 +30,14 @@ def build_scheduler(manager: Manager, config: SchedulerConfig | None = None) -> 
         retry_seconds=config.retry_seconds,
         scheduler_name=config.scheduler_name,
         recorder=EventRecorder(store, component="nos-scheduler"),
+        flight_recorder=flight_recorder,
     )
+    if flight_recorder is not None:
+        # Session facts replay needs to rebuild an identical scheduler.
+        flight_recorder.record_session_meta(
+            scheduler_name=config.scheduler_name,
+            gang_timeout_seconds=config.gang_wait_timeout_seconds,
+        )
 
     logged_foreign: set = set()
 

@@ -50,6 +50,7 @@ class _QuotaReconcilerBase:
         store: KubeStore,
         chip_memory_gb: int | None = None,
         recorder=None,
+        flight_recorder=None,
     ) -> None:
         from nos_tpu.api.v1alpha1 import constants
 
@@ -59,6 +60,10 @@ class _QuotaReconcilerBase:
         # on every capacity-label flip, so "why is my pod a preemption
         # victim" is answerable from kubectl-style events.
         self.recorder = recorder
+        # Optional record/recorder.py FlightRecorder: quota reconciles are
+        # logged as decision records (informational on replay — the label
+        # flips themselves arrive via the recorded pod deltas).
+        self.flight_recorder = flight_recorder
 
     def _running_pods(self, namespaces: List[str]) -> List[Pod]:
         pods: List[Pod] = []
@@ -80,6 +85,10 @@ class _QuotaReconcilerBase:
             self._reconcile_quota_traced(quota, namespaces)
 
     def _reconcile_quota_traced(self, quota, namespaces: List[str]) -> None:
+        # Watermark BEFORE this reconcile's own writes: the flips below are
+        # consequences of the state at this revision, not inputs to it.
+        revision = self.store.revision
+        flips: List[List[str]] = []
         pods = sort_pods_for_quota(self._running_pods(namespaces))
         min_resources = quota.spec.min
         used: ResourceList = {}
@@ -104,6 +113,7 @@ class _QuotaReconcilerBase:
                     {labels_api.CAPACITY_LABEL: desired_label},
                 )
                 self._record_capacity_flip(quota, pod, in_quota, previous_label)
+                flips.append([pod.namespaced_name, desired_label])
             used = candidate
 
         if quota.status.used != used:
@@ -112,6 +122,14 @@ class _QuotaReconcilerBase:
 
             self.store.patch_merge(
                 quota.kind, quota.metadata.name, quota.metadata.namespace, mutate
+            )
+
+        if self.flight_recorder is not None:
+            self.flight_recorder.record_quota_reconcile(
+                quota=f"{quota.metadata.namespace}/{quota.metadata.name}".lstrip("/"),
+                revision=revision,
+                used=dict(used),
+                flips=flips,
             )
 
     def _record_capacity_flip(

@@ -50,12 +50,19 @@ class PartitionerController:
         tracked_resource_fn=None,
         scheduler_name: str = "",
         recorder=None,
+        flight_recorder=None,
+        auditor=None,
     ) -> None:
         self.store = store
         # Optional kube/events.py EventRecorder: PartitioningApplied when a
         # plan actuates, CarveFailed (with the planner's lacking-profile
         # reason) per pod the plan could not serve.
         self.recorder = recorder
+        # Optional record.FlightRecorder (planner.plan + actuation records)
+        # and record.InvariantAuditor (sampled shadow-recompute of the
+        # planner's incremental caches after a plan).
+        self.flight_recorder = flight_recorder
+        self.auditor = auditor
         # namespaced_name -> last CarveFailed reason recorded; pruned to
         # the live pending set every cycle so deleted pods don't leak.
         self._last_carve_reason: Dict[str, str] = {}
@@ -262,6 +269,10 @@ class PartitionerController:
         # (batch-mates still correlate through the shared plan id
         # attribute on their own scheduler cycles).
         journey = TRACER.journey(("pod", pending[0].namespaced_name))
+        # Watermark BEFORE the snapshot read: replay applies deltas up to
+        # here, so the replayed snapshot sees exactly the state this plan
+        # planned from (the plan's own actuation writes come after).
+        revision = self.store.revision
         with TRACER.attach(journey):
             with TRACER.span(
                 "partitioner.process", kind=self.kind, pending=len(pending)
@@ -280,6 +291,12 @@ class PartitionerController:
                 with TRACER.span("partitioner.actuate", plan_id=plan.id):
                     applied = self.actuator.apply(current, plan)
                 proc.set_attributes(nodes_repartitioned=applied)
+                self._record_plan(revision, pending, plan, applied, journey)
+                if self.auditor is not None and self.auditor.should_audit():
+                    violations = self.auditor.audit_plan(
+                        self.planner, snapshot, revision=revision
+                    )
+                    proc.set_attributes(audit_violations=len(violations))
         if applied:
             self.plans_applied += 1
             self.nodes_repartitioned += applied
@@ -289,6 +306,35 @@ class PartitionerController:
             )
         self._record_plan_events(pending, applied)
         return applied
+
+    def _record_plan(
+        self, revision: int, pending: List[Pod], plan, applied: int, journey
+    ) -> None:
+        if self.flight_recorder is None:
+            return
+        from nos_tpu.partitioning.core.partition_state import (
+            partitioning_state_to_dict,
+        )
+
+        self.flight_recorder.record_plan(
+            kind=self.kind,
+            revision=revision,
+            pending=[p.namespaced_name for p in pending],
+            pending_ages=dict(
+                getattr(self.planner, "last_pending_ages", {}) or {}
+            ),
+            plan_id=plan.id,
+            desired=partitioning_state_to_dict(plan.desired_state),
+            unserved=dict(getattr(self.planner, "last_unserved", {}) or {}),
+            applied=applied,
+            trace_id=journey.trace_id if journey is not None else "",
+        )
+        self.flight_recorder.record_actuation(
+            kind=self.kind,
+            plan_id=plan.id,
+            revision=self.store.revision,
+            applied=applied,
+        )
 
     def _record_plan_events(self, pending: List[Pod], applied: int) -> None:
         """Event messages carry NO plan id: the id changes every cycle, so

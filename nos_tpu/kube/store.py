@@ -129,8 +129,36 @@ class KubeStore:
             if k not in self._objects:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             stored = self._objects.pop(k)
+            # Deletes advance the revision too (a real apiserver's
+            # deletionTimestamp write does): the flight recorder keys every
+            # delta by revision, and an rv-less delete would be unorderable
+            # against the writes around it.
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
         self._notify(WatchEvent(DELETED, copy.deepcopy(stored)))
         return stored
+
+    @property
+    def revision(self) -> int:
+        """Current store revision — the watermark a control cycle reads at
+        entry so replay knows which deltas the decision observed."""
+        with self._lock:
+            return self._rv
+
+    def apply_event(self, etype: str, obj: Any) -> None:
+        """Replay a recorded watch event verbatim: upsert or delete WITHOUT
+        re-stamping, preserving the recorded resource_version so replayed
+        state is revision-identical to the recording. Idempotent (an ADDED
+        for an existing key overwrites), since a recorder attached after
+        seeding replays existing objects as ADDED."""
+        with self._lock:
+            k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
+            if etype == DELETED:
+                self._objects.pop(k, None)
+            else:
+                self._objects[k] = copy.deepcopy(obj)
+            self._rv = max(self._rv, obj.metadata.resource_version)
+        self._notify(WatchEvent(etype, copy.deepcopy(obj)))
 
     def list(
         self,

@@ -90,10 +90,12 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> LlamaConfig:
     # the same converter serves both). transformers uses None for "full".
     sliding = getattr(hf_config, "sliding_window", None)
     # Mixtral: Mistral attention + a routed MoE MLP per block. Routing
-    # parity note: Mixtral computes top-k over router logits THEN
-    # softmaxes the survivors; this stack softmaxes all experts then
-    # renormalizes the top-k — identical math (softmax is monotonic and
-    # the renormalization cancels the common denominator).
+    # parity note: HF transformers (the checkpoints this converter reads)
+    # softmaxes ALL router logits then renormalizes the top-k — the same
+    # order this stack uses; it is the mistral-inference reference that
+    # takes top-k over the logits first and softmaxes only the survivors.
+    # Identical math either way (softmax is monotonic and the
+    # renormalization cancels the common denominator).
     n_experts = 0
     moe_top_k = 2
     if model_type == "mixtral":

@@ -33,6 +33,32 @@ class PartitioningPlan:
     id: str
 
 
+def partitioning_state_to_dict(state: PartitioningState) -> dict:
+    """JSON projection for the flight recorder: node -> board index (as a
+    string key) -> resources. Round-trips through
+    partitioning_state_from_dict."""
+    return {
+        node: {
+            str(b.board_index): dict(b.resources) for b in np.boards
+        }
+        for node, np in state.items()
+    }
+
+
+def partitioning_state_from_dict(data: dict) -> PartitioningState:
+    return {
+        node: NodePartitioning(
+            boards=[
+                BoardPartitioning(
+                    board_index=int(index), resources=dict(resources)
+                )
+                for index, resources in sorted(boards.items(), key=lambda kv: int(kv[0]))
+            ]
+        )
+        for node, boards in data.items()
+    }
+
+
 def _node_key(np: NodePartitioning) -> tuple:
     return tuple(
         sorted(

@@ -160,6 +160,13 @@ class Planner:
         # _plan could not serve; read by the partitioner controller for
         # CarveFailed Events. Valid until the next plan() overwrites it.
         self.last_unserved: Dict[str, str] = {}
+        # Flight-recorder/auditor taps, valid until the next plan():
+        # the effective fairness age per pending pod (recorded so replay
+        # can reproduce the aging-dependent sort without this planner's
+        # _pending_seen history), and the SliceTracker the final pass ran
+        # with (audited against a full lacking recompute).
+        self.last_pending_ages: Dict[str, float] = {}
+        self.last_tracker: Optional[SliceTracker] = None
         # namespaced_name -> (first_seen, last_seen) monotonic instants.
         # Age for the fairness sort is measured from first_seen — time
         # passed over across plan() calls — never from creation time (a
@@ -241,7 +248,15 @@ class Planner:
 
     # ----------------------------------------------------------- entry
 
-    def plan(self, snapshot: ClusterSnapshot, pending_pods: List[Pod]) -> PartitioningState:
+    def plan(
+        self,
+        snapshot: ClusterSnapshot,
+        pending_pods: List[Pod],
+        pending_ages: Optional[Dict[str, float]] = None,
+    ) -> PartitioningState:
+        """``pending_ages`` (namespaced_name -> seconds pending) overrides
+        the planner's own first-seen bookkeeping — replay passes the
+        recorded ages so the aging-dependent candidate sort reproduces."""
         started = time.monotonic()
         with TRACER.span(
             "partitioner.plan",
@@ -253,7 +268,7 @@ class Planner:
             # refreshes) don't all pass through the stamped mutators.
             self._reset_plan_caches(snapshot)
             try:
-                return self._plan(snapshot, pending_pods, span)
+                return self._plan(snapshot, pending_pods, span, pending_ages)
             finally:
                 metrics.PLAN_DURATION.observe(time.monotonic() - started)
                 self._flush_cache_stats(span)
@@ -295,27 +310,43 @@ class Planner:
         }
 
     def _plan(
-        self, snapshot: ClusterSnapshot, pending_pods: List[Pod], span=None
+        self,
+        snapshot: ClusterSnapshot,
+        pending_pods: List[Pod],
+        span=None,
+        pending_ages: Optional[Dict[str, float]] = None,
     ) -> PartitioningState:
         # Pool draw order == claim pre-pass order (first-fit-descending):
         # the tracker and the pre-pass must agree on WHICH pods the
         # existing free slices serve, or a pod could end up neither
         # claim-placed nor carved for this round.
         self.last_unserved = {}
+        self.last_tracker = None
         now = time.monotonic()
-        # Key includes the uid: a recreated pod with a reused name is a NEW
-        # pod and must start at age 0, not inherit its predecessor's boost.
-        live = {(p.namespaced_name, p.metadata.uid) for p in pending_pods}
-        for key in live:
-            first, _ = self._pending_seen.get(key, (now, now))
-            self._pending_seen[key] = (first, now)
-        self._pending_seen = {
-            k: v
-            for k, v in self._pending_seen.items()
-            if now - v[1] <= self._PENDING_TTL_S
-        }
-        pending_since = {
-            k[0]: v[0] for k, v in self._pending_seen.items() if k in live
+        if pending_ages is not None:
+            pending_since = {
+                p.namespaced_name: now
+                - pending_ages.get(p.namespaced_name, 0.0)
+                for p in pending_pods
+            }
+        else:
+            # Key includes the uid: a recreated pod with a reused name is a
+            # NEW pod and must start at age 0, not inherit its
+            # predecessor's boost.
+            live = {(p.namespaced_name, p.metadata.uid) for p in pending_pods}
+            for key in live:
+                first, _ = self._pending_seen.get(key, (now, now))
+                self._pending_seen[key] = (first, now)
+            self._pending_seen = {
+                k: v
+                for k, v in self._pending_seen.items()
+                if now - v[1] <= self._PENDING_TTL_S
+            }
+            pending_since = {
+                k[0]: v[0] for k, v in self._pending_seen.items() if k in live
+            }
+        self.last_pending_ages = {
+            k: now - v for k, v in pending_since.items()
         }
         candidates = sort_candidate_pods(
             pending_pods,
@@ -336,6 +367,7 @@ class Planner:
             >= 2.5
         }
         tracker = SliceTracker(snapshot, candidates)
+        self.last_tracker = tracker
         if tracker.empty:
             # Nothing is lacking — current geometry already serves every
             # pending pod (planner.go:80-83).
@@ -373,6 +405,7 @@ class Planner:
                 self.last_unserved = self._unserved_reasons(
                     trial_tracker, candidates
                 )
+                self.last_tracker = trial_tracker
                 snapshot.commit()
                 log.info(
                     "planner: gang trial committed as the real plan "
@@ -410,6 +443,7 @@ class Planner:
                 self.last_unserved = excluded_reasons
                 return snapshot.partitioning_state()
             tracker = SliceTracker(snapshot, candidates)
+            self.last_tracker = tracker
             if tracker.empty:
                 self.last_unserved = excluded_reasons
                 return snapshot.partitioning_state()

@@ -1,0 +1,32 @@
+"""Flight recorder: decision-log capture, offline replay, cache auditing.
+
+The control plane's hot decisions (bind this pod, carve that node, flip
+that quota label) flow through layered incremental state — CoW snapshots,
+the verdict cache, incremental lacking totals, the futility memo — whose
+silent drift would corrupt decisions without failing a test. This package
+closes that loop:
+
+- ``FlightRecorder`` (recorder.py): per control cycle (scheduler cycle,
+  ``planner.plan()``, quota reconcile, actuation) captures a compact
+  record — input deltas keyed by store revision, decision outputs,
+  clock stamps, trace-id/Diagnosis links — into a bounded ring with
+  JSONL export, served at ``/debug/record``.
+- ``ReplaySession`` (replay.py): reconstructs cluster state from the
+  recorded deltas and deterministically re-runs the scheduler and
+  planner over each cycle, diffing decisions against the recorded ones.
+- ``InvariantAuditor`` (audit.py): named checks that shadow-recompute
+  ground truth for each incremental structure and compare (sampled in
+  live mode, exhaustive in replay).
+"""
+from nos_tpu.record.audit import AuditViolation, InvariantAuditor
+from nos_tpu.record.recorder import FlightRecorder, load_jsonl
+from nos_tpu.record.replay import ReplayReport, ReplaySession
+
+__all__ = [
+    "AuditViolation",
+    "FlightRecorder",
+    "InvariantAuditor",
+    "ReplayReport",
+    "ReplaySession",
+    "load_jsonl",
+]

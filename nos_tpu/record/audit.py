@@ -1,0 +1,316 @@
+"""InvariantAuditor: shadow-recompute ground truth for the incremental
+planning structures and compare.
+
+PR 1/3 made the planner fast by making it incremental: the CoW snapshot
+maintains the free pool by delta, the verdict cache memoizes plugin
+conjunctions per (pod-signature, node, version), SliceTracker keeps
+lacking totals current by subtraction, and the carve-futility memo skips
+whole fork+carve trials. Each structure has an exact ground truth it
+claims to equal — `_compute_free_pool`, a fresh plugin run, a full
+re-sum, a real carve attempt. The auditor recomputes those truths and
+compares, so silent cache drift becomes a counted, evented, traceable
+violation instead of a corrupted decision.
+
+Named checks:
+
+- ``verdict_cache``   cached verdicts vs. a fresh uncached cacheable-
+                      plugin run (entries at the node's current version)
+- ``lacking_totals``  SliceTracker's incremental per-accelerator totals
+                      vs. a full re-sum over its lacking map
+- ``free_pool``       the snapshot's incremental free pool vs.
+                      ``_compute_free_pool()``
+- ``mutation_clock``  node versions never exceed ``state_version``, and
+                      no two live nodes share a nonzero tick
+- ``carve_futility``  memoized "carve is a no-op" entries vs. an actual
+                      forked carve attempt (reverted)
+
+Live mode samples (deterministic counter stride, config-controlled) and
+caps per-check work; replay audits exhaustively.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from nos_tpu.util import metrics
+from nos_tpu.util import resources as res
+
+CHECKS = (
+    "verdict_cache",
+    "lacking_totals",
+    "free_pool",
+    "mutation_clock",
+    "carve_futility",
+)
+
+
+def _nonzero(pool: Dict[str, int]) -> Dict[str, int]:
+    """Zero entries are representation noise (a drained counter left at 0
+    vs. popped), not drift."""
+    return {k: v for k, v in pool.items() if v}
+
+
+@dataclass
+class AuditViolation:
+    check: str
+    subject: str  # node name, accelerator, or cache-key description
+    detail: str
+    node: str = ""  # set when node-scoped, for Event targeting
+
+    def to_dict(self) -> dict:
+        return {
+            "check": self.check,
+            "subject": self.subject,
+            "detail": self.detail,
+            "node": self.node,
+        }
+
+
+class InvariantAuditor:
+    def __init__(
+        self,
+        sample_rate: float = 0.0,
+        recorder=None,
+        flight_recorder=None,
+        max_entries_per_check: int = 8,
+    ) -> None:
+        # Fraction of plans audited in live mode. Sampling is a
+        # deterministic counter stride, not a coin flip: replayed sessions
+        # must audit the same plans the live run did.
+        self.sample_rate = sample_rate
+        self.recorder = recorder  # kube EventRecorder for AuditViolation
+        self.flight_recorder = flight_recorder
+        # Live-mode cap on the expensive per-entry checks (verdict cache,
+        # futility memo); exhaustive mode ignores it.
+        self.max_entries_per_check = max_entries_per_check
+        self._plans_seen = 0
+        self.violations_total = 0
+
+    # -------------------------------------------------------- sampling
+
+    def should_audit(self) -> bool:
+        """Counter-stride sampling: audits plan k iff floor(k*rate)
+        advances, giving exactly `rate` density with no RNG."""
+        if self.sample_rate <= 0:
+            return False
+        self._plans_seen += 1
+        k = self._plans_seen
+        return math.floor(k * self.sample_rate) > math.floor(
+            (k - 1) * self.sample_rate
+        )
+
+    # ----------------------------------------------------------- entry
+
+    def audit_plan(
+        self, planner, snapshot, exhaustive: bool = False, revision: int = 0
+    ) -> List[AuditViolation]:
+        """Run every check against the given planner's just-completed
+        plan() state. Publishes violations (metric, Event, flight record)
+        and returns them."""
+        violations: List[AuditViolation] = []
+        violations += self.check_free_pool(snapshot)
+        violations += self.check_mutation_clock(snapshot)
+        violations += self.check_lacking_totals(planner.last_tracker)
+        violations += self.check_verdict_cache(planner, snapshot, exhaustive)
+        violations += self.check_carve_futility(planner, snapshot, exhaustive)
+        self.publish(violations, snapshot, revision)
+        return violations
+
+    def publish(
+        self, violations: List[AuditViolation], snapshot=None, revision: int = 0
+    ) -> None:
+        for violation in violations:
+            metrics.AUDIT_VIOLATIONS.labels(check=violation.check).inc()
+            self.violations_total += 1
+            self._emit_event(violation, snapshot)
+        if self.flight_recorder is not None and violations:
+            self.flight_recorder.record_audit(
+                revision=revision,
+                violations=[v.to_dict() for v in violations],
+            )
+
+    def _emit_event(self, violation: AuditViolation, snapshot) -> None:
+        if self.recorder is None or snapshot is None or not violation.node:
+            return
+        node = snapshot.get_nodes().get(violation.node)
+        if node is None:
+            return
+        from nos_tpu.api.v1alpha1 import constants
+
+        self.recorder.record(
+            node.sim_node_info().node,
+            constants.EVENT_REASON_AUDIT_VIOLATION,
+            f"{violation.check}: {violation.detail}",
+            type="Warning",
+        )
+
+    # ---------------------------------------------------------- checks
+
+    def check_free_pool(self, snapshot) -> List[AuditViolation]:
+        incremental = _nonzero(snapshot.free_slice_resources())
+        truth = _nonzero(snapshot._compute_free_pool())
+        if incremental == truth:
+            return []
+        return [
+            AuditViolation(
+                check="free_pool",
+                subject="cluster",
+                detail=f"incremental pool {incremental} != recomputed {truth}",
+            )
+        ]
+
+    def check_mutation_clock(self, snapshot) -> List[AuditViolation]:
+        out: List[AuditViolation] = []
+        versions = {
+            name: node.version for name, node in snapshot.get_nodes().items()
+        }
+        for name, version in versions.items():
+            if version > snapshot.state_version:
+                out.append(
+                    AuditViolation(
+                        check="mutation_clock",
+                        subject=name,
+                        detail=(
+                            f"node version {version} ahead of "
+                            f"state_version {snapshot.state_version}"
+                        ),
+                        node=name,
+                    )
+                )
+        nonzero = [v for v in versions.values() if v]
+        if len(nonzero) != len(set(nonzero)):
+            dupes = sorted(v for v in set(nonzero) if nonzero.count(v) > 1)
+            out.append(
+                AuditViolation(
+                    check="mutation_clock",
+                    subject="cluster",
+                    detail=f"duplicate mutation ticks across nodes: {dupes}",
+                )
+            )
+        return out
+
+    def check_lacking_totals(self, tracker) -> List[AuditViolation]:
+        if tracker is None:
+            return []
+        out: List[AuditViolation] = []
+        for accelerator, cached in tracker._totals_cache.items():
+            truth: Dict[str, int] = {}
+            for lacking in tracker._lacking.values():
+                truth = res.sum_resources(
+                    truth, tracker._convert_plain(lacking, accelerator)
+                )
+            if _nonzero(dict(cached)) != _nonzero(truth):
+                out.append(
+                    AuditViolation(
+                        check="lacking_totals",
+                        subject=accelerator or "(plain)",
+                        detail=(
+                            f"incremental totals {_nonzero(dict(cached))} "
+                            f"!= recomputed {_nonzero(truth)}"
+                        ),
+                    )
+                )
+        return out
+
+    def check_verdict_cache(
+        self, planner, snapshot, exhaustive: bool = False
+    ) -> List[AuditViolation]:
+        entries = getattr(planner._verdict_cache, "entries", None)
+        if not entries:
+            return []
+        # Recover each signature's normalized sim pod from the planner's
+        # per-plan cache — the signature alone cannot be re-run.
+        sim_by_signature = {
+            cached[2]: cached[1] for cached in planner._sim_pod_cache.values()
+        }
+        nodes = snapshot.get_nodes()
+        out: List[AuditViolation] = []
+        checked = 0
+        limit = None if exhaustive else self.max_entries_per_check
+        for (signature, node_name, version), verdict in list(entries.items()):
+            node = nodes.get(node_name)
+            if node is None or node.version != version:
+                # Stale key: the node moved on, the entry can never be
+                # consulted for this state again — nothing to audit.
+                continue
+            sim_pod = sim_by_signature.get(signature)
+            if sim_pod is None:
+                continue
+            fresh = planner._run_simulation(
+                snapshot,
+                node,
+                sim_pod,
+                publish=False,
+                pre=planner._cacheable_pre,
+                filters=planner._cacheable_filters,
+            )
+            if fresh != verdict:
+                out.append(
+                    AuditViolation(
+                        check="verdict_cache",
+                        subject=f"{node_name}@v{version}",
+                        detail=(
+                            f"cached verdict {verdict} != fresh plugin run "
+                            f"{fresh} for signature on {node_name}"
+                        ),
+                        node=node_name,
+                    )
+                )
+            checked += 1
+            if limit is not None and checked >= limit:
+                break
+        return out
+
+    def check_carve_futility(
+        self, planner, snapshot, exhaustive: bool = False
+    ) -> List[AuditViolation]:
+        memo = getattr(planner, "_futility_cache", None)
+        if not memo:
+            return []
+        nodes = snapshot.get_nodes()
+        out: List[AuditViolation] = []
+        checked = 0
+        limit = None if exhaustive else self.max_entries_per_check
+        for (node_name, version, lacking_items) in list(memo):
+            node = nodes.get(node_name)
+            if node is None or node.version != version:
+                continue  # stale key, unreachable for this node state
+            snapshot.fork()
+            try:
+                changed = snapshot.update_geometry_for(
+                    node_name, dict(lacking_items)
+                )
+            finally:
+                snapshot.revert()
+            if changed:
+                out.append(
+                    AuditViolation(
+                        check="carve_futility",
+                        subject=f"{node_name}@v{version}",
+                        detail=(
+                            "futility memo claims carving toward "
+                            f"{dict(lacking_items)} is a no-op, but a real "
+                            "carve changed the geometry"
+                        ),
+                        node=node_name,
+                    )
+                )
+            checked += 1
+            if limit is not None and checked >= limit:
+                break
+        return out
+
+
+def build_auditor(
+    sample_rate: float = 0.0, recorder=None, flight_recorder=None
+) -> Optional[InvariantAuditor]:
+    """Config seam: a zero rate means no auditor at all (no per-plan
+    branch in the controller), not an auditor that never fires."""
+    if sample_rate <= 0:
+        return None
+    return InvariantAuditor(
+        sample_rate=sample_rate,
+        recorder=recorder,
+        flight_recorder=flight_recorder,
+    )

@@ -1,0 +1,262 @@
+"""FlightRecorder: the control plane's decision log.
+
+Two record streams share one bounded ring, ordered by a process-wide
+sequence number:
+
+- ``delta`` records — every store write (ADDED/MODIFIED/DELETED) for the
+  kinds decisions read, serialized to the wire format (kube/serde.py) and
+  keyed by the store revision the write was stamped with. Together they
+  reconstruct the cluster state at any revision watermark.
+- decision records — one per control cycle (``scheduler.cycle``,
+  ``planner.plan``, ``quota.reconcile``, ``actuation``), carrying the
+  revision watermark read at cycle entry (so replay knows exactly which
+  deltas the decision observed), the decision outputs, monotonic/wall
+  clock stamps, and links to the pod's journey trace id and Diagnosis.
+
+Deltas arrive on a watch queue drained by a daemon thread, so they can
+lag the decision records written synchronously by the deciding threads —
+replay therefore orders deltas by revision (never by arrival) and
+decisions by sequence. The ring is bounded (oldest records fall off) so a
+long-lived process can always serve "the recent past" from
+``/debug/record`` without growing memory.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+# Kinds replay needs to reconstruct decision inputs. Events are excluded
+# on purpose: they are high-churn telemetry output, never decision input.
+RECORDED_KINDS = (
+    "Pod",
+    "Node",
+    "ConfigMap",
+    "PodDisruptionBudget",
+    "ElasticQuota",
+    "CompositeElasticQuota",
+)
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Parse an exported decision log back into record dicts."""
+    records: List[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 4096, seed: int = 0) -> None:
+        self.capacity = capacity
+        self._ring: "deque[dict]" = deque(maxlen=capacity)
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._store = None
+        self._queue = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # Session header: wall/monotonic origin plus the (currently
+        # unused, recorded for provenance) RNG seed — the clock/seed
+        # stamps every later record's offsets are read against.
+        self._append(
+            "session.start",
+            revision=0,
+            seed=seed,
+            wall_time=time.time(),
+            monotonic=time.monotonic(),
+        )
+
+    # ------------------------------------------------------------ ring
+
+    def _append(self, kind: str, **payload: Any) -> dict:
+        record = {"seq": next(self._seq), "kind": kind, "ts": time.time()}
+        record.update(payload)
+        with self._lock:
+            self._ring.append(record)
+        return record
+
+    def records(self) -> List[dict]:
+        """Ring contents in sequence order (deep enough copies to be
+        JSON-serialized by a concurrent reader)."""
+        with self._lock:
+            return list(self._ring)
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(r, sort_keys=True) for r in self.records())
+
+    def export_jsonl(self, path: str) -> int:
+        """Write the ring as JSONL; returns the record count."""
+        records = self.records()
+        with open(path, "w") as fh:
+            for record in records:
+                fh.write(json.dumps(record, sort_keys=True) + "\n")
+        return len(records)
+
+    # ----------------------------------------------------- delta stream
+
+    def attach(self, store, kinds: Iterable[str] = RECORDED_KINDS) -> None:
+        """Subscribe to the store's watch stream and record every write to
+        the given kinds as a ``delta``. Existing objects replay as ADDED
+        (informer list+watch), so a recorder attached before traffic
+        starts captures the full initial state."""
+        if self._store is not None:
+            raise RuntimeError("recorder already attached")
+        self._store = store
+        self._queue = store.watch(kinds)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="flight-recorder", daemon=True
+        )
+        self._thread.start()
+
+    def detach(self) -> None:
+        """Stop the delta stream and drain whatever is still queued, so an
+        export right after detach() holds every write made before it."""
+        if self._store is None:
+            return
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self._drain_pending()
+        self._store.stop_watch(self._queue)
+        self._store = None
+        self._queue = None
+
+    def _drain_loop(self) -> None:
+        import queue as queue_mod
+
+        while not self._stop.is_set():
+            try:
+                event = self._queue.get(timeout=0.1)
+            except queue_mod.Empty:
+                continue
+            self._record_delta(event)
+
+    def _drain_pending(self) -> None:
+        import queue as queue_mod
+
+        while True:
+            try:
+                event = self._queue.get_nowait()
+            except queue_mod.Empty:
+                return
+            self._record_delta(event)
+
+    def _record_delta(self, event) -> None:
+        from nos_tpu.kube import serde
+
+        try:
+            wire = serde.to_wire(event.object)
+        except (KeyError, AttributeError):
+            return  # kind without a wire codec; decisions never read it
+        self._append(
+            "delta",
+            type=event.type,
+            revision=event.object.metadata.resource_version,
+            object=wire,
+        )
+
+    # -------------------------------------------------- decision stream
+
+    def record_session_meta(self, **meta: Any) -> None:
+        """Extra session-level facts replay needs (scheduler name, gang
+        timeout, ...), folded into the session.start header."""
+        with self._lock:
+            for record in self._ring:
+                if record["kind"] == "session.start":
+                    record.update(meta)
+                    return
+
+    def record_scheduler_cycle(
+        self,
+        *,
+        pod: str,
+        revision: int,
+        decision: str,
+        node: str = "",
+        bound: Optional[List[List[str]]] = None,
+        victims: Optional[List[str]] = None,
+        message: str = "",
+        trace_id: str = "",
+        diagnosis: Optional[dict] = None,
+    ) -> None:
+        self._append(
+            "scheduler.cycle",
+            pod=pod,
+            revision=revision,
+            decision=decision,
+            node=node,
+            bound=bound or [],
+            victims=victims or [],
+            message=message,
+            trace_id=trace_id,
+            diagnosis=diagnosis,
+            monotonic=time.monotonic(),
+        )
+
+    def record_plan(
+        self,
+        *,
+        kind: str,
+        revision: int,
+        pending: List[str],
+        pending_ages: Dict[str, float],
+        plan_id: str,
+        desired: dict,
+        unserved: Dict[str, str],
+        applied: int,
+        trace_id: str = "",
+    ) -> None:
+        self._append(
+            "planner.plan",
+            partitioner_kind=kind,
+            revision=revision,
+            pending=pending,
+            pending_ages=pending_ages,
+            plan_id=plan_id,
+            desired=desired,
+            unserved=unserved,
+            applied=applied,
+            trace_id=trace_id,
+            monotonic=time.monotonic(),
+        )
+
+    def record_quota_reconcile(
+        self,
+        *,
+        quota: str,
+        revision: int,
+        used: Dict[str, float],
+        flips: List[List[str]],
+    ) -> None:
+        """One quota reconcile pass: published usage plus the capacity
+        label flips ([pod key, new label] pairs) it produced."""
+        self._append(
+            "quota.reconcile",
+            quota=quota,
+            revision=revision,
+            used=used,
+            flips=flips,
+        )
+
+    def record_actuation(
+        self, *, kind: str, plan_id: str, revision: int, applied: int
+    ) -> None:
+        self._append(
+            "actuation",
+            partitioner_kind=kind,
+            plan_id=plan_id,
+            revision=revision,
+            applied=applied,
+        )
+
+    def record_audit(self, *, revision: int, violations: List[dict]) -> None:
+        self._append("audit", revision=revision, violations=violations)

@@ -1,0 +1,254 @@
+"""ReplaySession: deterministic offline re-execution of a recorded log.
+
+The recorded deltas ARE the cluster history: replay rebuilds a fresh
+KubeStore by applying them in revision order (preserving the recorded
+resource versions), pausing at each decision record's watermark to
+re-run the decision against exactly the state it saw live. Decisions
+replay in sequence order through ONE scheduler and ONE planner instance,
+so order-dependent in-memory state (the assume cache, gang formation,
+plan caches) accumulates the way it did live.
+
+Drift is compared per decision:
+
+- ``scheduler.cycle`` — (decision, node, sorted bound pairs, sorted
+  victims) must match the record.
+- ``planner.plan``    — the replayed desired PartitioningState must be
+  equal (unordered, empty-board-insensitive) to the recorded one. The
+  recorded ``pending_ages`` feed the planner so the aging-dependent
+  candidate sort reproduces without the live process's clock history.
+
+After each replayed plan the invariant auditor runs exhaustively —
+replay is where "sampled in live mode" becomes "every entry, every
+plan".
+
+Known non-replayable inputs (reported as skips, not drift): decisions
+whose pod no longer resolves at the watermark, and decision kinds the
+replayer treats as informational (quota reconciles, actuations — both
+are deterministic functions of state already covered by the deltas).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from nos_tpu.record.audit import InvariantAuditor
+
+
+@dataclass
+class ReplayReport:
+    cycles: int = 0
+    plans: int = 0
+    drifts: List[dict] = field(default_factory=list)
+    violations: List[dict] = field(default_factory=list)
+    skips: List[dict] = field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not self.drifts and not self.violations
+
+    def render(self) -> str:
+        lines = [
+            f"replayed {self.cycles} scheduler cycle(s), {self.plans} plan(s): "
+            f"{len(self.drifts)} drift(s), {len(self.violations)} audit "
+            f"violation(s), {len(self.skips)} skip(s)"
+        ]
+        for drift in self.drifts:
+            lines.append(f"  DRIFT seq={drift.get('seq')}: {drift.get('detail')}")
+        for violation in self.violations:
+            lines.append(
+                f"  AUDIT {violation.get('check')}: {violation.get('detail')}"
+            )
+        for skip in self.skips:
+            lines.append(f"  skip seq={skip.get('seq')}: {skip.get('detail')}")
+        return "\n".join(lines)
+
+
+class ReplaySession:
+    def __init__(self, records: List[dict]) -> None:
+        from nos_tpu.cmd.partitioner import build_sim_framework, register_indexers
+        from nos_tpu.kube.store import KubeStore
+        from nos_tpu.partitioning.core import Planner
+        from nos_tpu.scheduler.scheduler import Scheduler, new_framework
+
+        self.records = records
+        self.meta = next(
+            (r for r in records if r.get("kind") == "session.start"), {}
+        )
+        self.store = KubeStore()
+        register_indexers(self.store)
+        # Deltas ordered by the revision the store stamped, not arrival:
+        # the recorder's drain thread can observe writes out of order
+        # across threads, but revisions are the store's own total order.
+        self.deltas = sorted(
+            (r for r in records if r.get("kind") == "delta"),
+            key=lambda r: (r["revision"], r["seq"]),
+        )
+        self._delta_index = 0
+        # Decisions replay in WATERMARK order, not record order: a plan's
+        # record is emitted at plan END (seq after any scheduler cycles
+        # that ran concurrently) but its watermark was captured at plan
+        # START. Seq order would fast-forward the store past the plan's
+        # watermark — feeding it its own actuation writes — because the
+        # delta cursor only moves forward. Each stream is serialized
+        # live, so per-stream watermark order equals execution order and
+        # in-memory state still accumulates correctly.
+        self.decisions = sorted(
+            (
+                r
+                for r in records
+                if r.get("kind") in ("scheduler.cycle", "planner.plan")
+            ),
+            key=lambda r: (r.get("revision", 0), r["seq"]),
+        )
+        framework, capacity, gang = new_framework(
+            self.store,
+            gang_timeout_seconds=self.meta.get("gang_timeout_seconds", 30.0),
+        )
+        self.scheduler = Scheduler(
+            self.store,
+            framework,
+            capacity,
+            gang,
+            scheduler_name=self.meta.get("scheduler_name", ""),
+        )
+        aging = self.meta.get("aging_chips_per_second", 1.0)
+        # One planner per partitioner kind (tpu / sharing), same plugin set
+        # as the live controllers (build_sim_framework).
+        self._planners = {
+            kind: Planner(
+                build_sim_framework(self.store), aging_chips_per_second=aging
+            )
+            for kind in ("tpu", "sharing")
+        }
+        self.auditor = InvariantAuditor(sample_rate=1.0)
+
+    # ----------------------------------------------------------- state
+
+    def _apply_deltas_up_to(self, revision: int) -> None:
+        from nos_tpu.kube import serde
+
+        while self._delta_index < len(self.deltas):
+            delta = self.deltas[self._delta_index]
+            if delta["revision"] > revision:
+                return
+            self.store.apply_event(delta["type"], serde.from_wire(delta["object"]))
+            self._delta_index += 1
+
+    def _snapshot_taker(self, kind: str):
+        if kind == "sharing":
+            from nos_tpu.partitioning.sharing import SharingSnapshotTaker
+
+            return SharingSnapshotTaker()
+        from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+        return TpuSnapshotTaker()
+
+    # ------------------------------------------------------------- run
+
+    def run(self) -> ReplayReport:
+        report = ReplayReport()
+        for record in self.decisions:
+            self._apply_deltas_up_to(record.get("revision", 0))
+            if record["kind"] == "scheduler.cycle":
+                self._replay_cycle(record, report)
+            else:
+                self._replay_plan(record, report)
+        return report
+
+    def _replay_cycle(self, record: dict, report: ReplayReport) -> None:
+        namespace, _, name = record["pod"].partition("/")
+        pod = self.store.try_get("Pod", name, namespace)
+        if pod is None:
+            report.skips.append(
+                {
+                    "seq": record["seq"],
+                    "detail": f"pod {record['pod']} absent at revision "
+                    f"{record.get('revision')}",
+                }
+            )
+            return
+        report.cycles += 1
+        outcome = self.scheduler.decide(pod)
+        self.scheduler.settle(outcome)
+        got = {
+            "decision": outcome.decision,
+            "node": outcome.node,
+            "bound": sorted(
+                [p.namespaced_name, n] for p, n in outcome.to_bind
+            ),
+            "victims": sorted(outcome.victims),
+        }
+        want = {
+            "decision": record["decision"],
+            "node": record.get("node", ""),
+            "bound": sorted(list(pair) for pair in record.get("bound", [])),
+            "victims": sorted(record.get("victims", [])),
+        }
+        if got != want:
+            report.drifts.append(
+                {
+                    "seq": record["seq"],
+                    "kind": "scheduler.cycle",
+                    "pod": record["pod"],
+                    "detail": f"recorded {want} but replay decided {got}",
+                }
+            )
+
+    def _replay_plan(self, record: dict, report: ReplayReport) -> None:
+        from nos_tpu.partitioning.core.partition_state import (
+            partitioning_state_equal,
+            partitioning_state_from_dict,
+            partitioning_state_to_dict,
+        )
+        from nos_tpu.partitioning.core.state import ClusterState
+
+        kind = record.get("partitioner_kind", "tpu")
+        planner = self._planners.get(kind)
+        if planner is None:
+            report.skips.append(
+                {
+                    "seq": record["seq"],
+                    "detail": f"unknown partitioner kind {kind!r}",
+                }
+            )
+            return
+        pending = []
+        for key in record.get("pending", []):
+            namespace, _, name = key.partition("/")
+            pod = self.store.try_get("Pod", name, namespace)
+            if pod is not None:
+                pending.append(pod)
+        report.plans += 1
+        snapshot = self._snapshot_taker(kind).take_snapshot(
+            ClusterState(), store=self.store
+        )
+        desired = planner.plan(
+            snapshot, pending, pending_ages=record.get("pending_ages", {})
+        )
+        recorded = partitioning_state_from_dict(record.get("desired", {}))
+        if not partitioning_state_equal(desired, recorded):
+            report.drifts.append(
+                {
+                    "seq": record["seq"],
+                    "kind": "planner.plan",
+                    "plan_id": record.get("plan_id", ""),
+                    "detail": (
+                        f"recorded desired {record.get('desired')} but replay "
+                        f"planned {partitioning_state_to_dict(desired)}"
+                    ),
+                }
+            )
+        violations = self.auditor.audit_plan(
+            planner, snapshot, exhaustive=True, revision=record.get("revision", 0)
+        )
+        report.violations.extend(v.to_dict() for v in violations)
+
+
+def replay_file(path: str) -> ReplayReport:
+    """Convenience wrapper: load a JSONL export and replay it."""
+    from nos_tpu.record.recorder import load_jsonl
+
+    return ReplaySession(load_jsonl(path)).run()
+
+
+def drift_exit_code(report: Optional[ReplayReport]) -> int:
+    return 0 if report is not None and report.ok() else 1

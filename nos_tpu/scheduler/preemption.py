@@ -193,6 +193,12 @@ class Preemptor:
         if best is None:
             return None
         node_name, victims = best
+        # Stash the victim list on the (persistent) capacity plugin — this
+        # Preemptor is per-cycle, but the flight recorder reads the victims
+        # after post_filter returns only the nominated node name.
+        self.plugin.last_victims = sorted(
+            v.namespaced_name for v in victims.pods
+        )
         for victim in victims.pods:
             log.info(
                 "preempting %s (node %s) for %s",

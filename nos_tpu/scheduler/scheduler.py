@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.kube.controller import Request, Result
@@ -74,6 +75,26 @@ def new_framework(
     return framework, capacity, gang
 
 
+@dataclass
+class CycleOutcome:
+    """One scheduling cycle's decision, separated from its application.
+
+    ``_decide`` produces it (mutating only in-memory bookkeeping plus the
+    preemption/reservation store writes); ``_apply_outcome`` performs the
+    bind/nominate/fail store writes and metrics. The flight recorder
+    captures the outcome between the two, and replay runs ``_decide``
+    alone — a no-write shadow of the recorded cycle.
+    """
+
+    decision: str  # bind | wait | nominate | fail
+    node: str = ""
+    to_bind: List[Tuple[Pod, str]] = field(default_factory=list)
+    victims: List[str] = field(default_factory=list)
+    diagnosis: Optional[Diagnosis] = None
+    message: str = ""
+    start: float = 0.0
+
+
 class Scheduler:
     def __init__(
         self,
@@ -84,6 +105,7 @@ class Scheduler:
         retry_seconds: float = 0.5,
         scheduler_name: str = "",
         recorder=None,
+        flight_recorder=None,
     ) -> None:
         self.store = store
         self.framework = framework
@@ -96,6 +118,9 @@ class Scheduler:
         self.recorder = recorder
         if capacity is not None and recorder is not None:
             capacity.recorder = recorder
+        # Optional record.FlightRecorder: one decision record per cycle,
+        # written between _decide and _apply_outcome.
+        self.flight_recorder = flight_recorder
         # Latest Diagnosis per pod, served by /debug/explain. Bounded:
         # oldest entry falls off so a churning cluster can't grow it.
         self._diagnoses: Dict[str, dict] = {}
@@ -174,7 +199,27 @@ class Scheduler:
         return result
 
     def _schedule_cycle(self, pod: Pod, cycle) -> Optional[Result]:
+        # Watermark BEFORE the cycle's own writes: replay applies deltas up
+        # to this revision, then re-decides — the cycle's writes are the
+        # decision's consequences, not its inputs.
+        revision = self.store.revision
+        outcome = self._decide(pod)
+        self._record_cycle(pod, revision, outcome)
+        return self._apply_outcome(pod, outcome)
+
+    def decide(self, pod: Pod) -> CycleOutcome:
+        """Replay entrypoint: the full decision pipeline without the
+        bind/nominate/fail store writes. In-memory bookkeeping (assume
+        cache, gang state) still mutates so a decision sequence replays the
+        way it recorded; preemption's victim deletes and the board
+        reservation's annotations also still write, converging with the
+        recorded deltas."""
+        return self._decide(pod)
+
+    def _decide(self, pod: Pod) -> CycleOutcome:
         start = time.monotonic()
+        if self.capacity is not None:
+            self.capacity.last_victims = []
         state = CycleState()
         # Published before ANY extension point: the PreFilter-failure
         # preemption path below also runs filter plugins (victim trials),
@@ -199,10 +244,19 @@ class Scheduler:
             filtered = {name: status for name in node_infos}
             nominated = self.framework.run_post_filter_plugins(state, pod, filtered)
             if nominated:
-                self._set_nominated(pod, nominated)
-                return Result(requeue_after=self.retry / 2)
-            self._fail_cycle(pod, self._diagnosis(pod, node_infos, filtered))
-            return Result(requeue_after=self.retry)
+                return CycleOutcome(
+                    "nominate",
+                    node=nominated,
+                    victims=self._last_victims(),
+                    start=start,
+                )
+            diagnosis = self._diagnosis(pod, node_infos, filtered)
+            return CycleOutcome(
+                "fail",
+                diagnosis=diagnosis,
+                message=diagnosis.aggregate_message(),
+                start=start,
+            )
 
         feasible: List[NodeInfo] = []
         filtered: Dict[str, Status] = {}
@@ -225,16 +279,24 @@ class Scheduler:
                 )
                 pf_span.set_attributes(nominated=nominated or "")
             if nominated:
-                self._set_nominated(pod, nominated)
-                # Victims are terminating; retry shortly.
-                return Result(requeue_after=self.retry / 2)
+                return CycleOutcome(
+                    "nominate",
+                    node=nominated,
+                    victims=self._last_victims(),
+                    start=start,
+                )
             if self.reservation is not None:
                 # Fragmentation-blocked full-board pod: reserve the node
                 # closest to draining so the board frees deterministically
                 # instead of by luck (no-op for sub-board requests).
                 self.reservation.try_reserve(pod, node_infos)
-            self._fail_cycle(pod, self._diagnosis(pod, node_infos, filtered))
-            return Result(requeue_after=self.retry)
+            diagnosis = self._diagnosis(pod, node_infos, filtered)
+            return CycleOutcome(
+                "fail",
+                diagnosis=diagnosis,
+                message=diagnosis.aggregate_message(),
+                start=start,
+            )
 
         with TRACER.span("scheduler.score", feasible=len(feasible)) as score_span:
             best = max(
@@ -248,10 +310,13 @@ class Scheduler:
         with TRACER.span("scheduler.reserve", node=best.name):
             status = self.framework.run_reserve_plugins(state, pod, best.name)
         if not status.success:
-            self._fail_cycle(
-                pod, self._diagnosis(pod, node_infos, {best.name: status})
+            diagnosis = self._diagnosis(pod, node_infos, {best.name: status})
+            return CycleOutcome(
+                "fail",
+                diagnosis=diagnosis,
+                message=diagnosis.aggregate_message(),
+                start=start,
             )
-            return Result(requeue_after=self.retry)
 
         with TRACER.span("scheduler.permit", node=best.name):
             permit = self.framework.run_permit_plugins(state, pod, best.name)
@@ -259,14 +324,18 @@ class Scheduler:
             # Gang forming: reservation held, pod stays pending but its
             # claim on the node must be visible to later cycles.
             self._assumed[pod.namespaced_name] = (pod, best.name)
-            log.info("scheduler: %s waiting (%s)", pod.namespaced_name, permit.message)
-            return Result(requeue_after=self.retry)
+            return CycleOutcome(
+                "wait", node=best.name, message=permit.message, start=start
+            )
         if not permit.success:
             self.framework.run_unreserve_plugins(state, pod, best.name)
-            self._fail_cycle(
-                pod, self._diagnosis(pod, node_infos, {best.name: permit})
+            diagnosis = self._diagnosis(pod, node_infos, {best.name: permit})
+            return CycleOutcome(
+                "fail",
+                diagnosis=diagnosis,
+                message=diagnosis.aggregate_message(),
+                start=start,
             )
-            return Result(requeue_after=self.retry)
 
         # Bind — and release any gang members waiting on this quorum.
         to_bind = [(pod, best.name)]
@@ -276,16 +345,61 @@ class Scheduler:
                 to_bind = released
                 if all(key[0].namespaced_name != pod.namespaced_name for key in released):
                     to_bind.append((pod, best.name))
-        with TRACER.span("scheduler.bind", pods=len(to_bind)):
-            for bind_pod, node_name in to_bind:
+        return CycleOutcome("bind", node=best.name, to_bind=to_bind, start=start)
+
+    def settle(self, outcome: CycleOutcome) -> None:
+        """Replay companion to decide(): the in-memory consequences of a
+        bind (assume-cache pop, capacity reservation release) without the
+        store writes — those arrive as recorded deltas."""
+        if outcome.decision != "bind":
+            return
+        for bind_pod, _ in outcome.to_bind:
+            self._assumed.pop(bind_pod.namespaced_name, None)
+            if self.capacity is not None:
+                self.capacity.forget(bind_pod)
+
+    def _last_victims(self) -> List[str]:
+        return list(getattr(self.capacity, "last_victims", None) or [])
+
+    def _record_cycle(self, pod: Pod, revision: int, outcome: CycleOutcome) -> None:
+        if self.flight_recorder is None:
+            return
+        root = TRACER.journey(("pod", pod.namespaced_name))
+        self.flight_recorder.record_scheduler_cycle(
+            pod=pod.namespaced_name,
+            revision=revision,
+            decision=outcome.decision,
+            node=outcome.node,
+            bound=[[p.namespaced_name, n] for p, n in outcome.to_bind],
+            victims=list(outcome.victims),
+            message=outcome.message,
+            trace_id=root.trace_id if root is not None else "",
+            diagnosis=outcome.diagnosis.to_dict() if outcome.diagnosis else None,
+        )
+
+    def _apply_outcome(self, pod: Pod, outcome: CycleOutcome) -> Optional[Result]:
+        if outcome.decision == "nominate":
+            self._set_nominated(pod, outcome.node)
+            # Victims are terminating; retry shortly.
+            return Result(requeue_after=self.retry / 2)
+        if outcome.decision == "fail":
+            self._fail_cycle(pod, outcome.diagnosis)
+            return Result(requeue_after=self.retry)
+        if outcome.decision == "wait":
+            log.info(
+                "scheduler: %s waiting (%s)", pod.namespaced_name, outcome.message
+            )
+            return Result(requeue_after=self.retry)
+        with TRACER.span("scheduler.bind", pods=len(outcome.to_bind)):
+            for bind_pod, node_name in outcome.to_bind:
                 self._assumed.pop(bind_pod.namespaced_name, None)
                 self._bind(bind_pod, node_name)
                 if self.reservation is not None:
                     self.reservation.release_for(bind_pod)
         metrics.SCHEDULE_LATENCY.labels(namespace=pod.metadata.namespace).observe(
-            time.monotonic() - start
+            time.monotonic() - outcome.start
         )
-        if self.gang is not None and len(to_bind) > 1:
+        if self.gang is not None and len(outcome.to_bind) > 1:
             metrics.GANGS_SCHEDULED.inc()
         return None
 

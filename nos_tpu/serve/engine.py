@@ -215,7 +215,6 @@ class Engine:
 
         self._n_adapters = n_adapters(params)
         self._adapter_rows = np.zeros(max_slots, np.int32)
-        self._decode_tree = None  # cached re-pointed tree; None = dirty
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._queue: List[GenRequest] = []
         self._done: List[Completion] = []
@@ -457,7 +456,17 @@ class Engine:
             horizons.append(budget)
         if not horizons:
             return 1
-        return min(horizons) if self._queue else max(horizons)
+        if self._queue:
+            return min(horizons)
+        if len(horizons) > 1:
+            # No queue: running to the LARGEST budget would have every
+            # shorter co-tenant riding (and discarding) decode chunks
+            # until the longest slot's horizon. Syncing at the
+            # second-largest budget retires the shorter slots at their
+            # own frontier; the longest slot just takes another round
+            # (same shape as the rolling 16-chunk cap above).
+            return sorted(horizons)[-2]
+        return horizons[0]
 
     # ---------------------------------------------------------- scheduling
 
@@ -501,7 +510,6 @@ class Engine:
             self._admission_params(request.adapter), padded
         )
         self._adapter_rows[b] = request.adapter
-        self._decode_tree = None
         self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))
         slot = _Slot(request=request)
         self._slots[b] = slot
@@ -559,7 +567,6 @@ class Engine:
             row_cache, prompt, n, resume,
         )
         self._adapter_rows[b] = request.adapter
-        self._decode_tree = None
         if self.prefix_cache_entries > 0:
             store_at = ((length - 1) // n) * n
             if store_at > 0:
@@ -786,4 +793,3 @@ class Engine:
             self._rope[b] = 0
             self._key_valid[b, :] = False
             self._adapter_rows[b] = 0
-            self._decode_tree = None

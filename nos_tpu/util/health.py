@@ -26,6 +26,7 @@ class HealthServer:
         metrics_token: "str | Callable[[], Optional[str]]" = "",
         metrics_loopback_port: Optional[int] = None,
         explain_fn: Optional[Callable[[str], Optional[dict]]] = None,
+        record_fn: Optional[Callable[[], list]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -34,6 +35,9 @@ class HealthServer:
         # for the pod (per-node per-plugin rejection ledger) as JSON; None
         # disables the endpoint (components without a scheduler).
         self.explain_fn = explain_fn
+        # /debug/record -> the flight recorder's in-memory ring (list of
+        # record dicts); None disables the endpoint (recording off).
+        self.record_fn = record_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -57,6 +61,7 @@ class HealthServer:
         ready_check = self.ready_check
         metrics_token = self.metrics_token
         explain_fn = self.explain_fn
+        record_fn = self.record_fn
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
 
@@ -129,6 +134,26 @@ class HealthServer:
                     self._respond(
                         200, json.dumps(diagnosis, indent=2), "application/json"
                     )
+                elif (
+                    path == "/debug/record"
+                    and serve_metrics
+                    and record_fn is not None
+                ):
+                    # Same credential as /metrics: decision records carry
+                    # pod names, namespaces, and full object deltas.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    records = record_fn()
+                    fmt = parse_qs(url.query).get("format", ["json"])[0]
+                    if fmt == "jsonl":
+                        # Directly consumable by `python -m nos_tpu replay`.
+                        body = "".join(json.dumps(r) + "\n" for r in records)
+                        self._respond(200, body, "application/x-ndjson")
+                    else:
+                        self._respond(
+                            200, json.dumps(records, indent=2), "application/json"
+                        )
                 elif path == "/debug/vars" and serve_metrics:
                     if not self._authorized():
                         self._respond(401, "unauthorized")

@@ -427,3 +427,11 @@ SERVE_QUEUE_DEPTH = REGISTRY.gauge(
 SERVE_SLOTS = REGISTRY.gauge(
     "nos_tpu_serve_slots", "Configured slot count (the occupancy denominator)"
 )
+
+# Flight recorder / invariant auditor (record/).
+AUDIT_VIOLATIONS = REGISTRY.counter(
+    "nos_tpu_audit_violations_total",
+    "Invariant-auditor checks whose shadow recompute disagreed with the "
+    "incremental structure (verdict cache, lacking totals, free pool, "
+    "mutation clock, carve-futility memo) (by check)",
+)

@@ -1,0 +1,168 @@
+"""Revision semantics the flight recorder depends on.
+
+The recorder's replay reconstructs cluster history by sorting recorded
+deltas on the resource version the store stamped, and pauses at each
+decision's revision watermark. That only works if (a) every write —
+including deletes — advances the revision and stamps it on the emitted
+object, (b) `apply_event` rebuilds a store that preserves the recorded
+versions, and (c) the sim apiserver's event log replays in the same
+stable revision order a live watch saw.
+"""
+import json
+import time
+import urllib.request
+
+from nos_tpu.kube import serde
+from nos_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.sim.apiserver import StubApiServer
+
+
+def make_pod(name, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests={"cpu": 1})]),
+    )
+
+
+class TestKubeStoreRevisions:
+    def test_every_write_kind_advances_revision(self):
+        s = KubeStore()
+        assert s.revision == 0
+        created = s.create(make_pod("p1"))
+        rv_create = created.metadata.resource_version
+        assert rv_create == s.revision > 0
+
+        created.metadata.labels["a"] = "b"
+        updated = s.update(created)
+        assert updated.metadata.resource_version > rv_create
+        assert s.revision == updated.metadata.resource_version
+
+        s.patch_labels("Pod", "p1", "default", {"c": "d"})
+        rv_patch = s.revision
+        assert rv_patch > updated.metadata.resource_version
+
+        s.delete("Pod", "p1", "default")
+        assert s.revision > rv_patch
+
+    def test_delete_stamps_revision_on_watch_event(self):
+        # A delete that did not bump would make the recorder's deltas
+        # unsortable: the DELETED event would carry the last write's rv.
+        s = KubeStore()
+        q = s.watch(["Pod"])
+        s.create(make_pod("p1"))
+        s.delete("Pod", "p1", "default")
+        added = q.get(timeout=2)
+        deleted = q.get(timeout=2)
+        assert added.type == "ADDED"
+        assert deleted.type == "DELETED"
+        assert (
+            deleted.object.metadata.resource_version
+            > added.object.metadata.resource_version
+        )
+
+    def test_revisions_strictly_monotonic_across_objects(self):
+        s = KubeStore()
+        seen = []
+        for i in range(5):
+            seen.append(s.create(make_pod(f"p{i}")).metadata.resource_version)
+            s.delete("Pod", f"p{i}", "default")
+            seen.append(s.revision)
+        assert seen == sorted(seen)
+        assert len(set(seen)) == len(seen)
+
+    def test_apply_event_preserves_recorded_versions(self):
+        live = KubeStore()
+        events = []
+        q = live.watch(["Pod"])
+        live.create(make_pod("p1"))
+        p = live.get("Pod", "p1", "default")
+        p.metadata.labels["x"] = "y"
+        live.update(p)
+        live.create(make_pod("p2"))
+        live.delete("Pod", "p1", "default")
+        for _ in range(4):
+            events.append(q.get(timeout=2))
+
+        replayed = KubeStore()
+        for e in events:
+            replayed.apply_event(e.type, e.object)
+        assert replayed.try_get("Pod", "p1", "default") is None
+        survivor = replayed.get("Pod", "p2", "default")
+        assert (
+            survivor.metadata.resource_version
+            == live.get("Pod", "p2", "default").metadata.resource_version
+        )
+        # The replayed store's clock catches up to the last applied rv so
+        # post-replay writes keep advancing past the recorded history.
+        assert replayed.revision == max(
+            e.object.metadata.resource_version for e in events
+        )
+
+    def test_apply_event_is_idempotent(self):
+        s = KubeStore()
+        q = s.watch(["Pod"])
+        s.create(make_pod("p1"))
+        event = q.get(timeout=2)
+        replayed = KubeStore()
+        replayed.apply_event(event.type, event.object)
+        replayed.apply_event(event.type, event.object)
+        assert len(replayed.list("Pod")) == 1
+        assert replayed.revision == event.object.metadata.resource_version
+
+
+class TestStubApiServerRevisions:
+    def _client_write(self, server, method, path, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            server.url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    def test_monotonic_across_create_update_delete(self):
+        with StubApiServer() as server:
+            path = serde.resource_path("Pod", "default")
+            wire = serde.to_wire(make_pod("p1"))
+            created = self._client_write(server, "POST", path, wire)
+            rv1 = int(created["metadata"]["resourceVersion"])
+            created["metadata"]["labels"] = {"a": "b"}
+            updated = self._client_write(
+                server, "PUT", serde.resource_path("Pod", "default", "p1"), created
+            )
+            rv2 = int(updated["metadata"]["resourceVersion"])
+            deleted = self._client_write(
+                server, "DELETE", serde.resource_path("Pod", "default", "p1")
+            )
+            rv3 = int(deleted["metadata"]["resourceVersion"])
+            assert rv1 < rv2 < rv3
+
+    def test_event_log_replays_in_stable_revision_order(self):
+        # The recorder sorts deltas by revision; the sim apiserver's watch
+        # must hand history back in that same order however many times it
+        # is replayed from rv=0.
+        with StubApiServer() as server:
+            path = serde.resource_path("Pod", "default")
+            for i in range(4):
+                self._client_write(
+                    server, "POST", path, serde.to_wire(make_pod(f"p{i}"))
+                )
+            self._client_write(
+                server, "DELETE", serde.resource_path("Pod", "default", "p1")
+            )
+            time.sleep(0.05)
+            rvs = [rv for rv, _, plural, _ in server.state.events if plural == "pods"]
+            assert rvs == sorted(rvs)
+            assert len(set(rvs)) == len(rvs)
+            # Two replays from scratch see identical (rv, type, name) runs.
+            def replay():
+                return [
+                    (rv, et, o["metadata"]["name"])
+                    for rv, et, plural, o in server.state.events
+                    if plural == "pods" and rv > 0
+                ]
+
+            assert replay() == replay()

@@ -2,8 +2,9 @@
 
 The converter maps block_sparse_moe (router gate + per-expert w1/w3/w2)
 onto this stack's stacked-expert MoE layer. Routing math differs only
-syntactically (Mixtral: top-k then softmax; here: softmax then top-k
-renormalize — identical by monotonicity), so logits must match torch to
+syntactically (mistral-inference: top-k then softmax; HF transformers
+and this stack: softmax then top-k renormalize — identical by
+monotonicity), so logits must match torch to
 float tolerance WHEN no expert overflows — parity runs with a generous
 capacity factor (static capacity is this stack's own TPU discipline;
 torch gathers densely).

@@ -1,0 +1,135 @@
+"""Invariant auditor: a clean plan audits clean; a deliberately poisoned
+verdict-cache entry is flagged by exactly the verdict_cache check; the
+live sampling stride is deterministic."""
+import random
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.partitioning.core import ClusterSnapshot, Planner, SnapshotNode
+from nos_tpu.record.audit import InvariantAuditor, build_auditor
+from nos_tpu.scheduler.framework import (
+    Framework,
+    NodeAffinityFit,
+    NodeResourcesFit,
+    NodeSelectorFit,
+    TaintTolerationFit,
+)
+from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+def build_snapshot(n=4):
+    rng = random.Random(42)
+    nodes = {}
+    for i in range(n):
+        style = rng.random()
+        if style < 0.5:
+            annotations = None
+        else:
+            annotations = annot.status_from_devices(
+                free={0: {"2x2": 1}}, used={}
+            )
+        node = build_tpu_node(name=f"n{i}", annotations=annotations)
+        nodes[f"n{i}"] = SnapshotNode(partitionable=TpuNode(node))
+    return ClusterSnapshot(nodes)
+
+
+def build_planner():
+    return Planner(
+        Framework(
+            filter_plugins=[
+                NodeResourcesFit(),
+                NodeSelectorFit(),
+                NodeAffinityFit(),
+                TaintTolerationFit(),
+            ]
+        )
+    )
+
+
+def planned(planner, snapshot, n_pods=6):
+    planner.plan(
+        snapshot,
+        [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(n_pods)],
+    )
+
+
+class TestCleanPlan:
+    def test_no_violations_on_untampered_state(self):
+        snapshot = build_snapshot()
+        planner = build_planner()
+        planned(planner, snapshot)
+        auditor = InvariantAuditor(sample_rate=1.0)
+        assert auditor.audit_plan(planner, snapshot, exhaustive=True) == []
+        assert auditor.violations_total == 0
+
+
+class TestPoisonedVerdictCache:
+    def _poison_one_live_entry(self, planner, snapshot):
+        """Insert (or flip) a verdict-cache entry keyed at a node's CURRENT
+        version — the only kind of entry a future trial could consult."""
+        node_name = sorted(snapshot.get_nodes())[0]
+        pod = build_pod("poison-probe", {slice_res("1x1"): 1})
+        # Route one probe through the cache layer so the entry and its
+        # signature's sim pod both exist, then flip the verdict.
+        planner._can_schedule(snapshot, node_name, pod)
+        node = snapshot.get_nodes()[node_name]
+        for key in list(planner._verdict_cache.entries):
+            signature, name, version = key
+            if name == node_name and version == node.version:
+                planner._verdict_cache.entries[key] = (
+                    not planner._verdict_cache.entries[key]
+                )
+                return key
+        pytest.fail("no live verdict-cache entry to poison")
+
+    def test_flags_exactly_the_verdict_cache_check(self):
+        snapshot = build_snapshot()
+        planner = build_planner()
+        planned(planner, snapshot)
+        auditor = InvariantAuditor(sample_rate=1.0)
+        assert auditor.audit_plan(planner, snapshot, exhaustive=True) == []
+
+        poisoned_key = self._poison_one_live_entry(planner, snapshot)
+        violations = auditor.audit_plan(planner, snapshot, exhaustive=True)
+        assert violations, "poisoned entry went undetected"
+        assert {v.check for v in violations} == {"verdict_cache"}
+        assert all(v.node == poisoned_key[1] for v in violations)
+        assert auditor.violations_total == len(violations)
+
+    def test_stale_version_entries_are_skipped(self):
+        # An entry keyed at a version the node has moved past is
+        # unreachable — poisoning it must NOT fire the auditor.
+        snapshot = build_snapshot()
+        planner = build_planner()
+        planned(planner, snapshot)
+        node_name = sorted(snapshot.get_nodes())[0]
+        node = snapshot.get_nodes()[node_name]
+        pod = build_pod("stale-probe", {slice_res("1x1"): 1})
+        planner._can_schedule(snapshot, node_name, pod)
+        signature = planner._sim_pod_cache[(id(pod), "tpu-v5-lite-podslice")][2]
+        planner._verdict_cache.entries[(signature, node_name, node.version + 999)] = (
+            False
+        )
+        auditor = InvariantAuditor(sample_rate=1.0)
+        assert auditor.check_verdict_cache(planner, snapshot, exhaustive=True) == []
+
+
+class TestSampling:
+    def test_zero_rate_builds_no_auditor(self):
+        assert build_auditor(sample_rate=0.0) is None
+        assert build_auditor(sample_rate=0.5) is not None
+
+    def test_counter_stride_density_and_determinism(self):
+        a = InvariantAuditor(sample_rate=0.25)
+        b = InvariantAuditor(sample_rate=0.25)
+        decisions_a = [a.should_audit() for _ in range(100)]
+        decisions_b = [b.should_audit() for _ in range(100)]
+        assert decisions_a == decisions_b  # replay sees identical sampling
+        assert sum(decisions_a) == 25
+
+    def test_full_rate_audits_every_plan(self):
+        a = InvariantAuditor(sample_rate=1.0)
+        assert all(a.should_audit() for _ in range(10))

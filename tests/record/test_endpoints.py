@@ -1,0 +1,97 @@
+"""/debug/record endpoint and quota-reconcile recording."""
+import http.client
+import json
+
+from nos_tpu.controllers.elasticquota import ElasticQuotaReconciler
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.record import FlightRecorder
+from nos_tpu.util.health import HealthServer
+
+
+def _get(port, path, token=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode()
+
+
+class TestDebugRecordEndpoint:
+    def test_serves_ring_json_and_jsonl(self):
+        fr = FlightRecorder()
+        fr.record_scheduler_cycle(pod="default/p1", revision=1, decision="bind", node="n1")
+        server = HealthServer(port=0, record_fn=fr.records)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/record")
+            assert status == 200
+            records = json.loads(body)
+            assert records[0]["kind"] == "session.start"
+            assert records[1]["decision"] == "bind"
+
+            status, body = _get(port, "/debug/record?format=jsonl")
+            assert status == 200
+            lines = [json.loads(line) for line in body.splitlines() if line]
+            assert lines == records  # same ring, replay-ready framing
+        finally:
+            server.stop()
+
+    def test_shares_the_metrics_bearer_gate(self):
+        fr = FlightRecorder()
+        server = HealthServer(port=0, metrics_token="s3cret", record_fn=fr.records)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/record")[0] == 401
+            assert _get(port, "/debug/record", "wrong")[0] == 401
+            assert _get(port, "/debug/record", "s3cret")[0] == 200
+        finally:
+            server.stop()
+
+    def test_404_when_recording_is_off(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/record")[0] == 404
+        finally:
+            server.stop()
+
+
+class TestQuotaReconcileRecording:
+    def test_reconcile_emits_decision_record_with_flips(self):
+        from tests.factory import build_pod
+        from nos_tpu.api.v1alpha1.constants import RESOURCE_TPU_CHIPS
+        from nos_tpu.api.v1alpha1.elasticquota import (
+            ElasticQuota,
+            ElasticQuotaSpec,
+        )
+        from nos_tpu.kube.objects import ObjectMeta, PodPhase
+
+        store = KubeStore()
+        fr = FlightRecorder()
+        store.create(
+            ElasticQuota(
+                metadata=ObjectMeta(name="q", namespace="default"),
+                spec=ElasticQuotaSpec(min={RESOURCE_TPU_CHIPS: 4}),
+            )
+        )
+        store.create(
+            build_pod("in-quota", {RESOURCE_TPU_CHIPS: 4}, phase=PodPhase.RUNNING)
+        )
+        store.create(
+            build_pod("over-quota", {RESOURCE_TPU_CHIPS: 4}, phase=PodPhase.RUNNING)
+        )
+        reconciler = ElasticQuotaReconciler(store, flight_recorder=fr)
+        reconciler.reconcile(Request(name="q", namespace="default"))
+
+        records = [r for r in fr.records() if r["kind"] == "quota.reconcile"]
+        assert len(records) == 1
+        record = records[0]
+        assert record["quota"] == "default/q"
+        # used accumulates every running pod's request (the over-quota pod
+        # is labeled, not excluded) — 4 + 4.
+        assert record["used"] == {RESOURCE_TPU_CHIPS: 8}
+        flipped = dict(record["flips"])
+        assert set(flipped) == {"default/in-quota", "default/over-quota"}
+        # The watermark precedes the reconcile's own label writes.
+        assert record["revision"] <= store.revision

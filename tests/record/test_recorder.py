@@ -1,0 +1,120 @@
+"""FlightRecorder unit behavior: ring bound, session meta, delta capture,
+JSONL round-trip."""
+import threading
+
+from nos_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.record import FlightRecorder
+from nos_tpu.record.recorder import load_jsonl
+
+
+def make_pod(name, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests={"cpu": 1})]),
+    )
+
+
+class TestRing:
+    def test_capacity_bounds_the_ring(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(50):
+            fr.record_scheduler_cycle(
+                pod=f"default/p{i}", revision=i, decision="fail"
+            )
+        records = fr.records()
+        assert len(records) == 8
+        # Oldest records (including session.start) were evicted; the tail
+        # survives in order.
+        assert [r["pod"] for r in records] == [f"default/p{i}" for i in range(42, 50)]
+
+    def test_seq_strictly_increasing(self):
+        fr = FlightRecorder(capacity=16)
+        for i in range(5):
+            fr.record_actuation(kind="tpu", plan_id=str(i), revision=i, applied=0)
+        seqs = [r["seq"] for r in fr.records()]
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs)
+
+
+class TestSessionMeta:
+    def test_meta_folds_into_session_start(self):
+        fr = FlightRecorder(seed=7)
+        fr.record_session_meta(scheduler_name="nos", gang_timeout_seconds=3.0)
+        fr.record_session_meta(aging_chips_per_second=2.0)
+        start = fr.records()[0]
+        assert start["kind"] == "session.start"
+        assert start["seed"] == 7
+        assert start["scheduler_name"] == "nos"
+        assert start["gang_timeout_seconds"] == 3.0
+        assert start["aging_chips_per_second"] == 2.0
+
+
+class TestDeltaCapture:
+    def test_attach_records_store_writes_with_revisions(self):
+        fr = FlightRecorder()
+        store = KubeStore()
+        fr.attach(store)
+        try:
+            store.create(make_pod("p1"))
+            p = store.get("Pod", "p1", "default")
+            p.status.phase = "Running"
+            store.update(p)
+            store.delete("Pod", "p1", "default")
+        finally:
+            fr.detach()
+        deltas = [r for r in fr.records() if r["kind"] == "delta"]
+        assert [d["type"] for d in deltas] == ["ADDED", "MODIFIED", "DELETED"]
+        revisions = [d["revision"] for d in deltas]
+        assert revisions == sorted(revisions)
+        assert len(set(revisions)) == len(revisions)
+        assert deltas[0]["object"]["metadata"]["name"] == "p1"
+
+    def test_detach_drains_pending_events(self):
+        fr = FlightRecorder()
+        store = KubeStore()
+        fr.attach(store)
+        barrier = threading.Barrier(2)
+
+        def writer():
+            barrier.wait()
+            for i in range(20):
+                store.create(make_pod(f"w{i}"))
+
+        t = threading.Thread(target=writer)
+        t.start()
+        barrier.wait()
+        t.join()
+        fr.detach()
+        deltas = [r for r in fr.records() if r["kind"] == "delta"]
+        assert len(deltas) == 20
+
+
+class TestJsonl:
+    def test_export_load_round_trip(self, tmp_path):
+        fr = FlightRecorder()
+        fr.record_scheduler_cycle(
+            pod="default/p1",
+            revision=3,
+            decision="bind",
+            node="n1",
+            bound=[["default/p1", "n1"]],
+        )
+        fr.record_plan(
+            kind="tpu",
+            revision=4,
+            pending=["default/p1"],
+            pending_ages={"default/p1": 1.5},
+            plan_id="42-1",
+            desired={"n1": {"0": {"2x4": 1}}},
+            unserved={},
+            applied=1,
+        )
+        path = tmp_path / "rec.jsonl"
+        count = fr.export_jsonl(str(path))
+        loaded = load_jsonl(str(path))
+        assert count == len(loaded) == 3  # session.start + 2
+        assert loaded == fr.records()
+        assert loaded[1]["decision"] == "bind"
+        assert loaded[2]["partitioner_kind"] == "tpu"
+        assert loaded[2]["pending_ages"] == {"default/p1": 1.5}

@@ -1,0 +1,111 @@
+"""Record → replay round trip: an untampered recording replays with zero
+decision drift and zero audit violations; perturbing a recorded input
+produces a nonzero drift diff."""
+import copy
+import time
+
+import pytest
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.cmd.cluster import build_cluster
+from nos_tpu.cmd.run import seed_node, seed_pod
+from nos_tpu.record import FlightRecorder, ReplaySession
+from nos_tpu.record.replay import drift_exit_code
+
+
+def record_session():
+    """Run a short sim-cluster session under the recorder: one 8-chip node,
+    two 4-chip pods — one carve plan, two binds."""
+    fr = FlightRecorder()
+    cluster = build_cluster(
+        partitioner_config=GpuPartitionerConfig(
+            batch_window_timeout_seconds=1.0,
+            batch_window_idle_seconds=0.05,
+            audit_sample_rate=1.0,
+        ),
+        scheduler_config=SchedulerConfig(retry_seconds=0.2),
+        flight_recorder=fr,
+    )
+    fr.attach(cluster.store)
+    agent_cfg = TpuAgentConfig(report_config_interval_seconds=0.2)
+    cluster.add_tpu_node(
+        seed_node({"name": "node-1", "chips": 8, "topology": "2x4"}), agent_cfg
+    )
+    cluster.store.create(seed_pod({"name": "w1", "chips": 4}))
+    cluster.store.create(seed_pod({"name": "w2", "chips": 4}))
+    cluster.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pods = cluster.store.list("Pod")
+        if pods and all(
+            p.spec.node_name and p.status.phase == "Running" for p in pods
+        ):
+            break
+        time.sleep(0.2)
+    cluster.wait_idle(10)
+    cluster.stop()
+    fr.detach()
+    pods = cluster.store.list("Pod")
+    assert all(p.spec.node_name for p in pods), "session never bound its pods"
+    return fr.records()
+
+
+@pytest.fixture(scope="module")
+def recording():
+    return record_session()
+
+
+class TestFaithfulReplay:
+    def test_zero_drift_zero_violations(self, recording):
+        kinds = {r["kind"] for r in recording}
+        assert "scheduler.cycle" in kinds and "planner.plan" in kinds
+        report = ReplaySession(copy.deepcopy(recording)).run()
+        assert report.cycles > 0 and report.plans > 0
+        assert report.drifts == [], report.render()
+        assert report.violations == [], report.render()
+        assert report.ok()
+        assert drift_exit_code(report) == 0
+
+    def test_replay_is_itself_deterministic(self, recording):
+        first = ReplaySession(copy.deepcopy(recording)).run()
+        second = ReplaySession(copy.deepcopy(recording)).run()
+        assert first.drifts == second.drifts
+        assert first.violations == second.violations
+        assert (first.cycles, first.plans) == (second.cycles, second.plans)
+
+
+class TestPerturbedReplay:
+    def test_shrunken_node_produces_decision_drift(self, recording):
+        # Strip the TPU capacity out of every recorded Node delta: the
+        # replayed scheduler/planner now see a chipless cluster, so the
+        # recorded binds and carve plan cannot reproduce.
+        records = copy.deepcopy(recording)
+        perturbed = 0
+        for r in records:
+            if r.get("kind") == "delta" and r["object"].get("kind") == "Node":
+                status = r["object"].setdefault("status", {})
+                for field in ("capacity", "allocatable"):
+                    status[field] = {"cpu": "8", "memory": "128"}
+                annotations = r["object"].get("metadata", {}).get("annotations")
+                if annotations:
+                    # Drop reported slice status too, or the replayed
+                    # snapshot still sees free boards.
+                    r["object"]["metadata"]["annotations"] = {}
+                perturbed += 1
+        assert perturbed > 0, "recording held no node deltas to perturb"
+        report = ReplaySession(records).run()
+        assert report.drifts, report.render()
+        assert not report.ok()
+        assert drift_exit_code(report) == 1
+
+    def test_flipped_recorded_decision_is_drift(self, recording):
+        records = copy.deepcopy(recording)
+        cycle = next(r for r in records if r["kind"] == "scheduler.cycle")
+        cycle["decision"] = "fail" if cycle["decision"] != "fail" else "bind"
+        cycle["node"] = ""
+        cycle["bound"] = []
+        report = ReplaySession(records).run()
+        assert any(
+            d["kind"] == "scheduler.cycle" and d["seq"] == cycle["seq"]
+            for d in report.drifts
+        ), report.render()

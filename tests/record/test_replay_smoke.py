@@ -1,0 +1,60 @@
+"""The `make replay-smoke` loop: record a short sim run through the real
+`run` CLI, replay it through the real `replay` CLI, and require zero
+decision drift and zero audit violations (exit code 0)."""
+import time
+
+from nos_tpu.cmd.replay import main as replay_main
+from nos_tpu.cmd.run import main as run_main
+from nos_tpu.record.recorder import load_jsonl
+
+CONFIG = """
+partitioner:
+  batchWindowTimeoutSeconds: 1.0
+  batchWindowIdleSeconds: 0.05
+  auditSampleRate: 1.0
+scheduler:
+  retrySeconds: 0.2
+agent:
+  reportConfigIntervalSeconds: 0.2
+nodes:
+  - name: smoke-node
+    chips: 8
+    topology: 2x4
+pods:
+  - name: smoke-w1
+    chips: 4
+  - name: smoke-w2
+    chips: 4
+"""
+
+
+def test_record_then_replay_exits_zero(tmp_path, capsys):
+    cfg = tmp_path / "smoke.yaml"
+    cfg.write_text(CONFIG)
+    record = tmp_path / "smoke-record.jsonl"
+
+    start = time.monotonic()
+    rc = run_main(
+        [
+            "--config",
+            str(cfg),
+            "--record",
+            str(record),
+            "--run-seconds",
+            "6",
+            "--health-port",
+            "0",
+        ]
+    )
+    assert rc == 0
+    assert time.monotonic() - start < 60
+
+    records = load_jsonl(str(record))
+    kinds = {r["kind"] for r in records}
+    assert "scheduler.cycle" in kinds, f"no decisions recorded: {sorted(kinds)}"
+    assert "planner.plan" in kinds, f"no plans recorded: {sorted(kinds)}"
+
+    rc = replay_main([str(record)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 drift(s), 0 audit violation(s)" in out
