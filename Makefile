@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke incluster-e2e kind-e2e bench bench-planner examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos incluster-e2e kind-e2e bench bench-planner examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -35,6 +35,22 @@ test-integration:
 # violations. Non-slow — tier-1 exercises the full loop.
 replay-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/record/test_replay_smoke.py -q
+
+# Chaos tier-1 gate: one fixed seed through the full suite under fault
+# injection — must converge, replay clean, and fire a byte-identical
+# fault schedule every run. Plus the committed regression fixtures.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/chaos -q -m 'not slow'
+	JAX_PLATFORMS=cpu $(PY) -m nos_tpu chaos --seed 7 --bursts 2 --nodes 2 \
+	    --burst-seconds 0.4 --timeout 30 --backend memory
+
+# Slow soak: many seeds on both backends (see tests/chaos/test_sweep.py),
+# then a wide memory sweep via the CLI. Each seed must converge with zero
+# oracle violations and replay with zero drift.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/chaos -q
+	JAX_PLATFORMS=cpu $(PY) -m nos_tpu chaos --seed 0 --sweep 50 --bursts 2 \
+	    --burst-seconds 0.4 --timeout 30 --backend memory --no-minimize
 
 # Hardware-free in-cluster dry run: real component processes against the
 # sim apiserver over HTTP (see hack/kind/README.md for the real-kind tier).

@@ -17,6 +17,7 @@ def main() -> int:
         "sharingagent": "per-node sharing reporter daemon (NODE_NAME)",
         "export-metrics": "one-shot installation telemetry snapshot",
         "replay": "deterministic offline replay of a flight-recorder log",
+        "chaos": "seeded fault injection with convergence oracles",
         "bench": "the utilization benchmark",
     }
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
@@ -42,6 +43,10 @@ def main() -> int:
         from nos_tpu.cmd.replay import main as replay_main
 
         return replay_main(argv)
+    if command == "chaos":
+        from nos_tpu.cmd.chaos import main as chaos_main
+
+        return chaos_main(argv)
     if command == "bench":
         import os
 

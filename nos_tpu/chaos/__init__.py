@@ -1,0 +1,22 @@
+"""Deterministic chaos harness for the full suite.
+
+``build_schedule`` turns a seed into a reproducible fault schedule;
+``ChaosDriver`` runs the suite against it and asserts convergence after
+every burst; ``minimize`` shrinks a failing run's flight-recorder log to
+a minimal regression fixture.
+"""
+from nos_tpu.chaos.driver import ChaosConfig, ChaosDriver, ChaosReport
+from nos_tpu.chaos.faults import Burst, Fault, FaultInjector, build_schedule
+from nos_tpu.chaos.minimize import ddmin, failure_signature
+
+__all__ = [
+    "Burst",
+    "ChaosConfig",
+    "ChaosDriver",
+    "ChaosReport",
+    "Fault",
+    "FaultInjector",
+    "build_schedule",
+    "ddmin",
+    "failure_signature",
+]

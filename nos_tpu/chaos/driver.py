@@ -1,0 +1,536 @@
+"""ChaosDriver: run the full suite under a seeded fault schedule and
+prove it heals.
+
+Per burst: seed workload pods, fire the burst's faults along their
+scheduled offsets, heal everything the schedule broke, then poll the
+convergence oracles until they all pass or the deadline expires. After
+the last burst the whole run's flight-recorder log is replayed offline —
+zero drift and zero audit violations is itself an oracle. On any
+failure, the ddmin minimizer (nos_tpu/chaos/minimize.py) shrinks the log
+to a committable regression fixture.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.chaos import faults as F
+from nos_tpu.chaos import oracles
+from nos_tpu.chaos.faults import Burst, FaultInjector, build_schedule
+from nos_tpu.kube.leaderelection import LeaderElector
+from nos_tpu.kube.store import AlreadyExistsError, NotFoundError
+from nos_tpu.util import metrics
+
+log = logging.getLogger("nos_tpu.chaos")
+
+LEASE_NAME = "chaos-leader-lease"
+QUOTA_NAME = "chaos-quota"
+QUOTA_NAMESPACE = "default"
+
+
+@dataclass
+class ChaosConfig:
+    seed: int = 0
+    bursts: int = 3
+    nodes: int = 3
+    backend: str = "memory"  # "memory" | "apiserver"
+    burst_s: float = 2.0
+    convergence_timeout_s: float = 30.0
+    recorder_capacity: int = 65536
+    minimize: bool = True
+    fixtures_dir: str = ""  # minimized repro lands here on failure
+    export_path: str = ""   # full log always exported here when set
+
+
+@dataclass
+class BurstResult:
+    index: int
+    faults: List[str]
+    converged: bool
+    convergence_s: float
+    violations: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    backend: str
+    bursts: List[BurstResult] = field(default_factory=list)
+    replay_ok: bool = True
+    replay_summary: str = ""
+    fault_counts: Dict[str, int] = field(default_factory=dict)
+    fixture_path: str = ""
+    records: int = 0
+
+    def ok(self) -> bool:
+        return self.replay_ok and all(b.converged for b in self.bursts)
+
+    def render(self) -> str:
+        lines = [
+            f"chaos seed={self.seed} backend={self.backend}: "
+            f"{len(self.bursts)} burst(s), faults={self.fault_counts}"
+        ]
+        for b in self.bursts:
+            status = (
+                f"converged in {b.convergence_s:.2f}s"
+                if b.converged
+                else f"FAILED to converge ({len(b.violations)} violation(s))"
+            )
+            lines.append(f"  burst {b.index} [{', '.join(b.faults)}]: {status}")
+            for v in b.violations[:8]:
+                lines.append(f"    {v}")
+        lines.append(
+            f"  replay: {'clean' if self.replay_ok else 'FAILED'}"
+            + (f" — {self.replay_summary}" if self.replay_summary else "")
+        )
+        if self.fixture_path:
+            lines.append(f"  minimized fixture: {self.fixture_path}")
+        return "\n".join(lines)
+
+
+class ChaosDriver:
+    def __init__(self, config: Optional[ChaosConfig] = None) -> None:
+        self.config = config or ChaosConfig()
+        self.injector = FaultInjector()
+        self.node_names = [f"chaos-node-{i}" for i in range(self.config.nodes)]
+        self.schedule: List[Burst] = build_schedule(
+            self.config.seed,
+            self.config.bursts,
+            self.node_names,
+            backend=self.config.backend,
+            burst_s=self.config.burst_s,
+        )
+        self._dead_nodes: Dict[str, object] = {}
+        self._cordoned: List[str] = []
+        self._quota_flapped = False
+        self._leader_overlap: List[str] = []
+
+    # ------------------------------------------------------------ plumbing
+
+    def _robust(self, fn, attempts: int = 8, delay: float = 0.05):
+        """Driver-internal store operation: suspended from memory-backend
+        injection, retried through apiserver-backend injected 503s (the
+        HTTP seam cannot see the driver's thread-local suspension)."""
+        last: Optional[Exception] = None
+        for _ in range(attempts):
+            try:
+                with self.injector.suspended():
+                    return fn()
+            except (NotFoundError, AlreadyExistsError):
+                raise
+            except Exception as e:  # noqa: BLE001 — injected fault classes vary
+                last = e
+                time.sleep(delay)
+        raise last  # type: ignore[misc]
+
+    # -------------------------------------------------------------- setup
+
+    def _build(self):
+        from nos_tpu.cmd.cluster import build_cluster
+        from nos_tpu.record import FlightRecorder
+
+        self.recorder = FlightRecorder(
+            capacity=self.config.recorder_capacity, seed=self.config.seed
+        )
+        self.api = None
+        store = None
+        if self.config.backend == "apiserver":
+            from nos_tpu.kube.apiclient import ClusterCredentials, KubeApiClient
+            from nos_tpu.kube.apistore import KubeApiStore
+            from nos_tpu.sim.apiserver import StubApiServer
+
+            self.api = StubApiServer().start()
+            store = KubeApiStore(
+                KubeApiClient(ClusterCredentials(server=self.api.url), timeout=5.0),
+                relist_backoff_s=1.0,
+                backoff_seed=self.config.seed,
+            )
+            store.start(sync_timeout_s=15.0)
+        self.cluster = build_cluster(
+            store=store,
+            partitioner_config=GpuPartitionerConfig(
+                batch_window_timeout_seconds=0.3, batch_window_idle_seconds=0.05
+            ),
+            scheduler_config=SchedulerConfig(retry_seconds=0.1),
+            flight_recorder=self.recorder,
+        )
+        self.store = self.cluster.store
+        # Arm the injection seams (both disarmed until a burst sets rates).
+        if self.api is not None:
+            self.api.set_fault_injector(self.injector)
+        else:
+            self.store.fault_injector = self.injector
+        # Deltas from here on: nodes, quota, and all traffic get recorded.
+        self.recorder.attach(self.store)
+        agent_cfg = TpuAgentConfig(report_config_interval_seconds=0.3)
+        from nos_tpu.cmd.run import seed_node
+
+        for name in self.node_names:
+            self.cluster.add_tpu_node(seed_node({"name": name}), agent_cfg)
+        self._create_quota()
+        self._start_electors()
+        self.cluster.start()
+
+    def _create_quota(self) -> None:
+        from nos_tpu.api.v1alpha1.elasticquota import (
+            ElasticQuota,
+            ElasticQuotaSpec,
+        )
+        from nos_tpu.kube.objects import ObjectMeta
+
+        chips = self.config.nodes * 8
+        quota = ElasticQuota(
+            metadata=ObjectMeta(name=QUOTA_NAME, namespace=QUOTA_NAMESPACE),
+            spec=ElasticQuotaSpec(
+                min={constants.RESOURCE_TPU: chips},
+                max={constants.RESOURCE_TPU: chips},
+            ),
+        )
+        self._robust(lambda: self.store.create(quota))
+
+    def _start_electors(self) -> None:
+        """Two contenders on a chaos-owned lease: the leader-flap fault
+        drops the current holder; a monitor thread asserts mutual
+        exclusion the whole run (two leaders at once is a failed oracle,
+        whatever the fault mix did to the lease ConfigMap)."""
+        self.electors = [
+            LeaderElector(
+                self.store,
+                LEASE_NAME,
+                identity,
+                lease_duration_s=1.0,
+                renew_period_s=0.2,
+            )
+            for identity in ("chaos-elector-a", "chaos-elector-b")
+        ]
+        self._monitor_stop = threading.Event()
+
+        def monitor() -> None:
+            while not self._monitor_stop.is_set():
+                if all(e.is_leader for e in self.electors):
+                    self._leader_overlap.append(
+                        "leader-overlap: both contenders held the lease "
+                        f"simultaneously at monotonic {time.monotonic():.3f}"
+                    )
+                time.sleep(0.005)
+
+        self._monitor = threading.Thread(
+            target=monitor, name="chaos-leader-monitor", daemon=True
+        )
+        for elector in self.electors:
+            elector.start()
+        self._monitor.start()
+
+    # -------------------------------------------------------------- faults
+
+    def _apply_fault(self, burst: Burst, fault: F.Fault) -> None:
+        kind = fault.kind
+        if kind == F.CONFLICT_WRITES:
+            self.injector.arm_conflicts(int(fault.param))
+        elif kind == F.API_ERRORS:
+            self.injector.arm_errors(int(fault.param))
+        elif kind == F.API_LATENCY:
+            self.injector.arm_latency(fault.param)
+        elif kind == F.WATCH_SEVER:
+            self.injector.arm_sever(int(fault.param))
+        elif kind == F.NODE_DEATH:
+            self._kill_node(fault.target)
+        elif kind == F.NODE_CORDON_FLAP:
+            self._cordon(fault.target)
+        elif kind == F.AGENT_RESTART:
+            self._arm_agent_restart(burst, fault.target)
+        elif kind == F.QUOTA_FLAP:
+            self._flap_quota()
+        elif kind == F.LEADER_FLAP:
+            self._flap_leader()
+
+    def _kill_node(self, name: str) -> None:
+        if name in self._dead_nodes:
+            return
+        node = self.store.try_get("Node", name)
+        if node is None:
+            return
+        self._dead_nodes[name] = node
+        # Eviction: pods on the node die with it.
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name == name:
+                try:
+                    self._robust(
+                        lambda p=pod: self.store.delete(
+                            "Pod", p.metadata.name, p.metadata.namespace
+                        )
+                    )
+                except NotFoundError:
+                    pass
+        try:
+            self._robust(lambda: self.store.delete("Node", name))
+        except NotFoundError:
+            pass
+        self.injector.record(F.NODE_DEATH)
+        log.info("chaos: killed node %s (and its pods)", name)
+
+    def _resurrect_nodes(self) -> None:
+        from nos_tpu.kube.objects import Node, NodeStatus, ObjectMeta
+
+        for name, old in list(self._dead_nodes.items()):
+            # A replaced machine comes back with labels and capacity but no
+            # annotations: the reporter re-publishes geometry from device
+            # state (which survived — slices persist across reboots) and
+            # the partitioner replans the spec side.
+            fresh = Node(
+                metadata=ObjectMeta(
+                    name=name, labels=dict(old.metadata.labels)
+                ),
+                status=NodeStatus(
+                    capacity=dict(old.status.capacity),
+                    allocatable=dict(old.status.allocatable),
+                ),
+            )
+            try:
+                self._robust(lambda n=fresh: self.store.create(n))
+            except AlreadyExistsError:
+                pass
+            del self._dead_nodes[name]
+            log.info("chaos: resurrected node %s", name)
+
+    def _cordon(self, name: str) -> None:
+        if name in self._dead_nodes:
+            return
+
+        def mutate(node) -> None:
+            node.spec.unschedulable = True
+
+        try:
+            self._robust(lambda: self.store.patch_merge("Node", name, "", mutate))
+        except NotFoundError:
+            return
+        self._cordoned.append(name)
+        self.injector.record(F.NODE_CORDON_FLAP)
+        log.info("chaos: cordoned node %s", name)
+
+    def _uncordon_all(self) -> None:
+        def mutate(node) -> None:
+            node.spec.unschedulable = False
+
+        for name in self._cordoned:
+            try:
+                self._robust(
+                    lambda n=name: self.store.patch_merge("Node", n, "", mutate)
+                )
+            except NotFoundError:
+                pass
+        self._cordoned.clear()
+
+    def _arm_agent_restart(self, burst: Burst, name: str) -> None:
+        handles = self.cluster.agents.get(name)
+        if handles is None:
+            return
+        # Interrupt stage alternates by burst so one seed exercises both
+        # crash windows across its bursts.
+        stage = "post-delete" if burst.index % 2 == 0 else "pre-report"
+        injector = self.injector
+
+        def interrupt(node_name: str, at_stage: str) -> None:
+            if at_stage != stage:
+                return
+            # One-shot: disarm, lose the process's handshake memory, die.
+            handles.actuator.chaos_interrupt = None
+            handles.shared.reset()
+            injector.record(F.AGENT_RESTART)
+            log.info(
+                "chaos: tpuagent on %s killed at %s (restart modeled by "
+                "handshake reset)",
+                node_name,
+                at_stage,
+            )
+            raise RuntimeError(
+                f"chaos: tpuagent on {node_name} died mid-actuation ({at_stage})"
+            )
+
+        handles.actuator.chaos_interrupt = interrupt
+
+    def _flap_quota(self) -> None:
+        def collapse(quota) -> None:
+            quota.spec.min = {constants.RESOURCE_TPU: 0}
+            quota.spec.max = {constants.RESOURCE_TPU: 1}
+
+        try:
+            self._robust(
+                lambda: self.store.patch_merge(
+                    "ElasticQuota", QUOTA_NAME, QUOTA_NAMESPACE, collapse
+                )
+            )
+        except NotFoundError:
+            return
+        self._quota_flapped = True
+        self.injector.record(F.QUOTA_FLAP)
+        log.info("chaos: collapsed quota %s/%s", QUOTA_NAMESPACE, QUOTA_NAME)
+
+    def _restore_quota(self) -> None:
+        if not self._quota_flapped:
+            return
+        chips = self.config.nodes * 8
+
+        def restore(quota) -> None:
+            quota.spec.min = {constants.RESOURCE_TPU: chips}
+            quota.spec.max = {constants.RESOURCE_TPU: chips}
+
+        try:
+            self._robust(
+                lambda: self.store.patch_merge(
+                    "ElasticQuota", QUOTA_NAME, QUOTA_NAMESPACE, restore
+                )
+            )
+        except NotFoundError:
+            pass
+        self._quota_flapped = False
+
+    def _flap_leader(self) -> None:
+        for elector in self.electors:
+            if elector.is_leader:
+                elector.release()
+                self.injector.record(F.LEADER_FLAP)
+                log.info("chaos: dropped lease held by %s", elector.identity)
+                return
+
+    # --------------------------------------------------------------- run
+
+    def _seed_pods(self, burst: Burst) -> None:
+        from nos_tpu.cmd.run import seed_pod
+
+        for name, chips in burst.pods:
+            pod = seed_pod({"name": name, "chips": chips})
+            try:
+                self._robust(lambda p=pod: self.store.create(p))
+            except AlreadyExistsError:
+                pass
+
+    def _cleanup_pods(self, burst: Burst) -> None:
+        for name, _ in burst.pods:
+            try:
+                self._robust(
+                    lambda n=name: self.store.delete("Pod", n, "default")
+                )
+            except NotFoundError:
+                pass
+
+    def _violations(self) -> List[str]:
+        out = oracles.check_convergence(
+            self.store,
+            scheduler_name=self.cluster.scheduler.scheduler_name,
+            partitioner=self.cluster.partitioner,
+        )
+        out += self._leader_overlap
+        return out
+
+    def _run_burst(self, burst: Burst) -> BurstResult:
+        self._seed_pods(burst)
+        start = time.monotonic()
+        for fault in burst.faults:
+            delay = start + fault.at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            self._apply_fault(burst, fault)
+        remaining = start + burst.duration_s - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+
+        # Heal: rates off, nodes back, cordons lifted, quota restored.
+        self.injector.clear()
+        self._resurrect_nodes()
+        self._uncordon_all()
+        self._restore_quota()
+
+        heal = time.monotonic()
+        deadline = heal + self.config.convergence_timeout_s
+        violations: List[str] = []
+        while time.monotonic() < deadline:
+            violations = self._violations()
+            if not violations:
+                break
+            time.sleep(0.1)
+        elapsed = time.monotonic() - heal
+        converged = not violations
+        if converged:
+            metrics.CHAOS_CONVERGENCE.observe(elapsed)
+        result = BurstResult(
+            index=burst.index,
+            faults=[f.kind for f in burst.faults],
+            converged=converged,
+            convergence_s=elapsed,
+            violations=violations,
+        )
+        self._cleanup_pods(burst)
+        return result
+
+    def run(self) -> ChaosReport:
+        report = ChaosReport(seed=self.config.seed, backend=self.config.backend)
+        self._build()
+        try:
+            for burst in self.schedule:
+                result = self._run_burst(burst)
+                report.bursts.append(result)
+                log.info(
+                    "chaos: burst %d %s",
+                    burst.index,
+                    "converged" if result.converged else "FAILED",
+                )
+        finally:
+            self._monitor_stop.set()
+            for elector in self.electors:
+                elector.stop()
+            self.cluster.stop()
+            if self.config.backend == "apiserver":
+                self.store.stop()
+                self.api.stop()
+            self.recorder.detach()
+
+        records = self.recorder.records()
+        report.records = len(records)
+        report.fault_counts = dict(self.injector.counts)
+        if self.config.export_path:
+            self.recorder.export_jsonl(self.config.export_path)
+
+        from nos_tpu.record.replay import ReplaySession
+
+        replay = ReplaySession(records).run()
+        report.replay_ok = replay.ok()
+        if not replay.ok():
+            report.replay_summary = replay.render().splitlines()[0]
+
+        if not report.ok() and self.config.minimize and self.config.fixtures_dir:
+            report.fixture_path = self._write_fixture(records)
+        return report
+
+    def _write_fixture(self, records: List[dict]) -> str:
+        import json
+        import os
+
+        from nos_tpu.chaos.minimize import minimize_records, signature_names
+
+        minimal, sig, probes = minimize_records(records)
+        os.makedirs(self.config.fixtures_dir, exist_ok=True)
+        # Filenames carry the oracle base names only; an empty signature
+        # means a live-only failure (e.g. auditor against planner caches)
+        # replay cannot reproduce — the full log is exported as 'full'.
+        path = os.path.join(
+            self.config.fixtures_dir,
+            f"chaos-seed{self.config.seed}-"
+            f"{'-'.join(signature_names(sig)) or 'full'}.jsonl",
+        )
+        with open(path, "w") as fh:
+            for record in minimal:
+                fh.write(json.dumps(record, sort_keys=True) + "\n")
+        log.info(
+            "chaos: minimized %d records to %d in %d probe(s) -> %s",
+            len(records),
+            len(minimal),
+            probes,
+            path,
+        )
+        return path

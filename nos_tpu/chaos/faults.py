@@ -1,0 +1,226 @@
+"""Fault vocabulary, seeded schedule generation, and the injector.
+
+Determinism contract: ``build_schedule(seed, ...)`` is a pure function —
+the same arguments always produce the same bursts, faults, offsets and
+workload pods (asserted by tests/chaos/test_faults.py). The injector's
+per-write decisions use deterministic counters (every Nth eligible
+operation faults) rather than a shared RNG, so the set of injected
+faults depends only on each component's own operation sequence, not on
+cross-thread RNG interleaving.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nos_tpu.kube.store import ConflictError
+from nos_tpu.util import metrics
+
+# Fault kinds. Backend-independent:
+NODE_DEATH = "node-death"          # delete node + its pods, recreate at heal
+NODE_CORDON_FLAP = "node-cordon-flap"  # spec.unschedulable True, then False
+AGENT_RESTART = "agent-restart"    # kill tpuagent between apply and report
+CONFLICT_WRITES = "conflict-writes"  # stale-rv ConflictError on store writes
+QUOTA_FLAP = "quota-flap"          # ElasticQuota min collapses, then restores
+LEADER_FLAP = "leader-flap"        # leader drops the lease mid-burst
+# Apiserver-backend only (the memory store has no HTTP surface):
+WATCH_SEVER = "watch-sever"        # cut a watch stream mid-chunk
+API_ERRORS = "api-errors"          # 503 bursts on API verbs
+API_LATENCY = "api-latency"        # per-request added latency
+
+_HTTP_KINDS = (WATCH_SEVER, API_ERRORS, API_LATENCY)
+ALL_KINDS = (
+    NODE_DEATH,
+    NODE_CORDON_FLAP,
+    AGENT_RESTART,
+    CONFLICT_WRITES,
+    QUOTA_FLAP,
+    LEADER_FLAP,
+) + _HTTP_KINDS
+
+
+@dataclass
+class Fault:
+    kind: str
+    target: str = ""   # node name for node faults; empty otherwise
+    param: float = 0.0  # rate/budget/latency, kind-dependent
+    at: float = 0.0     # seconds into the burst
+
+
+@dataclass
+class Burst:
+    index: int
+    duration_s: float
+    faults: List[Fault] = field(default_factory=list)
+    # Workload pods seeded just before the burst: (name, chips).
+    pods: List[Tuple[str, int]] = field(default_factory=list)
+
+
+def build_schedule(
+    seed: int,
+    bursts: int,
+    nodes: List[str],
+    backend: str = "memory",
+    burst_s: float = 2.0,
+) -> List[Burst]:
+    """The seed's entire story, decided up front: which faults fire in
+    which burst, against which node, at what offset, and which workload
+    pods ride along. Pure — no clocks, no global RNG."""
+    rng = random.Random(seed)
+    kinds = [k for k in ALL_KINDS if backend == "apiserver" or k not in _HTTP_KINDS]
+    out: List[Burst] = []
+    for index in range(bursts):
+        burst = Burst(index=index, duration_s=burst_s)
+        # 2-4 distinct fault kinds per burst.
+        for kind in rng.sample(kinds, k=rng.randint(2, min(4, len(kinds)))):
+            fault = Fault(
+                kind=kind,
+                at=round(rng.uniform(0.0, burst_s * 0.5), 3),
+            )
+            if kind in (NODE_DEATH, NODE_CORDON_FLAP, AGENT_RESTART):
+                fault.target = rng.choice(nodes)
+            if kind == CONFLICT_WRITES:
+                fault.param = rng.choice([2, 3, 5])  # every Nth write
+            if kind == API_ERRORS:
+                fault.param = rng.choice([3, 5, 8])  # every Nth request
+            if kind == API_LATENCY:
+                fault.param = rng.choice([0.02, 0.05])
+            if kind == WATCH_SEVER:
+                fault.param = rng.randint(1, 3)  # streams to cut
+            burst.faults.append(fault)
+        burst.faults.sort(key=lambda f: (f.at, f.kind))
+        for p in range(rng.randint(2, 4)):
+            burst.pods.append(
+                (f"chaos-{seed}-b{index}-p{p}", rng.choice([1, 1, 2, 4, 8]))
+            )
+        out.append(burst)
+    return out
+
+
+class FaultInjector:
+    """The armed half of the schedule: rate faults the driver switches on
+    for a burst window and off at heal.
+
+    Wired into two seams, both free when disarmed:
+
+    - ``KubeStore.fault_injector`` calls :meth:`on_store_write` before
+      every write verb (memory backend) — raising ConflictError models a
+      stale-resourceVersion rejection.
+    - ``StubApiServer.set_fault_injector`` consults :meth:`on_request`
+      before every verb and :meth:`take_sever` before every watch chunk
+      (apiserver backend).
+
+    The driver's own writes (seeding, node resurrection, healing) wrap in
+    :meth:`suspended` so injected faults never hit the harness itself.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._conflict_every = 0
+        self._error_every = 0
+        self._latency_s = 0.0
+        self._sever_budget = 0
+        self._writes = 0
+        self._requests = 0
+        self.counts: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- arming
+
+    def arm_conflicts(self, every: int) -> None:
+        with self._lock:
+            self._conflict_every = int(every)
+
+    def arm_errors(self, every: int) -> None:
+        with self._lock:
+            self._error_every = int(every)
+
+    def arm_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latency_s = float(seconds)
+
+    def arm_sever(self, budget: int) -> None:
+        with self._lock:
+            self._sever_budget += int(budget)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._conflict_every = 0
+            self._error_every = 0
+            self._latency_s = 0.0
+            self._sever_budget = 0
+
+    def suspended(self):
+        """Context manager: the calling thread's store writes bypass
+        injection (driver-internal operations)."""
+        injector = self
+
+        class _Suspend:
+            def __enter__(self_inner):
+                injector._local.depth = getattr(injector._local, "depth", 0) + 1
+
+            def __exit__(self_inner, *exc):
+                injector._local.depth -= 1
+
+        return _Suspend()
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+        metrics.CHAOS_FAULTS.labels(kind=kind).inc()
+
+    def record(self, kind: str) -> None:
+        """Count a driver-executed fault (node death, agent restart, ...)
+        in the same ledger as the rate faults."""
+        self._count(kind)
+
+    # ------------------------------------------------------------- seams
+
+    def on_store_write(self, kind: str, name: str) -> None:
+        if getattr(self._local, "depth", 0) > 0:
+            return
+        if kind == "Event":
+            # Telemetry, not decision input (not in RECORDED_KINDS): real
+            # controllers post events fire-and-forget, so conflicting them
+            # would model a failure mode that doesn't exist.
+            return
+        with self._lock:
+            every = self._conflict_every
+            if every <= 0:
+                return
+            self._writes += 1
+            fire = self._writes % every == 0
+        if fire:
+            self._count(CONFLICT_WRITES)
+            raise ConflictError(
+                f"chaos: injected resource version conflict on {kind}/{name}"
+            )
+
+    def on_request(self, method: str, path: str) -> Optional[Tuple[int, str]]:
+        import time
+
+        with self._lock:
+            latency = self._latency_s
+            every = self._error_every
+            if every > 0:
+                self._requests += 1
+                fire = self._requests % every == 0
+            else:
+                fire = False
+        if latency > 0:
+            self._count(API_LATENCY)
+            time.sleep(latency)
+        if fire:
+            self._count(API_ERRORS)
+            return (503, "ServiceUnavailable")
+        return None
+
+    def take_sever(self) -> bool:
+        with self._lock:
+            if self._sever_budget <= 0:
+                return False
+            self._sever_budget -= 1
+        self._count(WATCH_SEVER)
+        return True

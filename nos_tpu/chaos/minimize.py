@@ -1,0 +1,123 @@
+"""ddmin over flight-recorder records: shrink a failing run to the
+smallest record subset that still reproduces the same failure.
+
+The probe re-runs the real replayer (record/replay.py) on the candidate
+subset, then evaluates the store-state oracles on the replay-
+reconstructed final state. A subset "fails the same way" when the set of
+failing oracle names — plus replay-drift / audit-violation flags — is
+EXACTLY the original signature; signature equality (not mere
+non-emptiness) keeps the minimizer from wandering onto a different bug
+than the one it was asked to isolate.
+
+Classic Zeller/Hildebrandt delta debugging: split into n chunks, try
+each chunk and each complement, recurse on the first reducer, double n
+when nothing reduces. The ``session.start`` header is pinned (replay
+needs it to rebuild the scheduler); everything else is fair game.
+"""
+from __future__ import annotations
+
+from typing import Callable, FrozenSet, List, Tuple
+
+from nos_tpu.chaos import oracles
+
+
+def failure_signature(records: List[dict]) -> FrozenSet[str]:
+    """Replay the records and name every way they fail: failing state
+    oracles on the final replayed store, plus replay drift and audit
+    violations. Empty = healthy."""
+    from nos_tpu.record.replay import ReplaySession
+
+    session = ReplaySession(records)
+    try:
+        report = session.run()
+    except Exception:  # noqa: BLE001 — a crashing subset is its own signature
+        return frozenset({"replay-crash"})
+    session._apply_deltas_up_to(float("inf"))
+    signature = set(
+        oracles.failing_oracles(
+            oracles.state_oracles(
+                session.store,
+                scheduler_name=session.meta.get("scheduler_name", ""),
+            )
+        )
+    )
+    for drift in report.drifts:
+        # Pin each drifting record individually (seq survives subsetting:
+        # replay reads the stored seq, never renumbers). Oracle-name
+        # granularity alone lets ddmin wander onto a DIFFERENT degenerate
+        # drift — e.g. strip every delta so some unrelated plan record
+        # "drifts" against an empty store — and call it the same bug.
+        signature.add(
+            f"{oracles.REPLAY_CLEAN}@{drift.get('seq')}:{drift.get('kind', '')}"
+        )
+    if report.violations:
+        signature.add(oracles.AUDITOR_CLEAN)
+    return frozenset(signature)
+
+
+def signature_names(signature: FrozenSet[str]) -> List[str]:
+    """Collapse a signature to its oracle base names (sorted, unique) —
+    the human-facing part fixture filenames and reports are built from."""
+    return sorted({s.split("@", 1)[0] for s in signature})
+
+
+def ddmin(
+    records: List[dict],
+    predicate: Callable[[List[dict]], bool],
+    budget: int = 300,
+) -> Tuple[List[dict], int]:
+    """Minimize ``records`` (minus the pinned session header) under
+    ``predicate`` (True = still fails the same way). Returns (minimal
+    records including the header, probes spent). ``budget`` bounds probe
+    count — on exhaustion the best reduction so far is returned."""
+    pinned = [r for r in records if r.get("kind") == "session.start"]
+    rest = [r for r in records if r.get("kind") != "session.start"]
+    probes = 0
+
+    def test(subset: List[dict]) -> bool:
+        nonlocal probes
+        probes += 1
+        return predicate(pinned + subset)
+
+    n = 2
+    while len(rest) >= 2 and probes < budget:
+        chunk = max(1, (len(rest) + n - 1) // n)
+        subsets = [rest[i : i + chunk] for i in range(0, len(rest), chunk)]
+        reduced = False
+        for i, subset in enumerate(subsets):
+            if probes >= budget:
+                break
+            if len(subset) < len(rest) and test(subset):
+                rest = subset
+                n = 2
+                reduced = True
+                break
+            complement = [r for j, s in enumerate(subsets) for r in s if j != i]
+            if probes >= budget:
+                break
+            if len(complement) < len(rest) and test(complement):
+                rest = complement
+                n = max(2, n - 1)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(rest):
+                break
+            n = min(len(rest), n * 2)
+    return pinned + rest, probes
+
+
+def minimize_records(
+    records: List[dict], budget: int = 300
+) -> Tuple[List[dict], FrozenSet[str], int]:
+    """Compute the full run's failure signature, then ddmin to the
+    smallest subset preserving it. Returns (minimal records, signature,
+    probes). A healthy input returns itself untouched with an empty
+    signature (nothing to minimize)."""
+    target = failure_signature(records)
+    if not target:
+        return records, target, 0
+    minimal, probes = ddmin(
+        records, lambda subset: failure_signature(subset) == target, budget
+    )
+    return minimal, target, probes

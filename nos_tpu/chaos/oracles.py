@@ -1,0 +1,147 @@
+"""Convergence oracles: what "the suite healed" means, as predicates.
+
+Each oracle inspects store state only (so the minimizer can re-evaluate
+them on a replay-reconstructed store); ``auditor_clean`` additionally
+needs the live planner. The driver polls :func:`check_convergence` after
+every burst until it returns no violations or the deadline passes.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.kube.objects import PodPhase
+
+# Oracle names — the minimizer's failure signatures are sets of these.
+PENDING_SETTLED = "pending-settled"
+ACTUATION_CONVERGED = "actuation-converged"
+NO_ORPHANED_RESERVATIONS = "no-orphaned-reservations"
+AUDITOR_CLEAN = "auditor-clean"
+REPLAY_CLEAN = "replay-clean"
+
+
+def pending_settled(store, scheduler_name: str = "") -> List[str]:
+    """Every pending pod of ours is either bound or carries a fresh
+    scheduler verdict (PodScheduled=False/Unschedulable — the Diagnosis
+    companion): no pod is ever silently stuck."""
+    out: List[str] = []
+    for pod in store.list("Pod"):
+        if scheduler_name and pod.spec.scheduler_name != scheduler_name:
+            continue
+        if pod.status.phase != PodPhase.PENDING:
+            continue
+        if pod.spec.node_name:
+            continue
+        if not pod.unschedulable():
+            out.append(
+                f"{PENDING_SETTLED}: pod {pod.namespaced_name} is pending "
+                "with neither a binding nor an Unschedulable verdict"
+            )
+    return out
+
+
+def actuation_converged(store) -> List[str]:
+    """Every TPU/hybrid node whose spec carries a partitioning plan has
+    actuated it: the status plan id acknowledges the spec plan id and the
+    reported geometry satisfies the spec geometry."""
+    out: List[str] = []
+    for node in store.list("Node"):
+        if node.metadata.labels.get(labels.PARTITIONING_LABEL) not in (
+            labels.PartitioningKind.TPU,
+            labels.PartitioningKind.HYBRID,
+        ):
+            continue
+        ann = node.metadata.annotations
+        spec_plan = ann.get(annot.SPEC_PARTITIONING_PLAN, "")
+        if not spec_plan:
+            continue  # never planned: vacuously converged
+        status_plan = ann.get(annot.STATUS_PARTITIONING_PLAN, "")
+        name = node.metadata.name
+        if status_plan != spec_plan:
+            out.append(
+                f"{ACTUATION_CONVERGED}: node {name} status plan "
+                f"{status_plan!r} has not acknowledged spec plan {spec_plan!r}"
+            )
+            continue
+        spec, status = annot.parse_node_annotations(ann)
+        if not annot.spec_matches_status(spec, status):
+            out.append(
+                f"{ACTUATION_CONVERGED}: node {name} acked plan {spec_plan!r} "
+                "but its reported geometry does not satisfy the spec"
+            )
+    return out
+
+
+def no_orphaned_reservations(store) -> List[str]:
+    """No node carries a board-reservation annotation whose holder is
+    gone, bound, finished, or TTL-expired."""
+    from nos_tpu.scheduler.plugins.reservation import RESERVED_FOR, BoardReservation
+
+    checker = BoardReservation(store)
+    out: List[str] = []
+    for node in store.list("Node"):
+        holder = node.metadata.annotations.get(RESERVED_FOR)
+        if holder is None:
+            continue
+        if checker._valid_holder(node) is None:
+            out.append(
+                f"{NO_ORPHANED_RESERVATIONS}: node {node.metadata.name} is "
+                f"reserved for {holder!r}, which is no longer a valid holder"
+            )
+    return out
+
+
+def auditor_clean(partitioner, store) -> List[str]:
+    """Exhaustive invariant audit of the live planner against a fresh
+    snapshot (live-only: needs the planner's caches)."""
+    from nos_tpu.partitioning.core.state import ClusterState
+    from nos_tpu.record.audit import InvariantAuditor
+
+    out: List[str] = []
+    controllers = [("tpu", partitioner)]
+    sharing = getattr(partitioner, "sharing", None)
+    if sharing is not None:
+        controllers.append(("sharing", sharing))
+    for kind, controller in controllers:
+        planner = getattr(controller, "planner", None)
+        taker = getattr(controller, "snapshot_taker", None)
+        if planner is None or taker is None:
+            continue
+        snapshot = taker.take_snapshot(ClusterState(), store=store)
+        violations = InvariantAuditor(sample_rate=1.0).audit_plan(
+            planner, snapshot, exhaustive=True, revision=store.revision
+        )
+        out.extend(
+            f"{AUDITOR_CLEAN}: [{kind}] {v.check}: {v.detail}" for v in violations
+        )
+    return out
+
+
+def check_convergence(
+    store,
+    scheduler_name: str = "",
+    partitioner=None,
+) -> List[str]:
+    """All oracles that can run mid-flight, concatenated. Empty = healed."""
+    out = pending_settled(store, scheduler_name)
+    out += actuation_converged(store)
+    out += no_orphaned_reservations(store)
+    if partitioner is not None:
+        out += auditor_clean(partitioner, store)
+    return out
+
+
+def state_oracles(store, scheduler_name: str = "") -> List[str]:
+    """The store-only subset — what the minimizer evaluates on a store
+    rebuilt from recorded deltas (no live planner exists there)."""
+    out = pending_settled(store, scheduler_name)
+    out += actuation_converged(store)
+    out += no_orphaned_reservations(store)
+    return out
+
+
+def failing_oracles(violations: List[str]) -> List[str]:
+    """Collapse violation strings to their oracle names (sorted, unique) —
+    the stable part a minimizer signature can match on."""
+    return sorted({v.split(":", 1)[0] for v in violations})

@@ -1,0 +1,103 @@
+"""`python -m nos_tpu chaos`: seeded chaos runs against the in-process
+suite.
+
+Single-seed mode runs one driver and prints its report; ``--sweep N``
+runs N consecutive seeds (the slow soak `make chaos` uses) and fails if
+any seed fails to converge or drifts on replay.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from nos_tpu.chaos.driver import ChaosConfig, ChaosDriver
+
+
+def _parse(argv):
+    parser = argparse.ArgumentParser(
+        description="Run the suite under seeded fault injection"
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--bursts", type=int, default=3)
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument(
+        "--backend",
+        choices=("memory", "apiserver"),
+        default="memory",
+        help="memory: in-process store; apiserver: everything over the "
+        "HTTP stub (enables watch-sever/5xx/latency faults)",
+    )
+    parser.add_argument("--burst-seconds", type=float, default=2.0)
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=30.0,
+        help="per-burst convergence deadline after heal (seconds)",
+    )
+    parser.add_argument(
+        "--record", default="", metavar="PATH", help="export the full JSONL log"
+    )
+    parser.add_argument(
+        "--fixtures-dir",
+        default="",
+        metavar="DIR",
+        help="write an auto-minimized repro fixture here on failure",
+    )
+    parser.add_argument(
+        "--no-minimize",
+        action="store_true",
+        help="skip ddmin on failure (fast triage)",
+    )
+    parser.add_argument(
+        "--sweep",
+        type=int,
+        default=0,
+        metavar="N",
+        help="run seeds [--seed, --seed+N) and aggregate",
+    )
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    return parser.parse_args(argv)
+
+
+def _run_one(args, seed: int) -> int:
+    config = ChaosConfig(
+        seed=seed,
+        bursts=args.bursts,
+        nodes=args.nodes,
+        backend=args.backend,
+        burst_s=args.burst_seconds,
+        convergence_timeout_s=args.timeout,
+        minimize=not args.no_minimize,
+        fixtures_dir=args.fixtures_dir,
+        export_path=args.record,
+    )
+    report = ChaosDriver(config).run()
+    print(report.render())
+    return 0 if report.ok() else 1
+
+
+def main(argv=None) -> int:
+    args = _parse(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose > 1 else
+        logging.INFO if args.verbose else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    if args.sweep <= 0:
+        return _run_one(args, args.seed)
+    failed = []
+    for seed in range(args.seed, args.seed + args.sweep):
+        code = _run_one(args, seed)
+        if code != 0:
+            failed.append(seed)
+    print(
+        f"sweep: {args.sweep} seed(s), "
+        f"{args.sweep - len(failed)} converged, {len(failed)} failed"
+        + (f" (seeds {failed})" if failed else "")
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,7 @@ sim kubelet, and the sim device layer.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from nos_tpu.api.config import (
     GpuPartitionerConfig,
@@ -48,6 +48,9 @@ class SimCluster:
     device_backend: str = "sim"  # "sim" | "tpuctl" (native C++ slice state)
     tpuctl_dir: str = ""
     device_plugin_config_map: str = "nos-device-plugin-config"
+    # node name -> TpuAgentHandles, for harnesses that poke agent
+    # internals (the chaos driver's restart-mid-actuation fault).
+    agents: Dict[str, object] = field(default_factory=dict)
     _agent_nodes: List[str] = field(default_factory=list)
     _sharing_agent_nodes: List[str] = field(default_factory=list)
     _tpuctl_client: object = None
@@ -73,7 +76,7 @@ class SimCluster:
                 SimPodResourcesClient(self.store, self.pool.get),
             )
             plugin = SimDevicePlugin(self.store, self.pool)
-        build_tpuagent(
+        self.agents[node_name] = build_tpuagent(
             self.manager,
             node_name,
             client,

@@ -5,7 +5,7 @@ from __future__ import annotations
 import logging
 
 from nos_tpu.api.config import SchedulerConfig
-from nos_tpu.kube.controller import Controller, Manager, Request, Watch
+from nos_tpu.kube.controller import Controller, Manager, Request, Result, Watch
 from nos_tpu.kube.events import EventRecorder
 from nos_tpu.kube.objects import PodPhase
 from nos_tpu.scheduler.scheduler import Scheduler, new_framework
@@ -105,7 +105,53 @@ def build_scheduler(
             ],
         )
     )
+    _add_reservation_janitor(manager, scheduler)
     return scheduler
+
+
+def _add_reservation_janitor(manager: Manager, scheduler: Scheduler) -> None:
+    """Board reservations release on bind; a holder that dies instead
+    (deleted, evicted with its node, phase change) orphans the annotation.
+    This controller clears invalid reservations level-triggered — on pod
+    departure events and on a TTL timer while any reservation exists."""
+    reservation = scheduler.reservation
+    if reservation is None:
+        return
+    from nos_tpu.scheduler.plugins.reservation import RESERVED_FOR
+
+    store = manager.store
+    sweep_request = [Request(name="sweep")]
+
+    def janitor(req: Request):
+        reservation.release_invalid()
+        if reservation.any_reserved():
+            # Valid reservations expire by wall clock with no event of
+            # their own; poll while any annotation remains.
+            return Result(requeue_after=max(1.0, reservation.ttl / 2))
+        return None
+
+    def reserved_node_mapper(event):
+        if RESERVED_FOR in event.object.metadata.annotations:
+            return sweep_request
+        return []
+
+    def pod_departed_mapper(event):
+        obj = event.object
+        if event.type == "DELETED" or obj.status.phase not in (PodPhase.PENDING,):
+            return sweep_request
+        return []
+
+    manager.add(
+        Controller(
+            "reservation-janitor",
+            store,
+            janitor,
+            [
+                Watch(kind="Node", mapper=reserved_node_mapper),
+                Watch(kind="Pod", mapper=pod_departed_mapper),
+            ],
+        )
+    )
 
 
 def main(argv=None) -> int:

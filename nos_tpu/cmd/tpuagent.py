@@ -3,11 +3,25 @@
 node a real daemonset instance manages)."""
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 from nos_tpu.api.config import TpuAgentConfig
 from nos_tpu.controllers.tpuagent import SharedState, TpuActuator, TpuReporter
 from nos_tpu.device.client import TpuClient
 from nos_tpu.kube.controller import Controller, Manager, Request, Watch
 from nos_tpu.util.predicates import matching_name
+
+
+@dataclass
+class TpuAgentHandles:
+    """The live pieces of one node's agent — returned so harnesses (the
+    chaos driver) can reach the process-internal seams: SharedState.reset()
+    models a restart, actuator.chaos_interrupt a mid-actuation crash."""
+
+    shared: SharedState
+    reporter: TpuReporter
+    actuator: TpuActuator
+    reporter_controller: Controller
 
 
 def build_tpuagent(
@@ -16,7 +30,7 @@ def build_tpuagent(
     client: TpuClient,
     device_plugin,
     config: TpuAgentConfig | None = None,
-) -> None:
+) -> TpuAgentHandles:
     config = config or TpuAgentConfig()
     config.validate()
     store = manager.store
@@ -62,6 +76,12 @@ def build_tpuagent(
             actuator.reconcile,
             [Watch(kind="Node", predicate=matching_name(node_name))],
         )
+    )
+    return TpuAgentHandles(
+        shared=shared,
+        reporter=reporter,
+        actuator=actuator,
+        reporter_controller=reporter_controller,
     )
 
 

@@ -163,8 +163,35 @@ class PartitionerController:
         if annot.spec_matches_status(spec, status):
             self._diverged.pop(req.name, None)
             return None
+        if not self.fetch_pending_pods():
+            # No demand to replan FOR — and the batch processor is a no-op
+            # with an empty pending set, so firing the batcher would leave
+            # the infeasible spec in place forever (the agent keeps
+            # re-clamping it, the handshake stays "acked but diverged").
+            # With nothing asking for a different shape, the declarative
+            # intent adopts reported reality: spec := status geometry under
+            # the same plan id, which the agent then acks as an empty plan.
+            # Not memo-gated: adoption is idempotent, and the memo may
+            # already be burned by a replan that never touched this node.
+            patch: dict = annot.strip_spec_annotations(ann)
+            patch.update(
+                annot.spec_from_geometries(annot.status_geometries(status))
+            )
+            metrics.DIVERGENCE_REPLANS.inc()
+            log.info(
+                "partitioner: %s reports geometry diverging from plan %s "
+                "with no pending pods; spec adopts reported geometry",
+                req.name,
+                spec_plan,
+            )
+            self.store.patch_annotations("Node", req.name, "", patch)
+            return None
         if self._diverged.get(req.name) == spec_plan:
-            return None  # already replanned once for this stale plan
+            # Already replanned once for this stale plan. Keep the node on
+            # a heartbeat: if the replan never reshaped it and the pending
+            # set later drains, the adopt path above must still get a turn
+            # (pods draining emits no Node event to wake this watch).
+            return Result(requeue_after=1.0)
         self._diverged[req.name] = spec_plan
         metrics.DIVERGENCE_REPLANS.inc()
         log.info(
@@ -174,7 +201,7 @@ class PartitionerController:
             spec_plan,
         )
         self.batcher.fire_now()
-        return None
+        return Result(requeue_after=1.0)
 
     # --------------------------------------------- capacity-freed watch
 

@@ -45,6 +45,15 @@ class TpuActuator:
         # at error level; repeats (same stale spec re-reconciled until the
         # control plane replans) drop to debug. Reset on plan-id change.
         self._clamp_logged: set = set()
+        # Chaos seam: callable(node_name, stage) armed only by the chaos
+        # harness; raising from it models the agent process dying
+        # mid-actuation (devices already mutated, apply never acked).
+        self.chaos_interrupt = None
+
+    def _chaos_point(self, stage: str) -> None:
+        hook = self.chaos_interrupt
+        if hook is not None:
+            hook(self.node_name, stage)
 
     def reconcile(self, req: Request) -> Optional[Result]:
         if req.name != self.node_name:
@@ -85,6 +94,9 @@ class TpuActuator:
                 self.client.delete_slice(self.node_name, device.device_id)
                 metrics.SLICES_DELETED.labels(profile=device.profile).inc()
                 log.info("actuator: %s deleted %s", self.node_name, device.device_id)
+            # The window where a real agent crash hurts most: deletes are
+            # on the silicon but the creates/ack are not.
+            self._chaos_point("post-delete")
             creates_by_board: dict = {}
             for op in plan.creates:
                 board = creates_by_board.setdefault(op.board_index, {})
@@ -114,6 +126,10 @@ class TpuActuator:
                     board_index,
                 )
             span.set_attributes(deleted=len(plan.deletes), created=created)
+            # Devices fully reshaped but the apply not yet acknowledged:
+            # a crash here leaves the reporter republishing the new
+            # geometry while the spec plan is never marked applied.
+            self._chaos_point("pre-report")
             self.device_plugin.restart(self.node_name)
             self.shared.on_apply(plan_id)
         return None

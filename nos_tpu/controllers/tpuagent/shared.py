@@ -34,3 +34,12 @@ class SharedState:
     def at_least_one_report_since_last_apply(self) -> bool:
         with self._lock:
             return self._reported_since_last_apply
+
+    def reset(self) -> None:
+        """Simulate the agent process restarting: all in-memory handshake
+        state is lost (a fresh process has seen no report and remembers no
+        applied plan). Listeners survive — they model the wiring, not the
+        process."""
+        with self._lock:
+            self._reported_since_last_apply = False
+            self.last_applied_plan_id = ""

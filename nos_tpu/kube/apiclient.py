@@ -17,6 +17,7 @@ import http.client
 import json
 import logging
 import os
+import random
 import ssl
 import tempfile
 import threading
@@ -27,6 +28,47 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class Backoff:
+    """Capped exponential backoff with deterministic, seeded jitter.
+
+    client-go's ``wait.Backoff`` analogue for watch reconnects: every
+    failure doubles the delay up to ``cap``; ``reset()`` — called after a
+    successful re-list — drops back to ``base``. Jitter spreads a thundering
+    herd of reflectors reconnecting after one apiserver hiccup, but is drawn
+    from a private ``random.Random(seed)`` so a given (seed, failure
+    sequence) always produces the same delays — the chaos harness depends on
+    fault timing being a pure function of its seed.
+    """
+
+    def __init__(
+        self,
+        base: float = 0.2,
+        cap: float = 30.0,
+        factor: float = 2.0,
+        jitter: float = 0.5,
+        seed: int = 0,
+    ) -> None:
+        self.base = base
+        self.cap = cap
+        self.factor = factor
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._failures = 0
+
+    @property
+    def failures(self) -> int:
+        """Consecutive failures since the last reset."""
+        return self._failures
+
+    def next(self) -> float:
+        delay = min(self.cap, self.base * (self.factor ** self._failures))
+        self._failures += 1
+        return delay * (1.0 + self.jitter * self._rng.random())
+
+    def reset(self) -> None:
+        self._failures = 0
 
 
 class ApiError(Exception):

@@ -27,10 +27,12 @@ import copy
 import logging
 import threading
 import time
+import zlib
 from typing import Any, Dict, Iterable, List
 
 from nos_tpu.kube import serde
-from nos_tpu.kube.apiclient import ApiError, KubeApiClient
+from nos_tpu.kube.apiclient import ApiError, Backoff, KubeApiClient
+from nos_tpu.util import metrics
 from nos_tpu.kube.store import (
     ADDED,
     DELETED,
@@ -136,12 +138,26 @@ class KubeApiStore(KubeStore):
         client: KubeApiClient,
         kinds: Iterable[str] = DEFAULT_KINDS,
         relist_backoff_s: float = 2.0,
+        backoff_seed: int = 0,
     ) -> None:
         super().__init__()
         self._client = client
         self._kinds = tuple(kinds)
+        # `relist_backoff_s` is the CAP of the reconnect backoff (the old
+        # fixed sleep): the first retry after a hiccup is much faster, and
+        # repeated failures grow back up to it.
         self._relist_backoff_s = relist_backoff_s
+        self._backoff_seed = backoff_seed
         self._stop_informers = threading.Event()
+        # Cache apply-sequence: increments under the lock for every event
+        # this cache applies (write path AND reflector). This — not the
+        # apiserver resourceVersion — is the revision the flight recorder
+        # keys deltas and decision watermarks on: apiserver rvs can reach
+        # the cache out of order (reflector backfill after a severed watch,
+        # server-side writes like the sim kubelet's phase transitions), and
+        # replay must order deltas the way the cache actually saw them or
+        # decisions time-travel against state the live process never had.
+        self._applied = 0
         self._threads: List[threading.Thread] = []
         self._synced: Dict[str, threading.Event] = {
             k: threading.Event() for k in self._kinds
@@ -171,6 +187,13 @@ class KubeApiStore(KubeStore):
     def _reflector(self, kind: str) -> None:
         path = serde.resource_path(kind)
         rv = ""  # last-seen resourceVersion; empty = must (re)list
+        # Per-kind seed: reflectors of different kinds jitter differently,
+        # but the whole sequence is reproducible from backoff_seed.
+        backoff = Backoff(
+            base=min(0.1, self._relist_backoff_s),
+            cap=self._relist_backoff_s,
+            seed=self._backoff_seed ^ zlib.crc32(kind.encode()),
+        )
         while not self._stop_informers.is_set():
             try:
                 if not rv:
@@ -179,8 +202,15 @@ class KubeApiStore(KubeStore):
                     for item in items:
                         item.setdefault("kind", kind)
                         objs.append(serde.from_wire(item))
-                    self._replace_kind(kind, objs)
+                    try:
+                        list_rv = int(rv or 0)
+                    except ValueError:
+                        list_rv = 0
+                    self._replace_kind(kind, objs, list_rv=list_rv)
                     self._synced[kind].set()
+                    # Successful re-list: the apiserver is healthy again,
+                    # so the next failure starts the backoff from scratch.
+                    backoff.reset()
                 for event in self._client.watch(path, rv, self._stop_informers):
                     etype = event.get("type")
                     wire = event.get("object") or {}
@@ -201,6 +231,7 @@ class KubeApiStore(KubeStore):
             except ApiError as e:
                 if e.status == 410:  # watch window expired: relist
                     logger.info("informer %s: watch expired, relisting", kind)
+                    metrics.WATCH_RECONNECTS.labels(kind=kind).inc()
                     rv = ""
                     continue
                 if e.status in (403, 404) and not self._synced[kind].is_set():
@@ -217,17 +248,19 @@ class KubeApiStore(KubeStore):
                     rv = ""
                     continue
                 logger.warning("informer %s: %s", kind, e)
+                metrics.WATCH_RECONNECTS.labels(kind=kind).inc()
                 rv = ""
             except Exception as e:  # noqa: BLE001 — reflectors must survive
                 if self._stop_informers.is_set():
                     return
                 logger.warning("informer %s: %s: %s", kind, type(e).__name__, e)
+                metrics.WATCH_RECONNECTS.labels(kind=kind).inc()
                 rv = ""
-            self._stop_informers.wait(self._relist_backoff_s)
+            self._stop_informers.wait(backoff.next())
 
     # ------------------------------------------------------- cache mutation
 
-    def _replace_kind(self, kind: str, objs: List[Any]) -> None:
+    def _replace_kind(self, kind: str, objs: List[Any], list_rv: int = 0) -> None:
         """Initial/relist sync: diff the cache against the listed world."""
         events: List[WatchEvent] = []
         with self._lock:
@@ -236,15 +269,32 @@ class KubeApiStore(KubeStore):
             }
             stale = [k for k in self._objects if k[0] == kind and k not in fresh]
             for k in stale:
-                events.append(WatchEvent(DELETED, self._objects.pop(k)))
+                gone = self._objects.pop(k)
+                # The object vanished while we were disconnected; the exact
+                # deletion rv is lost. The list's collection rv is the
+                # tightest bound we have ("deleted by now") — stamping it
+                # keeps the recorded delete ordered after every decision
+                # that saw the object alive.
+                if list_rv > gone.metadata.resource_version:
+                    gone.metadata.resource_version = list_rv
+                    self._rv = max(self._rv, list_rv)
+                self._applied += 1
+                events.append(WatchEvent(DELETED, gone, revision=self._applied))
             for k, obj in fresh.items():
                 old = self._objects.get(k)
                 if old is None:
                     self._objects[k] = obj
-                    events.append(WatchEvent(ADDED, copy.deepcopy(obj)))
+                    self._applied += 1
+                    events.append(
+                        WatchEvent(ADDED, copy.deepcopy(obj), revision=self._applied)
+                    )
                 elif old.metadata.resource_version < obj.metadata.resource_version:
                     self._objects[k] = obj
-                    events.append(WatchEvent(MODIFIED, copy.deepcopy(obj)))
+                    self._applied += 1
+                    events.append(
+                        WatchEvent(MODIFIED, copy.deepcopy(obj), revision=self._applied)
+                    )
+                self._rv = max(self._rv, obj.metadata.resource_version)
         for e in events:
             self._notify(e)
 
@@ -255,8 +305,15 @@ class KubeApiStore(KubeStore):
             if old is not None and old.metadata.resource_version >= obj.metadata.resource_version:
                 return  # stale or already applied via write path
             self._objects[k] = copy.deepcopy(obj)
+            # Track the apiserver's revision high-water mark: store.revision
+            # is the watermark every recorded decision keys on, and it must
+            # advance in apiserver mode too or replay ordering collapses to
+            # revision 0.
+            self._rv = max(self._rv, obj.metadata.resource_version)
+            self._applied += 1
+            seq = self._applied
             etype = ADDED if old is None else MODIFIED
-        self._notify(WatchEvent(etype, copy.deepcopy(obj)))
+        self._notify(WatchEvent(etype, copy.deepcopy(obj), revision=seq))
 
     def _apply_delete(self, obj: Any) -> None:
         k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
@@ -264,7 +321,27 @@ class KubeApiStore(KubeStore):
             if k not in self._objects:
                 return
             stored = self._objects.pop(k)
-        self._notify(WatchEvent(DELETED, stored))
+            # Notify at the DELETION rv (the watch event's), not the cached
+            # object's last rv: recorded deltas must order the delete after
+            # every decision that saw the object alive.
+            if obj.metadata.resource_version > stored.metadata.resource_version:
+                stored.metadata.resource_version = obj.metadata.resource_version
+            self._rv = max(self._rv, obj.metadata.resource_version)
+            self._applied += 1
+            seq = self._applied
+        self._notify(WatchEvent(DELETED, stored, revision=seq))
+
+    @property
+    def revision(self) -> int:
+        """The cache apply-sequence, NOT the apiserver resourceVersion.
+
+        Decisions read this at cycle entry as their replay watermark; it
+        must promise "every delta numbered <= this was in the cache when I
+        decided", which apiserver rvs cannot (backfill applies old rvs
+        late). Object rvs in the cache stay authentic apiserver rvs —
+        optimistic concurrency is untouched."""
+        with self._lock:
+            return self._applied
 
     # ---------------------------------------------------------- write verbs
 
@@ -299,13 +376,31 @@ class KubeApiStore(KubeStore):
     def delete(self, kind: str, name: str, namespace: str = "") -> Any:
         path = serde.resource_path(kind, namespace, name)
         try:
-            self._client.delete(path)
+            resp = self._client.delete(path)
         except ApiError as e:
             raise _api_error_to_store(e) from e
+        # The apiserver bumps the resourceVersion on delete and returns the
+        # deleted object carrying it. Stamp the notified event with THAT rv,
+        # not the cached object's last one: the flight recorder keys deltas
+        # by rv, and a delete recorded at its pre-delete rv sorts BEFORE
+        # decisions that saw the object alive — replay would free the
+        # capacity too early and drift.
+        deleted_rv = 0
+        try:
+            deleted_rv = int((resp.get("metadata") or {}).get("resourceVersion", 0))
+        except (AttributeError, TypeError, ValueError):
+            pass
         with self._lock:
             stored = self._objects.pop(_key(kind, namespace, name), None)
+            if stored is not None and deleted_rv:
+                stored.metadata.resource_version = deleted_rv
+            if deleted_rv:
+                self._rv = max(self._rv, deleted_rv)
+            if stored is not None:
+                self._applied += 1
+                seq = self._applied
         if stored is not None:
-            self._notify(WatchEvent(DELETED, copy.deepcopy(stored)))
+            self._notify(WatchEvent(DELETED, copy.deepcopy(stored), revision=seq))
         return stored
 
     def patch_merge(self, kind, name, namespace, mutate, max_retries: int = 5):

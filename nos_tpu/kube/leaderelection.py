@@ -65,6 +65,12 @@ class LeaderElector:
         self.is_leader = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Serializes a renew attempt (+ the is_leader transition it drives)
+        # against release(): without it, a release() from another thread
+        # can land mid-renew — it demotes and clears the lock, then the
+        # in-flight renew returns True and re-promotes, overlapping with
+        # whichever challenger took the freed lease.
+        self._lease_lock = threading.Lock()
         # (holder, renew) last observed on the lock + local monotonic time
         # of FIRST observing that exact pair — the skew-free age source.
         self._observed: Optional[tuple] = None
@@ -121,20 +127,33 @@ class LeaderElector:
 
     def release(self) -> None:
         """Voluntarily drop the lease: clearing the holder lets the next
-        challenger acquire instantly (no lease-duration wait)."""
+        challenger acquire instantly (no lease-duration wait).
 
+        Demotes BEFORE touching the lock: the moment the holder field
+        clears, a challenger may acquire — if this elector still reported
+        is_leader until its next renew tick, two leaders would overlap for
+        up to a renew period. Demoting first errs the safe way (briefly no
+        leader, never two)."""
         def mutate(cm: ConfigMap) -> None:
             if cm.metadata.annotations.get(HOLDER_ANNOTATION) != self.identity:
                 raise _HeldByOther(cm.metadata.annotations.get(HOLDER_ANNOTATION, ""))
             cm.metadata.annotations[HOLDER_ANNOTATION] = ""
             cm.metadata.annotations[RENEW_ANNOTATION] = "0"
 
-        try:
-            self.store.patch_merge("ConfigMap", self.name, self.namespace, mutate)
-        except (_HeldByOther, NotFoundError, ConflictError):
-            pass
-        except Exception as e:  # noqa: BLE001 — releasing must never raise
-            logger.warning("lease %s: release failed: %s", self.name, e)
+        with self._lease_lock:
+            if self.is_leader:
+                self.is_leader = False
+                logger.info(
+                    "lease %s: %s released leadership", self.name, self.identity
+                )
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+            try:
+                self.store.patch_merge("ConfigMap", self.name, self.namespace, mutate)
+            except (_HeldByOther, NotFoundError, ConflictError):
+                pass
+            except Exception as e:  # noqa: BLE001 — releasing must never raise
+                logger.warning("lease %s: release failed: %s", self.name, e)
 
     # --------------------------------------------------------------- loop
 
@@ -146,34 +165,41 @@ class LeaderElector:
         leader only after the renew deadline."""
         stop = stop or self._stop
         while not stop.is_set():
-            try:
-                got = self._try_acquire_or_renew()
-            except Exception as e:  # noqa: BLE001 — elector must survive
-                logger.warning(
-                    "lease %s: renew attempt failed: %s: %s",
-                    self.name, type(e).__name__, e,
-                )
-                # Retain leadership only within the renew deadline.
-                got = (
-                    self.is_leader
-                    and time.monotonic() - self._last_renew_ok < self.lease_duration_s
-                )
-            else:
-                if got:
-                    self._last_renew_ok = time.monotonic()
-            if got and not self.is_leader:
-                # Counter ticks BEFORE the flag flips: wait_for_leadership
-                # observers must never see is_leader without the count.
-                metrics.LEADER_TRANSITIONS.inc()
-                self.is_leader = True
-                logger.info("lease %s: %s became leader", self.name, self.identity)
-                if self.on_started_leading:
-                    self.on_started_leading()
-            elif not got and self.is_leader:
-                self.is_leader = False
-                logger.warning("lease %s: %s LOST leadership", self.name, self.identity)
-                if self.on_stopped_leading:
-                    self.on_stopped_leading()
+            # The whole attempt + transition holds the lease lock so a
+            # concurrent release() cannot interleave between our renew
+            # landing on the store and the is_leader flip it justifies.
+            with self._lease_lock:
+                try:
+                    got = self._try_acquire_or_renew()
+                except Exception as e:  # noqa: BLE001 — elector must survive
+                    logger.warning(
+                        "lease %s: renew attempt failed: %s: %s",
+                        self.name, type(e).__name__, e,
+                    )
+                    # Retain leadership only within the renew deadline.
+                    got = (
+                        self.is_leader
+                        and time.monotonic() - self._last_renew_ok
+                        < self.lease_duration_s
+                    )
+                else:
+                    if got:
+                        self._last_renew_ok = time.monotonic()
+                if got and not self.is_leader:
+                    # Counter ticks BEFORE the flag flips: wait_for_leadership
+                    # observers must never see is_leader without the count.
+                    metrics.LEADER_TRANSITIONS.inc()
+                    self.is_leader = True
+                    logger.info("lease %s: %s became leader", self.name, self.identity)
+                    if self.on_started_leading:
+                        self.on_started_leading()
+                elif not got and self.is_leader:
+                    self.is_leader = False
+                    logger.warning(
+                        "lease %s: %s LOST leadership", self.name, self.identity
+                    )
+                    if self.on_stopped_leading:
+                        self.on_stopped_leading()
             stop.wait(self.renew_period_s if self.is_leader else self.renew_period_s / 2)
         if self.is_leader:
             self.is_leader = False

@@ -46,6 +46,14 @@ DELETED = "DELETED"
 class WatchEvent:
     type: str  # ADDED | MODIFIED | DELETED
     object: Any
+    # Store-local apply sequence (0 = unset; fall back to the object's
+    # resourceVersion). The in-memory store's rv IS its apply order, but an
+    # API-backed cache applies events in an order that can diverge from
+    # apiserver rv order (write-path read-your-writes races the reflector;
+    # a severed watch backfills old rvs late). Recording THIS stamp as the
+    # delta ordering key lets replay reconstruct exactly what the cache
+    # contained at any decision watermark, lag included.
+    revision: int = 0
 
     @property
     def kind(self) -> str:
@@ -70,6 +78,12 @@ class KubeStore:
         # the validating-webhook admission seam (reference
         # pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:31-97).
         self._admission: Dict[str, List[Callable[[Any, "KubeStore"], None]]] = {}
+        # Chaos seam: armed only by the chaos harness. When set, the
+        # injector's on_store_write(kind, name) runs before every write
+        # verb and may raise ConflictError/RuntimeError to model stale-rv
+        # conflicts and apiserver write failures. None on every production
+        # path — one attribute read of cost.
+        self.fault_injector: Optional[Any] = None
 
     def register_admission(self, kind: str, fn: Callable[[Any, "KubeStore"], None]) -> None:
         self._admission.setdefault(kind, []).append(fn)
@@ -78,9 +92,15 @@ class KubeStore:
         for fn in self._admission.get(obj.kind, []):
             fn(obj, self)
 
+    def _chaos_write(self, kind: str, name: str) -> None:
+        injector = self.fault_injector
+        if injector is not None:
+            injector.on_store_write(kind, name)
+
     # ------------------------------------------------------------------ CRUD
 
     def create(self, obj: Any) -> Any:
+        self._chaos_write(obj.kind, obj.metadata.name)
         with self._lock:
             k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
             if k in self._objects:
@@ -108,6 +128,7 @@ class KubeStore:
             return None
 
     def update(self, obj: Any, check_version: bool = False) -> Any:
+        self._chaos_write(obj.kind, obj.metadata.name)
         with self._lock:
             k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
             if k not in self._objects:
@@ -124,6 +145,7 @@ class KubeStore:
         return out
 
     def delete(self, kind: str, name: str, namespace: str = "") -> Any:
+        self._chaos_write(kind, name)
         with self._lock:
             k = _key(kind, namespace, name)
             if k not in self._objects:
@@ -195,6 +217,7 @@ class KubeStore:
     def patch_merge(self, kind: str, name: str, namespace: str, mutate: Callable[[Any], None]) -> Any:
         """Read-modify-write under the store lock — the analogue of a merge
         patch (client.Patch in controller-runtime)."""
+        self._chaos_write(kind, name)
         with self._lock:
             k = _key(kind, namespace, name)
             if k not in self._objects:

@@ -160,7 +160,11 @@ class FlightRecorder:
         self._append(
             "delta",
             type=event.type,
-            revision=event.object.metadata.resource_version,
+            # The event's apply-sequence stamp when the store provides one
+            # (KubeApiStore: apiserver rvs can reach the cache out of
+            # order, so only the apply order keys replay correctly); the
+            # in-memory store's rv is already its apply order.
+            revision=event.revision or event.object.metadata.resource_version,
             object=wire,
         )
 
@@ -187,6 +191,7 @@ class FlightRecorder:
         message: str = "",
         trace_id: str = "",
         diagnosis: Optional[dict] = None,
+        settled: bool = True,
     ) -> None:
         self._append(
             "scheduler.cycle",
@@ -199,6 +204,7 @@ class FlightRecorder:
             message=message,
             trace_id=trace_id,
             diagnosis=diagnosis,
+            settled=settled,
             monotonic=time.monotonic(),
         )
 

@@ -168,7 +168,14 @@ class ReplaySession:
             return
         report.cycles += 1
         outcome = self.scheduler.decide(pod)
-        self.scheduler.settle(outcome)
+        if record.get("settled", True):
+            self.scheduler.settle(outcome)
+        # settled=False: the live cycle's store writes failed (conflict,
+        # apiserver outage) — the bind never happened, so the replay store
+        # must not apply it either; the retry cycle's record covers the
+        # eventual outcome. The decision comparison below still holds:
+        # decide() is a function of observed state, which failed writes
+        # don't change.
         got = {
             "decision": outcome.decision,
             "node": outcome.node,

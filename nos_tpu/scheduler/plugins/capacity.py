@@ -298,12 +298,19 @@ class CapacityScheduling:
         return Status.ok()
 
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
-        for pods in self._reserved.values():
-            pods.pop(pod.namespaced_name, None)
+        self.forget_key(pod.namespaced_name)
 
     def forget(self, pod: Pod) -> None:
         """Drop any reservation once the pod is visibly bound in the store."""
-        self.unreserve(CycleState(), pod, "")
+        self.forget_key(pod.namespaced_name)
+
+    def forget_key(self, key: str) -> None:
+        """Drop a reservation by pod key — for pods that vanished from the
+        store entirely (deleted before their bound state was ever observed):
+        without this, the in-flight reservation leaks and inflates the
+        quota's used forever."""
+        for pods in self._reserved.values():
+            pods.pop(key, None)
 
     # ------------------------------------------------------ postfilter
 

@@ -200,6 +200,46 @@ class BoardReservation:
 
     # ----------------------------------------------------------- release
 
+    def release_invalid(self) -> int:
+        """Clear reservation annotations whose holder is no longer valid
+        (holder deleted/bound/finished, or TTL expired).
+
+        ``release_for`` only fires on bind; a holder that dies instead —
+        evicted with its node, deleted by its owner — used to leave the
+        annotation on the node forever. The filter tolerates that (an
+        invalid reservation rejects nobody), but the stale annotation costs
+        a holder lookup per node per cycle and reads as a live drain to
+        operators and oracles. The janitor controller calls this on pod
+        deletions/phase changes and on a TTL timer."""
+        cleared = 0
+        for node in self.store.list("Node"):
+            if RESERVED_FOR not in node.metadata.annotations:
+                continue
+            if self._valid_holder(node) is not None:
+                continue
+            try:
+                self.store.patch_annotations(
+                    "Node",
+                    node.metadata.name,
+                    "",
+                    {RESERVED_FOR: None, RESERVED_AT: None},
+                )
+            except NotFoundError:
+                continue
+            cleared += 1
+            log.info(
+                "scheduler: cleared orphaned reservation on %s (holder %s "
+                "no longer valid)",
+                node.metadata.name,
+                node.metadata.annotations.get(RESERVED_FOR, ""),
+            )
+        return cleared
+
+    def any_reserved(self) -> bool:
+        return any(
+            RESERVED_FOR in n.metadata.annotations for n in self.store.list("Node")
+        )
+
     def release_for(self, pod: Pod) -> None:
         """Clear any reservation held by `pod` (called on bind; deletion
         and phase changes fall back to holder-validity + TTL)."""

@@ -150,6 +150,16 @@ class Scheduler:
         self._handle_gang_timeouts()
         pod = self.store.try_get("Pod", req.name, req.namespace)
         if pod is None:
+            # Deleted — possibly before this scheduler ever observed it
+            # bound. Any in-flight quota reservation and assume-cache entry
+            # must die with the pod, or the leaked reservation inflates the
+            # quota's used for the rest of the process lifetime and every
+            # later pod in the namespace fails admission against phantom
+            # usage.
+            key = f"{req.namespace}/{req.name}" if req.namespace else req.name
+            self._assumed.pop(key, None)
+            if self.capacity is not None:
+                self.capacity.forget_key(key)
             return None
         if not self.responsible_for(pod):
             # Another scheduler's pod: binding it here would double-bind
@@ -204,8 +214,21 @@ class Scheduler:
         # decision's consequences, not its inputs.
         revision = self.store.revision
         outcome = self._decide(pod)
+        # Record only after the outcome's store writes land. A bind whose
+        # write fails (apiserver conflict or outage) must not be recorded
+        # as if it happened: replay's settle would bind the pod in the
+        # replay store with no delta to back it, and every later decision
+        # about that pod would drift. The decision itself is still recorded
+        # (settled=False) because _decide's in-memory effects — assume
+        # cache, gang formation — did happen and replay must re-run decide
+        # to accumulate them; it just skips settle.
+        try:
+            result = self._apply_outcome(pod, outcome)
+        except Exception:
+            self._record_cycle(pod, revision, outcome, settled=False)
+            raise
         self._record_cycle(pod, revision, outcome)
-        return self._apply_outcome(pod, outcome)
+        return result
 
     def decide(self, pod: Pod) -> CycleOutcome:
         """Replay entrypoint: the full decision pipeline without the
@@ -361,7 +384,9 @@ class Scheduler:
     def _last_victims(self) -> List[str]:
         return list(getattr(self.capacity, "last_victims", None) or [])
 
-    def _record_cycle(self, pod: Pod, revision: int, outcome: CycleOutcome) -> None:
+    def _record_cycle(
+        self, pod: Pod, revision: int, outcome: CycleOutcome, settled: bool = True
+    ) -> None:
         if self.flight_recorder is None:
             return
         root = TRACER.journey(("pod", pod.namespaced_name))
@@ -375,6 +400,7 @@ class Scheduler:
             message=outcome.message,
             trace_id=root.trace_id if root is not None else "",
             diagnosis=outcome.diagnosis.to_dict() if outcome.diagnosis else None,
+            settled=settled,
         )
 
     def _apply_outcome(self, pod: Pod, outcome: CycleOutcome) -> Optional[Result]:

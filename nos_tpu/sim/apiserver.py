@@ -115,8 +115,47 @@ class StubApiServer:
                 n = int(self.headers.get("Content-Length") or 0)
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _drain_body(self) -> None:
+                """Consume an unread request body before replying early.
+
+                Responding without reading the body leaves its bytes in the
+                keep-alive stream; the NEXT request on the connection then
+                parses as body-garbage + request-line ("Bad request
+                syntax"), poisoning an innocent caller. Every reply path
+                that fires before _read_body() must drain first."""
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    try:
+                        self.rfile.read(n)
+                    except (OSError, ValueError):
+                        self.close_connection = True
+
+            def _fault_gate(self) -> bool:
+                """Consult the armed chaos injector (if any) before serving.
+
+                The injector is duck-typed (`on_request(method, path)` →
+                None to proceed, or `(code, reason)` to deny; it may sleep
+                internally to model latency). Production paths never pay
+                for this: one getattr against a None default.
+                """
+                fault = getattr(self.server, "chaos_faults", None)
+                if fault is None:
+                    return False
+                verdict = fault.on_request(self.command, self.path)
+                if verdict is None:
+                    return False
+                code, reason = verdict
+                self._drain_body()
+                try:
+                    self._error(code, reason, "chaos fault injection")
+                except (OSError, ValueError):
+                    pass
+                return True
+
             # ------------------------------------------------------ verbs
             def do_GET(self) -> None:
+                if self._fault_gate():
+                    return
                 route = self._route()
                 if not route:
                     return self._error(404, "NotFound", self.path)
@@ -146,8 +185,11 @@ class StubApiServer:
                 )
 
             def do_POST(self) -> None:
+                if self._fault_gate():
+                    return
                 route = self._route()
                 if not route:
+                    self._drain_body()
                     return self._error(404, "NotFound", self.path)
                 plural, ns, name, sub, _ = route
                 if sub == "binding":
@@ -175,8 +217,11 @@ class StubApiServer:
                 self._send_json(201, obj)
 
             def do_PUT(self) -> None:
+                if self._fault_gate():
+                    return
                 route = self._route()
                 if not route or not route[2]:
+                    self._drain_body()
                     return self._error(404, "NotFound", self.path)
                 plural, ns, name, _, _ = route
                 obj = self._read_body()
@@ -233,11 +278,15 @@ class StubApiServer:
                         target[k] = v
 
             def do_PATCH(self) -> None:
+                if self._fault_gate():
+                    return
                 route = self._route()
                 if not route or not route[2]:
+                    self._drain_body()
                     return self._error(404, "NotFound", self.path)
                 plural, ns, name, sub, _ = route
                 if "merge-patch" not in (self.headers.get("Content-Type") or ""):
+                    self._drain_body()
                     return self._error(415, "UnsupportedMediaType")
                 patch = self._read_body()
                 with state.lock:
@@ -278,6 +327,8 @@ class StubApiServer:
                 self._send_json(200, obj)
 
             def do_DELETE(self) -> None:
+                if self._fault_gate():
+                    return
                 route = self._route()
                 if not route or not route[2]:
                     return self._error(404, "NotFound", self.path)
@@ -294,6 +345,7 @@ class StubApiServer:
 
             # ------------------------------------------------------ watch
             def _watch(self, plural: str, ns: str, params: Dict[str, str]) -> None:
+                fault = getattr(self.server, "chaos_faults", None)
                 since = int(params.get("resourceVersion") or 0)
                 deadline = time.monotonic() + float(params.get("timeoutSeconds") or 60)
                 self.send_response(200)
@@ -302,38 +354,84 @@ class StubApiServer:
                 self.end_headers()
 
                 def send_chunk(payload: Dict[str, Any]) -> bool:
+                    data = (json.dumps(payload) + "\n").encode()
+                    if fault is not None and fault.take_sever():
+                        # Chaos: kill the stream MID-frame — the client
+                        # sees the TCP connection die halfway through a
+                        # chunk, not a clean end-of-stream.
+                        try:
+                            self.wfile.write(
+                                f"{len(data):x}\r\n".encode() + data[: len(data) // 2]
+                            )
+                            self.wfile.flush()
+                        except (OSError, ValueError):
+                            pass
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return False
                     try:
-                        data = (json.dumps(payload) + "\n").encode()
                         self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
                         return True
-                    except (BrokenPipeError, ConnectionResetError):
+                    except (OSError, ValueError):
+                        # Any socket failure — broken pipe, reset, closed
+                        # file object — means the client is gone: end this
+                        # watch quietly instead of letting the exception
+                        # propagate out of the handler thread.
                         return False
 
                 cursor = since
-                while time.monotonic() < deadline:
-                    with state.lock:
-                        pending = [
-                            (rv, et, o)
-                            for (rv, et, p, o) in state.events
-                            if rv > cursor
-                            and p == plural
-                            and (not ns or o["metadata"].get("namespace", "") == ns)
-                        ]
+                last_write = time.monotonic()
+                try:
+                    while time.monotonic() < deadline:
+                        with state.lock:
+                            pending = [
+                                (rv, et, o)
+                                for (rv, et, p, o) in state.events
+                                if rv > cursor
+                                and p == plural
+                                and (not ns or o["metadata"].get("namespace", "") == ns)
+                            ]
+                            rv_now = state.rv
+                            if not pending:
+                                state.lock.wait(timeout=0.2)
                         if not pending:
-                            state.lock.wait(timeout=0.2)
+                            # Idle heartbeat: a BOOKMARK keeps the client's
+                            # resourceVersion fresh AND probes the socket, so
+                            # a disconnected watcher is reaped within ~a
+                            # second instead of parking its handler thread
+                            # (and re-scanning the event log) until the full
+                            # timeoutSeconds deadline.
+                            if time.monotonic() - last_write >= 0.5:
+                                bookmark = {
+                                    "type": "BOOKMARK",
+                                    "object": {
+                                        "metadata": {"resourceVersion": str(rv_now)}
+                                    },
+                                }
+                                if not send_chunk(bookmark):
+                                    return
+                                last_write = time.monotonic()
                             continue
-                    for rv, etype, obj in pending:
-                        cursor = max(cursor, rv)
-                        if not send_chunk({"type": etype, "object": obj}):
-                            return
-                try:  # terminating zero-chunk
-                    self.wfile.write(b"0\r\n\r\n")
-                    self.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError):
+                        for rv, etype, obj in pending:
+                            cursor = max(cursor, rv)
+                            if not send_chunk({"type": etype, "object": obj}):
+                                return
+                            last_write = time.monotonic()
+                    try:  # terminating zero-chunk
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except (OSError, ValueError):
+                        pass
+                except (OSError, ValueError):
+                    # Disconnect surfaced outside send_chunk (e.g. while
+                    # flushing headers): same story — die quietly.
                     pass
 
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.chaos_faults = None
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="stub-apiserver", daemon=True
         )
@@ -351,6 +449,15 @@ class StubApiServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+    def set_fault_injector(self, injector) -> None:
+        """Arm (or with None, disarm) a chaos fault injector.
+
+        Duck-typed: ``on_request(method, path)`` is consulted before every
+        verb (return ``(code, reason)`` to deny, None to proceed; sleep
+        inside to model latency) and ``take_sever()`` before every watch
+        chunk (return True to cut the stream mid-frame)."""
+        self._server.chaos_faults = injector
 
     def __enter__(self) -> "StubApiServer":
         return self.start()

@@ -396,6 +396,11 @@ LEADER_TRANSITIONS = REGISTRY.counter(
     "nos_tpu_leader_transitions_total",
     "Leadership acquisitions across all components' leases",
 )
+WATCH_RECONNECTS = REGISTRY.counter(
+    "nos_tpu_watch_reconnects_total",
+    "Informer watch streams re-established after an error, disconnect, "
+    "or 410 expiry (by kind)",
+)
 
 # Serving engine (a replica exports these next to the control-plane set).
 SERVE_REQUESTS = REGISTRY.counter(
@@ -434,4 +439,15 @@ AUDIT_VIOLATIONS = REGISTRY.counter(
     "Invariant-auditor checks whose shadow recompute disagreed with the "
     "incremental structure (verdict cache, lacking totals, free pool, "
     "mutation clock, carve-futility memo) (by check)",
+)
+
+# Chaos harness (chaos/).
+CHAOS_FAULTS = REGISTRY.counter(
+    "nos_tpu_chaos_faults_total",
+    "Faults injected by the chaos driver (by kind)",
+)
+CHAOS_CONVERGENCE = REGISTRY.histogram(
+    "nos_tpu_chaos_convergence_seconds",
+    "Wall time from end-of-burst heal to all convergence oracles passing",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
 )

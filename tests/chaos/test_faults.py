@@ -1,0 +1,114 @@
+"""Determinism and seam contracts of the fault vocabulary.
+
+The chaos harness's whole value rests on ``build_schedule`` being a pure
+function of its arguments — same seed, same story — and on the injector
+firing from deterministic per-seam counters rather than shared RNG state.
+"""
+import pytest
+
+from nos_tpu.chaos import faults as F
+from nos_tpu.chaos.faults import FaultInjector, build_schedule
+from nos_tpu.kube.store import ConflictError
+
+NODES = ["n0", "n1", "n2"]
+
+
+def _flat(schedule):
+    return [
+        (b.index, b.duration_s, tuple(b.pods), tuple(
+            (f.kind, f.target, f.param, f.at) for f in b.faults
+        ))
+        for b in schedule
+    ]
+
+
+def test_same_seed_same_schedule():
+    a = build_schedule(42, 4, NODES, backend="apiserver", burst_s=2.0)
+    b = build_schedule(42, 4, NODES, backend="apiserver", burst_s=2.0)
+    assert _flat(a) == _flat(b)
+
+
+def test_different_seeds_diverge():
+    flats = {tuple(_flat(build_schedule(s, 3, NODES))) for s in range(8)}
+    assert len(flats) > 1
+
+
+def test_schedule_is_pure_of_global_rng():
+    import random
+
+    a = build_schedule(7, 3, NODES)
+    random.seed(999)
+    random.random()
+    b = build_schedule(7, 3, NODES)
+    assert _flat(a) == _flat(b)
+
+
+def test_memory_backend_excludes_http_faults():
+    schedule = build_schedule(3, 20, NODES, backend="memory")
+    kinds = {f.kind for b in schedule for f in b.faults}
+    assert kinds.isdisjoint({F.WATCH_SEVER, F.API_ERRORS, F.API_LATENCY})
+    assert kinds  # something still fires
+
+
+def test_every_burst_has_faults_and_pods():
+    for burst in build_schedule(11, 6, NODES, backend="apiserver"):
+        assert 2 <= len(burst.faults) <= 4
+        assert 2 <= len(burst.pods) <= 4
+        assert all(f.at <= burst.duration_s for f in burst.faults)
+        for f in burst.faults:
+            if f.kind in (F.NODE_DEATH, F.NODE_CORDON_FLAP, F.AGENT_RESTART):
+                assert f.target in NODES
+
+
+def test_conflict_injection_every_nth_write():
+    inj = FaultInjector()
+    inj.arm_conflicts(2)
+    fired = []
+    for i in range(6):
+        try:
+            inj.on_store_write("Pod", f"p{i}")
+        except ConflictError:
+            fired.append(i)
+    assert fired == [1, 3, 5]
+    assert inj.counts[F.CONFLICT_WRITES] == 3
+
+
+def test_suspended_writes_bypass_injection():
+    inj = FaultInjector()
+    inj.arm_conflicts(1)
+    with inj.suspended():
+        inj.on_store_write("Pod", "driver-pod")  # must not raise
+    with pytest.raises(ConflictError):
+        inj.on_store_write("Pod", "victim")
+
+
+def test_events_never_conflict():
+    inj = FaultInjector()
+    inj.arm_conflicts(1)
+    inj.on_store_write("Event", "telemetry")  # must not raise
+
+
+def test_error_injection_every_nth_request():
+    inj = FaultInjector()
+    inj.arm_errors(3)
+    results = [inj.on_request("GET", "/api/v1/pods") for _ in range(6)]
+    assert [r for r in results if r] == [(503, "ServiceUnavailable")] * 2
+
+
+def test_sever_budget_is_finite_and_additive():
+    inj = FaultInjector()
+    inj.arm_sever(2)
+    inj.arm_sever(1)
+    assert [inj.take_sever() for _ in range(5)] == [True, True, True, False, False]
+
+
+def test_clear_disarms_everything():
+    inj = FaultInjector()
+    inj.arm_conflicts(1)
+    inj.arm_errors(1)
+    inj.arm_sever(5)
+    inj.arm_latency(0.5)
+    inj.clear()
+    inj.on_store_write("Pod", "p")  # no raise
+    assert inj.on_request("GET", "/") is None
+    assert not inj.take_sever()

@@ -1,0 +1,81 @@
+"""Committed chaos fixtures replay as regression tests.
+
+Every ``tests/chaos/fixtures/*.jsonl`` is auto-discovered: its filename
+encodes the failure signature the chaos driver minimized it down to
+(``chaos-seed<N>-<oracle-names>.jsonl``, ``full`` = no replayable
+signature), and replaying it must keep producing EXACTLY that signature.
+Drop a new fixture in the directory and it becomes a test case — no
+registration step.
+"""
+import os
+
+import pytest
+
+from nos_tpu.chaos.minimize import failure_signature, signature_names
+from nos_tpu.record import ReplaySession
+from nos_tpu.record.recorder import load_jsonl
+
+FIXTURES_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+# Oracle base names a fixture filename may carry (chaos/oracles.py plus
+# the minimizer's crash sentinel).
+KNOWN_NAMES = (
+    "actuation-converged",
+    "auditor-clean",
+    "no-orphaned-reservations",
+    "pending-settled",
+    "replay-clean",
+    "replay-crash",
+)
+
+
+def expected_names(stem: str):
+    """Parse the oracle names out of a driver-style fixture filename."""
+    tail = stem.split("-", 2)[2] if stem.count("-") >= 2 else ""
+    if not tail or tail == "full":
+        return []
+    names = []
+    while tail:
+        for name in KNOWN_NAMES:
+            if tail == name or tail.startswith(name + "-"):
+                names.append(name)
+                tail = tail[len(name) + 1 :]
+                break
+        else:
+            raise ValueError(f"fixture name segment {tail!r} is not an oracle name")
+    return sorted(names)
+
+
+def _fixtures():
+    if not os.path.isdir(FIXTURES_DIR):
+        return []
+    return sorted(f for f in os.listdir(FIXTURES_DIR) if f.endswith(".jsonl"))
+
+
+@pytest.mark.parametrize("filename", _fixtures())
+def test_fixture_reproduces_its_signature(filename):
+    path = os.path.join(FIXTURES_DIR, filename)
+    records = load_jsonl(path)
+    assert records, f"{filename} is empty"
+    signature = failure_signature(records)
+    assert signature_names(signature) == expected_names(filename[: -len(".jsonl")])
+
+
+@pytest.mark.parametrize("filename", _fixtures())
+def test_fixture_replay_is_deterministic(filename):
+    path = os.path.join(FIXTURES_DIR, filename)
+    first = ReplaySession(load_jsonl(path)).run()
+    second = ReplaySession(load_jsonl(path)).run()
+    assert first.drifts == second.drifts
+    assert first.violations == second.violations
+    assert (first.cycles, first.plans, first.skips) == (
+        second.cycles, second.plans, second.skips,
+    )
+
+
+def test_discovery_found_the_committed_fixtures():
+    """The repo ships at least one clean pin and one drift repro; if this
+    fails the fixtures directory went missing from the checkout."""
+    names = _fixtures()
+    assert any("full" in n for n in names), names
+    assert any("replay-clean" in n for n in names), names

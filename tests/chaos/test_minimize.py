@@ -1,0 +1,171 @@
+"""ddmin and failure signatures: the auto-minimizer must isolate a known
+failing subset, pin the session header, preserve the exact failure
+signature, and round-trip through the JSONL fixture format.
+"""
+import copy
+import json
+import time
+
+import pytest
+
+from nos_tpu.chaos import oracles
+from nos_tpu.chaos.minimize import (
+    ddmin,
+    failure_signature,
+    minimize_records,
+    signature_names,
+)
+from nos_tpu.record import ReplaySession
+from nos_tpu.record.recorder import load_jsonl
+
+HEADER = {"kind": "session.start", "seq": 0, "revision": 0}
+
+
+def _synthetic(n=24):
+    return [dict(HEADER)] + [{"kind": "delta", "seq": i, "i": i} for i in range(n)]
+
+
+class TestDdmin:
+    def test_isolates_known_failing_pair(self):
+        """Predicate: fails iff records 3 AND 11 are both present — the
+        classic ddmin exercise; the minimum is exactly that pair."""
+        records = _synthetic()
+
+        def predicate(subset):
+            have = {r.get("i") for r in subset}
+            return {3, 11} <= have
+
+        minimal, probes = ddmin(records, predicate)
+        body = [r for r in minimal if r["kind"] != "session.start"]
+        assert sorted(r["i"] for r in body) == [3, 11]
+        assert probes > 0
+
+    def test_header_is_pinned(self):
+        records = _synthetic(8)
+        minimal, _ = ddmin(records, lambda subset: True)
+        assert any(r["kind"] == "session.start" for r in minimal)
+
+    def test_budget_bounds_probe_count(self):
+        records = _synthetic(64)
+        minimal, probes = ddmin(
+            records, lambda subset: {3, 11} <= {r.get("i") for r in subset},
+            budget=5,
+        )
+        assert probes <= 5
+        # Best-so-far still fails the predicate (never a healthy result).
+        have = {r.get("i") for r in minimal}
+        assert {3, 11} <= have
+
+    def test_single_record_input_returns_unchanged(self):
+        records = [dict(HEADER), {"kind": "delta", "seq": 1, "i": 0}]
+        minimal, _ = ddmin(records, lambda subset: True)
+        assert len(minimal) == 2
+
+
+def _record_healthy_session():
+    """A short real cluster session under the recorder (one node, two
+    pods, everything binds) — the healthy substrate the tampering tests
+    break in controlled ways."""
+    from nos_tpu.api.config import (
+        GpuPartitionerConfig,
+        SchedulerConfig,
+        TpuAgentConfig,
+    )
+    from nos_tpu.cmd.cluster import build_cluster
+    from nos_tpu.cmd.run import seed_node, seed_pod
+    from nos_tpu.record import FlightRecorder
+
+    fr = FlightRecorder()
+    cluster = build_cluster(
+        partitioner_config=GpuPartitionerConfig(
+            batch_window_timeout_seconds=1.0,
+            batch_window_idle_seconds=0.05,
+        ),
+        scheduler_config=SchedulerConfig(retry_seconds=0.2),
+        flight_recorder=fr,
+    )
+    fr.attach(cluster.store)
+    cluster.add_tpu_node(
+        seed_node({"name": "node-1", "chips": 8, "topology": "2x4"}),
+        TpuAgentConfig(report_config_interval_seconds=0.2),
+    )
+    cluster.store.create(seed_pod({"name": "w1", "chips": 4}))
+    cluster.store.create(seed_pod({"name": "w2", "chips": 4}))
+    cluster.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        pods = cluster.store.list("Pod")
+        if pods and all(
+            p.spec.node_name and p.status.phase == "Running" for p in pods
+        ):
+            break
+        time.sleep(0.2)
+    cluster.wait_idle(10)
+    cluster.stop()
+    fr.detach()
+    assert all(p.spec.node_name for p in cluster.store.list("Pod"))
+    return fr.records()
+
+
+@pytest.fixture(scope="module")
+def healthy_records():
+    return _record_healthy_session()
+
+
+class TestFailureSignature:
+    def test_healthy_log_has_empty_signature(self, healthy_records):
+        assert failure_signature(copy.deepcopy(healthy_records)) == frozenset()
+
+    def test_minimize_returns_healthy_input_untouched(self, healthy_records):
+        records = copy.deepcopy(healthy_records)
+        minimal, signature, probes = minimize_records(records)
+        assert signature == frozenset()
+        assert probes == 0
+        assert minimal is records
+
+
+class TestBrokenBuildMinimization:
+    """The acceptance drill: a deliberately broken recording must shrink
+    to a small repro that still fails the SAME way, and the written
+    fixture must reproduce after a JSONL round trip."""
+
+    def _tamper(self, records):
+        """Flip one recorded bind to 'fail' — the recorded world claims
+        the scheduler refused a pod that replay (same inputs) binds: a
+        guaranteed replay-clean violation, the signature a regressed
+        scheduler build would produce."""
+        records = copy.deepcopy(records)
+        cycle = next(
+            r for r in records
+            if r["kind"] == "scheduler.cycle" and r["decision"] == "bind"
+        )
+        cycle["decision"] = "fail"
+        cycle["node"] = ""
+        cycle["bound"] = []
+        return records
+
+    def test_tampered_log_minimizes_to_small_repro(self, healthy_records, tmp_path):
+        tampered = self._tamper(healthy_records)
+        minimal, signature, probes = minimize_records(tampered)
+        assert oracles.REPLAY_CLEAN in signature_names(signature)
+        # The signature pins the exact drifting record, not just the
+        # oracle name — ddmin must reproduce THIS drift, not any drift.
+        assert any("scheduler.cycle" in s for s in signature)
+        assert probes > 0
+        body = [r for r in minimal if r["kind"] != "session.start"]
+        # The acceptance bound: a handful of deltas + the flipped cycle,
+        # not the whole session.
+        assert len(body) <= 25, f"minimized to {len(body)} records"
+        assert len(minimal) < len(tampered)
+        # The minimal subset still fails in exactly the original way.
+        assert failure_signature(copy.deepcopy(minimal)) == signature
+
+        # Fixture round trip: dump JSONL, reload, drift still reproduces.
+        path = tmp_path / "fixture.jsonl"
+        path.write_text(
+            "\n".join(json.dumps(r, sort_keys=True) for r in minimal) + "\n"
+        )
+        reloaded = load_jsonl(str(path))
+        assert failure_signature(reloaded) == signature
+        report = ReplaySession(load_jsonl(str(path))).run()
+        assert report.drifts, report.render()

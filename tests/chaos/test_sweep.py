@@ -1,0 +1,35 @@
+"""The slow chaos soak (`make chaos` in test form): many seeds, both
+backends, every burst must converge and every log must replay with zero
+drift and zero auditor violations. Marked slow — the tier-1 suite runs
+the single-seed smoke instead (test_smoke.py).
+"""
+import pytest
+
+from nos_tpu.chaos.driver import ChaosConfig, ChaosDriver
+
+pytestmark = pytest.mark.slow
+
+MEMORY_SEEDS = range(0, 25)
+APISERVER_SEEDS = range(0, 4)
+
+
+@pytest.mark.parametrize("seed", MEMORY_SEEDS)
+def test_memory_seed_converges_and_replays_clean(seed):
+    report = ChaosDriver(
+        ChaosConfig(
+            seed=seed, bursts=2, nodes=3, backend="memory",
+            burst_s=0.4, convergence_timeout_s=30.0, minimize=False,
+        )
+    ).run()
+    assert report.ok(), report.render()
+
+
+@pytest.mark.parametrize("seed", APISERVER_SEEDS)
+def test_apiserver_seed_converges_and_replays_clean(seed):
+    report = ChaosDriver(
+        ChaosConfig(
+            seed=seed, bursts=2, nodes=3, backend="apiserver",
+            burst_s=1.0, convergence_timeout_s=30.0, minimize=False,
+        )
+    ).run()
+    assert report.ok(), report.render()

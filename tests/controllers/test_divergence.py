@@ -13,7 +13,19 @@ from nos_tpu.kube.store import KubeStore
 from nos_tpu.partitioning.core import ClusterState
 from nos_tpu.util import metrics
 
-from tests.factory import build_tpu_node
+from tests.factory import build_pod, build_tpu_node
+
+
+def make_store():
+    store = KubeStore()
+    # Same wiring as cmd/partitioner.py: fetch_pending_pods reads pods
+    # through the phase index.
+    store.add_indexer("Pod", constants.INDEX_POD_PHASE, lambda p: [p.status.phase])
+    return store
+
+
+def add_pending_pod(store, name="pend"):
+    store.create(build_pod(name, {constants.RESOURCE_TPU: 4}))
 
 
 def make_controller(store):
@@ -43,8 +55,9 @@ def set_annotations(store, name, spec_geoms, status_free, spec_plan, status_plan
 
 class TestDivergenceWatch:
     def test_acked_divergent_node_fires_immediate_replan(self):
-        store = KubeStore()
+        store = make_store()
         store.create(build_tpu_node(name="n1"))
+        add_pending_pod(store)
         c = make_controller(store)
         # Agent acked plan p1 but reports one 2x2 where spec wants two.
         set_annotations(
@@ -63,7 +76,7 @@ class TestDivergenceWatch:
             c.batcher.stop()
 
     def test_handshake_in_flight_defers_to_plan_gate(self):
-        store = KubeStore()
+        store = make_store()
         store.create(build_tpu_node(name="n1"))
         c = make_controller(store)
         set_annotations(
@@ -77,8 +90,9 @@ class TestDivergenceWatch:
             c.batcher.stop()
 
     def test_converged_node_clears_memo(self):
-        store = KubeStore()
+        store = make_store()
         store.create(build_tpu_node(name="n1"))
+        add_pending_pod(store)
         c = make_controller(store)
         set_annotations(
             store, "n1", {0: {"2x2": 2}}, {0: {"2x2": 1}}, "p1", "p1"
@@ -104,13 +118,44 @@ class TestDivergenceWatch:
             c.batcher.stop()
 
     def test_non_tpu_node_ignored(self):
-        store = KubeStore()
+        store = make_store()
         node = build_tpu_node(name="n1", partitioning=None)
         store.create(node)
         c = make_controller(store)
         c.batcher.start()
         try:
             c.reconcile_node_divergence(Request(name="n1"))
+            assert c.batcher.ready(timeout=0.2) is None
+        finally:
+            c.batcher.stop()
+
+
+class TestDivergenceAdoption:
+    def test_no_pending_pods_spec_adopts_reported_geometry(self):
+        """An acked-but-diverged node with nothing pending must not wedge:
+        there is no demand to replan for, so the spec adopts the reported
+        geometry instead of firing the (no-op) batcher. Found by the chaos
+        harness: node-death mid-actuation left a clamped spec that the
+        agent re-acked forever while the pending set had already drained."""
+        store = make_store()
+        store.create(build_tpu_node(name="n1"))
+        c = make_controller(store)
+        set_annotations(
+            store, "n1", {0: {"2x2": 2}}, {0: {"2x2": 1}}, "p1", "p1"
+        )
+        c.batcher.start()
+        try:
+            before = metrics.DIVERGENCE_REPLANS.value
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert metrics.DIVERGENCE_REPLANS.value == before + 1
+            assert c.batcher.ready(timeout=0.2) is None  # no replan fired
+            spec, status = annot.parse_node_annotations(
+                store.get("Node", "n1").metadata.annotations
+            )
+            assert annot.spec_matches_status(spec, status)
+            # Converged now: a second reconcile is a clean no-op.
+            c.reconcile_node_divergence(Request(name="n1"))
+            assert metrics.DIVERGENCE_REPLANS.value == before + 1
             assert c.batcher.ready(timeout=0.2) is None
         finally:
             c.batcher.stop()

@@ -96,6 +96,8 @@ class TestApiClient:
 
         def consume():
             for event in client.watch(serde.resource_path("Pod"), rv, timeout_seconds=5):
+                if event["type"] == "BOOKMARK":
+                    continue
                 seen.append((event["type"], event["object"]["metadata"]["name"]))
                 if len(seen) >= 2:
                     break

@@ -1,0 +1,145 @@
+"""Leader election under chaos-harness fault conditions: lease loss
+mid-cycle, reacquire after a flap, and the mutual-exclusion invariant the
+chaos driver's monitor asserts — two contenders must never both report
+leadership, however the lease ConfigMap is flapped."""
+import threading
+import time
+
+from nos_tpu.kube.leaderelection import (
+    HOLDER_ANNOTATION,
+    LeaderElector,
+)
+from nos_tpu.kube.store import ConflictError, KubeStore
+
+LEASE = "chaos-lease-test"
+
+
+def make_elector(store, ident, events=None, lease=0.5, renew=0.1):
+    return LeaderElector(
+        store,
+        name=LEASE,
+        identity=ident,
+        lease_duration_s=lease,
+        renew_period_s=renew,
+        on_started_leading=(
+            (lambda: events.append(f"{ident}-up")) if events is not None else None
+        ),
+        on_stopped_leading=(
+            (lambda: events.append(f"{ident}-down")) if events is not None else None
+        ),
+    )
+
+
+class TestLeaseLossMidCycle:
+    def test_conflict_on_renew_demotes_within_deadline(self):
+        """Injected write conflicts (the chaos conflict-writes fault) on
+        every renew: the leader must step down once its renew deadline
+        passes, never wedge, and recover when writes heal."""
+        store = KubeStore()
+        events = []
+        elector = make_elector(store, "a", events, lease=0.4, renew=0.1)
+        elector.start()
+        try:
+            assert elector.wait_for_leadership(5.0)
+            original = store.patch_merge
+
+            def conflicted(*a, **k):
+                raise ConflictError("chaos: injected resource version conflict")
+
+            store.patch_merge = conflicted
+            deadline = time.monotonic() + 5.0
+            while elector.is_leader and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not elector.is_leader
+            assert "a-down" in events
+            store.patch_merge = original
+            assert elector.wait_for_leadership(5.0)
+        finally:
+            elector.stop()
+
+    def test_hijacked_lease_demotes_current_leader(self):
+        """The lease annotation is overwritten out from under the leader
+        (stale-rv world): the next renew observes the foreign holder and
+        steps down instead of splitting the brain."""
+        store = KubeStore()
+        elector = make_elector(store, "a", lease=0.4, renew=0.1)
+        elector.start()
+        try:
+            assert elector.wait_for_leadership(5.0)
+            store.patch_annotations(
+                "ConfigMap", LEASE, "nos-system",
+                {HOLDER_ANNOTATION: "usurper"},
+            )
+            deadline = time.monotonic() + 5.0
+            while elector.is_leader and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not elector.is_leader
+        finally:
+            elector.stop()
+
+
+class TestReacquireAfterFlap:
+    def test_release_hands_over_without_lease_wait(self):
+        """The chaos leader-flap fault is a release(): some contender must
+        hold the lease again well before a full lease duration elapses."""
+        store = KubeStore()
+        a = make_elector(store, "a", lease=5.0, renew=0.1)
+        b = make_elector(store, "b", lease=5.0, renew=0.1)
+        a.start()
+        b.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not (a.is_leader or b.is_leader):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            leader = a if a.is_leader else b
+            flapped = time.monotonic()
+            leader.release()
+            assert not leader.is_leader  # demoted synchronously
+            deadline = time.monotonic() + 5.0
+            while not (a.is_leader or b.is_leader):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            # Reacquired far faster than lease expiry (5s) would allow.
+            assert time.monotonic() - flapped < 2.0
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_repeated_flaps_never_overlap(self):
+        """The chaos driver's monitor, in miniature: flap the leader many
+        times while sampling both contenders — is_leader must never be
+        True on both, and leadership must keep being reacquired."""
+        store = KubeStore()
+        a = make_elector(store, "a", lease=1.0, renew=0.05)
+        b = make_elector(store, "b", lease=1.0, renew=0.05)
+        overlaps = []
+        acquisitions = []
+        stop = threading.Event()
+
+        def monitor():
+            while not stop.is_set():
+                if a.is_leader and b.is_leader:
+                    overlaps.append(time.monotonic())
+                time.sleep(0.002)
+
+        t = threading.Thread(target=monitor, daemon=True)
+        a.start()
+        b.start()
+        t.start()
+        try:
+            for _ in range(6):
+                deadline = time.monotonic() + 5.0
+                while not (a.is_leader or b.is_leader):
+                    assert time.monotonic() < deadline, "leadership never reacquired"
+                    time.sleep(0.005)
+                leader = a if a.is_leader else b
+                acquisitions.append(leader.identity)
+                leader.release()
+        finally:
+            stop.set()
+            t.join(timeout=2.0)
+            a.stop()
+            b.stop()
+        assert not overlaps, f"contenders overlapped {len(overlaps)} time(s)"
+        assert len(acquisitions) == 6
