@@ -17,12 +17,20 @@ rows carry the hit/miss/bypass tallies. The deepcopy engine always runs
 cache-off (it exists to show the pre-optimization cost) and is skipped
 entirely at >= 1024 nodes, where a single plan() takes minutes.
 
+``--plan-mode incremental`` measures steady-state REPLANS instead of cold
+plans: one persistent snapshot + planner across cycles, each cycle
+dirtying ``--churn`` of the nodes through ``refresh_node`` and replanning
+with the dirty set (the partitioner controller's incremental path), over
+a fragmented cluster whose pending residue is mostly unservable — the
+regime a production partitioner spends its life in.
+
 Output: one JSON line per (engine, cache mode, nodes, pods) config with
 p50/p95 plan latency (ms) and forks/sec, e.g.
 
   make bench-planner
   python bench_planner.py --quick
   python bench_planner.py --output BENCH_planner.json
+  python bench_planner.py --plan-mode incremental --churn 0.05
 """
 from __future__ import annotations
 
@@ -99,6 +107,106 @@ def make_pending(n_pods: int):
         {constants.RESOURCE_TPU: 1},
     ]
     return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
+
+
+def build_steady_node(name: str, variant: bool) -> SnapshotNode:
+    """One fragmented node for the steady-state bench: a used 2x2 pins the
+    board (no full-board carve can ever succeed) while free 1x1 slices
+    keep the node in the candidate set. The two variants differ in their
+    free/used 1x1 split so a churn refresh is a real geometry change."""
+    if variant:
+        ann = annot.status_from_devices(
+            free={0: {"1x1": 1}}, used={0: {"2x2": 1, "1x1": 1}}
+        )
+    else:
+        ann = annot.status_from_devices(free={0: {"1x1": 2}}, used={0: {"2x2": 1}})
+    return SnapshotNode(partitionable=TpuNode(build_node(name, ann)))
+
+
+def make_steady_cluster(n_nodes: int) -> ClusterSnapshot:
+    return ClusterSnapshot(
+        {f"node-{i:05d}": build_steady_node(f"node-{i:05d}", False) for i in range(n_nodes)}
+    )
+
+
+def make_steady_pending(n_pods: int):
+    """Steady-state residue: mostly board-sized requests no fragmented
+    node can ever serve (every carve provably futile — the futility memo
+    carries the replan) plus ~10%% small slices the free pool claims each
+    cycle (exercising the claim pre-pass and cross-cycle verdict reuse)."""
+    mixes = [
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("2x4"): 1},
+        {constants.tpu_slice_resource("1x1"): 1},
+    ]
+    return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
+
+
+def bench_incremental(
+    n_nodes: int, n_pods: int, repeats: int, churn: float = 0.05
+) -> dict:
+    """Steady-state replans over ONE persistent snapshot + planner: an
+    untimed cold plan (fallback mode — builds the caches at base
+    versions), then `repeats` timed cycles, each dirtying `churn` of the
+    nodes via refresh_node before replanning with the dirty set. Every
+    timed cycle must take the incremental path."""
+    snapshot = make_steady_cluster(n_nodes)
+    planner = Planner(
+        Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+    )
+    pods = make_steady_pending(n_pods)
+    all_names = set(snapshot.get_nodes())
+    started = time.perf_counter()
+    planner.plan(snapshot, pods, dirty=all_names)
+    cold_ms = (time.perf_counter() - started) * 1e3
+    if planner.last_plan_mode != "fallback":
+        raise RuntimeError(f"cold plan mode {planner.last_plan_mode!r}")
+    k = max(1, int(n_nodes * churn)) if churn > 0 else 0
+    variant: dict = {}
+    latencies = []
+    for cycle in range(repeats + 1):  # cycle 0 is untimed warm-up
+        dirty = set()
+        for j in range(k):
+            name = f"node-{(cycle * k + j) % n_nodes:05d}"
+            variant[name] = not variant.get(name, False)
+            snapshot.refresh_node(name, build_steady_node(name, variant[name]))
+            dirty.add(name)
+        t0 = time.perf_counter()
+        planner.plan(snapshot, pods, dirty=dirty)
+        if cycle > 0:
+            latencies.append(time.perf_counter() - t0)
+        if planner.last_plan_mode != "incremental":
+            raise RuntimeError(f"replan mode {planner.last_plan_mode!r}")
+    quantiles = (
+        statistics.quantiles(latencies, n=20) if len(latencies) > 1 else latencies * 2
+    )
+    hits, misses, bypasses = planner.verdict_cache_stats()
+    eligible = hits + misses
+    return {
+        "bench": "bench_planner_incremental",
+        "engine": "cow",
+        "plan_mode": "incremental",
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "churn": churn,
+        "dirty_per_cycle": k,
+        "cycles": repeats,
+        "cold_plan_ms": round(cold_ms, 2),
+        "p50_replan_ms": round(statistics.median(latencies) * 1e3, 2),
+        "p95_replan_ms": round(quantiles[-1] * 1e3, 2),
+        "replan_speedup_vs_cold": round(
+            cold_ms / (statistics.median(latencies) * 1e3), 1
+        ),
+        "futility_hits_last_cycle": planner._futility_hits,
+        "cache_hit_rate_last_cycle": round(hits / eligible, 4) if eligible else None,
+    }
 
 
 def bench_config(
@@ -185,6 +293,25 @@ def main() -> None:
         default="16x50,64x200,256x400,1024x800",
         help="comma-separated nodesxpods pairs",
     )
+    parser.add_argument(
+        "--plan-mode",
+        default="full",
+        choices=("full", "incremental", "both"),
+        help="full = cold from-scratch plans (the original bench); "
+        "incremental = steady-state replans over one persistent snapshot "
+        "with a churn phase (see bench_incremental)",
+    )
+    parser.add_argument(
+        "--incremental-configs",
+        default="1024x800,4096x800",
+        help="nodesxpods pairs for the incremental mode",
+    )
+    parser.add_argument(
+        "--churn",
+        type=float,
+        default=0.05,
+        help="fraction of nodes dirtied per incremental cycle",
+    )
     parser.add_argument("--repeats", type=int, default=5)
     parser.add_argument("--quick", action="store_true", help="16x50 only, 2 repeats")
     parser.add_argument("--output", default="", help="also append JSON lines to file")
@@ -197,11 +324,23 @@ def main() -> None:
     args = parser.parse_args()
 
     configs = [tuple(map(int, c.split("x"))) for c in args.configs.split(",")]
+    incremental_configs = [
+        tuple(map(int, c.split("x"))) for c in args.incremental_configs.split(",")
+    ]
     repeats = args.repeats
     if args.quick:
         configs, repeats = [(16, 50)], 2
+        incremental_configs = [(64, 100)]
 
     results = []
+    if args.plan_mode in ("incremental", "both"):
+        for n_nodes, n_pods in incremental_configs:
+            result = bench_incremental(n_nodes, n_pods, repeats, churn=args.churn)
+            results.append(result)
+            print(json.dumps(result), flush=True)
+    if args.plan_mode == "incremental":
+        _finish(args, results)
+        return
     for engine in args.engines.split(","):
         # cow runs with the verdict cache on AND off (the off rows are the
         # like-for-like before/after for the cache); deepcopy is the
@@ -225,7 +364,12 @@ def main() -> None:
 
     raw = list(results)
     for a in raw:
-        if not (a["engine"] == "cow" and a["verdict_cache"] == "on" and a["p50_plan_ms"]):
+        # Incremental rows carry no verdict_cache field — .get() skips them.
+        if not (
+            a.get("engine") == "cow"
+            and a.get("verdict_cache") == "on"
+            and a.get("p50_plan_ms")
+        ):
             continue
         for b in raw:
             if (a["nodes"], a["pending_pods"]) != (b["nodes"], b["pending_pods"]):
@@ -250,6 +394,10 @@ def main() -> None:
                 results.append(speedup)
                 print(json.dumps(speedup), flush=True)
 
+    _finish(args, results)
+
+
+def _finish(args, results) -> None:
     if args.output:
         with open(args.output, "a") as fh:
             for result in results:

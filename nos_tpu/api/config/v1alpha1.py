@@ -49,12 +49,24 @@ class GpuPartitionerConfig:
     # mode (record/audit.py). 0 disables auditing entirely; replay always
     # audits exhaustively regardless of this rate.
     audit_sample_rate: float = 0.0
+    # Incremental replanning (controllers/partitioner/incremental.py):
+    # keep one base snapshot alive across plan cycles and warm-start the
+    # planner from store deltas. Off = rebuild snapshot + caches per
+    # cycle (pre-incremental behavior).
+    incremental_planning: bool = True
+    # Dirty fraction above which an incremental cycle falls back to a
+    # from-scratch replan (still base-preserving).
+    incremental_dirty_threshold: float = 0.25
 
     def validate(self) -> None:
         if self.aging_chips_per_second < 0:
             raise ConfigError("aging_chips_per_second must be >= 0")
         if not 0.0 <= self.audit_sample_rate <= 1.0:
             raise ConfigError("audit_sample_rate must be in [0, 1]")
+        if not 0.0 < self.incremental_dirty_threshold <= 1.0:
+            raise ConfigError(
+                "incremental_dirty_threshold must be in (0, 1]"
+            )
         if self.batch_window_timeout_seconds <= 0:
             raise ConfigError("batch_window_timeout_seconds must be > 0")
         if self.batch_window_idle_seconds < 0:

@@ -154,7 +154,19 @@ class ChaosDriver:
         self.cluster = build_cluster(
             store=store,
             partitioner_config=GpuPartitionerConfig(
-                batch_window_timeout_seconds=0.3, batch_window_idle_seconds=0.05
+                batch_window_timeout_seconds=0.3,
+                batch_window_idle_seconds=0.05,
+                # Chaos inverts the production posture: threshold 1.0
+                # forces EVERY base-preserving replan down the incremental
+                # path (production falls back when too much is dirty; here
+                # we want the riskiest path exercised as often as faults
+                # allow), and the live auditor at full sample rate runs
+                # the incremental-vs-from-scratch shadow check on each
+                # one. The auditor_clean oracle fails the burst on any
+                # recorded violation. (Tiny clusters — shadows are cheap.)
+                incremental_planning=True,
+                incremental_dirty_threshold=1.0,
+                audit_sample_rate=1.0,
             ),
             scheduler_config=SchedulerConfig(retry_seconds=0.1),
             flight_recorder=self.recorder,

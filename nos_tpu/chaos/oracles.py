@@ -115,6 +115,16 @@ def auditor_clean(partitioner, store) -> List[str]:
         out.extend(
             f"{AUDITOR_CLEAN}: [{kind}] {v.check}: {v.detail}" for v in violations
         )
+        # The controller's own live auditor (chaos runs it at full sample
+        # rate) sees the plan inputs/outputs this oracle cannot — its
+        # incremental-vs-from-scratch shadow check in particular. Any
+        # violation it recorded during the run fails the oracle too.
+        live = getattr(controller, "auditor", None)
+        if live is not None and live.violations_total:
+            out.append(
+                f"{AUDITOR_CLEAN}: [{kind}] live auditor recorded "
+                f"{live.violations_total} violation(s) during the run"
+            )
     return out
 
 

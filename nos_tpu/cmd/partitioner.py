@@ -115,6 +115,8 @@ def build_partitioner(
         recorder=recorder,
         flight_recorder=flight_recorder,
         auditor=auditor,
+        incremental_planning=config.incremental_planning,
+        incremental_dirty_threshold=config.incremental_dirty_threshold,
     )
 
     node_ctrl = StateNodeController(store, cluster_state, initializer=initializer)
@@ -228,6 +230,8 @@ def build_partitioner(
         recorder=recorder,
         flight_recorder=flight_recorder,
         auditor=auditor,
+        incremental_planning=config.incremental_planning,
+        incremental_dirty_threshold=config.incremental_dirty_threshold,
     )
     manager.add(
         Controller(

@@ -58,6 +58,8 @@ def configs_from(config: dict):
         aging_chips_per_second=p.get("agingChipsPerSecond", 1.0),
         scheduler_name=p.get("schedulerName", constants.SCHEDULER_NAME),
         audit_sample_rate=p.get("auditSampleRate", 0.0),
+        incremental_planning=p.get("incrementalPlanning", True),
+        incremental_dirty_threshold=p.get("incrementalDirtyThreshold", 0.25),
     )
     scheduler = SchedulerConfig(
         retry_seconds=s.get("retrySeconds", 0.5),

@@ -52,6 +52,8 @@ class PartitionerController:
         recorder=None,
         flight_recorder=None,
         auditor=None,
+        incremental_planning: bool = True,
+        incremental_dirty_threshold: Optional[float] = None,
     ) -> None:
         self.store = store
         # Optional kube/events.py EventRecorder: PartitioningApplied when a
@@ -88,6 +90,15 @@ class PartitionerController:
         # Divergence memo: node name -> spec plan id already replanned for,
         # so one infeasible plan triggers exactly one immediate replan.
         self._diverged: dict = {}
+        # Incremental planning: keep one base snapshot alive across cycles
+        # and hand the planner a dirty set derived from store deltas
+        # instead of rebuilding the world (see incremental.py). Off =
+        # the legacy take-snapshot-per-cycle path, bit-identical to prior
+        # releases.
+        self.incremental_planning = incremental_planning
+        if incremental_dirty_threshold is not None:
+            self.planner.incremental_dirty_threshold = incremental_dirty_threshold
+        self._maintainer = None
 
     # ----------------------------------------------------- pod reconcile
 
@@ -276,9 +287,15 @@ class PartitionerController:
         Permit carry no Unschedulable condition, and dropping them from
         the candidates would deadlock a half-formed gang's remaining
         carves."""
+        # copy=False: planning only reads the pods, and stable object
+        # identity across cycles is what lets the planner's id-keyed pod
+        # memos survive an incremental replan (the store replaces objects
+        # on write, so a changed pod is a new object — a fresh memo key).
         return [
             p
-            for p in self.store.list_by_index("Pod", constants.INDEX_POD_PHASE, "Pending")
+            for p in self.store.list_by_index(
+                "Pod", constants.INDEX_POD_PHASE, "Pending", copy=False
+            )
             if not p.spec.node_name
             and (
                 not self.scheduler_name
@@ -306,13 +323,20 @@ class PartitionerController:
             ) as proc:
                 # Snapshot from the live store: pending pods come from the
                 # store, so bindings/usage must too, or the plan races
-                # fresh binds.
+                # fresh binds. Incrementally: drain store deltas into a
+                # dirty set and refresh only those nodes of the persistent
+                # base (the maintainer reads the live store too, after the
+                # same revision watermark — same race profile for replay).
                 with TRACER.span("snapshot.take"):
-                    snapshot = self.snapshot_taker.take_snapshot(
-                        self.cluster_state, store=self.store
-                    )
+                    if self.incremental_planning:
+                        snapshot, dirty = self._maintain_snapshot()
+                    else:
+                        snapshot = self.snapshot_taker.take_snapshot(
+                            self.cluster_state, store=self.store
+                        )
+                        dirty = None
                 current = snapshot.partitioning_state()
-                desired = self.planner.plan(snapshot, pending)
+                desired = self.planner.plan(snapshot, pending, dirty=dirty)
                 plan = PartitioningPlan(desired_state=desired, id=self.plan_id_fn())
                 proc.set_attributes(plan_id=plan.id)
                 with TRACER.span("partitioner.actuate", plan_id=plan.id):
@@ -321,7 +345,11 @@ class PartitionerController:
                 self._record_plan(revision, pending, plan, applied, journey)
                 if self.auditor is not None and self.auditor.should_audit():
                     violations = self.auditor.audit_plan(
-                        self.planner, snapshot, revision=revision
+                        self.planner,
+                        snapshot,
+                        revision=revision,
+                        pending=pending,
+                        desired=desired,
                     )
                     proc.set_attributes(audit_violations=len(violations))
         if applied:
@@ -333,6 +361,17 @@ class PartitionerController:
             )
         self._record_plan_events(pending, applied)
         return applied
+
+    def _maintain_snapshot(self):
+        from nos_tpu.controllers.partitioner.incremental import (
+            IncrementalSnapshotMaintainer,
+        )
+
+        if self._maintainer is None:
+            self._maintainer = IncrementalSnapshotMaintainer(
+                self.store, self.snapshot_taker, kind=self.kind
+            )
+        return self._maintainer.snapshot(self.cluster_state)
 
     def _record_plan(
         self, revision: int, pending: List[Pod], plan, applied: int, journey

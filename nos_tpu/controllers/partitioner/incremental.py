@@ -1,0 +1,231 @@
+"""Persistent-snapshot maintenance for incremental replanning.
+
+The partitioner used to rebuild its ClusterSnapshot from the whole store
+on every cycle and the planner re-walked the world from scratch — O(cluster)
+per replan even when nothing changed. This module keeps ONE base snapshot
+alive across cycles per partitioning mode and turns the store deltas the
+cycle boundary drains into a **dirty set** of node names:
+
+- a Node event dirties that node;
+- a Pod event dirties the node the pod is (or was) bound to — unbound
+  pending pods don't touch any node's observed state;
+- an ElasticQuota SPEC change (min/max bounds, create, delete) forces a
+  full rebuild (quota bounds are cluster-wide planner inputs with no
+  per-node locality). Status-only quota updates — the usage bumps the
+  quota controller writes after every bind — are ignored: the snapshot
+  carries no quota state and every quota-reading plugin
+  (CapacityScheduling) is verdict-uncacheable, re-reading the live
+  store on each trial, so no retained structure can go stale. Without
+  this distinction steady state never exists: each plan's own binds
+  trigger a usage write that would force a rebuild next cycle;
+- a Node entering or leaving this mode's scope (delete, label flip,
+  becoming/ceasing to be a TPU or sharing node) changes the snapshot's
+  SHAPE and forces a full rebuild — the snapshot has no add/remove API by
+  design, so shape changes can never half-apply;
+- an accelerator-generation change on a node forces a full rebuild
+  (request normalization and the accelerator list are cross-node inputs);
+- a drain overflow (event storm) forces a full rebuild — classifying the
+  storm would cost more than replanning.
+
+Dirty nodes are re-snapshotted from the live store through the taker's
+``take_snapshot_node`` — the exact constructor the full take uses — and
+swapped into the base via ``ClusterSnapshot.refresh_node``, which keeps
+the free pool and anti-affinity aggregates exact and stamps a fresh
+mutation tick so the planner's version-keyed memos for the old state
+become unreachable. Re-refreshing a node whose change was already visible
+to the previous rebuild is therefore harmless (idempotent), which is what
+makes the watch-attach / first-build race benign.
+
+The maintainer returns ``(snapshot, dirty)`` where a full rebuild reports
+every node as dirty; the planner maps a fresh snapshot object or an
+oversized dirty set to its from-scratch fallback on its own, so this
+module never needs to agree with the planner's threshold.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+from typing import Optional, Set, Tuple
+
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+
+log = logging.getLogger("nos_tpu.partitioner")
+
+# Store kinds whose deltas the dirty-set derivation understands; anything
+# else never reaches the planner's inputs.
+WATCH_KINDS = ("ElasticQuota", "Node", "Pod")
+
+# Above this many drained events per cycle the per-event classification
+# costs more than a rebuild.
+MAX_EVENTS_PER_DRAIN = 10_000
+
+
+class IncrementalSnapshotMaintainer:
+    """Owns the persistent base ClusterSnapshot for one partitioner mode
+    (tpu or sharing) and derives the per-cycle dirty set from store
+    deltas. Single-threaded by contract: only the partitioner's batch
+    loop calls :meth:`snapshot` (the store's watch queue is the only
+    cross-thread hand-off, and it is a thread-safe queue)."""
+
+    def __init__(self, store, snapshot_taker, kind: str = "tpu") -> None:
+        self.store = store
+        self.taker = snapshot_taker
+        self.kind = kind
+        self._queue = None
+        self._base: Optional[ClusterSnapshot] = None
+        # Names currently in the base — the shape the snapshot was built
+        # with. Kept here so scope checks never walk the snapshot.
+        self._names: Set[str] = set()
+        # Quota key -> spec signature as of the last rebuild, so status-
+        # only quota updates can be told apart from bound changes.
+        self._quota_specs: dict = {}
+        # Test/observability taps.
+        self.full_rebuilds = 0
+        self.nodes_refreshed = 0
+
+    # ------------------------------------------------------------- entry
+
+    def snapshot(self, cluster_state) -> Tuple[ClusterSnapshot, Set[str]]:
+        """The base snapshot plus the names of nodes refreshed since the
+        previous call (a full rebuild reports every node dirty). Must be
+        called once per plan cycle, AFTER the caller read its revision
+        watermark — the maintainer reads the live store, so draining first
+        would widen the recorded race window replay has to reproduce."""
+        if self._queue is None:
+            self._queue = self.store.watch(set(WATCH_KINDS))
+            # Discard the list+watch ADDED replay of existing objects —
+            # the first build below reads the live store directly.
+            self._drain()
+            return self._rebuild(cluster_state)
+        events = self._drain()
+        if events is None:
+            log.info(
+                "partitioner[%s]: delta drain overflow; rebuilding snapshot",
+                self.kind,
+            )
+            return self._rebuild(cluster_state)
+        dirty, rebuild = self._classify(events)
+        if not rebuild:
+            refreshed = self._refresh(dirty)
+            if refreshed is not None:
+                return self._base, refreshed
+        return self._rebuild(cluster_state)
+
+    # ----------------------------------------------------------- internals
+
+    def _drain(self) -> "Optional[list]":
+        """Every queued event, or None on overflow (queue left empty)."""
+        events: list = []
+        q = self._queue
+        overflow = False
+        while True:
+            try:
+                event = q.get_nowait()
+            except queue.Empty:
+                return None if overflow else events
+            if not overflow:
+                events.append(event)
+                overflow = len(events) > MAX_EVENTS_PER_DRAIN
+
+    def _classify(self, events) -> Tuple[Set[str], bool]:
+        """(dirty node names, full-rebuild?). Conservative by design: any
+        delta whose node-local footprint is unclear escalates to a
+        rebuild rather than guessing."""
+        dirty: Set[str] = set()
+        for event in events:
+            kind = event.kind
+            if kind == "ElasticQuota":
+                meta = event.object.metadata
+                key = f"{meta.namespace}/{meta.name}"
+                if event.type == "DELETED":
+                    if key in self._quota_specs:
+                        return dirty, True
+                    continue
+                sig = _quota_spec_signature(event.object)
+                if self._quota_specs.get(key) == sig:
+                    continue  # status-only update: planner-neutral
+                return dirty, True
+            obj = event.object
+            if kind == "Pod":
+                node_name = obj.spec.node_name
+                if node_name and node_name in self._names:
+                    dirty.add(node_name)
+                continue
+            # Node event. Deleting a node we snapshot is a shape change;
+            # deletes of out-of-scope nodes never mattered.
+            name = obj.metadata.name
+            if event.type == "DELETED":
+                if name in self._names:
+                    return dirty, True
+                continue
+            # ADDED/MODIFIED: scope membership is resolved against the
+            # live store in _refresh (events can be stale).
+            dirty.add(name)
+        return dirty, False
+
+    def _refresh(self, dirty: Set[str]) -> Optional[Set[str]]:
+        """Re-snapshot each dirty node from the live store into the base.
+        Returns the refreshed names, or None when a scope transition was
+        discovered (caller rebuilds). Two copy-free store passes fetch
+        the dirty nodes and their bound pods — no per-node index scans,
+        no walk of the untouched part of the base."""
+        if not dirty:
+            return set()
+        nodes_by_name = {}
+        for node in self.store.list("Node", copy=False):
+            if node.metadata.name in dirty:
+                nodes_by_name[node.metadata.name] = node
+        pods_by_node: dict = {name: [] for name in dirty}
+        for pod in self.store.list("Pod", copy=False):
+            bucket = pods_by_node.get(pod.spec.node_name)
+            if bucket is not None and pod.status.phase in ("Pending", "Running"):
+                bucket.append(pod)
+        refreshed: Set[str] = set()
+        for name in sorted(dirty):
+            node = nodes_by_name.get(name)
+            in_base = name in self._names
+            snap_node = (
+                self.taker.take_snapshot_node(node, pods_by_node[name])
+                if node is not None
+                else None
+            )
+            if snap_node is None:
+                if in_base:
+                    # Left our scope (deleted between drain and list, or
+                    # label/eligibility flip): shape change.
+                    return None
+                continue  # never ours — another mode's node, ignore
+            if not in_base:
+                return None  # entered our scope: shape change
+            old = self._base.get_node(name)
+            if getattr(snap_node.partitionable, "accelerator", None) != getattr(
+                old.partitionable, "accelerator", None
+            ):
+                # Generation swap changes request normalization for every
+                # pod signature — cheaper to re-key the world than reason
+                # about which memos survive.
+                return None
+            self._base.refresh_node(name, snap_node)
+            refreshed.add(name)
+        self.nodes_refreshed += len(refreshed)
+        return refreshed
+
+    def _rebuild(self, cluster_state) -> Tuple[ClusterSnapshot, Set[str]]:
+        self._base = self.taker.take_snapshot(cluster_state, store=self.store)
+        self._names = set(self._base.get_nodes())
+        self._quota_specs = {
+            f"{q.metadata.namespace}/{q.metadata.name}": _quota_spec_signature(q)
+            for q in self.store.list("ElasticQuota", copy=False)
+        }
+        self.full_rebuilds += 1
+        return self._base, set(self._names)
+
+
+def _quota_spec_signature(quota) -> tuple:
+    """Canonical hash input for the planner-relevant part of a quota: its
+    bounds. Status (usage) is derived state the planner re-reads live."""
+    spec = quota.spec
+    return (
+        tuple(sorted(spec.min.items())),
+        tuple(sorted(spec.max.items())),
+    )

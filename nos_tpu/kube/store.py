@@ -257,11 +257,17 @@ class KubeStore:
     def add_indexer(self, kind: str, index_name: str, fn: Callable[[Any], List[str]]) -> None:
         self._indexers[(kind, index_name)] = fn
 
-    def list_by_index(self, kind: str, index_name: str, value: str) -> List[Any]:
+    def list_by_index(
+        self, kind: str, index_name: str, value: str, copy: bool = True
+    ) -> List[Any]:
+        """``copy=False`` has the same read-only contract as ``list``; it
+        additionally keeps object identity stable across calls for
+        unchanged objects, which the planner's id-keyed pod memos rely on
+        between incremental plan cycles."""
         fn = self._indexers.get((kind, index_name))
         if fn is None:
             raise KeyError(f"no indexer {index_name!r} for kind {kind!r}")
-        return self.list(kind, filter_fn=lambda o: value in fn(o))
+        return self.list(kind, filter_fn=lambda o: value in fn(o), copy=copy)
 
     # ---------------------------------------------------------------- watch
 

@@ -29,7 +29,23 @@ candidate-node order keyed by the snapshot-wide ``state_version``,
 simulated NodeInfo views keyed by (node, version), and a carve-futility
 memo keyed by (node, version, lacking signature) that skips fork+carve
 trials ``update_geometry_for`` already proved to be geometry no-ops. All
-of it is per-plan state, rebuilt at every ``plan()`` entry.
+of it is per-plan state, rebuilt at every ``plan()`` entry — except in
+incremental mode, where still-valid version-keyed entries survive.
+
+Incremental replans: callers that maintain ONE persistent base snapshot
+across cycles (every out-of-band change applied via
+``snapshot.refresh_node``, which stamps a fresh mutation tick) pass
+``plan(..., dirty=<changed node names>)``. The plan then runs inside an
+outer fork that is reverted after the result is taken — the base snapshot
+is left at observed state, node versions restored — and, when the same
+snapshot object returns with a small enough dirty fraction,
+``_prune_plan_caches`` retains every memo entry whose version key still
+matches a live node instead of rebuilding the world: untouched nodes keep
+their verdicts, NodeInfo views, candidate order and futility proofs, so a
+steady-state replan degenerates to O(nodes) memo probes plus work on the
+dirty set. A snapshot-identity change or an oversized dirty set falls back
+to a from-scratch pass (still base-preserving); ``dirty=None`` is the
+legacy snapshot-consuming path, bit-identical to prior releases.
 
 Diagnosability: every ``_plan`` exit leaves ``last_unserved`` mapping each
 still-unserved pending pod to a human-readable reason (its lacking slice
@@ -148,6 +164,7 @@ class Planner:
         verdict_cache_enabled: bool = True,
         reuse_gang_trial: bool = True,
         futility_memo_enabled: bool = True,
+        incremental_dirty_threshold: float = 0.25,
     ) -> None:
         self.framework = framework
         self.aging_chips_per_second = aging_chips_per_second
@@ -156,6 +173,12 @@ class Planner:
         self.verdict_cache_enabled = verdict_cache_enabled
         self.reuse_gang_trial = reuse_gang_trial
         self.futility_memo_enabled = futility_memo_enabled
+        # Above this dirty fraction, deriving what survives costs more
+        # than replanning: take the from-scratch fallback instead.
+        self.incremental_dirty_threshold = incremental_dirty_threshold
+        # Mode the most recent plan() executed in — "full", "incremental"
+        # or "fallback"; read by the audit shadow check and tests.
+        self.last_plan_mode = "full"
         # namespaced_name -> reason for every pending pod the most recent
         # _plan could not serve; read by the partitioner controller for
         # CarveFailed Events. Valid until the next plan() overwrites it.
@@ -246,6 +269,64 @@ class Planner:
         if snapshot is not self._cache_snapshot:
             self._reset_plan_caches(snapshot)
 
+    def _prune_plan_caches(
+        self, snapshot: ClusterSnapshot, pending_pods: List[Pod]
+    ) -> None:
+        """Incremental-mode cache retention: evict exactly the entries a
+        dirtied key can no longer reach, keep everything else. Soundness
+        rests on the mutation clock — ``refresh_node`` stamps a fresh,
+        never-repeated tick on every out-of-band change, and the outer
+        fork/revert around a base-preserving plan restores pre-plan
+        versions — so an entry whose version key still matches the live
+        node describes a bit-identical state. Pod-identity-keyed entries
+        pin the pod object, so a key found in the current pending set is
+        necessarily the same object it was built from. Hit/miss counters
+        reset here: stats stay per-plan even when entries don't."""
+        self._verdict_cache.reset_stats()
+        self._futility_hits = 0
+        version_of = snapshot.node_version
+        entries = self._verdict_cache.entries
+        for key in [k for k in entries if version_of(k[1]) != k[2]]:
+            del entries[key]
+        infos = self._node_info_cache
+        for key in [k for k in infos if version_of(k[0]) != k[1]]:
+            del infos[key]
+        futility = self._futility_cache
+        for key in [k for k in futility if version_of(k[0]) != k[1]]:
+            del futility[key]
+        state_version = snapshot.state_version
+        lacking = self._lacking_cache
+        for key in [k for k in lacking if k[1] != state_version]:
+            del lacking[key]
+        if (
+            self._candidate_cache is not None
+            and self._candidate_cache[0] != state_version
+        ):
+            self._candidate_cache = None
+        live = {id(p) for p in pending_pods}
+        sims = self._sim_pod_cache
+        for key in [k for k in sims if k[0] not in live]:
+            del sims[key]
+        requests = self._request_cache
+        for key in [k for k in requests if k not in live]:
+            del requests[key]
+
+    def _select_plan_mode(
+        self, snapshot: ClusterSnapshot, dirty: "Optional[set]"
+    ) -> str:
+        if dirty is None:
+            return "full"
+        if snapshot is not self._cache_snapshot:
+            # New snapshot object: every memo key is meaningless (foreign
+            # mutation clock). Also the cold-start path of a persistent
+            # base — the fallback pass builds caches at base versions,
+            # which the revert preserves for the next cycle.
+            return "fallback"
+        total = snapshot.node_count()
+        if total and len(dirty) <= self.incremental_dirty_threshold * total:
+            return "incremental"
+        return "fallback"
+
     # ----------------------------------------------------------- entry
 
     def plan(
@@ -253,23 +334,44 @@ class Planner:
         snapshot: ClusterSnapshot,
         pending_pods: List[Pod],
         pending_ages: Optional[Dict[str, float]] = None,
+        dirty: "Optional[set]" = None,
     ) -> PartitioningState:
         """``pending_ages`` (namespaced_name -> seconds pending) overrides
         the planner's own first-seen bookkeeping — replay passes the
-        recorded ages so the aging-dependent candidate sort reproduces."""
+        recorded ages so the aging-dependent candidate sort reproduces.
+
+        ``dirty`` opts into base-preserving planning: the caller owns a
+        persistent snapshot whose ONLY out-of-band mutations since the
+        last plan() went through ``refresh_node``, and ``dirty`` names the
+        refreshed nodes. The plan runs in an outer fork reverted before
+        returning, so the base stays at observed state. ``dirty=None`` is
+        the legacy path: caches rebuilt, snapshot mutated in place."""
         started = time.monotonic()
+        mode = self._select_plan_mode(snapshot, dirty)
         with TRACER.span(
             "partitioner.plan",
             pending_pods=len(pending_pods),
-            nodes=len(snapshot.get_nodes()),
+            nodes=snapshot.node_count(),
+            plan_mode=mode,
+            dirty_nodes=-1 if dirty is None else len(dirty),
         ) as span:
-            # Unconditional rebuild even for a repeated snapshot object:
-            # out-of-band mutations between plan() calls (controller
-            # refreshes) don't all pass through the stamped mutators.
-            self._reset_plan_caches(snapshot)
+            if mode == "incremental":
+                self._prune_plan_caches(snapshot, pending_pods)
+            else:
+                # Full rebuild — for dirty=None also because out-of-band
+                # mutations between plan() calls need not pass through
+                # the stamped mutators on that legacy contract.
+                self._reset_plan_caches(snapshot)
+            self.last_plan_mode = mode
+            metrics.PLAN_MODE.labels(mode=mode).inc()
+            base_preserving = dirty is not None
+            if base_preserving:
+                snapshot.fork()
             try:
                 return self._plan(snapshot, pending_pods, span, pending_ages)
             finally:
+                if base_preserving:
+                    snapshot.revert()
                 metrics.PLAN_DURATION.observe(time.monotonic() - started)
                 self._flush_cache_stats(span)
 
@@ -559,7 +661,15 @@ class Planner:
         for pod in candidates:
             if pod in tracker:
                 continue
+            claims_slices = self._claims_free_slices(pod)
             for node_name in self._candidate_nodes(snapshot):
+                # Exhausted nodes sort FIRST in best-fit order (0 free
+                # chips) yet can never serve a slice-consuming claim —
+                # skipping them here is add_pod's exact no-fit
+                # precondition, not a heuristic, and avoids running the
+                # simulation against nodes with nothing left to give.
+                if claims_slices and not snapshot.node_has_free_slices(node_name):
+                    continue
                 if self._try_add_pod(snapshot, node_name, pod):
                     placed.append(pod)
                     break
@@ -709,6 +819,24 @@ class Planner:
             entry = (pod, tuple(sorted(res.compute_pod_request(pod).items())))
             self._request_cache[id(pod)] = entry
         return entry[1]
+
+    def _claims_free_slices(self, pod: Pod) -> bool:
+        """Whether binding this pod must consume a free slice: it names a
+        partitionable resource (plain chips, a slice, or a shared slice).
+        Such a pod cannot fit a node with no free slices — add_pod either
+        takes a free slice or returns False — so the claim pre-pass skips
+        exhausted nodes for it. Pods with no partitionable request
+        trivially fit anywhere and keep the original probe order."""
+        for name, qty in self._request_signature(pod):
+            if not qty:
+                continue
+            if (
+                name == constants.RESOURCE_TPU
+                or constants.is_tpu_slice_resource(name)
+                or constants.is_tpu_shared_resource(name)
+            ):
+                return True
+        return False
 
     def _has_lacking(self, snapshot: ClusterSnapshot, pod: Pod) -> bool:
         """bool(get_lacking_slices), memoized on (request signature,

@@ -44,6 +44,7 @@ must not rely on them.
 """
 from __future__ import annotations
 
+import bisect
 import copy
 import itertools
 from dataclasses import dataclass, field
@@ -139,10 +140,20 @@ class ClusterSnapshot:
         # increments it and fork/revert checkpoint it, so
         # has_anti_affinity_pods() never rescans the cluster per trial.
         self._anti_count: Optional[int] = None
-        # node name -> (version, free chips, has_free_capacity): the
-        # best-fit candidate sort reads both per node per call, and the
-        # version key keeps entries exact across mutation and revert.
+        # node name -> (version, free chips, has_free_capacity,
+        # has_free_slices): the best-fit candidate sort reads these per
+        # node per call, and the version key keeps entries exact across
+        # mutation and revert.
         self._free_chips_cache: Dict[str, tuple] = {}
+        # Best-fit candidate order, maintained incrementally: (order list,
+        # state_version at build) plus the names mutated since the build.
+        # A placement dirties ONE node, so the next call repairs the prior
+        # order (drop dirty names, re-insert by current key) instead of
+        # re-sorting the whole cluster — the repair reproduces the full
+        # sort exactly because untouched nodes keep their keys and the
+        # (chips, name) key is a total order.
+        self._cand_cache: Optional[tuple] = None
+        self._cand_dirty: set = set()
 
     # ------------------------------------------------------ fork/commit
 
@@ -193,6 +204,8 @@ class ClusterSnapshot:
         journal = self._journals.pop()
         for name, backup in journal.items():
             self._nodes[name] = backup
+        # Restored nodes differ from any candidate order built mid-fork.
+        self._cand_dirty.update(journal)
         self._free_pool, self.state_version, self._anti_count = (
             self._pool_backups.pop()
         )
@@ -214,6 +227,54 @@ class ClusterSnapshot:
             return
         journal[name] = node.plan_clone()
         metrics.SNAPSHOT_NODES_COPIED.inc()
+
+    # ------------------------------------------------ cross-cycle refresh
+
+    def node_count(self) -> int:
+        return len(self._nodes)
+
+    def node_version(self, name: str) -> int:
+        """O(1) mutation-clock read for one node (-1 = absent). The
+        incremental planner revalidates version-keyed cache entries with
+        this instead of walking ``get_nodes()``."""
+        node = self._nodes.get(name)
+        return node.version if node is not None else -1
+
+    def refresh_node(self, name: str, replacement: SnapshotNode) -> None:
+        """Replace one node's observed state between plan cycles, keeping
+        every incremental aggregate exact: the free pool absorbs the
+        old→new free-slice delta, the anti-affinity count is adjusted by
+        the old and new pod sets, and the replacement is stamped with a
+        fresh mutation tick so every version-keyed cache entry for the old
+        state becomes unreachable (never wrong). This is the ONLY
+        sanctioned out-of-band mutation for a snapshot used as a
+        persistent planning base — node additions and removals change the
+        snapshot's shape and require rebuilding it instead.
+
+        Refusing to run under an active fork is load-bearing: a mid-trial
+        replacement would bypass the journal and survive revert."""
+        if self._journals:
+            raise RuntimeError("refresh_node during an active fork")
+        old = self._nodes.get(name)
+        if old is None:
+            raise KeyError(f"refresh_node: unknown node {name!r}")
+        before = dict(old.partitionable.free_slices())
+        if self._anti_count is not None:
+            self._anti_count -= sum(
+                1 for p in old.pods if p.spec.pod_anti_affinity
+            )
+            self._anti_count += sum(
+                1 for p in replacement.pods if p.spec.pod_anti_affinity
+            )
+        if getattr(replacement.partitionable, "accelerator", "") != getattr(
+            old.partitionable, "accelerator", ""
+        ):
+            self._accel_cache = None
+        self._nodes[name] = replacement
+        self._apply_free_delta(before, replacement)
+        self._free_chips_cache.pop(name, None)
+        self._sim_cache = None
+        self._stamp(replacement)
 
     # --------------------------------------------------------- queries
 
@@ -242,20 +303,31 @@ class ClusterSnapshot:
         return self._accel_cache
 
     def _node_free_state(self, name: str, node: SnapshotNode) -> tuple:
-        """(free chips, has_free_capacity) for one node, memoized on its
-        mutation version — the candidate sort reads both for every node on
-        every call, and most nodes are untouched between calls."""
+        """(free chips, has_free_capacity, has_free_slices) for one node,
+        memoized on its mutation version — the candidate sort reads these
+        for every node on every call, and most nodes are untouched between
+        calls."""
         cached = self._free_chips_cache.get(name)
         if cached is not None and cached[0] == node.version:
-            return cached[1], cached[2]
+            return cached[1], cached[2], cached[3]
         part = node.partitionable
-        chips = sum(
-            topology_chips(profile) * qty
-            for profile, qty in part.free_slices().items()
-        )
+        free = part.free_slices()
+        chips = sum(topology_chips(profile) * qty for profile, qty in free.items())
         has_free = part.has_free_capacity()
-        self._free_chips_cache[name] = (node.version, chips, has_free)
-        return chips, has_free
+        self._free_chips_cache[name] = (node.version, chips, has_free, bool(free))
+        return chips, has_free, bool(free)
+
+    def node_has_free_slices(self, name: str) -> bool:
+        """Whether `name` currently exposes any free slice — the exact
+        precondition for add_pod() to place a slice-consuming pod, read
+        through the version-keyed memo so the claim pre-pass can skip
+        exhausted nodes without probing them."""
+        node = self._nodes.get(name)
+        return bool(node) and self._node_free_state(name, node)[2]
+
+    def _cand_sort_key(self, name: str) -> tuple:
+        node = self._nodes[name]
+        return self._node_free_state(name, node)[0], name
 
     def get_candidate_nodes(self) -> List[str]:
         """Nodes whose geometry could still change or serve slices.
@@ -263,19 +335,43 @@ class ClusterSnapshot:
         Best-fit order — fewest free chips first, name for determinism —
         instead of the reference's plain name order (snapshot.go:119-130):
         small lacking slices carve out of already-fragmented nodes, so
-        whole free boards survive for board-sized requests."""
-        states = {
-            name: self._node_free_state(name, node)
-            for name, node in self._nodes.items()
-        }
-        return [
-            name
-            for name, node in sorted(
-                self._nodes.items(),
-                key=lambda kv: (states[kv[0]][0], kv[0]),
-            )
-            if states[name][1] and not node.frozen
-        ]
+        whole free boards survive for board-sized requests.
+
+        The order is cached and repaired incrementally: a plan placement
+        dirties one node, so re-sorting the whole cluster per call (the
+        dominant replan cost at 1k+ nodes) is replaced by dropping the
+        dirty names from the previous order and bisect-inserting them at
+        their current keys — byte-identical output to the full sort."""
+        cached = self._cand_cache
+        if cached is not None and cached[1] == self.state_version:
+            return cached[0]
+        dirty = self._cand_dirty
+        if cached is not None and len(dirty) * 8 <= len(self._nodes):
+            order = [n for n in cached[0] if n not in dirty]
+            for name in sorted(dirty):
+                node = self._nodes.get(name)
+                if node is None or node.frozen:
+                    continue
+                chips, has_free, _ = self._node_free_state(name, node)
+                if not has_free:
+                    continue
+                bisect.insort(order, name, key=self._cand_sort_key)
+        else:
+            states = {
+                name: self._node_free_state(name, node)
+                for name, node in self._nodes.items()
+            }
+            order = [
+                name
+                for name, node in sorted(
+                    self._nodes.items(),
+                    key=lambda kv: (states[kv[0]][0], kv[0]),
+                )
+                if states[name][1] and not node.frozen
+            ]
+        self._cand_cache = (order, self.state_version)
+        dirty.clear()
+        return order
 
     def _compute_free_pool(self) -> ResourceList:
         total: ResourceList = {}
@@ -302,6 +398,8 @@ class ClusterSnapshot:
         self._free_chips_cache = {}
         self._anti_count = None
         self._sim_cache = None
+        self._cand_cache = None
+        self._cand_dirty.clear()
         self.state_version = next(self._mutation_clock)
 
     def _stamp(self, node: SnapshotNode) -> None:
@@ -309,6 +407,7 @@ class ClusterSnapshot:
         tick = next(self._mutation_clock)
         node.version = tick
         self.state_version = tick
+        self._cand_dirty.add(node.name)
 
     def _apply_free_delta(self, before: "Dict[str, int]", node: SnapshotNode) -> None:
         """Fold the change in one node's free slices into the cluster pool."""
@@ -456,6 +555,8 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._sim_cache = None
         self._anti_count = None
         self._free_chips_cache = {}
+        self._cand_cache = None
+        self._cand_dirty.clear()
 
     def commit(self) -> int:
         if not self._deep_stack:
@@ -464,6 +565,8 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._sim_cache = None
         self._anti_count = None
         self._free_chips_cache = {}
+        self._cand_cache = None
+        self._cand_dirty.clear()
         return len(self._nodes)
 
     def revert(self) -> int:
@@ -476,6 +579,8 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._sim_cache = None
         self._anti_count = None
         self._free_chips_cache = {}
+        self._cand_cache = None
+        self._cand_dirty.clear()
         return len(self._nodes)
 
     @property

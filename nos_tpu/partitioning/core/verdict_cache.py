@@ -82,9 +82,11 @@ def needs_cluster_context(pod: Pod) -> bool:
 
 class VerdictCache:
     """One plan() invocation's verdict memo plus its hit/miss/bypass
-    ledger. Entries never need eviction: the planner creates a fresh
-    cache per plan(), and within a plan the version keys make stale
-    entries unreachable rather than wrong."""
+    ledger. Entries never need mid-plan eviction: the version keys make
+    stale entries unreachable rather than wrong. The planner rebuilds the
+    cache per plan() on the full path; incremental plans instead prune
+    entries whose version key no longer matches a live node and call
+    ``reset_stats`` so the ledger stays per-plan."""
 
     __slots__ = ("entries", "hits", "misses", "bypasses")
 
@@ -106,6 +108,14 @@ class VerdictCache:
 
     def put(self, key: Tuple[tuple, str, int], verdict: bool) -> None:
         self.entries[key] = verdict
+
+    def reset_stats(self) -> None:
+        """Zero the ledger while keeping entries — incremental plan mode
+        carries still-valid entries across plan() calls, but hit/miss
+        accounting stays per-plan."""
+        self.hits = 0
+        self.misses = 0
+        self.bypasses = 0
 
     def stats(self) -> Tuple[int, int, int]:
         return (self.hits, self.misses, self.bypasses)

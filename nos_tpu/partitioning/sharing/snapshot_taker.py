@@ -17,11 +17,25 @@ from nos_tpu.tpu.sharing import SharingNode
 
 
 class SharingSnapshotTaker:
-    def take_snapshot(self, state: ClusterState, store=None) -> ClusterSnapshot:
-        from nos_tpu.partitioning.tpu.snapshot_taker import (
-            _plan_in_flight,
-            live_cluster_view,
+    def take_snapshot_node(self, node, pods) -> "SnapshotNode | None":
+        """One node's snapshot entry, or None when the node is outside
+        this taker's scope — shared by the full take and the incremental
+        per-node refresh path."""
+        from nos_tpu.partitioning.tpu.snapshot_taker import _plan_in_flight
+
+        if not is_sharing_partitioning_enabled(node):
+            return None
+        sharing_node = SharingNode(node, owned=True)
+        if not sharing_node.is_sharing_node:
+            return None
+        return SnapshotNode(
+            partitionable=sharing_node,
+            pods=list(pods),
+            frozen=_plan_in_flight(node),
         )
+
+    def take_snapshot(self, state: ClusterState, store=None) -> ClusterSnapshot:
+        from nos_tpu.partitioning.tpu.snapshot_taker import live_cluster_view
 
         if store is not None:
             view = live_cluster_view(store)
@@ -33,14 +47,7 @@ class SharingSnapshotTaker:
             }
         nodes: Dict[str, SnapshotNode] = {}
         for name, (node, pods) in view.items():
-            if not is_sharing_partitioning_enabled(node):
-                continue
-            sharing_node = SharingNode(node, owned=True)
-            if not sharing_node.is_sharing_node:
-                continue
-            nodes[name] = SnapshotNode(
-                partitionable=sharing_node,
-                pods=list(pods),
-                frozen=_plan_in_flight(node),
-            )
+            snap_node = self.take_snapshot_node(node, pods)
+            if snap_node is not None:
+                nodes[name] = snap_node
         return ClusterSnapshot(nodes, codec=SharedSliceCodec())

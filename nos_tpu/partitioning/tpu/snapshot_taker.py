@@ -49,6 +49,25 @@ def live_cluster_view(store) -> "Dict[str, tuple]":
 
 
 class TpuSnapshotTaker:
+    def take_snapshot_node(self, node, pods) -> "SnapshotNode | None":
+        """One node's snapshot entry, or None when the node is outside
+        this taker's scope (not TPU-partitioning-labeled, or not a TPU
+        node). Shared by the full take and the incremental per-node
+        refresh path, so both build bit-identical SnapshotNodes."""
+        if not is_tpu_partitioning_enabled(node):
+            return None
+        tpu_node = TpuNode(node, owned=True)
+        if not tpu_node.is_tpu_node:
+            return None
+        # Plan against live pod bindings, not the reporter's (possibly
+        # stale) used/free split — see rebuild_usage_from_pods.
+        tpu_node.rebuild_usage_from_pods(pods)
+        return SnapshotNode(
+            partitionable=tpu_node,
+            pods=list(pods),
+            frozen=_plan_in_flight(node),
+        )
+
     def take_snapshot(self, state: ClusterState, store=None) -> ClusterSnapshot:
         if store is not None:
             view = live_cluster_view(store)
@@ -63,17 +82,7 @@ class TpuSnapshotTaker:
             }
         nodes: Dict[str, SnapshotNode] = {}
         for name, (node, pods) in view.items():
-            if not is_tpu_partitioning_enabled(node):
-                continue
-            tpu_node = TpuNode(node, owned=True)
-            if not tpu_node.is_tpu_node:
-                continue
-            # Plan against live pod bindings, not the reporter's (possibly
-            # stale) used/free split — see rebuild_usage_from_pods.
-            tpu_node.rebuild_usage_from_pods(pods)
-            nodes[name] = SnapshotNode(
-                partitionable=tpu_node,
-                pods=list(pods),
-                frozen=_plan_in_flight(node),
-            )
+            snap_node = self.take_snapshot_node(node, pods)
+            if snap_node is not None:
+                nodes[name] = snap_node
         return ClusterSnapshot(nodes)

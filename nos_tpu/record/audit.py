@@ -23,9 +23,20 @@ Named checks:
                       no two live nodes share a nonzero tick
 - ``carve_futility``  memoized "carve is a no-op" entries vs. an actual
                       forked carve attempt (reverted)
+- ``incremental_plan`` a warm-started (incremental-mode) plan's desired
+                      PartitioningState and unserved reasons vs. a
+                      from-scratch shadow replan of the same pending set
+                      on a fresh clone of the base snapshot (runs only
+                      when the audited plan actually took the incremental
+                      path and the controller passed its inputs along)
 
 Live mode samples (deterministic counter stride, config-controlled) and
-caps per-check work; replay audits exhaustively.
+caps per-check work; replay audits exhaustively. Replay is ALSO the
+exhaustive oracle for incremental planning as a whole: live records the
+incrementally-computed desired state, while replayed planners always run
+the full from-scratch path — the replay driver's desired-state diff is
+therefore an end-to-end incremental-vs-from-scratch comparison over every
+recorded plan, with the live shadow check naturally idle there.
 """
 from __future__ import annotations
 
@@ -42,6 +53,7 @@ CHECKS = (
     "free_pool",
     "mutation_clock",
     "carve_futility",
+    "incremental_plan",
 )
 
 
@@ -103,17 +115,30 @@ class InvariantAuditor:
     # ----------------------------------------------------------- entry
 
     def audit_plan(
-        self, planner, snapshot, exhaustive: bool = False, revision: int = 0
+        self,
+        planner,
+        snapshot,
+        exhaustive: bool = False,
+        revision: int = 0,
+        pending=None,
+        desired=None,
     ) -> List[AuditViolation]:
         """Run every check against the given planner's just-completed
         plan() state. Publishes violations (metric, Event, flight record)
-        and returns them."""
+        and returns them. ``pending``/``desired`` are the plan's inputs
+        and output — callers that have them (the partitioner controller)
+        pass them so the incremental-plan shadow check can replan; callers
+        auditing only structural invariants (chaos oracles, replay) omit
+        them and that check idles."""
         violations: List[AuditViolation] = []
         violations += self.check_free_pool(snapshot)
         violations += self.check_mutation_clock(snapshot)
         violations += self.check_lacking_totals(planner.last_tracker)
         violations += self.check_verdict_cache(planner, snapshot, exhaustive)
         violations += self.check_carve_futility(planner, snapshot, exhaustive)
+        violations += self.check_incremental_plan(
+            planner, snapshot, pending, desired
+        )
         self.publish(violations, snapshot, revision)
         return violations
 
@@ -300,6 +325,97 @@ class InvariantAuditor:
             if limit is not None and checked >= limit:
                 break
         return out
+
+    def check_incremental_plan(
+        self, planner, snapshot, pending, desired
+    ) -> List[AuditViolation]:
+        """Warm-start correctness, checked end to end: when the audited
+        plan() ran in incremental mode, replan the same pending set from
+        scratch — fresh planner, fresh clone of the base snapshot, the
+        recorded fairness ages — and require the identical desired
+        PartitioningState and unserved reasons.
+
+        A disagreement is arbitrated with a SECOND from-scratch run
+        before it counts: the framework's uncacheable plugins read the
+        live store, which other control loops may have advanced since the
+        audited plan ran. Two shadows agreeing with each other but not
+        with the incremental result is cache drift; shadows disagreeing
+        between themselves means the inputs moved under us, which is a
+        race, not a violation."""
+        if desired is None or pending is None:
+            return []
+        if getattr(planner, "last_plan_mode", "full") != "incremental":
+            return []
+        from nos_tpu.partitioning.core.partition_state import (
+            partitioning_state_equal,
+            partitioning_state_to_dict,
+        )
+
+        first, first_unserved = self._shadow_plan(planner, snapshot, pending)
+        desired_ok = partitioning_state_equal(desired, first)
+        unserved_ok = dict(planner.last_unserved) == first_unserved
+        if desired_ok and unserved_ok:
+            return []
+        second, second_unserved = self._shadow_plan(planner, snapshot, pending)
+        if (
+            not partitioning_state_equal(first, second)
+            or first_unserved != second_unserved
+        ):
+            return []  # the shadow inputs themselves raced; inconclusive
+        out: List[AuditViolation] = []
+        if not desired_ok:
+            out.append(
+                AuditViolation(
+                    check="incremental_plan",
+                    subject="desired",
+                    detail=(
+                        "incremental desired state "
+                        f"{partitioning_state_to_dict(desired)} != "
+                        f"from-scratch {partitioning_state_to_dict(first)}"
+                    ),
+                )
+            )
+        if not unserved_ok:
+            out.append(
+                AuditViolation(
+                    check="incremental_plan",
+                    subject="unserved",
+                    detail=(
+                        f"incremental unserved {dict(planner.last_unserved)}"
+                        f" != from-scratch {first_unserved}"
+                    ),
+                )
+            )
+        return out
+
+    @staticmethod
+    def _shadow_plan(planner, snapshot, pending):
+        """One from-scratch replan on a fresh clone of the base snapshot.
+        Cloned nodes get version 0 (matching a fresh take_snapshot): the
+        clone's mutation clock starts over, and preserving base versions
+        would let a new tick collide with an inherited one."""
+        from nos_tpu.partitioning.core.planner import Planner
+        from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+
+        nodes = {}
+        for name, node in snapshot.get_nodes().items():
+            clone = node.plan_clone()
+            clone.version = 0
+            nodes[name] = clone
+        shadow_snapshot = ClusterSnapshot(nodes, codec=snapshot.codec)
+        shadow = Planner(
+            planner.framework,
+            aging_chips_per_second=planner.aging_chips_per_second,
+            verdict_cache_enabled=planner.verdict_cache_enabled,
+            reuse_gang_trial=planner.reuse_gang_trial,
+            futility_memo_enabled=planner.futility_memo_enabled,
+        )
+        desired = shadow.plan(
+            shadow_snapshot,
+            list(pending),
+            pending_ages=dict(planner.last_pending_ages),
+        )
+        return desired, dict(shadow.last_unserved)
 
 
 def build_auditor(

@@ -384,6 +384,15 @@ PLAN_CARVE_FUTILITY = REGISTRY.counter(
     "Carve attempts skipped because a (node version, lacking signature) "
     "memo already proved them futile; flushed once per plan()",
 )
+PLAN_MODE = REGISTRY.counter(
+    "nos_tpu_plan_mode_total",
+    "Planner.plan() invocations by execution mode "
+    "(mode=incremental|full|fallback): incremental prunes-and-reuses the "
+    "previous cycle's memos over a persistent base snapshot, fallback "
+    "replans from scratch but preserves the base (cold start, oversized "
+    "dirty set, shape/quota change), full is the legacy "
+    "snapshot-consuming path",
+)
 MULTIHOST_EXPANSIONS = REGISTRY.counter(
     "nos_tpu_multihost_expansions_total",
     "Oversized chip requests expanded into multi-host slice gangs",

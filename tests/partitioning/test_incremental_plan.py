@@ -1,0 +1,388 @@
+"""Incremental replanning equivalence (ISSUE 7 tentpole).
+
+The contract under test: a planner warm-started from a persistent base
+snapshot (``plan(..., dirty=...)`` after ``refresh_node`` deltas) produces
+the IDENTICAL desired PartitioningState and unserved reasons as a fresh
+planner replanning the same world from scratch — across randomized delta
+sequences (node fill rotations, pending-set churn, gang pairs, aged
+pods), and regardless of whether the cycle ran incrementally or fell
+back. Also pinned here: the fallback triggers themselves (dirty fraction
+over threshold, foreign snapshot object), base-snapshot preservation
+(plan() must not leak trial mutations into the base), and the auditor's
+incremental-vs-from-scratch shadow check catching a poisoned result.
+"""
+import random
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.partitioning.core import (
+    ClusterSnapshot,
+    ClusterState,
+    Planner,
+    SnapshotNode,
+    partitioning_state_equal,
+)
+from nos_tpu.record.audit import InvariantAuditor
+from nos_tpu.scheduler.framework import (
+    Framework,
+    NodeResourcesFit,
+    NodeSelectorFit,
+)
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+from nos_tpu.tpu.node import TpuNode
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+# Node fill styles a delta sequence rotates nodes through. Each is the
+# annotation state of one 2x4 (8-chip) board.
+STYLES = [
+    None,  # virgin board — fully carvable
+    {"free": {0: {"1x1": 2}}, "used": {0: {"2x2": 1}}},
+    {"free": {0: {"1x1": 1}}, "used": {0: {"2x2": 1, "1x1": 1}}},
+    {"free": {0: {"2x2": 1}}, "used": {0: {"2x2": 1}}},
+    {"free": {}, "used": {0: {"2x4": 1}}},  # fully allocated
+]
+
+
+def build_node(name, style_idx):
+    style = STYLES[style_idx % len(STYLES)]
+    annotations = (
+        annot.status_from_devices(free=style["free"], used=style["used"])
+        if style is not None
+        else None
+    )
+    node = build_tpu_node(name=name, annotations=annotations)
+    return SnapshotNode(partitionable=TpuNode(node))
+
+
+def make_snapshot(styles):
+    return ClusterSnapshot(
+        {name: build_node(name, idx) for name, idx in sorted(styles.items())}
+    )
+
+
+def make_framework():
+    return Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+
+
+def random_pod(rng, i):
+    profile = rng.choice(["1x1", "1x1", "1x2", "2x2", "2x4"])
+    return build_pod(f"p{i}", {slice_res(profile): 1})
+
+
+def gang_pair(i):
+    pods = []
+    for member in range(2):
+        pod = build_pod(f"g{i}-{member}", {slice_res("2x2"): 1})
+        pod.metadata.labels[GANG_NAME_LABEL] = f"gang{i}"
+        pod.metadata.labels[GANG_SIZE_LABEL] = "2"
+        pods.append(pod)
+    return pods
+
+
+def from_scratch(styles, pods, ages):
+    """The oracle: a fresh snapshot of the same world, a fresh planner,
+    legacy full-mode plan()."""
+    planner = Planner(make_framework())
+    desired = planner.plan(make_snapshot(styles), list(pods), pending_ages=dict(ages))
+    return desired, dict(planner.last_unserved)
+
+
+class TestIncrementalMatchesFromScratch:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_randomized_delta_sequences(self, seed):
+        rng = random.Random(seed)
+        names = [f"n{i:02d}" for i in range(12)]
+        styles = {name: rng.randrange(len(STYLES)) for name in names}
+        base = make_snapshot(styles)
+        planner = Planner(make_framework())
+
+        pods = [random_pod(rng, i) for i in range(8)]
+        if seed % 2:
+            pods += gang_pair(seed)
+        ages = {p.namespaced_name: float(rng.randrange(0, 6)) for p in pods}
+
+        # Cold start on a persistent base: dirty=all, planner has never
+        # seen this snapshot object -> fallback, base preserved.
+        planner.plan(base, pods, pending_ages=dict(ages), dirty=set(names))
+        assert planner.last_plan_mode == "fallback"
+
+        for step in range(6):
+            # Node deltas: rotate 1-3 nodes' fill via refresh_node.
+            dirty = set()
+            for name in rng.sample(names, rng.randint(1, 3)):
+                styles[name] += 1
+                base.refresh_node(name, build_node(name, styles[name]))
+                dirty.add(name)
+            # Pending churn: retire old pods, admit new ones.
+            if len(pods) > 4 and rng.random() < 0.5:
+                gone = pods.pop(rng.randrange(len(pods)))
+                ages.pop(gone.namespaced_name, None)
+            if rng.random() < 0.7:
+                new = random_pod(rng, 100 * (step + 1) + seed)
+                pods.append(new)
+                ages[new.namespaced_name] = float(rng.randrange(0, 6))
+
+            before_state = base.partitioning_state()
+            desired = planner.plan(
+                base, pods, pending_ages=dict(ages), dirty=dirty
+            )
+            assert planner.last_plan_mode == "incremental", f"step={step}"
+
+            oracle_desired, oracle_unserved = from_scratch(styles, pods, ages)
+            assert partitioning_state_equal(desired, oracle_desired), (
+                f"seed={seed} step={step}"
+            )
+            assert planner.last_unserved == oracle_unserved, (
+                f"seed={seed} step={step}"
+            )
+            # Base preservation: the plan ran inside a reverted fork, so
+            # the base still shows observed state and its incrementally
+            # maintained free pool matches a recompute.
+            assert partitioning_state_equal(
+                base.partitioning_state(), before_state
+            )
+            assert base.free_slice_resources() == base._compute_free_pool()
+            assert not base.forked
+
+    def test_aged_rescue_path_matches(self):
+        """Ages far over the rescue threshold exercise the dedicated-carve
+        pass on both sides."""
+        styles = {f"n{i}": 1 for i in range(6)}
+        base = make_snapshot(styles)
+        planner = Planner(make_framework())
+        pods = [build_pod(f"p{i}", {slice_res("1x2"): 1}) for i in range(4)]
+        ages = {p.namespaced_name: 30.0 for p in pods}
+        planner.plan(base, pods, pending_ages=dict(ages), dirty=set(styles))
+        styles["n0"] = 0
+        base.refresh_node("n0", build_node("n0", 0))
+        desired = planner.plan(base, pods, pending_ages=dict(ages), dirty={"n0"})
+        assert planner.last_plan_mode == "incremental"
+        oracle_desired, oracle_unserved = from_scratch(styles, pods, ages)
+        assert partitioning_state_equal(desired, oracle_desired)
+        assert planner.last_unserved == oracle_unserved
+
+
+class TestFallbackTriggers:
+    def test_dirty_fraction_over_threshold_falls_back_and_matches(self):
+        styles = {f"n{i}": i % len(STYLES) for i in range(8)}
+        base = make_snapshot(styles)
+        planner = Planner(make_framework(), incremental_dirty_threshold=0.25)
+        pods = [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(6)]
+        ages = {p.namespaced_name: 0.0 for p in pods}
+        planner.plan(base, pods, pending_ages=dict(ages), dirty=set(styles))
+
+        dirty = set()
+        for name in ["n0", "n1", "n2", "n3"]:  # 50% > 25% threshold
+            styles[name] += 1
+            base.refresh_node(name, build_node(name, styles[name]))
+            dirty.add(name)
+        desired = planner.plan(base, pods, pending_ages=dict(ages), dirty=dirty)
+        assert planner.last_plan_mode == "fallback"
+        oracle_desired, oracle_unserved = from_scratch(styles, pods, ages)
+        assert partitioning_state_equal(desired, oracle_desired)
+        assert planner.last_unserved == oracle_unserved
+        # Fallback is still base-preserving.
+        assert base.free_slice_resources() == base._compute_free_pool()
+
+    def test_foreign_snapshot_object_falls_back(self):
+        styles = {f"n{i}": 1 for i in range(4)}
+        planner = Planner(make_framework())
+        pods = [build_pod("p0", {slice_res("1x1"): 1})]
+        planner.plan(make_snapshot(styles), pods, dirty={"n0"})
+        assert planner.last_plan_mode == "fallback"
+        # Same planner, ANOTHER snapshot object: memos keyed by a foreign
+        # mutation clock must not be trusted.
+        desired = planner.plan(make_snapshot(styles), pods, dirty={"n0"})
+        assert planner.last_plan_mode == "fallback"
+        oracle_desired, _ = from_scratch(styles, pods, {})
+        assert partitioning_state_equal(desired, oracle_desired)
+
+    def test_dirty_none_is_legacy_full_mode(self):
+        styles = {f"n{i}": 1 for i in range(4)}
+        base = make_snapshot(styles)
+        planner = Planner(make_framework())
+        planner.plan(base, [build_pod("p0", {slice_res("2x4"): 1})])
+        assert planner.last_plan_mode == "full"
+        # Legacy mode mutates the snapshot in place (no outer fork).
+        assert not base.forked
+
+
+class TestAuditorShadowCheck:
+    def _incremental_plan(self):
+        styles = {f"n{i}": (i % 3) + 1 for i in range(6)}
+        base = make_snapshot(styles)
+        planner = Planner(make_framework())
+        pods = [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(3)] + [
+            build_pod("big", {slice_res("2x4"): 1})
+        ]
+        ages = {p.namespaced_name: 0.0 for p in pods}
+        planner.plan(base, pods, pending_ages=dict(ages), dirty=set(styles))
+        base.refresh_node("n0", build_node("n0", 0))
+        desired = planner.plan(base, pods, pending_ages=dict(ages), dirty={"n0"})
+        assert planner.last_plan_mode == "incremental"
+        return planner, base, pods, desired
+
+    def test_clean_incremental_plan_passes(self):
+        planner, base, pods, desired = self._incremental_plan()
+        auditor = InvariantAuditor(sample_rate=1.0)
+        assert auditor.check_incremental_plan(planner, base, pods, desired) == []
+
+    def test_poisoned_desired_state_is_caught(self):
+        planner, base, pods, desired = self._incremental_plan()
+        poisoned = dict(desired)
+        poisoned.pop(sorted(poisoned)[0])
+        auditor = InvariantAuditor(sample_rate=1.0)
+        violations = auditor.check_incremental_plan(planner, base, pods, poisoned)
+        assert violations and violations[0].check == "incremental_plan"
+
+    def test_check_idles_outside_incremental_mode(self):
+        styles = {f"n{i}": 1 for i in range(3)}
+        base = make_snapshot(styles)
+        planner = Planner(make_framework())
+        pods = [build_pod("p0", {slice_res("1x1"): 1})]
+        desired = planner.plan(base, pods)  # legacy full mode
+        auditor = InvariantAuditor(sample_rate=1.0)
+        assert auditor.check_incremental_plan(planner, base, pods, desired) == []
+
+
+class TestMaintainerDrivesEquivalence:
+    """Store-delta level: the controller-side maintainer refreshes the
+    base from watch events and the warm-started plan still equals a
+    from-scratch snapshot+plan of the live store."""
+
+    def _store(self, n=5):
+        from nos_tpu.cmd.partitioner import register_indexers
+        from nos_tpu.kube.store import KubeStore
+
+        store = KubeStore()
+        register_indexers(store)
+        for i in range(n):
+            node = build_tpu_node(name=f"n{i}")
+            node.metadata.annotations.update(
+                annot.status_from_devices(
+                    free={0: {"1x1": 2}}, used={0: {"2x2": 1}}
+                )
+            )
+            store.create(node)
+        return store
+
+    def test_refresh_matches_full_rebuild(self):
+        from nos_tpu.controllers.partitioner.incremental import (
+            IncrementalSnapshotMaintainer,
+        )
+        from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+        store = self._store()
+        taker = TpuSnapshotTaker()
+        maintainer = IncrementalSnapshotMaintainer(store, taker, kind="tpu")
+        state = ClusterState()
+        base, dirty = maintainer.snapshot(state)
+        assert dirty == set(base.get_nodes())
+        assert maintainer.full_rebuilds == 1
+
+        # Bind a pod to n2: Pod event -> dirty {n2}, refreshed in place.
+        bound = build_pod("w0", {slice_res("1x1"): 1}, node="n2")
+        bound.status.phase = "Running"
+        store.create(bound)
+        base2, dirty2 = maintainer.snapshot(state)
+        assert base2 is base and dirty2 == {"n2"}
+        assert maintainer.full_rebuilds == 1
+
+        fresh = taker.take_snapshot(state, store=store)
+        assert partitioning_state_equal(
+            base2.partitioning_state(), fresh.partitioning_state()
+        )
+        assert [p.metadata.name for p in base2.get_nodes()["n2"].pods] == ["w0"]
+
+    def test_node_delete_forces_rebuild(self):
+        from nos_tpu.controllers.partitioner.incremental import (
+            IncrementalSnapshotMaintainer,
+        )
+        from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+        store = self._store()
+        maintainer = IncrementalSnapshotMaintainer(
+            store, TpuSnapshotTaker(), kind="tpu"
+        )
+        state = ClusterState()
+        base, _ = maintainer.snapshot(state)
+        store.delete("Node", "n1")
+        base2, dirty2 = maintainer.snapshot(state)
+        assert base2 is not base
+        assert "n1" not in base2.get_nodes()
+        assert dirty2 == set(base2.get_nodes())
+        assert maintainer.full_rebuilds == 2
+
+    def _quota(self, name="q", min_tpu=8, max_tpu=8):
+        from nos_tpu.api.v1alpha1.elasticquota import (
+            ElasticQuota,
+            ElasticQuotaSpec,
+        )
+        from nos_tpu.kube.objects import ObjectMeta
+
+        return ElasticQuota(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=ElasticQuotaSpec(
+                min={constants.RESOURCE_TPU: min_tpu},
+                max={constants.RESOURCE_TPU: max_tpu},
+            ),
+        )
+
+    def test_status_only_quota_update_preserves_base(self):
+        """The quota controller bumps status.used after every bind; that
+        write is planner-neutral (the snapshot holds no quota state and
+        CapacityScheduling re-reads the live store) and must NOT cost
+        the base — or steady state would never exist."""
+        from nos_tpu.controllers.partitioner.incremental import (
+            IncrementalSnapshotMaintainer,
+        )
+        from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+        store = self._store()
+        store.create(self._quota())
+        maintainer = IncrementalSnapshotMaintainer(
+            store, TpuSnapshotTaker(), kind="tpu"
+        )
+        state = ClusterState()
+        base, _ = maintainer.snapshot(state)
+
+        def bump(q):
+            q.status.used = {constants.RESOURCE_TPU: 4}
+
+        store.patch_merge("ElasticQuota", "q", "default", bump)
+        base2, dirty2 = maintainer.snapshot(state)
+        assert base2 is base and dirty2 == set()
+        assert maintainer.full_rebuilds == 1
+
+    def test_quota_spec_change_forces_rebuild(self):
+        from nos_tpu.controllers.partitioner.incremental import (
+            IncrementalSnapshotMaintainer,
+        )
+        from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+        store = self._store()
+        store.create(self._quota())
+        maintainer = IncrementalSnapshotMaintainer(
+            store, TpuSnapshotTaker(), kind="tpu"
+        )
+        state = ClusterState()
+        base, _ = maintainer.snapshot(state)
+
+        def shrink(q):
+            q.spec.max = {constants.RESOURCE_TPU: 4}
+
+        store.patch_merge("ElasticQuota", "q", "default", shrink)
+        base2, _ = maintainer.snapshot(state)
+        assert base2 is not base
+        assert maintainer.full_rebuilds == 2
+
+        # New quota appearing and quota deletion are bound changes too.
+        store.create(self._quota(name="q2"))
+        maintainer.snapshot(state)
+        assert maintainer.full_rebuilds == 3
+        store.delete("ElasticQuota", "q2", "default")
+        maintainer.snapshot(state)
+        assert maintainer.full_rebuilds == 4

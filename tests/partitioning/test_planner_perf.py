@@ -55,6 +55,56 @@ def test_verdict_cache_hit_rate_floor():
 
 
 @pytest.mark.slow
+def test_incremental_replan_floor_1024_nodes():
+    """The warm-start headline (ISSUE 7): at 1024 nodes / 800 pending with
+    ≤5% of nodes dirtied per cycle, a steady-state incremental replan runs
+    ~34ms p50 on a dev box against a ~107ms cold plan (BENCH_planner.json).
+    Two floors guard it: a generous absolute wall-clock bound for loaded
+    CI, and a relative one — replanning must stay at least 2x faster than
+    the cold fallback plan, or cross-cycle cache retention has quietly
+    stopped working (every cycle would pay from-scratch cost again)."""
+    import statistics
+
+    from bench_planner import build_steady_node, make_steady_cluster, make_steady_pending
+
+    REPLAN_BOUND_SECONDS = 10.0
+
+    planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+    snapshot = make_steady_cluster(1024)
+    pods = make_steady_pending(800)
+
+    started = time.perf_counter()
+    planner.plan(snapshot, pods, dirty=set(snapshot.get_nodes()))
+    cold = time.perf_counter() - started
+    assert planner.last_plan_mode == "fallback"  # cold start on a new base
+
+    dirty_per_cycle = 51  # 5% of 1024
+    variant = {}
+    samples = []
+    for cycle in range(6):
+        dirty = set()
+        for j in range(dirty_per_cycle):
+            name = f"node-{(cycle * dirty_per_cycle + j) % 1024:05d}"
+            variant[name] = not variant.get(name, False)
+            snapshot.refresh_node(name, build_steady_node(name, variant[name]))
+            dirty.add(name)
+        started = time.perf_counter()
+        planner.plan(snapshot, pods, dirty=dirty)
+        elapsed = time.perf_counter() - started
+        assert planner.last_plan_mode == "incremental"
+        if cycle > 0:  # first warm cycle still fills cross-cycle memos
+            samples.append(elapsed)
+
+    p50 = statistics.median(samples)
+    assert p50 < REPLAN_BOUND_SECONDS, f"incremental replan p50 {p50:.3f}s"
+    assert p50 * 2 < cold, (
+        f"replan p50 {p50 * 1000:.1f}ms is not ≥2x faster than the cold plan "
+        f"{cold * 1000:.1f}ms — cross-cycle cache retention has regressed"
+    )
+    assert not snapshot.forked
+
+
+@pytest.mark.slow
 def test_tracing_overhead_within_allowance():
     """The planner is instrumented (a span per carve trial, suppressed
     plugin spans in simulation). With TRACER.enabled=False those calls are

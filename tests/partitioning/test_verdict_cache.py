@@ -404,11 +404,33 @@ class TestPlanCacheOnOffEquivalence:
         assert not on_snap.forked and not off_snap.forked
 
     def test_plan_records_hits_no_bypass_on_plain_pods(self):
-        snapshot = build_cluster(random.Random(42), n_min=6, n_max=6)
+        # "mismatch" sorts first in best-fit order (2 free chips) and keeps
+        # a free 1x2 every 1x1 claim probes and fails on: same signature
+        # against an unchanged version, so each probe after the first is a
+        # cache hit. (Exhausted nodes no longer produce repeat trials — the
+        # claim pre-pass skips nodes with no free slices outright.)
+        def steady(name, free):
+            node = build_tpu_node(
+                name=name,
+                annotations=annot.status_from_devices(
+                    free={0: free}, used={0: {"2x2": 1}}
+                ),
+            )
+            return SnapshotNode(partitionable=TpuNode(node))
+
+        snapshot = ClusterSnapshot(
+            {
+                "mismatch": steady("mismatch", {"1x2": 1}),
+                "serving": steady("serving", {"1x1": 4}),
+            }
+        )
         planner = Planner(node_local_framework())
+        # The lacking 2x4 pod keeps the tracker non-empty (an all-served
+        # batch returns before any simulation runs).
         planner.plan(
             snapshot,
-            [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(12)],
+            [build_pod(f"p{i}", {slice_res("1x1"): 1}) for i in range(4)]
+            + [build_pod("big", {slice_res("2x4"): 1})],
         )
         hits, _, bypasses = planner.verdict_cache_stats()
         assert hits > 0

@@ -163,6 +163,9 @@ class TestHotPathNoDeepcopy:
                 "_request_signature",
                 "_node_info",
                 "_candidate_nodes",
+                "_claims_free_slices",
+                "_prune_plan_caches",
+                "_select_plan_mode",
             ],
             ClusterSnapshot: [
                 "fork",
@@ -172,6 +175,9 @@ class TestHotPathNoDeepcopy:
                 "get_node",
                 "get_candidate_nodes",
                 "_node_free_state",
+                "node_has_free_slices",
+                "_cand_sort_key",
+                "refresh_node",
                 "get_lacking_slices",
                 "free_slice_resources",
                 "_apply_free_delta",
@@ -204,6 +210,44 @@ class TestHotPathNoDeepcopy:
                         break
         assert not offenders, (
             f"deepcopy reached the simulation hot path: {offenders}"
+        )
+
+
+class TestIncrementalPathNoFullScans:
+    """The point of incremental replanning is O(dirty) work per cycle —
+    a `get_nodes()` call in the delta-maintenance or cache-pruning path
+    silently reintroduces an O(cluster) walk per plan. Per-node reads go
+    through node_version()/node_has_free_slices()/refresh_node instead.
+    (The full-rebuild path and plan()'s own passes legitimately walk the
+    world and are NOT on this list.)"""
+
+    def test_no_get_nodes_in_incremental_path(self):
+        import ast
+        import inspect
+        import textwrap
+
+        from nos_tpu.controllers.partitioner.incremental import (
+            IncrementalSnapshotMaintainer,
+        )
+        from nos_tpu.partitioning.core.planner import Planner
+        from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+
+        incremental_path = {
+            Planner: ["_prune_plan_caches", "_select_plan_mode"],
+            ClusterSnapshot: ["refresh_node", "node_version", "node_count"],
+            IncrementalSnapshotMaintainer: ["_classify", "_refresh", "_drain"],
+        }
+        offenders = []
+        for cls, names in incremental_path.items():
+            for name in names:
+                fn = getattr(cls, name)
+                tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Attribute) and node.attr == "get_nodes":
+                        offenders.append(f"{cls.__name__}.{name}")
+                        break
+        assert not offenders, (
+            f"full get_nodes() scan on the incremental path: {offenders}"
         )
 
 
