@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos incluster-e2e kind-e2e bench bench-planner examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke incluster-e2e kind-e2e bench bench-planner examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -35,6 +35,12 @@ test-integration:
 # violations. Non-slow — tier-1 exercises the full loop.
 replay-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/record/test_replay_smoke.py -q
+
+# Capacity-ledger gate: incremental chip-seconds accounting agrees with a
+# from-scratch shadow recompute, /debug/capacity serves the rollups, and
+# recorded observes replay with zero drift.
+capacity-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/capacity -q -m 'not slow'
 
 # Chaos tier-1 gate: one fixed seed through the full suite under fault
 # injection — must converge, replay clean, and fire a byte-identical

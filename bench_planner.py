@@ -149,9 +149,43 @@ def make_steady_pending(n_pods: int):
     return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
 
 
+def capacity_row(snapshot, n_nodes: int, n_pods: int, churn: float) -> dict:
+    """Steady-state capacity shape of the churned cluster, measured with
+    the capacity ledger's fragmentation helper over each node's final
+    slice-state annotations: the free-chip-weighted fragmentation index
+    and the utilization the churn regime settles into — the same numbers
+    `/debug/capacity` reports for a live cluster."""
+    from nos_tpu.capacity import fragmentation_from_annotations
+
+    capacity = free_total = largest_any = 0
+    weighted = 0.0
+    for snap_node in snapshot.get_nodes().values():
+        node = snap_node.partitionable.node
+        capacity += int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        index, largest, free = fragmentation_from_annotations(
+            node.metadata.annotations, V5E
+        )
+        weighted += index * free
+        free_total += free
+        largest_any = max(largest_any, largest)
+    return {
+        "bench": "bench_capacity",
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "churn": churn,
+        "capacity_chips": capacity,
+        "free_chips": free_total,
+        "steady_state_utilization": round(1 - free_total / capacity, 4)
+        if capacity
+        else None,
+        "fragmentation_index": round(weighted / free_total, 4) if free_total else 0.0,
+        "largest_free_slice_chips": largest_any,
+    }
+
+
 def bench_incremental(
     n_nodes: int, n_pods: int, repeats: int, churn: float = 0.05
-) -> dict:
+) -> list:
     """Steady-state replans over ONE persistent snapshot + planner: an
     untimed cold plan (fallback mode — builds the caches at base
     versions), then `repeats` timed cycles, each dirtying `churn` of the
@@ -189,7 +223,7 @@ def bench_incremental(
     )
     hits, misses, bypasses = planner.verdict_cache_stats()
     eligible = hits + misses
-    return {
+    row = {
         "bench": "bench_planner_incremental",
         "engine": "cow",
         "plan_mode": "incremental",
@@ -207,6 +241,7 @@ def bench_incremental(
         "futility_hits_last_cycle": planner._futility_hits,
         "cache_hit_rate_last_cycle": round(hits / eligible, 4) if eligible else None,
     }
+    return [row, capacity_row(snapshot, n_nodes, n_pods, churn)]
 
 
 def bench_config(
@@ -335,9 +370,9 @@ def main() -> None:
     results = []
     if args.plan_mode in ("incremental", "both"):
         for n_nodes, n_pods in incremental_configs:
-            result = bench_incremental(n_nodes, n_pods, repeats, churn=args.churn)
-            results.append(result)
-            print(json.dumps(result), flush=True)
+            for result in bench_incremental(n_nodes, n_pods, repeats, churn=args.churn):
+                results.append(result)
+                print(json.dumps(result), flush=True)
     if args.plan_mode == "incremental":
         _finish(args, results)
         return

@@ -1,0 +1,8 @@
+from nos_tpu.capacity.ledger import (  # noqa: F401
+    BUCKET_NO_DEMAND,
+    BUCKET_PENDING,
+    BUCKET_RECONFIG,
+    BUCKET_RESERVED,
+    CapacityLedger,
+    fragmentation_from_annotations,
+)

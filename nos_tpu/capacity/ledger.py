@@ -1,0 +1,782 @@
+"""Cluster capacity ledger: live, incremental chip-seconds accounting.
+
+ROADMAP item 2 sets utilization targets (idle-with-pending-demand < 3%,
+8-chip gang p50 wait < 1s) that until now existed only as post-hoc
+computations inside bench.py. This module is the live meter: a
+:class:`CapacityLedger` drains the same rv-ordered store deltas the
+flight recorder and the IncrementalSnapshotMaintainer consume (one watch
+stream, another read view) and integrates chip-seconds over the wall
+time between control-cycle observations.
+
+Accounting model
+----------------
+Every ``observe(now)`` call closes the interval ``[last_ts, now)``. The
+interval is integrated against the state the ledger held at its previous
+revision watermark — events drained *during* the interval describe
+transitions that become visible at the *end* of it, exactly the view a
+control cycle has. Per node (iterated in sorted-name order so float
+accumulation is bit-reproducible on replay):
+
+- ``busy``   = chips of pods bound to the node (request arithmetic via
+  :func:`nos_tpu.util.resources.tpu_chips_in`), capped at capacity;
+- ``idle``   = capacity - busy, attributed to one bucket:
+  * ``reconfig``            — the node's spec plan differs from its
+    reported status plan (a partitioning plan is in flight);
+  * ``reserved-by-gang``    — the node carries a board reservation
+    annotation for a pending gang;
+  * ``pending-unschedulable`` — otherwise, up to the cluster's unbound
+    pending TPU demand (``min(idle, pending_chips)``, the same coverage
+    rule bench.py's post-hoc attribution uses), labeled with the
+    dominant carve-failure reason joined from the planner's
+    ``last_unserved`` ledger;
+  * ``no-demand``           — the remainder.
+
+The ledger additionally tracks a per-node fragmentation index (1 -
+largest-carveable-slice / free-chips, from the status annotations and
+the accelerator's slice shapes), per-gang wait clocks (arrival →
+first-feasible → bound) feeding ``nos_tpu_gang_wait_seconds``, and
+per-namespace quota borrow/starvation derived from ElasticQuota objects.
+
+Determinism & verification
+--------------------------
+Each integrating ``observe`` appends a ``capacity.observe`` record to
+the flight recorder (watermark revision, observation timestamp, pending
+reason, cumulative totals). Replay rebuilds a shadow ledger over the
+replayed store and re-runs the same observations from the recorded
+timestamps — totals must match bit-for-bit (zero drift). Live, the
+InvariantAuditor's ``capacity_ledger`` check calls :meth:`self_check`,
+which recomputes the instantaneous state from scratch off the store and
+diffs it against the incrementally-maintained state; the chaos
+``ledger-consistent`` oracle runs the same check after every burst.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.tpu.known import KNOWN_ACCELERATORS
+from nos_tpu.tpu.topology import topology_chips
+from nos_tpu.util import metrics as m
+from nos_tpu.util import resources as res
+
+# Idle-attribution buckets. Low-cardinality by construction: the only
+# free-form label is the pending reason, normalized to its prefix.
+BUCKET_NO_DEMAND = "no-demand"
+BUCKET_PENDING = "pending-unschedulable"
+BUCKET_RECONFIG = "reconfig"
+BUCKET_RESERVED = "reserved-by-gang"
+IDLE_BUCKETS = (BUCKET_NO_DEMAND, BUCKET_PENDING, BUCKET_RECONFIG, BUCKET_RESERVED)
+
+# Store kinds the ledger's delta view understands (same set the
+# IncrementalSnapshotMaintainer watches).
+WATCH_KINDS = ("ElasticQuota", "Node", "Pod")
+
+# Annotation the gang reservation plugin stamps on held nodes.
+_RESERVED_FOR = annot.PREFIX + "reserved-for"
+
+# Pending-demand label when no carve-failure reason is known (demand
+# exists but the planner has not reported why it is unserved).
+_REASON_QUEUED = "queued"
+
+# Completed gang wait entries kept for /debug/capacity.
+_RECENT_GANGS = 64
+
+_UNSET = object()
+
+
+def _reason_prefix(reason: str) -> str:
+    """Normalize a carve-failure message to its low-cardinality prefix
+    (the part before ':'), matching the unschedulable metric's scheme."""
+    return reason.split(":", 1)[0].strip() or _REASON_QUEUED
+
+
+def dominant_unserved_reason(unserved: Dict[str, str]) -> Optional[str]:
+    """The most common normalized reason in a pod→reason map, ties broken
+    lexicographically so the choice is deterministic."""
+    counts: Dict[str, int] = {}
+    for reason in unserved.values():
+        key = _reason_prefix(reason)
+        counts[key] = counts.get(key, 0) + 1
+    if not counts:
+        return None
+    return max(sorted(counts), key=lambda k: counts[k])
+
+
+def fragmentation_from_annotations(
+    annotations: Dict[str, str], accelerator: str
+) -> Tuple[float, int, int]:
+    """(fragmentation index, largest carveable chips, free chips) for a
+    node's reported slice state.
+
+    Free chips are summed from the ``free`` status annotations; the
+    largest carveable slice is the biggest profile in the accelerator's
+    slice shapes that fits inside a single board's free chips (carving
+    never crosses boards). Index = 1 - largest/free; 0 when nothing is
+    free (a full node is busy, not fragmented)."""
+    _, status = annot.parse_node_annotations(annotations)
+    free_by_board: Dict[int, int] = {}
+    for entry in status:
+        if entry.status == annot.STATUS_FREE and "x" in entry.profile:
+            chips = topology_chips(entry.profile) * entry.quantity
+            free_by_board[entry.board_index] = (
+                free_by_board.get(entry.board_index, 0) + chips
+            )
+    free_total = sum(free_by_board.values())
+    if free_total <= 0:
+        return 0.0, 0, 0
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    shape_chips = (
+        sorted(topology_chips(s) for s in spec.slice_shapes) if spec else []
+    )
+    largest = 0
+    for board_free in free_by_board.values():
+        for chips in shape_chips:
+            if chips <= board_free and chips > largest:
+                largest = chips
+    return 1.0 - largest / free_total, largest, free_total
+
+
+def _pod_chips(pod: Any) -> int:
+    return res.tpu_chips_in(res.compute_pod_request(pod))
+
+
+def _quota_chips(resource_list: Dict[str, Any]) -> int:
+    """Chips a quota bound amounts to: the synthetic aggregate when the
+    quota is expressed in it, the extended-resource arithmetic otherwise."""
+    if constants.RESOURCE_TPU_CHIPS in resource_list:
+        return int(resource_list[constants.RESOURCE_TPU_CHIPS])
+    return res.tpu_chips_in(resource_list)
+
+
+class _NodeState:
+    """Instantaneous per-node facts the integration step reads."""
+
+    __slots__ = (
+        "total_chips",
+        "pool",
+        "accelerator",
+        "frozen",
+        "reserved",
+        "frag_index",
+        "largest_free_slice",
+        "free_chips",
+        "used_profiles",
+    )
+
+    def __init__(self, node: Any, total_chips: int) -> None:
+        meta = node.metadata
+        self.total_chips = total_chips
+        self.pool = meta.labels.get(labels.PARTITIONING_LABEL, "")
+        self.accelerator = meta.labels.get(labels.GKE_TPU_ACCELERATOR_LABEL, "")
+        ann = meta.annotations
+        spec_plan = ann.get(annot.SPEC_PARTITIONING_PLAN)
+        self.frozen = bool(spec_plan) and spec_plan != ann.get(
+            annot.STATUS_PARTITIONING_PLAN
+        )
+        self.reserved = _RESERVED_FOR in ann
+        self.frag_index, self.largest_free_slice, self.free_chips = (
+            fragmentation_from_annotations(ann, self.accelerator)
+        )
+        _, status = annot.parse_node_annotations(ann)
+        used: Dict[str, int] = {}
+        for entry in status:
+            if entry.status == annot.STATUS_USED and "x" in entry.profile:
+                used[entry.profile] = (
+                    used.get(entry.profile, 0)
+                    + topology_chips(entry.profile) * entry.quantity
+                )
+        self.used_profiles = used
+
+    def canonical(self) -> tuple:
+        return (
+            self.total_chips,
+            self.pool,
+            self.accelerator,
+            self.frozen,
+            self.reserved,
+            round(self.frag_index, 9),
+            self.largest_free_slice,
+            self.free_chips,
+            tuple(sorted(self.used_profiles.items())),
+        )
+
+
+class CapacityLedger:
+    """Incremental time-weighted chip-seconds accounting over a KubeStore.
+
+    Thread-safe: ``observe`` / gang clocks / ``debug_payload`` may be
+    called from different controller threads; all state is guarded by one
+    lock. The store's watch queue is the only cross-thread hand-off.
+
+    ``metrics`` turns Prometheus export off for replay shadow ledgers so
+    a replayed run never pollutes the live registry.
+    """
+
+    def __init__(self, store, flight_recorder=None, metrics: bool = True) -> None:
+        self.store = store
+        self.flight = flight_recorder
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._queue = store.watch(set(WATCH_KINDS)) if store is not None else None
+        self._buffer: List[Any] = []
+        # Instantaneous state at the current revision watermark.
+        self._nodes: Dict[str, _NodeState] = {}
+        self._bound: Dict[str, Tuple[str, int, str]] = {}  # pod -> (node, chips, ns)
+        self._pending: Dict[str, Tuple[int, str]] = {}  # pod -> (chips, ns)
+        self._quotas: Dict[str, Tuple[str, int, int, int]] = {}  # key -> (ns,min,max,used)
+        self._reason: Optional[str] = None
+        self._unserved_sample: Dict[str, str] = {}
+        self._last_ts: Optional[float] = None
+        self._first_ts: Optional[float] = None
+        self._revision = 0
+        self._last_trace_id = ""
+        # Cumulative chip-second integrals.
+        self.total_chip_seconds = 0.0
+        self.busy_chip_seconds = 0.0
+        self.idle_chip_seconds: Dict[str, float] = {b: 0.0 for b in IDLE_BUCKETS}
+        self.pending_reason_seconds: Dict[str, float] = {}
+        self.by_node: Dict[str, Dict[str, float]] = {}
+        self.by_pool: Dict[str, Dict[str, float]] = {}
+        self.by_namespace: Dict[str, float] = {}
+        self.by_profile: Dict[str, float] = {}
+        self.observes = 0
+        # Gang wait clocks (live-only; excluded from replay drift).
+        self._gangs: Dict[str, Dict[str, float]] = {}
+        self._recent_gangs: deque = deque(maxlen=_RECENT_GANGS)
+        # Node names with exported per-node gauges (reset-on-delete).
+        self._exported_nodes: set = set()
+        # Heartbeat: the control loops only observe when they run (the
+        # partitioner on plan cycles), so a quiet steady-state cluster
+        # would stop accruing chip-seconds without a periodic tick.
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+
+    # ---------------------------------------------------------- heartbeat
+
+    def start_heartbeat(self, interval_seconds: float = 5.0) -> None:
+        """Observe on a timer so integrals keep accruing while the control
+        loops idle. Heartbeat observes are recorded like any other — an
+        unrecorded watermark advance would make every later recorded total
+        unreproducible on replay."""
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+
+        def loop() -> None:
+            while not self._hb_stop.wait(interval_seconds):
+                self.observe(time.time())
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="capacity-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_thread is None:
+            return
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=5.0)
+        self._hb_thread = None
+
+    # ------------------------------------------------------------ observe
+
+    def observe(
+        self,
+        now: float,
+        unserved: Optional[Dict[str, str]] = None,
+        reason: Any = _UNSET,
+        trace_id: str = "",
+        record: bool = True,
+    ) -> None:
+        """Close the interval since the previous observation and roll the
+        watermark forward.
+
+        ``unserved`` is the planner's pod→reason carve-failure map; the
+        dominant normalized reason labels pending-idle time from here
+        until the next observation. ``reason`` overrides that computation
+        directly (the replay path, which replays the recorded choice).
+        """
+        with self._lock:
+            watermark = self.store.revision
+            self._integrate(now)
+            self._drain_apply(watermark)
+            if reason is not _UNSET:
+                self._reason = reason
+            elif unserved is not None:
+                self._reason = dominant_unserved_reason(unserved)
+                self._unserved_sample = {
+                    k: unserved[k] for k in sorted(unserved)[:32]
+                }
+                if not unserved:
+                    self._unserved_sample = {}
+            if trace_id:
+                self._last_trace_id = trace_id
+            self._last_ts = now
+            if self._first_ts is None:
+                self._first_ts = now
+            self._revision = watermark
+            self.observes += 1
+            if self._metrics:
+                self._export_gauges()
+            totals = self._totals()
+            reason_out = self._reason
+        if record and self.flight is not None:
+            self.flight.record_capacity(
+                revision=watermark,
+                now=now,
+                reason=reason_out,
+                trace_id=trace_id,
+                totals=totals,
+            )
+
+    def _integrate(self, now: float) -> None:
+        if self._last_ts is None:
+            return
+        dt = now - self._last_ts
+        if dt <= 0 or not self._nodes:
+            return
+        bound_by_node: Dict[str, int] = {}
+        busy_by_ns: Dict[str, int] = {}
+        for key in sorted(self._bound):
+            node_name, chips, ns = self._bound[key]
+            if node_name not in self._nodes:
+                continue
+            bound_by_node[node_name] = bound_by_node.get(node_name, 0) + chips
+            busy_by_ns[ns] = busy_by_ns.get(ns, 0) + chips
+        pending_chips = sum(chips for chips, _ in self._pending.values())
+        available_idle = 0
+        for name in sorted(self._nodes):
+            st = self._nodes[name]
+            busy = min(st.total_chips, bound_by_node.get(name, 0))
+            idle = st.total_chips - busy
+            self.total_chip_seconds += st.total_chips * dt
+            self.busy_chip_seconds += busy * dt
+            node_acc = self.by_node.setdefault(name, {"total": 0.0, "busy": 0.0})
+            node_acc["total"] += st.total_chips * dt
+            node_acc["busy"] += busy * dt
+            pool_acc = self.by_pool.setdefault(st.pool, {"total": 0.0, "busy": 0.0})
+            pool_acc["total"] += st.total_chips * dt
+            pool_acc["busy"] += busy * dt
+            if st.frozen:
+                self.idle_chip_seconds[BUCKET_RECONFIG] += idle * dt
+            elif st.reserved:
+                self.idle_chip_seconds[BUCKET_RESERVED] += idle * dt
+            else:
+                available_idle += idle
+            for profile in sorted(st.used_profiles):
+                self.by_profile[profile] = (
+                    self.by_profile.get(profile, 0.0) + st.used_profiles[profile] * dt
+                )
+        for ns in sorted(busy_by_ns):
+            self.by_namespace[ns] = self.by_namespace.get(ns, 0.0) + busy_by_ns[ns] * dt
+        # Idle on schedulable nodes is "scheduling inefficiency" only up
+        # to the demand that could have used it (bench.py's coverage rule).
+        covered = float(min(available_idle, pending_chips))
+        self.idle_chip_seconds[BUCKET_PENDING] += covered * dt
+        self.idle_chip_seconds[BUCKET_NO_DEMAND] += (available_idle - covered) * dt
+        if covered > 0:
+            reason = self._reason or _REASON_QUEUED
+            self.pending_reason_seconds[reason] = (
+                self.pending_reason_seconds.get(reason, 0.0) + covered * dt
+            )
+        if self._metrics:
+            c = m.CAPACITY_CHIP_SECONDS
+            c.labels(state="busy", reason="").inc(
+                sum(
+                    min(self._nodes[n].total_chips, bound_by_node.get(n, 0))
+                    for n in self._nodes
+                )
+                * dt
+            )
+            for name in sorted(self._nodes):
+                st = self._nodes[name]
+                idle = st.total_chips - min(
+                    st.total_chips, bound_by_node.get(name, 0)
+                )
+                if st.frozen:
+                    c.labels(state=BUCKET_RECONFIG, reason="").inc(idle * dt)
+                elif st.reserved:
+                    c.labels(state=BUCKET_RESERVED, reason="").inc(idle * dt)
+            if covered > 0:
+                c.labels(
+                    state=BUCKET_PENDING, reason=self._reason or _REASON_QUEUED
+                ).inc(covered * dt)
+            c.labels(state=BUCKET_NO_DEMAND, reason="").inc(
+                (available_idle - covered) * dt
+            )
+
+    # ------------------------------------------------------------- deltas
+
+    def _drain_apply(self, watermark: int) -> None:
+        if self._queue is not None:
+            while True:
+                try:
+                    self._buffer.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+        keep: List[Any] = []
+        for event in self._buffer:
+            revision = event.revision or event.object.metadata.resource_version
+            if revision <= watermark:
+                self._apply_event(event)
+            else:
+                keep.append(event)
+        self._buffer = keep
+
+    def _apply_event(self, event: Any) -> None:
+        kind = event.object.kind
+        if kind == "Node":
+            self._apply_node(event)
+        elif kind == "Pod":
+            self._apply_pod(event)
+        elif kind == "ElasticQuota":
+            self._apply_quota(event)
+
+    def _apply_node(self, event: Any) -> None:
+        node = event.object
+        name = node.metadata.name
+        if event.type == "DELETED":
+            if self._nodes.pop(name, None) is not None and self._metrics:
+                self._zero_node_gauges(name)
+            return
+        total = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        if total <= 0:
+            if self._nodes.pop(name, None) is not None and self._metrics:
+                self._zero_node_gauges(name)
+            return
+        self._nodes[name] = _NodeState(node, total)
+
+    def _apply_pod(self, event: Any) -> None:
+        pod = event.object
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        self._bound.pop(key, None)
+        self._pending.pop(key, None)
+        if event.type == "DELETED":
+            return
+        chips = _pod_chips(pod)
+        if chips <= 0:
+            return
+        phase = pod.status.phase
+        if pod.spec.node_name and phase in ("Pending", "Running"):
+            self._bound[key] = (pod.spec.node_name, chips, pod.metadata.namespace)
+        elif phase == "Pending":
+            self._pending[key] = (chips, pod.metadata.namespace)
+
+    def _apply_quota(self, event: Any) -> None:
+        quota = event.object
+        key = f"{quota.metadata.namespace}/{quota.metadata.name}"
+        if event.type == "DELETED":
+            self._quotas.pop(key, None)
+            return
+        self._quotas[key] = (
+            quota.metadata.namespace,
+            _quota_chips(quota.spec.min),
+            _quota_chips(quota.spec.max),
+            _quota_chips(quota.status.used),
+        )
+
+    # -------------------------------------------------------- gang clocks
+
+    def note_gang_arrival(self, gang: str, now: float) -> None:
+        with self._lock:
+            self._gangs.setdefault(gang, {"arrival": now})
+
+    def note_gang_feasible(self, gang: str, now: float) -> None:
+        with self._lock:
+            clock = self._gangs.get(gang)
+            if clock is None or "feasible" in clock:
+                return
+            clock["feasible"] = now
+            wait = max(0.0, now - clock["arrival"])
+        if self._metrics:
+            m.GANG_WAIT_SECONDS.labels(stage="first_feasible").observe(wait)
+
+    def note_gang_bound(self, gang: str, now: float) -> None:
+        with self._lock:
+            clock = self._gangs.pop(gang, None)
+            if clock is None:
+                return
+            clock["bound"] = now
+            wait = max(0.0, now - clock["arrival"])
+            self._recent_gangs.append(
+                {
+                    "gang": gang,
+                    "wait_seconds": round(wait, 6),
+                    "feasible_after": (
+                        round(clock["feasible"] - clock["arrival"], 6)
+                        if "feasible" in clock
+                        else None
+                    ),
+                }
+            )
+        if self._metrics:
+            m.GANG_WAIT_SECONDS.labels(stage="bound").observe(wait)
+
+    def drop_gang(self, gang: str) -> None:
+        """Forget a gang's clock (gang timeout: it will never bind)."""
+        with self._lock:
+            self._gangs.pop(gang, None)
+
+    # ------------------------------------------------------------ exports
+
+    def _totals(self) -> Dict[str, Any]:
+        """Cumulative integrals, the replay drift-comparison payload.
+        Plain floats: json round-trips IEEE doubles exactly, so recorded
+        and recomputed totals can be compared bit-for-bit."""
+        return {
+            "total": self.total_chip_seconds,
+            "busy": self.busy_chip_seconds,
+            "idle": dict(self.idle_chip_seconds),
+            "reasons": dict(self.pending_reason_seconds),
+            "pools": {k: dict(v) for k, v in self.by_pool.items()},
+            "namespaces": dict(self.by_namespace),
+        }
+
+    def totals(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._totals()
+
+    def utilization(self) -> float:
+        with self._lock:
+            if self.total_chip_seconds <= 0:
+                return 0.0
+            return self.busy_chip_seconds / self.total_chip_seconds
+
+    def idle_pending_fraction(self) -> float:
+        with self._lock:
+            if self.total_chip_seconds <= 0:
+                return 0.0
+            return self.idle_chip_seconds[BUCKET_PENDING] / self.total_chip_seconds
+
+    def _export_gauges(self) -> None:
+        if self.total_chip_seconds > 0:
+            m.CAPACITY_UTILIZATION.set(
+                self.busy_chip_seconds / self.total_chip_seconds
+            )
+            m.CAPACITY_IDLE_PENDING_FRACTION.set(
+                self.idle_chip_seconds[BUCKET_PENDING] / self.total_chip_seconds
+            )
+        bound_by_node: Dict[str, int] = {}
+        for node_name, chips, _ in self._bound.values():
+            bound_by_node[node_name] = bound_by_node.get(node_name, 0) + chips
+        frag_num = frag_den = 0.0
+        for name in sorted(self._nodes):
+            st = self._nodes[name]
+            used = min(st.total_chips, bound_by_node.get(name, 0))
+            m.CAPACITY_NODE_CHIPS.labels(node=name, state="total").set(st.total_chips)
+            m.CAPACITY_NODE_CHIPS.labels(node=name, state="used").set(used)
+            m.CAPACITY_NODE_CHIPS.labels(node=name, state="free").set(
+                st.total_chips - used
+            )
+            m.NODE_FRAGMENTATION.labels(node=name).set(st.frag_index)
+            self._exported_nodes.add(name)
+            frag_num += st.frag_index * st.free_chips
+            frag_den += st.free_chips
+        m.CLUSTER_FRAGMENTATION.set(frag_num / frag_den if frag_den else 0.0)
+        starved_ok = {
+            ns for _, ns in self._pending.values()
+        }  # namespaces with queued demand
+        for key in sorted(self._quotas):
+            ns, min_chips, _, used = self._quotas[key]
+            m.QUOTA_BORROWED_CHIPS.labels(namespace=ns).set(max(0, used - min_chips))
+            m.QUOTA_STARVED_CHIPS.labels(namespace=ns).set(
+                max(0, min_chips - used) if ns in starved_ok else 0
+            )
+
+    def _zero_node_gauges(self, name: str) -> None:
+        """A deleted node's labeled gauges would otherwise report its last
+        live values forever; zero them (the registry has no child-delete)."""
+        if name not in self._exported_nodes:
+            return
+        for state in ("total", "used", "free"):
+            m.CAPACITY_NODE_CHIPS.labels(node=name, state=state).set(0)
+        m.NODE_FRAGMENTATION.labels(node=name).set(0.0)
+        self._exported_nodes.discard(name)
+
+    # ---------------------------------------------------------- debugging
+
+    def debug_payload(self) -> Dict[str, Any]:
+        """The /debug/capacity document: cluster rollup, per-node detail,
+        quota posture, gang wait clocks, and links into the other debug
+        surfaces (explain/traces/record) for cross-navigation."""
+        with self._lock:
+            bound_by_node: Dict[str, int] = {}
+            for node_name, chips, _ in self._bound.values():
+                bound_by_node[node_name] = bound_by_node.get(node_name, 0) + chips
+            total_now = sum(st.total_chips for st in self._nodes.values())
+            used_now = sum(
+                min(self._nodes[n].total_chips, c)
+                for n, c in bound_by_node.items()
+                if n in self._nodes
+            )
+            pending_now = sum(chips for chips, _ in self._pending.values())
+            window = (
+                (self._last_ts - self._first_ts)
+                if self._last_ts is not None and self._first_ts is not None
+                else 0.0
+            )
+            denom = self.total_chip_seconds or 1.0
+            nodes = {}
+            frag_num = frag_den = 0.0
+            for name in sorted(self._nodes):
+                st = self._nodes[name]
+                used = min(st.total_chips, bound_by_node.get(name, 0))
+                acc = self.by_node.get(name, {"total": 0.0, "busy": 0.0})
+                nodes[name] = {
+                    "pool": st.pool,
+                    "accelerator": st.accelerator,
+                    "total_chips": st.total_chips,
+                    "used_chips": used,
+                    "free_chips": st.total_chips - used,
+                    "frozen": st.frozen,
+                    "reserved": st.reserved,
+                    "fragmentation": round(st.frag_index, 6),
+                    "largest_free_slice_chips": st.largest_free_slice,
+                    "busy_chip_seconds": acc["busy"],
+                    "total_chip_seconds": acc["total"],
+                    "utilization": (
+                        acc["busy"] / acc["total"] if acc["total"] else 0.0
+                    ),
+                }
+                frag_num += st.frag_index * st.free_chips
+                frag_den += st.free_chips
+            pending_ns = {ns for _, ns in self._pending.values()}
+            quotas = {}
+            for key in sorted(self._quotas):
+                ns, min_chips, max_chips, used = self._quotas[key]
+                quotas[key] = {
+                    "namespace": ns,
+                    "min_chips": min_chips,
+                    "max_chips": max_chips,
+                    "used_chips": used,
+                    "borrowed_chips": max(0, used - min_chips),
+                    "starved_chips": (
+                        max(0, min_chips - used) if ns in pending_ns else 0
+                    ),
+                }
+            pending_pods = [
+                {
+                    "pod": key,
+                    "chips": chips,
+                    "namespace": ns,
+                    "reason": self._unserved_sample.get(key),
+                    "links": {"explain": f"/debug/explain?pod={key}"},
+                }
+                for key, (chips, ns) in sorted(self._pending.items())
+            ]
+            return {
+                "revision": self._revision,
+                "ts": self._last_ts,
+                "window_seconds": window,
+                "observes": self.observes,
+                "cluster": {
+                    "total_chips": total_now,
+                    "used_chips": used_now,
+                    "free_chips": total_now - used_now,
+                    "pending_chips": pending_now,
+                    "utilization": self.busy_chip_seconds / denom,
+                    "idle_with_pending_demand": (
+                        self.idle_chip_seconds[BUCKET_PENDING] / denom
+                    ),
+                    "fragmentation": frag_num / frag_den if frag_den else 0.0,
+                    "chip_seconds": {
+                        "total": self.total_chip_seconds,
+                        "busy": self.busy_chip_seconds,
+                        "idle": dict(self.idle_chip_seconds),
+                        "pending_reasons": dict(self.pending_reason_seconds),
+                    },
+                },
+                "pools": {k: dict(v) for k, v in sorted(self.by_pool.items())},
+                "namespaces": dict(sorted(self.by_namespace.items())),
+                "profiles": dict(sorted(self.by_profile.items())),
+                "nodes": nodes,
+                "quotas": quotas,
+                "pending_pods": pending_pods,
+                "gangs": {
+                    "waiting": {
+                        gang: dict(clock)
+                        for gang, clock in sorted(self._gangs.items())
+                    },
+                    "recent": list(self._recent_gangs),
+                },
+                "links": {
+                    "trace_id": self._last_trace_id,
+                    "traces": "/debug/traces",
+                    "record": "/debug/record",
+                    "vars": "/debug/vars",
+                },
+            }
+
+    # -------------------------------------------------------- self check
+
+    def _canonical_state(self) -> Dict[str, Any]:
+        return {
+            "nodes": {n: st.canonical() for n, st in self._nodes.items()},
+            "bound": dict(self._bound),
+            "pending": dict(self._pending),
+            "quotas": dict(self._quotas),
+        }
+
+    def self_check(self, store=None) -> List[str]:
+        """Diff the incrementally-maintained instantaneous state against a
+        from-scratch recomputation off the store. Empty list = clean.
+
+        Skips (returns clean) when the store has moved past the ledger's
+        watermark — the comparison would race concurrent writers; the
+        auditor's sampling and the chaos oracle's quiesced polling both
+        reach the quiet case."""
+        store = store if store is not None else self.store
+        with self._lock:
+            if store.revision != self._revision:
+                return []
+            live = self._canonical_state()
+        shadow = state_from_store(store)
+        if store.revision != self._revision:
+            return []  # a writer slipped in mid-recompute: racy, skip
+        diffs: List[str] = []
+        for section in ("nodes", "bound", "pending", "quotas"):
+            a, b = live[section], shadow[section]
+            for key in sorted(set(a) | set(b)):
+                if a.get(key) != b.get(key):
+                    diffs.append(
+                        f"{section}[{key}]: incremental={a.get(key)!r} "
+                        f"store={b.get(key)!r}"
+                    )
+        return diffs
+
+
+def state_from_store(store) -> Dict[str, Any]:
+    """The ledger's instantaneous state recomputed from scratch off the
+    store — the shadow side of :meth:`CapacityLedger.self_check`."""
+    nodes: Dict[str, tuple] = {}
+    for node in store.list("Node", copy=False):
+        total = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
+        if total > 0:
+            nodes[node.metadata.name] = _NodeState(node, total).canonical()
+    bound: Dict[str, Tuple[str, int, str]] = {}
+    pending: Dict[str, Tuple[int, str]] = {}
+    for pod in store.list("Pod", copy=False):
+        chips = _pod_chips(pod)
+        if chips <= 0:
+            continue
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        phase = pod.status.phase
+        if pod.spec.node_name and phase in ("Pending", "Running"):
+            bound[key] = (pod.spec.node_name, chips, pod.metadata.namespace)
+        elif phase == "Pending":
+            pending[key] = (chips, pod.metadata.namespace)
+    quotas: Dict[str, Tuple[str, int, int, int]] = {}
+    for quota in store.list("ElasticQuota", copy=False):
+        key = f"{quota.metadata.namespace}/{quota.metadata.name}"
+        quotas[key] = (
+            quota.metadata.namespace,
+            _quota_chips(quota.spec.min),
+            _quota_chips(quota.spec.max),
+            _quota_chips(quota.status.used),
+        )
+    return {"nodes": nodes, "bound": bound, "pending": pending, "quotas": quotas}

@@ -19,6 +19,7 @@ ACTUATION_CONVERGED = "actuation-converged"
 NO_ORPHANED_RESERVATIONS = "no-orphaned-reservations"
 AUDITOR_CLEAN = "auditor-clean"
 REPLAY_CLEAN = "replay-clean"
+LEDGER_CONSISTENT = "ledger-consistent"
 
 
 def pending_settled(store, scheduler_name: str = "") -> List[str]:
@@ -128,6 +129,25 @@ def auditor_clean(partitioner, store) -> List[str]:
     return out
 
 
+def ledger_consistent(partitioner, store) -> List[str]:
+    """The capacity ledger's incremental state matches a from-scratch
+    recomputation off the store (live-only: needs the ledger). Quiesced
+    polling makes the comparison non-racy: the driver calls this after a
+    burst healed, when the store has stopped moving — a ledger observe is
+    forced first so its watermark catches up to the settled store."""
+    ledger = getattr(partitioner, "capacity_ledger", None)
+    if ledger is None:
+        return []
+    import time
+
+    # Recorded like any other observe: an unrecorded watermark advance
+    # would make later recorded totals unreproducible on replay.
+    ledger.observe(time.time())
+    return [
+        f"{LEDGER_CONSISTENT}: {diff}" for diff in ledger.self_check(store)
+    ]
+
+
 def check_convergence(
     store,
     scheduler_name: str = "",
@@ -139,6 +159,7 @@ def check_convergence(
     out += no_orphaned_reservations(store)
     if partitioner is not None:
         out += auditor_clean(partitioner, store)
+        out += ledger_consistent(partitioner, store)
     return out
 
 

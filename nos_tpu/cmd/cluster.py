@@ -30,6 +30,7 @@ from nos_tpu.device import (
     SimTpuDeviceClient,
     TpuClient,
 )
+from nos_tpu.capacity import CapacityLedger
 from nos_tpu.kube.controller import Controller, Manager, Watch
 from nos_tpu.kube.objects import Node, PodPhase
 from nos_tpu.kube.store import KubeStore
@@ -45,6 +46,7 @@ class SimCluster:
     partitioner: PartitionerController
     scheduler: Scheduler
     kubelet: Optional[SimKubelet] = None
+    capacity_ledger: Optional[CapacityLedger] = None
     device_backend: str = "sim"  # "sim" | "tpuctl" (native C++ slice state)
     tpuctl_dir: str = ""
     device_plugin_config_map: str = "nos-device-plugin-config"
@@ -138,8 +140,13 @@ class SimCluster:
 
     def start(self) -> None:
         self.manager.start()
+        if self.capacity_ledger is not None:
+            # Sim timescale: cycles are sub-second, so tick accordingly.
+            self.capacity_ledger.start_heartbeat(interval_seconds=1.0)
 
     def stop(self) -> None:
+        if self.capacity_ledger is not None:
+            self.capacity_ledger.stop_heartbeat()
         self.manager.stop()
 
     def wait_idle(self, timeout: float = 15.0) -> bool:
@@ -157,15 +164,25 @@ def build_cluster(
 ) -> SimCluster:
     store = store or KubeStore()
     manager = Manager(store=store)
+    # ONE ledger for the whole suite: the partitioner drives observes (it
+    # knows the unserved demand each cycle), the scheduler stamps gang
+    # wait clocks on the same instance.
+    ledger = CapacityLedger(store, flight_recorder=flight_recorder)
     build_operator(manager, operator_config, flight_recorder=flight_recorder)
     partitioner_config = partitioner_config or GpuPartitionerConfig(
         batch_window_timeout_seconds=1.0, batch_window_idle_seconds=0.05
     )
     partitioner = build_partitioner(
-        manager, partitioner_config, flight_recorder=flight_recorder
+        manager,
+        partitioner_config,
+        flight_recorder=flight_recorder,
+        capacity_ledger=ledger,
     )
     scheduler = build_scheduler(
-        manager, scheduler_config, flight_recorder=flight_recorder
+        manager,
+        scheduler_config,
+        flight_recorder=flight_recorder,
+        capacity_ledger=ledger,
     )
     pool = SimDevicePool()
     # Admission arbitrates against the device inventory (ground truth),
@@ -226,6 +243,7 @@ def build_cluster(
         partitioner=partitioner,
         scheduler=scheduler,
         kubelet=kubelet,
+        capacity_ledger=ledger,
         device_backend=device_backend,
         tpuctl_dir=tpuctl_dir,
         device_plugin_config_map=partitioner_config.device_plugin_config_map,

@@ -68,6 +68,7 @@ def build_partitioner(
     manager: Manager,
     config: GpuPartitionerConfig | None = None,
     flight_recorder=None,
+    capacity_ledger=None,
 ) -> PartitionerController:
     config = config or GpuPartitionerConfig()
     config.validate()
@@ -117,6 +118,9 @@ def build_partitioner(
         auditor=auditor,
         incremental_planning=config.incremental_planning,
         incremental_dirty_threshold=config.incremental_dirty_threshold,
+        # The tpu controller alone drives ledger observes: one observer per
+        # cluster, or chip-seconds would double-integrate per cycle.
+        capacity_ledger=capacity_ledger,
     )
 
     node_ctrl = StateNodeController(store, cluster_state, initializer=initializer)

@@ -175,11 +175,14 @@ def main(argv=None) -> int:
         port=port,
         explain_fn=cluster.scheduler.explain,
         record_fn=flight_recorder.records if flight_recorder is not None else None,
+        capacity_fn=cluster.capacity_ledger.debug_payload
+        if cluster.capacity_ledger is not None
+        else None,
     )
     bound = health.start()
     logging.info(
         "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain"
-        "%s)",
+        " /debug/capacity%s)",
         bound,
         " /debug/record" if flight_recorder is not None else "",
     )

@@ -15,6 +15,7 @@ def build_scheduler(
     manager: Manager,
     config: SchedulerConfig | None = None,
     flight_recorder=None,
+    capacity_ledger=None,
 ) -> Scheduler:
     config = config or SchedulerConfig()
     config.validate()
@@ -31,6 +32,7 @@ def build_scheduler(
         scheduler_name=config.scheduler_name,
         recorder=EventRecorder(store, component="nos-scheduler"),
         flight_recorder=flight_recorder,
+        capacity_ledger=capacity_ledger,
     )
     if flight_recorder is not None:
         # Session facts replay needs to rebuild an identical scheduler.

@@ -54,6 +54,7 @@ class PartitionerController:
         auditor=None,
         incremental_planning: bool = True,
         incremental_dirty_threshold: Optional[float] = None,
+        capacity_ledger=None,
     ) -> None:
         self.store = store
         # Optional kube/events.py EventRecorder: PartitioningApplied when a
@@ -65,6 +66,10 @@ class PartitionerController:
         # planner's incremental caches after a plan).
         self.flight_recorder = flight_recorder
         self.auditor = auditor
+        # Optional capacity.CapacityLedger (cluster-wide, shared with the
+        # scheduler): observed once per plan cycle with the planner's
+        # unserved reasons, so idle time between cycles gets attributed.
+        self.capacity_ledger = capacity_ledger
         # namespaced_name -> last CarveFailed reason recorded; pruned to
         # the live pending set every cycle so deleted pods don't leak.
         self._last_carve_reason: Dict[str, str] = {}
@@ -343,6 +348,17 @@ class PartitionerController:
                     applied = self.actuator.apply(current, plan)
                 proc.set_attributes(nodes_repartitioned=applied)
                 self._record_plan(revision, pending, plan, applied, journey)
+                if self.capacity_ledger is not None:
+                    # One ledger observation per plan cycle: close the
+                    # interval since the previous cycle and re-label the
+                    # pending-idle bucket from this plan's carve failures.
+                    self.capacity_ledger.observe(
+                        time.time(),
+                        unserved=dict(
+                            getattr(self.planner, "last_unserved", {}) or {}
+                        ),
+                        trace_id=journey.trace_id if journey is not None else "",
+                    )
                 if self.auditor is not None and self.auditor.should_audit():
                     violations = self.auditor.audit_plan(
                         self.planner,
@@ -350,6 +366,7 @@ class PartitionerController:
                         revision=revision,
                         pending=pending,
                         desired=desired,
+                        ledger=self.capacity_ledger,
                     )
                     proc.set_attributes(audit_violations=len(violations))
         if applied:

@@ -23,8 +23,15 @@ import time
 from typing import Any, List, Optional
 
 from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.annotations import PREFIX
 from nos_tpu.kube.objects import Event
 from nos_tpu.kube.store import AlreadyExistsError, NotFoundError
+
+# Correlation: every Event carries the trace id of the decision journey
+# that emitted it, so `kubectl describe` output links straight into
+# /debug/traces. Annotation only — NOT part of the dedup digest, or each
+# journey would mint a fresh Event instead of bumping the counter.
+TRACE_ID_ANNOTATION = PREFIX + "trace-id"
 
 # client-go spam-filter defaults: a burst of 25 events per object, then
 # one more every 5 minutes (qps = 1/300).
@@ -98,9 +105,19 @@ class EventRecorder:
         # like the real apiserver's event sink.
         event_ns = involved_ns or "default"
 
+        from nos_tpu.util.tracing import TRACER
+
+        span = TRACER.current()
+        trace_id = span.trace_id if span is not None else ""
+
         def bump(ev: Event) -> None:
             ev.count += 1
             ev.last_timestamp = now
+            if trace_id:
+                # A repeat keeps the annotation pointing at its LATEST
+                # occurrence's journey — that's the trace still in the
+                # ring buffer when an operator goes looking.
+                ev.metadata.annotations[TRACE_ID_ANNOTATION] = trace_id
 
         try:
             return self.store.patch_merge("Event", name, event_ns, bump)
@@ -120,6 +137,8 @@ class EventRecorder:
         )
         ev.metadata.name = name
         ev.metadata.namespace = event_ns
+        if trace_id:
+            ev.metadata.annotations[TRACE_ID_ANNOTATION] = trace_id
         try:
             return self.store.create(ev)
         except AlreadyExistsError:

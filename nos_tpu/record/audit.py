@@ -29,6 +29,13 @@ Named checks:
                       on a fresh clone of the base snapshot (runs only
                       when the audited plan actually took the incremental
                       path and the controller passed its inputs along)
+- ``capacity_ledger`` the CapacityLedger's incrementally-maintained
+                      instantaneous state (per-node chips/flags/
+                      fragmentation, bound/pending pods, quota posture)
+                      vs. a from-scratch recomputation off the store
+                      (runs only when the controller passed its ledger
+                      along; skips silently while concurrent writers
+                      hold the store past the ledger's watermark)
 
 Live mode samples (deterministic counter stride, config-controlled) and
 caps per-check work; replay audits exhaustively. Replay is ALSO the
@@ -54,6 +61,7 @@ CHECKS = (
     "mutation_clock",
     "carve_futility",
     "incremental_plan",
+    "capacity_ledger",
 )
 
 
@@ -122,6 +130,7 @@ class InvariantAuditor:
         revision: int = 0,
         pending=None,
         desired=None,
+        ledger=None,
     ) -> List[AuditViolation]:
         """Run every check against the given planner's just-completed
         plan() state. Publishes violations (metric, Event, flight record)
@@ -139,6 +148,7 @@ class InvariantAuditor:
         violations += self.check_incremental_plan(
             planner, snapshot, pending, desired
         )
+        violations += self.check_capacity_ledger(ledger)
         self.publish(violations, snapshot, revision)
         return violations
 
@@ -387,6 +397,23 @@ class InvariantAuditor:
                 )
             )
         return out
+
+    def check_capacity_ledger(self, ledger) -> List[AuditViolation]:
+        """Shadow-recompute the capacity ledger's instantaneous state from
+        scratch off its store and diff against the incremental view. The
+        ledger itself declines the comparison (empty diff) when the store
+        has advanced past its watermark — that window is a race between
+        control loops, not drift."""
+        if ledger is None:
+            return []
+        return [
+            AuditViolation(
+                check="capacity_ledger",
+                subject="ledger",
+                detail=diff,
+            )
+            for diff in ledger.self_check()
+        ]
 
     @staticmethod
     def _shadow_plan(planner, snapshot, pending):

@@ -266,3 +266,26 @@ class FlightRecorder:
 
     def record_audit(self, *, revision: int, violations: List[dict]) -> None:
         self._append("audit", revision=revision, violations=violations)
+
+    def record_capacity(
+        self,
+        *,
+        revision: int,
+        now: float,
+        reason: Optional[str],
+        totals: dict,
+        trace_id: str = "",
+    ) -> None:
+        """One integrating CapacityLedger.observe(): the watermark it
+        drained to, the wall timestamp it integrated to (``now`` — replay
+        re-integrates from these, never from its own clock), the pending
+        reason chosen for the next interval, and the cumulative
+        chip-second totals for zero-drift comparison."""
+        self._append(
+            "capacity.observe",
+            revision=revision,
+            now=now,
+            reason=reason,
+            totals=totals,
+            trace_id=trace_id,
+        )

@@ -38,6 +38,7 @@ from nos_tpu.record.audit import InvariantAuditor
 class ReplayReport:
     cycles: int = 0
     plans: int = 0
+    capacity_observes: int = 0
     drifts: List[dict] = field(default_factory=list)
     violations: List[dict] = field(default_factory=list)
     skips: List[dict] = field(default_factory=list)
@@ -47,7 +48,8 @@ class ReplayReport:
 
     def render(self) -> str:
         lines = [
-            f"replayed {self.cycles} scheduler cycle(s), {self.plans} plan(s): "
+            f"replayed {self.cycles} scheduler cycle(s), {self.plans} plan(s), "
+            f"{self.capacity_observes} capacity observe(s): "
             f"{len(self.drifts)} drift(s), {len(self.violations)} audit "
             f"violation(s), {len(self.skips)} skip(s)"
         ]
@@ -95,7 +97,8 @@ class ReplaySession:
             (
                 r
                 for r in records
-                if r.get("kind") in ("scheduler.cycle", "planner.plan")
+                if r.get("kind")
+                in ("scheduler.cycle", "planner.plan", "capacity.observe")
             ),
             key=lambda r: (r.get("revision", 0), r["seq"]),
         )
@@ -120,6 +123,16 @@ class ReplaySession:
             for kind in ("tpu", "sharing")
         }
         self.auditor = InvariantAuditor(sample_rate=1.0)
+        # Shadow capacity ledger: watches the replay store (constructed
+        # BEFORE any delta applies, so its watch sees every event), fed
+        # the recorded observe timestamps — its integrals must land
+        # bit-exactly on the recorded totals. No metrics, no recorder:
+        # replay must not pollute gauges or re-record.
+        from nos_tpu.capacity import CapacityLedger
+
+        self.capacity_ledger = CapacityLedger(
+            self.store, flight_recorder=None, metrics=False
+        )
 
     # ----------------------------------------------------------- state
 
@@ -150,6 +163,8 @@ class ReplaySession:
             self._apply_deltas_up_to(record.get("revision", 0))
             if record["kind"] == "scheduler.cycle":
                 self._replay_cycle(record, report)
+            elif record["kind"] == "capacity.observe":
+                self._replay_capacity(record, report)
             else:
                 self._replay_plan(record, report)
         return report
@@ -248,6 +263,35 @@ class ReplaySession:
             planner, snapshot, exhaustive=True, revision=record.get("revision", 0)
         )
         report.violations.extend(v.to_dict() for v in violations)
+
+    def _replay_capacity(self, record: dict, report: ReplayReport) -> None:
+        """Re-integrate the shadow ledger up to the recorded timestamp and
+        demand the recorded totals bit-for-bit. Chip-second integrals are
+        sums of float products in deterministic (sorted) order over state
+        derived purely from the deltas, and JSON round-trips IEEE doubles
+        exactly — so equality here is ==, not almost-equal. Any mismatch
+        means the incremental bookkeeping diverged from the recorded run."""
+        report.capacity_observes += 1
+        self.capacity_ledger.observe(
+            record["now"], reason=record.get("reason", ""), record=False
+        )
+        got = self.capacity_ledger.totals()
+        want = record.get("totals", {})
+        if got != want:
+            report.drifts.append(
+                {
+                    "seq": record["seq"],
+                    "kind": "capacity.observe",
+                    "detail": f"recorded totals {want} but replay integrated {got}",
+                }
+            )
+        # The live auditor samples self_check only when the store is quiet;
+        # replay is single-threaded, so every observe gets the exhaustive
+        # incremental-vs-from-scratch comparison.
+        for diff in self.capacity_ledger.self_check(self.store):
+            report.violations.append(
+                {"check": "capacity_ledger", "subject": "ledger", "detail": diff}
+            )
 
 
 def replay_file(path: str) -> ReplayReport:

@@ -106,6 +106,7 @@ class Scheduler:
         scheduler_name: str = "",
         recorder=None,
         flight_recorder=None,
+        capacity_ledger=None,
     ) -> None:
         self.store = store
         self.framework = framework
@@ -121,6 +122,10 @@ class Scheduler:
         # Optional record.FlightRecorder: one decision record per cycle,
         # written between _decide and _apply_outcome.
         self.flight_recorder = flight_recorder
+        # Optional capacity.CapacityLedger: per-gang wait clocks (arrival →
+        # first-feasible → bound) feeding nos_tpu_gang_wait_seconds. None
+        # in replayed schedulers, so replay never double-observes waits.
+        self.capacity_ledger = capacity_ledger
         # Latest Diagnosis per pod, served by /debug/explain. Bounded:
         # oldest entry falls off so a churning cluster can't grow it.
         self._diagnoses: Dict[str, dict] = {}
@@ -239,8 +244,20 @@ class Scheduler:
         recorded deltas."""
         return self._decide(pod)
 
+    def _gang_key(self, pod: Pod) -> Optional[str]:
+        from nos_tpu.scheduler.plugins.gang import gang_of
+
+        membership = gang_of(pod)
+        return membership[0] if membership else None
+
     def _decide(self, pod: Pod) -> CycleOutcome:
         start = time.monotonic()
+        if self.capacity_ledger is not None:
+            gang_key = self._gang_key(pod)
+            if gang_key is not None:
+                # Idempotent: the first cycle that sees any member starts
+                # the gang's wait clock.
+                self.capacity_ledger.note_gang_arrival(gang_key, time.time())
         if self.capacity is not None:
             self.capacity.last_victims = []
         state = CycleState()
@@ -404,6 +421,15 @@ class Scheduler:
         )
 
     def _apply_outcome(self, pod: Pod, outcome: CycleOutcome) -> Optional[Result]:
+        if self.capacity_ledger is not None and outcome.decision in (
+            "wait",
+            "bind",
+        ):
+            # A member passing Permit (wait) or releasing the gang (bind)
+            # means the whole gang found feasible nodes this cycle.
+            gang_key = self._gang_key(pod)
+            if gang_key is not None:
+                self.capacity_ledger.note_gang_feasible(gang_key, time.time())
         if outcome.decision == "nominate":
             self._set_nominated(pod, outcome.node)
             # Victims are terminating; retry shortly.
@@ -427,6 +453,11 @@ class Scheduler:
         )
         if self.gang is not None and len(outcome.to_bind) > 1:
             metrics.GANGS_SCHEDULED.inc()
+        if self.capacity_ledger is not None:
+            for bound_pod, _ in outcome.to_bind:
+                gang_key = self._gang_key(bound_pod)
+                if gang_key is not None:
+                    self.capacity_ledger.note_gang_bound(gang_key, time.time())
         return None
 
     # --------------------------------------------------------- diagnosis
@@ -572,6 +603,12 @@ class Scheduler:
             return
         for members in self.gang.expired_gangs():
             for member_pod, node_name in members:
+                if self.capacity_ledger is not None:
+                    gang_key = self._gang_key(member_pod)
+                    if gang_key is not None:
+                        # The gang will never bind: a dead clock would
+                        # otherwise pollute the wait histogram at re-arrival.
+                        self.capacity_ledger.drop_gang(gang_key)
                 state = CycleState()
                 self._assumed.pop(member_pod.namespaced_name, None)
                 self.framework.run_unreserve_plugins(state, member_pod, node_name)

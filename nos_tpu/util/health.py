@@ -27,6 +27,7 @@ class HealthServer:
         metrics_loopback_port: Optional[int] = None,
         explain_fn: Optional[Callable[[str], Optional[dict]]] = None,
         record_fn: Optional[Callable[[], list]] = None,
+        capacity_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -38,6 +39,10 @@ class HealthServer:
         # /debug/record -> the flight recorder's in-memory ring (list of
         # record dicts); None disables the endpoint (recording off).
         self.record_fn = record_fn
+        # /debug/capacity -> the CapacityLedger's rollup document (per-node
+        # and cluster chip-seconds, idle attribution, fragmentation, gang
+        # waits); None disables the endpoint (no ledger wired).
+        self.capacity_fn = capacity_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -62,6 +67,31 @@ class HealthServer:
         metrics_token = self.metrics_token
         explain_fn = self.explain_fn
         record_fn = self.record_fn
+        capacity_fn = self.capacity_fn
+
+        # The /debug/ index: every debug surface this listener actually
+        # serves, with a one-liner. Conditional entries appear only when
+        # their callback is wired, so the index never lists a 404.
+        debug_index = {
+            "/debug/traces": "per-trace summaries; ?id=<trace_id> for the "
+            "full Chrome trace-event timeline",
+            "/debug/vars": "the MetricsRegistry snapshot as flat JSON",
+        }
+        if explain_fn is not None:
+            debug_index["/debug/explain"] = (
+                "?pod=<namespace>/<name> — the scheduler's latest per-node "
+                "per-plugin rejection Diagnosis for the pod"
+            )
+        if record_fn is not None:
+            debug_index["/debug/record"] = (
+                "the flight recorder's decision ring; ?format=jsonl for "
+                "`python -m nos_tpu replay` input"
+            )
+        if capacity_fn is not None:
+            debug_index["/debug/capacity"] = (
+                "the capacity ledger: chip-seconds accounting, idle "
+                "attribution, fragmentation, gang waits, quota posture"
+            )
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
 
@@ -159,6 +189,26 @@ class HealthServer:
                         self._respond(401, "unauthorized")
                         return
                     body = json.dumps(REGISTRY.snapshot(), indent=2, sort_keys=True)
+                    self._respond(200, body, "application/json")
+                elif (
+                    path == "/debug/capacity"
+                    and serve_metrics
+                    and capacity_fn is not None
+                ):
+                    # Same credential as /metrics: the rollup carries node,
+                    # pod, and namespace names.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    body = json.dumps(capacity_fn(), indent=2)
+                    self._respond(200, body, "application/json")
+                elif path in ("/debug", "/debug/") and serve_metrics:
+                    # Bearer-gated like every endpoint it links to — the
+                    # index itself reveals which subsystems are wired.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    body = json.dumps({"endpoints": debug_index}, indent=2)
                     self._respond(200, body, "application/json")
                 else:
                     self._respond(404, "not found")

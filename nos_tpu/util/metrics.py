@@ -447,7 +447,7 @@ AUDIT_VIOLATIONS = REGISTRY.counter(
     "nos_tpu_audit_violations_total",
     "Invariant-auditor checks whose shadow recompute disagreed with the "
     "incremental structure (verdict cache, lacking totals, free pool, "
-    "mutation clock, carve-futility memo) (by check)",
+    "mutation clock, carve-futility memo, capacity ledger) (by check)",
 )
 
 # Chaos harness (chaos/).
@@ -459,4 +459,54 @@ CHAOS_CONVERGENCE = REGISTRY.histogram(
     "nos_tpu_chaos_convergence_seconds",
     "Wall time from end-of-burst heal to all convergence oracles passing",
     buckets=(0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
+)
+
+# Capacity ledger (capacity/): live time-weighted chip-seconds accounting.
+CAPACITY_CHIP_SECONDS = REGISTRY.counter(
+    "nos_tpu_capacity_chip_seconds_total",
+    "Chip-seconds integrated between control-cycle observations, by "
+    "state=busy|no-demand|pending-unschedulable|reconfig|reserved-by-gang "
+    "(idle states attribute where idle time went; reason carries the "
+    "dominant carve-failure prefix for pending-unschedulable)",
+)
+CAPACITY_UTILIZATION = REGISTRY.gauge(
+    "nos_tpu_capacity_utilization",
+    "Cumulative cluster utilization: busy chip-seconds / total "
+    "chip-seconds since the ledger started",
+)
+CAPACITY_IDLE_PENDING_FRACTION = REGISTRY.gauge(
+    "nos_tpu_capacity_idle_pending_fraction",
+    "Share of total chip-seconds spent idle while unbound pending TPU "
+    "demand existed (the scheduling-inefficiency meter of ROADMAP item 2)",
+)
+CAPACITY_NODE_CHIPS = REGISTRY.gauge(
+    "nos_tpu_capacity_node_chips",
+    "Instantaneous per-node chip counts (by node, state=total|used|free); "
+    "zeroed when the node is deleted",
+)
+NODE_FRAGMENTATION = REGISTRY.gauge(
+    "nos_tpu_node_fragmentation_index",
+    "Per-node fragmentation: 1 - largest-carveable-slice / free-chips "
+    "from the reported slice geometry (0 = a pending job as large as the "
+    "free space could still be carved)",
+)
+CLUSTER_FRAGMENTATION = REGISTRY.gauge(
+    "nos_tpu_cluster_fragmentation_index",
+    "Free-chip-weighted mean of the per-node fragmentation indices",
+)
+GANG_WAIT_SECONDS = REGISTRY.histogram(
+    "nos_tpu_gang_wait_seconds",
+    "Gang wait from arrival, by stage=first_feasible|bound (first_feasible "
+    "= the first cycle the whole gang found nodes; bound = released for "
+    "binding)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
+)
+QUOTA_BORROWED_CHIPS = REGISTRY.gauge(
+    "nos_tpu_quota_borrowed_chips",
+    "Chips a namespace uses beyond its ElasticQuota min (by namespace)",
+)
+QUOTA_STARVED_CHIPS = REGISTRY.gauge(
+    "nos_tpu_quota_starved_chips",
+    "Chips of guaranteed ElasticQuota min a namespace is short of while "
+    "it has pending demand (by namespace)",
 )

@@ -1,0 +1,83 @@
+"""/debug/capacity endpoint: bearer gate, rollup document, index entry."""
+import http.client
+import json
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.capacity import CapacityLedger
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.util.health import HealthServer
+
+from tests.factory import build_pod, build_tpu_node
+
+
+def _get(port, path, token=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode()
+
+
+def _make_ledger():
+    store = KubeStore()
+    ledger = CapacityLedger(store, metrics=False)
+    store.create(build_tpu_node(name="n1", chips=8))
+    store.create(build_pod("w", {constants.RESOURCE_TPU: 4}, node="n1"))
+    store.create(build_pod("pend", {constants.RESOURCE_TPU: 2}, ns="ml"))
+    ledger.observe(1000.0, unserved={"ml/pend": "insufficient capacity: 2"})
+    ledger.observe(1010.0, unserved={"ml/pend": "insufficient capacity: 2"})
+    return ledger
+
+
+class TestDebugCapacityEndpoint:
+    def test_serves_rollup_behind_bearer_gate(self):
+        ledger = _make_ledger()
+        server = HealthServer(
+            port=0, metrics_token="s3cret", capacity_fn=ledger.debug_payload
+        )
+        port = server.start()
+        try:
+            assert _get(port, "/debug/capacity")[0] == 401
+            assert _get(port, "/debug/capacity", "wrong")[0] == 401
+            status, body = _get(port, "/debug/capacity", "s3cret")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["cluster"]["total_chips"] == 8
+            assert doc["cluster"]["used_chips"] == 4
+            assert doc["cluster"]["utilization"] == 0.5
+            assert doc["nodes"]["n1"]["free_chips"] == 4
+            assert doc["pending_pods"][0]["pod"] == "ml/pend"
+            assert doc["pending_pods"][0]["links"]["explain"] == (
+                "/debug/explain?pod=ml/pend"
+            )
+        finally:
+            server.stop()
+
+    def test_404_when_no_ledger_is_wired(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/capacity")[0] == 404
+        finally:
+            server.stop()
+
+    def test_debug_index_lists_capacity_when_wired(self):
+        ledger = _make_ledger()
+        server = HealthServer(port=0, capacity_fn=ledger.debug_payload)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/")
+            assert status == 200
+            endpoints = json.loads(body)["endpoints"]
+            assert "/debug/capacity" in endpoints
+        finally:
+            server.stop()
+
+    def test_debug_index_omits_capacity_when_absent(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            endpoints = json.loads(_get(port, "/debug/")[1])["endpoints"]
+            assert "/debug/capacity" not in endpoints
+        finally:
+            server.stop()

@@ -1,0 +1,386 @@
+"""CapacityLedger unit coverage: integration math, idle attribution,
+fragmentation, gang clocks, quota posture, and the self-check shadow."""
+import pytest
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.capacity import (
+    BUCKET_NO_DEMAND,
+    BUCKET_PENDING,
+    BUCKET_RECONFIG,
+    BUCKET_RESERVED,
+    CapacityLedger,
+    fragmentation_from_annotations,
+)
+from nos_tpu.capacity.ledger import dominant_unserved_reason, state_from_store
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import V5E, build_pod, build_tpu_node
+
+T0 = 1_000_000.0
+
+
+def make_ledger(metrics=False):
+    store = KubeStore()
+    return store, CapacityLedger(store, metrics=metrics)
+
+
+class TestIntegration:
+    def test_busy_and_idle_chip_seconds(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_tpu_node(name="n2", chips=8))
+        store.create(build_pod("w", {constants.RESOURCE_TPU: 4}, node="n1"))
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        t = ledger.totals()
+        assert t["total"] == 160.0  # 16 chips x 10 s
+        assert t["busy"] == 40.0  # 4 bound chips x 10 s
+        assert t["idle"][BUCKET_NO_DEMAND] == 120.0
+        assert ledger.utilization() == pytest.approx(0.25)
+
+    def test_interval_integrates_pre_drain_state(self):
+        # A pod bound DURING the interval contributes nothing to that
+        # interval: transitions become visible at the end of it.
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        store.create(build_pod("w", {constants.RESOURCE_TPU: 8}, node="n1"))
+        ledger.observe(T0 + 5)  # interval [T0, T0+5) was all idle
+        assert ledger.totals()["busy"] == 0.0
+        ledger.observe(T0 + 15)  # now the binding is in effect
+        assert ledger.totals()["busy"] == 80.0
+
+    def test_pending_coverage_rule(self):
+        # 8 idle chips, 4 pending chips: only min(idle, pending) counts as
+        # scheduling inefficiency; the rest is genuine no-demand idle.
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_pod("pend", {constants.RESOURCE_TPU: 4}))
+        ledger.observe(T0, unserved={"default/pend": "insufficient capacity: 4"})
+        ledger.observe(T0 + 10)
+        t = ledger.totals()
+        assert t["idle"][BUCKET_PENDING] == 40.0
+        assert t["idle"][BUCKET_NO_DEMAND] == 40.0
+        assert t["reasons"] == {"insufficient capacity": 40.0}
+        assert ledger.idle_pending_fraction() == pytest.approx(0.5)
+
+    def test_frozen_node_idles_into_reconfig(self):
+        store, ledger = make_ledger()
+        node = build_tpu_node(
+            name="n1",
+            chips=8,
+            annotations={
+                annot.SPEC_PARTITIONING_PLAN: "plan-2",
+                annot.STATUS_PARTITIONING_PLAN: "plan-1",
+            },
+        )
+        store.create(node)
+        # Pending demand exists, but a frozen node is not schedulable
+        # inefficiency — it is actively being repartitioned.
+        store.create(build_pod("pend", {constants.RESOURCE_TPU: 4}))
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        t = ledger.totals()
+        assert t["idle"][BUCKET_RECONFIG] == 80.0
+        assert t["idle"][BUCKET_PENDING] == 0.0
+
+    def test_reserved_node_idles_into_reserved_bucket(self):
+        store, ledger = make_ledger()
+        node = build_tpu_node(
+            name="n1",
+            chips=8,
+            annotations={annot.PREFIX + "reserved-for": "ml/gang-leader"},
+        )
+        store.create(node)
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        assert ledger.totals()["idle"][BUCKET_RESERVED] == 80.0
+
+    def test_namespace_and_pool_rollups(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_pod("a", {constants.RESOURCE_TPU: 2}, ns="ml", node="n1"))
+        store.create(build_pod("b", {constants.RESOURCE_TPU: 4}, ns="batch", node="n1"))
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        t = ledger.totals()
+        assert t["namespaces"] == {"ml": 20.0, "batch": 40.0}
+        assert t["pools"]["tpu"] == {"total": 80.0, "busy": 60.0}
+
+    def test_finished_pod_stops_accruing(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        pod = build_pod("w", {constants.RESOURCE_TPU: 8}, node="n1")
+        store.create(pod)
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        assert ledger.totals()["busy"] == 80.0
+        done = build_pod("w", {constants.RESOURCE_TPU: 8}, node="n1")
+        done.status.phase = "Succeeded"
+        store.update(done)
+        ledger.observe(T0 + 11)  # drains the phase change
+        ledger.observe(T0 + 21)
+        assert ledger.totals()["busy"] == 80.0 + 8.0  # one more second, then idle
+
+    def test_node_delete_drops_from_accounting(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        store.delete("Node", "n1")
+        ledger.observe(T0 + 10)
+        ledger.observe(T0 + 20)
+        assert ledger.totals()["total"] == 80.0  # only the first interval
+
+    def test_busy_capped_at_capacity(self):
+        # Double-booked chips (mid-preemption) never integrate above the
+        # node's physical capacity.
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_pod("a", {constants.RESOURCE_TPU: 8}, node="n1"))
+        store.create(build_pod("b", {constants.RESOURCE_TPU: 8}, node="n1"))
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        assert ledger.totals()["busy"] == 80.0
+
+
+class TestHeartbeat:
+    def test_accrues_without_control_loop_observes(self):
+        # A quiet steady-state cluster (no plan cycles, no explicit
+        # observes) must still integrate chip-seconds.
+        import time
+
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.start_heartbeat(interval_seconds=0.05)
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and ledger.totals()["total"] <= 0:
+                time.sleep(0.02)
+        finally:
+            ledger.stop_heartbeat()
+        assert ledger.observes >= 2
+        assert ledger.totals()["total"] > 0
+        assert ledger.self_check() == []
+
+    def test_start_and_stop_are_idempotent(self):
+        _, ledger = make_ledger()
+        ledger.start_heartbeat(interval_seconds=60.0)
+        thread = ledger._hb_thread
+        ledger.start_heartbeat(interval_seconds=60.0)
+        assert ledger._hb_thread is thread  # second start is a no-op
+        ledger.stop_heartbeat()
+        ledger.stop_heartbeat()
+        assert ledger._hb_thread is None
+
+
+class TestReasons:
+    def test_dominant_reason_majority_and_prefix(self):
+        assert (
+            dominant_unserved_reason(
+                {
+                    "a": "insufficient google.com/tpu: needs 8",
+                    "b": "insufficient google.com/tpu: needs 4",
+                    "c": "untolerated taint: k=v",
+                }
+            )
+            == "insufficient google.com/tpu"
+        )
+
+    def test_dominant_reason_tie_is_lexicographic(self):
+        assert dominant_unserved_reason({"a": "beta", "b": "alpha"}) == "alpha"
+
+    def test_empty_unserved_is_none(self):
+        assert dominant_unserved_reason({}) is None
+
+
+class TestFragmentation:
+    def test_no_free_chips_is_not_fragmented(self):
+        assert fragmentation_from_annotations({}, V5E) == (0.0, 0, 0)
+        ann = annot.status_from_devices(free={}, used={0: {"2x4": 1}})
+        assert fragmentation_from_annotations(ann, V5E) == (0.0, 0, 0)
+
+    def test_whole_board_free_is_not_fragmented(self):
+        ann = annot.status_from_devices(free={0: {"2x4": 1}}, used={})
+        index, largest, free = fragmentation_from_annotations(ann, V5E)
+        assert (index, largest, free) == (0.0, 8, 8)
+
+    def test_scattered_singles_are_fragmented(self):
+        # 3 free chips as 1x1s: the largest V5E shape fitting is a 1x2
+        # (2 chips), so a third of the free capacity is uncarveable.
+        ann = annot.status_from_devices(free={0: {"1x1": 3}}, used={0: {"1x1": 5}})
+        index, largest, free = fragmentation_from_annotations(ann, V5E)
+        assert (largest, free) == (2, 3)
+        assert index == pytest.approx(1.0 - 2.0 / 3.0)
+
+    def test_free_split_across_boards_cannot_merge(self):
+        # 16-chip node (two boards), each board has a free 2x2: largest
+        # single carve is 4 chips out of 8 free — index 0.5.
+        ann = annot.status_from_devices(
+            free={0: {"2x2": 1}, 1: {"2x2": 1}}, used={0: {"2x2": 1}, 1: {"2x2": 1}}
+        )
+        index, largest, free = fragmentation_from_annotations(ann, V5E)
+        assert (largest, free) == (4, 8)
+        assert index == pytest.approx(0.5)
+
+
+class TestGangClocks:
+    def test_arrival_feasible_bound_flow(self):
+        _, ledger = make_ledger()
+        ledger.note_gang_arrival("ml/g1", T0)
+        ledger.note_gang_arrival("ml/g1", T0 + 1)  # idempotent
+        ledger.note_gang_feasible("ml/g1", T0 + 2)
+        ledger.note_gang_feasible("ml/g1", T0 + 3)  # first one wins
+        ledger.note_gang_bound("ml/g1", T0 + 4)
+        recent = ledger.debug_payload()["gangs"]["recent"]
+        assert recent == [
+            {"gang": "ml/g1", "wait_seconds": 4.0, "feasible_after": 2.0}
+        ]
+        # Bound pops the clock: a repeat is a no-op, not a double-observe.
+        ledger.note_gang_bound("ml/g1", T0 + 9)
+        assert len(ledger.debug_payload()["gangs"]["recent"]) == 1
+
+    def test_timeout_drops_clock(self):
+        _, ledger = make_ledger()
+        ledger.note_gang_arrival("ml/g1", T0)
+        ledger.drop_gang("ml/g1")
+        payload = ledger.debug_payload()["gangs"]
+        assert payload["waiting"] == {} and payload["recent"] == []
+
+
+class TestSelfCheck:
+    def test_clean_after_observe(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_pod("w", {constants.RESOURCE_TPU: 4}, node="n1"))
+        store.create(build_pod("pend", {constants.RESOURCE_TPU: 4}))
+        ledger.observe(T0)
+        assert ledger.self_check() == []
+
+    def test_skips_when_store_moved_past_watermark(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        store.create(build_pod("racer", {constants.RESOURCE_TPU: 1}))
+        # The store moved; a diff now would be racy, so the check skips.
+        assert ledger.self_check() == []
+        ledger.observe(T0 + 1)
+        assert ledger.self_check() == []
+
+    def test_detects_corrupted_incremental_state(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        ledger._bound["default/ghost"] = ("n1", 4, "default")  # corrupt
+        diffs = ledger.self_check()
+        assert diffs and "bound[default/ghost]" in diffs[0]
+
+    def test_state_from_store_matches_full_lifecycle(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_tpu_node(name="n2", chips=16, topology="4x4"))
+        store.create(build_pod("a", {constants.RESOURCE_TPU: 4}, node="n1"))
+        store.create(build_pod("b", {constants.RESOURCE_TPU: 8}, ns="ml"))
+        store.delete("Node", "n2")
+        ledger.observe(T0)
+        assert ledger._canonical_state() == state_from_store(store)
+
+
+class TestQuotas:
+    def test_borrowed_and_starved_in_debug_payload(self):
+        from nos_tpu.api.v1alpha1.elasticquota import ElasticQuota, ElasticQuotaSpec
+        from nos_tpu.kube.objects import ObjectMeta
+
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=16, topology="4x4"))
+        borrower = ElasticQuota(
+            metadata=ObjectMeta(name="q-ml", namespace="ml"),
+            spec=ElasticQuotaSpec(
+                min={constants.RESOURCE_TPU_CHIPS: 4},
+                max={constants.RESOURCE_TPU_CHIPS: 16},
+            ),
+        )
+        borrower.status.used = {constants.RESOURCE_TPU_CHIPS: 10}
+        starved = ElasticQuota(
+            metadata=ObjectMeta(name="q-batch", namespace="batch"),
+            spec=ElasticQuotaSpec(
+                min={constants.RESOURCE_TPU_CHIPS: 8},
+                max={constants.RESOURCE_TPU_CHIPS: 8},
+            ),
+        )
+        starved.status.used = {constants.RESOURCE_TPU_CHIPS: 2}
+        store.create(borrower)
+        store.create(starved)
+        # batch has queued demand, so its unused min counts as starvation.
+        store.create(build_pod("pend", {constants.RESOURCE_TPU: 4}, ns="batch"))
+        ledger.observe(T0)
+        quotas = ledger.debug_payload()["quotas"]
+        assert quotas["ml/q-ml"]["borrowed_chips"] == 6
+        assert quotas["ml/q-ml"]["starved_chips"] == 0
+        assert quotas["batch/q-batch"]["borrowed_chips"] == 0
+        assert quotas["batch/q-batch"]["starved_chips"] == 6
+        assert ledger.self_check() == []
+
+
+class TestDebugPayload:
+    def test_document_shape_and_links(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(build_pod("w", {constants.RESOURCE_TPU: 4}, node="n1"))
+        store.create(build_pod("pend", {constants.RESOURCE_TPU: 2}, ns="ml"))
+        ledger.observe(T0, unserved={"ml/pend": "insufficient capacity: 2"})
+        ledger.observe(T0 + 10, unserved={"ml/pend": "insufficient capacity: 2"})
+        doc = ledger.debug_payload()
+        assert doc["revision"] == store.revision
+        assert doc["window_seconds"] == 10.0
+        cluster = doc["cluster"]
+        assert cluster["total_chips"] == 8
+        assert cluster["used_chips"] == 4
+        assert cluster["pending_chips"] == 2
+        assert cluster["utilization"] == pytest.approx(0.5)
+        assert cluster["chip_seconds"]["idle"][BUCKET_PENDING] == 20.0
+        assert doc["nodes"]["n1"]["utilization"] == pytest.approx(0.5)
+        pend = doc["pending_pods"][0]
+        assert pend["pod"] == "ml/pend"
+        assert pend["reason"] == "insufficient capacity: 2"
+        assert pend["links"]["explain"] == "/debug/explain?pod=ml/pend"
+        assert doc["links"]["vars"] == "/debug/vars"
+
+
+class TestAuditorIntegration:
+    def test_audit_plan_runs_capacity_ledger_check(self):
+        from nos_tpu.record.audit import InvariantAuditor
+
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        ledger._bound["default/ghost"] = ("n1", 4, "default")
+        auditor = InvariantAuditor(sample_rate=1.0)
+        violations = [
+            v
+            for v in auditor.check_capacity_ledger(ledger)
+            if v.check == "capacity_ledger"
+        ]
+        assert violations and "ghost" in violations[0].detail
+        assert auditor.check_capacity_ledger(None) == []
+
+
+class TestChaosOracle:
+    def test_ledger_consistent_oracle(self):
+        import time
+
+        from nos_tpu.chaos import oracles
+
+        class FakePartitioner:
+            capacity_ledger = None
+
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        p = FakePartitioner()
+        assert oracles.ledger_consistent(p, store) == []  # no ledger: skip
+        p.capacity_ledger = ledger
+        assert oracles.ledger_consistent(p, store) == []
+        ledger._pending["ml/ghost"] = (4, "ml")
+        time.sleep(0.001)
+        out = oracles.ledger_consistent(p, store)
+        assert out and out[0].startswith("ledger-consistent:")

@@ -197,3 +197,33 @@ class TestLifecycleEvents:
         ev = scheduled_events()[0]
         assert ev.type == "Normal"
         assert "ml/ok" in ev.message and "tpu-1" in ev.message
+
+        # The Event links back to the decision journey that emitted it:
+        # the trace-id annotation matches the pod's journey root, so an
+        # operator can jump from `kubectl describe` to /debug/traces.
+        from nos_tpu.kube.events import TRACE_ID_ANNOTATION
+
+        trace_id = ev.metadata.annotations.get(TRACE_ID_ANNOTATION, "")
+        assert trace_id.startswith("t"), ev.metadata.annotations
+        # The bind closed the journey, so it isn't open anymore — but the
+        # finished trace must be resolvable in the ring buffer the
+        # /debug/traces endpoint serves.
+        assert wait_for(lambda: TRACER.store.get(trace_id) is not None)
+
+    def test_failed_scheduling_event_carries_trace_annotation(
+        self, cluster, stuck_pod
+    ):
+        from nos_tpu.kube.events import TRACE_ID_ANNOTATION
+
+        def failed():
+            return [
+                e
+                for e in cluster.store.list("Event", namespace="ml")
+                if e.reason == "FailedScheduling" and e.involved_name == "stuck"
+            ]
+
+        # Dedup bumps must RE-stamp the annotation (latest journey wins),
+        # not drop it: wait for a count >= 2 repeat and check it's there.
+        assert wait_for(lambda: any(e.count >= 2 for e in failed()))
+        ev = failed()[0]
+        assert ev.metadata.annotations.get(TRACE_ID_ANNOTATION, "").startswith("t")

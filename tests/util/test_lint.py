@@ -260,11 +260,20 @@ class TestMetricsDocDrift:
         import re
 
         repo = os.path.join(os.path.dirname(__file__), "..", "..")
-        with open(os.path.join(repo, "nos_tpu", "util", "metrics.py")) as fh:
-            source = fh.read()
-        return re.findall(
-            r"REGISTRY\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"", source
-        )
+        # Scan the whole package, not just util/metrics.py: a subsystem
+        # registering its own series (the capacity ledger pattern) must
+        # not dodge the docs check by living in a different file.
+        names = []
+        for path in lint.iter_py([os.path.join(repo, "nos_tpu")]):
+            with open(path) as fh:
+                source = fh.read()
+            names.extend(
+                re.findall(
+                    r"REGISTRY\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"",
+                    source,
+                )
+            )
+        return names
 
     def test_every_metric_has_namespace_prefix(self):
         names = self._registered_names()

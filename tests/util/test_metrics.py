@@ -383,3 +383,70 @@ class TestTextFormatConformance:
             'nos_tpu_schedule_latency_seconds_bucket{le="0.1",namespace="ml"}'
             in body
         )
+
+
+class TestExpositionEdgeCases:
+    """Edge cases the capacity-ledger series stress: profile strings that
+    look like exposition syntax, histogram summaries before any sample,
+    and per-node gauges that must not go stale after a node disappears."""
+
+    def test_profile_label_values_with_x_and_quotes(self):
+        r = MetricsRegistry()
+        c = r.counter("cap_total")
+        # Real profile strings contain 'x' (topology) — and a hostile
+        # label value with quotes/backslashes must stay one sample line.
+        c.labels(profile="2x4", state="busy").inc(2)
+        c.labels(profile='2x2"x"', state="busy").inc()
+        text = r.render()
+        assert 'cap_total{profile="2x4",state="busy"} 2.0' in text
+        assert 'cap_total{profile="2x2\\"x\\"",state="busy"} 1.0' in text
+        for line in text.splitlines():
+            if line.startswith("cap_total{"):
+                assert line.endswith(".0"), f"split sample line: {line!r}"
+
+    def test_histogram_sum_and_p95_on_empty_series(self):
+        r = MetricsRegistry()
+        h = r.histogram("wait_seconds", buckets=(1.0, 10.0))
+        # No samples yet: percentile is None (not 0.0 — zero is a real
+        # wait), _sum/_count render as exact zeros, nothing crashes.
+        assert h.percentile(95) is None
+        text = r.render()
+        assert "wait_seconds_sum 0.0" in text
+        assert "wait_seconds_count 0" in text
+        snap = r.snapshot()
+        assert snap["wait_seconds_count"] == 0
+        assert "wait_seconds_p95" not in snap
+
+    def test_histogram_sum_and_p95_on_single_sample(self):
+        r = MetricsRegistry()
+        h = r.histogram("wait_seconds", buckets=(1.0, 10.0))
+        h.observe(3.5)
+        # One sample: every percentile IS that sample and _sum is exact.
+        assert h.percentile(50) == 3.5
+        assert h.percentile(95) == 3.5
+        text = r.render()
+        assert "wait_seconds_sum 3.5" in text
+        assert "wait_seconds_count 1" in text
+        assert 'wait_seconds_bucket{le="10.0"} 1' in text
+
+    def test_node_gauges_reset_when_node_deleted(self):
+        import time
+
+        from nos_tpu.capacity import CapacityLedger
+        from nos_tpu.kube.store import KubeStore
+        from nos_tpu.util.metrics import CAPACITY_NODE_CHIPS, NODE_FRAGMENTATION
+        from tests.factory import build_tpu_node
+
+        store = KubeStore()
+        ledger = CapacityLedger(store)
+        store.create(build_tpu_node(name="ghost-node", chips=8))
+        ledger.observe(time.time())
+        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="total").value == 8.0
+        store.delete("Node", "ghost-node")
+        ledger.observe(time.time())
+        # The registry has no child-delete: a vanished node's series must
+        # be zeroed or scrapes would report phantom capacity forever.
+        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="total").value == 0.0
+        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="used").value == 0.0
+        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="free").value == 0.0
+        assert NODE_FRAGMENTATION.labels(node="ghost-node").value == 0.0
