@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke incluster-e2e kind-e2e bench bench-planner examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke incluster-e2e kind-e2e bench bench-planner bench-store examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -77,6 +77,12 @@ bench:
 # --output; see BENCH_planner.json for the committed numbers.
 bench-planner:
 	JAX_PLATFORMS=cpu $(PY) bench_planner.py
+
+# Shared-store verb throughput (list, list_by_index indexed vs scan,
+# patch, watch fanout, apply_event) at 1k×10k and 10k×100k scale. See
+# BENCH_store.json for the committed numbers.
+bench-store:
+	JAX_PLATFORMS=cpu $(PY) bench_store.py --output BENCH_store.json
 
 ## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
 ## for real chips) -------------------------------------------------------

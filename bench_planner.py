@@ -320,6 +320,54 @@ def export_sample_trace(path: str) -> None:
     print(f"sample trace -> {path}", flush=True)
 
 
+def export_sample_profile(path: str) -> None:
+    """Collapsed-stack sampling profile of back-to-back 64x200 plan()
+    calls — the flamegraph companion to the Perfetto trace. The bench
+    thread is registered with the profiler only while plan() runs, so
+    snapshot construction between plans never dilutes the attribution;
+    with tracing on, every sample lands in a named span phase
+    (partitioner.plan / plan.trial / ...)."""
+    from nos_tpu.util.profiling import PROFILER
+    from nos_tpu.util.tracing import TRACER
+
+    TRACER.reset()
+    tracing_was = TRACER.enabled
+    TRACER.enabled = True
+    PROFILER.reset()
+    planner = Planner(
+        Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+    )
+    pending = make_pending(200)
+    target_samples = 500
+    PROFILER.start(interval_seconds=0.002)
+    try:
+        for _ in range(80):
+            snapshot = make_cluster(64, ClusterSnapshot)
+            with PROFILER.registered("bench-planner"):
+                planner.plan(snapshot, pending)
+            if PROFILER.total_samples >= target_samples:
+                break
+    finally:
+        PROFILER.stop()
+        TRACER.enabled = tracing_was
+    with open(path, "w") as fh:
+        fh.write(PROFILER.collapsed())
+    report = PROFILER.phase_report()
+    print(
+        json.dumps(
+            {
+                "bench": "bench_planner_profile",
+                "output": path,
+                "total_samples": report["total_samples"],
+                "attributed_fraction": report["attributed_fraction"],
+                "overhead_fraction": round(PROFILER.overhead_fraction(), 6),
+                "phases": report["phases"],
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--engines", default="cow,deepcopy")
@@ -355,6 +403,18 @@ def main() -> None:
         default="",
         help="write a sample plan() trace (Chrome trace-event JSON) here; "
         "defaults to <output-stem>_trace.json when --output is set",
+    )
+    parser.add_argument(
+        "--profile",
+        action="store_true",
+        help="also capture a sampling profile of back-to-back plan() calls "
+        "(collapsed-stack text for flamegraph.pl/speedscope)",
+    )
+    parser.add_argument(
+        "--profile-output",
+        default="",
+        help="collapsed-stack profile path; defaults to "
+        "<output-stem>_profile.txt when --output is set",
     )
     args = parser.parse_args()
 
@@ -443,6 +503,12 @@ def _finish(args, results) -> None:
         trace_path = f"{stem}_trace.json"
     if trace_path:
         export_sample_trace(trace_path)
+    if args.profile or args.profile_output:
+        profile_path = args.profile_output
+        if not profile_path and args.output:
+            stem = args.output[:-5] if args.output.endswith(".json") else args.output
+            profile_path = f"{stem}_profile.txt"
+        export_sample_profile(profile_path or "bench_planner_profile.txt")
 
 
 if __name__ == "__main__":

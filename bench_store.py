@@ -1,0 +1,312 @@
+"""KubeStore microbenchmark: the control plane's shared-state hot paths.
+
+Every controller in the suite reads and writes ONE in-memory store; at
+10k nodes / 100k pods the store's list/index/patch/fan-out costs ARE the
+control plane's saturation profile. This bench measures the verbs the
+loops actually hit, over synthetic clusters shaped like the planner
+benches (bound pods round-robin across nodes, a pending residue):
+
+  list            — full-kind list, copy and copy=False (the planner's view)
+  list_by_index   — the maintained per-(kind, index) map ("indexed" rows)
+                    AND the pre-index full-scan equivalent, replicated as
+                    list(filter_fn=...) ("scan" rows) so BENCH_store.json
+                    carries the before/after pair for the same store
+  patch           — patch_merge status flips on sampled pods (the kubelet
+                    and quota controllers' write shape)
+  watch_fanout    — W writes fanned out to N subscribed watchers, drained
+                    (events delivered / sec end-to-end)
+  apply_event     — the flight-replay verb: recorded MODIFIED events
+                    re-applied verbatim
+
+Output: one JSON line per (bench, nodes, pods, ...) config, e.g.
+
+  make bench-store
+  python bench_store.py --quick
+  python bench_store.py --output BENCH_store.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import statistics
+import time
+
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.kube.objects import (
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.kube.store import KubeStore
+
+V5E = "tpu-v5-lite-podslice"
+
+
+def build_node(name: str) -> Node:
+    alloc = {constants.RESOURCE_TPU: 8, "cpu": 8, "memory": 128}
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={
+                labels.GKE_TPU_ACCELERATOR_LABEL: V5E,
+                labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+                labels.PARTITIONING_LABEL: "tpu",
+            },
+        ),
+        status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
+    )
+
+
+def build_pod(name: str, node: str, phase: str) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="bench"),
+        spec=PodSpec(
+            containers=[Container(requests={constants.RESOURCE_TPU: 1})],
+            scheduler_name=constants.SCHEDULER_NAME,
+            node_name=node,
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+def seed_store(n_nodes: int, n_pods: int) -> KubeStore:
+    """Nodes plus pods bound round-robin; every 10th pod is an unbound
+    Pending straggler (the population the partitioner's phase index
+    serves). Indexers registered before seeding, like the suite does."""
+    store = KubeStore()
+    store.add_indexer("Pod", constants.INDEX_POD_PHASE, lambda p: [p.status.phase])
+    store.add_indexer("Pod", constants.INDEX_POD_NODE, lambda p: [p.spec.node_name])
+    for i in range(n_nodes):
+        store.create(build_node(f"node-{i:05d}"))
+    for i in range(n_pods):
+        if i % 10 == 0:
+            store.create(build_pod(f"pod-{i:06d}", "", "Pending"))
+        else:
+            store.create(
+                build_pod(f"pod-{i:06d}", f"node-{i % n_nodes:05d}", "Running")
+            )
+    return store
+
+
+def _time_repeats(fn, repeats: int):
+    """(total_seconds, per-repeat durations) for `repeats` calls of fn."""
+    durations = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        durations.append(time.perf_counter() - t0)
+    return sum(durations), durations
+
+
+def _row(bench: str, n_nodes: int, n_pods: int, **extra) -> dict:
+    return {"bench": bench, "nodes": n_nodes, "pods": n_pods, **extra}
+
+
+def bench_list(store, n_nodes, n_pods, repeats):
+    rows = []
+    for copy_flag in (True, False):
+        total, durations = _time_repeats(
+            lambda: store.list("Pod", copy=copy_flag), repeats
+        )
+        rows.append(
+            _row(
+                "store_list",
+                n_nodes,
+                n_pods,
+                copy=copy_flag,
+                p50_ms=round(statistics.median(durations) * 1e3, 3),
+                lists_per_sec=round(repeats / total, 1),
+            )
+        )
+    return rows
+
+
+def bench_list_by_index(store, n_nodes, n_pods, repeats):
+    """The satellite's before/after pair: 'indexed' is the maintained
+    index map, 'scan' replicates the pre-index behavior (a full-kind
+    scan with a per-object filter) against the very same store."""
+    node_fn = lambda p: [p.spec.node_name]  # noqa: E731 — mirrors the indexer
+    targets = [f"node-{i:05d}" for i in range(0, n_nodes, max(1, n_nodes // 50))]
+
+    def indexed():
+        for node in targets:
+            store.list_by_index("Pod", constants.INDEX_POD_NODE, node, copy=False)
+
+    def scan():
+        for node in targets:
+            store.list("Pod", filter_fn=lambda o: node in node_fn(o), copy=False)
+
+    rows = []
+    for variant, fn in (("indexed", indexed), ("scan", scan)):
+        # The scan variant is O(pods) per lookup — one repeat suffices to
+        # document the collapse at 100k pods.
+        reps = repeats if variant == "indexed" else 1
+        total, durations = _time_repeats(fn, reps)
+        lookups = reps * len(targets)
+        rows.append(
+            _row(
+                "store_list_by_index",
+                n_nodes,
+                n_pods,
+                variant=variant,
+                lookups=lookups,
+                p50_lookup_ms=round(
+                    statistics.median(durations) / len(targets) * 1e3, 4
+                ),
+                lookups_per_sec=round(lookups / total, 1),
+            )
+        )
+    return rows
+
+
+def bench_patch(store, n_nodes, n_pods, repeats):
+    sampled = [f"pod-{i:06d}" for i in range(1, min(n_pods, 2000), 7)]
+
+    def flip(p):
+        p.status.phase = "Running" if p.status.phase == "Pending" else "Pending"
+
+    def patch_all():
+        for name in sampled:
+            store.patch_merge("Pod", name, "bench", flip)
+
+    total, _ = _time_repeats(patch_all, repeats)
+    patches = repeats * len(sampled)
+    return [
+        _row(
+            "store_patch",
+            n_nodes,
+            n_pods,
+            patches=patches,
+            patches_per_sec=round(patches / total, 1),
+        )
+    ]
+
+
+def bench_watch_fanout(store, n_nodes, n_pods, n_watchers, writes):
+    queues = [
+        store.watch({"Pod"}, name=f"bench-watcher-{i}") for i in range(n_watchers)
+    ]
+    # Drain the ADDED replay so only the bench's own writes are measured.
+    for q in queues:
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+    def bump(p):
+        p.status.phase = p.status.phase  # rv bump; field content irrelevant
+
+    t0 = time.perf_counter()
+    for i in range(writes):
+        store.patch_merge("Pod", f"pod-{i % n_pods:06d}", "bench", bump)
+    delivered = 0
+    for q in queues:
+        while True:
+            try:
+                q.get_nowait()
+                delivered += 1
+            except queue.Empty:
+                break
+    total = time.perf_counter() - t0
+    for q in queues:
+        store.stop_watch(q)
+    return [
+        _row(
+            "store_watch_fanout",
+            n_nodes,
+            n_pods,
+            watchers=n_watchers,
+            writes=writes,
+            events_delivered=delivered,
+            events_per_sec=round(delivered / total, 1),
+        )
+    ]
+
+
+def bench_apply_event(store, n_nodes, n_pods, events):
+    # Replay-shaped traffic: re-apply MODIFIED snapshots of live pods
+    # verbatim (deepcopy inside apply_event is part of the measured cost,
+    # exactly as replay pays it).
+    pods = store.list("Pod", copy=False)[: min(events, n_pods)]
+    t0 = time.perf_counter()
+    applied = 0
+    while applied < events:
+        for pod in pods:
+            store.apply_event("MODIFIED", pod)
+            applied += 1
+            if applied >= events:
+                break
+    total = time.perf_counter() - t0
+    return [
+        _row(
+            "store_apply_event",
+            n_nodes,
+            n_pods,
+            events=events,
+            events_per_sec=round(applied / total, 1),
+        )
+    ]
+
+
+def run_config(n_nodes: int, n_pods: int, n_watchers: int, quick: bool):
+    t0 = time.perf_counter()
+    store = seed_store(n_nodes, n_pods)
+    seed_s = time.perf_counter() - t0
+    rows = [
+        _row(
+            "store_seed",
+            n_nodes,
+            n_pods,
+            seed_seconds=round(seed_s, 2),
+            creates_per_sec=round((n_nodes + n_pods) / seed_s, 1),
+        )
+    ]
+    repeats = 2 if quick else 5
+    rows += bench_list(store, n_nodes, n_pods, repeats)
+    rows += bench_list_by_index(store, n_nodes, n_pods, repeats)
+    rows += bench_patch(store, n_nodes, n_pods, repeats)
+    rows += bench_watch_fanout(
+        store, n_nodes, n_pods, n_watchers, writes=200 if quick else 1000
+    )
+    rows += bench_apply_event(store, n_nodes, n_pods, events=500 if quick else 5000)
+    return rows
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--configs",
+        default="1000x10000,10000x100000",
+        help="comma-separated nodesxpods pairs",
+    )
+    parser.add_argument("--watchers", type=int, default=8)
+    parser.add_argument(
+        "--quick", action="store_true", help="100x1000 only, fewer repeats"
+    )
+    parser.add_argument("--output", default="", help="also append JSON lines to file")
+    args = parser.parse_args()
+
+    configs = [tuple(map(int, c.split("x"))) for c in args.configs.split(",")]
+    if args.quick:
+        configs = [(100, 1000)]
+
+    results = []
+    for n_nodes, n_pods in configs:
+        for row in run_config(n_nodes, n_pods, args.watchers, args.quick):
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+    if args.output:
+        with open(args.output, "a") as fh:
+            for row in results:
+                fh.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -222,7 +222,11 @@ class CapacityLedger:
         self.flight = flight_recorder
         self._metrics = metrics
         self._lock = threading.Lock()
-        self._queue = store.watch(set(WATCH_KINDS)) if store is not None else None
+        self._queue = (
+            store.watch(set(WATCH_KINDS), name="capacity-ledger")
+            if store is not None
+            else None
+        )
         self._buffer: List[Any] = []
         # Instantaneous state at the current revision watermark.
         self._nodes: Dict[str, _NodeState] = {}

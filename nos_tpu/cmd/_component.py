@@ -18,6 +18,8 @@ from typing import Callable, Optional
 from nos_tpu.kube.controller import Manager
 from nos_tpu.kube.store import KubeStore
 from nos_tpu.util.health import HealthServer
+from nos_tpu.util.loop_health import LOOPS
+from nos_tpu.util.profiling import PROFILER
 
 
 def build_store(config: dict) -> KubeStore:
@@ -112,9 +114,14 @@ def run_component(
         metrics_token=metrics_token,
         metrics_loopback_port=int(metrics_port) if metrics_port else None,
         explain_fn=getattr(component, "explain", None),
+        profiler=PROFILER,
+        loops_fn=lambda: LOOPS.payload(store=store),
     )
     bound = health.start()
     logging.info("%s: health/metrics on 127.0.0.1:%d", name, bound)
+    # Always-on control-plane sampling (registered threads only; runtime
+    # on/off via /debug/profile?action=).
+    PROFILER.start()
 
     stop = stop_event or threading.Event()
     if stop_event is None:
@@ -155,6 +162,7 @@ def run_component(
         if elector is not None:
             elector.stop()
         manager.stop()
+        PROFILER.stop()
         health.stop()
         if hasattr(store, "stop"):  # KubeApiStore: stop informer threads
             store.stop()

@@ -30,6 +30,8 @@ from nos_tpu.kube.objects import (
     PodSpec,
 )
 from nos_tpu.util.health import HealthServer
+from nos_tpu.util.loop_health import LOOPS
+from nos_tpu.util.profiling import PROFILER
 
 
 def load_config(path: str) -> dict:
@@ -178,15 +180,21 @@ def main(argv=None) -> int:
         capacity_fn=cluster.capacity_ledger.debug_payload
         if cluster.capacity_ledger is not None
         else None,
+        profiler=PROFILER,
+        loops_fn=lambda: LOOPS.payload(store=cluster.store),
     )
     bound = health.start()
     logging.info(
         "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain"
-        " /debug/capacity%s)",
+        " /debug/capacity /debug/profile /debug/loops%s)",
         bound,
         " /debug/record" if flight_recorder is not None else "",
     )
 
+    # Always-on control-plane sampling: the profiler only sees threads
+    # that registered themselves (controller pumps/workers, batch loops),
+    # and its measured duty cycle at the default rate is within budget.
+    PROFILER.start()
     cluster.start()
     stop = threading.Event()
 
@@ -217,6 +225,7 @@ def main(argv=None) -> int:
             stop.wait()
     finally:
         cluster.stop()
+        PROFILER.stop()
         health.stop()
         if flight_recorder is not None:
             flight_recorder.detach()

@@ -30,6 +30,8 @@ from nos_tpu.partitioning.core import (
 from nos_tpu.util import metrics
 from nos_tpu.util import pod as podutil
 from nos_tpu.util.batcher import Batcher
+from nos_tpu.util.loop_health import LOOPS, BusyMeter
+from nos_tpu.util.profiling import PROFILER
 from nos_tpu.util.tracing import TRACER
 
 log = logging.getLogger("nos_tpu.partitioner")
@@ -104,6 +106,13 @@ class PartitionerController:
         if incremental_dirty_threshold is not None:
             self.planner.incremental_dirty_threshold = incremental_dirty_threshold
         self._maintainer = None
+        # Saturation telemetry: phase histogram children cached here
+        # (labels() takes a registry lock — not for the hot loop) and a
+        # busy meter for the batch loop itself.
+        self._phase_refresh = metrics.PARTITIONER_PHASE.labels(kind=kind, phase="refresh")
+        self._phase_plan = metrics.PARTITIONER_PHASE.labels(kind=kind, phase="plan")
+        self._phase_actuate = metrics.PARTITIONER_PHASE.labels(kind=kind, phase="actuate")
+        self._busy = BusyMeter(f"partitioner-{kind}")
 
     # ----------------------------------------------------- pod reconcile
 
@@ -245,6 +254,7 @@ class PartitionerController:
 
     def start(self) -> None:
         self.batcher.start()
+        LOOPS.register(f"partitioner-{self.kind}", self._loop_stats)
         self._thread = threading.Thread(
             target=self._batch_loop, name=f"partitioner-{self.kind}", daemon=True
         )
@@ -253,13 +263,30 @@ class PartitionerController:
     def stop(self) -> None:
         self._stop.set()
         self.batcher.stop()
+        LOOPS.unregister(f"partitioner-{self.kind}")
         if self._thread:
             self._thread.join(timeout=2.0)
 
+    def _loop_stats(self) -> dict:
+        stats = self._busy.snapshot()
+        stats["plans_applied"] = self.plans_applied
+        stats["nodes_repartitioned"] = self.nodes_repartitioned
+        return stats
+
     def _batch_loop(self) -> None:
+        PROFILER.register_thread()
+        try:
+            self._batch_loop_inner()
+        finally:
+            PROFILER.unregister_thread()
+
+    def _batch_loop_inner(self) -> None:
         while not self._stop.is_set():
+            t0 = time.monotonic()
             batch = self.batcher.ready(timeout=0.2)
+            t1 = time.monotonic()
             if batch is None:
+                self._busy.record(0.0, idle_s=t1 - t0)
                 continue
             try:
                 self.process_pending_pods()
@@ -277,6 +304,8 @@ class PartitionerController:
                             self.batcher.add(pod.namespaced_name)
             except Exception:  # pragma: no cover - defensive
                 log.exception("partitioner batch processing failed")
+            finally:
+                self._busy.record(time.monotonic() - t1, idle_s=t1 - t0)
 
     # ------------------------------------------------------- processing
 
@@ -336,16 +365,22 @@ class PartitionerController:
                     if self.incremental_planning:
                         snapshot, dirty = self._maintain_snapshot()
                     else:
+                        t_snap = time.monotonic()
                         snapshot = self.snapshot_taker.take_snapshot(
                             self.cluster_state, store=self.store
                         )
+                        self._phase_refresh.observe(time.monotonic() - t_snap)
                         dirty = None
                 current = snapshot.partitioning_state()
+                t_plan = time.monotonic()
                 desired = self.planner.plan(snapshot, pending, dirty=dirty)
+                self._phase_plan.observe(time.monotonic() - t_plan)
                 plan = PartitioningPlan(desired_state=desired, id=self.plan_id_fn())
                 proc.set_attributes(plan_id=plan.id)
                 with TRACER.span("partitioner.actuate", plan_id=plan.id):
+                    t_act = time.monotonic()
                     applied = self.actuator.apply(current, plan)
+                    self._phase_actuate.observe(time.monotonic() - t_act)
                 proc.set_attributes(nodes_repartitioned=applied)
                 self._record_plan(revision, pending, plan, applied, journey)
                 if self.capacity_ledger is not None:

@@ -45,9 +45,11 @@ from __future__ import annotations
 
 import logging
 import queue
+import time
 from typing import Optional, Set, Tuple
 
 from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.util import metrics
 
 log = logging.getLogger("nos_tpu.partitioner")
 
@@ -82,6 +84,9 @@ class IncrementalSnapshotMaintainer:
         # Test/observability taps.
         self.full_rebuilds = 0
         self.nodes_refreshed = 0
+        # Phase histogram children, cached (labels() locks the registry).
+        self._phase_drain = metrics.PARTITIONER_PHASE.labels(kind=kind, phase="drain")
+        self._phase_refresh_h = metrics.PARTITIONER_PHASE.labels(kind=kind, phase="refresh")
 
     # ------------------------------------------------------------- entry
 
@@ -92,24 +97,52 @@ class IncrementalSnapshotMaintainer:
         watermark — the maintainer reads the live store, so draining first
         would widen the recorded race window replay has to reproduce."""
         if self._queue is None:
-            self._queue = self.store.watch(set(WATCH_KINDS))
+            self._queue = self.store.watch(
+                set(WATCH_KINDS), name=f"partitioner-maintainer-{self.kind}"
+            )
             # Discard the list+watch ADDED replay of existing objects —
             # the first build below reads the live store directly.
-            self._drain()
-            return self._rebuild(cluster_state)
-        events = self._drain()
+            self._timed_drain()
+            return self._timed_rebuild(cluster_state)
+        events = self._timed_drain()
         if events is None:
             log.info(
                 "partitioner[%s]: delta drain overflow; rebuilding snapshot",
                 self.kind,
             )
-            return self._rebuild(cluster_state)
+            return self._timed_rebuild(cluster_state)
         dirty, rebuild = self._classify(events)
         if not rebuild:
-            refreshed = self._refresh(dirty)
+            refreshed = self._timed_refresh(dirty)
             if refreshed is not None:
                 return self._base, refreshed
-        return self._rebuild(cluster_state)
+        return self._timed_rebuild(cluster_state)
+
+    # ------------------------------------------------------ phase timing
+    # Thin wrappers so every cycle's drain/refresh(+rebuild) lands in the
+    # nos_tpu_partitioner_phase_seconds histogram (a rebuild is the
+    # refresh phase taken the expensive way, so it shares that label).
+
+    def _timed_drain(self) -> "Optional[list]":
+        t0 = time.monotonic()
+        try:
+            return self._drain()
+        finally:
+            self._phase_drain.observe(time.monotonic() - t0)
+
+    def _timed_refresh(self, dirty: Set[str]) -> Optional[Set[str]]:
+        t0 = time.monotonic()
+        try:
+            return self._refresh(dirty)
+        finally:
+            self._phase_refresh_h.observe(time.monotonic() - t0)
+
+    def _timed_rebuild(self, cluster_state) -> Tuple[ClusterSnapshot, Set[str]]:
+        t0 = time.monotonic()
+        try:
+            return self._rebuild(cluster_state)
+        finally:
+            self._phase_refresh_h.observe(time.monotonic() - t0)
 
     # ----------------------------------------------------------- internals
 

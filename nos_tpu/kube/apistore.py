@@ -269,7 +269,7 @@ class KubeApiStore(KubeStore):
             }
             stale = [k for k in self._objects if k[0] == kind and k not in fresh]
             for k in stale:
-                gone = self._objects.pop(k)
+                gone = self._discard_object(k)
                 # The object vanished while we were disconnected; the exact
                 # deletion rv is lost. The list's collection rv is the
                 # tightest bound we have ("deleted by now") — stamping it
@@ -283,13 +283,13 @@ class KubeApiStore(KubeStore):
             for k, obj in fresh.items():
                 old = self._objects.get(k)
                 if old is None:
-                    self._objects[k] = obj
+                    self._store_object(k, obj)
                     self._applied += 1
                     events.append(
                         WatchEvent(ADDED, copy.deepcopy(obj), revision=self._applied)
                     )
                 elif old.metadata.resource_version < obj.metadata.resource_version:
-                    self._objects[k] = obj
+                    self._store_object(k, obj)
                     self._applied += 1
                     events.append(
                         WatchEvent(MODIFIED, copy.deepcopy(obj), revision=self._applied)
@@ -304,7 +304,7 @@ class KubeApiStore(KubeStore):
             old = self._objects.get(k)
             if old is not None and old.metadata.resource_version >= obj.metadata.resource_version:
                 return  # stale or already applied via write path
-            self._objects[k] = copy.deepcopy(obj)
+            self._store_object(k, copy.deepcopy(obj))
             # Track the apiserver's revision high-water mark: store.revision
             # is the watermark every recorded decision keys on, and it must
             # advance in apiserver mode too or replay ordering collapses to
@@ -320,7 +320,7 @@ class KubeApiStore(KubeStore):
         with self._lock:
             if k not in self._objects:
                 return
-            stored = self._objects.pop(k)
+            stored = self._discard_object(k)
             # Notify at the DELETION rv (the watch event's), not the cached
             # object's last rv: recorded deltas must order the delete after
             # every decision that saw the object alive.
@@ -391,7 +391,7 @@ class KubeApiStore(KubeStore):
         except (AttributeError, TypeError, ValueError):
             pass
         with self._lock:
-            stored = self._objects.pop(_key(kind, namespace, name), None)
+            stored = self._discard_object(_key(kind, namespace, name))
             if stored is not None and deleted_rv:
                 stored.metadata.resource_version = deleted_rv
             if deleted_rv:

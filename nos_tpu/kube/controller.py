@@ -21,6 +21,9 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from nos_tpu.kube.store import KubeStore, WatchEvent
+from nos_tpu.util import metrics
+from nos_tpu.util.loop_health import LOOPS, BusyMeter
+from nos_tpu.util.profiling import PROFILER
 
 log = logging.getLogger("nos_tpu.kube")
 
@@ -158,6 +161,8 @@ class Controller:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._event_queue: Optional["queue.Queue[WatchEvent]"] = None
+        self._busy = BusyMeter(name)
+        self._drain_lag = metrics.WATCH_DRAIN_LAG.labels(consumer=name)
 
     # -- event pump -----------------------------------------------------
 
@@ -172,40 +177,55 @@ class Controller:
 
     def _pump(self) -> None:
         assert self._event_queue is not None
-        while not self._stop.is_set():
-            try:
-                event = self._event_queue.get(timeout=0.2)
-            except queue.Empty:
-                continue
-            try:
-                self._dispatch(event)
-            except Exception:  # pragma: no cover - defensive
-                log.exception("[%s] dispatch failed", self.name)
+        PROFILER.register_thread()
+        try:
+            while not self._stop.is_set():
+                try:
+                    event = self._event_queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if event.enqueued:
+                    self._drain_lag.observe(time.monotonic() - event.enqueued)
+                try:
+                    self._dispatch(event)
+                except Exception:  # pragma: no cover - defensive
+                    log.exception("[%s] dispatch failed", self.name)
+        finally:
+            PROFILER.unregister_thread()
 
     # -- worker ---------------------------------------------------------
 
     def _work(self) -> None:
-        while not self._stop.is_set():
-            req = self.queue.get(timeout=0.2)
-            if req is None:
-                continue
-            try:
-                result = self.reconciler(req)
-            except Exception:
-                log.exception("[%s] reconcile %s failed; requeuing", self.name, req.namespaced_name)
-                result = Result(requeue=True, requeue_after=0.05)
-            finally:
-                self.queue.done(req)
-            if result and result.requeue_after > 0:
-                self.queue.add_after(req, result.requeue_after)
-            elif result and result.requeue:
-                self.queue.add(req)
+        PROFILER.register_thread()
+        try:
+            while not self._stop.is_set():
+                t0 = time.monotonic()
+                req = self.queue.get(timeout=0.2)
+                t1 = time.monotonic()
+                if req is None:
+                    self._busy.record(0.0, idle_s=t1 - t0)
+                    continue
+                try:
+                    result = self.reconciler(req)
+                except Exception:
+                    log.exception("[%s] reconcile %s failed; requeuing", self.name, req.namespaced_name)
+                    result = Result(requeue=True, requeue_after=0.05)
+                finally:
+                    self.queue.done(req)
+                    self._busy.record(time.monotonic() - t1, idle_s=t1 - t0)
+                if result and result.requeue_after > 0:
+                    self.queue.add_after(req, result.requeue_after)
+                elif result and result.requeue:
+                    self.queue.add(req)
+        finally:
+            PROFILER.unregister_thread()
 
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
         kinds = {w.kind for w in self.watches}
-        self._event_queue = self.store.watch(kinds)
+        self._event_queue = self.store.watch(kinds, name=self.name)
+        LOOPS.register(self.name, self._loop_stats)
         for target, label in ((self._pump, "pump"), (self._work, "work")):
             t = threading.Thread(target=target, name=f"{self.name}-{label}", daemon=True)
             t.start()
@@ -214,10 +234,18 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         self.queue.shut_down()
+        LOOPS.unregister(self.name)
         if self._event_queue is not None:
             self.store.stop_watch(self._event_queue)
         for t in self._threads:
             t.join(timeout=2.0)
+
+    def _loop_stats(self) -> dict:
+        eq = self._event_queue
+        stats = self._busy.snapshot()
+        stats["event_queue_depth"] = eq.qsize() if eq is not None else 0
+        stats["workqueue_idle"] = self.queue.idle()
+        return stats
 
     def idle(self) -> bool:
         eq = self._event_queue

@@ -14,11 +14,17 @@ never mutates the store, exactly like talking to a real API server.
 from __future__ import annotations
 
 import copy
+import logging
 import queue
 import threading
+import time
 from copy import deepcopy as _deepcopy
-from dataclasses import dataclass
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from nos_tpu.util import metrics
+
+log = logging.getLogger("nos_tpu.kube.store")
 
 
 class NotFoundError(KeyError):
@@ -54,6 +60,12 @@ class WatchEvent:
     # delta ordering key lets replay reconstruct exactly what the cache
     # contained at any decision watermark, lag included.
     revision: int = 0
+    # Monotonic enqueue stamp set by the store at fan-out time (0.0 =
+    # unset, e.g. hand-built events in tests). Consumers observe
+    # ``time.monotonic() - enqueued`` at dequeue as their watch drain lag
+    # (nos_tpu_watch_drain_lag_seconds) — the direct "how far behind is
+    # this loop" meter.
+    enqueued: float = 0.0
 
     @property
     def kind(self) -> str:
@@ -64,16 +76,63 @@ def _key(kind: str, namespace: str, name: str) -> Tuple[str, str, str]:
     return (kind, namespace or "", name)
 
 
-class KubeStore:
-    """Thread-safe object store with watch + indexer semantics."""
+class _InstrumentedLock:
+    """RLock that meters contended acquisitions.
+
+    The uncontended fast path costs one extra non-blocking try; a caller
+    that actually blocks lands its wait in
+    ``nos_tpu_store_lock_wait_seconds_total`` — so the counters sample
+    exactly the interesting population (waits) at zero hot-path cost.
+    """
+
+    __slots__ = ("_lock",)
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
+
+    def __enter__(self) -> "_InstrumentedLock":
+        if not self._lock.acquire(blocking=False):
+            start = time.perf_counter()
+            self._lock.acquire()
+            metrics.STORE_LOCK_CONTENTION.inc()
+            metrics.STORE_LOCK_WAIT.inc(time.perf_counter() - start)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lock.release()
+
+
+@dataclass
+class _Watcher:
+    """One watch subscription plus its telemetry state."""
+
+    kind_set: Optional[set]
+    queue: "queue.Queue[WatchEvent]"
+    label: str
+    depth_gauge: Any
+    last_warn: float = field(default=0.0)
+
+
+class KubeStore:
+    """Thread-safe object store with watch + indexer semantics."""
+
+    # Slow-watcher visibility: a subscriber whose (unbounded) queue grows
+    # past WARN_DEPTH gets a rate-limited warning — a stalled controller
+    # becomes diagnosable before its queue eats the heap.
+    WATCH_QUEUE_WARN_DEPTH = 1000
+    WATCH_QUEUE_WARN_INTERVAL = 30.0
+
+    def __init__(self) -> None:
+        self._lock = _InstrumentedLock()
         self._objects: Dict[Tuple[str, str, str], Any] = {}
         self._rv = 0
-        self._watchers: List[Tuple[Optional[set], "queue.Queue[WatchEvent]"]] = []
+        self._watchers: List[_Watcher] = []
         # (kind, index_name) -> fn(obj) -> list of index values
         self._indexers: Dict[Tuple[str, str], Callable[[Any], List[str]]] = {}
+        # (kind, index_name) -> index value -> set of object keys. Kept in
+        # lockstep with _objects by _store_object/_discard_object, so
+        # list_by_index is a map lookup instead of a full all-kinds scan.
+        self._index_maps: Dict[Tuple[str, str], Dict[str, Set[Tuple[str, str, str]]]] = {}
         # kind -> [validator(obj, store)] run before create/update commits —
         # the validating-webhook admission seam (reference
         # pkg/api/nos.nebuly.com/v1alpha1/elasticquota_webhook.go:31-97).
@@ -97,6 +156,41 @@ class KubeStore:
         if injector is not None:
             injector.on_store_write(kind, name)
 
+    # --------------------------------------------------- object mutation
+    # Every path that touches _objects goes through these two, which keep
+    # the per-(kind, index) maps in lockstep (the apistore's reflector
+    # apply paths included). Callers hold the lock.
+
+    def _store_object(self, k: Tuple[str, str, str], obj: Any) -> None:
+        old = self._objects.get(k)
+        self._objects[k] = obj
+        self._index_update(k, old, obj)
+
+    def _discard_object(self, k: Tuple[str, str, str]) -> Optional[Any]:
+        old = self._objects.pop(k, None)
+        if old is not None:
+            self._index_update(k, old, None)
+        return old
+
+    def _index_update(self, k: Tuple[str, str, str], old: Any, new: Any) -> None:
+        kind = k[0]
+        for (i_kind, i_name), fn in self._indexers.items():
+            if i_kind != kind:
+                continue
+            old_values = list(fn(old)) if old is not None else []
+            new_values = list(fn(new)) if new is not None else []
+            if old_values == new_values:
+                continue
+            index = self._index_maps[(i_kind, i_name)]
+            for value in old_values:
+                keys = index.get(value)
+                if keys is not None:
+                    keys.discard(k)
+                    if not keys:
+                        del index[value]
+            for value in new_values:
+                index.setdefault(value, set()).add(k)
+
     # ------------------------------------------------------------------ CRUD
 
     def create(self, obj: Any) -> Any:
@@ -109,7 +203,7 @@ class KubeStore:
             self._rv += 1
             stored = copy.deepcopy(obj)
             stored.metadata.resource_version = self._rv
-            self._objects[k] = stored
+            self._store_object(k, stored)
             out = copy.deepcopy(stored)
         self._notify(WatchEvent(ADDED, copy.deepcopy(stored)))
         return out
@@ -139,7 +233,7 @@ class KubeStore:
             self._rv += 1
             stored = copy.deepcopy(obj)
             stored.metadata.resource_version = self._rv
-            self._objects[k] = stored
+            self._store_object(k, stored)
             out = copy.deepcopy(stored)
         self._notify(WatchEvent(MODIFIED, copy.deepcopy(stored)))
         return out
@@ -150,7 +244,7 @@ class KubeStore:
             k = _key(kind, namespace, name)
             if k not in self._objects:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            stored = self._objects.pop(k)
+            stored = self._discard_object(k)
             # Deletes advance the revision too (a real apiserver's
             # deletionTimestamp write does): the flight recorder keys every
             # delta by revision, and an rv-less delete would be unorderable
@@ -176,9 +270,9 @@ class KubeStore:
         with self._lock:
             k = _key(obj.kind, obj.metadata.namespace, obj.metadata.name)
             if etype == DELETED:
-                self._objects.pop(k, None)
+                self._discard_object(k)
             else:
-                self._objects[k] = copy.deepcopy(obj)
+                self._store_object(k, copy.deepcopy(obj))
             self._rv = max(self._rv, obj.metadata.resource_version)
         self._notify(WatchEvent(etype, copy.deepcopy(obj)))
 
@@ -227,7 +321,7 @@ class KubeStore:
             self._admit(obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
-            self._objects[k] = obj
+            self._store_object(k, obj)
             stored = copy.deepcopy(obj)
         self._notify(WatchEvent(MODIFIED, stored))
         return copy.deepcopy(stored)
@@ -255,7 +349,18 @@ class KubeStore:
     # ------------------------------------------------------------- indexers
 
     def add_indexer(self, kind: str, index_name: str, fn: Callable[[Any], List[str]]) -> None:
-        self._indexers[(kind, index_name)] = fn
+        """Register an index and backfill it from the objects already
+        stored (indexers are usually registered before seeding, but a
+        late registration must not serve a partial index)."""
+        with self._lock:
+            self._indexers[(kind, index_name)] = fn
+            index: Dict[str, Set[Tuple[str, str, str]]] = {}
+            self._index_maps[(kind, index_name)] = index
+            for k, obj in self._objects.items():
+                if k[0] != kind:
+                    continue
+                for value in fn(obj):
+                    index.setdefault(value, set()).add(k)
 
     def list_by_index(
         self, kind: str, index_name: str, value: str, copy: bool = True
@@ -263,33 +368,87 @@ class KubeStore:
         """``copy=False`` has the same read-only contract as ``list``; it
         additionally keeps object identity stable across calls for
         unchanged objects, which the planner's id-keyed pod memos rely on
-        between incremental plan cycles."""
-        fn = self._indexers.get((kind, index_name))
-        if fn is None:
-            raise KeyError(f"no indexer {index_name!r} for kind {kind!r}")
-        return self.list(kind, filter_fn=lambda o: value in fn(o), copy=copy)
+        between incremental plan cycles.
+
+        Served from the maintained per-(kind, index) map — a lookup plus a
+        sort of the hits, not a scan of every object of every kind (the
+        before/after rows in BENCH_store.json quantify the difference)."""
+        with self._lock:
+            if (kind, index_name) not in self._indexers:
+                raise KeyError(f"no indexer {index_name!r} for kind {kind!r}")
+            keys = self._index_maps[(kind, index_name)].get(value, ())
+            out = [
+                _deepcopy(self._objects[k]) if copy else self._objects[k]
+                for k in keys
+            ]
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
 
     # ---------------------------------------------------------------- watch
 
-    def watch(self, kinds: Optional[Iterable[str]] = None) -> "queue.Queue[WatchEvent]":
+    def watch(
+        self, kinds: Optional[Iterable[str]] = None, name: str = ""
+    ) -> "queue.Queue[WatchEvent]":
         """Subscribe to events for the given kinds (None = all). Existing
-        objects are replayed as ADDED events first (informer list+watch)."""
+        objects are replayed as ADDED events first (informer list+watch).
+        ``name`` labels the subscriber's queue-depth gauge and slow-watcher
+        warnings; anonymous subscribers are labeled by their kind set."""
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         kind_set = set(kinds) if kinds is not None else None
+        label = name or ("*" if kind_set is None else "|".join(sorted(kind_set)))
+        watcher = _Watcher(
+            kind_set=kind_set,
+            queue=q,
+            label=label,
+            depth_gauge=metrics.WATCH_QUEUE_DEPTH.labels(kind_set=label),
+        )
         with self._lock:
+            now = time.monotonic()
             for (k_kind, _, _), obj in sorted(self._objects.items()):
                 if kind_set is None or k_kind in kind_set:
-                    q.put(WatchEvent(ADDED, copy.deepcopy(obj)))
-            self._watchers.append((kind_set, q))
+                    q.put(WatchEvent(ADDED, copy.deepcopy(obj), enqueued=now))
+            self._watchers.append(watcher)
+            watcher.depth_gauge.set(q.qsize())
         return q
 
     def stop_watch(self, q: "queue.Queue[WatchEvent]") -> None:
         with self._lock:
-            self._watchers = [(k, w) for (k, w) in self._watchers if w is not q]
+            for w in self._watchers:
+                if w.queue is q:
+                    w.depth_gauge.set(0)
+            self._watchers = [w for w in self._watchers if w.queue is not q]
 
-    def _notify(self, event: WatchEvent) -> None:
+    def watch_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-subscriber label -> {kinds, depth} — the /debug/loops
+        watcher rollup."""
         with self._lock:
             watchers = list(self._watchers)
-        for kind_set, q in watchers:
-            if kind_set is None or event.kind in kind_set:
-                q.put(event)
+        return {
+            w.label: {
+                "kinds": sorted(w.kind_set) if w.kind_set is not None else ["*"],
+                "depth": w.queue.qsize(),
+            }
+            for w in watchers
+        }
+
+    def _notify(self, event: WatchEvent) -> None:
+        event.enqueued = time.monotonic()
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            if w.kind_set is not None and event.kind not in w.kind_set:
+                continue
+            w.queue.put(event)
+            depth = w.queue.qsize()
+            w.depth_gauge.set(depth)
+            if depth >= self.WATCH_QUEUE_WARN_DEPTH:
+                now = time.monotonic()
+                if now - w.last_warn >= self.WATCH_QUEUE_WARN_INTERVAL:
+                    w.last_warn = now
+                    log.warning(
+                        "watch subscriber %r is %d events behind (slow "
+                        "consumer); its queue is unbounded and memory grows "
+                        "until it drains",
+                        w.label,
+                        depth,
+                    )

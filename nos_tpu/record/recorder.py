@@ -109,7 +109,7 @@ class FlightRecorder:
         if self._store is not None:
             raise RuntimeError("recorder already attached")
         self._store = store
-        self._queue = store.watch(kinds)
+        self._queue = store.watch(kinds, name="flight-recorder")
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._drain_loop, name="flight-recorder", daemon=True

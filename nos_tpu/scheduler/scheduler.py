@@ -142,6 +142,10 @@ class Scheduler:
         # members waiting in Permit). Without it, concurrent cycles would
         # stack every waiting member onto the same node.
         self._assumed: Dict[str, tuple] = {}  # pod key -> (pod, node_name)
+        # Cycle-phase histogram children, cached (labels() locks the
+        # registry; the cycle runs per pending pod event).
+        self._phase_decide = metrics.SCHEDULER_PHASE.labels(phase="decide")
+        self._phase_settle = metrics.SCHEDULER_PHASE.labels(phase="settle")
 
     # --------------------------------------------------------- reconcile
 
@@ -218,7 +222,9 @@ class Scheduler:
         # to this revision, then re-decides — the cycle's writes are the
         # decision's consequences, not its inputs.
         revision = self.store.revision
+        t_decide = time.monotonic()
         outcome = self._decide(pod)
+        self._phase_decide.observe(time.monotonic() - t_decide)
         # Record only after the outcome's store writes land. A bind whose
         # write fails (apiserver conflict or outage) must not be recorded
         # as if it happened: replay's settle would bind the pod in the
@@ -227,11 +233,14 @@ class Scheduler:
         # (settled=False) because _decide's in-memory effects — assume
         # cache, gang formation — did happen and replay must re-run decide
         # to accumulate them; it just skips settle.
+        t_settle = time.monotonic()
         try:
             result = self._apply_outcome(pod, outcome)
         except Exception:
             self._record_cycle(pod, revision, outcome, settled=False)
             raise
+        finally:
+            self._phase_settle.observe(time.monotonic() - t_settle)
         self._record_cycle(pod, revision, outcome)
         return result
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from nos_tpu.util.metrics import REGISTRY
@@ -28,6 +28,8 @@ class HealthServer:
         explain_fn: Optional[Callable[[str], Optional[dict]]] = None,
         record_fn: Optional[Callable[[], list]] = None,
         capacity_fn: Optional[Callable[[], dict]] = None,
+        profiler: Optional[Any] = None,
+        loops_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -43,6 +45,13 @@ class HealthServer:
         # and cluster chip-seconds, idle attribution, fragmentation, gang
         # waits); None disables the endpoint (no ledger wired).
         self.capacity_fn = capacity_fn
+        # /debug/profile -> the StackProfiler's collapsed stacks / top-N
+        # self-time document, plus ?action=start|stop runtime control;
+        # None disables the endpoint.
+        self.profiler = profiler
+        # /debug/loops -> the LoopHealthRegistry rollup (busy fractions,
+        # queue depths, saturation metric families); None disables it.
+        self.loops_fn = loops_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -68,6 +77,8 @@ class HealthServer:
         explain_fn = self.explain_fn
         record_fn = self.record_fn
         capacity_fn = self.capacity_fn
+        profiler = self.profiler
+        loops_fn = self.loops_fn
 
         # The /debug/ index: every debug surface this listener actually
         # serves, with a one-liner. Conditional entries appear only when
@@ -91,6 +102,17 @@ class HealthServer:
             debug_index["/debug/capacity"] = (
                 "the capacity ledger: chip-seconds accounting, idle "
                 "attribution, fragmentation, gang waits, quota posture"
+            )
+        if profiler is not None:
+            debug_index["/debug/profile"] = (
+                "the control-plane sampling profiler: JSON top-N self-time "
+                "and phase attribution; ?format=collapsed for flamegraph "
+                "input; ?action=start|stop for runtime control"
+            )
+        if loops_fn is not None:
+            debug_index["/debug/loops"] = (
+                "loop-health rollup: per-loop busy fractions, watch queue "
+                "depths, drain lag and phase-duration metric families"
             )
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
@@ -202,6 +224,65 @@ class HealthServer:
                         return
                     body = json.dumps(capacity_fn(), indent=2)
                     self._respond(200, body, "application/json")
+                elif (
+                    path == "/debug/profile"
+                    and serve_metrics
+                    and profiler is not None
+                ):
+                    # Same credential as /metrics: stack frames reveal
+                    # code paths and the phase labels carry span names.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    query = parse_qs(url.query)
+                    action = query.get("action", [None])[0]
+                    if action == "start":
+                        started = profiler.start()
+                        self._respond(
+                            200,
+                            json.dumps(
+                                {"enabled": True, "started": started}
+                            ),
+                            "application/json",
+                        )
+                        return
+                    if action == "stop":
+                        stopped = profiler.stop()
+                        self._respond(
+                            200,
+                            json.dumps(
+                                {"enabled": False, "stopped": stopped}
+                            ),
+                            "application/json",
+                        )
+                        return
+                    if action is not None:
+                        self._respond(400, "action must be start or stop")
+                        return
+                    fmt = query.get("format", ["json"])[0]
+                    if fmt == "collapsed":
+                        # flamegraph.pl / speedscope input, one aggregated
+                        # stack per line.
+                        self._respond(200, profiler.collapsed())
+                    else:
+                        self._respond(
+                            200,
+                            json.dumps(profiler.debug_payload(), indent=2),
+                            "application/json",
+                        )
+                elif (
+                    path == "/debug/loops"
+                    and serve_metrics
+                    and loops_fn is not None
+                ):
+                    # Same credential as /metrics: loop names and watcher
+                    # labels identify the deployment's topology.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    self._respond(
+                        200, json.dumps(loops_fn(), indent=2), "application/json"
+                    )
                 elif path in ("/debug", "/debug/") and serve_metrics:
                     # Bearer-gated like every endpoint it links to — the
                     # index itself reveals which subsystems are wired.

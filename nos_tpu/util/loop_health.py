@@ -1,0 +1,117 @@
+"""Loop-health rollup: busy meters plus the /debug/loops document.
+
+Every control loop in the suite has the same shape — block for work, do
+work, repeat — and the same failure mode under saturation: the busy
+fraction pins at 1.0 while its watch queue's drain lag grows. This module
+gives each loop a :class:`BusyMeter` (feeding the
+``nos_tpu_controller_busy_fraction`` gauge) and a process-wide
+:class:`LoopHealthRegistry` the loops register live stats callbacks with,
+so ``/debug/loops`` can answer "which loop is behind and by how much" in
+one document: per-loop busy fractions and queue depths, the store's
+per-subscriber watch depths, and the saturation metric families
+(drain lag, phase histograms, lock waits) from the registry snapshot.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from nos_tpu.util import metrics
+
+
+class BusyMeter:
+    """Windowed busy-fraction meter for one control loop.
+
+    The loop reports each iteration's busy and idle time; once a window's
+    total crosses ``WINDOW_SECONDS`` the gauge updates and the window
+    resets — so the gauge tracks recent behavior, not the lifetime mean,
+    and a loop that saturates shows up within about a second.
+    """
+
+    WINDOW_SECONDS = 1.0
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._window_busy = 0.0
+        self._window_total = 0.0
+        self._busy_total = 0.0
+        self._iterations = 0
+        self._fraction = 0.0
+        self._gauge = metrics.CONTROLLER_BUSY.labels(loop=name)
+
+    def record(self, busy_s: float, idle_s: float = 0.0) -> None:
+        with self._lock:
+            self._window_busy += busy_s
+            self._window_total += busy_s + idle_s
+            self._busy_total += busy_s
+            if busy_s > 0:
+                self._iterations += 1
+            if self._window_total >= self.WINDOW_SECONDS:
+                self._fraction = self._window_busy / self._window_total
+                self._gauge.set(round(self._fraction, 4))
+                self._window_busy = 0.0
+                self._window_total = 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "busy_fraction": round(self._fraction, 4),
+                "busy_seconds_total": round(self._busy_total, 4),
+                "iterations": self._iterations,
+            }
+
+
+class LoopHealthRegistry:
+    """Process-wide registry of live loop-stats callbacks (register on
+    loop start, unregister on stop — a leaked registration would keep a
+    dead loop in every later /debug/loops document)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loops: Dict[str, Callable[[], Dict[str, Any]]] = {}
+
+    def register(self, name: str, stats_fn: Callable[[], Dict[str, Any]]) -> None:
+        with self._lock:
+            self._loops[name] = stats_fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._loops.pop(name, None)
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._loops)
+
+    def payload(self, store: Optional[Any] = None) -> Dict[str, Any]:
+        """The /debug/loops JSON document."""
+        with self._lock:
+            loops = dict(self._loops)
+        doc: Dict[str, Any] = {"generated_monotonic": time.monotonic(), "loops": {}}
+        for name, stats_fn in sorted(loops.items()):
+            try:
+                doc["loops"][name] = stats_fn()
+            except Exception as exc:
+                doc["loops"][name] = {"error": f"{type(exc).__name__}: {exc}"}
+        if store is not None and hasattr(store, "watch_stats"):
+            doc["watchers"] = store.watch_stats()
+        saturation_prefixes = (
+            "nos_tpu_controller_busy_fraction",
+            "nos_tpu_watch_drain_lag_seconds",
+            "nos_tpu_watch_queue_depth",
+            "nos_tpu_store_lock_",
+            "nos_tpu_partitioner_phase_seconds",
+            "nos_tpu_scheduler_phase_seconds",
+            "nos_tpu_profiler_",
+        )
+        doc["metrics"] = {
+            key: value
+            for key, value in metrics.REGISTRY.snapshot().items()
+            if key.startswith(saturation_prefixes)
+        }
+        return doc
+
+
+# The process-wide loop registry (the metrics.REGISTRY analogue).
+LOOPS = LoopHealthRegistry()

@@ -510,3 +510,64 @@ QUOTA_STARVED_CHIPS = REGISTRY.gauge(
     "Chips of guaranteed ElasticQuota min a namespace is short of while "
     "it has pending demand (by namespace)",
 )
+
+# Control-plane saturation telemetry (util/loop_health.py, util/profiling.py,
+# kube/store.py): where a control cycle's wall time goes, how far behind the
+# watch queues run, and what the store lock costs — the inward-facing
+# counterpart of the capacity ledger's outward accounting.
+CONTROLLER_BUSY = REGISTRY.gauge(
+    "nos_tpu_controller_busy_fraction",
+    "Fraction of the last ~1 s window a control loop spent doing work "
+    "rather than waiting for it (by loop)",
+)
+WATCH_DRAIN_LAG = REGISTRY.histogram(
+    "nos_tpu_watch_drain_lag_seconds",
+    "Age of a WatchEvent at dequeue — monotonic enqueue-to-drain delay "
+    "per consuming loop (by consumer); a growing lag means the consumer "
+    "is saturated",
+    buckets=(
+        0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+    ),
+)
+WATCH_QUEUE_DEPTH = REGISTRY.gauge(
+    "nos_tpu_watch_queue_depth",
+    "Events waiting in a watch subscriber's (unbounded) queue "
+    "(by kind_set: the subscriber's name, or its joined kind set when "
+    "anonymous)",
+)
+STORE_LOCK_WAIT = REGISTRY.counter(
+    "nos_tpu_store_lock_wait_seconds_total",
+    "Seconds callers spent blocked on the KubeStore lock. Sampled at "
+    "contention: the uncontended fast path records nothing, so this "
+    "counts only acquisitions that actually waited",
+)
+STORE_LOCK_CONTENTION = REGISTRY.counter(
+    "nos_tpu_store_lock_contention_total",
+    "KubeStore lock acquisitions that had to wait for another holder",
+)
+PARTITIONER_PHASE = REGISTRY.histogram(
+    "nos_tpu_partitioner_phase_seconds",
+    "Partitioner cycle phase durations "
+    "(by kind, phase=drain|refresh|plan|actuate; a full rebuild lands in "
+    "refresh)",
+    buckets=(
+        0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    ),
+)
+SCHEDULER_PHASE = REGISTRY.histogram(
+    "nos_tpu_scheduler_phase_seconds",
+    "Scheduler cycle phase durations (phase=decide|settle: decide is the "
+    "in-memory pipeline through Permit, settle the bind/nominate/fail "
+    "store writes)",
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+)
+PROFILER_SAMPLES = REGISTRY.counter(
+    "nos_tpu_profiler_samples_total",
+    "Stack samples captured from registered controller threads by the "
+    "sampling profiler",
+)
+PROFILER_OVERHEAD = REGISTRY.gauge(
+    "nos_tpu_profiler_overhead_fraction",
+    "Sampler duty cycle: time spent capturing stacks divided by wall "
+    "time enabled (the profiler's measured overhead budget)",
+)

@@ -1,0 +1,311 @@
+"""Sampling wall-clock profiler for the control-plane threads.
+
+The suite observes everything outward (journeys, decisions, chip-seconds)
+but nothing inward: nothing answered "where does a planner cycle's wall
+time actually go". This module is the dependency-free answer — a
+background sampler over ``sys._current_frames()`` designed to stay ON in a
+long-running scheduler:
+
+- **Registered threads only.** Controller loops register their thread id
+  (``PROFILER.register_thread()`` in the thread body, or the
+  ``registered()`` context manager); everything else — JAX worker pools,
+  HTTP handler threads, the sampler itself — is invisible, so sample
+  volume tracks the control plane, not the process.
+- **Bounded aggregation.** Samples collapse into a
+  ``(thread, phase, stack) -> count`` table capped at ``max_stacks``
+  distinct entries; overflow increments a drop counter instead of growing
+  memory. Frames are ``file.py:function`` (no line numbers), keeping the
+  key space small and the flamegraph readable.
+- **Phase attribution.** Each sample is labeled with the thread's
+  innermost active tracing span via ``tracing.current_phase`` — the
+  thread-id → span registry maintained by ``Tracer.span``/``attach``
+  enter/exit. A bench_planner cycle therefore decomposes into
+  ``planner.plan`` / ``snapshot.take`` / ``partitioner.actuate`` … with no
+  instrumentation beyond the spans the code already has. (Attribution
+  requires ``TRACER.enabled``; with tracing off every sample lands in
+  ``(no-phase)``.)
+- **Measured overhead.** The sampler accounts its own duty cycle
+  (time capturing / wall time enabled) into
+  ``nos_tpu_profiler_overhead_fraction`` — the acceptance budget is <= 2%
+  at the default 100 Hz rate, and the slow guard in
+  ``tests/partitioning/test_planner_perf.py`` enforces it.
+
+Surfaces: ``/debug/profile`` (bearer-gated; JSON top-N self-time by
+default, ``?format=collapsed`` for flamegraph.pl/speedscope collapsed
+stacks, ``?action=start|stop`` for runtime on/off) and
+``bench_planner --profile`` (the committed offline artifact).
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from nos_tpu.util import metrics, tracing
+
+
+class StackProfiler:
+    """Aggregating sampler over ``sys._current_frames()``.
+
+    Thread-safe throughout: registration, sampling, rendering, and
+    start/stop may race freely (start/stop are idempotent; the stop path
+    joins the sampler thread before returning).
+    """
+
+    DEFAULT_INTERVAL = 0.01  # 100 Hz
+    MAX_STACKS = 2048
+    MAX_DEPTH = 48
+
+    def __init__(self, interval_seconds: float = DEFAULT_INTERVAL) -> None:
+        self.interval = interval_seconds
+        self.max_stacks = self.MAX_STACKS
+        self.max_depth = self.MAX_DEPTH
+        self._lock = threading.Lock()
+        self._threads: Dict[int, str] = {}
+        # code object -> "file.py:func", touched only by the sampler; keyed
+        # on the code object itself (ids recycle), bounded by a flush.
+        self._frame_labels: Dict[Any, str] = {}
+        # (thread name, phase, root-first stack tuple) -> sample count.
+        self._table: Dict[Tuple[str, str, Tuple[str, ...]], int] = {}
+        self._phase_samples: Dict[str, int] = {}
+        self._total_samples = 0
+        self._dropped_stacks = 0
+        # Overhead accounting: sampler busy time vs wall time enabled
+        # (prior enable windows accumulate into _wall_accum).
+        self._busy_s = 0.0
+        self._wall_accum = 0.0
+        self._started_at = 0.0
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- registration
+
+    def register_thread(
+        self, name: Optional[str] = None, ident: Optional[int] = None
+    ) -> int:
+        """Opt the thread in to sampling; returns the registered id."""
+        if ident is None:
+            ident = threading.get_ident()
+            name = name or threading.current_thread().name
+        with self._lock:
+            self._threads[ident] = name or str(ident)
+        return ident
+
+    def unregister_thread(self, ident: Optional[int] = None) -> None:
+        if ident is None:
+            ident = threading.get_ident()
+        with self._lock:
+            self._threads.pop(ident, None)
+
+    @contextlib.contextmanager
+    def registered(self, name: Optional[str] = None):
+        """Register the calling thread for the duration of the block."""
+        ident = self.register_thread(name)
+        try:
+            yield self
+        finally:
+            self.unregister_thread(ident)
+
+    def threads(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._threads)
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def enabled(self) -> bool:
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    def start(self, interval_seconds: Optional[float] = None) -> bool:
+        """Start the sampler thread; returns False if already running."""
+        with self._lock:
+            if interval_seconds is not None:
+                self.interval = interval_seconds
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            stop = threading.Event()
+            thread = threading.Thread(
+                target=self._run, args=(stop,), name="stack-profiler", daemon=True
+            )
+            self._stop = stop
+            self._thread = thread
+            self._started_at = time.perf_counter()
+            # Started under the lock: a concurrent stop() that wins the
+            # lock next must only ever see a thread that is joinable.
+            thread.start()
+        return True
+
+    def stop(self) -> bool:
+        """Stop and join the sampler thread; returns False if not running."""
+        with self._lock:
+            thread = self._thread
+            stop = self._stop
+            self._thread = None
+            self._stop = None
+            if thread is not None:
+                self._wall_accum += time.perf_counter() - self._started_at
+        if thread is None or stop is None:
+            return False
+        stop.set()
+        thread.join(timeout=2.0)
+        return True
+
+    def reset(self) -> None:
+        """Drop all samples and overhead accounting (registrations and the
+        running sampler, if any, are kept)."""
+        with self._lock:
+            self._table.clear()
+            self._phase_samples.clear()
+            self._total_samples = 0
+            self._dropped_stacks = 0
+            self._busy_s = 0.0
+            self._wall_accum = 0.0
+            self._started_at = time.perf_counter()
+
+    def _run(self, stop: threading.Event) -> None:
+        # Event.wait paces the loop — no hot polling (the Batcher lesson:
+        # a fixed-tick busy loop burns a core at idle).
+        while not stop.wait(self.interval):
+            t0 = time.perf_counter()
+            self.sample_once()
+            with self._lock:
+                self._busy_s += time.perf_counter() - t0
+            metrics.PROFILER_OVERHEAD.set(round(self.overhead_fraction(), 6))
+
+    # ----------------------------------------------------------- sampling
+
+    def sample_once(self) -> int:
+        """Capture one sample of every registered thread; returns the
+        number of threads sampled. Public so tests can sample
+        deterministically without the background thread."""
+        with self._lock:
+            targets = list(self._threads.items())
+        if not targets:
+            return 0
+        labels = self._frame_labels
+        if len(labels) > 8192:  # code churn backstop (reloads, lambdas)
+            labels.clear()
+        frames = sys._current_frames()
+        keys: List[Tuple[str, str, Tuple[str, ...]]] = []
+        for ident, name in targets:
+            frame = frames.get(ident)
+            if frame is None:
+                continue
+            stack: List[str] = []
+            depth = 0
+            while frame is not None and depth < self.max_depth:
+                code = frame.f_code
+                label = labels.get(code)
+                if label is None:
+                    label = f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}"
+                    labels[code] = label
+                stack.append(label)
+                frame = frame.f_back
+                depth += 1
+            stack.reverse()  # root-first: collapsed-stack order
+            keys.append((name, tracing.current_phase(ident), tuple(stack)))
+        del frames  # drop the frame references promptly
+        if not keys:
+            return 0
+        with self._lock:
+            for key in keys:
+                self._total_samples += 1
+                phase = key[1]
+                self._phase_samples[phase] = self._phase_samples.get(phase, 0) + 1
+                if key in self._table or len(self._table) < self.max_stacks:
+                    self._table[key] = self._table.get(key, 0) + 1
+                else:
+                    self._dropped_stacks += 1
+        metrics.PROFILER_SAMPLES.inc(len(keys))
+        return len(keys)
+
+    # ---------------------------------------------------------- reporting
+
+    @property
+    def total_samples(self) -> int:
+        with self._lock:
+            return self._total_samples
+
+    def overhead_fraction(self) -> float:
+        """Sampler busy time / wall time enabled, across every enable
+        window since the last reset()."""
+        with self._lock:
+            wall = self._wall_accum
+            if self._thread is not None and self._thread.is_alive():
+                wall += time.perf_counter() - self._started_at
+            busy = self._busy_s
+        return busy / wall if wall > 0 else 0.0
+
+    def collapsed(self) -> str:
+        """One ``thread;phase;frame;...;frame count`` line per aggregated
+        stack — the flamegraph.pl / speedscope collapsed format, with the
+        thread name and tracing phase as the two root frames."""
+        with self._lock:
+            items = sorted(self._table.items())
+            dropped = self._dropped_stacks
+        lines = []
+        for (name, phase, stack), count in items:
+            frames_part = ";".join([name, phase or "(no-phase)", *stack])
+            lines.append(f"{frames_part} {count}")
+        if dropped:
+            lines.append(f"(table-overflow);(dropped) {dropped}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def top(self, n: int = 20) -> List[Dict[str, Any]]:
+        """Top-N frames by self time (leaf-frame sample count)."""
+        with self._lock:
+            items = list(self._table.items())
+            total = self._total_samples
+        self_counts: Dict[str, int] = {}
+        for (_, _, stack), count in items:
+            leaf = stack[-1] if stack else "(unknown)"
+            self_counts[leaf] = self_counts.get(leaf, 0) + count
+        ranked = sorted(self_counts.items(), key=lambda kv: (-kv[1], kv[0]))[:n]
+        return [
+            {
+                "frame": frame,
+                "samples": count,
+                "fraction": round(count / total, 4) if total else 0.0,
+            }
+            for frame, count in ranked
+        ]
+
+    def phase_report(self) -> Dict[str, Any]:
+        """Per-phase sample counts plus the attributed fraction — the
+        "how much of the wall time do the spans explain" number."""
+        with self._lock:
+            phases = dict(self._phase_samples)
+            total = self._total_samples
+        attributed = sum(count for phase, count in phases.items() if phase)
+        return {
+            "total_samples": total,
+            "attributed_samples": attributed,
+            "attributed_fraction": round(attributed / total, 4) if total else 0.0,
+            "phases": {
+                phase or "(no-phase)": count
+                for phase, count in sorted(phases.items(), key=lambda kv: -kv[1])
+            },
+        }
+
+    def debug_payload(self, top_n: int = 20) -> Dict[str, Any]:
+        """The /debug/profile JSON document."""
+        with self._lock:
+            stacks = len(self._table)
+            dropped = self._dropped_stacks
+        return {
+            "enabled": self.enabled,
+            "interval_seconds": self.interval,
+            "threads": sorted(self.threads().values()),
+            "stacks": stacks,
+            "dropped_stacks": dropped,
+            "overhead_fraction": round(self.overhead_fraction(), 6),
+            **self.phase_report(),
+            "top": self.top(top_n),
+        }
+
+
+# The process-wide profiler (the metrics.REGISTRY / tracing.TRACER analogue).
+PROFILER = StackProfiler()

@@ -52,6 +52,19 @@ def _new_id(prefix: str) -> str:
 _current_span: ContextVar[Optional["Span"]] = ContextVar(
     "nos_tpu_current_span", default=None
 )
+# Thread id -> innermost active span NAME, maintained on span()/attach()
+# enter/exit. The sampling profiler (util/profiling.py) reads this from its
+# own sampler thread to attribute wall-clock samples to tracing phases.
+# A plain dict is safe here: each key is written only by the thread it
+# names, the sampler only reads, and the GIL makes single dict operations
+# atomic — so the span hot path pays two dict ops, no lock.
+_thread_phases: Dict[int, str] = {}
+
+
+def current_phase(thread_id: int) -> str:
+    """Name of the thread's innermost active span ('' outside any span or
+    while tracing is disabled)."""
+    return _thread_phases.get(thread_id, "")
 # Planner simulation runs the scheduler framework thousands of times per
 # plan(); per-plugin spans there are volume without information. The
 # planner raises this flag around its trials; framework plugin spans check
@@ -362,12 +375,19 @@ class Tracer:
             yield span
             return
         token = _current_span.set(span)
+        tid = threading.get_ident()
+        prev_phase = _thread_phases.get(tid)
+        _thread_phases[tid] = span.name
         try:
             yield span
         except BaseException:
             self.end_span(span, status="error")
             raise
         finally:
+            if prev_phase is None:
+                _thread_phases.pop(tid, None)
+            else:
+                _thread_phases[tid] = prev_phase
             _current_span.reset(token)
             self.end_span(span)
 
@@ -394,9 +414,17 @@ class Tracer:
         cross-thread propagation primitive (contextvars do not cross
         thread starts)."""
         token = _current_span.set(span)
+        tid = threading.get_ident()
+        prev_phase = _thread_phases.get(tid)
+        if span is not None and span is not NOOP_SPAN:
+            _thread_phases[tid] = span.name
         try:
             yield span
         finally:
+            if prev_phase is None:
+                _thread_phases.pop(tid, None)
+            else:
+                _thread_phases[tid] = prev_phase
             _current_span.reset(token)
 
     @contextlib.contextmanager

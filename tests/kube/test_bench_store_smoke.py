@@ -1,0 +1,45 @@
+"""bench_store smoke: every bench function runs on a tiny store and
+returns well-formed JSON-able rows (tier-1; the committed
+BENCH_store.json carries the real 10k-node / 100k-pod numbers)."""
+import json
+
+import bench_store
+
+
+class TestBenchStoreSmoke:
+    def test_all_benches_produce_rows(self):
+        rows = bench_store.run_config(20, 100, n_watchers=2, quick=True)
+        benches = {r["bench"] for r in rows}
+        assert benches == {
+            "store_seed",
+            "store_list",
+            "store_list_by_index",
+            "store_patch",
+            "store_watch_fanout",
+            "store_apply_event",
+        }
+        for row in rows:
+            json.dumps(row)  # every row is a JSON line
+            assert row["nodes"] == 20
+            assert row["pods"] == 100
+
+    def test_index_rows_carry_before_after_pair(self):
+        rows = bench_store.run_config(10, 50, n_watchers=1, quick=True)
+        variants = {
+            r["variant"]: r for r in rows if r["bench"] == "store_list_by_index"
+        }
+        assert set(variants) == {"indexed", "scan"}
+        assert variants["indexed"]["lookups_per_sec"] > 0
+        assert variants["scan"]["lookups_per_sec"] > 0
+
+    def test_watch_fanout_delivers_to_every_watcher(self):
+        rows = bench_store.run_config(5, 20, n_watchers=3, quick=True)
+        fanout = next(r for r in rows if r["bench"] == "store_watch_fanout")
+        assert fanout["events_delivered"] == fanout["writes"] * 3
+
+    def test_seeded_store_matches_config(self):
+        store = bench_store.seed_store(5, 30)
+        assert len(store.list("Node", copy=False)) == 5
+        assert len(store.list("Pod", copy=False)) == 30
+        pending = store.list_by_index("Pod", "status.phase", "Pending", copy=False)
+        assert len(pending) == 3  # every 10th pod is a Pending straggler

@@ -1,0 +1,305 @@
+"""Maintained index maps, watch-queue telemetry, and drain-lag stamps.
+
+The index maps must be behavior-identical to the full-scan list_by_index
+they replaced: same membership, same (namespace, name) sort, same
+``copy=False`` identity contract — across create/update/patch/delete,
+late indexer registration, and the apistore's reflector mutation paths.
+"""
+import queue
+import time
+
+import pytest
+
+from nos_tpu.kube.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+)
+from nos_tpu.kube.store import ADDED, KubeStore, WatchEvent
+from nos_tpu.util import metrics
+
+
+def make_pod(name: str, node: str = "", phase: str = "Pending", ns: str = "default") -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(containers=[Container(requests={"cpu": 1})], node_name=node),
+        status=PodStatus(phase=phase),
+    )
+
+
+def make_store() -> KubeStore:
+    s = KubeStore()
+    s.add_indexer("Pod", "status.phase", lambda p: [p.status.phase])
+    s.add_indexer("Pod", "spec.nodeName", lambda p: [p.spec.node_name])
+    return s
+
+
+def scan_equivalent(store, kind, fn, value, copy=True):
+    """The pre-index behavior list_by_index must stay identical to."""
+    return store.list(kind, filter_fn=lambda o: value in fn(o), copy=copy)
+
+
+class TestIndexMaps:
+    def test_matches_full_scan_after_mixed_mutations(self):
+        s = make_store()
+        for i in range(10):
+            s.create(make_pod(f"p{i}", node=f"n{i % 3}", phase="Pending"))
+        # update moves p1, patch flips p2's phase, delete removes p3
+        moved = s.get("Pod", "p1", "default")
+        moved.spec.node_name = "n9"
+        s.update(moved)
+        s.patch_merge(
+            "Pod", "p2", "default", lambda p: setattr(p.status, "phase", "Running")
+        )
+        s.delete("Pod", "p3", "default")
+        for index_name, fn in (
+            ("status.phase", lambda p: [p.status.phase]),
+            ("spec.nodeName", lambda p: [p.spec.node_name]),
+        ):
+            for value in ("Pending", "Running", "n0", "n1", "n2", "n9", "missing"):
+                got = [
+                    (o.metadata.namespace, o.metadata.name)
+                    for o in s.list_by_index("Pod", index_name, value)
+                ]
+                want = [
+                    (o.metadata.namespace, o.metadata.name)
+                    for o in scan_equivalent(s, "Pod", fn, value)
+                ]
+                assert got == want, (index_name, value)
+
+    def test_sorted_by_namespace_then_name(self):
+        s = make_store()
+        s.create(make_pod("zz", node="n1", ns="aaa"))
+        s.create(make_pod("aa", node="n1", ns="zzz"))
+        s.create(make_pod("mm", node="n1", ns="aaa"))
+        got = [
+            (o.metadata.namespace, o.metadata.name)
+            for o in s.list_by_index("Pod", "spec.nodeName", "n1")
+        ]
+        assert got == [("aaa", "mm"), ("aaa", "zz"), ("zzz", "aa")]
+
+    def test_copy_false_identity_stable_across_calls(self):
+        s = make_store()
+        s.create(make_pod("p1", node="n1"))
+        a = s.list_by_index("Pod", "spec.nodeName", "n1", copy=False)
+        b = s.list_by_index("Pod", "spec.nodeName", "n1", copy=False)
+        assert a[0] is b[0]
+        # copy=True hands out fresh objects
+        c = s.list_by_index("Pod", "spec.nodeName", "n1")
+        assert c[0] is not a[0]
+
+    def test_unknown_indexer_raises_keyerror(self):
+        s = make_store()
+        with pytest.raises(KeyError, match="no indexer"):
+            s.list_by_index("Pod", "nope", "x")
+
+    def test_late_indexer_registration_backfills(self):
+        s = KubeStore()
+        s.create(make_pod("p1", node="n1"))
+        s.create(make_pod("p2", node="n2"))
+        s.add_indexer("Pod", "spec.nodeName", lambda p: [p.spec.node_name])
+        assert [o.metadata.name for o in s.list_by_index("Pod", "spec.nodeName", "n1")] == ["p1"]
+
+    def test_apply_event_maintains_index(self):
+        s = make_store()
+        s.create(make_pod("p1", node="n1"))
+        moved = s.get("Pod", "p1", "default")
+        moved.spec.node_name = "n2"
+        moved.metadata.resource_version += 1
+        s.apply_event("MODIFIED", moved)
+        assert s.list_by_index("Pod", "spec.nodeName", "n1") == []
+        assert [o.metadata.name for o in s.list_by_index("Pod", "spec.nodeName", "n2")] == ["p1"]
+        s.apply_event("DELETED", moved)
+        assert s.list_by_index("Pod", "spec.nodeName", "n2") == []
+
+
+class TestWatchTelemetry:
+    def test_named_watcher_has_queue_depth_gauge(self):
+        s = make_store()
+        s.create(make_pod("p0"))
+        q = s.watch({"Pod"}, name="depth-test-watcher")
+        try:
+            rendered = metrics.REGISTRY.render()
+            assert 'nos_tpu_watch_queue_depth{kind_set="depth-test-watcher"} 1' in rendered
+            s.create(make_pod("p1"))
+            rendered = metrics.REGISTRY.render()
+            assert 'nos_tpu_watch_queue_depth{kind_set="depth-test-watcher"} 2' in rendered
+        finally:
+            s.stop_watch(q)
+        # stop_watch zeroes the gauge so dead subscribers don't alert
+        assert 'kind_set="depth-test-watcher"} 0' in metrics.REGISTRY.render()
+
+    def test_anonymous_watcher_labeled_by_kind_set(self):
+        s = make_store()
+        q = s.watch({"Pod", "Node"})
+        try:
+            assert 'kind_set="Node|Pod"' in metrics.REGISTRY.render()
+        finally:
+            s.stop_watch(q)
+
+    def test_watch_all_kinds_labeled_star(self):
+        s = make_store()
+        q = s.watch()
+        try:
+            assert "*" in s.watch_stats()
+            assert s.watch_stats()["*"]["kinds"] == ["*"]
+        finally:
+            s.stop_watch(q)
+
+    def test_watch_stats_reports_depth(self):
+        s = make_store()
+        q = s.watch({"Pod"}, name="stats-watcher")
+        try:
+            s.create(make_pod("p1"))
+            s.create(make_pod("p2"))
+            stats = s.watch_stats()
+            assert stats["stats-watcher"]["depth"] == 2
+            assert stats["stats-watcher"]["kinds"] == ["Pod"]
+        finally:
+            s.stop_watch(q)
+
+    def test_slow_watcher_warning_rate_limited(self, caplog):
+        s = make_store()
+        s.WATCH_QUEUE_WARN_DEPTH = 3
+        q = s.watch({"Pod"}, name="slow-watcher")
+        try:
+            with caplog.at_level("WARNING", logger="nos_tpu.kube.store"):
+                for i in range(6):
+                    s.create(make_pod(f"p{i}"))
+            warnings = [r for r in caplog.records if "events behind" in r.message]
+            # Depth crosses 3 on the third event; later events are inside
+            # the rate-limit interval so exactly one warning fires.
+            assert len(warnings) == 1
+            assert "slow-watcher" in warnings[0].getMessage()
+        finally:
+            s.stop_watch(q)
+
+
+class TestDrainLag:
+    def test_events_carry_monotonic_enqueue_stamp(self):
+        s = make_store()
+        q = s.watch({"Pod"}, name="lag-watcher")
+        try:
+            before = time.monotonic()
+            s.create(make_pod("p1"))
+            event = q.get_nowait()
+            assert event.type == ADDED
+            assert before <= event.enqueued <= time.monotonic()
+        finally:
+            s.stop_watch(q)
+
+    def test_replayed_added_events_stamped_too(self):
+        s = make_store()
+        s.create(make_pod("p1"))
+        q = s.watch({"Pod"}, name="replay-watcher")
+        try:
+            event = q.get_nowait()
+            assert event.enqueued > 0
+        finally:
+            s.stop_watch(q)
+
+    def test_controller_pump_observes_drain_lag(self):
+        from nos_tpu.kube.controller import Controller, Manager, Watch
+
+        store = make_store()
+        seen = []
+        manager = Manager(store=store)
+        controller = Controller(
+            name="lag-test-controller",
+            store=store,
+            reconciler=lambda req: seen.append(req) or None,
+            watches=[Watch(kind="Pod")],
+        )
+        manager.add(controller)
+        manager.start()
+        try:
+            store.create(make_pod("p1"))
+            assert manager.wait_idle(timeout=5.0)
+            snap = metrics.REGISTRY.snapshot()
+            key = 'nos_tpu_watch_drain_lag_seconds_count{consumer="lag-test-controller"}'
+            assert snap.get(key, 0) >= 1, sorted(
+                k for k in snap if "drain_lag" in k
+            )
+        finally:
+            manager.stop()
+
+    def test_controller_registers_loop_stats(self):
+        from nos_tpu.kube.controller import Controller, Manager, Watch
+        from nos_tpu.util.loop_health import LOOPS
+
+        store = make_store()
+        manager = Manager(store=store)
+        controller = Controller(
+            name="stats-test-controller",
+            store=store,
+            reconciler=lambda req: None,
+            watches=[Watch(kind="Pod")],
+        )
+        manager.add(controller)
+        manager.start()
+        try:
+            assert "stats-test-controller" in LOOPS.names()
+            doc = LOOPS.payload(store=store)
+            stats = doc["loops"]["stats-test-controller"]
+            assert "busy_fraction" in stats
+            assert "event_queue_depth" in stats
+        finally:
+            manager.stop()
+        assert "stats-test-controller" not in LOOPS.names()
+
+
+class TestLockContention:
+    def test_contended_acquire_meters_wait(self):
+        import threading
+
+        s = make_store()
+        before = metrics.REGISTRY.snapshot().get(
+            "nos_tpu_store_lock_contention_total", 0
+        )
+        entered, release = threading.Event(), threading.Event()
+
+        def holder():
+            with s._lock:
+                entered.set()
+                release.wait(2.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        entered.wait(2.0)
+        waiter = threading.Thread(target=lambda: s.list("Pod"))
+        waiter.start()
+        time.sleep(0.05)  # let the waiter block on the held lock
+        release.set()
+        t.join()
+        waiter.join()
+        after = metrics.REGISTRY.snapshot().get(
+            "nos_tpu_store_lock_contention_total", 0
+        )
+        assert after >= before + 1
+
+    def test_uncontended_fast_path_meters_nothing(self):
+        s = make_store()
+        before = metrics.REGISTRY.snapshot().get(
+            "nos_tpu_store_lock_contention_total", 0
+        )
+        for i in range(20):
+            s.create(make_pod(f"fast-{i}"))
+        after = metrics.REGISTRY.snapshot().get(
+            "nos_tpu_store_lock_contention_total", 0
+        )
+        assert after == before
+
+
+class TestWatchEventCompat:
+    def test_enqueued_defaults_to_zero(self):
+        event = WatchEvent(ADDED, make_pod("p"))
+        assert event.enqueued == 0.0
+
+    def test_stale_event_queue_still_works(self):
+        # Events hand-built by tests (no enqueued stamp) must flow through
+        # the pump without producing lag observations.
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        q.put(WatchEvent(ADDED, make_pod("p")))
+        assert q.get_nowait().enqueued == 0.0

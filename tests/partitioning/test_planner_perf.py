@@ -146,3 +146,53 @@ def test_tracing_overhead_within_allowance():
         f"traced plan() {traced:.3f}s is {overhead:.1%} over the disabled "
         f"baseline {baseline:.3f}s — per-trial span cost has grown"
     )
+
+
+@pytest.mark.slow
+def test_profiler_overhead_within_allowance():
+    """The always-on sampling profiler's acceptance budget: at the default
+    100 Hz rate its measured duty cycle (sampler busy / wall enabled) must
+    stay <= 2% while real plan() work runs on a registered thread, and a
+    wall-clock comparison against a profiler-off baseline must stay within
+    the same allowance band the tracing guard uses."""
+    import statistics
+
+    from nos_tpu.util.profiling import StackProfiler
+
+    planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+    planner.plan(make_cluster(8, ClusterSnapshot), make_pending(10))  # warm-up
+
+    def timed_runs(runs=5):
+        samples = []
+        for _ in range(runs):
+            snapshot = make_cluster(64, ClusterSnapshot)
+            pods = make_pending(200)
+            started = time.perf_counter()
+            planner.plan(snapshot, pods)
+            samples.append(time.perf_counter() - started)
+        return statistics.median(samples)
+
+    baseline = timed_runs()
+
+    prof = StackProfiler()  # default interval: 100 Hz
+    prof.register_thread(name="perf-guard")
+    prof.start()
+    try:
+        profiled = timed_runs()
+    finally:
+        prof.stop()
+        prof.unregister_thread()
+
+    assert prof.total_samples > 0, "sampler never saw the registered thread"
+    duty = prof.overhead_fraction()
+    assert duty <= 0.02, (
+        f"profiler duty cycle {duty:.2%} exceeds the 2% budget at the "
+        f"default rate — sample_once has grown too expensive"
+    )
+    assert baseline < PLAN_BOUND_SECONDS
+    assert profiled < PLAN_BOUND_SECONDS
+    overhead = (profiled / baseline) - 1.0 if baseline else 0.0
+    assert overhead < 0.15, (
+        f"profiled plan() {profiled:.3f}s is {overhead:.1%} over the "
+        f"profiler-off baseline {baseline:.3f}s"
+    )

@@ -1,0 +1,143 @@
+"""/debug/profile and /debug/loops endpoints: bearer gate, documents,
+runtime profiler control, index entries."""
+import http.client
+import json
+
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.util.health import HealthServer
+from nos_tpu.util.loop_health import LoopHealthRegistry
+from nos_tpu.util.profiling import StackProfiler
+from nos_tpu.util.tracing import TRACER
+
+
+def _get(port, path, token=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode()
+
+
+def _sampled_profiler() -> StackProfiler:
+    prof = StackProfiler()
+    prof.register_thread(name="endpoint-test")
+    with TRACER.span("endpoint.phase"):
+        prof.sample_once()
+    return prof
+
+
+class TestDebugProfileEndpoint:
+    def test_json_document_behind_bearer_gate(self):
+        prof = _sampled_profiler()
+        server = HealthServer(port=0, metrics_token="s3cret", profiler=prof)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/profile")[0] == 401
+            assert _get(port, "/debug/profile", "wrong")[0] == 401
+            status, body = _get(port, "/debug/profile", "s3cret")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["total_samples"] == 1
+            assert doc["phases"] == {"endpoint.phase": 1}
+            assert doc["threads"] == ["endpoint-test"]
+            assert doc["top"]
+        finally:
+            server.stop()
+
+    def test_collapsed_format_is_plain_text(self):
+        prof = _sampled_profiler()
+        server = HealthServer(port=0, profiler=prof)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/profile?format=collapsed")
+            assert status == 200
+            line = body.strip().splitlines()[0]
+            assert line.startswith("endpoint-test;endpoint.phase;")
+            assert line.rsplit(" ", 1)[1] == "1"
+        finally:
+            server.stop()
+
+    def test_action_start_stop_controls_sampler(self):
+        prof = StackProfiler(interval_seconds=0.001)
+        server = HealthServer(port=0, profiler=prof)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/profile?action=start")
+            assert status == 200
+            assert json.loads(body)["enabled"] is True
+            assert prof.enabled
+            status, body = _get(port, "/debug/profile?action=stop")
+            assert status == 200
+            assert json.loads(body)["enabled"] is False
+            assert not prof.enabled
+            assert _get(port, "/debug/profile?action=bogus")[0] == 400
+        finally:
+            prof.stop()
+            server.stop()
+
+    def test_404_when_no_profiler_wired(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/profile")[0] == 404
+        finally:
+            server.stop()
+
+
+class TestDebugLoopsEndpoint:
+    def test_rollup_document_behind_bearer_gate(self):
+        reg = LoopHealthRegistry()
+        reg.register("ep-loop", lambda: {"busy_fraction": 0.25})
+        store = KubeStore()
+        q = store.watch({"Pod"}, name="ep-watcher")
+        server = HealthServer(
+            port=0,
+            metrics_token="s3cret",
+            loops_fn=lambda: reg.payload(store=store),
+        )
+        port = server.start()
+        try:
+            assert _get(port, "/debug/loops")[0] == 401
+            status, body = _get(port, "/debug/loops", "s3cret")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["loops"]["ep-loop"] == {"busy_fraction": 0.25}
+            assert doc["watchers"]["ep-watcher"]["kinds"] == ["Pod"]
+            assert "metrics" in doc
+        finally:
+            store.stop_watch(q)
+            server.stop()
+
+    def test_404_when_no_loops_fn_wired(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/loops")[0] == 404
+        finally:
+            server.stop()
+
+
+class TestDebugIndex:
+    def test_index_lists_both_when_wired(self):
+        server = HealthServer(
+            port=0,
+            profiler=StackProfiler(),
+            loops_fn=lambda: {"loops": {}},
+        )
+        port = server.start()
+        try:
+            endpoints = json.loads(_get(port, "/debug/")[1])["endpoints"]
+            assert "/debug/profile" in endpoints
+            assert "/debug/loops" in endpoints
+        finally:
+            server.stop()
+
+    def test_index_omits_both_when_absent(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            endpoints = json.loads(_get(port, "/debug/")[1])["endpoints"]
+            assert "/debug/profile" not in endpoints
+            assert "/debug/loops" not in endpoints
+        finally:
+            server.stop()

@@ -1,0 +1,80 @@
+"""BusyMeter windowing and the LoopHealthRegistry /debug/loops payload."""
+import pytest
+
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.util import metrics
+from nos_tpu.util.loop_health import LOOPS, BusyMeter, LoopHealthRegistry
+
+
+class TestBusyMeter:
+    def test_gauge_updates_once_window_fills(self):
+        meter = BusyMeter("test-loop-a")
+        meter.record(0.3, idle_s=0.3)  # window not yet full
+        assert meter.snapshot()["busy_fraction"] == 0.0
+        meter.record(0.2, idle_s=0.3)  # total 1.1s -> window closes at 0.5/1.1
+        snap = meter.snapshot()
+        assert snap["busy_fraction"] == pytest.approx(0.4545, abs=1e-3)
+        assert snap["iterations"] == 2
+        rendered = metrics.REGISTRY.render()
+        assert 'nos_tpu_controller_busy_fraction{loop="test-loop-a"}' in rendered
+
+    def test_idle_only_iterations_not_counted(self):
+        meter = BusyMeter("test-loop-b")
+        meter.record(0.0, idle_s=0.6)
+        meter.record(0.0, idle_s=0.6)
+        snap = meter.snapshot()
+        assert snap["iterations"] == 0
+        assert snap["busy_fraction"] == 0.0
+
+    def test_saturated_loop_reads_one(self):
+        meter = BusyMeter("test-loop-c")
+        meter.record(1.2, idle_s=0.0)
+        assert meter.snapshot()["busy_fraction"] == 1.0
+
+
+class TestLoopHealthRegistry:
+    def test_register_payload_unregister(self):
+        reg = LoopHealthRegistry()
+        reg.register("loop-x", lambda: {"busy_fraction": 0.5})
+        assert reg.names() == ["loop-x"]
+        doc = reg.payload()
+        assert doc["loops"]["loop-x"] == {"busy_fraction": 0.5}
+        reg.unregister("loop-x")
+        assert reg.names() == []
+        assert reg.payload()["loops"] == {}
+
+    def test_failing_stats_fn_reports_error_not_raises(self):
+        reg = LoopHealthRegistry()
+
+        def boom():
+            raise RuntimeError("dead loop")
+
+        reg.register("loop-y", boom)
+        doc = reg.payload()
+        assert doc["loops"]["loop-y"] == {"error": "RuntimeError: dead loop"}
+
+    def test_payload_includes_store_watch_stats(self):
+        reg = LoopHealthRegistry()
+        store = KubeStore()
+        q = store.watch({"Pod"}, name="payload-watcher")
+        try:
+            doc = reg.payload(store=store)
+            assert doc["watchers"]["payload-watcher"] == {
+                "kinds": ["Pod"],
+                "depth": 0,
+            }
+        finally:
+            store.stop_watch(q)
+
+    def test_payload_metrics_filtered_to_saturation_families(self):
+        reg = LoopHealthRegistry()
+        BusyMeter("filter-loop").record(1.5)  # publish a gauge point
+        doc = reg.payload()
+        assert any(
+            k.startswith("nos_tpu_controller_busy_fraction") for k in doc["metrics"]
+        )
+        # Unrelated families (e.g. plans applied) stay out of the rollup.
+        assert not any(k.startswith("nos_tpu_plans") for k in doc["metrics"])
+
+    def test_module_singleton_exists(self):
+        assert isinstance(LOOPS, LoopHealthRegistry)

@@ -1,0 +1,241 @@
+"""StackProfiler: registration filtering, bounded aggregation, phase
+attribution through tracing spans, start/stop lifecycle races."""
+import threading
+import time
+
+import pytest
+
+from nos_tpu.util.profiling import PROFILER, StackProfiler
+from nos_tpu.util.tracing import TRACER
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    TRACER.reset()
+    TRACER.enabled = True
+    yield
+    TRACER.reset()
+    TRACER.enabled = True
+
+
+def _hold(event: threading.Event, ready: threading.Event):
+    ready.set()
+    event.wait(5.0)
+
+
+class TestRegistration:
+    def test_only_registered_threads_are_sampled(self):
+        prof = StackProfiler()
+        release, ready = threading.Event(), threading.Event()
+        bystander = threading.Thread(target=_hold, args=(release, ready), daemon=True)
+        bystander.start()
+        ready.wait(2.0)
+        try:
+            prof.register_thread(name="me")
+            sampled = prof.sample_once()
+            assert sampled == 1  # the bystander thread is invisible
+            collapsed = prof.collapsed()
+            assert "me;" in collapsed
+            assert threading.current_thread().name in ("MainThread", "me") or True
+            # every line belongs to the registered thread
+            for line in collapsed.strip().splitlines():
+                assert line.startswith("me;")
+        finally:
+            release.set()
+            prof.unregister_thread()
+
+    def test_unregister_stops_sampling(self):
+        prof = StackProfiler()
+        ident = prof.register_thread(name="gone")
+        assert prof.sample_once() == 1
+        prof.unregister_thread(ident)
+        assert prof.sample_once() == 0
+
+    def test_registered_context_manager(self):
+        prof = StackProfiler()
+        with prof.registered("scoped"):
+            assert prof.sample_once() == 1
+        assert prof.sample_once() == 0
+
+    def test_dead_thread_yields_no_sample(self):
+        prof = StackProfiler()
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+        prof.register_thread(name="dead", ident=t.ident)
+        assert prof.sample_once() == 0
+
+
+class TestBoundedTable:
+    def test_overflow_increments_drop_counter_not_table(self):
+        prof = StackProfiler()
+        prof.max_stacks = 2
+        prof.register_thread(name="t")
+        # Three distinct stacks: vary the call depth.
+        def depth1():
+            prof.sample_once()
+
+        def depth2():
+            depth1()
+
+        def depth3():
+            depth2()
+
+        depth1()
+        depth2()
+        depth3()
+        payload = prof.debug_payload()
+        assert payload["stacks"] <= 2
+        assert payload["dropped_stacks"] >= 1
+        assert "(table-overflow);(dropped)" in prof.collapsed()
+        # Existing keys keep counting even at capacity.
+        depth1()
+        assert prof.total_samples == 4
+
+    def test_max_depth_truncates_stacks(self):
+        prof = StackProfiler()
+        prof.max_depth = 3
+        prof.register_thread(name="t")
+        prof.sample_once()
+        for line in prof.collapsed().strip().splitlines():
+            frames = line.rsplit(" ", 1)[0].split(";")
+            assert len(frames) <= 2 + 3  # thread + phase + max_depth frames
+
+    def test_reset_clears_samples_keeps_registration(self):
+        prof = StackProfiler()
+        prof.register_thread(name="t")
+        prof.sample_once()
+        assert prof.total_samples == 1
+        prof.reset()
+        assert prof.total_samples == 0
+        assert prof.sample_once() == 1  # still registered
+
+
+class TestPhaseAttribution:
+    def test_sample_inside_span_attributes_to_span_name(self):
+        prof = StackProfiler()
+        prof.register_thread(name="t")
+        with TRACER.span("planner.plan"):
+            prof.sample_once()
+        report = prof.phase_report()
+        assert report["phases"] == {"planner.plan": 1}
+        assert report["attributed_fraction"] == 1.0
+
+    def test_innermost_span_wins_and_restores(self):
+        prof = StackProfiler()
+        prof.register_thread(name="t")
+        with TRACER.span("outer"):
+            with TRACER.span("inner"):
+                prof.sample_once()
+            prof.sample_once()
+        prof.sample_once()
+        phases = prof.phase_report()["phases"]
+        assert phases["inner"] == 1
+        assert phases["outer"] == 1
+        assert phases["(no-phase)"] == 1
+
+    def test_tracing_disabled_means_no_phase(self):
+        TRACER.enabled = False
+        prof = StackProfiler()
+        prof.register_thread(name="t")
+        with TRACER.span("invisible"):
+            prof.sample_once()
+        assert prof.phase_report()["phases"] == {"(no-phase)": 1}
+        assert prof.phase_report()["attributed_fraction"] == 0.0
+
+    def test_attach_sets_phase_for_other_thread_work(self):
+        prof = StackProfiler()
+        results = {}
+
+        def worker(span):
+            prof.register_thread(name="w")
+            with TRACER.attach(span):
+                prof.sample_once()
+            results["phases"] = prof.phase_report()["phases"]
+
+        with TRACER.span("journey.root") as span:
+            t = threading.Thread(target=worker, args=(span,))
+            t.start()
+            t.join()
+        assert results["phases"] == {"journey.root": 1}
+
+
+class TestLifecycle:
+    def test_start_stop_idempotent(self):
+        prof = StackProfiler(interval_seconds=0.001)
+        assert prof.start() is True
+        assert prof.start() is False  # already running
+        assert prof.enabled
+        assert prof.stop() is True
+        assert prof.stop() is False  # already stopped
+        assert not prof.enabled
+
+    def test_background_sampling_collects(self):
+        prof = StackProfiler(interval_seconds=0.001)
+        prof.register_thread(name="main")
+        prof.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while prof.total_samples < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            prof.stop()
+        assert prof.total_samples >= 5
+        assert prof.overhead_fraction() < 0.5  # sane accounting
+
+    def test_concurrent_start_stop_races_are_safe(self):
+        prof = StackProfiler(interval_seconds=0.001)
+        prof.register_thread(name="main")
+        errors = []
+
+        def churn():
+            try:
+                for _ in range(20):
+                    prof.start()
+                    prof.stop()
+            except Exception as exc:  # pragma: no cover - the assertion
+                errors.append(exc)
+
+        threads = [threading.Thread(target=churn) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        prof.stop()
+        assert not errors
+        assert not prof.enabled
+
+    def test_module_singleton_exists(self):
+        assert isinstance(PROFILER, StackProfiler)
+
+
+class TestReporting:
+    def test_top_ranks_leaf_frames(self):
+        prof = StackProfiler()
+        prof.register_thread(name="t")
+        for _ in range(3):
+            prof.sample_once()
+        top = prof.top(5)
+        assert top
+        assert top[0]["samples"] >= 1
+        assert 0 < top[0]["fraction"] <= 1.0
+
+    def test_debug_payload_shape(self):
+        prof = StackProfiler()
+        prof.register_thread(name="t")
+        prof.sample_once()
+        payload = prof.debug_payload()
+        for key in (
+            "enabled",
+            "interval_seconds",
+            "threads",
+            "stacks",
+            "dropped_stacks",
+            "overhead_fraction",
+            "total_samples",
+            "attributed_fraction",
+            "phases",
+            "top",
+        ):
+            assert key in payload
+        assert payload["threads"] == ["t"]
