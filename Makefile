@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke incluster-e2e kind-e2e bench bench-planner bench-store examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -41,6 +41,12 @@ replay-smoke:
 # recorded observes replay with zero drift.
 capacity-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/capacity -q -m 'not slow'
+
+# Serving-SLO gate: seed-pinned open-loop driver run on the tiny CPU
+# model — deterministic BENCH_serve.json shape, SLO verdicts stable
+# across two runs, TTFT stamping and burn-rate math vs fixtures.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/slo -q -m 'not slow'
 
 # Chaos tier-1 gate: one fixed seed through the full suite under fault
 # injection — must converge, replay clean, and fire a byte-identical
@@ -83,6 +89,13 @@ bench-planner:
 # BENCH_store.json for the committed numbers.
 bench-store:
 	JAX_PLATFORMS=cpu $(PY) bench_store.py --output BENCH_store.json
+
+# Open-loop serving workload (seeded Poisson arrivals, hot/cold model
+# skew, diurnal shaping) against the continuous-batching engine on a
+# virtual cost-model clock: TTFT/TPOT/e2e percentiles, goodput, and SLO
+# verdicts, bit-stable at the pinned seed. See BENCH_serve.json.
+bench-serve:
+	JAX_PLATFORMS=cpu $(PY) bench_serve.py --output BENCH_serve.json
 
 ## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
 ## for real chips) -------------------------------------------------------

@@ -1,5 +1,11 @@
 from nos_tpu.serve.engine import Engine, GenRequest  # noqa: F401
 from nos_tpu.serve.spec_engine import SpecEngine  # noqa: F401
+from nos_tpu.serve.telemetry import (  # noqa: F401
+    RequestRecord,
+    ServeClock,
+    ServeTelemetry,
+    VirtualServeClock,
+)
 from nos_tpu.serve.sharded import (  # noqa: F401
     kv_cache_sharding,
     shard_for_serving,

@@ -42,6 +42,7 @@ from nos_tpu.models.generate import (
     prefill,
 )
 from nos_tpu.models.llama import LlamaConfig
+from nos_tpu.serve.telemetry import ServeClock, ServeTelemetry
 from nos_tpu.util import metrics
 
 # Left-pad bucket: token id that can never appear in a real prompt.
@@ -107,9 +108,17 @@ class Engine:
         mesh=None,
         rolling: bool = False,
         kv_quant: bool = False,
+        model: str = "default",
+        telemetry: Optional[ServeTelemetry] = None,
+        clock: Optional[ServeClock] = None,
     ) -> None:
         self.params = params
         self.config = config
+        # Per-request observability (serve/telemetry.py): journey spans +
+        # submit/admit/first-token/retire stamps + latency histograms.
+        # ``model`` labels this replica's series; ``clock`` swaps the
+        # wall clock for a virtual one (the deterministic bench driver).
+        self.telemetry = telemetry or ServeTelemetry(model=model, clock=clock)
         # Tensor-parallel serving (serve/sharded.py): params arrive
         # sharded (shard_for_serving) and the KV cache shards its head
         # axis here; everything else is ordinary SPMD propagation.
@@ -368,7 +377,13 @@ class Engine:
                 f"{self.max_len}"
             )
 
-    def submit(self, request: GenRequest) -> int:
+    def submit(
+        self, request: GenRequest, submit_at: Optional[float] = None
+    ) -> int:
+        """Enqueue a request. ``submit_at`` back-dates the telemetry
+        submit stamp (in the engine clock's timeline) — the open-loop
+        driver stamps the request's generated ARRIVAL time so queue wait
+        reflects the workload, not the driver's hand-off loop."""
         request.id = next(self._ids)
         # Decode advances in whole chunks; a slot's physical frontier can
         # reach the admission frontier + ceil((max_new-1)/ticks)*ticks
@@ -383,8 +398,14 @@ class Engine:
         frontier = len(request.prompt) if chunked else bucket
         self._validate_submit(request, frontier + chunks * t)
         self._queue.append(request)
+        self.telemetry.on_submit(request, bucket, submit_at=submit_at)
         metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         return request.id
+
+    @property
+    def busy(self) -> bool:
+        """Anything queued or occupying a slot (the drain condition)."""
+        return bool(self._queue) or any(s is not None for s in self._slots)
 
     def _decode_params(self):
         """The param tree decode dispatches on: with stacked LoRA
@@ -506,9 +527,10 @@ class Engine:
         padded = jnp.asarray(
             [[PAD_ID] * pad + list(request.prompt)], jnp.int32
         )
-        first, first_logits, row_cache = self._prefill_for(bucket)(
-            self._admission_params(request.adapter), padded
-        )
+        with self.telemetry.prefill_span(request, bucket, "padded"):
+            first, first_logits, row_cache = self._prefill_for(bucket)(
+                self._admission_params(request.adapter), padded
+            )
         self._adapter_rows[b] = request.adapter
         self._cache = self._splice(self._cache, row_cache, jnp.asarray(b, jnp.int32))
         slot = _Slot(request=request)
@@ -556,16 +578,18 @@ class Engine:
                 entry = self._prefix_cache.get(key)
                 if entry is not None:
                     self._prefix_cache.move_to_end(key)
-                    row_cache = self._prefix_restore(row_cache, entry)
+                    with self.telemetry.prefix_restore_span(request, boundary):
+                        row_cache = self._prefix_restore(row_cache, entry)
                     resume = boundary
                     metrics.SERVE_PREFIX_HITS.inc()
                     metrics.SERVE_PREFIX_TOKENS_REUSED.inc(boundary)
                     break
                 boundary -= n
-        logits, row_cache = self._ingest_pieces(
-            self._ingest, self._admission_params(request.adapter),
-            row_cache, prompt, n, resume,
-        )
+        with self.telemetry.prefill_span(request, length - resume, "chunked"):
+            logits, row_cache = self._ingest_pieces(
+                self._ingest, self._admission_params(request.adapter),
+                row_cache, prompt, n, resume,
+            )
         self._adapter_rows[b] = request.adapter
         if self.prefix_cache_entries > 0:
             store_at = ((length - 1) // n) * n
@@ -664,6 +688,11 @@ class Engine:
         """Append one token; marks (but does not free) a finished slot —
         chunk processing frees at the boundary."""
         slot = self._slots[b]
+        if not slot.out:
+            # TTFT stamps HERE — when the token reaches the host — not at
+            # admission: a deferred first token rides the round's decode
+            # chunk and honestly pays that sync's latency.
+            self.telemetry.on_first_token(slot.request)
         slot.out.append(token)
         req = slot.request
         if req.on_token is not None:
@@ -688,7 +717,9 @@ class Engine:
         host-side)."""
         for b in range(self.slots_n):
             if self._slots[b] is None and self._queue:
-                self._admit(b, self._queue.pop(0))
+                request = self._queue.pop(0)
+                with self.telemetry.admit_span(request):
+                    self._admit(b, request)
         deferred: List[tuple] = []
         if self._pending_first and self._must_resolve_eagerly():
             self._resolve_admissions()
@@ -708,51 +739,56 @@ class Engine:
             self._sync_horizon(pending_b) if chunks is None else max(1, chunks)
         )
         self.ticks += chunks
-        pos = jnp.asarray(self._pos)
-        last = jnp.asarray(self._last)
-        rope = jnp.asarray(self._rope)
-        key_valid = jnp.asarray(self._key_valid)
-        for b, tok in deferred:
-            # Traced scalar index: ONE compiled set-program serves every
-            # slot and admission count (a vectorized stack/scatter would
-            # compile per distinct admission count — on tunneled
-            # backends each new executable costs whole seconds).
-            last = last.at[jnp.asarray(b, jnp.int32)].set(tok)
-        admit_last = last
-        tok_chunks = []
-        if (self._temp > 0).any():
-            temp = jnp.asarray(self._temp)
-            topk = jnp.asarray(self._topk)
-            topp = jnp.asarray(self._topp)
-            keys = self._row_keys
-            dec_params = self._decode_params()
-            for _ in range(chunks):
-                toks, self._cache, pos, last, rope, keys = self._decode_sampled(
-                    dec_params, self._cache, pos, last, rope,
-                    key_valid, temp, topk, topp, keys,
-                )
-                tok_chunks.append(toks)
-            self._row_keys = keys
-        else:
-            dec_params = self._decode_params()
-            for _ in range(chunks):
-                toks, self._cache, pos, last, rope = self._decode_greedy(
-                    dec_params, self._cache, pos, last, rope, key_valid,
-                )
-                tok_chunks.append(toks)
-        # ONE transfer for the whole round: the chunk token arrays (and
-        # any deferred admission firsts) come back in a single
-        # device_get — no on-device concat (that would compile a new
-        # program per distinct chunk count).
-        if deferred:
-            first_row, *np_chunks = jax.device_get([admit_last] + tok_chunks)
-            for b, _ in deferred:
-                self._emit(b, int(first_row[b]))
-        else:
-            np_chunks = jax.device_get(tok_chunks)
+        active_slots = sum(1 for s in self._slots if s is not None)
+        with self.telemetry.decode_span(chunks, active_slots):
+            pos = jnp.asarray(self._pos)
+            last = jnp.asarray(self._last)
+            rope = jnp.asarray(self._rope)
+            key_valid = jnp.asarray(self._key_valid)
+            for b, tok in deferred:
+                # Traced scalar index: ONE compiled set-program serves every
+                # slot and admission count (a vectorized stack/scatter would
+                # compile per distinct admission count — on tunneled
+                # backends each new executable costs whole seconds).
+                last = last.at[jnp.asarray(b, jnp.int32)].set(tok)
+            admit_last = last
+            tok_chunks = []
+            if (self._temp > 0).any():
+                temp = jnp.asarray(self._temp)
+                topk = jnp.asarray(self._topk)
+                topp = jnp.asarray(self._topp)
+                keys = self._row_keys
+                dec_params = self._decode_params()
+                for _ in range(chunks):
+                    toks, self._cache, pos, last, rope, keys = self._decode_sampled(
+                        dec_params, self._cache, pos, last, rope,
+                        key_valid, temp, topk, topp, keys,
+                    )
+                    tok_chunks.append(toks)
+                self._row_keys = keys
+            else:
+                dec_params = self._decode_params()
+                for _ in range(chunks):
+                    toks, self._cache, pos, last, rope = self._decode_greedy(
+                        dec_params, self._cache, pos, last, rope, key_valid,
+                    )
+                    tok_chunks.append(toks)
+            # ONE transfer for the whole round: the chunk token arrays (and
+            # any deferred admission firsts) come back in a single
+            # device_get — no on-device concat (that would compile a new
+            # program per distinct chunk count).
+            if deferred:
+                first_row, *np_chunks = jax.device_get([admit_last] + tok_chunks)
+            else:
+                first_row = None
+                np_chunks = jax.device_get(tok_chunks)
         tokens = np.concatenate(np_chunks)  # [chunks * ticks_per_sync, B]
         ticks = tokens.shape[0]
-        active_slots = sum(1 for s in self._slots if s is not None)
+        # Clock cost BEFORE any emit: deferred first tokens only reached
+        # the host in this round's pull, so their TTFT includes it.
+        self.telemetry.on_decode_ticks(ticks)
+        for b, _ in deferred:
+            self._emit(b, int(first_row[b]))
         metrics.SERVE_TICKS.inc(ticks)
         metrics.SERVE_SLOT_TICKS_ACTIVE.inc(ticks * active_slots)
         metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
@@ -780,6 +816,7 @@ class Engine:
         slot = self._slots[b]
         if slot is not None and slot.done:
             self._done.append(Completion(id=slot.request.id, tokens=slot.out))
+            self.telemetry.on_retire(slot.request, len(slot.out))
             metrics.SERVE_REQUESTS.inc()
             metrics.SERVE_TOKENS.inc(len(slot.out))
             self._slots[b] = None

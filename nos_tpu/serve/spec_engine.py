@@ -122,7 +122,9 @@ class SpecEngine(Engine):
 
     # ---------------------------------------------------------- frontend
 
-    def submit(self, request: GenRequest) -> int:
+    def submit(
+        self, request: GenRequest, submit_at: "float | None" = None
+    ) -> int:
         if request.temperature > 0:
             raise ValueError(
                 "speculative acceptance is defined against the target's "
@@ -133,6 +135,9 @@ class SpecEngine(Engine):
             request, len(request.prompt) + request.max_new_tokens + self.k + 1
         )
         self._queue.append(request)
+        self.telemetry.on_submit(
+            request, self._bucket(len(request.prompt)), submit_at=submit_at
+        )
         metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         return request.id
 
@@ -153,9 +158,10 @@ class SpecEngine(Engine):
         prompt = list(request.prompt)
         n = min(self.prefill_chunk, self._bucket(len(prompt)))
         row = init_kv_cache(self.d_config, 1, self.max_len + 1)
-        _, row = self._ingest_pieces(
-            self._d_ingest, self.d_params, row, prompt, n
-        )
+        with self.telemetry.prefill_span(request, len(prompt), "draft"):
+            _, row = self._ingest_pieces(
+                self._d_ingest, self.d_params, row, prompt, n
+            )
         self._d_cache = self._d_splice(
             self._d_cache, row, jnp.asarray(b, jnp.int32)
         )
@@ -165,7 +171,9 @@ class SpecEngine(Engine):
     def step(self, chunks: "int | None" = 1) -> None:
         for b in range(self.slots_n):
             if self._slots[b] is None and self._queue:
-                self._admit(b, self._queue.pop(0))
+                request = self._queue.pop(0)
+                with self.telemetry.admit_span(request):
+                    self._admit(b, request)
         # Speculative rounds sync every horizon anyway (counts are
         # data-dependent); admission firsts always resolve eagerly.
         self._resolve_admissions()
@@ -176,51 +184,62 @@ class SpecEngine(Engine):
         rounds = self._sync_horizon() if chunks is None else max(1, chunks)
         self.ticks += rounds
         self.rounds += rounds
-        pos = jnp.asarray(self._pos)
-        last = jnp.asarray(self._last)
-        # Idle slots must not claim MoE expert capacity (their rows are
-        # garbage); a slot finishing MID-horizon keeps its flag for the
-        # remaining chained rounds — bounded, and exact whenever
-        # capacity is overflow-free (the serving contract).
-        row_valid = jnp.asarray(
-            [s is not None and not s.done for s in self._slots]
-        )
-        outs: List[jax.Array] = []
-        counts: List[jax.Array] = []
-        for _ in range(rounds):
-            # Finished riders advance up to k+1 per round; the clamp
-            # keeps their chunk writes in-bounds (live rows never reach
-            # it by the submit-time capacity check).
-            pos = jnp.minimum(pos, self.max_len - self.k - 1)
-            (self._cache, self._d_cache, pos, last,
-             _, out, count) = self._round(
-                self._cache, self._d_cache, pos, last, row_valid
+        live = [b for b in range(self.slots_n) if self._slots[b] is not None]
+        with self.telemetry.decode_span(rounds, len(live)):
+            pos = jnp.asarray(self._pos)
+            last = jnp.asarray(self._last)
+            # Idle slots must not claim MoE expert capacity (their rows are
+            # garbage); a slot finishing MID-horizon keeps its flag for the
+            # remaining chained rounds — bounded, and exact whenever
+            # capacity is overflow-free (the serving contract).
+            row_valid = jnp.asarray(
+                [s is not None and not s.done for s in self._slots]
             )
-            outs.append(out)
-            counts.append(count)
-        pulled = jax.device_get([pos, last] + outs + counts)
+            outs: List[jax.Array] = []
+            counts: List[jax.Array] = []
+            for _ in range(rounds):
+                # Finished riders advance up to k+1 per round; the clamp
+                # keeps their chunk writes in-bounds (live rows never reach
+                # it by the submit-time capacity check).
+                pos = jnp.minimum(pos, self.max_len - self.k - 1)
+                (self._cache, self._d_cache, pos, last,
+                 _, out, count) = self._round(
+                    self._cache, self._d_cache, pos, last, row_valid
+                )
+                outs.append(out)
+                counts.append(count)
+            pulled = jax.device_get([pos, last] + outs + counts)
         pos_np, last_np = pulled[0], pulled[1]
         outs_np = pulled[2:2 + rounds]
         counts_np = pulled[2 + rounds:]
-        live = [b for b in range(self.slots_n) if self._slots[b] is not None]
+        # Virtual-clock cost: one speculative round is the decode unit.
+        self.telemetry.on_decode_ticks(rounds)
         metrics.SERVE_TICKS.inc(rounds)
         metrics.SERVE_SLOT_TICKS_ACTIVE.inc(rounds * len(live))
         metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         self._pos = pos_np.astype(np.int32).copy()
         self._rope = self._pos.copy()  # chunked path: logical == physical
         self._last = last_np.astype(np.int32).copy()
+        row_rounds = 0
+        accepted = 0
         for r in range(rounds):
             for b in live:
                 slot = self._slots[b]
                 if slot.done:
                     continue
-                self._active_row_rounds += 1
+                row_rounds += 1
                 committed = int(counts_np[r][b])
-                self._accepted_total += committed - 1
+                accepted += committed - 1
                 for j in range(committed):
                     if slot.done:
                         break
                     self._emit(b, int(outs_np[r][b, j]))
+        self._active_row_rounds += row_rounds
+        self._accepted_total += accepted
+        if row_rounds:
+            metrics.SERVE_SPEC_ROUNDS.inc(row_rounds)
+            metrics.SERVE_SPEC_DRAFT_TOKENS.inc(row_rounds * self.k)
+            metrics.SERVE_SPEC_ACCEPTED_TOKENS.inc(accepted)
         for b in live:
             self._retire(b)
         for b in range(self.slots_n):

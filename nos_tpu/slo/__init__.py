@@ -1,0 +1,19 @@
+"""Serving SLOs: declarative specs, burn-rate evaluation, workload driver."""
+from nos_tpu.slo.driver import (
+    Arrival,
+    ModelProfile,
+    OpenLoopDriver,
+    WorkloadConfig,
+    build_arrivals,
+)
+from nos_tpu.slo.engine import SLOEngine, SLOSpec
+
+__all__ = [
+    "Arrival",
+    "ModelProfile",
+    "OpenLoopDriver",
+    "SLOEngine",
+    "SLOSpec",
+    "WorkloadConfig",
+    "build_arrivals",
+]

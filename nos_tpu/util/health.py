@@ -30,6 +30,7 @@ class HealthServer:
         capacity_fn: Optional[Callable[[], dict]] = None,
         profiler: Optional[Any] = None,
         loops_fn: Optional[Callable[[], dict]] = None,
+        slo_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -52,6 +53,10 @@ class HealthServer:
         # /debug/loops -> the LoopHealthRegistry rollup (busy fractions,
         # queue depths, saturation metric families); None disables it.
         self.loops_fn = loops_fn
+        # /debug/slo -> the SLOEngine rollup (per-SLO burn rates over the
+        # fast/slow windows, compliance, error-budget remaining, recent
+        # violations with /debug/traces links); None disables it.
+        self.slo_fn = slo_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -79,6 +84,7 @@ class HealthServer:
         capacity_fn = self.capacity_fn
         profiler = self.profiler
         loops_fn = self.loops_fn
+        slo_fn = self.slo_fn
 
         # The /debug/ index: every debug surface this listener actually
         # serves, with a one-liner. Conditional entries appear only when
@@ -113,6 +119,12 @@ class HealthServer:
             debug_index["/debug/loops"] = (
                 "loop-health rollup: per-loop busy fractions, watch queue "
                 "depths, drain lag and phase-duration metric families"
+            )
+        if slo_fn is not None:
+            debug_index["/debug/slo"] = (
+                "serving SLO rollup: per-SLO fast/slow-window burn rates, "
+                "compliance, error-budget remaining, recent violations "
+                "linked into /debug/traces"
             )
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
@@ -282,6 +294,19 @@ class HealthServer:
                         return
                     self._respond(
                         200, json.dumps(loops_fn(), indent=2), "application/json"
+                    )
+                elif (
+                    path == "/debug/slo"
+                    and serve_metrics
+                    and slo_fn is not None
+                ):
+                    # Same credential as /metrics: violation entries carry
+                    # request/model identifiers and trace links.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    self._respond(
+                        200, json.dumps(slo_fn(), indent=2), "application/json"
                     )
                 elif path in ("/debug", "/debug/") and serve_metrics:
                     # Bearer-gated like every endpoint it links to — the

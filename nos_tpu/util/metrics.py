@@ -442,6 +442,77 @@ SERVE_SLOTS = REGISTRY.gauge(
     "nos_tpu_serve_slots", "Configured slot count (the occupancy denominator)"
 )
 
+# Per-request serving latency (serve/telemetry.py): observed at retire
+# from the request's journey stamps, labeled model/adapter/bucket so tail
+# latency decomposes by tenant and prompt-length class. Stamps come from
+# the engine's ServeClock — wall time live, virtual time under the
+# deterministic bench driver (slo/driver.py).
+_SERVE_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+SERVE_TTFT = REGISTRY.histogram(
+    "nos_tpu_serve_ttft_seconds",
+    "Time to first token: submit to the first token EMITTED to the host "
+    "(includes queue wait, prefill, and — under deferred admission "
+    "resolution — the first decode chunk's sync) "
+    "(by model, adapter, bucket)",
+    buckets=_SERVE_LATENCY_BUCKETS,
+)
+SERVE_TPOT = REGISTRY.histogram(
+    "nos_tpu_serve_tpot_seconds",
+    "Time per output token: (last token - first token) / (tokens - 1); "
+    "single-token completions do not observe (by model, adapter, bucket)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+SERVE_E2E = REGISTRY.histogram(
+    "nos_tpu_serve_e2e_seconds",
+    "End-to-end request latency, submit to retire "
+    "(by model, adapter, bucket)",
+    buckets=_SERVE_LATENCY_BUCKETS,
+)
+SERVE_QUEUE_WAIT = REGISTRY.histogram(
+    "nos_tpu_serve_queue_wait_seconds",
+    "Submit-to-admission wait for a free slot (by model, adapter, bucket)",
+    buckets=_SERVE_LATENCY_BUCKETS,
+)
+SERVE_REQUEST_TOKENS_PER_S = REGISTRY.histogram(
+    "nos_tpu_serve_request_tokens_per_second",
+    "Per-request decode throughput: tokens / e2e latency "
+    "(by model, adapter, bucket)",
+    buckets=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0),
+)
+SERVE_GOODPUT_REQUESTS = REGISTRY.counter(
+    "nos_tpu_serve_goodput_requests_total",
+    "Completed requests by latency verdict (verdict=good|late: good met "
+    "the engine's per-request TTFT/e2e targets, typically derived from "
+    "the SLO specs) (by model)",
+)
+SERVE_GOODPUT_TOKENS = REGISTRY.counter(
+    "nos_tpu_serve_goodput_tokens_total",
+    "Tokens from requests that met their latency targets — the goodput "
+    "numerator next to nos_tpu_serve_tokens_total's raw throughput "
+    "(by model)",
+)
+
+# Speculative decoding (serve/spec_engine.py): acceptance telemetry. The
+# accept RATE is accepted/proposed; tokens-per-round parity with
+# stats()['mean_accepted'] is accepted/rounds over active row-rounds.
+SERVE_SPEC_ROUNDS = REGISTRY.counter(
+    "nos_tpu_serve_spec_rounds_total",
+    "Speculative rounds executed per active row (row-rounds): each "
+    "drafts k tokens and commits 1..k+1",
+)
+SERVE_SPEC_DRAFT_TOKENS = REGISTRY.counter(
+    "nos_tpu_serve_spec_draft_tokens_total",
+    "Draft tokens proposed to the target verifier (k per active "
+    "row-round)",
+)
+SERVE_SPEC_ACCEPTED_TOKENS = REGISTRY.counter(
+    "nos_tpu_serve_spec_accepted_tokens_total",
+    "Draft tokens the target accepted (committed - 1 per active "
+    "row-round; the bonus token is not a draft acceptance)",
+)
+
 # Flight recorder / invariant auditor (record/).
 AUDIT_VIOLATIONS = REGISTRY.counter(
     "nos_tpu_audit_violations_total",

@@ -1,0 +1,213 @@
+"""Request-journey telemetry on the real engine: TTFT stamping semantics
+(first *emitted* token, not admission), prefix-restore accounting, queue
+wait, goodput verdicts, and the journey span taxonomy.
+
+All on the virtual cost clock (tick = 8 ms, prefill token = 0.2 ms) with
+explicit ``step(chunks=1)`` rounds, so every latency is exact arithmetic:
+a deferred first token pays its prefill PLUS the first decode chunk's
+sync; an eagerly-resolved one (budget 1) pays only its prefill.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.serve.engine import Engine, GenRequest
+from nos_tpu.serve.telemetry import ServeTelemetry, VirtualServeClock
+from nos_tpu.util import metrics
+from nos_tpu.util.tracing import TRACER
+
+TICK = 0.008
+TOK = 0.0002
+TICKS_PER_SYNC = 4
+CHUNK_S = TICKS_PER_SYNC * TICK  # one decode chunk between syncs
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = tiny_config(dtype=jnp.float32)
+    params = init_llama_params(jax.random.key(0), config)
+    return config, params
+
+
+@pytest.fixture(scope="module")
+def engine(setup):
+    config, params = setup
+    telemetry = ServeTelemetry(model="tm", clock=VirtualServeClock())
+    return Engine(
+        params, config, max_slots=2, max_len=128,
+        ticks_per_sync=TICKS_PER_SYNC, prefill_chunk=16,
+        model="tm", telemetry=telemetry,
+    )
+
+
+def drain(engine):
+    while engine.busy:
+        engine.step(chunks=1)
+
+
+def prompt_of(n):
+    return [(i % 50) + 1 for i in range(n)]
+
+
+class TestTTFTStamping:
+    def test_deferred_first_token_pays_the_decode_chunk(self, engine):
+        # 20-token prompt > prefill_chunk -> chunked admission; budget > 1
+        # and no eos -> the first token defers into the round's single
+        # end-of-chunk pull. TTFT = 20 * 0.2ms prefill + one 4-tick chunk.
+        rid = engine.submit(GenRequest(prompt=prompt_of(20), max_new_tokens=6))
+        drain(engine)
+        rec = engine.telemetry.record(rid)
+        assert rec.queue_wait_s == pytest.approx(0.0, abs=1e-12)
+        assert rec.ttft_s == pytest.approx(20 * TOK + CHUNK_S)
+        # Budget 6 = deferred first + 4 chunk tokens + 1 from a second
+        # chunk: retire exactly one chunk after the first token.
+        assert rec.tokens == 6
+        assert rec.e2e_s == pytest.approx(20 * TOK + 2 * CHUNK_S)
+        assert rec.tpot_s == pytest.approx(CHUNK_S / 5)
+
+    def test_eager_first_token_is_prefill_only(self, engine):
+        # Budget 1 forces eager resolution: the admission's token is
+        # pulled BEFORE any decode chunk runs, so TTFT excludes tick cost.
+        rid = engine.submit(GenRequest(prompt=prompt_of(20), max_new_tokens=1))
+        drain(engine)
+        rec = engine.telemetry.record(rid)
+        assert rec.ttft_s == pytest.approx(20 * TOK)
+        assert rec.tokens == 1
+        assert rec.tpot_s == 0.0
+        assert rec.retire_t >= rec.first_token_t
+
+    def test_padded_prefill_costs_the_bucket(self, engine):
+        # Short prompt takes the left-padded path: prefill runs the
+        # whole pow2 bucket, and the cost model charges what actually ran.
+        rid = engine.submit(GenRequest(prompt=prompt_of(5), max_new_tokens=3))
+        bucket = engine.telemetry.record(rid).bucket
+        assert bucket <= 16  # padded path, not chunked
+        drain(engine)
+        rec = engine.telemetry.record(rid)
+        assert rec.ttft_s == pytest.approx(bucket * TOK + CHUNK_S)
+
+    def test_queue_wait_measured_for_the_request_that_waited(self, engine):
+        # 3 requests into 2 slots: the third queues until a slot frees at
+        # the first chunk boundary; its wait is real clock time, and its
+        # TTFT includes it implicitly (submit -> first token).
+        rids = [
+            engine.submit(GenRequest(prompt=prompt_of(20), max_new_tokens=4))
+            for _ in range(3)
+        ]
+        drain(engine)
+        recs = [engine.telemetry.record(r) for r in rids]
+        assert recs[0].queue_wait_s == pytest.approx(0.0, abs=1e-12)
+        # Second admits in the same round, after the first's prefill.
+        assert recs[1].queue_wait_s == pytest.approx(20 * TOK)
+        assert recs[2].queue_wait_s >= CHUNK_S  # waited out a full chunk
+        assert recs[2].ttft_s >= recs[2].queue_wait_s + 20 * TOK
+
+
+class TestPrefixRestoreTTFT:
+    def test_prefix_hit_shrinks_ttft_and_is_traced(self, setup):
+        config, params = setup
+        telemetry = ServeTelemetry(model="pm", clock=VirtualServeClock())
+        engine = Engine(
+            params, config, max_slots=2, max_len=128,
+            ticks_per_sync=TICKS_PER_SYNC, prefill_chunk=16,
+            prefix_cache_entries=2, model="pm", telemetry=telemetry,
+        )
+        prompt = prompt_of(20)
+        reused_before = metrics.SERVE_PREFIX_TOKENS_REUSED.value
+
+        cold = engine.submit(GenRequest(prompt=list(prompt), max_new_tokens=4))
+        drain(engine)
+        hit = engine.submit(GenRequest(prompt=list(prompt), max_new_tokens=4))
+        drain(engine)
+
+        cold_rec = telemetry.record(cold)
+        hit_rec = telemetry.record(hit)
+        # Cold: full 20-token ingest. Hit: 16 tokens restored from cache
+        # (the chunk-boundary prefix), only the 4-token tail re-ingested.
+        assert cold_rec.ttft_s == pytest.approx(20 * TOK + CHUNK_S)
+        assert hit_rec.ttft_s == pytest.approx(4 * TOK + CHUNK_S)
+        assert hit_rec.ttft_s < cold_rec.ttft_s
+        assert metrics.SERVE_PREFIX_TOKENS_REUSED.value - reused_before == 16
+
+        # The journey shows the restore: a serve.prefix_restore span with
+        # the reused token count, alongside the tail's serve.prefill.
+        trace = TRACER.store.get(hit_rec.trace_id)
+        assert trace is not None
+        by_name = {}
+        for span in trace.spans:
+            by_name.setdefault(span.name, []).append(span)
+        assert by_name["serve.prefix_restore"][0].attributes["reused_tokens"] == 16
+        assert by_name["serve.prefill"][0].attributes["tokens"] == 4
+        # And the cold journey has no restore span.
+        cold_trace = TRACER.store.get(cold_rec.trace_id)
+        assert all(s.name != "serve.prefix_restore" for s in cold_trace.spans)
+
+
+class TestJourneySpans:
+    def test_full_stage_taxonomy(self, engine):
+        rid = engine.submit(GenRequest(prompt=prompt_of(20), max_new_tokens=4))
+        drain(engine)
+        rec = engine.telemetry.record(rid)
+        trace = TRACER.store.get(rec.trace_id)
+        assert trace is not None
+        names = {s.name for s in trace.spans}
+        assert {
+            "serve.request", "serve.submit", "serve.queue", "serve.admit",
+            "serve.prefill", "serve.decode", "serve.retire",
+        } <= names
+        root = trace.root
+        assert root.name == "serve.request"
+        assert root.status == "ok"
+        assert root.attributes["request"] == rid
+        assert root.attributes["tokens"] == 4
+        assert root.attributes["ttft_s"] == pytest.approx(
+            rec.ttft_s, abs=1e-6
+        )
+        # Stage spans nest under the journey root (Dapper-style), so the
+        # trace summary decomposes the request's wall time by stage.
+        stages = trace.summary()["stages"]
+        assert "serve.queue" in stages and "serve.admit" in stages
+
+    def test_record_survives_in_completed_ring(self, engine):
+        rid = engine.submit(GenRequest(prompt=prompt_of(8), max_new_tokens=2))
+        drain(engine)
+        assert rid in engine.telemetry.completed
+        assert engine.telemetry.record(rid).tokens == 2
+
+
+class TestGoodputAndHistograms:
+    def test_late_request_counts_against_goodput(self, engine):
+        telemetry = engine.telemetry
+        late_before = metrics.SERVE_GOODPUT_REQUESTS.labels(
+            model="tm", verdict="late"
+        ).value
+        good_before = metrics.SERVE_GOODPUT_REQUESTS.labels(
+            model="tm", verdict="good"
+        ).value
+        telemetry.ttft_target_s = 1e-6  # unmeetable: one chunk > 1 us
+        try:
+            rid = engine.submit(
+                GenRequest(prompt=prompt_of(20), max_new_tokens=4)
+            )
+            drain(engine)
+        finally:
+            telemetry.ttft_target_s = None
+        assert telemetry.record(rid).good is False
+        late = metrics.SERVE_GOODPUT_REQUESTS.labels(model="tm", verdict="late")
+        good = metrics.SERVE_GOODPUT_REQUESTS.labels(model="tm", verdict="good")
+        assert late.value - late_before == 1
+        assert good.value == good_before
+
+    def test_latency_histograms_labeled_by_model_and_bucket(self, engine):
+        rid = engine.submit(GenRequest(prompt=prompt_of(20), max_new_tokens=4))
+        drain(engine)
+        rec = engine.telemetry.record(rid)
+        labels = dict(model="tm", adapter="0", bucket=str(rec.bucket))
+        ttft = metrics.SERVE_TTFT.labels(**labels)
+        assert ttft.count > 0
+        rendered = metrics.REGISTRY.render()
+        assert 'nos_tpu_serve_ttft_seconds_count{adapter="0"' in rendered
+        assert "nos_tpu_serve_tpot_seconds" in rendered
+        assert "nos_tpu_serve_queue_wait_seconds" in rendered
+        assert "nos_tpu_serve_goodput_tokens_total" in rendered

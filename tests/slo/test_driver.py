@@ -1,0 +1,174 @@
+"""Open-loop driver: arrival-schedule purity, percentile math, and a
+small seed-pinned drive of the real engine on the virtual clock."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nos_tpu.models.llama import init_llama_params, tiny_config
+from nos_tpu.serve.engine import Engine
+from nos_tpu.serve.telemetry import ServeTelemetry, VirtualServeClock
+from nos_tpu.slo.driver import (
+    ModelProfile,
+    OpenLoopDriver,
+    WorkloadConfig,
+    build_arrivals,
+    percentiles,
+)
+
+
+class TestBuildArrivals:
+    def test_pure_function_of_config(self):
+        config = WorkloadConfig(seed=11, duration_s=20.0, rate_rps=5.0)
+        assert build_arrivals(config) == build_arrivals(config)
+        other = WorkloadConfig(seed=12, duration_s=20.0, rate_rps=5.0)
+        assert build_arrivals(other) != build_arrivals(config)
+
+    def test_bounds_and_ordering(self):
+        config = WorkloadConfig(
+            seed=3, duration_s=10.0, rate_rps=20.0, vocab=64,
+            models=(ModelProfile(name="m", prompt_tokens=(4, 9),
+                                 max_new_tokens=(2, 5)),),
+        )
+        arrivals = build_arrivals(config)
+        assert arrivals  # ~200 expected; at least some
+        times = [a.t for a in arrivals]
+        assert times == sorted(times)
+        assert all(0.0 <= t < 10.0 for t in times)
+        for a in arrivals:
+            assert 4 <= len(a.prompt) <= 9
+            assert 2 <= a.max_new_tokens <= 5
+            assert all(0 <= tok < 64 for tok in a.prompt)
+
+    def test_mean_rate_roughly_holds(self):
+        config = WorkloadConfig(seed=0, duration_s=100.0, rate_rps=10.0)
+        n = len(build_arrivals(config))
+        # Poisson(1000): +/- 10% is ~3 sigma; the seed pins it anyway.
+        assert 900 < n < 1100
+
+    def test_hot_cold_skew(self):
+        config = WorkloadConfig(
+            seed=1, duration_s=50.0, rate_rps=10.0,
+            models=(
+                ModelProfile(name="hot", weight=0.8),
+                ModelProfile(name="cold", weight=0.2),
+            ),
+        )
+        arrivals = build_arrivals(config)
+        hot = sum(1 for a in arrivals if a.model == "hot")
+        cold = len(arrivals) - hot
+        assert hot > 3 * cold > 0
+
+    def test_diurnal_shaping_moves_mass_to_the_peak(self):
+        # amplitude 1, period = duration: rate(t) rides a full sine —
+        # above the mean in the first half, below in the second.
+        config = WorkloadConfig(
+            seed=2, duration_s=40.0, rate_rps=10.0,
+            diurnal_amplitude=1.0, diurnal_period_s=40.0,
+        )
+        arrivals = build_arrivals(config)
+        first = sum(1 for a in arrivals if a.t < 20.0)
+        second = len(arrivals) - first
+        assert first > 1.5 * second
+
+    def test_diurnal_only_thins_never_reorders(self):
+        flat = WorkloadConfig(seed=4, duration_s=30.0, rate_rps=8.0)
+        shaped = WorkloadConfig(
+            seed=4, duration_s=30.0, rate_rps=8.0,
+            diurnal_amplitude=0.5, diurnal_period_s=30.0,
+        )
+        times = [a.t for a in build_arrivals(shaped)]
+        assert times == sorted(times)
+        # Thinning at the higher peak rate changes counts, not validity.
+        assert build_arrivals(flat)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="ModelProfile"):
+            build_arrivals(WorkloadConfig(models=()))
+        with pytest.raises(ValueError, match="amplitude"):
+            build_arrivals(WorkloadConfig(diurnal_amplitude=1.5))
+        with pytest.raises(ValueError, match="weights"):
+            build_arrivals(
+                WorkloadConfig(models=(ModelProfile(name="m", weight=0.0),))
+            )
+
+
+class TestPercentiles:
+    def test_nearest_rank(self):
+        values = [float(i) for i in range(1, 101)]
+        assert percentiles(values) == {"p50": 50.0, "p95": 95.0, "p99": 99.0}
+
+    def test_small_samples(self):
+        assert percentiles([7.0]) == {"p50": 7.0, "p95": 7.0, "p99": 7.0}
+        assert percentiles([]) == {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+    def test_order_independent(self):
+        assert percentiles([3.0, 1.0, 2.0]) == percentiles([1.0, 2.0, 3.0])
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_config(dtype=jnp.float32)
+    params = init_llama_params(jax.random.key(0), config)
+    return config, params
+
+
+def make_engine(model, name="m"):
+    config, params = model
+    telemetry = ServeTelemetry(
+        model=name, clock=VirtualServeClock(), ttft_target_s=0.5,
+        e2e_target_s=2.0,
+    )
+    return Engine(
+        params, config, max_slots=2, max_len=128, ticks_per_sync=4,
+        prefill_chunk=16, model=name, telemetry=telemetry,
+    )
+
+
+class TestOpenLoopDriver:
+    def test_rejects_wall_clock_engine(self, model):
+        config, params = model
+        engine = Engine(params, config, max_slots=2, max_len=128)
+        workload = WorkloadConfig(models=(ModelProfile(name="default"),))
+        with pytest.raises(ValueError, match="VirtualServeClock"):
+            OpenLoopDriver({"default": engine}, workload)
+
+    def test_rejects_missing_engine(self, model):
+        workload = WorkloadConfig(models=(ModelProfile(name="nope"),))
+        with pytest.raises(ValueError, match="no engine"):
+            OpenLoopDriver({}, workload)
+
+    def test_drive_stamps_arrival_times(self, model):
+        workload = WorkloadConfig(
+            seed=5, duration_s=4.0, rate_rps=1.5, vocab=32,
+            models=(ModelProfile(name="m", prompt_tokens=(4, 10),
+                                 max_new_tokens=(3, 6)),),
+        )
+        arrivals = build_arrivals(workload)
+        assert arrivals
+        engine = make_engine(model)
+        driver = OpenLoopDriver({"m": engine}, workload)
+        report = driver.run()
+
+        # Every arrival became exactly one completed record, and the
+        # open-loop contract held: submit stamps are the *generated*
+        # arrival times, not whenever the engine got around to them.
+        records = driver.records["m"]
+        assert len(records) == len(arrivals)
+        assert sorted(r.submit_t for r in records) == pytest.approx(
+            [a.t for a in arrivals]
+        )
+        assert not engine.busy
+        for rec in records:
+            assert rec.queue_wait_s is not None and rec.queue_wait_s >= 0.0
+            assert rec.ttft_s is not None and rec.ttft_s > 0.0
+            assert rec.e2e_s >= rec.ttft_s
+            assert rec.tokens >= 1
+
+        # Report shape (no SLO engine wired -> no slo section).
+        assert set(report) == {"workload", "models", "aggregate"}
+        stats = report["models"]["m"]
+        assert stats["requests"] == len(arrivals)
+        assert stats["tokens"] == sum(r.tokens for r in records)
+        for key in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s"):
+            assert set(stats[key]) == {"p50", "p95", "p99"}
+        assert stats["goodput"]["good_requests"] <= stats["requests"]

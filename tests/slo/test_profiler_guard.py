@@ -1,0 +1,41 @@
+"""Profiler coverage of the serve loop: /debug/profile must decompose
+serve time into the engine's span phases, at <= 2% sampling overhead."""
+import pytest
+
+from nos_tpu.util.profiling import PROFILER
+
+
+@pytest.mark.slow
+def test_profiler_decomposes_serve_time_within_overhead_budget():
+    import bench_serve
+    from tests.slo.test_serve_smoke import smoke_args
+
+    PROFILER.stop()
+    PROFILER.reset()
+    assert PROFILER.start()
+    try:
+        report = bench_serve.run(smoke_args())
+        assert report["aggregate"]["requests"] > 0
+    finally:
+        PROFILER.stop()
+
+    overhead = PROFILER.overhead_fraction()
+    assert overhead <= 0.02, f"sampling overhead {overhead:.4f} > 2%"
+
+    # The driver registers each replica's drive loop, so the samples
+    # land in the serve.* phases the engine spans publish — that is the
+    # /debug/profile decomposition of serve time into admit / prefill /
+    # decode.
+    phases = PROFILER.phase_report()["phases"]
+    serve_phases = {p for p in phases if p.startswith("serve.")}
+    assert serve_phases, f"no serve.* phases in {sorted(phases)}"
+    # The decode loop dominates wall time in the smoke workload; the
+    # admission-side phases show up too across ~60 requests.
+    assert any(
+        p in serve_phases
+        for p in ("serve.batch_decode", "serve.prefill", "serve.admit")
+    ), sorted(serve_phases)
+
+    payload = PROFILER.debug_payload()
+    assert payload["attributed_fraction"] > 0.0
+    assert payload["total_samples"] > 0

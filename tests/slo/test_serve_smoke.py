@@ -1,0 +1,64 @@
+"""Seed-pinned serve-smoke gate: the open-loop bench config at smoke
+scale, run twice in-process — the two reports must be byte-identical
+(virtual clock => latencies are a pure function of the seed) and the
+SLO verdicts stable."""
+import argparse
+import json
+
+import bench_serve
+
+
+def smoke_args(seed=0, duration=20.0):
+    return argparse.Namespace(
+        seed=seed,
+        duration=duration,
+        rate=3.0,
+        slo=list(bench_serve.DEFAULT_SLOS),
+        output=None,
+    )
+
+
+def test_smoke_report_is_bit_stable_and_well_formed():
+    first = bench_serve.run(smoke_args())
+    second = bench_serve.run(smoke_args())
+    body1 = json.dumps(first, indent=2, sort_keys=True)
+    body2 = json.dumps(second, indent=2, sort_keys=True)
+    assert body1 == body2  # fresh engines, same seed -> same bytes
+
+    # BENCH_serve.json shape: workload echo, per-model + aggregate stats,
+    # SLO verdicts for every default spec.
+    assert set(first) == {"workload", "models", "aggregate", "slo"}
+    assert set(first["models"]) == {"hot", "cold"}
+    aggregate = first["aggregate"]
+    assert aggregate["requests"] > 0
+    assert aggregate["tokens"] > 0
+    assert (
+        first["models"]["hot"]["requests"]
+        > first["models"]["cold"]["requests"]
+    )
+    for key in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s"):
+        assert set(aggregate[key]) == {"p50", "p95", "p99"}
+        assert aggregate[key]["p50"] <= aggregate[key]["p99"]
+    assert aggregate["ttft_s"]["p50"] > 0.0
+    goodput = aggregate["goodput"]
+    assert 0.0 <= goodput["request_fraction"] <= 1.0
+    assert goodput["good_tokens_per_s"] > 0.0
+
+    verdicts = first["slo"]["verdicts"]
+    assert sorted(first["slo"]["specs"]) == sorted(bench_serve.DEFAULT_SLOS)
+    assert len(verdicts) == len(bench_serve.DEFAULT_SLOS)
+    for verdict in verdicts.values():
+        assert isinstance(verdict["compliant"], bool)
+        assert verdict["burn_rate_fast"] >= 0.0
+        assert verdict["burn_rate_slow"] >= 0.0
+        assert 0.0 <= verdict["error_budget_remaining"] <= 1.0
+
+
+def test_seed_changes_the_report():
+    # Not a fixed-point: a different seed yields a different arrival
+    # schedule and therefore different latencies.
+    a = bench_serve.run(smoke_args(seed=0, duration=8.0))
+    b = bench_serve.run(smoke_args(seed=1, duration=8.0))
+    assert a["aggregate"]["requests"] != b["aggregate"]["requests"] or (
+        a["aggregate"]["ttft_s"] != b["aggregate"]["ttft_s"]
+    )

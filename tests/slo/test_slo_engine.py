@@ -1,0 +1,222 @@
+"""SLO spec grammar and burn-rate window math vs hand-computed fixtures."""
+import pytest
+
+from nos_tpu.serve.telemetry import RequestRecord
+from nos_tpu.slo.engine import SLOEngine, SLOSpec
+from nos_tpu.util import metrics
+
+
+def make_record(
+    rid,
+    retire_t,
+    ttft=0.05,
+    tpot=0.005,
+    queue_wait=0.0,
+    tokens=10,
+    good=True,
+    model="m",
+    trace_id="",
+):
+    """A retired request with exact stamps: submit at retire - e2e, first
+    token at submit + ttft, e2e = ttft + tpot * (tokens - 1)."""
+    e2e = ttft + tpot * (tokens - 1)
+    submit = retire_t - e2e
+    return RequestRecord(
+        id=rid,
+        model=model,
+        adapter=0,
+        bucket=8,
+        prompt_tokens=4,
+        max_new_tokens=tokens,
+        submit_t=submit,
+        trace_id=trace_id,
+        admit_t=submit + queue_wait,
+        first_token_t=submit + ttft,
+        retire_t=retire_t,
+        tokens=tokens,
+        good=good,
+    )
+
+
+class TestSLOSpecParse:
+    def test_latency_forms(self):
+        spec = SLOSpec.parse("p95 ttft < 300ms")
+        assert spec.metric == "ttft"
+        assert spec.objective == pytest.approx(0.95)
+        assert spec.threshold_s == pytest.approx(0.3)
+        assert spec.name == "ttft_p95_lt_300ms"
+
+        spec = SLOSpec.parse("p99 e2e < 2.5s")
+        assert spec.metric == "e2e"
+        assert spec.objective == pytest.approx(0.99)
+        assert spec.threshold_s == pytest.approx(2.5)
+
+        spec = SLOSpec.parse("p50 tpot < 40ms")
+        assert spec.metric == "tpot"
+        assert spec.threshold_s == pytest.approx(0.04)
+
+        spec = SLOSpec.parse("p90 queue_wait < 1s")
+        assert spec.metric == "queue_wait"
+        assert spec.threshold_s == pytest.approx(1.0)
+
+    def test_availability_form(self):
+        spec = SLOSpec.parse("availability 99.9%")
+        assert spec.metric == "availability"
+        assert spec.objective == pytest.approx(0.999)
+        assert spec.threshold_s is None
+        assert spec.name == "availability_99.9"
+
+    def test_case_and_whitespace_tolerant(self):
+        spec = SLOSpec.parse("  P95 TTFT<300MS ")
+        assert spec.threshold_s == pytest.approx(0.3)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "p95 latency < 300ms",  # unknown metric
+            "ttft < 300ms",  # no percentile
+            "p95 ttft > 300ms",  # wrong comparator
+            "p95 ttft < 300",  # no unit
+            "availability 100%",  # no error budget at all
+            "p0 ttft < 1s",  # degenerate percentile
+            "",
+        ],
+    )
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            SLOSpec.parse(bad)
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine(["p95 ttft < 300ms", "p95 ttft < 300ms"])
+
+    def test_latency_targets_take_tightest_threshold(self):
+        engine = SLOEngine(
+            ["p95 ttft < 300ms", "p99 ttft < 900ms", "p99 e2e < 5s",
+             "availability 99%"]
+        )
+        assert engine.latency_targets() == {
+            "ttft": pytest.approx(0.3),
+            "e2e": pytest.approx(5.0),
+        }
+
+
+class TestBurnRateWindows:
+    """Hand-computed fixture: 10 requests retire at t = 1..10 s. The
+    last three (t = 8, 9, 10) have TTFT 200 ms; the rest 50 ms. Spec
+    'p90 ttft < 100ms' allows a 10% bad fraction."""
+
+    def _engine(self):
+        engine = SLOEngine(
+            ["p90 ttft < 100ms"], fast_window_s=3.0, slow_window_s=100.0
+        )
+        for i in range(1, 11):
+            engine.record(
+                make_record(i, retire_t=float(i),
+                            ttft=0.2 if i >= 8 else 0.05)
+            )
+        return engine
+
+    def test_fast_window_burn(self):
+        # Fast window (7, 10]: 3 requests, all bad -> bad fraction 1.0,
+        # burn = 1.0 / 0.1 = 10.
+        out = self._engine().evaluate(now=10.0)
+        slo = out["slos"][0]
+        assert slo["fast"] == {
+            "requests": 3, "bad": 3, "bad_fraction": 1.0, "burn_rate": 10.0,
+        }
+
+    def test_slow_window_burn_and_compliance(self):
+        # Slow window: all 10, 3 bad -> 0.3 / 0.1 = 3.0 -> non-compliant,
+        # budget fully burned.
+        out = self._engine().evaluate(now=10.0)
+        slo = out["slos"][0]
+        assert slo["slow"] == {
+            "requests": 10, "bad": 3, "bad_fraction": 0.3, "burn_rate": 3.0,
+        }
+        assert slo["compliant"] is False
+        assert slo["error_budget_remaining"] == 0.0
+
+    def test_windows_slide(self):
+        # At now = 20 the fast window (17, 20] is empty: vacuous health.
+        out = self._engine().evaluate(now=20.0)
+        slo = out["slos"][0]
+        assert slo["fast"] == {
+            "requests": 0, "bad": 0, "bad_fraction": 0.0, "burn_rate": 0.0,
+        }
+        # Slow window still sees all 10 -> verdict unchanged.
+        assert slo["slow"]["burn_rate"] == 3.0
+
+    def test_burn_exactly_one_is_compliant(self):
+        # 1 bad in 10 at a 10% budget: burn 1.0 burns the budget exactly
+        # but does not exceed it.
+        engine = SLOEngine(
+            ["p90 ttft < 100ms"], fast_window_s=3.0, slow_window_s=100.0
+        )
+        for i in range(1, 11):
+            engine.record(
+                make_record(i, retire_t=float(i),
+                            ttft=0.2 if i == 5 else 0.05)
+            )
+        slo = engine.evaluate(now=10.0)["slos"][0]
+        assert slo["slow"]["burn_rate"] == 1.0
+        assert slo["compliant"] is True
+        assert slo["error_budget_remaining"] == 0.0
+
+    def test_availability_counts_not_good(self):
+        engine = SLOEngine(
+            ["availability 90%"], fast_window_s=3.0, slow_window_s=100.0
+        )
+        for i in range(1, 11):
+            engine.record(make_record(i, retire_t=float(i), good=i != 4))
+        slo = engine.evaluate(now=10.0)["slos"][0]
+        assert slo["slow"] == {
+            "requests": 10, "bad": 1, "bad_fraction": 0.1, "burn_rate": 1.0,
+        }
+        assert slo["compliant"] is True
+
+    def test_missing_stage_is_bad(self):
+        # A request with no first token (e.g. failed before emit) is a
+        # bad event for any ttft spec — the user saw the miss.
+        engine = SLOEngine(["p90 ttft < 100ms"], slow_window_s=100.0)
+        rec = make_record(1, retire_t=1.0)
+        rec.first_token_t = None
+        engine.record(rec)
+        slo = engine.evaluate(now=1.0)["slos"][0]
+        assert slo["slow"]["bad"] == 1
+
+    def test_gauges_published(self):
+        engine = SLOEngine(
+            ["p90 ttft < 100ms"], fast_window_s=3.0, slow_window_s=100.0
+        )
+        for i in range(1, 11):
+            engine.record(
+                make_record(i, retire_t=float(i),
+                            ttft=0.2 if i >= 8 else 0.05)
+            )
+        engine.evaluate(now=10.0)
+        from nos_tpu.slo.engine import (
+            SLO_BUDGET_REMAINING, SLO_BURN_RATE, SLO_COMPLIANT,
+        )
+        name = "ttft_p90_lt_100ms"
+        assert SLO_BURN_RATE.labels(slo=name, window="fast").value == 10.0
+        assert SLO_BURN_RATE.labels(slo=name, window="slow").value == 3.0
+        assert SLO_COMPLIANT.labels(slo=name).value == 0.0
+        assert SLO_BUDGET_REMAINING.labels(slo=name).value == 0.0
+        # And they render through the registry (doc-drift names live).
+        rendered = metrics.REGISTRY.render()
+        assert "nos_tpu_slo_burn_rate" in rendered
+        assert "nos_tpu_slo_compliant" in rendered
+        assert "nos_tpu_slo_error_budget_remaining" in rendered
+
+    def test_debug_payload_links_violations_to_traces(self):
+        engine = SLOEngine(["p90 ttft < 100ms"], slow_window_s=100.0)
+        engine.record(make_record(1, retire_t=1.0, ttft=0.05, trace_id="t9"))
+        engine.record(make_record(2, retire_t=2.0, ttft=0.2, trace_id="tA"))
+        payload = engine.debug_payload()
+        assert payload["requests_seen"] == 2
+        violations = payload["recent_violations"]
+        assert len(violations) == 1
+        assert violations[0]["request"] == 2
+        assert violations[0]["slos"] == ["ttft_p90_lt_100ms"]
+        assert violations[0]["trace"] == "/debug/traces?id=tA"

@@ -1,0 +1,95 @@
+"""/debug/slo endpoint: bearer gate, burn-rate document, index entry."""
+import http.client
+import json
+
+from nos_tpu.serve.telemetry import RequestRecord, VirtualServeClock
+from nos_tpu.slo.engine import SLOEngine
+from nos_tpu.util.health import HealthServer
+
+
+def _get(port, path, token=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode()
+
+
+def _record(rid, retire_t, ttft, trace_id=""):
+    return RequestRecord(
+        id=rid, model="m", adapter=0, bucket=8, prompt_tokens=4,
+        max_new_tokens=8, submit_t=retire_t - ttft - 0.05,
+        trace_id=trace_id, admit_t=retire_t - ttft - 0.05,
+        first_token_t=retire_t - 0.05, retire_t=retire_t, tokens=8,
+        good=ttft <= 0.1,
+    )
+
+
+def _make_slo():
+    # Virtual clock pinned just past the last retire, so the endpoint's
+    # evaluate() windows cover the fixture events.
+    clock = VirtualServeClock()
+    clock.advance_to(12.0)
+    slo = SLOEngine(
+        ["p90 ttft < 100ms", "availability 99%"],
+        clock=clock, fast_window_s=60.0, slow_window_s=600.0,
+    )
+    slo.record(_record(1, 10.0, ttft=0.05))
+    slo.record(_record(2, 11.0, ttft=0.25, trace_id="tr-2"))
+    return slo
+
+
+class TestDebugSLOEndpoint:
+    def test_serves_rollup_behind_bearer_gate(self):
+        slo = _make_slo()
+        server = HealthServer(
+            port=0, metrics_token="s3cret", slo_fn=slo.debug_payload
+        )
+        port = server.start()
+        try:
+            assert _get(port, "/debug/slo")[0] == 401
+            assert _get(port, "/debug/slo", "wrong")[0] == 401
+            status, body = _get(port, "/debug/slo", "s3cret")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["requests_seen"] == 2
+            by_name = {s["slo"]: s for s in doc["slos"]}
+            ttft = by_name["ttft_p90_lt_100ms"]
+            assert ttft["slow"] == {
+                "requests": 2, "bad": 1, "bad_fraction": 0.5,
+                "burn_rate": 5.0,
+            }
+            assert ttft["compliant"] is False
+            # The violation feed links into /debug/traces by journey id.
+            assert doc["recent_violations"][0]["trace"] == (
+                "/debug/traces?id=tr-2"
+            )
+        finally:
+            server.stop()
+
+    def test_404_when_no_slo_engine_is_wired(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            assert _get(port, "/debug/slo")[0] == 404
+        finally:
+            server.stop()
+
+    def test_debug_index_lists_slo_when_wired(self):
+        server = HealthServer(port=0, slo_fn=_make_slo().debug_payload)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/")
+            assert status == 200
+            assert "/debug/slo" in json.loads(body)["endpoints"]
+        finally:
+            server.stop()
+
+    def test_debug_index_omits_slo_when_absent(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            endpoints = json.loads(_get(port, "/debug/")[1])["endpoints"]
+            assert "/debug/slo" not in endpoints
+        finally:
+            server.stop()
