@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke shard-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -47,6 +47,16 @@ capacity-smoke:
 # across two runs, TTFT stamping and burn-rate math vs fixtures.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/slo -q -m 'not slow'
+
+# Pool-sharded planning gate: pool partitioning + merge invariants,
+# warm-state codec round-trip/versioning, the sharded controller path,
+# and a tiny end-to-end sharded bench run (cold + replans + merge +
+# equivalence + warm boot on a 64-node / 2-pool world).
+shard-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/partitioning/test_pools.py \
+	    tests/partitioning/test_snapcodec.py \
+	    tests/controllers/test_sharded_controller.py -q -m 'not slow'
+	JAX_PLATFORMS=cpu $(PY) bench_planner.py --plan-mode sharded --quick
 
 # Chaos tier-1 gate: one fixed seed through the full suite under fault
 # injection — must converge, replay clean, and fire a byte-identical

@@ -24,6 +24,15 @@ with the dirty set (the partitioner controller's incremental path), over
 a fragmented cluster whose pending residue is mostly unservable — the
 regime a production partitioner spends its life in.
 
+``--plan-mode sharded`` measures the pool-sharded pipeline at ``--pools``
+pools (nodes labeled, pods selector-pinned round-robin): per-pool
+steady-state replans + the cross-pool merge, under ``--parallel``
+serial/thread/both execution (both modes are timed — on a single core
+under the GIL threads buy nothing for this pure-Python workload, and the
+rows say so instead of assuming it). The mode also emits the
+sharded-vs-unsharded byte-identity oracle row and the warm-boot restart
+bench (persisted memo adoption vs a from-scratch cold plan).
+
 Output: one JSON line per (engine, cache mode, nodes, pods) config with
 p50/p95 plan latency (ms) and forks/sec, e.g.
 
@@ -44,6 +53,7 @@ from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.partitioning.core import ClusterSnapshot, DeepcopyClusterSnapshot, Planner, SnapshotNode
 from nos_tpu.scheduler.framework import Framework, NodeResourcesFit, NodeSelectorFit
 from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.api.v1alpha1.labels import GKE_NODEPOOL_LABEL
 from nos_tpu.kube.objects import Container, Node, NodeStatus, ObjectMeta, Pod, PodSpec
 from nos_tpu.tpu.node import TpuNode
 
@@ -51,48 +61,70 @@ V5E = "tpu-v5-lite-podslice"
 ENGINES = {"cow": ClusterSnapshot, "deepcopy": DeepcopyClusterSnapshot}
 
 
-def build_node(name: str, annotations=None) -> Node:
+def build_node(name: str, annotations=None, pool: str = "") -> Node:
     alloc = {constants.RESOURCE_TPU: 8, "cpu": 8, "memory": 128}
+    node_labels = {
+        labels.GKE_TPU_ACCELERATOR_LABEL: V5E,
+        labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+        labels.PARTITIONING_LABEL: "tpu",
+    }
+    if pool:
+        node_labels[GKE_NODEPOOL_LABEL] = pool
     return Node(
         metadata=ObjectMeta(
             name=name,
-            labels={
-                labels.GKE_TPU_ACCELERATOR_LABEL: V5E,
-                labels.GKE_TPU_TOPOLOGY_LABEL: "2x4",
-                labels.PARTITIONING_LABEL: "tpu",
-            },
+            labels=node_labels,
             annotations=annotations or {},
         ),
         status=NodeStatus(capacity=dict(alloc), allocatable=dict(alloc)),
     )
 
 
-def build_pod(name: str, requests: dict) -> Pod:
-    return Pod(
-        metadata=ObjectMeta(name=name, namespace="bench"),
-        spec=PodSpec(
-            containers=[Container(requests=dict(requests))],
-            scheduler_name=constants.SCHEDULER_NAME,
-        ),
+def build_pod(name: str, requests: dict, pool: str = "") -> Pod:
+    spec = PodSpec(
+        containers=[Container(requests=dict(requests))],
+        scheduler_name=constants.SCHEDULER_NAME,
     )
+    if pool:
+        spec.node_selector[GKE_NODEPOOL_LABEL] = pool
+    return Pod(metadata=ObjectMeta(name=name, namespace="bench"), spec=spec)
+
+
+def node_name(i: int) -> str:
+    return f"node-{i:05d}"
+
+
+def pool_of(i: int, pools: int) -> str:
+    return f"pool-{i % pools}" if pools else ""
+
+
+def build_cluster(n_nodes: int, ann_of, snapshot_cls=ClusterSnapshot, pools: int = 0):
+    """The one cluster builder every bench mode seeds from: ``ann_of(i)``
+    supplies node i's slice-state annotations, ``pools`` > 0 labels nodes
+    pool-{i %% pools} round-robin (the sharded bench's partition seeds)."""
+    nodes = {}
+    for i in range(n_nodes):
+        name = node_name(i)
+        nodes[name] = SnapshotNode(
+            partitionable=TpuNode(build_node(name, ann_of(i), pool=pool_of(i, pools)))
+        )
+    return snapshot_cls(nodes)
+
+
+def mixed_fill_annotations(i: int):
+    """1/3 virgin boards, 1/3 with one free 2x2, 1/3 half-used — enough
+    fragmentation that the planner forks real carve trials instead of
+    shortcutting."""
+    style = i % 3
+    if style == 0:
+        return None
+    if style == 1:
+        return annot.status_from_devices(free={0: {"2x2": 1}}, used={})
+    return annot.status_from_devices(free={}, used={0: {"2x2": 1, "1x1": 2}})
 
 
 def make_cluster(n_nodes: int, snapshot_cls):
-    """Deterministic mixed-fill cluster: 1/3 virgin boards, 1/3 with one
-    free 2x2, 1/3 half-used — enough fragmentation that the planner forks
-    real carve trials instead of shortcutting."""
-    nodes = {}
-    for i in range(n_nodes):
-        style = i % 3
-        if style == 0:
-            ann = None
-        elif style == 1:
-            ann = annot.status_from_devices(free={0: {"2x2": 1}}, used={})
-        else:
-            ann = annot.status_from_devices(free={}, used={0: {"2x2": 1, "1x1": 2}})
-        name = f"node-{i:04d}"
-        nodes[name] = SnapshotNode(partitionable=TpuNode(build_node(name, ann)))
-    return snapshot_cls(nodes)
+    return build_cluster(n_nodes, mixed_fill_annotations, snapshot_cls)
 
 
 def make_pending(n_pods: int):
@@ -109,65 +141,72 @@ def make_pending(n_pods: int):
     return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
 
 
-def build_steady_node(name: str, variant: bool) -> SnapshotNode:
-    """One fragmented node for the steady-state bench: a used 2x2 pins the
-    board (no full-board carve can ever succeed) while free 1x1 slices
-    keep the node in the candidate set. The two variants differ in their
-    free/used 1x1 split so a churn refresh is a real geometry change."""
+def steady_annotations(variant: bool):
+    """One fragmented node's slice state for the steady-state bench: a
+    used 2x2 pins the board (no full-board carve can ever succeed) while
+    free 1x1 slices keep the node in the candidate set. The two variants
+    differ in their free/used 1x1 split so a churn refresh is a real
+    geometry change."""
     if variant:
-        ann = annot.status_from_devices(
+        return annot.status_from_devices(
             free={0: {"1x1": 1}}, used={0: {"2x2": 1, "1x1": 1}}
         )
-    else:
-        ann = annot.status_from_devices(free={0: {"1x1": 2}}, used={0: {"2x2": 1}})
-    return SnapshotNode(partitionable=TpuNode(build_node(name, ann)))
+    return annot.status_from_devices(free={0: {"1x1": 2}}, used={0: {"2x2": 1}})
 
 
-def make_steady_cluster(n_nodes: int) -> ClusterSnapshot:
-    return ClusterSnapshot(
-        {f"node-{i:05d}": build_steady_node(f"node-{i:05d}", False) for i in range(n_nodes)}
+def build_steady_node(name: str, variant: bool, pool: str = "") -> SnapshotNode:
+    return SnapshotNode(
+        partitionable=TpuNode(build_node(name, steady_annotations(variant), pool=pool))
     )
 
 
-def make_steady_pending(n_pods: int):
+def make_steady_cluster(n_nodes: int, pools: int = 0) -> ClusterSnapshot:
+    return build_cluster(n_nodes, lambda i: steady_annotations(False), pools=pools)
+
+
+def make_steady_pending(n_pods: int, pools: int = 0):
     """Steady-state residue: mostly board-sized requests no fragmented
     node can ever serve (every carve provably futile — the futility memo
     carries the replan) plus ~10%% small slices the free pool claims each
-    cycle (exercising the claim pre-pass and cross-cycle verdict reuse)."""
-    mixes = [
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("2x4"): 1},
-        {constants.tpu_slice_resource("1x1"): 1},
+    cycle (exercising the claim pre-pass and cross-cycle verdict reuse).
+    With ``pools`` > 0 each pod is selector-pinned round-robin so the
+    partition stays pool-independent (no multi-pool selector edges)."""
+    mixes = [{constants.tpu_slice_resource("2x4"): 1}] * 9 + [
+        {constants.tpu_slice_resource("1x1"): 1}
     ]
-    return [build_pod(f"pend-{i:04d}", mixes[i % len(mixes)]) for i in range(n_pods)]
+    return [
+        build_pod(f"pend-{i:04d}", mixes[i % len(mixes)], pool=pool_of(i, pools))
+        for i in range(n_pods)
+    ]
 
 
 def capacity_row(snapshot, n_nodes: int, n_pods: int, churn: float) -> dict:
     """Steady-state capacity shape of the churned cluster, measured with
-    the capacity ledger's fragmentation helper over each node's final
-    slice-state annotations: the free-chip-weighted fragmentation index
-    and the utilization the churn regime settles into — the same numbers
-    `/debug/capacity` reports for a live cluster."""
-    from nos_tpu.capacity import fragmentation_from_annotations
+    the capacity ledger's fragmentation helpers over each node's final
+    slice-state annotations: the cluster fragmentation index (1 - largest
+    free slice / largest satisfiable ask) and the utilization the churn
+    regime settles into — the same numbers `/debug/capacity` reports for
+    a live cluster. The old free-chip-weighted mean of per-node indices
+    read 0.0 exactly when every node was down to slivers — the most
+    fragmented state a cluster can reach."""
+    from nos_tpu.capacity import (
+        cluster_fragmentation_index,
+        fragmentation_from_annotations,
+        largest_profile_chips,
+    )
 
     capacity = free_total = largest_any = 0
-    weighted = 0.0
     for snap_node in snapshot.get_nodes().values():
         node = snap_node.partitionable.node
         capacity += int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
-        index, largest, free = fragmentation_from_annotations(
+        _, largest, free = fragmentation_from_annotations(
             node.metadata.annotations, V5E
         )
-        weighted += index * free
         free_total += free
         largest_any = max(largest_any, largest)
+    index = cluster_fragmentation_index(
+        free_total, largest_any, largest_profile_chips(V5E)
+    )
     return {
         "bench": "bench_capacity",
         "nodes": n_nodes,
@@ -178,7 +217,7 @@ def capacity_row(snapshot, n_nodes: int, n_pods: int, churn: float) -> dict:
         "steady_state_utilization": round(1 - free_total / capacity, 4)
         if capacity
         else None,
-        "fragmentation_index": round(weighted / free_total, 4) if free_total else 0.0,
+        "fragmentation_index": round(index, 4),
         "largest_free_slice_chips": largest_any,
     }
 
@@ -208,7 +247,7 @@ def bench_incremental(
     for cycle in range(repeats + 1):  # cycle 0 is untimed warm-up
         dirty = set()
         for j in range(k):
-            name = f"node-{(cycle * k + j) % n_nodes:05d}"
+            name = node_name((cycle * k + j) % n_nodes)
             variant[name] = not variant.get(name, False)
             snapshot.refresh_node(name, build_steady_node(name, variant[name]))
             dirty.add(name)
@@ -242,6 +281,255 @@ def bench_incremental(
         "cache_hit_rate_last_cycle": round(hits / eligible, 4) if eligible else None,
     }
     return [row, capacity_row(snapshot, n_nodes, n_pods, churn)]
+
+
+def _framework():
+    return Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+
+
+def _ages(pods):
+    return {p.namespaced_name: 0.0 for p in pods}
+
+
+def bench_sharded(
+    n_nodes: int,
+    n_pods: int,
+    repeats: int,
+    pools: int,
+    churn: float = 0.05,
+    parallelism: str = "serial",
+) -> dict:
+    """Steady-state replans through the pool-sharded pipeline: one
+    persistent per-pool snapshot + planner per pool, each cycle dirtying
+    ``churn`` of the nodes in their owning pool snapshots, replanning
+    every pool (serial or ThreadPoolExecutor — both are measured so the
+    GIL story is told honestly, not assumed), then the deterministic
+    merge + cross-pool invariant check the controller runs before
+    actuation. The timed cycle is the WHOLE sharded pipeline, merge
+    included."""
+    from nos_tpu.partitioning.core.pools import (
+        check_merge_invariants,
+        merge_pool_states,
+        node_capacities,
+        partition_pools,
+        run_pool_plans,
+        split_pending,
+        split_snapshot,
+    )
+
+    snapshot = make_steady_cluster(n_nodes, pools=pools)
+    pending = make_steady_pending(n_pods, pools=pools)
+    ages = _ages(pending)
+    partition = partition_pools(snapshot, pending)
+    if len(partition.pools) != pools:
+        raise RuntimeError(
+            f"expected {pools} pools, partitioned into {partition.pools}"
+        )
+    pool_snaps = split_snapshot(snapshot, partition)
+    pool_pending = split_pending(pending, partition)
+    planners = {pool: Planner(_framework()) for pool in partition.pools}
+    capacities = node_capacities(pool_snaps.values())
+
+    def cold_task(pool):
+        def task():
+            planners[pool].plan(
+                pool_snaps[pool],
+                pool_pending[pool],
+                dirty=set(pool_snaps[pool].get_nodes()),
+                pending_ages=ages,
+            )
+
+        return task
+
+    started = time.perf_counter()
+    run_pool_plans({p: cold_task(p) for p in partition.pools}, parallelism)
+    cold_ms = (time.perf_counter() - started) * 1e3
+    k = max(1, int(n_nodes * churn)) if churn > 0 else 0
+    variant: dict = {}
+    latencies, merge_latencies = [], []
+    for cycle in range(repeats + 1):  # cycle 0 is untimed warm-up
+        pool_dirty = {pool: set() for pool in partition.pools}
+        for j in range(k):
+            i = (cycle * k + j) % n_nodes
+            name = node_name(i)
+            variant[name] = not variant.get(name, False)
+            pool = partition.node_pool[name]
+            pool_snaps[pool].refresh_node(
+                name, build_steady_node(name, variant[name], pool=pool_of(i, pools))
+            )
+            pool_dirty[pool].add(name)
+
+        def make_task(pool):
+            def task():
+                # Pre-plan state first: plan() commits carves into its
+                # base, and the merge check + actuation baseline need the
+                # observed state.
+                current = pool_snaps[pool].partitioning_state()
+                desired = planners[pool].plan(
+                    pool_snaps[pool],
+                    pool_pending[pool],
+                    dirty=pool_dirty[pool],
+                    pending_ages=ages,
+                )
+                return current, desired
+
+            return task
+
+        t0 = time.perf_counter()
+        outcomes = run_pool_plans(
+            {p: make_task(p) for p in partition.pools}, parallelism
+        )
+        t1 = time.perf_counter()
+        pool_current = {p: cur for p, (cur, _) in outcomes.items()}
+        pool_desired = {p: des for p, (_, des) in outcomes.items()}
+        violations = check_merge_invariants(
+            partition, pool_current, pool_desired, capacities=capacities
+        )
+        merge_pool_states(pool_desired)
+        t2 = time.perf_counter()
+        if violations:
+            raise RuntimeError(f"merge invariants failed: {violations[:3]}")
+        for pool, planner in planners.items():
+            if planner.last_plan_mode != "incremental":
+                raise RuntimeError(
+                    f"pool {pool} replan mode {planner.last_plan_mode!r}"
+                )
+        if cycle > 0:
+            latencies.append(t2 - t0)
+            merge_latencies.append(t2 - t1)
+    quantiles = (
+        statistics.quantiles(latencies, n=20) if len(latencies) > 1 else latencies * 2
+    )
+    return {
+        "bench": "bench_planner_sharded",
+        "plan_mode": "sharded",
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "pools": pools,
+        "parallelism": parallelism,
+        "churn": churn,
+        "dirty_per_cycle": k,
+        "cycles": repeats,
+        "cold_plan_ms": round(cold_ms, 2),
+        "p50_replan_ms": round(statistics.median(latencies) * 1e3, 2),
+        "p95_replan_ms": round(quantiles[-1] * 1e3, 2),
+        "p50_merge_ms": round(statistics.median(merge_latencies) * 1e3, 3),
+    }
+
+
+def bench_sharded_equivalence(n_nodes: int, n_pods: int, pools: int) -> dict:
+    """Byte-identity oracle row: on pool-independent inputs (every pod
+    selector-pinned, draw_decomposes holds) the merged sharded plan must
+    equal the unsharded planner's output byte for byte."""
+    from nos_tpu.partitioning.core.partition_state import (
+        partitioning_state_to_dict,
+    )
+    from nos_tpu.partitioning.core.pools import (
+        draw_decomposes,
+        merge_pool_states,
+        partition_pools,
+        split_pending,
+        split_snapshot,
+    )
+
+    pending = make_steady_pending(n_pods, pools=pools)
+    ages = _ages(pending)
+    unsharded = Planner(_framework()).plan(
+        make_steady_cluster(n_nodes, pools=pools), list(pending), pending_ages=ages
+    )
+    snapshot = make_steady_cluster(n_nodes, pools=pools)
+    partition = partition_pools(snapshot, pending)
+    decomposes = draw_decomposes(snapshot, partition, pending)
+    pool_snaps = split_snapshot(snapshot, partition)
+    pool_pending = split_pending(pending, partition)
+    pool_desired = {
+        pool: Planner(_framework()).plan(
+            pool_snaps[pool], pool_pending[pool], pending_ages=ages
+        )
+        for pool in partition.pools
+    }
+    sharded = merge_pool_states(pool_desired)
+
+    def state_bytes(state):
+        return json.dumps(partitioning_state_to_dict(state), sort_keys=True)
+
+    return {
+        "bench": "bench_planner_sharded_equivalence",
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "pools": len(partition.pools),
+        "draw_decomposes": decomposes,
+        "byte_identical": state_bytes(sharded) == state_bytes(unsharded),
+    }
+
+
+def bench_warm_boot(n_nodes: int, n_pods: int, repeats: int = 3) -> dict:
+    """Restart economics, median of ``repeats`` fresh worlds: a
+    from-scratch cold plan vs a restart that adopts persisted warm state
+    (signature-matched futility/verdict memos) and replans only the
+    unmatched residue. ``warm_plan_speedup_vs_cold`` is the headline —
+    the restart's first plan, which would otherwise be the cold plan —
+    and the one-time adoption cost (file load + per-node signature
+    verification) is reported separately as part of the honest restart
+    total. The warm plan's bytes must equal the from-scratch plan's."""
+    import os
+    import tempfile
+
+    from nos_tpu.partitioning.core.partition_state import (
+        partitioning_state_to_dict,
+    )
+    from nos_tpu.partitioning.core.snapcodec import WarmStateCodec
+
+    def state_bytes(state):
+        return json.dumps(partitioning_state_to_dict(state), sort_keys=True)
+
+    cold_samples, adopt_samples, warm_samples = [], [], []
+    identical = True
+    matched = unmatched = 0
+    for _ in range(repeats):
+        pending = make_steady_pending(n_pods)
+        ages = _ages(pending)
+        snapshot = make_steady_cluster(n_nodes)
+        planner = Planner(_framework())
+        started = time.perf_counter()
+        desired_cold = planner.plan(
+            snapshot, pending, dirty=set(snapshot.get_nodes()), pending_ages=ages
+        )
+        cold_samples.append(time.perf_counter() - started)
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "warm.json")
+            WarmStateCodec(path).save(snapshot, planner, force=True)
+            restarted = make_steady_cluster(n_nodes)
+            warm_planner = Planner(_framework())
+            t0 = time.perf_counter()
+            report = WarmStateCodec(path).adopt(restarted, warm_planner)
+            adopt_samples.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            desired_warm = warm_planner.plan(
+                restarted, pending, dirty=set(report.unmatched), pending_ages=ages
+            )
+            warm_samples.append(time.perf_counter() - t0)
+        identical = identical and (
+            state_bytes(desired_warm) == state_bytes(desired_cold)
+        )
+        matched, unmatched = report.matched, len(report.unmatched)
+    cold_ms = statistics.median(cold_samples) * 1e3
+    adopt_ms = statistics.median(adopt_samples) * 1e3
+    warm_ms = statistics.median(warm_samples) * 1e3
+    return {
+        "bench": "bench_planner_warm_boot",
+        "nodes": n_nodes,
+        "pending_pods": n_pods,
+        "repeats": repeats,
+        "cold_plan_ms": round(cold_ms, 2),
+        "adopt_ms": round(adopt_ms, 2),
+        "warm_plan_ms": round(warm_ms, 2),
+        "warm_plan_speedup_vs_cold": round(cold_ms / warm_ms, 1),
+        "restart_total_ms": round(adopt_ms + warm_ms, 2),
+        "nodes_matched": matched,
+        "nodes_unmatched": unmatched,
+        "byte_identical": identical,
+    }
 
 
 def bench_config(
@@ -379,15 +667,37 @@ def main() -> None:
     parser.add_argument(
         "--plan-mode",
         default="full",
-        choices=("full", "incremental", "both"),
+        choices=("full", "incremental", "both", "sharded"),
         help="full = cold from-scratch plans (the original bench); "
         "incremental = steady-state replans over one persistent snapshot "
-        "with a churn phase (see bench_incremental)",
+        "with a churn phase (see bench_incremental); sharded = the "
+        "pool-sharded pipeline (per-pool replans + merge), plus the "
+        "warm-boot restart bench and the sharded-vs-unsharded "
+        "byte-identity oracle",
     )
     parser.add_argument(
         "--incremental-configs",
         default="1024x800,4096x800",
         help="nodesxpods pairs for the incremental mode",
+    )
+    parser.add_argument(
+        "--sharded-configs",
+        default="4096x800,16384x800",
+        help="nodesxpods pairs for the sharded mode",
+    )
+    parser.add_argument(
+        "--pools",
+        type=int,
+        default=8,
+        help="node-pool count for the sharded mode (nodes and pods are "
+        "labeled/pinned round-robin)",
+    )
+    parser.add_argument(
+        "--parallel",
+        default="both",
+        choices=("serial", "thread", "both"),
+        help="per-pool execution for the sharded mode; 'both' emits one "
+        "row per mode so the GIL story is measured, not assumed",
     )
     parser.add_argument(
         "--churn",
@@ -422,12 +732,43 @@ def main() -> None:
     incremental_configs = [
         tuple(map(int, c.split("x"))) for c in args.incremental_configs.split(",")
     ]
+    sharded_configs = [
+        tuple(map(int, c.split("x"))) for c in args.sharded_configs.split(",")
+    ]
+    pools = args.pools
     repeats = args.repeats
     if args.quick:
         configs, repeats = [(16, 50)], 2
         incremental_configs = [(64, 100)]
+        sharded_configs, pools = [(64, 100)], 2
 
     results = []
+    if args.plan_mode == "sharded":
+        modes = (
+            ("serial", "thread") if args.parallel == "both" else (args.parallel,)
+        )
+        # Warm boot and the equivalence oracle run FIRST: the 16k-node
+        # sharded benches leave enough long-lived garbage behind that a
+        # later warm-boot measurement in the same process inflates ~2x
+        # (GC pressure), which is not the number a real restart pays.
+        wb_nodes, wb_pods = min(sharded_configs)
+        result = bench_warm_boot(wb_nodes, wb_pods)
+        results.append(result)
+        print(json.dumps(result), flush=True)
+        eq_nodes, eq_pods = min(sharded_configs)
+        result = bench_sharded_equivalence(min(eq_nodes, 256), min(eq_pods, 400), pools)
+        results.append(result)
+        print(json.dumps(result), flush=True)
+        for n_nodes, n_pods in sharded_configs:
+            for parallelism in modes:
+                result = bench_sharded(
+                    n_nodes, n_pods, repeats, pools,
+                    churn=args.churn, parallelism=parallelism,
+                )
+                results.append(result)
+                print(json.dumps(result), flush=True)
+        _finish(args, results)
+        return
     if args.plan_mode in ("incremental", "both"):
         for n_nodes, n_pods in incremental_configs:
             for result in bench_incremental(n_nodes, n_pods, repeats, churn=args.churn):

@@ -57,6 +57,24 @@ class GpuPartitionerConfig:
     # Dirty fraction above which an incremental cycle falls back to a
     # from-scratch replan (still base-preserving).
     incremental_dirty_threshold: float = 0.25
+    # Pool-sharded planning (partitioning/core/pools.py): partition the
+    # cluster into pools no gang/affinity/quota edge crosses and plan
+    # each with its own incremental base + planner, merged under
+    # cross-pool invariants. Requires incremental_planning.
+    pool_sharding: bool = False
+    # How the per-pool plans execute: "serial" (sorted pool order,
+    # reproducible timing) or "thread" (ThreadPoolExecutor — wins only
+    # on multi-core GIL-released deployments; bench_planner --parallel
+    # measures both honestly).
+    pool_parallelism: str = "serial"
+    # Thread-mode worker cap; 0 = one worker per pool.
+    pool_max_workers: int = 0
+    # When set, persist the planners' warm state (carve-futility and
+    # verdict memos keyed by node-state signature) to this file so a
+    # restart or full-rebuild fallback warm-boots instead of replaying
+    # the world (partitioning/core/snapcodec.py). Empty = no persistence.
+    warm_state_path: str = ""
+    warm_state_save_interval_seconds: float = 30.0
 
     def validate(self) -> None:
         if self.aging_chips_per_second < 0:
@@ -73,6 +91,18 @@ class GpuPartitionerConfig:
             raise ConfigError("batch_window_idle_seconds must be >= 0")
         if self.batch_window_idle_seconds > self.batch_window_timeout_seconds:
             raise ConfigError("idle window cannot exceed timeout window")
+        if self.pool_sharding and not self.incremental_planning:
+            raise ConfigError("pool_sharding requires incremental_planning")
+        if self.pool_parallelism not in ("serial", "thread"):
+            raise ConfigError(
+                "pool_parallelism must be 'serial' or 'thread'"
+            )
+        if self.pool_max_workers < 0:
+            raise ConfigError("pool_max_workers must be >= 0")
+        if self.warm_state_save_interval_seconds < 0:
+            raise ConfigError(
+                "warm_state_save_interval_seconds must be >= 0"
+            )
 
 
 @dataclass

@@ -19,6 +19,12 @@ CAPACITY_OVER_QUOTA = "over-quota"
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 
+# GKE node-pool membership — the seed key for pool-sharded planning
+# (partitioning/core/pools.py): nodes sharing this label value start in
+# the same planning pool, then gang/affinity/quota edges merge pools.
+# Unlabeled nodes fall into one implicit pool.
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
 # On hybrid nodes: how many of the node's chips (the highest-indexed ones)
 # form the sharing pool; the rest are carved into slice boards. The TPU
 # analogue of nos's per-GPU MIG-enabled flag, which decides whether a

@@ -4,5 +4,7 @@ from nos_tpu.capacity.ledger import (  # noqa: F401
     BUCKET_RECONFIG,
     BUCKET_RESERVED,
     CapacityLedger,
+    cluster_fragmentation_index,
     fragmentation_from_annotations,
+    largest_profile_chips,
 )

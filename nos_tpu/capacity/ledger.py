@@ -33,7 +33,11 @@ accumulation is bit-reproducible on replay):
 
 The ledger additionally tracks a per-node fragmentation index (1 -
 largest-carveable-slice / free-chips, from the status annotations and
-the accelerator's slice shapes), per-gang wait clocks (arrival →
+the accelerator's slice shapes) and a cluster index (1 - best single
+carve anywhere / min(free total, largest known profile) — NOT the
+free-weighted mean of node indices, which reads 0.0 exactly when every
+node has decayed to slivers; see :func:`cluster_fragmentation_index`),
+per-gang wait clocks (arrival →
 first-feasible → bound) feeding ``nos_tpu_gang_wait_seconds``, and
 per-namespace quota borrow/starvation derived from ElasticQuota objects.
 
@@ -139,6 +143,43 @@ def fragmentation_from_annotations(
             if chips <= board_free and chips > largest:
                 largest = chips
     return 1.0 - largest / free_total, largest, free_total
+
+
+def largest_profile_chips(accelerator: str) -> int:
+    """The biggest carveable slice (in chips) the accelerator's shape
+    table admits — the most any single workload could ask of one node."""
+    spec = KNOWN_ACCELERATORS.get(accelerator)
+    if not spec:
+        return 0
+    return max(topology_chips(s) for s in spec.slice_shapes)
+
+
+def cluster_fragmentation_index(
+    free_chips_total: float,
+    largest_free_slice: float,
+    largest_profile: float,
+) -> float:
+    """Cluster-level fragmentation: how far the best single carve
+    anywhere falls short of the largest slice a workload could ask for,
+    bounded by what is actually free.
+
+    The free-chip-weighted mean of per-node indices is NOT this number:
+    it reads 0.0 exactly when every node has decayed to slivers (each
+    node's largest carve equals its own tiny free pool — e.g. 1487 free
+    chips cluster-wide whose best carve is a 1x2), which is the most
+    fragmented state a cluster can reach, not the least. This index
+    instead compares the single best carve to
+    ``min(free total, largest known profile)``: 0.0 when nothing is free
+    or the biggest askable slice still fits somewhere, approaching 1.0
+    as free capacity becomes uncarveable."""
+    if free_chips_total <= 0:
+        return 0.0
+    askable = free_chips_total
+    if largest_profile > 0:
+        askable = min(askable, largest_profile)
+    if askable <= 0:
+        return 0.0
+    return max(0.0, 1.0 - largest_free_slice / askable)
 
 
 def _pod_chips(pod: Any) -> int:
@@ -568,7 +609,7 @@ class CapacityLedger:
         bound_by_node: Dict[str, int] = {}
         for node_name, chips, _ in self._bound.values():
             bound_by_node[node_name] = bound_by_node.get(node_name, 0) + chips
-        frag_num = frag_den = 0.0
+        free_total = largest_free = largest_profile = 0.0
         for name in sorted(self._nodes):
             st = self._nodes[name]
             used = min(st.total_chips, bound_by_node.get(name, 0))
@@ -579,9 +620,14 @@ class CapacityLedger:
             )
             m.NODE_FRAGMENTATION.labels(node=name).set(st.frag_index)
             self._exported_nodes.add(name)
-            frag_num += st.frag_index * st.free_chips
-            frag_den += st.free_chips
-        m.CLUSTER_FRAGMENTATION.set(frag_num / frag_den if frag_den else 0.0)
+            free_total += st.free_chips
+            largest_free = max(largest_free, st.largest_free_slice)
+            largest_profile = max(
+                largest_profile, largest_profile_chips(st.accelerator)
+            )
+        m.CLUSTER_FRAGMENTATION.set(
+            cluster_fragmentation_index(free_total, largest_free, largest_profile)
+        )
         starved_ok = {
             ns for _, ns in self._pending.values()
         }  # namespaces with queued demand
@@ -626,7 +672,7 @@ class CapacityLedger:
             )
             denom = self.total_chip_seconds or 1.0
             nodes = {}
-            frag_num = frag_den = 0.0
+            free_frag = largest_free = largest_profile = 0.0
             for name in sorted(self._nodes):
                 st = self._nodes[name]
                 used = min(st.total_chips, bound_by_node.get(name, 0))
@@ -647,8 +693,11 @@ class CapacityLedger:
                         acc["busy"] / acc["total"] if acc["total"] else 0.0
                     ),
                 }
-                frag_num += st.frag_index * st.free_chips
-                frag_den += st.free_chips
+                free_frag += st.free_chips
+                largest_free = max(largest_free, st.largest_free_slice)
+                largest_profile = max(
+                    largest_profile, largest_profile_chips(st.accelerator)
+                )
             pending_ns = {ns for _, ns in self._pending.values()}
             quotas = {}
             for key in sorted(self._quotas):
@@ -687,7 +736,10 @@ class CapacityLedger:
                     "idle_with_pending_demand": (
                         self.idle_chip_seconds[BUCKET_PENDING] / denom
                     ),
-                    "fragmentation": frag_num / frag_den if frag_den else 0.0,
+                    "fragmentation": cluster_fragmentation_index(
+                        free_frag, largest_free, largest_profile
+                    ),
+                    "largest_free_slice_chips": largest_free,
                     "chip_seconds": {
                         "total": self.total_chip_seconds,
                         "busy": self.busy_chip_seconds,

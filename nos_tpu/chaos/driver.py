@@ -167,6 +167,12 @@ class ChaosDriver:
                 incremental_planning=True,
                 incremental_dirty_threshold=1.0,
                 audit_sample_rate=1.0,
+                # Sharded planning stays on under chaos too: the per-pool
+                # shadow oracle (audit_sharded_plan) and the cross-pool
+                # merge invariants must hold through every fault class,
+                # and chaos pods carry no pool-pinning selectors so most
+                # cycles exercise the mega-pool degradation as well.
+                pool_sharding=True,
             ),
             scheduler_config=SchedulerConfig(retry_seconds=0.1),
             flight_recorder=self.recorder,

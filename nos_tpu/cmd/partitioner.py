@@ -118,6 +118,17 @@ def build_partitioner(
         auditor=auditor,
         incremental_planning=config.incremental_planning,
         incremental_dirty_threshold=config.incremental_dirty_threshold,
+        pool_sharding=config.pool_sharding,
+        pool_parallelism=config.pool_parallelism,
+        pool_max_workers=config.pool_max_workers,
+        # Warm-state files are per mode: the two controllers' planners
+        # memoize against different snapshot shapes.
+        warm_state_path=(
+            f"{config.warm_state_path}.tpu" if config.warm_state_path else ""
+        ),
+        warm_state_save_interval_seconds=(
+            config.warm_state_save_interval_seconds
+        ),
         # The tpu controller alone drives ledger observes: one observer per
         # cluster, or chip-seconds would double-integrate per cycle.
         capacity_ledger=capacity_ledger,
@@ -236,6 +247,17 @@ def build_partitioner(
         auditor=auditor,
         incremental_planning=config.incremental_planning,
         incremental_dirty_threshold=config.incremental_dirty_threshold,
+        pool_sharding=config.pool_sharding,
+        pool_parallelism=config.pool_parallelism,
+        pool_max_workers=config.pool_max_workers,
+        warm_state_path=(
+            f"{config.warm_state_path}.sharing"
+            if config.warm_state_path
+            else ""
+        ),
+        warm_state_save_interval_seconds=(
+            config.warm_state_save_interval_seconds
+        ),
     )
     manager.add(
         Controller(

@@ -62,6 +62,13 @@ def configs_from(config: dict):
         audit_sample_rate=p.get("auditSampleRate", 0.0),
         incremental_planning=p.get("incrementalPlanning", True),
         incremental_dirty_threshold=p.get("incrementalDirtyThreshold", 0.25),
+        pool_sharding=p.get("poolSharding", False),
+        pool_parallelism=p.get("poolParallelism", "serial"),
+        pool_max_workers=p.get("poolMaxWorkers", 0),
+        warm_state_path=p.get("warmStatePath", ""),
+        warm_state_save_interval_seconds=p.get(
+            "warmStateSaveIntervalSeconds", 30.0
+        ),
     )
     scheduler = SchedulerConfig(
         retry_seconds=s.get("retrySeconds", 0.5),

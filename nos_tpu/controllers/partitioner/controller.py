@@ -57,6 +57,11 @@ class PartitionerController:
         incremental_planning: bool = True,
         incremental_dirty_threshold: Optional[float] = None,
         capacity_ledger=None,
+        pool_sharding: bool = False,
+        pool_parallelism: str = "serial",
+        pool_max_workers: int = 0,
+        warm_state_path: str = "",
+        warm_state_save_interval_seconds: float = 30.0,
     ) -> None:
         self.store = store
         # Optional kube/events.py EventRecorder: PartitioningApplied when a
@@ -106,6 +111,31 @@ class PartitionerController:
         if incremental_dirty_threshold is not None:
             self.planner.incremental_dirty_threshold = incremental_dirty_threshold
         self._maintainer = None
+        # Pool-sharded planning (pools.py): partition the cluster into
+        # pools no gang/affinity/quota edge crosses, keep one incremental
+        # base + one planner per pool, plan them independently, and merge
+        # under cross-pool invariants. Requires incremental planning (the
+        # per-pool bases ARE incremental snapshots).
+        self.pool_sharding = pool_sharding and incremental_planning
+        self.pool_parallelism = pool_parallelism
+        self.pool_max_workers = pool_max_workers
+        self._shard_maintainer = None
+        self._pool_planners: Dict[str, Planner] = {}
+        # Warm-state persistence (snapcodec.py): after each plan cycle the
+        # planners' futility/verdict memos are saved keyed by node-state
+        # signature; a restart or full-rebuild fallback adopts the entries
+        # whose signatures still match instead of replaying the world.
+        self._warm_codec = None
+        if warm_state_path and incremental_planning:
+            from nos_tpu.partitioning.core.snapcodec import WarmStateCodec
+
+            self._warm_codec = WarmStateCodec(
+                warm_state_path,
+                save_interval_seconds=warm_state_save_interval_seconds,
+            )
+        # Base-object identity from the previous cycle, so the unsharded
+        # incremental path can detect a rebuild (fresh base) and warm-boot.
+        self._last_base = None
         # Saturation telemetry: phase histogram children cached here
         # (labels() takes a registry lock — not for the hot loop) and a
         # busy meter for the batch loop itself.
@@ -361,9 +391,30 @@ class PartitionerController:
                 # dirty set and refresh only those nodes of the persistent
                 # base (the maintainer reads the live store too, after the
                 # same revision watermark — same race profile for replay).
+                shard = None
                 with TRACER.span("snapshot.take"):
-                    if self.incremental_planning:
+                    if self.pool_sharding:
+                        t_snap = time.monotonic()
+                        shard = self._shard_snapshot(pending)
+                        snapshot = shard[0]
+                        self._phase_refresh.observe(time.monotonic() - t_snap)
+                        dirty = None
+                    elif self.incremental_planning:
                         snapshot, dirty = self._maintain_snapshot()
+                        if (
+                            self._warm_codec is not None
+                            and snapshot is not self._last_base
+                        ):
+                            # Fresh base object = cold start or rebuild
+                            # fallback: adopt persisted memos for every
+                            # node whose state signature still matches,
+                            # and plan only the rest as dirty.
+                            report = self._warm_codec.adopt(
+                                snapshot, self.planner
+                            )
+                            dirty = set(report.unmatched)
+                            self._publish_warm_boot(report)
+                        self._last_base = snapshot
                     else:
                         t_snap = time.monotonic()
                         snapshot = self.snapshot_taker.take_snapshot(
@@ -371,9 +422,32 @@ class PartitionerController:
                         )
                         self._phase_refresh.observe(time.monotonic() - t_snap)
                         dirty = None
-                current = snapshot.partitioning_state()
                 t_plan = time.monotonic()
-                desired = self.planner.plan(snapshot, pending, dirty=dirty)
+                if shard is not None:
+                    # The actuation baseline comes from the POOL bases,
+                    # not the global one: plan() commits carves into its
+                    # base, so the pool bases carry planned-but-not-yet-
+                    # observed geometry the way the unsharded base does —
+                    # diffing desired against the global (observed) state
+                    # would re-actuate every un-acked node each cycle.
+                    desired, current, unserved, pending_ages, audit_runs = (
+                        self._plan_sharded(pending, shard)
+                    )
+                    if desired is None:
+                        # Merge invariants failed: discard the cycle's
+                        # plan (actuate a no-op), rebuild pools next
+                        # cycle. The conflict counter + log already fired.
+                        desired = current
+                else:
+                    current = snapshot.partitioning_state()
+                    desired = self.planner.plan(snapshot, pending, dirty=dirty)
+                    unserved = dict(
+                        getattr(self.planner, "last_unserved", {}) or {}
+                    )
+                    pending_ages = dict(
+                        getattr(self.planner, "last_pending_ages", {}) or {}
+                    )
+                    audit_runs = None
                 self._phase_plan.observe(time.monotonic() - t_plan)
                 plan = PartitioningPlan(desired_state=desired, id=self.plan_id_fn())
                 proc.set_attributes(plan_id=plan.id)
@@ -382,28 +456,38 @@ class PartitionerController:
                     applied = self.actuator.apply(current, plan)
                     self._phase_actuate.observe(time.monotonic() - t_act)
                 proc.set_attributes(nodes_repartitioned=applied)
-                self._record_plan(revision, pending, plan, applied, journey)
+                self._record_plan(
+                    revision, pending, plan, applied, journey,
+                    unserved=unserved, pending_ages=pending_ages,
+                )
                 if self.capacity_ledger is not None:
                     # One ledger observation per plan cycle: close the
                     # interval since the previous cycle and re-label the
                     # pending-idle bucket from this plan's carve failures.
                     self.capacity_ledger.observe(
                         time.time(),
-                        unserved=dict(
-                            getattr(self.planner, "last_unserved", {}) or {}
-                        ),
+                        unserved=dict(unserved),
                         trace_id=journey.trace_id if journey is not None else "",
                     )
                 if self.auditor is not None and self.auditor.should_audit():
-                    violations = self.auditor.audit_plan(
-                        self.planner,
-                        snapshot,
-                        revision=revision,
-                        pending=pending,
-                        desired=desired,
-                        ledger=self.capacity_ledger,
-                    )
+                    if audit_runs is not None:
+                        violations = self.auditor.audit_sharded_plan(
+                            audit_runs,
+                            snapshot=snapshot,
+                            revision=revision,
+                            ledger=self.capacity_ledger,
+                        )
+                    else:
+                        violations = self.auditor.audit_plan(
+                            self.planner,
+                            snapshot,
+                            revision=revision,
+                            pending=pending,
+                            desired=desired,
+                            ledger=self.capacity_ledger,
+                        )
                     proc.set_attributes(audit_violations=len(violations))
+                self._save_warm_state(snapshot, shard)
         if applied:
             self.plans_applied += 1
             self.nodes_repartitioned += applied
@@ -411,7 +495,7 @@ class PartitionerController:
             log.info(
                 "partitioner: plan %s applied for %d pending pods", plan.id, len(pending)
             )
-        self._record_plan_events(pending, applied)
+        self._record_plan_events(pending, applied, unserved=unserved)
         return applied
 
     def _maintain_snapshot(self):
@@ -425,8 +509,214 @@ class PartitionerController:
             )
         return self._maintainer.snapshot(self.cluster_state)
 
+    # --------------------------------------------------- sharded planning
+
+    def _shard_snapshot(self, pending: List[Pod]):
+        from nos_tpu.controllers.partitioner.incremental import (
+            PoolShardedMaintainer,
+        )
+
+        if self._shard_maintainer is None:
+            self._shard_maintainer = PoolShardedMaintainer(
+                self.store, self.snapshot_taker, kind=self.kind
+            )
+        return self._shard_maintainer.shard(self.cluster_state, pending)
+
+    def _new_planner(self) -> Planner:
+        """A pool planner with the controller planner's exact knobs —
+        per-pool memo state, shared policy."""
+        template = self.planner
+        planner = Planner(
+            template.framework,
+            aging_chips_per_second=template.aging_chips_per_second,
+            verdict_cache_enabled=template.verdict_cache_enabled,
+            reuse_gang_trial=template.reuse_gang_trial,
+            futility_memo_enabled=template.futility_memo_enabled,
+            incremental_dirty_threshold=template.incremental_dirty_threshold,
+        )
+        return planner
+
+    def _plan_sharded(self, pending: List[Pod], shard):
+        """Plan every pool independently and merge. Returns
+        ``(desired, current, unserved, pending_ages, audit_runs)`` where
+        ``current`` is the merged pre-plan pool state (the actuation
+        baseline); ``desired`` is None when the cross-pool merge
+        invariants failed, in which case the caller actuates a no-op and
+        the next cycle rebuilds."""
+        from nos_tpu.partitioning.core.pools import (
+            check_merge_invariants,
+            merge_pool_states,
+            node_capacities,
+            run_pool_plans,
+            split_pending,
+        )
+
+        snapshot, _dirty, partition, pool_snaps, pool_dirty = shard
+        maintainer = self._shard_maintainer
+        pool_pending = split_pending(pending, partition)
+        if maintainer.last_rebuilt:
+            # Fresh pool snapshots: fresh planners (the old ones' memos
+            # are keyed to dead mutation clocks). Fairness first-seen
+            # stamps carry over so pod aging survives the rebuild, and
+            # persisted warm state shrinks the all-dirty sets to the
+            # nodes whose observed state actually changed.
+            old_planners = list(self._pool_planners.values()) or [self.planner]
+            self._pool_planners = {}
+            doc = None
+            if self._warm_codec is not None:
+                doc = self._warm_codec.load(
+                    expected_codec=type(snapshot.codec).__name__
+                )
+            report_total = None
+            for pool in partition.pools:
+                planner = self._new_planner()
+                for prior in old_planners:
+                    planner.adopt_pending_seen(prior)
+                if doc is not None:
+                    pool_report = self._warm_codec.adopt(
+                        pool_snaps[pool], planner, doc
+                    )
+                    pool_dirty[pool] = set(pool_report.unmatched)
+                    if report_total is None:
+                        from nos_tpu.partitioning.core.snapcodec import (
+                            AdoptReport,
+                        )
+
+                        report_total = AdoptReport()
+                    report_total.matched += pool_report.matched
+                    report_total.unmatched |= pool_report.unmatched
+                    report_total.adopted_entries += pool_report.adopted_entries
+                self._pool_planners[pool] = planner
+            if self._warm_codec is not None:
+                from nos_tpu.partitioning.core.snapcodec import AdoptReport
+
+                self._publish_warm_boot(report_total or AdoptReport(
+                    unmatched=set(snapshot.get_nodes())
+                ))
+        metrics.PLAN_POOL_COUNT.labels(kind=self.kind).set(
+            len(partition.pools)
+        )
+
+        def make_task(pool: str):
+            def task():
+                planner = self._pool_planners[pool]
+                pool_snapshot = pool_snaps[pool]
+                # Pre-plan state FIRST: plan() commits successful carves
+                # into its base, so this is the last chance to read the
+                # pool's current geometry (merge-invariant and actuation
+                # baseline).
+                pool_current = pool_snapshot.partitioning_state()
+                t0 = time.monotonic()
+                desired = planner.plan(
+                    pool_snapshot, pool_pending[pool], dirty=pool_dirty[pool]
+                )
+                duration = time.monotonic() - t0
+                return desired, pool_current, duration
+
+            return task
+
+        tasks = {pool: make_task(pool) for pool in partition.pools}
+        outcomes = run_pool_plans(
+            tasks, self.pool_parallelism, self.pool_max_workers
+        )
+        pool_desired = {}
+        pool_current = {}
+        unserved: Dict[str, str] = {}
+        pending_ages: Dict[str, float] = {}
+        for pool, (desired, pool_cur, duration) in outcomes.items():
+            pool_desired[pool] = desired
+            pool_current[pool] = pool_cur
+            metrics.PLAN_POOL_DURATION.labels(pool=pool).observe(duration)
+            planner = self._pool_planners[pool]
+            unserved.update(planner.last_unserved)
+            pending_ages.update(planner.last_pending_ages)
+        audit_runs = [
+            (
+                pool,
+                self._pool_planners[pool],
+                pool_snaps[pool],
+                pool_pending[pool],
+                pool_desired[pool],
+            )
+            for pool in partition.pools
+        ]
+        current = merge_pool_states(pool_current)
+        violations = check_merge_invariants(
+            partition,
+            pool_current,
+            pool_desired,
+            capacities=node_capacities(pool_snaps.values()),
+        )
+        if violations:
+            metrics.PLAN_MERGE_CONFLICTS.inc()
+            maintainer.force_rebuild()
+            log.error(
+                "partitioner[%s]: sharded merge invariants failed, "
+                "discarding plan and rebuilding pools: %s",
+                self.kind,
+                "; ".join(violations[:5]),
+            )
+            return None, current, unserved, pending_ages, audit_runs
+        return (
+            merge_pool_states(pool_desired),
+            current,
+            unserved,
+            pending_ages,
+            audit_runs,
+        )
+
+    # ------------------------------------------------------- warm state
+
+    def _publish_warm_boot(self, report) -> None:
+        if report.matched and not report.unmatched:
+            outcome = "adopted"
+        elif report.matched:
+            outcome = "partial"
+        else:
+            outcome = "cold"
+        metrics.WARM_BOOT_OUTCOME.labels(outcome=outcome).inc()
+        log.info(
+            "partitioner[%s]: warm boot %s (%d nodes matched, %d dirty, "
+            "%d memo entries adopted)",
+            self.kind,
+            outcome,
+            report.matched,
+            len(report.unmatched),
+            report.adopted_entries,
+        )
+
+    def _save_warm_state(self, snapshot, shard) -> None:
+        if self._warm_codec is None:
+            return
+        if shard is None:
+            self._warm_codec.save(snapshot, self.planner)
+            return
+        if not self._warm_codec.due():
+            return
+        # Sharded: every pool planner exports against its own pool base
+        # (node keys are disjoint across pools), and the signatures are
+        # taken from those SAME pool bases — the memos were derived from
+        # their committed geometry, which the global (observed-only) base
+        # may not have caught up with yet.
+        _snapshot, _dirty, _partition, pool_snaps, _pool_dirty = shard
+        entries: Dict[str, dict] = {}
+        signing_nodes: Dict[str, object] = {}
+        for pool, planner in self._pool_planners.items():
+            pool_snapshot = pool_snaps.get(pool)
+            if pool_snapshot is not None:
+                entries.update(planner.export_warm_state(pool_snapshot))
+                signing_nodes.update(pool_snapshot.get_nodes())
+        self._warm_codec.save_entries(snapshot, entries, nodes=signing_nodes)
+
     def _record_plan(
-        self, revision: int, pending: List[Pod], plan, applied: int, journey
+        self,
+        revision: int,
+        pending: List[Pod],
+        plan,
+        applied: int,
+        journey,
+        unserved: Optional[Dict[str, str]] = None,
+        pending_ages: Optional[Dict[str, float]] = None,
     ) -> None:
         if self.flight_recorder is None:
             return
@@ -438,12 +728,10 @@ class PartitionerController:
             kind=self.kind,
             revision=revision,
             pending=[p.namespaced_name for p in pending],
-            pending_ages=dict(
-                getattr(self.planner, "last_pending_ages", {}) or {}
-            ),
+            pending_ages=dict(pending_ages or {}),
             plan_id=plan.id,
             desired=partitioning_state_to_dict(plan.desired_state),
-            unserved=dict(getattr(self.planner, "last_unserved", {}) or {}),
+            unserved=dict(unserved or {}),
             applied=applied,
             trace_id=journey.trace_id if journey is not None else "",
         )
@@ -454,7 +742,12 @@ class PartitionerController:
             applied=applied,
         )
 
-    def _record_plan_events(self, pending: List[Pod], applied: int) -> None:
+    def _record_plan_events(
+        self,
+        pending: List[Pod],
+        applied: int,
+        unserved: Optional[Dict[str, str]] = None,
+    ) -> None:
         """Event messages carry NO plan id: the id changes every cycle, so
         embedding it would defeat the recorder's dedup (a fresh Event
         object per plan) and the flood would drain the pod's rate-limit
@@ -464,7 +757,8 @@ class PartitionerController:
         records nothing until the verdict actually changes."""
         if self.recorder is None:
             return
-        unserved = getattr(self.planner, "last_unserved", {})
+        if unserved is None:
+            unserved = getattr(self.planner, "last_unserved", {})
         live = {p.namespaced_name for p in pending}
         self._last_carve_reason = {
             k: v for k, v in self._last_carve_reason.items() if k in live

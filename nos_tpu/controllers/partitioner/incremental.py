@@ -262,3 +262,102 @@ def _quota_spec_signature(quota) -> tuple:
         tuple(sorted(spec.min.items())),
         tuple(sorted(spec.max.items())),
     )
+
+
+class PoolShardedMaintainer:
+    """Layered over :class:`IncrementalSnapshotMaintainer`: keeps the
+    global base (and its drain/classify machinery) AND one persistent
+    per-pool ClusterSnapshot per planning pool, so each pool's planner
+    gets its own incremental base with its own mutation clock, dirty set
+    and memos.
+
+    Per cycle: the inner maintainer refreshes the global base and yields
+    the dirty set; the pool partition is recomputed as a pure function of
+    (snapshot, pending, quota bounds) through an incrementally maintained
+    selector index; then either
+
+    - the node->pool mapping is UNCHANGED: each dirty node's fresh state
+      is cloned from the global base into its pool snapshot via
+      ``refresh_node`` (pool memos for untouched nodes survive), or
+    - the mapping CHANGED (a gang now spans two pools, a label moved a
+      node, the graph connected into the mega-pool): every pool snapshot
+      is rebuilt from the global base and every pool reports fully dirty
+      — the memo flush the partition-stability test pins as happening
+      ONLY on real partition changes, never on no-op cycles.
+
+    Single-threaded by contract, like the inner maintainer; the per-pool
+    snapshots it returns may then be planned concurrently because they
+    share no mutable state (every SnapshotNode is an exclusive clone)."""
+
+    def __init__(self, store, snapshot_taker, kind: str = "tpu") -> None:
+        from nos_tpu.partitioning.core.pools import SelectorPoolIndex
+
+        self.inner = IncrementalSnapshotMaintainer(store, snapshot_taker, kind)
+        self.kind = kind
+        self.store = store
+        self._index = SelectorPoolIndex()
+        self._base: Optional[ClusterSnapshot] = None
+        self._partition = None  # the previous cycle's PoolPartition
+        self._pool_bases: dict = {}
+        # Set by shard(): whether this cycle rebuilt the pool snapshots
+        # (cold start, global rebuild, partition change, forced); the
+        # controller re-creates per-pool planners exactly then.
+        self.last_rebuilt = False
+        self._force_rebuild = False
+        # Test/observability taps.
+        self.pool_rebuilds = 0
+
+    def force_rebuild(self) -> None:
+        """Next shard() rebuilds pool snapshots regardless of partition
+        stability — the merge-conflict escape hatch."""
+        self._force_rebuild = True
+
+    def shard(self, cluster_state, pending_pods):
+        """(global snapshot, global dirty, partition, pool snapshots,
+        pool dirty sets) for one plan cycle."""
+        from nos_tpu.partitioning.core.pools import (
+            partition_pools,
+            split_snapshot,
+        )
+
+        snapshot, dirty = self.inner.snapshot(cluster_state)
+        nodes = snapshot.get_nodes()
+        if snapshot is not self._base:
+            # Inner rebuild produced a fresh base object: every incremental
+            # structure derived from the old one is meaningless.
+            self._base = snapshot
+            self._index.rebuild(snapshot)
+        else:
+            for name in dirty:
+                snap_node = nodes.get(name)
+                if snap_node is not None:
+                    self._index.note(name, snap_node)
+        quotas = list(self.store.list("ElasticQuota", copy=False))
+        partition = partition_pools(
+            snapshot, pending_pods, quotas=quotas, selector_index=self._index
+        )
+        rebuild = (
+            self._force_rebuild
+            or self._partition is None
+            or partition.node_pool != self._partition.node_pool
+        )
+        self._force_rebuild = False
+        if rebuild:
+            self._pool_bases = split_snapshot(snapshot, partition)
+            pool_dirty = {
+                pool: set(base.get_nodes())
+                for pool, base in self._pool_bases.items()
+            }
+            self.pool_rebuilds += 1
+        else:
+            pool_dirty = {pool: set() for pool in partition.pools}
+            for name in dirty:
+                pool = partition.node_pool.get(name)
+                if pool is None:
+                    continue
+                clone = nodes[name].plan_clone()
+                self._pool_bases[pool].refresh_node(name, clone)
+                pool_dirty[pool].add(name)
+        self._partition = partition
+        self.last_rebuilt = rebuild
+        return snapshot, dirty, partition, self._pool_bases, pool_dirty

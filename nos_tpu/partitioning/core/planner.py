@@ -83,6 +83,16 @@ from nos_tpu.tpu.topology import topology_chips
 log = logging.getLogger("nos_tpu.partitioning")
 
 
+def _retuple(value):
+    """Invert JSON's tuple→list flattening on a persisted pod signature.
+    Scalars compare and hash identically after the round-trip (8 == 8.0
+    in dict keys), so the reconstructed key is interchangeable with the
+    one pod_signature would compute live."""
+    if isinstance(value, list):
+        return tuple(_retuple(item) for item in value)
+    return value
+
+
 def _gang_of(pod: Pod):
     # Lazy import: scheduler.plugins.gang pulls the KubeStore stack, which
     # the planner's own dependents don't otherwise need.
@@ -366,6 +376,12 @@ class Planner:
             metrics.PLAN_MODE.labels(mode=mode).inc()
             base_preserving = dirty is not None
             if base_preserving:
+                # Warm the incremental free pool BEFORE forking: fork
+                # checkpoints the pool as-is, and a None checkpoint makes
+                # the final revert throw the pool away — the base would
+                # then recompute it from every node each cycle (and the
+                # refresh_node deltas maintaining it would no-op forever).
+                snapshot.free_slice_resources()
                 snapshot.fork()
             try:
                 return self._plan(snapshot, pending_pods, span, pending_ages)
@@ -379,6 +395,80 @@ class Planner:
         """(hits, misses, bypasses) accumulated by the most recent plan()
         — valid until the next plan() resets the per-plan caches."""
         return self._verdict_cache.stats()
+
+    # --------------------------------------------- fairness-age carryover
+
+    def adopt_pending_seen(self, other: "Planner") -> None:
+        """Carry another planner's first-seen fairness bookkeeping into
+        this one. Pool-sharded planning rebuilds per-pool planners when
+        the pool partition changes; without this, every partition change
+        would reset each starved pod's age to zero and restart the
+        aging-promotion clock."""
+        for key, value in other._pending_seen.items():
+            mine = self._pending_seen.get(key)
+            if mine is None or value[0] < mine[0]:
+                self._pending_seen[key] = value
+
+    # ----------------------------------------------- warm-state hand-off
+
+    def export_warm_state(self, snapshot: ClusterSnapshot) -> Dict[str, dict]:
+        """Per-node memo entries worth persisting across a process
+        restart: carve-futility proofs and cacheable scheduler verdicts,
+        both re-keyable because their pod half is a content signature (the
+        node half — the mutation version — is NOT portable and is
+        re-stamped at adoption). Only entries keyed at a node's CURRENT
+        (observed) version are exported: a plan's trial forks stamp
+        hypothetical mid-carve versions, and a verdict proved against a
+        hypothetical geometry must never be re-keyed onto observed state
+        (same retention rule as ``_prune_plan_caches``). Object-identity
+        keyed memos (sim pods, requests, NodeInfo views) die with the
+        process by design."""
+        version_of = snapshot.node_version
+        out: Dict[str, dict] = {}
+        for (node, version, lacking), reason in self._futility_cache.items():
+            if version_of(node) != version:
+                continue
+            out.setdefault(node, {"futility": [], "verdicts": []})[
+                "futility"
+            ].append([list(lacking), reason])
+        for (signature, node, version), verdict in (
+            self._verdict_cache.entries.items()
+        ):
+            if version_of(node) != version:
+                continue
+            out.setdefault(node, {"futility": [], "verdicts": []})[
+                "verdicts"
+            ].append([list(signature), bool(verdict)])
+        return out
+
+    def adopt_warm_state(
+        self, snapshot: ClusterSnapshot, entries: Dict[str, dict]
+    ) -> int:
+        """Re-key persisted memo entries onto `snapshot`'s live mutation
+        versions and make it this planner's cache snapshot. The caller
+        (snapcodec.adopt) has already proven, via content signatures, that
+        each node's observed state is bit-identical to the state the
+        entries were derived from — so re-stamping the version half of the
+        keys preserves exactness. Returns the number of entries adopted."""
+        self._reset_plan_caches(snapshot)
+        adopted = 0
+        for node_name, memos in entries.items():
+            version = snapshot.node_version(node_name)
+            if version < 0:
+                continue
+            for lacking, reason in memos.get("futility", ()):
+                key = (
+                    node_name,
+                    version,
+                    tuple(tuple(item) for item in lacking),
+                )
+                self._futility_cache[key] = reason
+                adopted += 1
+            for signature, verdict in memos.get("verdicts", ()):
+                key = (_retuple(signature), node_name, version)
+                self._verdict_cache.put(key, bool(verdict))
+                adopted += 1
+        return adopted
 
     def _flush_cache_stats(self, span=None) -> None:
         """Per-lookup counting happens on unlocked ints owned by the

@@ -1,0 +1,581 @@
+"""Pool-sharded planning: partition, per-pool execution, deterministic merge.
+
+A single planner thread owning the whole cluster saturates a core around
+16k nodes (ROADMAP item 1) — but most clusters decompose: gangs, affinity
+edges and quota borrowing induce a partition of the node graph, and any
+two components with no such edge between them can be planned independently
+(the Omega insight, applied to our gang-aware global planner instead of
+per-node partitioning). This module owns the decomposition:
+
+- :func:`partition_pools` — a per-cycle pure function from (snapshot,
+  pending pods, quotas) to a :class:`PoolPartition`. Pools are seeded by
+  the GKE node-pool label and merged by a union-find over the edges that
+  couple planning decisions: a pending pod whose node selector matches
+  several pools, a gang with members across pools, and quota namespaces
+  that can borrow (spec.max != spec.min). Anything whose footprint is
+  inherently cluster-wide — topology spread, inter-pod (anti-)affinity,
+  required node affinity — degrades the whole partition to one mega-pool
+  rather than guessing locality.
+- :func:`split_snapshot` — carve one ClusterSnapshot into per-pool
+  snapshots with cloned nodes (versions reset: each pool snapshot runs
+  its own mutation clock, and a foreign clock's ticks must never alias).
+- :func:`merge_pool_states` / :func:`check_merge_invariants` — the
+  deterministic recombination of per-pool ``PartitioningState``s and the
+  cross-pool safety net behind it (no node claimed twice, every node
+  accounted for, no board listed twice, and no node partitioned past its
+  physical capacity — chips are never minted by the merge).
+- :func:`run_pool_plans` — serial or ThreadPoolExecutor execution of the
+  per-pool closures. Threads buy nothing on a single core under the GIL
+  (the hot path is pure-Python dict work); both modes exist so the bench
+  can measure that honestly, and the serial order is sorted-by-pool so
+  results are reproducible.
+- :func:`draw_decomposes` — the test/bench oracle for byte-identical
+  sharded-vs-unsharded plans: the global planner draws every pod from ONE
+  cluster-wide free-slice pool in first-fit-descending order, so identity
+  holds exactly when that sequential draw decomposes per pool (each pod's
+  lack unchanged when drawn only against its own pool). Deliberate
+  deviation, documented in partitioner-performance.md: the sharded path
+  carves toward pool-local lacking totals, so on inputs where the draw
+  does NOT decompose the two paths may serve a contested profile to
+  different pods; the per-pool shadow oracle still proves every sharded
+  plan internally sound.
+
+Pool ids: a merged pool takes the lexicographically smallest member seed,
+so ids are stable across cycles whenever the edge set is — pool-keyed
+planner memos survive steady state instead of flushing every cycle.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.labels import GKE_NODEPOOL_LABEL
+from nos_tpu.kube.objects import Pod
+from nos_tpu.partitioning.core.partition_state import (
+    NodePartitioning,
+    PartitioningState,
+)
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.core.verdict_cache import needs_cluster_context
+from nos_tpu.tpu.topology import topology_chips
+
+# Seed pool for nodes without a node-pool label.
+DEFAULT_POOL = "default"
+# The single pool every node lands in when the graph is connected (or a
+# cluster-wide constraint makes locality unknowable).
+MEGA_POOL = "cluster"
+
+
+def _gang_of(pod: Pod):
+    # Lazy import, as in planner.py: the gang plugin pulls the KubeStore
+    # stack this module's dependents don't otherwise need.
+    from nos_tpu.scheduler.plugins.gang import gang_of
+
+    return gang_of(pod)
+
+
+@dataclass
+class PoolPartition:
+    """One cycle's decomposition of the cluster into independent pools."""
+
+    # Sorted, deduplicated pool ids.
+    pools: Tuple[str, ...]
+    # node name -> pool id (every snapshot node appears exactly once).
+    node_pool: Dict[str, str]
+    # pending pod namespaced_name -> pool id the pod is planned in.
+    pod_pool: Dict[str, str]
+    # merged pool id -> the seed pools folded into it (only multi-seed
+    # merges are recorded; singleton pools are absent).
+    merged_from: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    # Non-empty when the partition degraded to one mega-pool, naming why
+    # (observability: the /debug surface and tests read this).
+    single_pool_reason: str = ""
+
+    def nodes_of(self, pool: str) -> List[str]:
+        return sorted(
+            name for name, p in self.node_pool.items() if p == pool
+        )
+
+
+class SelectorPoolIndex:
+    """pool -> multiset of (label key, value) pairs present on >= 1 node,
+    maintained incrementally so selector routing never rescans the
+    cluster. ``pools_for`` returns the pools whose nodes *may* match a
+    node selector — an over-approximation (every term present somewhere
+    in the pool, not necessarily on one node), which is safe: routing a
+    pod to MORE pools only merges more, never splits what must stay
+    together."""
+
+    def __init__(self) -> None:
+        # pool -> {(key, value): node count}
+        self._pairs: Dict[str, Dict[tuple, int]] = {}
+        # node name -> (seed pool, label pairs) as last indexed, so a
+        # refresh needs only the node's NEW state.
+        self._node_state: Dict[str, tuple] = {}
+        # pool -> node count (a pool with zero nodes stops seeding).
+        self._pool_nodes: Dict[str, int] = {}
+
+    @staticmethod
+    def _node_labels(snap_node) -> dict:
+        node = getattr(snap_node.partitionable, "node", None)
+        return dict(node.metadata.labels) if node is not None else {}
+
+    @staticmethod
+    def seed_of(snap_node) -> str:
+        node = getattr(snap_node.partitionable, "node", None)
+        if node is None:
+            return DEFAULT_POOL
+        return node.metadata.labels.get(GKE_NODEPOOL_LABEL, DEFAULT_POOL)
+
+    def rebuild(self, snapshot: ClusterSnapshot) -> None:
+        self._pairs = {}
+        self._node_state = {}
+        self._pool_nodes = {}
+        for name, snap_node in snapshot.get_nodes().items():
+            self.note(name, snap_node)
+
+    def note(self, name: str, snap_node) -> None:
+        """Index (or re-index) one node's current labels."""
+        self.forget(name)
+        pool = self.seed_of(snap_node)
+        pairs = tuple(sorted(self._node_labels(snap_node).items()))
+        self._node_state[name] = (pool, pairs)
+        self._pool_nodes[pool] = self._pool_nodes.get(pool, 0) + 1
+        counts = self._pairs.setdefault(pool, {})
+        for pair in pairs:
+            counts[pair] = counts.get(pair, 0) + 1
+
+    def forget(self, name: str) -> None:
+        state = self._node_state.pop(name, None)
+        if state is None:
+            return
+        pool, pairs = state
+        remaining_nodes = self._pool_nodes.get(pool, 0) - 1
+        if remaining_nodes > 0:
+            self._pool_nodes[pool] = remaining_nodes
+        else:
+            self._pool_nodes.pop(pool, None)
+        counts = self._pairs.get(pool)
+        if counts is None:
+            return
+        for pair in pairs:
+            remaining = counts.get(pair, 0) - 1
+            if remaining > 0:
+                counts[pair] = remaining
+            else:
+                counts.pop(pair, None)
+        if not counts:
+            self._pairs.pop(pool, None)
+
+    def seeds(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._pool_nodes))
+
+    def pools_for(self, selector_items: Tuple[tuple, ...]) -> Tuple[str, ...]:
+        """Pools that may satisfy a node selector (sorted). An empty
+        selector matches every pool."""
+        if not selector_items:
+            return self.seeds()
+        return tuple(
+            sorted(
+                pool
+                for pool, counts in self._pairs.items()
+                if all(pair in counts for pair in selector_items)
+            )
+        )
+
+
+class _UnionFind:
+    def __init__(self, keys: Iterable[str]) -> None:
+        self._parent = {key: key for key in keys}
+
+    def find(self, key: str) -> str:
+        parent = self._parent
+        root = key
+        while parent[root] != root:
+            root = parent[root]
+        while parent[key] != root:
+            parent[key], key = root, parent[key]
+        return root
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra == rb:
+            return
+        # Smaller id wins the root so merged pool ids are deterministic.
+        if rb < ra:
+            ra, rb = rb, ra
+        self._parent[rb] = ra
+
+
+def _mega(partition_nodes: Iterable[str], pending_pods: List[Pod], reason: str) -> PoolPartition:
+    return PoolPartition(
+        pools=(MEGA_POOL,),
+        node_pool={name: MEGA_POOL for name in partition_nodes},
+        pod_pool={p.namespaced_name: MEGA_POOL for p in pending_pods},
+        merged_from={},
+        single_pool_reason=reason,
+    )
+
+
+def partition_pools(
+    snapshot: ClusterSnapshot,
+    pending_pods: List[Pod],
+    quotas: Iterable = (),
+    selector_index: Optional[SelectorPoolIndex] = None,
+) -> PoolPartition:
+    """Decompose the snapshot into independently plannable pools.
+
+    Pure function of its inputs: identical (snapshot shape, pending set,
+    quota bounds) yield an identical partition, which is what keeps pool
+    membership — and therefore per-pool planner memos — stable across
+    no-op cycles."""
+    nodes = snapshot.get_nodes()
+    # Cluster-wide constraints first: any of these makes per-pool verdicts
+    # unsound (they read nodes outside the candidate's pool), so locality
+    # cannot be assumed for ANY pod this cycle.
+    for pod in pending_pods:
+        if needs_cluster_context(pod):
+            return _mega(
+                nodes, pending_pods,
+                f"pending pod {pod.namespaced_name} needs cluster-wide context",
+            )
+        affinity = pod.spec.affinity
+        if affinity is not None and affinity.required_terms:
+            return _mega(
+                nodes, pending_pods,
+                f"pending pod {pod.namespaced_name} has required node affinity",
+            )
+    if snapshot.has_anti_affinity_pods():
+        return _mega(
+            nodes, pending_pods,
+            "placed pods carry required anti-affinity (symmetric terms)",
+        )
+
+    index = selector_index
+    if index is None:
+        index = SelectorPoolIndex()
+        index.rebuild(snapshot)
+    seeds = index.seeds()
+    if not seeds:
+        return _mega(nodes, pending_pods, "no nodes")
+    uf = _UnionFind(seeds)
+
+    # Selector routing: a pod whose selector spans several pools couples
+    # them (the planner must choose among all of them); a selector no pool
+    # can satisfy routes to the first pool, where it will report unserved.
+    routed: Dict[str, str] = {}
+    gang_members: Dict[str, List[str]] = {}
+    coupled_quota_pods: List[str] = []
+    coupled_namespaces = {
+        q.metadata.namespace
+        for q in quotas
+        if tuple(sorted(q.spec.min.items())) != tuple(sorted(q.spec.max.items()))
+    }
+    for pod in pending_pods:
+        selector = tuple(sorted(pod.spec.node_selector.items()))
+        matched = index.pools_for(selector)
+        if not matched:
+            routed[pod.namespaced_name] = seeds[0]
+        else:
+            first = matched[0]
+            for other in matched[1:]:
+                uf.union(first, other)
+            routed[pod.namespaced_name] = first
+        gang = _gang_of(pod)
+        if gang:
+            gang_members.setdefault(gang[0], []).append(pod.namespaced_name)
+        if pod.metadata.namespace in coupled_namespaces:
+            coupled_quota_pods.append(pod.namespaced_name)
+
+    # Gang edges: every member of a gang — pending or already bound —
+    # must be planned by one pool, or a pool could carve for a gang
+    # another pool just proved half-formable.
+    if gang_members:
+        bound_pool: Dict[str, List[str]] = {}
+        for name, snap_node in nodes.items():
+            for placed in snap_node.pods:
+                gang = _gang_of(placed)
+                if gang and gang[0] in gang_members:
+                    bound_pool.setdefault(gang[0], []).append(
+                        index.seed_of(snap_node)
+                    )
+        for key, members in gang_members.items():
+            anchor = routed[members[0]]
+            for member in members[1:]:
+                uf.union(anchor, routed[member])
+            for pool in bound_pool.get(key, ()):
+                uf.union(anchor, pool)
+
+    # Quota borrowing (spec.max != spec.min) lets one namespace's usage
+    # displace another's over-quota pods, so pending pods under borrowing
+    # quotas plan together.
+    if len(coupled_quota_pods) > 1:
+        anchor = routed[coupled_quota_pods[0]]
+        for name in coupled_quota_pods[1:]:
+            uf.union(anchor, routed[name])
+
+    node_pool = {
+        name: uf.find(index.seed_of(snap_node))
+        for name, snap_node in nodes.items()
+    }
+    pod_pool = {name: uf.find(pool) for name, pool in routed.items()}
+    merged_from: Dict[str, Tuple[str, ...]] = {}
+    for seed in seeds:
+        root = uf.find(seed)
+        if root != seed:
+            merged_from.setdefault(root, (root,))
+            merged_from[root] = tuple(sorted(set(merged_from[root]) | {seed}))
+    pools = tuple(sorted({uf.find(seed) for seed in seeds}))
+    return PoolPartition(
+        pools=pools,
+        node_pool=node_pool,
+        pod_pool=pod_pool,
+        merged_from=merged_from,
+        single_pool_reason="",
+    )
+
+
+# --------------------------------------------------------------- split
+
+
+def split_snapshot(
+    snapshot: ClusterSnapshot, partition: PoolPartition
+) -> Dict[str, ClusterSnapshot]:
+    """Per-pool snapshots with cloned nodes. Versions are reset to zero:
+    each pool snapshot runs its OWN mutation clock, and a tick inherited
+    from the source clock could alias a future tick of the pool clock —
+    version-keyed memos must never see two states share a key."""
+    by_pool: Dict[str, Dict[str, object]] = {pool: {} for pool in partition.pools}
+    for name, snap_node in snapshot.get_nodes().items():
+        clone = snap_node.plan_clone()
+        clone.version = 0
+        by_pool[partition.node_pool[name]][name] = clone
+    return {
+        pool: ClusterSnapshot(nodes, codec=snapshot.codec)
+        for pool, nodes in by_pool.items()
+    }
+
+
+def split_pending(
+    pending_pods: List[Pod], partition: PoolPartition
+) -> Dict[str, List[Pod]]:
+    """Pending pods routed per pool, original order preserved."""
+    out: Dict[str, List[Pod]] = {pool: [] for pool in partition.pools}
+    for pod in pending_pods:
+        out[partition.pod_pool[pod.namespaced_name]].append(pod)
+    return out
+
+
+# --------------------------------------------------------------- merge
+
+
+def merge_pool_states(
+    states: Dict[str, PartitioningState],
+) -> PartitioningState:
+    """Deterministic recombination: pools in sorted id order, nodes in
+    sorted name order — byte-identical output regardless of the order the
+    pool plans finished in."""
+    merged: Dict[str, NodePartitioning] = {}
+    for pool in sorted(states):
+        for name in sorted(states[pool]):
+            merged[name] = states[pool][name]
+    return dict(sorted(merged.items()))
+
+
+_CHIPS_PER_RESOURCE: Dict[str, float] = {}
+
+
+def _resource_chips(resource: str) -> float:
+    """Chips (or GB for sharing-mode resources) one unit of ``resource``
+    amounts to; memoized — the invariant check calls this for every board
+    resource of every touched node every cycle, and the underlying
+    regex parses are the dominant cost at 16k nodes."""
+    cached = _CHIPS_PER_RESOURCE.get(resource)
+    if cached is not None:
+        return cached
+    if constants.is_tpu_slice_resource(resource):
+        chips = float(topology_chips(constants.tpu_slice_topology(resource)))
+    elif resource == constants.RESOURCE_TPU:
+        chips = 1.0
+    elif constants.is_tpu_shared_resource(resource):
+        chips = float(
+            constants.shared_profile_gb(constants.tpu_shared_profile(resource))
+        )
+    else:
+        chips = 0.0
+    _CHIPS_PER_RESOURCE[resource] = chips
+    return chips
+
+
+def _board_chips(board) -> float:
+    """One board's partitioned capacity, in chips for slice/plain
+    resources and GB for sharing-mode resources (a consistent measure is
+    all conservation needs — carving never creates or destroys either)."""
+    total = 0.0
+    for resource, qty in board.resources.items():
+        total += _resource_chips(resource) * qty
+    return total
+
+
+def node_capacity(snap_node) -> Optional[float]:
+    """The node's total partitionable capacity in the same measure as
+    :func:`_board_chips` (chips, or GB for sharing nodes); None when the
+    node object carries neither resource kind."""
+    node = getattr(snap_node.partitionable, "node", None)
+    if node is None:
+        return None
+    qty = node.status.capacity.get(constants.RESOURCE_TPU)
+    if qty:
+        return float(qty)
+    total = 0.0
+    for resource, count in node.status.capacity.items():
+        if constants.is_tpu_shared_resource(resource):
+            total += constants.shared_profile_gb(
+                constants.tpu_shared_profile(resource)
+            ) * count
+    return total or None
+
+
+def node_capacities(snapshots: Iterable[ClusterSnapshot]) -> Dict[str, float]:
+    """node -> capacity over a collection of (pool) snapshots, for
+    :func:`check_merge_invariants`'s minting ceiling."""
+    out: Dict[str, float] = {}
+    for snap in snapshots:
+        for name, snap_node in snap.get_nodes().items():
+            cap = node_capacity(snap_node)
+            if cap is not None:
+                out[name] = cap
+    return out
+
+
+def check_merge_invariants(
+    partition: PoolPartition,
+    pool_current: Dict[str, PartitioningState],
+    pool_desired: Dict[str, PartitioningState],
+    capacities: Optional[Dict[str, float]] = None,
+) -> List[str]:
+    """Cross-pool safety net run on every sharded plan before actuation.
+    Returns human-readable violations (empty = sound): a node claimed by
+    two pools, a partition node no pool planned (or a planned node outside
+    the partition), a node whose desired state lists the same board twice
+    (merge corruption), or a node whose desired chip total exceeds its
+    physical capacity (minting). Re-carving a board to a different chip
+    total is deliberately legal — tearing down a degraded board and
+    carving it back to full is exactly what a replan after chip-loss
+    faults does — so the chip invariant is the capacity ceiling, not
+    per-board equality."""
+    violations: List[str] = []
+    seen: Dict[str, str] = {}
+    for pool, desired in pool_desired.items():
+        for name in desired:
+            prior = seen.get(name)
+            if prior is not None:
+                violations.append(
+                    f"node {name} claimed by pools {prior} and {pool}"
+                )
+            seen[name] = pool
+            if partition.node_pool.get(name) != pool:
+                violations.append(
+                    f"node {name} planned by pool {pool} but assigned to "
+                    f"{partition.node_pool.get(name)!r}"
+                )
+    missing = set(partition.node_pool) - set(seen)
+    for name in sorted(missing):
+        violations.append(f"node {name} missing from every pool plan")
+    for pool in sorted(pool_desired):
+        current = pool_current.get(pool, {})
+        for name in sorted(pool_desired[pool]):
+            if pool_desired[pool][name] is current.get(name):
+                # The memoized partitioning_state returns the SAME object
+                # for a node the plan never touched — nothing to check,
+                # and skipping it keeps this pass O(touched), not
+                # O(cluster), at 16k nodes per cycle.
+                continue
+            desired_total = 0.0
+            board_indices = set()
+            for board in pool_desired[pool][name].boards:
+                desired_total += _board_chips(board)
+                if board.board_index in board_indices:
+                    violations.append(
+                        f"pool {pool}: node {name} lists board "
+                        f"{board.board_index} twice"
+                    )
+                board_indices.add(board.board_index)
+            cap = (capacities or {}).get(name)
+            if cap is not None and desired_total > cap + 1e-9:
+                violations.append(
+                    f"pool {pool}: node {name} desired {desired_total} "
+                    f"chips exceeds capacity {cap}"
+                )
+    return violations
+
+
+# ----------------------------------------------------------- execution
+
+
+def run_pool_plans(
+    tasks: Dict[str, Callable[[], object]],
+    parallelism: str = "serial",
+    max_workers: int = 0,
+) -> Dict[str, object]:
+    """Run one closure per pool; serial mode executes in sorted pool
+    order (reproducible), thread mode fans out on a ThreadPoolExecutor.
+    On a single GIL-bound core the thread mode measures slightly WORSE
+    than serial (bench_planner --parallel reports both); it exists for
+    multi-core deployments and for honest measurement, not as a default."""
+    if parallelism == "thread" and len(tasks) > 1:
+        workers = max_workers if max_workers > 0 else len(tasks)
+        with ThreadPoolExecutor(max_workers=min(workers, len(tasks))) as pool:
+            futures = {
+                name: pool.submit(task) for name, task in sorted(tasks.items())
+            }
+            return {name: future.result() for name, future in futures.items()}
+    return {name: task() for name, task in sorted(tasks.items())}
+
+
+# ------------------------------------------------------- equivalence
+
+
+def draw_decomposes(
+    snapshot: ClusterSnapshot,
+    partition: PoolPartition,
+    candidates: List[Pod],
+) -> bool:
+    """Whether the global planner's sequential free-pool draw (first-fit
+    over `candidates`, which the caller passes already sorted) yields the
+    same per-pod lack when each pod draws only from its own pool's free
+    slices. When true — pool-independent inputs — the sharded and
+    unsharded paths provably produce byte-identical PartitioningStates;
+    when false, a contested profile may be served to different pods and
+    the paths may diverge (soundly, but not identically). Test and bench
+    oracle; never on the hot path."""
+    from nos_tpu.util import resources as res
+
+    codec = snapshot.codec
+    global_pool = snapshot.free_slice_resources()
+    accelerators = snapshot.accelerators()
+    pool_free: Dict[str, dict] = {pool: {} for pool in partition.pools}
+    pool_accels: Dict[str, set] = {pool: set() for pool in partition.pools}
+    for name, snap_node in snapshot.get_nodes().items():
+        pool = partition.node_pool[name]
+        free = pool_free[pool]
+        for profile, qty in snap_node.partitionable.free_slices().items():
+            resource = codec.resource(profile)
+            free[resource] = free.get(resource, 0) + qty
+        accel = getattr(snap_node.partitionable, "accelerator", "")
+        if accel:
+            pool_accels[pool].add(accel)
+    for pod in candidates:
+        request = res.compute_pod_request(pod)
+        global_lack = codec.take_from_pool(
+            global_pool, request, accelerators
+        )
+        pool = partition.pod_pool[pod.namespaced_name]
+        local_lack = codec.take_from_pool(
+            pool_free[pool], request, sorted(pool_accels[pool])
+        )
+        if global_lack != local_lack:
+            return False
+    return True

@@ -146,14 +146,27 @@ class ClusterSnapshot:
         # mutation and revert.
         self._free_chips_cache: Dict[str, tuple] = {}
         # Best-fit candidate order, maintained incrementally: (order list,
-        # state_version at build) plus the names mutated since the build.
-        # A placement dirties ONE node, so the next call repairs the prior
-        # order (drop dirty names, re-insert by current key) instead of
-        # re-sorting the whole cluster — the repair reproduces the full
-        # sort exactly because untouched nodes keep their keys and the
-        # (chips, name) key is a total order.
+        # parallel sort-key list, state_version at build) plus the names
+        # mutated since the build. A placement dirties ONE node, so the
+        # next call repairs the prior order (bisect-remove each dirty name
+        # at its RECORDED key, bisect-insert at its current key) instead
+        # of re-sorting — or re-filtering — the whole cluster. The repair
+        # reproduces the full sort exactly because untouched nodes keep
+        # their keys and the (chips, name) key is a total order; keeping
+        # the key list parallel to the order list is what makes removal a
+        # binary search + C-level pop instead of an O(nodes) Python scan.
         self._cand_cache: Optional[tuple] = None
         self._cand_dirty: set = set()
+        # name -> (chips, name) key the candidate order currently holds
+        # for that member (absent = filtered out: frozen or no capacity).
+        self._cand_keys: Dict[str, tuple] = {}
+        # name -> (version, boards) for the partitioning_state projection:
+        # building BoardPartitioning rows is an O(nodes) dict walk per
+        # call, and the projection runs at least twice per plan cycle
+        # (observed state + desired state) over mostly-untouched nodes.
+        # Entries are shared with callers — the projection is read-only by
+        # contract (actuators and recorders never mutate it).
+        self._part_state_cache: Dict[str, tuple] = {}
 
     # ------------------------------------------------------ fork/commit
 
@@ -339,23 +352,37 @@ class ClusterSnapshot:
 
         The order is cached and repaired incrementally: a plan placement
         dirties one node, so re-sorting the whole cluster per call (the
-        dominant replan cost at 1k+ nodes) is replaced by dropping the
-        dirty names from the previous order and bisect-inserting them at
-        their current keys — byte-identical output to the full sort."""
+        dominant replan cost at 1k+ nodes) is replaced by bisect-removing
+        the dirty names at their recorded keys and bisect-inserting them
+        at their current keys — byte-identical output to the full sort at
+        O(dirty · log nodes) comparisons. The lists are copied before
+        repair (a C-level pointer memcpy) so iterations over previously
+        returned orders never see mid-repair mutation."""
         cached = self._cand_cache
-        if cached is not None and cached[1] == self.state_version:
+        if cached is not None and cached[2] == self.state_version:
             return cached[0]
         dirty = self._cand_dirty
         if cached is not None and len(dirty) * 8 <= len(self._nodes):
-            order = [n for n in cached[0] if n not in dirty]
+            order = list(cached[0])
+            keys = list(cached[1])
             for name in sorted(dirty):
+                old_key = self._cand_keys.pop(name, None)
+                if old_key is not None:
+                    index = bisect.bisect_left(keys, old_key)
+                    if index < len(order) and order[index] == name:
+                        order.pop(index)
+                        keys.pop(index)
                 node = self._nodes.get(name)
                 if node is None or node.frozen:
                     continue
                 chips, has_free, _ = self._node_free_state(name, node)
                 if not has_free:
                     continue
-                bisect.insort(order, name, key=self._cand_sort_key)
+                key = (chips, name)
+                index = bisect.bisect_left(keys, key)
+                order.insert(index, name)
+                keys.insert(index, key)
+                self._cand_keys[name] = key
         else:
             states = {
                 name: self._node_free_state(name, node)
@@ -369,7 +396,9 @@ class ClusterSnapshot:
                 )
                 if states[name][1] and not node.frozen
             ]
-        self._cand_cache = (order, self.state_version)
+            keys = [(states[name][0], name) for name in order]
+            self._cand_keys = dict(zip(order, keys))
+        self._cand_cache = (order, keys, self.state_version)
         dirty.clear()
         return order
 
@@ -400,6 +429,8 @@ class ClusterSnapshot:
         self._sim_cache = None
         self._cand_cache = None
         self._cand_dirty.clear()
+        self._cand_keys = {}
+        self._part_state_cache = {}
         self.state_version = next(self._mutation_clock)
 
     def _stamp(self, node: SnapshotNode) -> None:
@@ -521,19 +552,38 @@ class ClusterSnapshot:
     # ------------------------------------------------------ projection
 
     def partitioning_state(self) -> PartitioningState:
+        """Projection of every node's current geometry. Board rows are
+        memoized per (node, mutation version) — the projection runs at
+        least twice per plan cycle over mostly-untouched nodes, and the
+        mutation clock makes the memo exact (a revert restores pre-fork
+        versions together with pre-fork geometry). The returned structures
+        are shared across calls and read-only by contract."""
         out: PartitioningState = {}
+        cache = self._part_state_cache
+        resource = self.codec.resource
         for name, node in self._nodes.items():
-            boards = [
-                BoardPartitioning(
-                    board_index=index,
-                    resources={
-                        self.codec.resource(profile): qty
-                        for profile, qty in geometry.items()
-                    },
-                )
-                for index, geometry in sorted(node.partitionable.geometry().items())
-            ]
-            out[name] = NodePartitioning(boards=boards)
+            entry = cache.get(name)
+            if entry is None or entry[0] != node.version:
+                boards = [
+                    BoardPartitioning(
+                        board_index=index,
+                        resources={
+                            resource(profile): qty
+                            for profile, qty in geometry.items()
+                        },
+                    )
+                    for index, geometry in sorted(
+                        node.partitionable.geometry().items()
+                    )
+                ]
+                # The NodePartitioning itself is memoized, not just its
+                # boards: an untouched node projects as the SAME object in
+                # consecutive calls, so current-vs-desired diffs (merge
+                # invariants, actuation) can identity-skip it, and a 16k-
+                # node cycle does not allocate 16k throwaway wrappers.
+                entry = (node.version, NodePartitioning(boards=boards))
+                cache[name] = entry
+            out[name] = entry[1]
         return out
 
 
@@ -557,6 +607,8 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._free_chips_cache = {}
         self._cand_cache = None
         self._cand_dirty.clear()
+        self._cand_keys = {}
+        self._part_state_cache = {}
 
     def commit(self) -> int:
         if not self._deep_stack:
@@ -567,6 +619,8 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._free_chips_cache = {}
         self._cand_cache = None
         self._cand_dirty.clear()
+        self._cand_keys = {}
+        self._part_state_cache = {}
         return len(self._nodes)
 
     def revert(self) -> int:
@@ -581,6 +635,8 @@ class DeepcopyClusterSnapshot(ClusterSnapshot):
         self._free_chips_cache = {}
         self._cand_cache = None
         self._cand_dirty.clear()
+        self._cand_keys = {}
+        self._part_state_cache = {}
         return len(self._nodes)
 
     @property

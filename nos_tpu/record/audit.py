@@ -152,6 +152,45 @@ class InvariantAuditor:
         self.publish(violations, snapshot, revision)
         return violations
 
+    def audit_sharded_plan(
+        self,
+        pool_runs,
+        snapshot=None,
+        exhaustive: bool = False,
+        revision: int = 0,
+        ledger=None,
+    ) -> List[AuditViolation]:
+        """Per-pool generalization of ``audit_plan``: under pool-sharded
+        planning every pool's planner ran against its own snapshot shard,
+        so every check — including the from-scratch ``incremental_plan``
+        shadow replan — must hold pool by pool. ``pool_runs`` is an
+        iterable of ``(pool, planner, pool_snapshot, pending, desired)``
+        tuples; violation subjects are prefixed with the pool id so a
+        drifting shard is named directly. The global ``snapshot`` (the
+        unsharded base) is used only for Event targeting, and the ledger
+        check stays cluster-scoped."""
+        violations: List[AuditViolation] = []
+        for pool, planner, pool_snapshot, pending, desired in pool_runs:
+            pool_violations: List[AuditViolation] = []
+            pool_violations += self.check_free_pool(pool_snapshot)
+            pool_violations += self.check_mutation_clock(pool_snapshot)
+            pool_violations += self.check_lacking_totals(planner.last_tracker)
+            pool_violations += self.check_verdict_cache(
+                planner, pool_snapshot, exhaustive
+            )
+            pool_violations += self.check_carve_futility(
+                planner, pool_snapshot, exhaustive
+            )
+            pool_violations += self.check_incremental_plan(
+                planner, pool_snapshot, pending, desired
+            )
+            for violation in pool_violations:
+                violation.subject = f"pool={pool}/{violation.subject}"
+            violations += pool_violations
+        violations += self.check_capacity_ledger(ledger)
+        self.publish(violations, snapshot, revision)
+        return violations
+
     def publish(
         self, violations: List[AuditViolation], snapshot=None, revision: int = 0
     ) -> None:

@@ -393,6 +393,30 @@ PLAN_MODE = REGISTRY.counter(
     "dirty set, shape/quota change), full is the legacy "
     "snapshot-consuming path",
 )
+PLAN_POOL_COUNT = REGISTRY.gauge(
+    "nos_tpu_plan_pool_count",
+    "Independent planning pools the most recent sharded cycle "
+    "partitioned the cluster into (by kind); 1 means the pool graph was "
+    "connected (mega-pool) or sharding is off",
+)
+PLAN_POOL_DURATION = REGISTRY.histogram(
+    "nos_tpu_plan_pool_duration_seconds",
+    "Per-pool Planner.plan() wall time within a sharded cycle (by pool)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+)
+PLAN_MERGE_CONFLICTS = REGISTRY.counter(
+    "nos_tpu_plan_merge_conflicts_total",
+    "Sharded cycles whose cross-pool merge invariants failed (a node "
+    "claimed twice, a node unplanned, a board listed twice, or physical "
+    "capacity exceeded); the cycle's plan is discarded and the next "
+    "cycle rebuilds the partition from scratch",
+)
+WARM_BOOT_OUTCOME = REGISTRY.counter(
+    "nos_tpu_warm_boot_outcome_total",
+    "Warm-state adoption attempts at startup/full-rebuild by outcome "
+    "(outcome=adopted|partial|cold): adopted = every node's signature "
+    "matched, partial = some matched, cold = no usable warm state",
+)
 MULTIHOST_EXPANSIONS = REGISTRY.counter(
     "nos_tpu_multihost_expansions_total",
     "Oversized chip requests expanded into multi-host slice gangs",

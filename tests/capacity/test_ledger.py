@@ -10,7 +10,9 @@ from nos_tpu.capacity import (
     BUCKET_RECONFIG,
     BUCKET_RESERVED,
     CapacityLedger,
+    cluster_fragmentation_index,
     fragmentation_from_annotations,
+    largest_profile_chips,
 )
 from nos_tpu.capacity.ledger import dominant_unserved_reason, state_from_store
 from nos_tpu.kube.store import KubeStore
@@ -222,6 +224,44 @@ class TestFragmentation:
         index, largest, free = fragmentation_from_annotations(ann, V5E)
         assert (largest, free) == (4, 8)
         assert index == pytest.approx(0.5)
+
+    def test_cluster_index_is_not_the_weighted_node_mean(self):
+        """Regression for the bench_capacity report of fragmentation 0.0
+        at 81.85% utilization with a 2-chip largest free slice out of
+        1487 free chips: the free-weighted mean of per-node indices goes
+        to 0.0 exactly when every node is reduced to slivers. Hand-made
+        3-node fixture: each node's free capacity is one 1x2 (2 chips),
+        so every per-node index is 0.0 (largest carve == node free), the
+        old rollup reported 0.0 — but cluster-wide the best carve is 2
+        chips against min(6 free, 8 largest-profile) askable:
+        index = 1 - 2/6 ≈ 0.667."""
+        sliver = annot.status_from_devices(
+            free={0: {"1x2": 1}}, used={0: {"1x2": 3}}
+        )
+        per_node = fragmentation_from_annotations(dict(sliver), V5E)
+        assert per_node == (0.0, 2, 2)
+        assert largest_profile_chips(V5E) == 8
+        assert cluster_fragmentation_index(6, 2, 8) == pytest.approx(2.0 / 3.0)
+        # End to end through the ledger's /debug rollup.
+        store, ledger = make_ledger()
+        for i in range(3):
+            store.create(
+                build_tpu_node(name=f"n{i}", chips=8, annotations=dict(sliver))
+            )
+        ledger.observe(T0)
+        cluster = ledger.debug_payload()["cluster"]
+        assert cluster["fragmentation"] == pytest.approx(2.0 / 3.0)
+        assert cluster["largest_free_slice_chips"] == 2
+        # The bench shape itself: best carve 2 chips, free total huge, so
+        # the askable bound is the 8-chip largest profile -> 0.75.
+        assert cluster_fragmentation_index(1487, 2, 8) == pytest.approx(0.75)
+
+    def test_cluster_index_zero_when_nothing_free_or_biggest_fits(self):
+        assert cluster_fragmentation_index(0, 0, 8) == 0.0
+        # A whole board free somewhere: the largest askable slice fits.
+        assert cluster_fragmentation_index(24, 8, 8) == 0.0
+        # Unknown accelerator (no profile table): fall back to free total.
+        assert cluster_fragmentation_index(6, 2, 0) == pytest.approx(2.0 / 3.0)
 
 
 class TestGangClocks:

@@ -1,0 +1,146 @@
+"""End-to-end pool-sharded partitioner controller (ISSUE 13 tentpole):
+process_pending_pods with pool_sharding=True shards the cluster, plans
+pools independently, merges under invariants, actuates, and persists /
+adopts warm state across a simulated restart.
+"""
+import json
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1.labels import GKE_NODEPOOL_LABEL
+from nos_tpu.cmd.partitioner import register_indexers
+from nos_tpu.controllers.partitioner.controller import PartitionerController
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import Actuator, ClusterState, Planner
+from nos_tpu.partitioning.tpu import TpuPartitioner, TpuSnapshotTaker
+from nos_tpu.record.audit import InvariantAuditor
+from nos_tpu.scheduler.framework import (
+    Framework,
+    NodeResourcesFit,
+    NodeSelectorFit,
+)
+from nos_tpu.util import metrics
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+def make_store(pools=("pool-a", "pool-b"), nodes_per_pool=2):
+    store = KubeStore()
+    register_indexers(store)
+    for pool in pools:
+        for i in range(nodes_per_pool):
+            node = build_tpu_node(name=f"{pool}-n{i}")
+            node.metadata.labels[GKE_NODEPOOL_LABEL] = pool
+            store.create(node)
+    return store
+
+
+def pinned_pod(name, profile, pool):
+    pod = build_pod(name, {slice_res(profile): 1}, scheduler="")
+    pod.spec.node_selector[GKE_NODEPOOL_LABEL] = pool
+    return pod
+
+
+def make_controller(store, auditor=None, warm_state_path="", **kwargs):
+    framework = Framework(
+        filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]
+    )
+    return PartitionerController(
+        store=store,
+        cluster_state=ClusterState(),
+        snapshot_taker=TpuSnapshotTaker(),
+        planner=Planner(framework),
+        actuator=Actuator(TpuPartitioner(store)),
+        kind="tpu",
+        batch_timeout_seconds=60.0,
+        batch_idle_seconds=60.0,
+        auditor=auditor,
+        incremental_planning=True,
+        incremental_dirty_threshold=1.0,
+        pool_sharding=True,
+        warm_state_path=warm_state_path,
+        **kwargs,
+    )
+
+
+class TestShardedController:
+    def test_sharded_cycle_plans_and_actuates_per_pool(self):
+        store = make_store()
+        auditor = InvariantAuditor(sample_rate=1.0)
+        controller = make_controller(store, auditor=auditor)
+        store.create(pinned_pod("pa", "2x2", "pool-a"))
+        store.create(pinned_pod("pb", "1x2", "pool-b"))
+        applied = controller.process_pending_pods()
+        assert applied >= 2  # one carve per pool
+        assert auditor.violations_total == 0
+        assert metrics.PLAN_POOL_COUNT.labels(kind="tpu").value == 2
+        # Each pool's carve landed on that pool's nodes only.
+        carved = {
+            name: annot.parse_node_annotations(node.metadata.annotations)[0]
+            for name, node in (
+                (n, store.get("Node", n))
+                for n in [f"{p}-n{i}" for p in ("pool-a", "pool-b") for i in range(2)]
+            )
+            if annot.SPEC_PARTITIONING_PLAN in node.metadata.annotations
+        }
+        assert any(name.startswith("pool-a") for name in carved)
+        assert any(name.startswith("pool-b") for name in carved)
+
+    def test_steady_state_keeps_pools_and_audits_clean(self):
+        store = make_store()
+        auditor = InvariantAuditor(sample_rate=1.0)
+        controller = make_controller(store, auditor=auditor)
+        store.create(pinned_pod("pa", "2x2", "pool-a"))
+        store.create(pinned_pod("pb", "2x2", "pool-b"))
+        controller.process_pending_pods()
+        maintainer = controller._shard_maintainer
+        assert maintainer.pool_rebuilds == 1
+        # Further cycles with an unchanged world: no pool rebuilds, no
+        # memo flush, per-pool incremental replans, shadow oracle clean.
+        for _ in range(3):
+            controller.process_pending_pods()
+            assert not maintainer.last_rebuilt
+        assert maintainer.pool_rebuilds == 1
+        assert auditor.violations_total == 0
+        for pool, planner in controller._pool_planners.items():
+            assert planner.last_plan_mode == "incremental"
+
+    def test_unpinned_pod_collapses_to_single_pool(self):
+        store = make_store()
+        controller = make_controller(store)
+        store.create(build_pod("free", {slice_res("2x2"): 1}, scheduler=""))
+        applied = controller.process_pending_pods()
+        assert applied >= 1
+        assert metrics.PLAN_POOL_COUNT.labels(kind="tpu").value == 1
+
+    def test_warm_state_saved_and_adopted_after_restart(self, tmp_path):
+        path = str(tmp_path / "warm.json")
+        store = make_store()
+        controller = make_controller(store, warm_state_path=path)
+        # Unservable requests: futility memos everywhere, nothing placed,
+        # so the observed world at "restart" equals the saved one.
+        store.create(pinned_pod("pa", "4x4", "pool-a"))
+        store.create(pinned_pod("pb", "4x4", "pool-b"))
+        controller.process_pending_pods()
+        doc = json.loads((tmp_path / "warm.json").read_text())
+        assert set(doc["nodes"]) == {
+            "pool-a-n0", "pool-a-n1", "pool-b-n0", "pool-b-n1",
+        }
+        assert any(
+            entry["futility"] for entry in doc["nodes"].values()
+        )
+        # Restart: a brand-new controller over the same store adopts the
+        # warm state and its first sharded plan runs warm (empty dirty
+        # sets -> incremental mode) with identical unserved verdicts.
+        before = metrics.WARM_BOOT_OUTCOME.labels(outcome="adopted").value
+        restarted = make_controller(store, warm_state_path=path)
+        restarted.process_pending_pods()
+        assert (
+            metrics.WARM_BOOT_OUTCOME.labels(outcome="adopted").value
+            == before + 1
+        )
+        for pool, planner in restarted._pool_planners.items():
+            assert planner.last_plan_mode == "incremental"
+            assert planner._futility_hits > 0
+            assert set(planner.last_unserved) == {
+                "default/pa" if pool == "pool-a" else "default/pb"
+            }

@@ -105,6 +105,29 @@ def test_incremental_replan_floor_1024_nodes():
 
 
 @pytest.mark.slow
+def test_sharded_replan_floor_1024_nodes_8_pools():
+    """The pool-sharded headline (ISSUE 13) at test scale: 1024 nodes in
+    8 selector-pinned pools, 800 pending, 5% churn — the whole sharded
+    cycle (per-pool incremental replans + cross-pool merge + invariant
+    check) must stay under a generous wall bound, retain cross-cycle
+    caches (≥2x faster than the sharded cold plan), and keep the merge
+    overhead a small fraction of the cycle. bench_sharded itself raises
+    if any pool leaves incremental mode or the merge invariants fail."""
+    from bench_planner import bench_sharded
+
+    row = bench_sharded(1024, 800, repeats=4, pools=8, parallelism="serial")
+    assert row["p50_replan_ms"] < 10_000, row
+    assert row["p50_replan_ms"] * 2 < row["cold_plan_ms"], (
+        f"sharded replan p50 {row['p50_replan_ms']}ms is not ≥2x faster "
+        f"than the sharded cold plan {row['cold_plan_ms']}ms — per-pool "
+        f"cache retention has regressed"
+    )
+    assert row["p50_merge_ms"] < row["p50_replan_ms"], (
+        "cross-pool merge dominates the sharded cycle"
+    )
+
+
+@pytest.mark.slow
 def test_tracing_overhead_within_allowance():
     """The planner is instrumented (a span per carve trial, suppressed
     plugin spans in simulation). With TRACER.enabled=False those calls are

@@ -1,0 +1,550 @@
+"""Pool-sharded planning (ISSUE 13 tentpole): partition correctness,
+sharded-vs-unsharded equivalence, merge invariants, and pool-membership
+stability across no-op maintainer cycles.
+
+The contract: pools are seeded by the GKE node-pool label and merged by
+every edge that couples planning decisions (multi-pool selectors, gangs,
+borrowing quotas); anything cluster-wide degrades to one mega-pool; and
+on pool-independent inputs (draw_decomposes holds) the merged sharded
+plan is byte-identical to the unsharded planner's output.
+"""
+import json
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.labels import GKE_NODEPOOL_LABEL
+from nos_tpu.kube.objects import (
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from nos_tpu.partitioning.core import ClusterSnapshot, Planner, SnapshotNode
+from nos_tpu.partitioning.core.partition_state import (
+    partitioning_state_to_dict,
+)
+from nos_tpu.partitioning.core.pools import (
+    MEGA_POOL,
+    PoolPartition,
+    check_merge_invariants,
+    draw_decomposes,
+    merge_pool_states,
+    partition_pools,
+    split_pending,
+    split_snapshot,
+)
+from nos_tpu.scheduler.framework import (
+    Framework,
+    NodeResourcesFit,
+    NodeSelectorFit,
+)
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+def make_framework():
+    return Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+
+
+def pool_node(name, pool=None, annotations=None):
+    from nos_tpu.tpu.node import TpuNode
+
+    node = build_tpu_node(name=name, annotations=annotations)
+    if pool is not None:
+        node.metadata.labels[GKE_NODEPOOL_LABEL] = pool
+    return SnapshotNode(partitionable=TpuNode(node))
+
+
+def make_snapshot(nodes):
+    return ClusterSnapshot(dict(sorted(nodes.items())))
+
+
+def pinned_pod(name, profile, pool):
+    pod = build_pod(name, {slice_res(profile): 1})
+    pod.spec.node_selector[GKE_NODEPOOL_LABEL] = pool
+    return pod
+
+
+def two_pool_world():
+    """Two 2-node pools, partially carved so plans are non-trivial."""
+    carved = annot.status_from_devices(free={0: {"1x1": 2}}, used={0: {"2x2": 1}})
+    nodes = {
+        "a0": pool_node("a0", "pool-a"),
+        "a1": pool_node("a1", "pool-a", annotations=dict(carved)),
+        "b0": pool_node("b0", "pool-b"),
+        "b1": pool_node("b1", "pool-b", annotations=dict(carved)),
+    }
+    return make_snapshot(nodes)
+
+
+def zero_ages(pods):
+    return {p.namespaced_name: 0.0 for p in pods}
+
+
+def plan_unsharded(snapshot, pending):
+    planner = Planner(make_framework())
+    return planner.plan(snapshot, list(pending), pending_ages=zero_ages(pending))
+
+
+def plan_sharded(snapshot, pending, quotas=()):
+    """The controller's sharded pipeline, inlined: partition, split,
+    per-pool plan, invariant check, deterministic merge."""
+    partition = partition_pools(snapshot, pending, quotas=quotas)
+    pool_snaps = split_snapshot(snapshot, partition)
+    pool_pending = split_pending(pending, partition)
+    pool_desired, pool_current = {}, {}
+    for pool in partition.pools:
+        planner = Planner(make_framework())
+        # Pre-plan state first: plan() commits carves into its base.
+        pool_current[pool] = pool_snaps[pool].partitioning_state()
+        pool_desired[pool] = planner.plan(
+            pool_snaps[pool],
+            pool_pending[pool],
+            pending_ages=zero_ages(pool_pending[pool]),
+        )
+    assert check_merge_invariants(partition, pool_current, pool_desired) == []
+    return merge_pool_states(pool_desired), partition
+
+
+def state_bytes(state):
+    return json.dumps(partitioning_state_to_dict(state), sort_keys=True)
+
+
+class TestPartitionPools:
+    def test_selector_pinned_pods_keep_pools_apart(self):
+        snapshot = two_pool_world()
+        pending = [
+            pinned_pod("pa", "2x2", "pool-a"),
+            pinned_pod("pb", "2x2", "pool-b"),
+        ]
+        partition = partition_pools(snapshot, pending)
+        assert partition.pools == ("pool-a", "pool-b")
+        assert partition.single_pool_reason == ""
+        assert partition.node_pool == {
+            "a0": "pool-a", "a1": "pool-a", "b0": "pool-b", "b1": "pool-b",
+        }
+        assert partition.pod_pool == {
+            "default/pa": "pool-a", "default/pb": "pool-b",
+        }
+
+    def test_unpinned_pod_connects_every_pool(self):
+        """An empty selector matches every pool: the planner must choose
+        among all of them, so the whole graph collapses into one pool
+        named after the smallest seed (stable id, not the mega-pool)."""
+        snapshot = two_pool_world()
+        pending = [build_pod("free", {slice_res("2x2"): 1})]
+        partition = partition_pools(snapshot, pending)
+        assert partition.pools == ("pool-a",)
+        assert partition.single_pool_reason == ""
+        assert set(partition.node_pool.values()) == {"pool-a"}
+        assert partition.merged_from == {"pool-a": ("pool-a", "pool-b")}
+
+    def test_gang_spanning_two_pools_forces_merge(self):
+        snapshot = two_pool_world()
+        members = []
+        for i, pool in enumerate(["pool-a", "pool-b"]):
+            pod = pinned_pod(f"g{i}", "2x2", pool)
+            pod.metadata.labels[GANG_NAME_LABEL] = "g"
+            pod.metadata.labels[GANG_SIZE_LABEL] = "2"
+            members.append(pod)
+        # A third, unrelated pinned pod shows the merge is the gang's
+        # doing, not a global collapse.
+        partition = partition_pools(snapshot, members)
+        assert partition.pools == ("pool-a",)
+        assert partition.pod_pool["default/g0"] == "pool-a"
+        assert partition.pod_pool["default/g1"] == "pool-a"
+
+    def test_gang_bound_member_pins_pending_member_to_its_pool(self):
+        """A gang with one member already RUNNING in pool-b couples the
+        still-pending member's pool (pool-a, by selector) to pool-b: the
+        union joins both, so no pool can carve for a gang another pool
+        already half-placed."""
+        carved = annot.status_from_devices(
+            free={0: {"1x1": 2}}, used={0: {"2x2": 1}}
+        )
+        bound = build_pod("g-bound", {slice_res("2x2"): 1}, node="b0")
+        bound.status.phase = "Running"
+        bound.metadata.labels[GANG_NAME_LABEL] = "g"
+        bound.metadata.labels[GANG_SIZE_LABEL] = "2"
+        from nos_tpu.tpu.node import TpuNode
+
+        b0 = build_tpu_node(name="b0", annotations=dict(carved))
+        b0.metadata.labels[GKE_NODEPOOL_LABEL] = "pool-b"
+        nodes = {
+            "a0": pool_node("a0", "pool-a"),
+            "b0": SnapshotNode(partitionable=TpuNode(b0), pods=[bound]),
+        }
+        snapshot = make_snapshot(nodes)
+        pending = pinned_pod("g-pend", "2x2", "pool-a")
+        pending.metadata.labels[GANG_NAME_LABEL] = "g"
+        pending.metadata.labels[GANG_SIZE_LABEL] = "2"
+        partition = partition_pools(snapshot, [pending])
+        assert partition.pools == ("pool-a",)
+        assert partition.node_pool["b0"] == "pool-a"
+
+    def test_required_node_affinity_degrades_to_mega_pool(self):
+        snapshot = two_pool_world()
+        pod = build_pod("aff", {slice_res("2x2"): 1})
+        pod.spec.affinity = NodeAffinity(required_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(
+                    key="pool", operator="In", values=["gold"]
+                ),
+            ])
+        ])
+        partition = partition_pools(snapshot, [pod])
+        assert partition.pools == (MEGA_POOL,)
+        assert "required node affinity" in partition.single_pool_reason
+        assert set(partition.node_pool.values()) == {MEGA_POOL}
+
+    def test_borrowing_quota_couples_namespaces(self):
+        from nos_tpu.api.v1alpha1.elasticquota import (
+            ElasticQuota,
+            ElasticQuotaSpec,
+        )
+        from nos_tpu.kube.objects import ObjectMeta
+
+        snapshot = two_pool_world()
+        pending = [
+            pinned_pod("pa", "2x2", "pool-a"),
+            pinned_pod("pb", "2x2", "pool-b"),
+        ]
+        borrowing = ElasticQuota(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=ElasticQuotaSpec(
+                min={constants.RESOURCE_TPU: 4},
+                max={constants.RESOURCE_TPU: 8},
+            ),
+        )
+        partition = partition_pools(snapshot, pending, quotas=[borrowing])
+        assert partition.pools == ("pool-a",)
+        # Fixed quotas (min == max) cannot displace anything: no edge.
+        fixed = ElasticQuota(
+            metadata=ObjectMeta(name="q", namespace="default"),
+            spec=ElasticQuotaSpec(
+                min={constants.RESOURCE_TPU: 8},
+                max={constants.RESOURCE_TPU: 8},
+            ),
+        )
+        partition = partition_pools(snapshot, pending, quotas=[fixed])
+        assert partition.pools == ("pool-a", "pool-b")
+
+
+class TestShardedEquivalence:
+    def test_pool_independent_inputs_byte_identical(self):
+        snapshot = two_pool_world()
+        pending = [
+            pinned_pod("pa0", "2x2", "pool-a"),
+            pinned_pod("pa1", "1x1", "pool-a"),
+            pinned_pod("pb0", "2x2", "pool-b"),
+        ]
+        partition = partition_pools(snapshot, pending)
+        assert len(partition.pools) == 2
+        assert draw_decomposes(snapshot, partition, pending)
+        sharded, _ = plan_sharded(snapshot, pending)
+        unsharded = plan_unsharded(two_pool_world(), pending)
+        assert state_bytes(sharded) == state_bytes(unsharded)
+
+    def test_connected_cluster_single_pool_byte_identical(self):
+        """A connected pool graph (unpinned pods) must shard into ONE
+        pool whose plan is byte-identical to the unsharded planner's --
+        sharding degrades to a clone, never to a different answer."""
+        snapshot = two_pool_world()
+        pending = [
+            build_pod("p0", {slice_res("2x2"): 1}),
+            build_pod("p1", {slice_res("1x1"): 1}),
+        ]
+        sharded, partition = plan_sharded(snapshot, pending)
+        assert partition.pools == ("pool-a",)
+        unsharded = plan_unsharded(two_pool_world(), pending)
+        assert state_bytes(sharded) == state_bytes(unsharded)
+
+    def test_unlabeled_nodes_form_implicit_default_pool(self):
+        nodes = {
+            "n0": pool_node("n0"),
+            "n1": pool_node("n1", "pool-b"),
+        }
+        snapshot = make_snapshot(nodes)
+        pending = [pinned_pod("pb", "2x2", "pool-b")]
+        partition = partition_pools(snapshot, pending)
+        assert partition.pools == ("default", "pool-b")
+        assert partition.node_pool["n0"] == "default"
+
+
+class TestMergeInvariants:
+    def _partition(self):
+        return PoolPartition(
+            pools=("pool-a", "pool-b"),
+            node_pool={"a0": "pool-a", "b0": "pool-b"},
+            pod_pool={},
+            merged_from={},
+            single_pool_reason="",
+        )
+
+    def _state_of(self, snapshot, names):
+        full = snapshot.partitioning_state()
+        return {name: full[name] for name in names}
+
+    def test_clean_split_passes(self):
+        snapshot = two_pool_world()
+        partition = partition_pools(
+            snapshot, [pinned_pod("pa", "2x2", "pool-a")]
+        )
+        pool_snaps = split_snapshot(snapshot, partition)
+        states = {
+            pool: snap.partitioning_state()
+            for pool, snap in pool_snaps.items()
+        }
+        assert check_merge_invariants(partition, states, states) == []
+
+    def test_node_claimed_twice_detected(self):
+        snapshot = make_snapshot(
+            {"a0": pool_node("a0", "pool-a"), "b0": pool_node("b0", "pool-b")}
+        )
+        partition = self._partition()
+        current = {
+            "pool-a": self._state_of(snapshot, ["a0"]),
+            "pool-b": self._state_of(snapshot, ["b0"]),
+        }
+        desired = {
+            "pool-a": self._state_of(snapshot, ["a0", "b0"]),
+            "pool-b": self._state_of(snapshot, ["b0"]),
+        }
+        violations = check_merge_invariants(partition, current, desired)
+        assert any("claimed by pools" in v for v in violations)
+
+    def test_unplanned_node_detected(self):
+        snapshot = make_snapshot(
+            {"a0": pool_node("a0", "pool-a"), "b0": pool_node("b0", "pool-b")}
+        )
+        partition = self._partition()
+        current = {
+            "pool-a": self._state_of(snapshot, ["a0"]),
+            "pool-b": self._state_of(snapshot, ["b0"]),
+        }
+        desired = {
+            "pool-a": self._state_of(snapshot, ["a0"]),
+            "pool-b": {},
+        }
+        violations = check_merge_invariants(partition, current, desired)
+        assert any("missing from every pool plan" in v for v in violations)
+
+    def test_chip_invariants_allow_recarve_but_not_minting(self):
+        """Re-carving an observed board to a DIFFERENT chip total is
+        legal — a replan after chip-loss faults tears a degraded board
+        down and carves it back to full (the chaos sweep's seed-15
+        world does exactly this) — so the chip invariant is the
+        capacity ceiling, not per-board equality. Listing the same
+        board twice or exceeding the node's physical capacity is merge
+        corruption and must flag."""
+        carved = annot.status_from_devices(
+            free={0: {"1x1": 2}}, used={0: {"2x2": 1}}
+        )
+        snapshot = make_snapshot(
+            {
+                "a0": pool_node("a0", "pool-a", annotations=dict(carved)),
+                "b0": pool_node("b0", "pool-b"),
+            }
+        )
+        partition = self._partition()
+        current = {
+            "pool-a": self._state_of(snapshot, ["a0"]),
+            "pool-b": self._state_of(snapshot, ["b0"]),
+        }
+        from nos_tpu.partitioning.core.partition_state import (
+            BoardPartitioning,
+            NodePartitioning,
+        )
+
+        # a0's board 0 shows 6 carved chips; replanning it to a single
+        # 2x2 (4 chips, within the node's 8) is a legitimate re-carve.
+        recarved = {
+            "pool-a": {
+                "a0": NodePartitioning(boards=[
+                    BoardPartitioning(
+                        board_index=0,
+                        resources={slice_res("2x2"): 1},
+                    )
+                ])
+            },
+            "pool-b": current["pool-b"],
+        }
+        assert check_merge_invariants(
+            partition, current, recarved, capacities={"a0": 8.0, "b0": 8.0}
+        ) == []
+        # Minting: carving the virgin b0 whole is legal, but a desired
+        # total past its physical 8 chips is flagged once capacities are
+        # supplied.
+        minted = {
+            "pool-a": current["pool-a"],
+            "pool-b": {
+                "b0": NodePartitioning(boards=[
+                    BoardPartitioning(
+                        board_index=0,
+                        resources={slice_res("2x4"): 2},
+                    )
+                ])
+            },
+        }
+        assert check_merge_invariants(partition, current, minted) == []
+        violations = check_merge_invariants(
+            partition, current, minted, capacities={"b0": 8.0}
+        )
+        assert any("exceeds capacity" in v for v in violations)
+        # Merge corruption: the same board listed twice on one node.
+        doubled = {
+            "pool-a": current["pool-a"],
+            "pool-b": {
+                "b0": NodePartitioning(boards=[
+                    BoardPartitioning(
+                        board_index=0,
+                        resources={slice_res("2x2"): 1},
+                    ),
+                    BoardPartitioning(
+                        board_index=0,
+                        resources={slice_res("2x2"): 1},
+                    ),
+                ])
+            },
+        }
+        violations = check_merge_invariants(partition, current, doubled)
+        assert any("twice" in v for v in violations)
+
+    def test_merge_is_order_independent(self):
+        snapshot = two_pool_world()
+        partition = partition_pools(
+            snapshot,
+            [pinned_pod("pa", "2x2", "pool-a"), pinned_pod("pb", "2x2", "pool-b")],
+        )
+        pool_snaps = split_snapshot(snapshot, partition)
+        states = {
+            pool: snap.partitioning_state()
+            for pool, snap in pool_snaps.items()
+        }
+        forward = merge_pool_states(dict(states))
+        backward = merge_pool_states(dict(reversed(list(states.items()))))
+        assert state_bytes(forward) == state_bytes(backward)
+        assert list(forward) == sorted(forward)
+
+
+class TestPoolStabilityAcrossCycles:
+    """The PoolShardedMaintainer must NOT flush per-pool memos on no-op
+    cycles: identical (snapshot shape, pending, quotas) must keep the
+    same pool snapshot objects, with empty dirty sets."""
+
+    def _store(self):
+        from nos_tpu.cmd.partitioner import register_indexers
+        from nos_tpu.kube.store import KubeStore
+
+        store = KubeStore()
+        register_indexers(store)
+        for name, pool in [
+            ("a0", "pool-a"), ("a1", "pool-a"),
+            ("b0", "pool-b"), ("b1", "pool-b"),
+        ]:
+            node = build_tpu_node(name=name)
+            node.metadata.labels[GKE_NODEPOOL_LABEL] = pool
+            store.create(node)
+        return store
+
+    def _maintainer(self, store):
+        from nos_tpu.controllers.partitioner.incremental import (
+            PoolShardedMaintainer,
+        )
+        from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+        return PoolShardedMaintainer(store, TpuSnapshotTaker(), kind="tpu")
+
+    def test_noop_cycles_keep_pool_snapshots(self):
+        from nos_tpu.partitioning.core import ClusterState
+
+        store = self._store()
+        maintainer = self._maintainer(store)
+        state = ClusterState()
+        pending = [
+            pinned_pod("pa", "2x2", "pool-a"),
+            pinned_pod("pb", "2x2", "pool-b"),
+        ]
+        _, _, partition, pool_snaps, pool_dirty = maintainer.shard(
+            state, pending
+        )
+        assert maintainer.last_rebuilt
+        assert partition.pools == ("pool-a", "pool-b")
+        assert pool_dirty == {
+            "pool-a": {"a0", "a1"}, "pool-b": {"b0", "b1"},
+        }
+        for _ in range(3):
+            _, _, partition2, pool_snaps2, pool_dirty2 = maintainer.shard(
+                state, pending
+            )
+            assert not maintainer.last_rebuilt
+            assert partition2.node_pool == partition.node_pool
+            for pool in partition.pools:
+                assert pool_snaps2[pool] is pool_snaps[pool]
+            assert pool_dirty2 == {"pool-a": set(), "pool-b": set()}
+        assert maintainer.pool_rebuilds == 1
+
+    def test_dirty_node_refreshes_only_its_pool(self):
+        from nos_tpu.partitioning.core import ClusterState
+
+        store = self._store()
+        maintainer = self._maintainer(store)
+        state = ClusterState()
+        pending = [
+            pinned_pod("pa", "2x2", "pool-a"),
+            pinned_pod("pb", "2x2", "pool-b"),
+        ]
+        _, _, _, pool_snaps, _ = maintainer.shard(state, pending)
+        bound = build_pod("w0", {slice_res("1x1"): 1}, node="b1")
+        bound.status.phase = "Running"
+        store.create(bound)
+        _, dirty, _, pool_snaps2, pool_dirty2 = maintainer.shard(
+            state, pending
+        )
+        assert not maintainer.last_rebuilt
+        assert dirty == {"b1"}
+        assert pool_dirty2 == {"pool-a": set(), "pool-b": {"b1"}}
+        assert pool_snaps2["pool-b"] is pool_snaps["pool-b"]
+        assert [
+            p.metadata.name
+            for p in pool_snaps2["pool-b"].get_nodes()["b1"].pods
+        ] == ["w0"]
+
+    def test_partition_change_rebuilds_pools(self):
+        from nos_tpu.partitioning.core import ClusterState
+
+        store = self._store()
+        maintainer = self._maintainer(store)
+        state = ClusterState()
+        pending = [
+            pinned_pod("pa", "2x2", "pool-a"),
+            pinned_pod("pb", "2x2", "pool-b"),
+        ]
+        _, _, _, pool_snaps, _ = maintainer.shard(state, pending)
+        # A gang now spans the pools: the partition changes, pools rebuild.
+        members = []
+        for i, pool in enumerate(["pool-a", "pool-b"]):
+            pod = pinned_pod(f"g{i}", "2x2", pool)
+            pod.metadata.labels[GANG_NAME_LABEL] = "g"
+            pod.metadata.labels[GANG_SIZE_LABEL] = "2"
+            members.append(pod)
+        _, _, partition2, pool_snaps2, pool_dirty2 = maintainer.shard(
+            state, members
+        )
+        assert maintainer.last_rebuilt
+        assert partition2.pools == ("pool-a",)
+        assert pool_dirty2 == {"pool-a": {"a0", "a1", "b0", "b1"}}
+        assert maintainer.pool_rebuilds == 2
+
+    def test_force_rebuild_escape_hatch(self):
+        from nos_tpu.partitioning.core import ClusterState
+
+        store = self._store()
+        maintainer = self._maintainer(store)
+        state = ClusterState()
+        pending = [pinned_pod("pa", "2x2", "pool-a")]
+        maintainer.shard(state, pending)
+        maintainer.force_rebuild()
+        maintainer.shard(state, pending)
+        assert maintainer.last_rebuilt
+        assert maintainer.pool_rebuilds == 2

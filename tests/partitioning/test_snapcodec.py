@@ -1,0 +1,234 @@
+"""Warm-state serialization (ISSUE 13 tentpole, satellite 4).
+
+The contract: a restarted process that adopts persisted warm state plans
+BYTE-IDENTICALLY to one that never restarted (and measurably warmer —
+the adopted futility memos fire instead of being re-proven); and ANY
+reason to distrust the file — codec version bump, slice-codec change,
+node-state drift, corruption — degrades to a clean cold rebuild for the
+affected scope, never a crash and never silently stale state.
+"""
+import json
+
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.partitioning.core import ClusterSnapshot, Planner, SnapshotNode
+from nos_tpu.partitioning.core.partition_state import (
+    partitioning_state_to_dict,
+)
+from nos_tpu.partitioning.core.snapcodec import (
+    SNAPSHOT_CODEC_VERSION,
+    WarmStateCodec,
+    node_state_signature,
+)
+from nos_tpu.scheduler.framework import (
+    Framework,
+    NodeResourcesFit,
+    NodeSelectorFit,
+)
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+
+def make_framework():
+    return Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()])
+
+
+def make_world(n=6, carve_first=0):
+    """n virgin 2x4 nodes (8 chips each); an unservable 4x4 request
+    against them drives the carve-futility memo — the expensive state a
+    warm boot exists to preserve."""
+    from nos_tpu.tpu.node import TpuNode
+
+    nodes = {}
+    for i in range(n):
+        annotations = None
+        if i < carve_first:
+            annotations = annot.status_from_devices(
+                free={0: {"1x1": 2}}, used={0: {"2x2": 1}}
+            )
+        node = build_tpu_node(name=f"n{i}", annotations=annotations)
+        nodes[f"n{i}"] = SnapshotNode(partitionable=TpuNode(node))
+    return ClusterSnapshot(nodes)
+
+
+def make_pending():
+    return [
+        build_pod("big", {slice_res("4x4"): 1}),   # unservable: futility
+        build_pod("ok", {slice_res("2x2"): 1}),    # servable: real carve
+    ]
+
+
+def state_bytes(state):
+    return json.dumps(partitioning_state_to_dict(state), sort_keys=True)
+
+
+def zero_ages(pods):
+    return {p.namespaced_name: 0.0 for p in pods}
+
+
+def warmed_codec(path, snapshot=None, planner=None):
+    """Plan once to populate memos, save, return (codec, desired)."""
+    snapshot = snapshot or make_world()
+    planner = planner or Planner(make_framework())
+    pending = make_pending()
+    desired = planner.plan(snapshot, pending, pending_ages=zero_ages(pending))
+    codec = WarmStateCodec(str(path))
+    assert codec.save(snapshot, planner, force=True)
+    return codec, desired, snapshot, planner
+
+
+class TestRoundTrip:
+    def test_restart_warm_boot_is_byte_identical_and_warmer(self, tmp_path):
+        # Commit-free workload: the unservable 4x4 builds futility memos
+        # on every node but places nothing, so plan() leaves the base at
+        # observed state — the saved signatures describe exactly what a
+        # restarted process re-observes. (A served pod or committed carve
+        # legitimately unmatches its node until actuation/binding is
+        # observed; that path is test_geometry_drift_invalidates below.)
+        path = tmp_path / "warm.json"
+        pending = [build_pod("big", {slice_res("4x4"): 1})]
+        world = make_world(carve_first=2)
+        before_planner = Planner(make_framework())
+        desired_before = before_planner.plan(
+            world, pending, pending_ages=zero_ages(pending)
+        )
+        codec = WarmStateCodec(str(path))
+        assert codec.save(world, before_planner, force=True)
+        # "Restart": fresh snapshot of the same world, fresh planner,
+        # fresh codec (no in-memory signature cache carried over).
+        snapshot = make_world(carve_first=2)
+        planner = Planner(make_framework())
+        report = WarmStateCodec(str(path)).adopt(snapshot, planner)
+        assert report.matched == len(snapshot.get_nodes())
+        assert report.unmatched == set()
+        assert report.adopted_entries > 0
+        desired = planner.plan(
+            snapshot,
+            pending,
+            dirty=set(report.unmatched),
+            pending_ages=zero_ages(pending),
+        )
+        assert state_bytes(desired) == state_bytes(desired_before)
+        # The adopted memos actually fired: the unservable pod's carve
+        # trials were skipped, not re-proven node by node.
+        assert planner._futility_hits > 0
+
+    def test_save_rate_limited_and_atomic(self, tmp_path):
+        path = tmp_path / "warm.json"
+        snapshot = make_world(n=2)
+        planner = Planner(make_framework())
+        planner.plan(snapshot, make_pending(), pending_ages={})
+        codec = WarmStateCodec(str(path), save_interval_seconds=3600.0)
+        assert codec.save(snapshot, planner, now=1000.0, force=True)
+        assert not codec.save(snapshot, planner, now=1001.0)
+        assert not codec.due(now=1001.0)
+        assert codec.due(now=5000.0)
+        assert codec.save(snapshot, planner, now=5000.0)
+        # Atomic write left no temp droppings.
+        assert [p.name for p in tmp_path.iterdir()] == ["warm.json"]
+
+
+class TestDistrustDegradesToCold:
+    def test_codec_version_bump_is_clean_cold_rebuild(self, tmp_path):
+        path = tmp_path / "warm.json"
+        warmed_codec(path)
+        doc = json.loads(path.read_text())
+        doc["codec_version"] = SNAPSHOT_CODEC_VERSION + 1
+        path.write_text(json.dumps(doc))
+        snapshot = make_world()
+        planner = Planner(make_framework())
+        codec = WarmStateCodec(str(path))
+        assert codec.load(expected_codec=type(snapshot.codec).__name__) is None
+        report = codec.adopt(snapshot, planner)
+        assert report.matched == 0
+        assert report.unmatched == set(snapshot.get_nodes())
+        # The cold path still plans fine — never a crash.
+        pending = make_pending()
+        desired = planner.plan(
+            snapshot, pending, pending_ages=zero_ages(pending)
+        )
+        fresh = Planner(make_framework()).plan(
+            make_world(), make_pending(), pending_ages=zero_ages(pending)
+        )
+        assert state_bytes(desired) == state_bytes(fresh)
+
+    def test_slice_codec_mismatch_is_cold(self, tmp_path):
+        path = tmp_path / "warm.json"
+        warmed_codec(path)
+        codec = WarmStateCodec(str(path))
+        assert codec.load(expected_codec="SomeOtherCodec") is None
+
+    def test_corrupt_file_is_cold(self, tmp_path):
+        path = tmp_path / "warm.json"
+        path.write_text("{not json")
+        snapshot = make_world(n=2)
+        codec = WarmStateCodec(str(path))
+        report = codec.adopt(snapshot, Planner(make_framework()))
+        assert report.matched == 0
+        assert report.unmatched == set(snapshot.get_nodes())
+
+    def test_absent_file_is_cold(self, tmp_path):
+        codec = WarmStateCodec(str(tmp_path / "nope.json"))
+        snapshot = make_world(n=2)
+        report = codec.adopt(snapshot, Planner(make_framework()))
+        assert report.unmatched == set(snapshot.get_nodes())
+
+    def test_geometry_drift_invalidates_only_that_node(self, tmp_path):
+        """One node restarted with different carved geometry: its
+        signature no longer matches, so ONLY it is reported unmatched
+        (planned dirty/cold); every other node's memos are adopted — and
+        the warm plan still equals a from-scratch plan of the new world."""
+        path = tmp_path / "warm.json"
+        warmed_codec(path)
+        # Same world except n0 comes back already carved.
+        snapshot = make_world(carve_first=1)
+        planner = Planner(make_framework())
+        report = WarmStateCodec(str(path)).adopt(snapshot, planner)
+        assert report.unmatched == {"n0"}
+        assert report.matched == len(snapshot.get_nodes()) - 1
+        pending = make_pending()
+        desired = planner.plan(
+            snapshot,
+            pending,
+            dirty=set(report.unmatched),
+            pending_ages=zero_ages(pending),
+        )
+        fresh = Planner(make_framework()).plan(
+            make_world(carve_first=1),
+            make_pending(),
+            pending_ages=zero_ages(pending),
+        )
+        assert state_bytes(desired) == state_bytes(fresh)
+
+    def test_signature_covers_planner_inputs(self):
+        """Every planner-relevant node input moves the signature; object
+        identity does not."""
+        from nos_tpu.tpu.node import TpuNode
+
+        def sig(mutate=None):
+            node = build_tpu_node(name="n")
+            if mutate:
+                mutate(node)
+            return node_state_signature(
+                SnapshotNode(partitionable=TpuNode(node))
+            )
+
+        base = sig()
+        assert sig() == base  # deterministic across objects
+        assert sig(lambda n: n.metadata.labels.update({"x": "y"})) != base
+        assert sig(
+            lambda n: n.status.allocatable.update({"cpu": 99})
+        ) != base
+
+        def cordon(n):
+            n.spec.unschedulable = True
+
+        assert sig(cordon) != base
+
+        def carve(n):
+            n.metadata.annotations.update(
+                annot.status_from_devices(
+                    free={0: {"1x1": 2}}, used={0: {"2x2": 1}}
+                )
+            )
+
+        assert sig(carve) != base
