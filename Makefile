@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke shard-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -47,6 +47,13 @@ capacity-smoke:
 # across two runs, TTFT stamping and burn-rate math vs fixtures.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/slo -q -m 'not slow'
+
+# Autoscaler gate: the ModelServing policy/reconciler unit tier plus a
+# short seeded closed loop (workload -> burn rate -> replica pods ->
+# carve) that must be byte-identical across two in-process runs.
+autoscale-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/controllers/test_autoscaler.py \
+	    tests/slo/test_autoscale_smoke.py -q -m 'not slow'
 
 # Pool-sharded planning gate: pool partitioning + merge invariants,
 # warm-state codec round-trip/versioning, the sharded controller path,
@@ -106,6 +113,14 @@ bench-store:
 # verdicts, bit-stable at the pinned seed. See BENCH_serve.json.
 bench-serve:
 	JAX_PLATFORMS=cpu $(PY) bench_serve.py --output BENCH_serve.json
+
+# The serving autoscaler's closed loop on a live SimCluster: diurnal
+# workload -> SLO burn -> ModelServing verdicts -> replica pods ->
+# gang-place + carve, with scale-to-zero chip reclamation accounted by a
+# shadow capacity ledger. Bit-stable at the pinned seed. See
+# BENCH_autoscale.json.
+bench-autoscale:
+	JAX_PLATFORMS=cpu $(PY) bench_autoscale.py --output BENCH_autoscale.json
 
 ## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
 ## for real chips) -------------------------------------------------------

@@ -1,4 +1,5 @@
 from nos_tpu.api.config.v1alpha1 import (
+    AutoscalerConfig,
     GpuPartitionerConfig,
     OperatorConfig,
     SchedulerConfig,
@@ -6,6 +7,7 @@ from nos_tpu.api.config.v1alpha1 import (
 )
 
 __all__ = [
+    "AutoscalerConfig",
     "GpuPartitionerConfig",
     "OperatorConfig",
     "SchedulerConfig",

@@ -128,6 +128,37 @@ class TpuAgentConfig:
 
 
 @dataclass
+class AutoscalerConfig:
+    manager: ManagerConfig = field(default_factory=ManagerConfig)
+    # Burn-rate thresholds driving the policy: fast-window burn above
+    # `scale_up_burn_threshold` adds a replica; scale-down requires fast
+    # burn below `scale_down_burn_threshold` AND the spec's budget
+    # surplus, sustained for `scale_down_stable_seconds`.
+    scale_up_burn_threshold: float = 1.0
+    scale_down_burn_threshold: float = 0.5
+    scale_down_stable_seconds: float = 120.0
+    # A cold model still counts as "recently active" (blocks
+    # scale-to-zero) for this long after its last arrival.
+    recent_activity_seconds: float = 30.0
+    # Periodic resync so idle timers fire without a triggering event.
+    resync_seconds: float = 5.0
+
+    def validate(self) -> None:
+        if self.scale_up_burn_threshold <= 0:
+            raise ConfigError("scale_up_burn_threshold must be > 0")
+        if not 0 <= self.scale_down_burn_threshold <= self.scale_up_burn_threshold:
+            raise ConfigError(
+                "scale_down_burn_threshold must be in [0, scale_up_burn_threshold]"
+            )
+        if self.scale_down_stable_seconds < 0:
+            raise ConfigError("scale_down_stable_seconds must be >= 0")
+        if self.recent_activity_seconds < 0:
+            raise ConfigError("recent_activity_seconds must be >= 0")
+        if self.resync_seconds <= 0:
+            raise ConfigError("resync_seconds must be > 0")
+
+
+@dataclass
 class SchedulerConfig:
     manager: ManagerConfig = field(default_factory=ManagerConfig)
     retry_seconds: float = 0.5

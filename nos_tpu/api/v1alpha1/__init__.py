@@ -6,6 +6,11 @@ from nos_tpu.api.v1alpha1.elasticquota import (
     ElasticQuotaSpec,
     ElasticQuotaStatus,
 )
+from nos_tpu.api.v1alpha1.modelserving import (
+    ModelServing,
+    ModelServingSpec,
+    ModelServingStatus,
+)
 
 __all__ = [
     "annotations",
@@ -16,4 +21,7 @@ __all__ = [
     "ElasticQuota",
     "ElasticQuotaSpec",
     "ElasticQuotaStatus",
+    "ModelServing",
+    "ModelServingSpec",
+    "ModelServingStatus",
 ]

@@ -25,6 +25,14 @@ PREFIX = "nos.nebuly.com/"
 SPEC_PARTITIONING_PLAN = PREFIX + "spec-partitioning-plan"
 STATUS_PARTITIONING_PLAN = PREFIX + "status-partitioning-plan"
 
+# Cold-start grace reservation written by the model autoscaler on the
+# nodes a scaled-to-zero model vacated: holder ("<ns>/<name>") and an
+# absolute expiry timestamp. The capacity ledger attributes the idle
+# chip-seconds under these keys to the "autoscaler-grace" bucket, and
+# the autoscaler clears them at expiry (or on cold start).
+AUTOSCALER_RESERVED = PREFIX + "autoscaler-reserved"
+AUTOSCALER_RESERVED_UNTIL = PREFIX + "autoscaler-reserved-until"
+
 # Profiles are either slice topologies ("2x2", "2x2x1" — tpu mode) or
 # HBM fractions ("8gb" — sharing mode); both ride the same protocol the
 # way MIG ("1g.10gb") and MPS ("10gb") profiles share the reference's.

@@ -65,6 +65,10 @@ EVENT_REASON_QUOTA_RECLAIMED = "QuotaReclaimed"
 EVENT_REASON_PARTITIONING_APPLIED = "PartitioningApplied"
 EVENT_REASON_CARVE_FAILED = "CarveFailed"
 EVENT_REASON_AUDIT_VIOLATION = "AuditViolation"
+EVENT_REASON_SCALED_UP = "ScaledUp"
+EVENT_REASON_SCALED_DOWN = "ScaledDown"
+EVENT_REASON_SCALED_TO_ZERO = "ScaledToZero"
+EVENT_REASON_COLD_START = "ColdStart"
 
 EVENT_REASONS = (
     EVENT_REASON_FAILED_SCHEDULING,
@@ -75,6 +79,10 @@ EVENT_REASONS = (
     EVENT_REASON_PARTITIONING_APPLIED,
     EVENT_REASON_CARVE_FAILED,
     EVENT_REASON_AUDIT_VIOLATION,
+    EVENT_REASON_SCALED_UP,
+    EVENT_REASON_SCALED_DOWN,
+    EVENT_REASON_SCALED_TO_ZERO,
+    EVENT_REASON_COLD_START,
 )
 
 

@@ -25,6 +25,11 @@ GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 # Unlabeled nodes fall into one implicit pool.
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 
+# Replica pods created by the model autoscaler carry the owning
+# ModelServing's "<namespace>.<name>" here so the controller can map pod
+# events back to its object (kube-style ownership without a real GC).
+MODEL_SERVING_LABEL = "nos.nebuly.com/model-serving"
+
 # On hybrid nodes: how many of the node's chips (the highest-indexed ones)
 # form the sharing pool; the rest are carved into slice boards. The TPU
 # analogue of nos's per-GPU MIG-enabled flag, which decides whether a

@@ -1,0 +1,98 @@
+"""ModelServing CRD-equivalent type: a served model with SLO targets.
+
+The declarative half of the autoscaling loop (ROADMAP item 3): spec names
+the model, the mesh-sized slice profile each replica occupies (e.g. "2x4"
+= 8 chips for a (batch, model) mesh), the replica bounds, and the SLO
+targets in `slo/engine.py` spec syntax ("p95 ttft < 300ms",
+"availability 99.9%"). The autoscaler controller reconciles
+status.desired_replicas from measured burn rate + queue depth and acts
+purely through Pods — the scheduler gang-places them and the partitioner
+carves the slices, exactly as for hand-written workloads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.objects import ObjectMeta
+
+
+@dataclass
+class ModelServingSpec:
+    # Model identity routed by the serving shim (slo/routing.py); must
+    # match a ModelProfile name in the workload driver.
+    model: str = ""
+    # Topology each replica's server pod occupies ("2x4" = 8 chips).
+    slice_profile: str = "2x4"
+    min_replicas: int = 0
+    max_replicas: int = 1
+    # SLO targets in slo/engine.py spec syntax; validated at admission.
+    slos: List[str] = field(default_factory=list)
+    # Scale-to-zero: tear down after this much idle time (no arrivals and
+    # empty queue). Only meaningful when min_replicas == 0.
+    scale_to_zero_idle_seconds: float = 300.0
+    # After scaling to zero, hold the freed boards in an autoscaler-grace
+    # reservation for this long so a cold start lands on a pre-carved
+    # slice instead of waiting out a full re-carve.
+    cold_start_grace_seconds: float = 60.0
+    # Queue-depth target per replica; backlog above desired*target scales up.
+    target_queue_depth: int = 4
+    # Scale down one replica only while at least this fraction of error
+    # budget remains across every declared SLO (sustained surplus).
+    scale_down_budget_surplus: float = 0.5
+    scheduler_name: str = constants.SCHEDULER_NAME
+
+    def validate(self) -> None:
+        from nos_tpu.slo.engine import SLOSpec
+        from nos_tpu.tpu.topology import topology_chips
+
+        if not self.model:
+            raise ValueError("spec.model must be set")
+        if topology_chips(self.slice_profile) < 1:
+            raise ValueError(f"invalid slice_profile {self.slice_profile!r}")
+        if self.min_replicas < 0:
+            raise ValueError("min_replicas must be >= 0")
+        if self.max_replicas < max(1, self.min_replicas):
+            raise ValueError("max_replicas must be >= max(1, min_replicas)")
+        for text in self.slos:
+            SLOSpec.parse(text)  # raises ValueError on bad syntax
+        if self.scale_to_zero_idle_seconds < 0:
+            raise ValueError("scale_to_zero_idle_seconds must be >= 0")
+        if self.cold_start_grace_seconds < 0:
+            raise ValueError("cold_start_grace_seconds must be >= 0")
+        if self.target_queue_depth < 1:
+            raise ValueError("target_queue_depth must be >= 1")
+        if not 0.0 <= self.scale_down_budget_surplus <= 1.0:
+            raise ValueError("scale_down_budget_surplus must be in [0, 1]")
+
+    @property
+    def chips_per_replica(self) -> int:
+        from nos_tpu.tpu.topology import topology_chips
+
+        return topology_chips(self.slice_profile)
+
+
+@dataclass
+class ModelServingStatus:
+    # Replica pods that currently exist / are bound to nodes.
+    replicas: int = 0
+    ready_replicas: int = 0
+    # The controller's last reconciled target.
+    desired_replicas: int = 0
+    # Last policy verdict ("scale-up", "scale-down", "scale-to-zero",
+    # "cold-start", "hold") and when desired_replicas last changed.
+    last_verdict: str = ""
+    last_transition_t: float = 0.0
+    # Cold-start bookkeeping: set when scaling 0 -> N, cleared (and the
+    # latency observed) when the first replica binds again.
+    cold_start_since: float = 0.0
+    cold_starts: int = 0
+
+
+@dataclass
+class ModelServing:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ModelServingSpec = field(default_factory=ModelServingSpec)
+    status: ModelServingStatus = field(default_factory=ModelServingStatus)
+    kind: str = "ModelServing"

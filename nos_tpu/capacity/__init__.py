@@ -1,4 +1,5 @@
 from nos_tpu.capacity.ledger import (  # noqa: F401
+    BUCKET_AUTOSCALER,
     BUCKET_NO_DEMAND,
     BUCKET_PENDING,
     BUCKET_RECONFIG,

@@ -24,6 +24,9 @@ accumulation is bit-reproducible on replay):
     reported status plan (a partitioning plan is in flight);
   * ``reserved-by-gang``    — the node carries a board reservation
     annotation for a pending gang;
+  * ``autoscaler-grace``    — the node is held by the model autoscaler's
+    cold-start grace reservation after a scale-to-zero (a deliberate
+    wake-latency trade, not scheduling waste);
   * ``pending-unschedulable`` — otherwise, up to the cluster's unbound
     pending TPU demand (``min(idle, pending_chips)``, the same coverage
     rule bench.py's post-hoc attribution uses), labeled with the
@@ -74,7 +77,14 @@ BUCKET_NO_DEMAND = "no-demand"
 BUCKET_PENDING = "pending-unschedulable"
 BUCKET_RECONFIG = "reconfig"
 BUCKET_RESERVED = "reserved-by-gang"
-IDLE_BUCKETS = (BUCKET_NO_DEMAND, BUCKET_PENDING, BUCKET_RECONFIG, BUCKET_RESERVED)
+BUCKET_AUTOSCALER = "autoscaler-grace"
+IDLE_BUCKETS = (
+    BUCKET_NO_DEMAND,
+    BUCKET_PENDING,
+    BUCKET_RECONFIG,
+    BUCKET_RESERVED,
+    BUCKET_AUTOSCALER,
+)
 
 # Store kinds the ledger's delta view understands (same set the
 # IncrementalSnapshotMaintainer watches).
@@ -203,6 +213,7 @@ class _NodeState:
         "accelerator",
         "frozen",
         "reserved",
+        "autoscaler_grace",
         "frag_index",
         "largest_free_slice",
         "free_chips",
@@ -220,6 +231,10 @@ class _NodeState:
             annot.STATUS_PARTITIONING_PLAN
         )
         self.reserved = _RESERVED_FOR in ann
+        # Cold-start grace hold stamped by the model autoscaler on
+        # scale-to-zero: idle here is a deliberate wake-latency trade,
+        # not scheduling inefficiency, and must not read as no-demand.
+        self.autoscaler_grace = annot.AUTOSCALER_RESERVED in ann
         self.frag_index, self.largest_free_slice, self.free_chips = (
             fragmentation_from_annotations(ann, self.accelerator)
         )
@@ -240,6 +255,7 @@ class _NodeState:
             self.accelerator,
             self.frozen,
             self.reserved,
+            self.autoscaler_grace,
             round(self.frag_index, 9),
             self.largest_free_slice,
             self.free_chips,
@@ -411,6 +427,8 @@ class CapacityLedger:
                 self.idle_chip_seconds[BUCKET_RECONFIG] += idle * dt
             elif st.reserved:
                 self.idle_chip_seconds[BUCKET_RESERVED] += idle * dt
+            elif st.autoscaler_grace:
+                self.idle_chip_seconds[BUCKET_AUTOSCALER] += idle * dt
             else:
                 available_idle += idle
             for profile in sorted(st.used_profiles):
@@ -447,6 +465,8 @@ class CapacityLedger:
                     c.labels(state=BUCKET_RECONFIG, reason="").inc(idle * dt)
                 elif st.reserved:
                     c.labels(state=BUCKET_RESERVED, reason="").inc(idle * dt)
+                elif st.autoscaler_grace:
+                    c.labels(state=BUCKET_AUTOSCALER, reason="").inc(idle * dt)
             if covered > 0:
                 c.labels(
                     state=BUCKET_PENDING, reason=self._reason or _REASON_QUEUED

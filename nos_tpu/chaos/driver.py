@@ -17,7 +17,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig, TpuAgentConfig
+from nos_tpu.api.config import (
+    AutoscalerConfig,
+    GpuPartitionerConfig,
+    SchedulerConfig,
+    TpuAgentConfig,
+)
 from nos_tpu.api.v1alpha1 import constants
 from nos_tpu.chaos import faults as F
 from nos_tpu.chaos import oracles
@@ -31,6 +36,7 @@ log = logging.getLogger("nos_tpu.chaos")
 LEASE_NAME = "chaos-leader-lease"
 QUOTA_NAME = "chaos-quota"
 QUOTA_NAMESPACE = "default"
+MODEL_SERVING_NAME = "chaos-model"
 
 
 @dataclass
@@ -175,6 +181,12 @@ class ChaosDriver:
                 pool_sharding=True,
             ),
             scheduler_config=SchedulerConfig(retry_seconds=0.1),
+            # The model autoscaler rides every chaos run: its replica
+            # fleet must survive node death / quota flaps / API faults and
+            # re-settle to the decision function's verdict (the
+            # autoscaler-settled oracle). Fast resync so idle-timer
+            # reconciles land within the convergence window.
+            autoscaler_config=AutoscalerConfig(resync_seconds=0.5),
             flight_recorder=self.recorder,
         )
         self.store = self.cluster.store
@@ -191,6 +203,7 @@ class ChaosDriver:
         for name in self.node_names:
             self.cluster.add_tpu_node(seed_node({"name": name}), agent_cfg)
         self._create_quota()
+        self._create_modelserving()
         self._start_electors()
         self.cluster.start()
 
@@ -210,6 +223,30 @@ class ChaosDriver:
             ),
         )
         self._robust(lambda: self.store.create(quota))
+
+    def _create_modelserving(self) -> None:
+        """One standing ModelServing: min 1 replica of a 2x2 slice. With
+        no serve traffic its settled verdict is always "hold at
+        min_replicas", so after every healed burst the oracle demands
+        exactly one live replica pod — faults that evict it must be
+        answered by a re-created replica."""
+        from nos_tpu.api.v1alpha1.modelserving import (
+            ModelServing,
+            ModelServingSpec,
+        )
+        from nos_tpu.kube.objects import ObjectMeta
+
+        ms = ModelServing(
+            metadata=ObjectMeta(name=MODEL_SERVING_NAME, namespace=QUOTA_NAMESPACE),
+            spec=ModelServingSpec(
+                model=MODEL_SERVING_NAME,
+                slice_profile="2x2",
+                min_replicas=1,
+                max_replicas=2,
+                slos=["p95 ttft < 1s"],
+            ),
+        )
+        self._robust(lambda: self.store.create(ms))
 
     def _start_electors(self) -> None:
         """Two contenders on a chaos-owned lease: the leader-flap fault
@@ -442,6 +479,7 @@ class ChaosDriver:
             self.store,
             scheduler_name=self.cluster.scheduler.scheduler_name,
             partitioner=self.cluster.partitioner,
+            autoscaler=self.cluster.autoscaler,
         )
         out += self._leader_overlap
         return out

@@ -20,6 +20,7 @@ NO_ORPHANED_RESERVATIONS = "no-orphaned-reservations"
 AUDITOR_CLEAN = "auditor-clean"
 REPLAY_CLEAN = "replay-clean"
 LEDGER_CONSISTENT = "ledger-consistent"
+AUTOSCALER_SETTLED = "autoscaler-settled"
 
 
 def pending_settled(store, scheduler_name: str = "") -> List[str]:
@@ -148,10 +149,60 @@ def ledger_consistent(partitioner, store) -> List[str]:
     ]
 
 
+def autoscaler_settled(store, autoscaler) -> List[str]:
+    """After a burst heals, every ModelServing's replica fleet is stable
+    and MATCHES what the decision function says it should be: live pods ==
+    status.desired_replicas == decide(...) at the controller's own clock,
+    none terminating. Catches both a wedged reconciler (verdict never
+    actuated) and a flapping one (actuation disagrees with the verdict a
+    settled signal registry produces)."""
+    from nos_tpu.controllers.autoscaler import policy
+    from nos_tpu.controllers.autoscaler.controller import serving_key
+
+    out: List[str] = []
+    for ms in store.list("ModelServing"):
+        key = serving_key(ms)
+        pods = [
+            p
+            for p in store.list("Pod", namespace=ms.metadata.namespace)
+            if p.metadata.labels.get(labels.MODEL_SERVING_LABEL) == key
+        ]
+        terminating = [p for p in pods if p.metadata.deletion_timestamp is not None]
+        if terminating:
+            out.append(
+                f"{AUTOSCALER_SETTLED}: {key} still tearing down "
+                f"{len(terminating)} replica(s)"
+            )
+            continue
+        now = autoscaler.signals.now()
+        decision = policy.decide(
+            ms.spec,
+            len(pods),
+            autoscaler.signals.get(ms.spec.model),
+            autoscaler.config,
+            now,
+            last_transition_t=ms.status.last_transition_t,
+        )
+        if decision.desired != len(pods):
+            out.append(
+                f"{AUTOSCALER_SETTLED}: {key} has {len(pods)} replica(s) but "
+                f"the settled verdict is {decision.verdict} -> "
+                f"{decision.desired} ({decision.reason})"
+            )
+        elif ms.status.desired_replicas != decision.desired:
+            out.append(
+                f"{AUTOSCALER_SETTLED}: {key} status.desired_replicas="
+                f"{ms.status.desired_replicas} disagrees with the settled "
+                f"verdict {decision.desired}"
+            )
+    return out
+
+
 def check_convergence(
     store,
     scheduler_name: str = "",
     partitioner=None,
+    autoscaler=None,
 ) -> List[str]:
     """All oracles that can run mid-flight, concatenated. Empty = healed."""
     out = pending_settled(store, scheduler_name)
@@ -160,6 +211,8 @@ def check_convergence(
     if partitioner is not None:
         out += auditor_clean(partitioner, store)
         out += ledger_consistent(partitioner, store)
+    if autoscaler is not None:
+        out += autoscaler_settled(store, autoscaler)
     return out
 
 

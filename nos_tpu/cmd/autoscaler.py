@@ -1,0 +1,55 @@
+"""autoscaler: the SLO-driven model-serving replica controller.
+
+No reference binary exists for this one — it is the suite's own closing
+of the control-plane/data-plane loop (ROADMAP item 3). It follows the
+same builder shape as every other component: wire a reconciler onto the
+shared manager's store with watches, hand back the live object.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from nos_tpu.api.config import AutoscalerConfig
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.controllers.autoscaler.controller import (
+    ModelServingReconciler,
+    pod_to_serving_requests,
+)
+from nos_tpu.controllers.autoscaler.signals import SignalRegistry
+from nos_tpu.kube.controller import Controller, Manager, Watch
+from nos_tpu.kube.events import EventRecorder
+
+
+def build_autoscaler(
+    manager: Manager,
+    config: Optional[AutoscalerConfig] = None,
+    signals: Optional[SignalRegistry] = None,
+) -> ModelServingReconciler:
+    config = config or AutoscalerConfig()
+    config.validate()
+    store = manager.store
+    reconciler = ModelServingReconciler(
+        store,
+        config=config,
+        signals=signals or SignalRegistry(),
+        recorder=EventRecorder(store, component="nos-autoscaler"),
+    )
+    manager.add(
+        Controller(
+            "autoscaler",
+            store,
+            reconciler.reconcile,
+            [
+                Watch(kind="ModelServing"),
+                # Replica pod lifecycle (create/bind/delete) maps back to
+                # the owning ModelServing so ready counts stay fresh.
+                Watch(
+                    kind="Pod",
+                    predicate=lambda e: labels.MODEL_SERVING_LABEL
+                    in e.object.metadata.labels,
+                    mapper=lambda e: pod_to_serving_requests(store, e),
+                ),
+            ],
+        )
+    )
+    return reconciler

@@ -11,11 +11,13 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from nos_tpu.api.config import (
+    AutoscalerConfig,
     GpuPartitionerConfig,
     OperatorConfig,
     SchedulerConfig,
     TpuAgentConfig,
 )
+from nos_tpu.cmd.autoscaler import build_autoscaler
 from nos_tpu.cmd.operator import build_operator
 from nos_tpu.cmd.partitioner import build_partitioner
 from nos_tpu.cmd.scheduler import build_scheduler
@@ -47,6 +49,9 @@ class SimCluster:
     scheduler: Scheduler
     kubelet: Optional[SimKubelet] = None
     capacity_ledger: Optional[CapacityLedger] = None
+    # Set when built with autoscaler_config: the ModelServingReconciler
+    # (signals registry at .signals, /debug payload at .debug_payload).
+    autoscaler: Optional[object] = None
     device_backend: str = "sim"  # "sim" | "tpuctl" (native C++ slice state)
     tpuctl_dir: str = ""
     device_plugin_config_map: str = "nos-device-plugin-config"
@@ -158,6 +163,8 @@ def build_cluster(
     partitioner_config: Optional[GpuPartitionerConfig] = None,
     scheduler_config: Optional[SchedulerConfig] = None,
     operator_config: Optional[OperatorConfig] = None,
+    autoscaler_config: Optional[AutoscalerConfig] = None,
+    autoscaler_signals=None,
     device_backend: str = "sim",
     tpuctl_dir: str = "",
     flight_recorder=None,
@@ -184,6 +191,14 @@ def build_cluster(
         flight_recorder=flight_recorder,
         capacity_ledger=ledger,
     )
+    # The model autoscaler is opt-in: only serving-aware deployments
+    # (run.py with an `autoscaler:` section, bench_autoscale, chaos) pay
+    # for the extra watches.
+    autoscaler = None
+    if autoscaler_config is not None:
+        autoscaler = build_autoscaler(
+            manager, autoscaler_config, signals=autoscaler_signals
+        )
     pool = SimDevicePool()
     # Admission arbitrates against the device inventory (ground truth),
     # the backstop for scheduler-vs-repartitioner races — see SimKubelet.
@@ -244,6 +259,7 @@ def build_cluster(
         scheduler=scheduler,
         kubelet=kubelet,
         capacity_ledger=ledger,
+        autoscaler=autoscaler,
         device_backend=device_backend,
         tpuctl_dir=tpuctl_dir,
         device_plugin_config_map=partitioner_config.device_plugin_config_map,

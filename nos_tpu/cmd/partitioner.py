@@ -284,7 +284,7 @@ def main(argv=None) -> int:
     from nos_tpu.cmd.run import configs_from
 
     def build(manager, config):
-        partitioner_cfg, _, _ = configs_from(config)
+        partitioner_cfg, _, _, _ = configs_from(config)
         build_partitioner(manager, partitioner_cfg)
 
     return run_component("partitioner", build, argv)

@@ -15,6 +15,7 @@ import sys
 import threading
 
 from nos_tpu.api.config import (
+    AutoscalerConfig,
     GpuPartitionerConfig,
     SchedulerConfig,
     TpuAgentConfig,
@@ -78,9 +79,22 @@ def configs_from(config: dict):
     agent = TpuAgentConfig(
         report_config_interval_seconds=a.get("reportConfigIntervalSeconds", 10.0)
     )
-    for c in (partitioner, scheduler, agent):
-        c.validate()
-    return partitioner, scheduler, agent
+    # The model autoscaler is opt-in: no `autoscaler:` section, no extra
+    # watches (build_cluster skips the component when config is None).
+    autoscaler = None
+    if "autoscaler" in config:
+        u = config.get("autoscaler") or {}
+        autoscaler = AutoscalerConfig(
+            scale_up_burn_threshold=u.get("scaleUpBurnThreshold", 1.0),
+            scale_down_burn_threshold=u.get("scaleDownBurnThreshold", 0.5),
+            scale_down_stable_seconds=u.get("scaleDownStableSeconds", 120.0),
+            recent_activity_seconds=u.get("recentActivitySeconds", 30.0),
+            resync_seconds=u.get("resyncSeconds", 5.0),
+        )
+    for c in (partitioner, scheduler, agent, autoscaler):
+        if c is not None:
+            c.validate()
+    return partitioner, scheduler, agent, autoscaler
 
 
 def seed_node(spec: dict) -> Node:
@@ -123,6 +137,41 @@ def seed_pod(spec: dict) -> Pod:
     )
 
 
+def seed_modelserving(spec: dict):
+    """A ModelServing from a `modelServings:` config entry, e.g.
+
+      modelServings:
+        - name: chat
+          model: llama-70b
+          sliceProfile: 2x4
+          minReplicas: 1
+          maxReplicas: 3
+          slos: ["p95 ttft < 500ms"]
+    """
+    from nos_tpu.api.v1alpha1.modelserving import ModelServing, ModelServingSpec
+    from nos_tpu.kube.objects import ObjectMeta
+
+    ms = ModelServing(
+        metadata=ObjectMeta(
+            name=spec["name"], namespace=spec.get("namespace", "default")
+        ),
+        spec=ModelServingSpec(
+            model=spec.get("model", spec["name"]),
+            slice_profile=spec.get("sliceProfile", "2x4"),
+            min_replicas=int(spec.get("minReplicas", 0)),
+            max_replicas=int(spec.get("maxReplicas", 1)),
+            slos=list(spec.get("slos", [])),
+            scale_to_zero_idle_seconds=spec.get("scaleToZeroIdleSeconds", 300.0),
+            cold_start_grace_seconds=spec.get("coldStartGraceSeconds", 60.0),
+            target_queue_depth=int(spec.get("targetQueueDepth", 4)),
+            scale_down_budget_surplus=spec.get("scaleDownBudgetSurplus", 0.5),
+            scheduler_name=spec.get("schedulerName", constants.SCHEDULER_NAME),
+        ),
+    )
+    ms.spec.validate()
+    return ms
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="Run the nos-tpu suite in-process")
     parser.add_argument("--config", default="", help="YAML component config")
@@ -148,7 +197,7 @@ def main(argv=None) -> int:
     )
 
     config = load_config(args.config)
-    partitioner_cfg, scheduler_cfg, agent_cfg = configs_from(config)
+    partitioner_cfg, scheduler_cfg, agent_cfg, autoscaler_cfg = configs_from(config)
 
     flight_recorder = None
     if args.record:
@@ -158,6 +207,7 @@ def main(argv=None) -> int:
     cluster = build_cluster(
         partitioner_config=partitioner_cfg,
         scheduler_config=scheduler_cfg,
+        autoscaler_config=autoscaler_cfg,
         device_backend=config.get("deviceBackend", "sim"),
         tpuctl_dir=config.get("tpuctlDir", "/tmp/nos-tpu"),
         flight_recorder=flight_recorder,
@@ -176,6 +226,8 @@ def main(argv=None) -> int:
             cluster.add_tpu_node(node, agent_cfg)
     for spec in config.get("pods", []):
         cluster.store.create(seed_pod(spec))
+    for spec in config.get("modelServings", []):
+        cluster.store.create(seed_modelserving(spec))
 
     port = args.health_port
     if port is None:
@@ -189,12 +241,16 @@ def main(argv=None) -> int:
         else None,
         profiler=PROFILER,
         loops_fn=lambda: LOOPS.payload(store=cluster.store),
+        autoscaler_fn=cluster.autoscaler.debug_payload
+        if cluster.autoscaler is not None
+        else None,
     )
     bound = health.start()
     logging.info(
         "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain"
-        " /debug/capacity /debug/profile /debug/loops%s)",
+        " /debug/capacity /debug/profile /debug/loops%s%s)",
         bound,
+        " /debug/autoscaler" if cluster.autoscaler is not None else "",
         " /debug/record" if flight_recorder is not None else "",
     )
 

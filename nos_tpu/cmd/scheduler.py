@@ -162,7 +162,7 @@ def main(argv=None) -> int:
     from nos_tpu.cmd.run import configs_from
 
     def build(manager, config):
-        _, scheduler_cfg, _ = configs_from(config)
+        _, scheduler_cfg, _, _ = configs_from(config)
         # Returned so run_component serves the scheduler's diagnosis
         # ledger as /debug/explain.
         return build_scheduler(manager, scheduler_cfg)

@@ -71,7 +71,7 @@ def main(argv=None) -> int:
         return 1
 
     def build(manager, config):
-        _, _, agent_cfg = configs_from(config)
+        _, _, agent_cfg, _ = configs_from(config)
         client = SharedSliceClient(
             manager.store,
             config.get("devicePluginConfigMap", "nos-device-plugin-config"),

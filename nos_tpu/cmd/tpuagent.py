@@ -106,7 +106,7 @@ def main(argv=None) -> int:
         return 1
 
     def build(manager, config):
-        _, _, agent_cfg = configs_from(config)
+        _, _, agent_cfg, _ = configs_from(config)
         backend = config.get("deviceBackend", "sim")
         if backend == "tpuctl":
             from nos_tpu.api.v1alpha1 import constants as const

@@ -1,0 +1,37 @@
+"""SLO-driven model-serving autoscaler.
+
+Closes the control-plane/data-plane loop (ROADMAP item 3): a ModelServing
+CRD declares a model, the slice profile each replica occupies, replica
+bounds, and SLO targets; the controller here reconciles desired replicas
+from measured burn rate + queue depth and acts purely through the
+API-server contract — it writes replica Pods, the scheduler gang-places
+them, the partitioner carves the slices, ElasticQuota arbitrates.
+
+  policy.py    pure decision function (spec + signals -> Decision)
+  signals.py   thread-safe per-model signal registry fed by slo/ + routing
+  controller.py  the ModelServing reconciler
+"""
+from nos_tpu.controllers.autoscaler.controller import ModelServingReconciler
+from nos_tpu.controllers.autoscaler.policy import (
+    Decision,
+    VERDICT_COLD_START,
+    VERDICT_HOLD,
+    VERDICT_SCALE_DOWN,
+    VERDICT_SCALE_TO_ZERO,
+    VERDICT_SCALE_UP,
+    decide,
+)
+from nos_tpu.controllers.autoscaler.signals import SignalRegistry, Signals
+
+__all__ = [
+    "Decision",
+    "ModelServingReconciler",
+    "SignalRegistry",
+    "Signals",
+    "VERDICT_COLD_START",
+    "VERDICT_HOLD",
+    "VERDICT_SCALE_DOWN",
+    "VERDICT_SCALE_TO_ZERO",
+    "VERDICT_SCALE_UP",
+    "decide",
+]

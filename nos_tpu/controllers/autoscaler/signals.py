@@ -1,0 +1,79 @@
+"""Per-model scaling signals, bridged from the data plane.
+
+The SLO engine and the serving router run on their own clocks (wall time
+live, the virtual cost clock in benches), so the registry takes an
+injectable ``now_fn`` and the controller reads ALL its timestamps through
+it — bench runs stay bit-stable because no wall-clock value ever reaches
+a decision or a status field.
+
+Writers:
+  slo/ evaluation   burn_fast / burn_slow / error_budget_remaining
+  routing shim      queue_depth / last_request_t (arrivals, backlog)
+Reader: the ModelServing reconciler, via ``get(model)``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Dict
+
+
+@dataclass(frozen=True)
+class Signals:
+    # Max burn rate across the model's SLOs per window; min budget left.
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+    error_budget_remaining: float = 1.0
+    # Requests accepted by the router but not yet submitted to a replica.
+    queue_depth: int = 0
+    # When the model last saw an arrival; -inf = never.
+    last_request_t: float = float("-inf")
+
+
+class SignalRegistry:
+    def __init__(self, now_fn: Callable[[], float] = time.time) -> None:
+        self.now_fn = now_fn
+        self._lock = threading.Lock()
+        self._by_model: Dict[str, Signals] = {}
+
+    def now(self) -> float:
+        return self.now_fn()
+
+    def get(self, model: str) -> Signals:
+        with self._lock:
+            return self._by_model.get(model, Signals())
+
+    def update(self, model: str, **fields) -> Signals:
+        """Replace the named fields of the model's signals atomically."""
+        with self._lock:
+            sig = replace(self._by_model.get(model, Signals()), **fields)
+            self._by_model[model] = sig
+            return sig
+
+    def note_arrival(self, model: str, t: float, queue_depth: int) -> None:
+        with self._lock:
+            sig = self._by_model.get(model, Signals())
+            self._by_model[model] = replace(
+                sig,
+                last_request_t=max(sig.last_request_t, t),
+                queue_depth=queue_depth,
+            )
+
+    def models(self):
+        with self._lock:
+            return sorted(self._by_model)
+
+    def payload(self) -> Dict[str, dict]:
+        """/debug/autoscaler building block: every model's current signals."""
+        with self._lock:
+            return {
+                m: {
+                    "burn_fast": s.burn_fast,
+                    "burn_slow": s.burn_slow,
+                    "error_budget_remaining": s.error_budget_remaining,
+                    "queue_depth": s.queue_depth,
+                    "last_request_t": s.last_request_t,
+                }
+                for m, s in sorted(self._by_model.items())
+            }

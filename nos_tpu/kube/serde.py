@@ -24,6 +24,11 @@ from nos_tpu.api.v1alpha1.elasticquota import (
     ElasticQuotaSpec,
     ElasticQuotaStatus,
 )
+from nos_tpu.api.v1alpha1.modelserving import (
+    ModelServing,
+    ModelServingSpec,
+    ModelServingStatus,
+)
 from nos_tpu.kube.objects import (
     ConfigMap,
     Event,
@@ -65,6 +70,7 @@ RESOURCES: Dict[str, Tuple[str, str, bool]] = {
         "compositeelasticquotas",
         True,
     ),
+    "ModelServing": ("/apis/nos.nebuly.com/v1alpha1", "modelservings", True),
 }
 
 API_VERSIONS: Dict[str, str] = {
@@ -76,6 +82,7 @@ API_VERSIONS: Dict[str, str] = {
     "PodDisruptionBudget": "policy/v1",
     "ElasticQuota": "nos.nebuly.com/v1alpha1",
     "CompositeElasticQuota": "nos.nebuly.com/v1alpha1",
+    "ModelServing": "nos.nebuly.com/v1alpha1",
 }
 
 
@@ -738,6 +745,73 @@ def ceq_from_wire(d: Dict[str, Any]) -> CompositeElasticQuota:
     )
 
 
+# ------------------------------------------------------------ ModelServing
+
+
+def modelserving_to_wire(ms: ModelServing) -> Dict[str, Any]:
+    return {
+        "apiVersion": "nos.nebuly.com/v1alpha1",
+        "kind": "ModelServing",
+        "metadata": meta_to_wire(ms.metadata),
+        "spec": {
+            "model": ms.spec.model,
+            "sliceProfile": ms.spec.slice_profile,
+            "minReplicas": ms.spec.min_replicas,
+            "maxReplicas": ms.spec.max_replicas,
+            "slos": list(ms.spec.slos),
+            "scaleToZeroIdleSeconds": ms.spec.scale_to_zero_idle_seconds,
+            "coldStartGraceSeconds": ms.spec.cold_start_grace_seconds,
+            "targetQueueDepth": ms.spec.target_queue_depth,
+            "scaleDownBudgetSurplus": ms.spec.scale_down_budget_surplus,
+            "schedulerName": ms.spec.scheduler_name,
+        },
+        "status": {
+            "replicas": ms.status.replicas,
+            "readyReplicas": ms.status.ready_replicas,
+            "desiredReplicas": ms.status.desired_replicas,
+            "lastVerdict": ms.status.last_verdict,
+            "lastTransitionTime": ms.status.last_transition_t,
+            "coldStartSince": ms.status.cold_start_since,
+            "coldStarts": ms.status.cold_starts,
+        },
+    }
+
+
+def modelserving_from_wire(d: Dict[str, Any]) -> ModelServing:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return ModelServing(
+        metadata=meta_from_wire(d.get("metadata") or {}),
+        spec=ModelServingSpec(
+            model=spec.get("model", ""),
+            slice_profile=spec.get("sliceProfile", "2x4"),
+            min_replicas=int(spec.get("minReplicas", 0)),
+            max_replicas=int(spec.get("maxReplicas", 1)),
+            slos=list(spec.get("slos") or []),
+            scale_to_zero_idle_seconds=float(
+                spec.get("scaleToZeroIdleSeconds", 300.0)
+            ),
+            cold_start_grace_seconds=float(
+                spec.get("coldStartGraceSeconds", 60.0)
+            ),
+            target_queue_depth=int(spec.get("targetQueueDepth", 4)),
+            scale_down_budget_surplus=float(
+                spec.get("scaleDownBudgetSurplus", 0.5)
+            ),
+            scheduler_name=spec.get("schedulerName", "nos-scheduler"),
+        ),
+        status=ModelServingStatus(
+            replicas=int(status.get("replicas", 0)),
+            ready_replicas=int(status.get("readyReplicas", 0)),
+            desired_replicas=int(status.get("desiredReplicas", 0)),
+            last_verdict=status.get("lastVerdict", ""),
+            last_transition_t=float(status.get("lastTransitionTime", 0.0)),
+            cold_start_since=float(status.get("coldStartSince", 0.0)),
+            cold_starts=int(status.get("coldStarts", 0)),
+        ),
+    )
+
+
 # ----------------------------------------------------------------- dispatch
 
 _TO_WIRE = {
@@ -749,6 +823,7 @@ _TO_WIRE = {
     "PodDisruptionBudget": pdb_to_wire,
     "ElasticQuota": eq_to_wire,
     "CompositeElasticQuota": ceq_to_wire,
+    "ModelServing": modelserving_to_wire,
 }
 
 _FROM_WIRE = {
@@ -760,6 +835,7 @@ _FROM_WIRE = {
     "PodDisruptionBudget": pdb_from_wire,
     "ElasticQuota": eq_from_wire,
     "CompositeElasticQuota": ceq_from_wire,
+    "ModelServing": modelserving_from_wire,
 }
 
 

@@ -260,3 +260,28 @@ class BoardReservation:
                     node.metadata.name,
                     key,
                 )
+
+
+class AutoscalerGraceScoring:
+    """Cold-start grace reservations steer placement softly: a node a
+    scaled-to-zero model vacated stays carved for that model's return
+    (annot.AUTOSCALER_RESERVED, written by the model autoscaler). The
+    returning model's replicas score highest there — the cold start
+    re-lands on a board that needs no re-carve — while unrelated pods
+    prefer unreserved nodes, so the grace hold is not silently consumed
+    the moment anything else scales up. A score, not a filter: under
+    genuine pressure the reserved board is still usable, the hold only
+    loses ties. Expiry is the autoscaler's sweep's job — scoring reads no
+    clock, keeping cycles replayable."""
+
+    name = "AutoscalerGraceScore"
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> int:
+        holder = node_info.node.metadata.annotations.get(
+            annot.AUTOSCALER_RESERVED, ""
+        )
+        if not holder:
+            return 30
+        if pod.metadata.labels.get(labels.MODEL_SERVING_LABEL, "") == holder:
+            return 50
+        return 0

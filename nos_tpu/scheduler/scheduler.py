@@ -52,7 +52,10 @@ def new_framework(
 ) -> "tuple[Framework, CapacityScheduling, GangScheduling]":
     """Default plugin wiring (the in-tree registry + nos plugins, reference
     cmd/gpupartitioner/gpupartitioner.go:294-318 and cmd/scheduler)."""
-    from nos_tpu.scheduler.plugins.reservation import BoardReservation
+    from nos_tpu.scheduler.plugins.reservation import (
+        AutoscalerGraceScoring,
+        BoardReservation,
+    )
 
     capacity = CapacityScheduling(store)
     gang = GangScheduling(store, wait_timeout_seconds=gang_timeout_seconds)
@@ -68,6 +71,7 @@ def new_framework(
             IciTopologyScoring(store),
             TaintTolerationScoring(),
             PodTopologySpreadScoring(),
+            AutoscalerGraceScoring(),
         ],
     )
     capacity.framework = framework  # preemption re-runs the filters

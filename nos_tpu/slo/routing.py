@@ -1,0 +1,235 @@
+"""Per-replica routing shim: the diurnal workload meets the SimCluster.
+
+The open-loop driver (slo/driver.py) drives ONE engine per model. The
+autoscaler changes replica counts mid-run, so this module adds the
+missing layer: a router that keeps one cost-model replica
+(``SimReplicaEngine``) per live replica Pod, spreads each model's
+arrivals round-robin across them, and holds a backlog while a
+scaled-to-zero model has no replicas — the backlog is what turns a cold
+start into an honest TTFT penalty, because requests keep their original
+arrival stamps and wait out the wake-up in virtual time.
+
+``SimReplicaEngine`` is deliberately NOT serve/engine.py: that engine
+runs a real JAX model. A replica here is the cost model alone — the
+same ``ServeTelemetry`` hooks, the same ``VirtualServeClock`` arithmetic
+(prefill cost per token, one batched tick per decode round), no device —
+so a bench can run dozens of replica-epochs in milliseconds while
+producing the same latency bookkeeping the real engine would.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from nos_tpu.controllers.autoscaler.signals import SignalRegistry
+from nos_tpu.serve.telemetry import ServeTelemetry, VirtualServeClock
+from nos_tpu.slo.driver import Arrival
+
+
+@dataclass
+class _SimRequest:
+    """The duck-typed surface ServeTelemetry reads off a request."""
+
+    id: int
+    prompt: List[int]
+    max_new_tokens: int
+    adapter: int = 0
+
+
+class SimReplicaEngine:
+    """One replica's continuous-batching cost model.
+
+    Engine-shaped for the driver loop (``submit`` / ``busy`` / ``step`` /
+    ``telemetry``): admission fills ``max_slots`` in submit order, each
+    ``step`` runs one batched decode tick (every active slot emits one
+    token — batching makes the tick cost independent of slot count, like
+    the real engine's fused decode), and a request retires at its token
+    budget.
+    """
+
+    def __init__(
+        self,
+        model: str,
+        max_slots: int = 8,
+        ready_t: float = 0.0,
+        tick_cost_s: float = 0.008,
+        prefill_token_cost_s: float = 0.0002,
+        ttft_target_s: Optional[float] = None,
+        e2e_target_s: Optional[float] = None,
+        on_complete=None,
+    ) -> None:
+        self.model = model
+        self.max_slots = max_slots
+        self.telemetry = ServeTelemetry(
+            model=model,
+            clock=VirtualServeClock(
+                tick_cost_s=tick_cost_s,
+                prefill_token_cost_s=prefill_token_cost_s,
+                start=ready_t,
+            ),
+            ttft_target_s=ttft_target_s,
+            e2e_target_s=e2e_target_s,
+            on_complete=on_complete,
+        )
+        self._next_id = 0
+        self._queue: List[_SimRequest] = []
+        # Active slots in admission order: request -> tokens emitted.
+        self._active: List[List] = []
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue or self._active)
+
+    def submit(self, arrival: Arrival, submit_at: float) -> None:
+        req = _SimRequest(
+            id=self._next_id,
+            prompt=list(arrival.prompt),
+            max_new_tokens=max(1, arrival.max_new_tokens),
+            adapter=arrival.adapter,
+        )
+        self._next_id += 1
+        self.telemetry.on_submit(req, bucket=0, submit_at=submit_at)
+        self._queue.append(req)
+
+    def step(self, chunks: int = 1) -> None:
+        while self._queue and len(self._active) < self.max_slots:
+            req = self._queue.pop(0)
+            with self.telemetry.admit_span(req):
+                with self.telemetry.prefill_span(
+                    req, len(req.prompt), path="sim"
+                ):
+                    pass
+            self._active.append([req, 0])
+        if not self._active:
+            return
+        with self.telemetry.decode_span(
+            chunks=chunks, active_slots=len(self._active)
+        ):
+            self.telemetry.on_decode_ticks(1)
+        retired = []
+        for slot in self._active:
+            req, emitted = slot
+            if emitted == 0:
+                self.telemetry.on_first_token(req)
+            slot[1] = emitted + 1
+            if slot[1] >= req.max_new_tokens:
+                retired.append(slot)
+        for slot in retired:
+            self._active.remove(slot)
+            self.telemetry.on_retire(slot[0], slot[1])
+
+
+class ReplicaRouter:
+    """Spreads each model's arrivals over its live replica engines.
+
+    The bench calls ``sync_replicas`` after every control epoch (with
+    the replica pod names the autoscaler + scheduler actually produced)
+    and ``drive`` with the epoch's arrivals. Zero replicas = arrivals
+    accumulate in the model's backlog and surface as queue-depth demand
+    in the signal registry; the next sync's fresh replicas inherit the
+    backlog with the original arrival stamps.
+    """
+
+    def __init__(
+        self,
+        signals: Optional[SignalRegistry] = None,
+        max_slots: int = 8,
+        ttft_targets: Optional[Dict[str, float]] = None,
+        e2e_targets: Optional[Dict[str, float]] = None,
+        on_complete: Optional[Dict[str, object]] = None,
+    ) -> None:
+        self.signals = signals
+        self.max_slots = max_slots
+        self.ttft_targets = ttft_targets or {}
+        self.e2e_targets = e2e_targets or {}
+        self.on_complete = on_complete or {}
+        # model -> replica pod name -> engine (insertion irrelevant:
+        # routing always walks sorted names).
+        self.replicas: Dict[str, Dict[str, SimReplicaEngine]] = {}
+        self.backlog: Dict[str, List[Arrival]] = {}
+        self._rr: Dict[str, int] = {}
+
+    # ----------------------------------------------------------- fleet
+
+    def sync_replicas(
+        self, model: str, replica_names: List[str], ready_t: float
+    ) -> List[str]:
+        """Reconcile the engine set to the given pod names; new replicas
+        come up at ``ready_t`` (epoch end + cold-start model cost).
+        Returns the names created."""
+        engines = self.replicas.setdefault(model, {})
+        wanted = set(replica_names)
+        for name in [n for n in engines if n not in wanted]:
+            del engines[name]
+        created = []
+        for name in sorted(wanted - set(engines)):
+            engines[name] = SimReplicaEngine(
+                model,
+                max_slots=self.max_slots,
+                ready_t=ready_t,
+                ttft_target_s=self.ttft_targets.get(model),
+                e2e_target_s=self.e2e_targets.get(model),
+                on_complete=self.on_complete.get(model),
+            )
+            created.append(name)
+        return created
+
+    def engines(self, model: str) -> List[SimReplicaEngine]:
+        return [e for _, e in sorted(self.replicas.get(model, {}).items())]
+
+    def clock_now(self, model: str) -> float:
+        return max(
+            (e.telemetry.clock.now() for e in self.engines(model)),
+            default=0.0,
+        )
+
+    # ----------------------------------------------------------- driving
+
+    def drive(
+        self, model: str, arrivals: List[Arrival], epoch_end: float
+    ) -> int:
+        """Queue the epoch's arrivals behind any backlog, drive the
+        model's replicas to completion in virtual time, then align every
+        replica clock to ``epoch_end``. Returns requests completed."""
+        backlog = self.backlog.setdefault(model, [])
+        backlog.extend(arrivals)
+        last_t = max((a.t for a in backlog), default=None)
+        engines = self.engines(model)
+        completed = 0
+        if engines:
+            names = sorted(self.replicas[model])
+            rr = self._rr.get(model, 0)
+            per_engine: Dict[str, List[Arrival]] = {n: [] for n in names}
+            for a in backlog:
+                per_engine[names[rr % len(names)]].append(a)
+                rr += 1
+            self._rr[model] = rr
+            backlog.clear()
+            for name in names:
+                completed += self._drive_engine(
+                    self.replicas[model][name], per_engine[name], epoch_end
+                )
+        if self.signals is not None:
+            if last_t is not None:
+                self.signals.note_arrival(model, last_t, len(backlog))
+            else:
+                self.signals.update(model, queue_depth=len(backlog))
+        return completed
+
+    @staticmethod
+    def _drive_engine(
+        engine: SimReplicaEngine, arrivals: List[Arrival], epoch_end: float
+    ) -> int:
+        clock = engine.telemetry.clock
+        before = len(engine.telemetry.completed)
+        i = 0
+        while i < len(arrivals) or engine.busy:
+            while i < len(arrivals) and arrivals[i].t <= clock.now():
+                engine.submit(arrivals[i], submit_at=arrivals[i].t)
+                i += 1
+            if engine.busy:
+                engine.step()
+            elif i < len(arrivals):
+                clock.advance_to(arrivals[i].t)
+        clock.advance_to(epoch_end)
+        return len(engine.telemetry.completed) - before

@@ -31,6 +31,7 @@ class HealthServer:
         profiler: Optional[Any] = None,
         loops_fn: Optional[Callable[[], dict]] = None,
         slo_fn: Optional[Callable[[], dict]] = None,
+        autoscaler_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -57,6 +58,10 @@ class HealthServer:
         # fast/slow windows, compliance, error-budget remaining, recent
         # violations with /debug/traces links); None disables it.
         self.slo_fn = slo_fn
+        # /debug/autoscaler -> the ModelServingReconciler rollup (per
+        # ModelServing desired/ready replicas, last verdict, cold starts,
+        # plus the live signal registry); None disables it.
+        self.autoscaler_fn = autoscaler_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -85,6 +90,7 @@ class HealthServer:
         profiler = self.profiler
         loops_fn = self.loops_fn
         slo_fn = self.slo_fn
+        autoscaler_fn = self.autoscaler_fn
 
         # The /debug/ index: every debug surface this listener actually
         # serves, with a one-liner. Conditional entries appear only when
@@ -125,6 +131,12 @@ class HealthServer:
                 "serving SLO rollup: per-SLO fast/slow-window burn rates, "
                 "compliance, error-budget remaining, recent violations "
                 "linked into /debug/traces"
+            )
+        if autoscaler_fn is not None:
+            debug_index["/debug/autoscaler"] = (
+                "model autoscaler rollup: per-ModelServing desired/ready "
+                "replicas, last verdict, cold starts, and the burn/queue "
+                "signal registry"
             )
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
@@ -307,6 +319,21 @@ class HealthServer:
                         return
                     self._respond(
                         200, json.dumps(slo_fn(), indent=2), "application/json"
+                    )
+                elif (
+                    path == "/debug/autoscaler"
+                    and serve_metrics
+                    and autoscaler_fn is not None
+                ):
+                    # Same credential as /metrics: the rollup names models
+                    # and ModelServing objects.
+                    if not self._authorized():
+                        self._respond(401, "unauthorized")
+                        return
+                    self._respond(
+                        200,
+                        json.dumps(autoscaler_fn(), indent=2),
+                        "application/json",
                     )
                 elif path in ("/debug", "/debug/") and serve_metrics:
                     # Bearer-gated like every endpoint it links to — the

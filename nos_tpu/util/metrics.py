@@ -560,9 +560,10 @@ CHAOS_CONVERGENCE = REGISTRY.histogram(
 CAPACITY_CHIP_SECONDS = REGISTRY.counter(
     "nos_tpu_capacity_chip_seconds_total",
     "Chip-seconds integrated between control-cycle observations, by "
-    "state=busy|no-demand|pending-unschedulable|reconfig|reserved-by-gang "
-    "(idle states attribute where idle time went; reason carries the "
-    "dominant carve-failure prefix for pending-unschedulable)",
+    "state=busy|no-demand|pending-unschedulable|reconfig|reserved-by-gang"
+    "|autoscaler-grace (idle states attribute where idle time went; "
+    "reason carries the dominant carve-failure prefix for "
+    "pending-unschedulable)",
 )
 CAPACITY_UTILIZATION = REGISTRY.gauge(
     "nos_tpu_capacity_utilization",
@@ -604,6 +605,24 @@ QUOTA_STARVED_CHIPS = REGISTRY.gauge(
     "nos_tpu_quota_starved_chips",
     "Chips of guaranteed ElasticQuota min a namespace is short of while "
     "it has pending demand (by namespace)",
+)
+
+# Model autoscaler (controllers/autoscaler/): burn-rate-driven replica
+# scaling of ModelServing objects.
+AUTOSCALER_REPLICAS = REGISTRY.gauge(
+    "nos_tpu_autoscaler_replicas",
+    "Replica counts per ModelServing (by model, state=desired|ready)",
+)
+AUTOSCALER_DECISIONS = REGISTRY.counter(
+    "nos_tpu_autoscaler_decisions_total",
+    "Autoscaler policy verdicts per reconcile "
+    "(by verdict=hold|scale-up|scale-down|scale-to-zero|cold-start)",
+)
+AUTOSCALER_COLD_START_SECONDS = REGISTRY.histogram(
+    "nos_tpu_autoscaler_cold_start_seconds",
+    "Time from a scaled-to-zero model's wake decision to its first "
+    "replica binding to a node (carve wait included)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
 )
 
 # Control-plane saturation telemetry (util/loop_health.py, util/profiling.py,

@@ -99,6 +99,36 @@ class TestIntegration:
         ledger.observe(T0 + 10)
         assert ledger.totals()["idle"][BUCKET_RESERVED] == 80.0
 
+    def test_autoscaler_grace_hold_idles_into_its_own_bucket(self):
+        # A board vacated by scale-to-zero carries the autoscaler's grace
+        # annotations: that idle window is the cost of instant cold
+        # starts, not unexplained no-demand waste — and when the hold is
+        # released the same chips flow back to no-demand.
+        from nos_tpu.capacity import BUCKET_AUTOSCALER
+
+        store, ledger = make_ledger()
+        node = build_tpu_node(
+            name="n1",
+            chips=8,
+            annotations={
+                annot.AUTOSCALER_RESERVED: "default.svc",
+                annot.AUTOSCALER_RESERVED_UNTIL: str(T0 + 60),
+            },
+        )
+        store.create(node)
+        ledger.observe(T0)
+        ledger.observe(T0 + 10)
+        assert ledger.totals()["idle"][BUCKET_AUTOSCALER] == 80.0
+        store.patch_annotations(
+            "Node", "n1", "",
+            {annot.AUTOSCALER_RESERVED: None, annot.AUTOSCALER_RESERVED_UNTIL: None},
+        )
+        ledger.observe(T0 + 20)  # interval [10, 20) still held (pre-drain)
+        ledger.observe(T0 + 30)
+        t = ledger.totals()
+        assert t["idle"][BUCKET_AUTOSCALER] == 160.0
+        assert t["idle"][BUCKET_NO_DEMAND] == 80.0
+
     def test_namespace_and_pool_rollups(self):
         store, ledger = make_ledger()
         store.create(build_tpu_node(name="n1", chips=8))

@@ -1,0 +1,102 @@
+"""The autoscaler-settled oracle: a healed cluster's replica fleets must
+match what the pure decision function says, and the sweep's driver wires
+the live autoscaler into every convergence poll."""
+from nos_tpu.api.config import AutoscalerConfig
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.api.v1alpha1.modelserving import ModelServing, ModelServingSpec
+from nos_tpu.chaos import oracles
+from nos_tpu.controllers.autoscaler import ModelServingReconciler, SignalRegistry
+from nos_tpu.controllers.autoscaler.controller import serving_key
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import build_tpu_node
+
+
+def _rig(min_replicas=1):
+    store = KubeStore()
+    clock = {"t": 100.0}
+    signals = SignalRegistry(now_fn=lambda: clock["t"])
+    autoscaler = ModelServingReconciler(
+        store, AutoscalerConfig(), signals=signals
+    )
+    store.create(build_tpu_node(name="n0"))
+    store.create(
+        ModelServing(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            spec=ModelServingSpec(
+                model="svc", min_replicas=min_replicas, max_replicas=2,
+                slos=["p95 ttft < 1s"],
+            ),
+        )
+    )
+    return store, clock, autoscaler
+
+
+def test_settled_fleet_passes():
+    store, clock, autoscaler = _rig()
+    autoscaler.reconcile(Request(name="svc", namespace="default"))
+    assert oracles.autoscaler_settled(store, autoscaler) == []
+
+
+def test_wedged_reconciler_is_flagged():
+    # Status says one replica is desired but no pod exists: a burst ate
+    # the replica and the reconciler never actuated the verdict.
+    store, clock, autoscaler = _rig()
+    autoscaler.reconcile(Request(name="svc", namespace="default"))
+    store.delete("Pod", "svc-replica-0", "default")
+    clock["t"] = 200.0
+    violations = oracles.autoscaler_settled(store, autoscaler)
+    assert violations and violations[0].startswith(oracles.AUTOSCALER_SETTLED)
+    # ...and healing it (one reconcile) clears the oracle.
+    autoscaler.reconcile(Request(name="svc", namespace="default"))
+    assert oracles.autoscaler_settled(store, autoscaler) == []
+
+
+def test_terminating_replicas_are_not_settled():
+    store, clock, autoscaler = _rig()
+    autoscaler.reconcile(Request(name="svc", namespace="default"))
+
+    def mark(p):
+        p.metadata.deletion_timestamp = 123.0
+
+    store.patch_merge("Pod", "svc-replica-0", "default", mark)
+    violations = oracles.autoscaler_settled(store, autoscaler)
+    assert violations and "tearing down" in violations[0]
+
+
+def test_check_convergence_includes_the_autoscaler():
+    store, clock, autoscaler = _rig()
+    autoscaler.reconcile(Request(name="svc", namespace="default"))
+    ms = store.get("ModelServing", "svc", "default")
+    pod = store.get("Pod", "svc-replica-0", "default")
+    assert pod.metadata.labels[labels.MODEL_SERVING_LABEL] == serving_key(ms)
+    store.delete("Pod", "svc-replica-0", "default")
+    clock["t"] = 200.0
+    # Replica pods pend-free here (deleted), so the only violations come
+    # from the autoscaler oracle — and only when it is passed in.
+    assert oracles.check_convergence(store) == []
+    out = oracles.check_convergence(store, autoscaler=autoscaler)
+    assert oracles.failing_oracles(out) == [oracles.AUTOSCALER_SETTLED]
+
+
+def test_chaos_driver_builds_with_the_autoscaler():
+    from nos_tpu.chaos.driver import MODEL_SERVING_NAME, ChaosConfig, ChaosDriver
+
+    driver = ChaosDriver(
+        ChaosConfig(seed=3, bursts=1, nodes=2, backend="memory", burst_s=0.2)
+    )
+    # The sweep rides this same _build path for all 50 seeds: the
+    # autoscaler component and its ModelServing are part of every run.
+    driver._build()
+    try:
+        assert driver.cluster.autoscaler is not None
+        assert (
+            driver.cluster.store.try_get(
+                "ModelServing", MODEL_SERVING_NAME, "default"
+            )
+            is not None
+        )
+    finally:
+        driver.cluster.stop()

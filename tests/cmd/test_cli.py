@@ -45,17 +45,49 @@ nodes:
 """
         )
         config = load_config(str(cfg_file))
-        partitioner, scheduler, agent = configs_from(config)
+        partitioner, scheduler, agent, autoscaler = configs_from(config)
         assert partitioner.batch_window_timeout_seconds == 5
         assert scheduler.retry_seconds == 0.2
         assert agent.report_config_interval_seconds == 2
+        assert autoscaler is None  # no `autoscaler:` section -> component off
         node = seed_node(config["nodes"][0])
         assert node.metadata.name == "tpu-0"
         assert node.status.capacity["google.com/tpu"] == 8
 
     def test_empty_config(self):
-        partitioner, scheduler, agent = configs_from({})
+        partitioner, scheduler, agent, autoscaler = configs_from({})
         assert partitioner.batch_window_timeout_seconds == 60.0
+        assert autoscaler is None
+
+    def test_autoscaler_section(self, tmp_path):
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text(
+            """
+autoscaler:
+  scaleUpBurnThreshold: 2.0
+  resyncSeconds: 1.5
+"""
+        )
+        _, _, _, autoscaler = configs_from(load_config(str(cfg)))
+        assert autoscaler is not None
+        assert autoscaler.scale_up_burn_threshold == 2.0
+        assert autoscaler.resync_seconds == 1.5
+
+    def test_seed_modelserving(self):
+        from nos_tpu.cmd.run import seed_modelserving
+
+        ms = seed_modelserving(
+            {
+                "name": "chat",
+                "model": "llama-70b",
+                "sliceProfile": "2x4",
+                "minReplicas": 1,
+                "maxReplicas": 3,
+                "slos": ["p95 ttft < 500ms"],
+            }
+        )
+        assert ms.spec.chips_per_replica == 8
+        assert ms.spec.max_replicas == 3
 
 
 class TestExporterCli:
@@ -75,6 +107,7 @@ class TestExporterCli:
 
     def test_empty_yaml_sections_use_defaults(self, tmp_path):
         cfg = tmp_path / "c.yaml"
-        cfg.write_text("partitioner:\nscheduler:\nagent:\n")
-        partitioner, scheduler, agent = configs_from(load_config(str(cfg)))
+        cfg.write_text("partitioner:\nscheduler:\nagent:\nautoscaler:\n")
+        partitioner, scheduler, agent, autoscaler = configs_from(load_config(str(cfg)))
         assert partitioner.batch_window_timeout_seconds == 60.0
+        assert autoscaler is not None  # bare section -> defaults, component on

@@ -1,0 +1,334 @@
+"""ModelServing autoscaler: the pure decision function, the reconciler's
+pod/annotation writes, and the scale-to-zero edge cases the paper's
+serving story hinges on (teardown races, cold-start onto a re-carving
+board, min_replicas=0 under a standing SLO)."""
+import pytest
+
+from nos_tpu.api.config import AutoscalerConfig
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import constants, labels
+from nos_tpu.api.v1alpha1.modelserving import ModelServing, ModelServingSpec
+from nos_tpu.controllers.autoscaler import (
+    ModelServingReconciler,
+    SignalRegistry,
+    policy,
+)
+from nos_tpu.controllers.autoscaler.controller import replica_name, serving_key
+from nos_tpu.controllers.autoscaler.signals import Signals
+from nos_tpu.kube.controller import Request
+from nos_tpu.kube.events import EventRecorder
+from nos_tpu.kube.objects import ObjectMeta
+from nos_tpu.kube.store import KubeStore
+
+from tests.factory import build_tpu_node
+
+CFG = AutoscalerConfig(
+    scale_down_stable_seconds=30.0, recent_activity_seconds=10.0
+)
+
+
+def spec(**kw):
+    base = dict(
+        model="m", slice_profile="2x4", min_replicas=0, max_replicas=3,
+        slos=["p95 ttft < 500ms"], scale_to_zero_idle_seconds=60.0,
+        cold_start_grace_seconds=30.0, target_queue_depth=4,
+    )
+    base.update(kw)
+    return ModelServingSpec(**base)
+
+
+class TestDecide:
+    def test_hold_inside_band(self):
+        d = policy.decide(spec(), 1, Signals(last_request_t=95.0), CFG, 100.0)
+        assert d.verdict == policy.VERDICT_HOLD and d.desired == 1
+
+    def test_scale_up_on_fast_burn(self):
+        sig = Signals(burn_fast=1.5, last_request_t=99.0)
+        d = policy.decide(spec(), 1, sig, CFG, 100.0)
+        assert d.verdict == policy.VERDICT_SCALE_UP and d.desired == 2
+
+    def test_scale_up_on_backlog(self):
+        sig = Signals(queue_depth=9, last_request_t=99.0)
+        d = policy.decide(spec(), 2, sig, CFG, 100.0)
+        assert d.verdict == policy.VERDICT_SCALE_UP and d.desired == 3
+
+    def test_no_scale_up_past_max(self):
+        sig = Signals(burn_fast=9.0, last_request_t=99.0)
+        d = policy.decide(spec(max_replicas=2), 2, sig, CFG, 100.0)
+        assert d.verdict == policy.VERDICT_HOLD and d.desired == 2
+
+    def test_below_min_heals(self):
+        d = policy.decide(spec(min_replicas=2), 1, Signals(), CFG, 100.0)
+        assert d.verdict == policy.VERDICT_SCALE_UP and d.desired == 2
+
+    def test_scale_down_needs_surplus_and_stability(self):
+        calm = Signals(
+            burn_fast=0.1, burn_slow=0.1, error_budget_remaining=0.9,
+            last_request_t=99.0,
+        )
+        d = policy.decide(spec(), 2, calm, CFG, 100.0, last_transition_t=80.0)
+        assert d.verdict == policy.VERDICT_HOLD  # only 20s stable of 30
+        d = policy.decide(spec(), 2, calm, CFG, 120.0, last_transition_t=80.0)
+        assert d.verdict == policy.VERDICT_SCALE_DOWN and d.desired == 1
+        burnt = Signals(
+            burn_fast=0.1, burn_slow=0.1, error_budget_remaining=0.2,
+            last_request_t=119.0,
+        )
+        d = policy.decide(spec(), 2, burnt, CFG, 120.0, last_transition_t=80.0)
+        assert d.verdict == policy.VERDICT_HOLD  # budget below surplus floor
+
+    def test_one_transition_per_timestamp(self):
+        sig = Signals(burn_fast=9.0, last_request_t=99.0)
+        d = policy.decide(spec(), 2, sig, CFG, 100.0, last_transition_t=100.0)
+        assert d.verdict == policy.VERDICT_HOLD and d.desired == 2
+
+    def test_cold_start_jumps_to_min_floor(self):
+        sig = Signals(queue_depth=3)
+        d = policy.decide(spec(min_replicas=2), 0, sig, CFG, 100.0)
+        assert d.verdict == policy.VERDICT_COLD_START and d.desired == 2
+
+    def test_min_replicas_zero_with_standing_slo_scales_to_zero(self):
+        # A declared SLO with zero traffic is vacuously compliant: burn 0,
+        # full budget. That must NOT hold a replica alive past the idle
+        # window — the budget-surplus scale-down gate is for fleets above
+        # the floor, not for idle-out.
+        idle = Signals(
+            burn_fast=0.0, burn_slow=0.0, error_budget_remaining=1.0,
+            queue_depth=0, last_request_t=10.0,
+        )
+        d = policy.decide(spec(), 1, idle, CFG, 100.0, last_transition_t=20.0)
+        assert d.verdict == policy.VERDICT_SCALE_TO_ZERO and d.desired == 0
+
+    def test_min_replicas_floor_blocks_scale_to_zero(self):
+        idle = Signals(last_request_t=10.0)
+        d = policy.decide(
+            spec(min_replicas=1), 1, idle, CFG, 500.0, last_transition_t=20.0
+        )
+        assert d.verdict == policy.VERDICT_HOLD and d.desired == 1
+
+
+class _Rig:
+    def __init__(self, ms_spec=None):
+        self.t = 0.0
+        self.store = KubeStore()
+        self.signals = SignalRegistry(now_fn=lambda: self.t)
+        self.recorder = EventRecorder(
+            self.store, component="nos-autoscaler", clock=lambda: self.t
+        )
+        self.reconciler = ModelServingReconciler(
+            self.store, CFG, signals=self.signals, recorder=self.recorder
+        )
+        self.ms = ModelServing(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            spec=ms_spec or spec(),
+        )
+        self.store.create(self.ms)
+        for i in range(3):
+            self.store.create(build_tpu_node(name=f"n{i}"))
+
+    def reconcile(self):
+        self.reconciler.reconcile(Request(name="svc", namespace="default"))
+
+    def pods(self):
+        key = serving_key(self.ms)
+        return sorted(
+            p.metadata.name
+            for p in self.store.list("Pod", namespace="default")
+            if p.metadata.labels.get(labels.MODEL_SERVING_LABEL) == key
+        )
+
+    def bind(self, pod_name, node_name):
+        def mutate(p):
+            p.spec.node_name = node_name
+
+        self.store.patch_merge("Pod", pod_name, "default", mutate)
+
+    def status(self):
+        return self.store.get("ModelServing", "svc", "default").status
+
+
+class TestReconciler:
+    def test_cold_start_creates_dense_replicas_and_events(self):
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        assert rig.pods() == [replica_name("svc", 0)]
+        st = rig.status()
+        assert st.desired_replicas == 1
+        assert st.last_verdict == policy.VERDICT_COLD_START
+        assert st.cold_starts == 1
+        reasons = {e.reason for e in rig.store.list("Event")}
+        assert constants.EVENT_REASON_COLD_START in reasons
+        assert constants.EVENT_REASON_SCALED_UP in reasons
+
+    def test_scale_up_is_idempotent_at_one_timestamp(self):
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        rig.reconcile()  # watch replay at the same instant
+        assert rig.pods() == [replica_name("svc", 0)]
+
+    def test_scale_down_deletes_top_and_reserves_boards(self):
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        rig.bind(replica_name("svc", 0), "n1")
+        # Idle out past the window: teardown to zero with a grace hold.
+        rig.t = 300.0
+        rig.signals.update("m", queue_depth=0)
+        rig.reconcile()
+        assert rig.pods() == []
+        st = rig.status()
+        assert st.desired_replicas == 0
+        assert st.last_verdict == policy.VERDICT_SCALE_TO_ZERO
+        node = rig.store.get("Node", "n1")
+        assert node.metadata.annotations[annot.AUTOSCALER_RESERVED] == "default.svc"
+        until = float(node.metadata.annotations[annot.AUTOSCALER_RESERVED_UNTIL])
+        assert until == pytest.approx(330.0)
+        reasons = {e.reason for e in rig.store.list("Event")}
+        assert constants.EVENT_REASON_SCALED_TO_ZERO in reasons
+
+    def test_grace_reservation_expires_on_its_own_clock(self):
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        rig.bind(replica_name("svc", 0), "n1")
+        rig.t = 300.0
+        rig.signals.update("m", queue_depth=0)
+        rig.reconcile()
+        rig.t = 331.0  # past the 30s grace
+        rig.reconcile()
+        node = rig.store.get("Node", "n1")
+        assert annot.AUTOSCALER_RESERVED not in node.metadata.annotations
+        assert annot.AUTOSCALER_RESERVED_UNTIL not in node.metadata.annotations
+
+    def test_request_arriving_during_teardown_cold_starts_again(self):
+        # Edge case: demand lands between the scale-to-zero write and the
+        # next resync. The very next reconcile must flip straight back to
+        # a cold start (fresh pod) and release the grace hold so the
+        # scheduler is free to use the board for it.
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        rig.bind(replica_name("svc", 0), "n1")
+        rig.t = 300.0
+        rig.signals.update("m", queue_depth=0)
+        rig.reconcile()
+        assert rig.pods() == []
+        rig.t = 301.0
+        rig.signals.note_arrival("m", 300.5, queue_depth=2)
+        rig.reconcile()
+        assert rig.pods() == [replica_name("svc", 0)]
+        st = rig.status()
+        assert st.last_verdict == policy.VERDICT_COLD_START
+        assert st.cold_starts == 2
+        node = rig.store.get("Node", "n1")
+        assert annot.AUTOSCALER_RESERVED not in node.metadata.annotations
+
+    def test_cold_start_with_board_mid_recarve(self):
+        # Edge case: the freed board was already handed to the partitioner
+        # when demand returns — the node is gone from the store (drained
+        # for re-carve) at cold-start time. The reconciler must still
+        # create the replica pod and sweep cleanly (NotFound on the
+        # reservation patch is not an error); the pod simply pends until
+        # a board exists again.
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        rig.bind(replica_name("svc", 0), "n1")
+        rig.t = 300.0
+        rig.signals.update("m", queue_depth=0)
+        rig.reconcile()
+        rig.store.delete("Node", "n1")  # mid-re-carve: board vanishes
+        rig.t = 302.0
+        rig.signals.note_arrival("m", 301.0, queue_depth=1)
+        rig.reconcile()
+        assert rig.pods() == [replica_name("svc", 0)]
+        assert rig.status().last_verdict == policy.VERDICT_COLD_START
+
+    def test_standing_slo_does_not_hold_replicas(self):
+        # Edge case: min_replicas=0 and a declared SLO, traffic long gone.
+        # Vacuous compliance (burn 0, budget 1.0) must not pin the fleet.
+        rig = _Rig(ms_spec=spec(slos=["p95 ttft < 100ms", "availability 99.9%"]))
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        rig.bind(replica_name("svc", 0), "n0")
+        rig.t = 500.0
+        rig.signals.update(
+            "m", queue_depth=0, burn_fast=0.0, burn_slow=0.0,
+            error_budget_remaining=1.0,
+        )
+        rig.reconcile()
+        assert rig.pods() == []
+        assert rig.status().last_verdict == policy.VERDICT_SCALE_TO_ZERO
+
+    def test_deleted_modelserving_collects_orphans(self):
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        assert rig.pods()
+        rig.store.delete("ModelServing", "svc", "default")
+        rig.reconcile()
+        assert rig.pods() == []
+
+    def test_replica_pods_are_gangs_of_one_requesting_chips(self):
+        rig = _Rig()
+        rig.t = 100.0
+        rig.signals.note_arrival("m", 99.0, queue_depth=5)
+        rig.reconcile()
+        pod = rig.store.get("Pod", replica_name("svc", 0), "default")
+        from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+        assert pod.metadata.labels[GANG_SIZE_LABEL] == "1"
+        assert pod.metadata.labels[GANG_NAME_LABEL] == replica_name("svc", 0)
+        assert pod.spec.containers[0].requests[constants.RESOURCE_TPU] == 8
+
+
+def test_cluster_wiring_places_min_replicas():
+    """The async component (build_cluster + watches): a min_replicas=1
+    ModelServing becomes a bound, carved replica pod with no bench in the
+    loop at all."""
+    import time
+
+    from nos_tpu.api.config import GpuPartitionerConfig, SchedulerConfig
+    from nos_tpu.cmd.cluster import build_cluster
+
+    cluster = build_cluster(
+        partitioner_config=GpuPartitionerConfig(
+            batch_window_timeout_seconds=1.0, batch_window_idle_seconds=0.05
+        ),
+        scheduler_config=SchedulerConfig(retry_seconds=0.1),
+        autoscaler_config=AutoscalerConfig(resync_seconds=0.2),
+    )
+    cluster.add_tpu_node(build_tpu_node(name="tpu-0"))
+    cluster.store.create(
+        ModelServing(
+            metadata=ObjectMeta(name="svc", namespace="default"),
+            spec=spec(min_replicas=1, max_replicas=1),
+        )
+    )
+    cluster.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        pod = None
+        while time.monotonic() < deadline:
+            pod = cluster.store.try_get("Pod", replica_name("svc", 0), "default")
+            if pod is not None and pod.spec.node_name:
+                break
+            time.sleep(0.05)
+        assert pod is not None and pod.spec.node_name == "tpu-0"
+        st = cluster.store.get("ModelServing", "svc", "default").status
+        assert st.desired_replicas == 1
+        assert cluster.autoscaler is not None
+        payload = cluster.autoscaler.debug_payload()
+        assert payload["servings"]["default/svc"]["ready_replicas"] == 1
+    finally:
+        cluster.stop()

@@ -234,3 +234,55 @@ class TestPodAffinityExpressions:
         # the term must actually select by expression
         assert term.selects({"app": "api"}, "ns", "ns")
         assert not term.selects({"app": "db"}, "ns", "ns")
+
+
+class TestModelServingWire:
+    def test_roundtrip_is_identity(self):
+        from nos_tpu.api.v1alpha1.modelserving import (
+            ModelServing,
+            ModelServingSpec,
+            ModelServingStatus,
+        )
+
+        ms = ModelServing(
+            metadata=ObjectMeta(name="chat", namespace="serving"),
+            spec=ModelServingSpec(
+                model="llama-70b",
+                slice_profile="2x4",
+                min_replicas=1,
+                max_replicas=3,
+                slos=["p95 ttft < 300ms", "availability 99.9%"],
+                scale_to_zero_idle_seconds=120.0,
+                cold_start_grace_seconds=45.0,
+                target_queue_depth=6,
+                scale_down_budget_surplus=0.4,
+            ),
+            status=ModelServingStatus(
+                replicas=2,
+                ready_replicas=1,
+                desired_replicas=2,
+                last_verdict="scale-up",
+                last_transition_t=123.5,
+                cold_starts=1,
+            ),
+        )
+        wire = serde.to_wire(ms)
+        assert wire["kind"] == "ModelServing"
+        assert wire["apiVersion"] == "nos.nebuly.com/v1alpha1"
+        back = serde.from_wire(wire)
+        assert back.spec == ms.spec
+        assert back.status == ms.status
+        assert back.metadata.name == "chat"
+        assert back.spec.chips_per_replica == 8
+
+    def test_validate_rejects_bad_specs(self):
+        from nos_tpu.api.v1alpha1.modelserving import ModelServingSpec
+
+        with pytest.raises(ValueError):
+            ModelServingSpec(model="m", slice_profile="9z9").validate()
+        with pytest.raises(ValueError):
+            ModelServingSpec(model="m", min_replicas=3, max_replicas=1).validate()
+        with pytest.raises(ValueError):
+            ModelServingSpec(model="", max_replicas=1).validate()
+        with pytest.raises(ValueError):
+            ModelServingSpec(model="m", slos=["p95 nonsense"]).validate()

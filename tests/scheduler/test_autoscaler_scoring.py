@@ -1,0 +1,58 @@
+"""AutoscalerGraceScoring: grace-held boards deterministically lose
+ties to unreserved nodes for unrelated pods, and win them for the
+returning model's own replicas — placement (and hence the capacity
+ledger's bucket attribution) stays reproducible around scale-to-zero."""
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.api.v1alpha1 import labels
+from nos_tpu.kube.objects import ObjectMeta, Pod, PodSpec
+from nos_tpu.scheduler.framework import CycleState, NodeInfo
+from nos_tpu.scheduler.plugins.reservation import AutoscalerGraceScoring
+
+from tests.factory import build_tpu_node
+
+
+def pod(serving_key=None):
+    meta = ObjectMeta(name="p", namespace="default")
+    if serving_key:
+        meta.labels[labels.MODEL_SERVING_LABEL] = serving_key
+    return Pod(metadata=meta, spec=PodSpec())
+
+
+def node_info(reserved_for=None):
+    annotations = {}
+    if reserved_for:
+        annotations[annot.AUTOSCALER_RESERVED] = reserved_for
+        annotations[annot.AUTOSCALER_RESERVED_UNTIL] = "1000.0"
+    return NodeInfo(build_tpu_node(name="n", annotations=annotations))
+
+
+def test_unreserved_node_scores_neutral():
+    plugin = AutoscalerGraceScoring()
+    assert plugin.score(CycleState(), pod(), node_info()) == 30
+
+
+def test_holder_model_prefers_its_grace_board():
+    plugin = AutoscalerGraceScoring()
+    own = plugin.score(
+        CycleState(), pod("default.svc"), node_info(reserved_for="default.svc")
+    )
+    neutral = plugin.score(CycleState(), pod("default.svc"), node_info())
+    assert own > neutral  # cold start lands back on the still-carved board
+
+
+def test_foreign_pod_avoids_grace_boards():
+    plugin = AutoscalerGraceScoring()
+    foreign = plugin.score(
+        CycleState(), pod("default.other"), node_info(reserved_for="default.svc")
+    )
+    plain = plugin.score(CycleState(), pod(), node_info(reserved_for="default.svc"))
+    assert foreign == plain == 0  # soft steering: score, not filter
+
+
+def test_plugin_is_wired_into_the_default_framework():
+    from nos_tpu.kube.store import KubeStore
+    from nos_tpu.scheduler.scheduler import new_framework
+
+    framework, _, _ = new_framework(KubeStore())
+    names = [type(p).__name__ for p in framework.score_plugins]
+    assert "AutoscalerGraceScoring" in names

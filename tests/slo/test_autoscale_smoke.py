@@ -1,0 +1,72 @@
+"""Autoscale-smoke gate: the full closed loop (diurnal workload ->
+burn-rate signals -> ModelServing verdicts -> replica pods placed and
+carved by the live SimCluster) at smoke scale, run twice in-process —
+byte-identical reports at the pinned seed, and the committed
+BENCH_autoscale.json must keep telling the acceptance story: SLOs met
+at peak, one model scaled to zero with its chips reclaimed."""
+import json
+import os
+
+import bench_autoscale
+
+
+def _run(seed):
+    # 80 virtual seconds: long enough for the cold model to idle out,
+    # scale to zero, AND accrue grace chip-seconds before the trace ends.
+    return bench_autoscale.run_bench(seed=seed, duration_s=80.0, rate_rps=14.0)
+
+
+def test_closed_loop_is_bit_stable_and_scales_to_zero():
+    first = _run(seed=0)
+    second = _run(seed=0)
+    body1 = json.dumps(first, indent=2, sort_keys=True)
+    body2 = json.dumps(second, indent=2, sort_keys=True)
+    # Fresh cluster + virtual clocks, same seed -> same bytes, even though
+    # each run's scheduler/partitioner raced on its own wall clock.
+    assert body1 == body2
+
+    assert set(first) >= {
+        "workload", "servings", "models", "timeline", "scale_events",
+        "cold_start", "peak", "replicas", "capacity",
+    }
+    # The cold model's lifecycle completes inside even the smoke trace:
+    # cold start at the first arrivals, scale-to-zero after the cutoff.
+    assert first["scale_events"].get("cold-start", 0) >= 1
+    assert first["scale_events"].get("scale-to-zero", 0) >= 1
+    assert first["cold_start"]["count"] >= 1
+    assert first["cold_start"]["ttft_penalty_s"]["p95"] > 0
+    # Chips freed by scale-to-zero are booked to the grace bucket and
+    # never leak into the gang-reservation bucket.
+    idle = first["capacity"]["idle_chip_seconds"]
+    assert idle["autoscaler-grace"] > 0
+    assert idle["reserved-by-gang"] == 0
+    assert first["capacity"]["busy_chip_seconds"] > 0
+
+
+def test_seed_changes_the_bytes():
+    base = json.dumps(_run(seed=0), sort_keys=True)
+    other = json.dumps(_run(seed=1), sort_keys=True)
+    assert base != other
+
+
+def test_committed_bench_artifact_tells_the_story():
+    path = os.path.join(os.path.dirname(bench_autoscale.__file__), "BENCH_autoscale.json")
+    with open(path) as f:
+        report = json.load(f)
+    # Acceptance: all declared SLOs compliant at the diurnal peak...
+    assert report["peak"]["slos_compliant"] is True
+    # ...and run-level (slow-window) compliance for every declared SLO.
+    for model, stats in report["models"].items():
+        for slo in stats["slo"]:
+            assert slo["compliant"], (model, slo)
+    # ...at least one model scaled to zero with chips reclaimed: grace
+    # chip-seconds accrued, then the board returns to no-demand rather
+    # than leaking into reserved-by-gang.
+    assert report["scale_events"]["scale-to-zero"] >= 1
+    idle = report["capacity"]["idle_chip_seconds"]
+    assert idle["autoscaler-grace"] > 0
+    assert idle["no-demand"] > 0
+    assert idle["reserved-by-gang"] == 0
+    assert report["replicas"]["final"]["batch"] == 0
+    # The hot model rode the wave: more replicas at peak than at the end.
+    assert report["replicas"]["max_ready"]["chat"] > report["replicas"]["final"]["chat"]
