@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke forecast-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -65,6 +65,12 @@ shard-smoke:
 	    tests/controllers/test_sharded_controller.py -q -m 'not slow'
 	JAX_PLATFORMS=cpu $(PY) bench_planner.py --plan-mode sharded --quick
 
+# Placement-forecaster gate: engine/advisor/accuracy unit tier plus the
+# streaming calibration bench run twice in-process — byte-identical
+# reports at the pinned seed and the accuracy auditor clean on replay.
+forecast-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/forecast -q -m 'not slow'
+
 # Chaos tier-1 gate: one fixed seed through the full suite under fault
 # injection — must converge, replay clean, and fire a byte-identical
 # fault schedule every run. Plus the committed regression fixtures.
@@ -121,6 +127,14 @@ bench-serve:
 # BENCH_autoscale.json.
 bench-autoscale:
 	JAX_PLATFORMS=cpu $(PY) bench_autoscale.py --output BENCH_autoscale.json
+
+# Placement-forecaster calibration on a streaming BENCH_r05-style
+# workload over a virtual clock: per-gang ETA stamps joined against
+# observed binds through the real capacity-ledger listener, defrag
+# advisor validation, and a zero-drift replay of the forecast records.
+# Bit-stable at the pinned seed. See BENCH_forecast.json.
+bench-forecast:
+	JAX_PLATFORMS=cpu $(PY) bench_forecast.py --output BENCH_forecast.json
 
 ## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
 ## for real chips) -------------------------------------------------------

@@ -75,6 +75,20 @@ class GpuPartitionerConfig:
     # the world (partitioning/core/snapcodec.py). Empty = no persistence.
     warm_state_path: str = ""
     warm_state_save_interval_seconds: float = 30.0
+    # Placement forecasting (nos_tpu/forecast/): a background thread with
+    # its own snapshot maintainer + planner publishes per-gang
+    # earliest-feasible-start ETAs, backfill-safety verdicts, and the
+    # read-only defrag advisor's plan every partitioner cycle. Read-only:
+    # off the plan path, never actuates.
+    forecast_enabled: bool = True
+    # Background runs are throttled to at most one per this interval (a
+    # notify storm under a burst must not become a forecast storm).
+    forecast_min_interval_seconds: float = 0.25
+    # Per-run work caps (sorted-order truncation, so deterministic).
+    forecast_max_gangs: int = 32
+    forecast_max_backfill_pairs: int = 64
+    # Pods at or below this many chips count as backfill candidates.
+    forecast_small_pod_chips: int = 2
 
     def validate(self) -> None:
         if self.aging_chips_per_second < 0:
@@ -103,6 +117,14 @@ class GpuPartitionerConfig:
             raise ConfigError(
                 "warm_state_save_interval_seconds must be >= 0"
             )
+        if self.forecast_min_interval_seconds < 0:
+            raise ConfigError("forecast_min_interval_seconds must be >= 0")
+        if self.forecast_max_gangs < 1:
+            raise ConfigError("forecast_max_gangs must be >= 1")
+        if self.forecast_max_backfill_pairs < 0:
+            raise ConfigError("forecast_max_backfill_pairs must be >= 0")
+        if self.forecast_small_pod_chips < 1:
+            raise ConfigError("forecast_small_pod_chips must be >= 1")
 
 
 @dataclass

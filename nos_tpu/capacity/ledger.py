@@ -58,6 +58,7 @@ diffs it against the incrementally-maintained state; the chaos
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -110,15 +111,17 @@ def _reason_prefix(reason: str) -> str:
 
 
 def dominant_unserved_reason(unserved: Dict[str, str]) -> Optional[str]:
-    """The most common normalized reason in a pod→reason map, ties broken
-    lexicographically so the choice is deterministic."""
+    """The most common normalized reason in a pod→reason map. Sorted by
+    (count desc, reason asc) explicitly — never dict insertion order —
+    so the label is deterministic for any map with tied counts (forecast
+    records and replay comparisons inherit this field)."""
     counts: Dict[str, int] = {}
     for reason in unserved.values():
         key = _reason_prefix(reason)
         counts[key] = counts.get(key, 0) + 1
     if not counts:
         return None
-    return max(sorted(counts), key=lambda k: counts[k])
+    return min(counts, key=lambda k: (-counts[k], k))
 
 
 def fragmentation_from_annotations(
@@ -309,6 +312,22 @@ class CapacityLedger:
         # Gang wait clocks (live-only; excluded from replay drift).
         self._gangs: Dict[str, Dict[str, float]] = {}
         self._recent_gangs: deque = deque(maxlen=_RECENT_GANGS)
+        # Live gang membership derived from pod deltas, so a gang whose
+        # every member is deleted before binding drops its wait clock —
+        # a same-named re-arrival must start a fresh clock, not inherit
+        # a stale one (forecast accuracy joins against these waits).
+        self._gang_members: Dict[str, set] = {}
+        self._pod_gang: Dict[str, str] = {}
+        # Fired on gang-bound with (gang, now, wait_seconds), outside the
+        # ledger lock (the forecaster joins forecast accuracy here).
+        self._gang_bound_listeners: List[Any] = []
+        # Measured node reconfig (re-carve actuation) latency: frozen
+        # rising/falling edges observed in the delta stream, stamped with
+        # the observation clock so replay reproduces the same stats.
+        self._apply_now: Optional[float] = None
+        self._reconfig_started: Dict[str, float] = {}
+        self.reconfig_count = 0
+        self.reconfig_seconds_total = 0.0
         # Node names with exported per-node gauges (reset-on-delete).
         self._exported_nodes: set = set()
         # Heartbeat: the control loops only observe when they run (the
@@ -365,6 +384,10 @@ class CapacityLedger:
         with self._lock:
             watermark = self.store.revision
             self._integrate(now)
+            # Deltas drained below are stamped with this observation's
+            # clock (reconfig edge timing): deterministic on replay,
+            # which re-observes with the recorded ``now``.
+            self._apply_now = now
             self._drain_apply(watermark)
             if reason is not _UNSET:
                 self._reason = reason
@@ -506,21 +529,45 @@ class CapacityLedger:
         node = event.object
         name = node.metadata.name
         if event.type == "DELETED":
+            self._reconfig_started.pop(name, None)
             if self._nodes.pop(name, None) is not None and self._metrics:
                 self._zero_node_gauges(name)
             return
         total = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
         if total <= 0:
+            self._reconfig_started.pop(name, None)
             if self._nodes.pop(name, None) is not None and self._metrics:
                 self._zero_node_gauges(name)
             return
-        self._nodes[name] = _NodeState(node, total)
+        old = self._nodes.get(name)
+        state = _NodeState(node, total)
+        self._note_reconfig_edge(name, old, state)
+        self._nodes[name] = state
+
+    def _note_reconfig_edge(
+        self, name: str, old: Optional[_NodeState], new: _NodeState
+    ) -> None:
+        """frozen False→True starts a reconfig; True→False completes it.
+        The elapsed observation-clock time feeds the measured reconfig
+        rate the forecaster prices re-carve ETAs with."""
+        was_frozen = old is not None and old.frozen
+        if new.frozen and not was_frozen:
+            if self._apply_now is not None:
+                self._reconfig_started[name] = self._apply_now
+        elif was_frozen and not new.frozen:
+            started = self._reconfig_started.pop(name, None)
+            if started is not None and self._apply_now is not None:
+                self.reconfig_count += 1
+                self.reconfig_seconds_total += max(
+                    0.0, self._apply_now - started
+                )
 
     def _apply_pod(self, event: Any) -> None:
         pod = event.object
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         self._bound.pop(key, None)
         self._pending.pop(key, None)
+        self._track_gang_membership(key, pod, event.type)
         if event.type == "DELETED":
             return
         chips = _pod_chips(pod)
@@ -531,6 +578,37 @@ class CapacityLedger:
             self._bound[key] = (pod.spec.node_name, chips, pod.metadata.namespace)
         elif phase == "Pending":
             self._pending[key] = (chips, pod.metadata.namespace)
+
+    def _track_gang_membership(
+        self, key: str, pod: Any, event_type: str
+    ) -> None:
+        """Keep ``_gang_members`` consistent with the pod stream, and
+        drop an unbound gang's wait clock the moment its last member
+        disappears — deleted-before-bound and preempt-then-resubmit must
+        restart the clock instead of inheriting a stale arrival."""
+        gang_key = None
+        if event_type != "DELETED":
+            # Lazy import: scheduler.plugins.gang pulls the KubeStore
+            # stack (same pattern as the planner).
+            from nos_tpu.scheduler.plugins.gang import gang_of
+
+            gang = gang_of(pod)
+            gang_key = gang[0] if gang else None
+        prev = self._pod_gang.get(key)
+        if prev == gang_key:
+            return
+        if prev is not None:
+            members = self._gang_members.get(prev)
+            if members is not None:
+                members.discard(key)
+                if not members:
+                    del self._gang_members[prev]
+                    self._gangs.pop(prev, None)
+        if gang_key is None:
+            self._pod_gang.pop(key, None)
+        else:
+            self._pod_gang[key] = gang_key
+            self._gang_members.setdefault(gang_key, set()).add(key)
 
     def _apply_quota(self, event: Any) -> None:
         quota = event.object
@@ -579,13 +657,35 @@ class CapacityLedger:
                     ),
                 }
             )
+            listeners = list(self._gang_bound_listeners)
         if self._metrics:
             m.GANG_WAIT_SECONDS.labels(stage="bound").observe(wait)
+        # Outside the lock: a listener (the forecast accuracy join) may
+        # itself read ledger state or block on I/O.
+        for listener in listeners:
+            try:
+                listener(gang, now, wait)
+            except Exception:
+                logging.getLogger("nos_tpu.capacity").exception(
+                    "gang-bound listener failed for %s", gang
+                )
+
+    def add_gang_bound_listener(self, listener: Any) -> None:
+        """Register ``listener(gang, now, wait_seconds)``, invoked after
+        every gang-bound observation, outside the ledger lock."""
+        with self._lock:
+            self._gang_bound_listeners.append(listener)
 
     def drop_gang(self, gang: str) -> None:
         """Forget a gang's clock (gang timeout: it will never bind)."""
         with self._lock:
             self._gangs.pop(gang, None)
+
+    def gang_clocks(self) -> Dict[str, Dict[str, float]]:
+        """Snapshot of the live gang wait clocks (gang -> stamp map) —
+        the forecaster's wait ages and ETA normalizers."""
+        with self._lock:
+            return {gang: dict(clock) for gang, clock in self._gangs.items()}
 
     # ------------------------------------------------------------ exports
 
@@ -605,6 +705,23 @@ class CapacityLedger:
     def totals(self) -> Dict[str, Any]:
         with self._lock:
             return self._totals()
+
+    def mean_reconfig_seconds(self, default: float = 0.5) -> float:
+        """Measured mean node re-carve latency (frozen edge to edge);
+        ``default`` until the first completed reconfig is observed. Kept
+        out of ``_totals()`` — the replay drift payload must not grow."""
+        with self._lock:
+            if self.reconfig_count <= 0:
+                return default
+            return self.reconfig_seconds_total / self.reconfig_count
+
+    def reconfig_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "count": self.reconfig_count,
+                "seconds_total": self.reconfig_seconds_total,
+                "in_flight": sorted(self._reconfig_started),
+            }
 
     def utilization(self) -> float:
         with self._lock:

@@ -179,6 +179,14 @@ class ChaosDriver:
                 # and chaos pods carry no pool-pinning selectors so most
                 # cycles exercise the mega-pool degradation as well.
                 pool_sharding=True,
+                # Forecasting rides every chaos run: the background
+                # forecaster keeps publishing ETAs through the faults and
+                # the forecast-calibrated oracle (check_convergence)
+                # re-forecasts the healed store — any gang still pending
+                # despite a feasible-now verdict fails the burst. Tight
+                # throttle so forecasts keep pace with 0.3s batch windows.
+                forecast_enabled=True,
+                forecast_min_interval_seconds=0.05,
             ),
             scheduler_config=SchedulerConfig(retry_seconds=0.1),
             # The model autoscaler rides every chaos run: its replica

@@ -21,6 +21,7 @@ AUDITOR_CLEAN = "auditor-clean"
 REPLAY_CLEAN = "replay-clean"
 LEDGER_CONSISTENT = "ledger-consistent"
 AUTOSCALER_SETTLED = "autoscaler-settled"
+FORECAST_CALIBRATED = "forecast-calibrated"
 
 
 def pending_settled(store, scheduler_name: str = "") -> List[str]:
@@ -149,6 +150,34 @@ def ledger_consistent(partitioner, store) -> List[str]:
     ]
 
 
+def forecast_calibrated(partitioner, store) -> List[str]:
+    """After a burst heals, no gang the forecaster classified
+    ``feasible-now`` may still be pending: a feasible-now forecast means
+    the next plan/bind cycle places it, so a gang that stayed
+    continuously feasible-now for several cycles without binding is a
+    forecast the system contradicted (live-only: needs the forecaster).
+    A fresh forecast runs first so the check reads the healed state, not
+    a mid-burst stamp."""
+    forecaster = getattr(partitioner, "forecaster", None)
+    if forecaster is None:
+        return []
+    import time
+
+    now = time.time()
+    try:
+        # The healed store's ACTUAL pending set, not the last notified
+        # batch (whose pods may have bound or vanished since).
+        pending = partitioner.fetch_pending_pods()
+        forecaster.run_once(now=now, pending=pending)
+    except Exception as exc:  # a crashed forecast fails the oracle too
+        return [f"{FORECAST_CALIBRATED}: forecast run failed: {exc!r}"]
+    return [
+        f"{FORECAST_CALIBRATED}: gang {gang} forecast feasible-now has "
+        "not bound within the cycle limit"
+        for gang in forecaster.stale_feasible_now(now)
+    ]
+
+
 def autoscaler_settled(store, autoscaler) -> List[str]:
     """After a burst heals, every ModelServing's replica fleet is stable
     and MATCHES what the decision function says it should be: live pods ==
@@ -211,6 +240,7 @@ def check_convergence(
     if partitioner is not None:
         out += auditor_clean(partitioner, store)
         out += ledger_consistent(partitioner, store)
+        out += forecast_calibrated(partitioner, store)
     if autoscaler is not None:
         out += autoscaler_settled(store, autoscaler)
     return out

@@ -116,6 +116,13 @@ def run_component(
         explain_fn=getattr(component, "explain", None),
         profiler=PROFILER,
         loops_fn=lambda: LOOPS.payload(store=store),
+        # The standalone partitioner's forecaster (None for components
+        # without one — the endpoint stays unregistered).
+        forecast_fn=(
+            getattr(component, "forecaster").debug_payload
+            if getattr(component, "forecaster", None) is not None
+            else None
+        ),
     )
     bound = health.start()
     logging.info("%s: health/metrics on 127.0.0.1:%d", name, bound)

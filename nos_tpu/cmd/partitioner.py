@@ -102,6 +102,31 @@ def build_partitioner(
 
     sim_framework = build_sim_framework(store)
 
+    forecaster = None
+    if config.forecast_enabled:
+        from nos_tpu.forecast import PlacementForecaster
+
+        # The forecaster gets its OWN planner (and, lazily, its own
+        # snapshot maintainer): forecast trials must never clobber the
+        # live controller's per-plan caches or incremental base.
+        forecaster = PlacementForecaster(
+            store,
+            cluster_state,
+            Planner(
+                build_sim_framework(store),
+                aging_chips_per_second=config.aging_chips_per_second,
+            ),
+            TpuSnapshotTaker(),
+            kind="tpu",
+            capacity_ledger=capacity_ledger,
+            flight_recorder=flight_recorder,
+            min_interval_seconds=config.forecast_min_interval_seconds,
+            max_gangs=config.forecast_max_gangs,
+            max_backfill_pairs=config.forecast_max_backfill_pairs,
+            small_pod_chips=config.forecast_small_pod_chips,
+        )
+        manager.add_runnable(forecaster.start, forecaster.stop)
+
     controller = PartitionerController(
         store=store,
         cluster_state=cluster_state,
@@ -132,6 +157,8 @@ def build_partitioner(
         # The tpu controller alone drives ledger observes: one observer per
         # cluster, or chip-seconds would double-integrate per cycle.
         capacity_ledger=capacity_ledger,
+        # Likewise one forecaster, fed by the tpu controller's cycles.
+        forecaster=forecaster,
     )
 
     node_ctrl = StateNodeController(store, cluster_state, initializer=initializer)

@@ -125,10 +125,22 @@ def seed_pod(spec: dict) -> Pod:
         requests["cpu"] = spec["cpu"]
     if "memoryGB" in spec:
         requests["memory"] = spec["memoryGB"]
+    pod_labels = {}
+    if "gang" in spec:
+        # Gang membership drives gang scheduling, the ledger's wait
+        # clocks, and the placement forecaster's per-gang ETAs.
+        from nos_tpu.scheduler.plugins.gang import (
+            GANG_NAME_LABEL,
+            GANG_SIZE_LABEL,
+        )
+
+        pod_labels[GANG_NAME_LABEL] = str(spec["gang"])
+        pod_labels[GANG_SIZE_LABEL] = str(spec.get("gangSize", 1))
     return Pod(
         metadata=ObjectMeta(
             name=spec["name"],
             namespace=spec.get("namespace", "default"),
+            labels=pod_labels,
         ),
         spec=PodSpec(
             containers=[Container(requests=dict(requests), limits=dict(requests))],
@@ -244,14 +256,20 @@ def main(argv=None) -> int:
         autoscaler_fn=cluster.autoscaler.debug_payload
         if cluster.autoscaler is not None
         else None,
+        forecast_fn=cluster.partitioner.forecaster.debug_payload
+        if getattr(cluster.partitioner, "forecaster", None) is not None
+        else None,
     )
     bound = health.start()
     logging.info(
         "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain"
-        " /debug/capacity /debug/profile /debug/loops%s%s)",
+        " /debug/capacity /debug/profile /debug/loops%s%s%s)",
         bound,
         " /debug/autoscaler" if cluster.autoscaler is not None else "",
         " /debug/record" if flight_recorder is not None else "",
+        " /debug/forecast"
+        if getattr(cluster.partitioner, "forecaster", None) is not None
+        else "",
     )
 
     # Always-on control-plane sampling: the profiler only sees threads

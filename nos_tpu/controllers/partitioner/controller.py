@@ -62,6 +62,7 @@ class PartitionerController:
         pool_max_workers: int = 0,
         warm_state_path: str = "",
         warm_state_save_interval_seconds: float = 30.0,
+        forecaster=None,
     ) -> None:
         self.store = store
         # Optional kube/events.py EventRecorder: PartitioningApplied when a
@@ -77,6 +78,10 @@ class PartitionerController:
         # scheduler): observed once per plan cycle with the planner's
         # unserved reasons, so idle time between cycles gets attributed.
         self.capacity_ledger = capacity_ledger
+        # Optional forecast.PlacementForecaster: notified once per plan
+        # cycle with the pending batch (off-path — the forecaster runs on
+        # its own thread with its own snapshot maintainer and planner).
+        self.forecaster = forecaster
         # namespaced_name -> last CarveFailed reason recorded; pruned to
         # the live pending set every cycle so deleted pods don't leak.
         self._last_carve_reason: Dict[str, str] = {}
@@ -468,6 +473,18 @@ class PartitionerController:
                         time.time(),
                         unserved=dict(unserved),
                         trace_id=journey.trace_id if journey is not None else "",
+                    )
+                if self.forecaster is not None:
+                    # Stash-and-wake only: the forecast itself runs on the
+                    # forecaster's thread (its forecast.cycle span parents
+                    # on this journey when it is still open).
+                    self.forecaster.notify_cycle(
+                        pending,
+                        now=time.time(),
+                        trace_id=(
+                            journey.trace_id if journey is not None else ""
+                        ),
+                        journey=journey,
                     )
                 if self.auditor is not None and self.auditor.should_audit():
                     if audit_runs is not None:

@@ -1,0 +1,31 @@
+"""Placement forecasting: earliest-feasible-start ETAs per pending gang,
+backfill-safety classification, and a read-only defrag advisor — the
+observability layer ROADMAP item 2's gang-aware backfill builds on."""
+from nos_tpu.forecast.accuracy import CalibrationTracker, nearest_rank
+from nos_tpu.forecast.advisor import DefragAdvisor
+from nos_tpu.forecast.engine import (
+    EXPECTED_COMPLETION_ANNOTATION,
+    STAGE_BLOCKED,
+    STAGE_FEASIBLE_NOW,
+    STAGE_RECARVE,
+    BackfillVerdict,
+    ForecastEngine,
+    ForecastResult,
+    GangForecast,
+)
+from nos_tpu.forecast.forecaster import PlacementForecaster
+
+__all__ = [
+    "BackfillVerdict",
+    "CalibrationTracker",
+    "DefragAdvisor",
+    "EXPECTED_COMPLETION_ANNOTATION",
+    "ForecastEngine",
+    "ForecastResult",
+    "GangForecast",
+    "PlacementForecaster",
+    "STAGE_BLOCKED",
+    "STAGE_FEASIBLE_NOW",
+    "STAGE_RECARVE",
+    "nearest_rank",
+]

@@ -1,0 +1,89 @@
+"""Forecast-accuracy calibration: the gate that makes ETAs trustworthy.
+
+Every published gang forecast is stamped (flight recorder + an
+in-memory outstanding map); when the capacity ledger observes the gang
+actually binding, the forecast joins against the observed bind time and
+the error lands here. The tracker publishes p50/p95 of the absolute ETA
+error and of the error normalized by the gang's actual total wait — the
+acceptance number ("p95 absolute ETA error <= 25% of actual wait") a
+later PR will require before letting forecasts actuate backfill.
+
+Deterministic by construction: nearest-rank percentiles over a bounded
+sample window, no wall clock, plain float arithmetic — so a replay that
+re-feeds the recorded outcomes recomputes the calibration payload
+bit-exactly (the "auditor clean on replay" check in record/replay.py).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Bounded sample window: calibration tracks the recent regime (reconfig
+# rates and workloads drift), and a bound keeps percentile cost O(1)-ish.
+DEFAULT_WINDOW = 512
+
+
+def nearest_rank(sorted_values: List[float], quantile: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation): the
+    ceil(q*n)-th smallest value, 1-indexed."""
+    if not sorted_values:
+        return 0.0
+    n = len(sorted_values)
+    rank = int(quantile * n)
+    if rank * 1.0 < quantile * n:  # ceil without float math surprises
+        rank += 1
+    rank = min(max(rank, 1), n)
+    return sorted_values[rank - 1]
+
+
+class CalibrationTracker:
+    """Rolling forecast-vs-observed calibration over the last N gang
+    binds. ``add`` takes one joined outcome; ``payload`` is the exported
+    calibration block (also the replay comparison payload — keep it a
+    pure function of the add() history)."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW) -> None:
+        self._samples: deque = deque(maxlen=window)
+        self.joined = 0  # outcomes with a usable ETA
+        self.unforecast = 0  # gang bound while its ETA was None
+
+    def add(
+        self,
+        eta_seconds: Optional[float],
+        actual_seconds: float,
+        wait_seconds: float,
+        stage: str = "",
+    ) -> Optional[Dict[str, float]]:
+        """Join one gang-bound observation against its last forecast.
+        ``actual_seconds`` is the observed remaining time from the
+        forecast stamp to the bind; ``wait_seconds`` the gang's total
+        arrival->bound wait (the normalizer). Returns the sample entry,
+        or None when the forecast had no ETA to score."""
+        if eta_seconds is None:
+            self.unforecast += 1
+            return None
+        error = abs(eta_seconds - actual_seconds)
+        ratio = error / wait_seconds if wait_seconds > 0 else 0.0
+        sample = {
+            "error_seconds": error,
+            "ratio": ratio,
+            "stage": stage,
+        }
+        self._samples.append(sample)
+        self.joined += 1
+        return sample
+
+    def payload(self) -> Dict[str, Any]:
+        errors = sorted(s["error_seconds"] for s in self._samples)
+        ratios = sorted(s["ratio"] for s in self._samples)
+        # None (not 0.0) when the window is empty: a zero here would
+        # read as "perfectly calibrated" with no evidence at all.
+        return {
+            "samples": len(self._samples),
+            "joined": self.joined,
+            "unforecast": self.unforecast,
+            "p50_error_seconds": nearest_rank(errors, 0.50) if errors else None,
+            "p95_error_seconds": nearest_rank(errors, 0.95) if errors else None,
+            "p50_ratio": nearest_rank(ratios, 0.50) if ratios else None,
+            "p95_ratio": nearest_rank(ratios, 0.95) if ratios else None,
+        }

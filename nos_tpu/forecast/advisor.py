@@ -1,0 +1,210 @@
+"""Read-only defrag advisor: re-carve recommendations, never actuation.
+
+BENCH_r05's gap (ROADMAP item 2) is near-empty boards carved for a
+profile mix the pending queue no longer wants: 8-chip gangs wait while
+1-2 chip slivers sit free. The advisor proposes the re-carve set that
+moves those boards toward the queue's demanded mix and prices each
+recommendation honestly: the proposal is applied on a forked snapshot,
+every pending gang is re-forecast against the hypothetical geometry,
+and the predicted saving is the ETA improvement weighted by each gang's
+pending chips (chip-seconds of queue wait the re-carve would remove).
+A recommendation only reports ``validated: true`` when that shadow sim
+confirms some gang actually starts earlier and none gets worse.
+
+Recommendations surface on /debug/forecast and in BENCH_forecast.json;
+nothing here writes to the store — actuation is a later PR's decision,
+gated on the accuracy calibration this PR measures.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from nos_tpu.forecast.engine import (
+    _STAGE_RANK,
+    ForecastEngine,
+    GangForecast,
+    _free_chips,
+    _pod_chips,
+)
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.core.tracker import SliceTracker
+from nos_tpu.util.tracing import TRACER
+
+
+class DefragAdvisor:
+    """Proposes re-carves of near-empty boards toward the pending queue's
+    profile mix. ``free_fraction`` is the near-empty threshold (free
+    chips / total chips at or above it qualifies a node)."""
+
+    def __init__(
+        self,
+        engine: ForecastEngine,
+        free_fraction: float = 0.5,
+        max_proposals: int = 4,
+    ) -> None:
+        self.engine = engine
+        self.free_fraction = free_fraction
+        self.max_proposals = max_proposals
+
+    def advise(
+        self,
+        snapshot: ClusterSnapshot,
+        pending,
+        before: List[GangForecast],
+        now: float,
+        clocks: Optional[Dict[str, Dict[str, float]]] = None,
+        cycle_seconds: float = 1.0,
+        reconfig_seconds: float = 0.5,
+    ) -> Dict[str, Any]:
+        """Advisor payload for one forecast cycle. ``before`` is the
+        cycle's baseline gang classification (so the shadow sim compares
+        against exactly what was published, not a recomputation)."""
+        with TRACER.span("forecast.advisor"):
+            return self._advise(
+                snapshot,
+                pending,
+                before,
+                now,
+                clocks or {},
+                cycle_seconds,
+                reconfig_seconds,
+            )
+
+    def _advise(
+        self,
+        snapshot: ClusterSnapshot,
+        pending,
+        before: List[GangForecast],
+        now: float,
+        clocks: Dict[str, Dict[str, float]],
+        cycle_seconds: float,
+        reconfig_seconds: float,
+    ) -> Dict[str, Any]:
+        tracker = SliceTracker(snapshot, list(pending))
+        candidates = self._near_empty_nodes(snapshot)
+        out: Dict[str, Any] = {
+            "proposals": [],
+            "predicted_idle_savings_chip_seconds": 0.0,
+            "validated": False,
+            "near_empty_nodes": [name for name, _ in candidates],
+        }
+        if tracker.empty or not candidates or not before:
+            return out
+        # Warm the pool before forking (base-preserving contract).
+        snapshot.free_slice_resources()
+        snapshot.fork()
+        try:
+            proposals: List[Dict[str, Any]] = []
+            nodes = snapshot.get_nodes()
+            for name, _free in candidates:
+                if len(proposals) >= self.max_proposals:
+                    break
+                node = nodes[name]
+                accelerator = getattr(node.partitionable, "accelerator", "")
+                lacking = tracker.lacking_totals(accelerator)
+                if not lacking:
+                    continue
+                geometry_before = {
+                    board: dict(g)
+                    for board, g in node.partitionable.geometry().items()
+                }
+                if not snapshot.update_geometry_for(name, lacking):
+                    continue
+                geometry_after = {
+                    board: dict(g)
+                    for board, g in nodes[name].partitionable.geometry().items()
+                }
+                proposals.append(
+                    {
+                        "node": name,
+                        "geometry_before": geometry_before,
+                        "geometry_after": geometry_after,
+                        "toward": dict(sorted(lacking.items())),
+                    }
+                )
+            if not proposals:
+                return out
+            after = self.engine.forecast(
+                snapshot,
+                list(pending),
+                now,
+                clocks=clocks,
+                cycle_seconds=cycle_seconds,
+                reconfig_seconds=reconfig_seconds,
+                with_backfill=False,
+            ).gangs
+        finally:
+            snapshot.revert()
+        after_by_key = {g.gang: g for g in after}
+        savings = 0.0
+        regressed = False
+        per_gang: List[Dict[str, Any]] = []
+        for base in before:
+            shadow = after_by_key.get(base.gang)
+            if shadow is None:
+                continue
+            if _STAGE_RANK[shadow.stage] > _STAGE_RANK[base.stage]:
+                regressed = True
+            gang_chips = self._gang_pending_chips(pending, base)
+            saved = 0.0
+            if (
+                base.eta_seconds is not None
+                and shadow.eta_seconds is not None
+            ):
+                saved = max(0.0, base.eta_seconds - shadow.eta_seconds)
+            elif base.eta_seconds is None and shadow.eta_seconds is not None:
+                # From un-forecastable (blocked, no hints) to a concrete
+                # ETA: credit the wait so far as the saved idle time.
+                saved = max(base.wait_seconds or 0.0, cycle_seconds)
+            savings += saved * gang_chips
+            per_gang.append(
+                {
+                    "gang": base.gang,
+                    "stage_before": base.stage,
+                    "stage_after": shadow.stage,
+                    "eta_before": base.eta_seconds,
+                    "eta_after": shadow.eta_seconds,
+                    "saved_chip_seconds": saved * gang_chips,
+                }
+            )
+        out["proposals"] = proposals
+        out["predicted_idle_savings_chip_seconds"] = savings
+        out["validated"] = bool(proposals) and savings > 0.0 and not regressed
+        out["gangs"] = per_gang
+        return out
+
+    def _near_empty_nodes(self, snapshot: ClusterSnapshot):
+        """(name, free chips) of non-frozen nodes whose free fraction is at
+        or above the threshold, most free first.
+
+        Free is measured against BOARD capacity, not carved free slices:
+        free_slices() reports only already-carved slices, so a pristine
+        (uncarved) node — the advisor's prime re-carve candidate — would
+        read as zero free and never be proposed."""
+        out = []
+        nodes = snapshot.get_nodes()
+        for name in sorted(nodes):
+            node = nodes[name]
+            if getattr(node, "frozen", False):
+                continue
+            used = sum(_pod_chips(p) for p in node.pods)
+            boards = getattr(node.partitionable, "boards", None)
+            if boards:
+                total = sum(b.chips for b in boards)
+                free = total - used
+            else:
+                free = _free_chips(node)
+                total = free + used
+            if total <= 0 or free <= 0:
+                continue
+            if free / total >= self.free_fraction:
+                out.append((name, free))
+        out.sort(key=lambda item: (-item[1], item[0]))
+        return out
+
+    @staticmethod
+    def _gang_pending_chips(pending, forecast: GangForecast) -> int:
+        names = set(forecast.pending)
+        return sum(
+            _pod_chips(p) for p in pending if p.namespaced_name in names
+        )

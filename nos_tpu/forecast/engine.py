@@ -1,0 +1,541 @@
+"""Forward-simulation forecast engine: fork-based what-if trials.
+
+The engine answers three questions against a planning snapshot, without
+ever mutating it (every trial runs inside a CoW fork that is reverted
+before returning, the same journal machinery the planner's own carve
+trials use):
+
+- **earliest feasible start** per pending gang: can the whole gang place
+  on current geometry (``feasible-now``), does it place only after a
+  re-carve (``recarve``, with the minimal re-carve node set and a cost
+  derived from the measured reconfig rate), or is it ``blocked`` on
+  chips bound pods currently hold (with the blocking set, each entry
+  linked to the diagnosis ledger via /debug/explain);
+- **backfill safety** per (small pending pod, candidate node) pair: the
+  exact predicate a gang-aware backfill will enforce — taking that
+  placement must not delay the oldest pending gang's ETA;
+- the **defrag advisor**'s inputs (see :mod:`nos_tpu.forecast.advisor`).
+
+Everything here is deterministic for a fixed (snapshot, pending, now):
+all iteration orders are sorted, caps are applied after sorting, and no
+wall clock is ever read — callers supply ``now``. That is what lets two
+bench runs at the same seed produce byte-identical forecasts and lets
+the accuracy auditor replay calibration bit-exactly.
+
+It reuses the caller-owned planner (its OWN instance, never the live
+control loop's) so the version-keyed verdict/futility/node-info memos
+stay warm across forecast cycles exactly as they do across plan cycles.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from nos_tpu.kube.objects import Pod
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.core.tracker import SliceTracker
+from nos_tpu.tpu.topology import topology_chips
+from nos_tpu.util import resources as res
+from nos_tpu.util.tracing import TRACER
+
+# Forecast stages, ordered best to worst. The order IS the backfill
+# predicate: a small placement that moves the oldest gang to a LATER
+# stage (or grows its recarve set) is unsafe.
+STAGE_FEASIBLE_NOW = "feasible-now"
+STAGE_RECARVE = "recarve"
+STAGE_BLOCKED = "blocked"
+_STAGE_RANK = {STAGE_FEASIBLE_NOW: 0, STAGE_RECARVE: 1, STAGE_BLOCKED: 2}
+
+# Optional workload hint: absolute wall timestamp (seconds) a pod is
+# expected to finish by. Blocked-gang ETAs are only computable when the
+# blocking pods carry it; without hints the ETA is honestly None.
+EXPECTED_COMPLETION_ANNOTATION = "nos.nebuly.com/expected-completion-ts"
+
+
+def _gang_of(pod: Pod):
+    # Lazy import, same reason as the planner's: scheduler.plugins.gang
+    # pulls the KubeStore stack.
+    from nos_tpu.scheduler.plugins.gang import gang_of
+
+    return gang_of(pod)
+
+
+def _pod_chips(pod: Pod) -> int:
+    return res.tpu_chips_in(res.compute_pod_request(pod))
+
+
+def _free_chips(node) -> int:
+    return sum(
+        topology_chips(profile) * qty
+        for profile, qty in node.partitionable.free_slices().items()
+    )
+
+
+@dataclass
+class GangForecast:
+    """One pending gang's earliest-feasible-start classification."""
+
+    gang: str
+    size: int
+    pending: List[str]  # namespaced names of the still-pending members
+    stage: str
+    eta_seconds: Optional[float]
+    # recarve: the minimal re-carve node set the trial needed (empty for
+    # feasible-now; for blocked it is whatever the failed trial touched).
+    recarve: List[str] = field(default_factory=list)
+    # blocked: bound pods whose chips the gang is waiting on.
+    blocking: List[Dict[str, Any]] = field(default_factory=list)
+    wait_seconds: Optional[float] = None  # age of the gang's wait clock
+
+    def payload(self) -> Dict[str, Any]:
+        return {
+            "gang": self.gang,
+            "size": self.size,
+            "pending": list(self.pending),
+            "stage": self.stage,
+            "eta_seconds": self.eta_seconds,
+            "recarve": list(self.recarve),
+            "blocking": [dict(b) for b in self.blocking],
+            "wait_seconds": self.wait_seconds,
+        }
+
+
+@dataclass
+class BackfillVerdict:
+    pod: str
+    node: str
+    safe: bool
+    reason: str
+
+    def payload(self) -> Dict[str, Any]:
+        return {
+            "pod": self.pod,
+            "node": self.node,
+            "safe": self.safe,
+            "reason": self.reason,
+        }
+
+
+@dataclass
+class ForecastResult:
+    now: float
+    gangs: List[GangForecast]
+    backfill: List[BackfillVerdict]
+    heatmap: Dict[str, Dict[str, int]]
+    advisor: Optional[Dict[str, Any]] = None
+
+    @property
+    def unsafe_count(self) -> int:
+        return sum(1 for v in self.backfill if not v.safe)
+
+    def payload(self) -> Dict[str, Any]:
+        return {
+            "now": self.now,
+            "gangs": [g.payload() for g in self.gangs],
+            "backfill": {
+                "safe": sum(1 for v in self.backfill if v.safe),
+                "unsafe": self.unsafe_count,
+                "pairs": [v.payload() for v in self.backfill],
+            },
+            "heatmap": {k: dict(v) for k, v in sorted(self.heatmap.items())},
+            "advisor": self.advisor,
+        }
+
+
+class ForecastEngine:
+    """Pure forecast computation over a snapshot + pending set.
+
+    ``planner`` must be an engine-private Planner (sharing the live
+    controller's would clobber its per-plan caches mid-cycle). The
+    engine manages that planner's cache lifecycle the way ``plan()``
+    does: prune on a retained base, reset on a fresh one.
+    """
+
+    def __init__(
+        self,
+        planner,
+        max_gangs: int = 32,
+        max_backfill_pairs: int = 64,
+        small_pod_chips: int = 2,
+        max_blocking: int = 8,
+    ) -> None:
+        self.planner = planner
+        self.max_gangs = max_gangs
+        self.max_backfill_pairs = max_backfill_pairs
+        self.small_pod_chips = small_pod_chips
+        self.max_blocking = max_blocking
+
+    # ------------------------------------------------------------ entry
+
+    def forecast(
+        self,
+        snapshot: ClusterSnapshot,
+        pending: List[Pod],
+        now: float,
+        clocks: Optional[Dict[str, Dict[str, float]]] = None,
+        cycle_seconds: float = 1.0,
+        reconfig_seconds: float = 0.5,
+        with_backfill: bool = True,
+    ) -> ForecastResult:
+        """Classify every pending gang and (optionally) every small-pod
+        backfill pair. The snapshot is returned to the caller bit-exactly
+        as received: trials run in a fork reverted before returning."""
+        planner = self.planner
+        if snapshot is getattr(planner, "_cache_snapshot", None):
+            planner._prune_plan_caches(snapshot, pending)
+        else:
+            planner._reset_plan_caches(snapshot)
+        clocks = clocks or {}
+        # Warm the incremental free pool BEFORE forking — fork checkpoints
+        # the pool as-is and a None checkpoint would make revert throw the
+        # base's pool away (the base-preserving plan() contract).
+        snapshot.free_slice_resources()
+        gangs = self._gang_groups(pending)
+        results: List[GangForecast] = []
+        with TRACER.span("forecast.gangs", gangs=len(gangs)):
+            for key, (size, members) in gangs[: self.max_gangs]:
+                results.append(
+                    self._classify_gang(
+                        snapshot,
+                        key,
+                        size,
+                        members,
+                        now,
+                        clocks,
+                        cycle_seconds,
+                        reconfig_seconds,
+                    )
+                )
+        backfill: List[BackfillVerdict] = []
+        heatmap: Dict[str, Dict[str, int]] = {}
+        if with_backfill and results:
+            with TRACER.span("forecast.backfill"):
+                backfill, heatmap = self._backfill_safety(
+                    snapshot,
+                    pending,
+                    gangs,
+                    results,
+                    now,
+                    clocks,
+                    cycle_seconds,
+                    reconfig_seconds,
+                )
+        return ForecastResult(
+            now=now, gangs=results, backfill=backfill, heatmap=heatmap
+        )
+
+    # ------------------------------------------------------ gang grouping
+
+    def _gang_groups(
+        self, pending: List[Pod]
+    ) -> List[Tuple[str, Tuple[int, List[Pod]]]]:
+        """Pending gangs as (key, (declared size, pending members)),
+        oldest arrival first via the wait clocks the caller resolves —
+        here the deterministic fallback order is (key,) so the cap and
+        the "oldest gang" pick never depend on dict order."""
+        groups: Dict[str, Tuple[int, List[Pod]]] = {}
+        for pod in pending:
+            gang = _gang_of(pod)
+            if not gang:
+                continue
+            key, size = gang
+            entry = groups.setdefault(key, (size, []))
+            entry[1].append(pod)
+        out = []
+        for key in sorted(groups):
+            size, members = groups[key]
+            members.sort(key=lambda p: (-_pod_chips(p), p.namespaced_name))
+            out.append((key, (size, members)))
+        return out
+
+    # ------------------------------------------------- stage classification
+
+    def _classify_gang(
+        self,
+        snapshot: ClusterSnapshot,
+        key: str,
+        size: int,
+        members: List[Pod],
+        now: float,
+        clocks: Dict[str, Dict[str, float]],
+        cycle_seconds: float,
+        reconfig_seconds: float,
+    ) -> GangForecast:
+        clock = clocks.get(key)
+        wait = max(0.0, now - clock["arrival"]) if clock else None
+        feasible, _ = self._claim_trial(snapshot, members)
+        if feasible:
+            return GangForecast(
+                gang=key,
+                size=size,
+                pending=[p.namespaced_name for p in members],
+                stage=STAGE_FEASIBLE_NOW,
+                # Earliest start = the next plan/bind cycle.
+                eta_seconds=cycle_seconds,
+                wait_seconds=wait,
+            )
+        placed_all, recarve = self._carve_trial(snapshot, members)
+        if placed_all:
+            # Agents actuate a plan's node re-carves concurrently, so the
+            # wall cost is one measured reconfig latency (not count *
+            # rate) on top of the cycle that applies the plan.
+            eta = cycle_seconds + (reconfig_seconds if recarve else 0.0)
+            return GangForecast(
+                gang=key,
+                size=size,
+                pending=[p.namespaced_name for p in members],
+                stage=STAGE_RECARVE,
+                eta_seconds=eta,
+                recarve=recarve,
+                wait_seconds=wait,
+            )
+        blocking, eta = self._blocking_set(
+            snapshot, members, now, cycle_seconds
+        )
+        return GangForecast(
+            gang=key,
+            size=size,
+            pending=[p.namespaced_name for p in members],
+            stage=STAGE_BLOCKED,
+            eta_seconds=eta,
+            recarve=recarve,
+            blocking=blocking,
+            wait_seconds=wait,
+        )
+
+    def _claim_trial(
+        self, snapshot: ClusterSnapshot, members: List[Pod]
+    ) -> Tuple[bool, List[str]]:
+        """Can every pending member place on CURRENT geometry (no carve)?
+        Returns (all placed, nodes used)."""
+        planner = self.planner
+        snapshot.fork()
+        try:
+            used: List[str] = []
+            for pod in members:
+                claims = planner._claims_free_slices(pod)
+                placed_on = None
+                for node_name in planner._candidate_nodes(snapshot):
+                    if claims and not snapshot.node_has_free_slices(node_name):
+                        continue
+                    if planner._try_add_pod(snapshot, node_name, pod):
+                        placed_on = node_name
+                        break
+                if placed_on is None:
+                    return False, used
+                used.append(placed_on)
+            return True, used
+        finally:
+            snapshot.revert()
+
+    def _carve_trial(
+        self, snapshot: ClusterSnapshot, members: List[Pod]
+    ) -> Tuple[bool, List[str]]:
+        """Does the gang place after re-carving? Returns (all placed,
+        minimal re-carve node set = nodes whose geometry the successful
+        trial actually changed)."""
+        planner = self.planner
+        snapshot.fork()
+        try:
+            tracker = SliceTracker(snapshot, members)
+            placed = planner._plan_pass(snapshot, tracker, members, quiet=True)
+            placed_names = {p.namespaced_name for p in placed}
+            all_placed = all(
+                p.namespaced_name in placed_names for p in members
+            )
+            # The trial's inner commits folded into our fork's journal:
+            # every touched node has its pre-fork clone there, so the
+            # re-carve set is exactly the touched nodes whose geometry
+            # (not just pod placements) differs from the backup.
+            journal = snapshot._journals[-1]
+            nodes = snapshot.get_nodes()
+            recarve = [
+                name
+                for name in sorted(journal)
+                if name in nodes
+                and nodes[name].partitionable.geometry()
+                != journal[name].partitionable.geometry()
+            ]
+            return all_placed, recarve
+        finally:
+            snapshot.revert()
+
+    def _blocking_set(
+        self,
+        snapshot: ClusterSnapshot,
+        members: List[Pod],
+        now: float,
+        cycle_seconds: float,
+    ) -> Tuple[List[Dict[str, Any]], Optional[float]]:
+        """Bound pods whose chips the gang is waiting on, earliest
+        expected completion first: the gang binds when the earliest
+        sufficient set frees, so picking long-running blockers would
+        systematically overprice the ETA (hintless pods sort last — they
+        cannot be priced either way). The ETA is only computable when
+        every chosen blocker carries the expected-completion hint."""
+        needed = sum(_pod_chips(p) for p in members)
+        nodes = snapshot.get_nodes()
+        candidates: List[Any] = []
+        for name in sorted(nodes):
+            for pod in nodes[name].pods:
+                chips = _pod_chips(pod)
+                if chips <= 0:
+                    continue
+                hint = pod.metadata.annotations.get(
+                    EXPECTED_COMPLETION_ANNOTATION
+                )
+                completion: Optional[float] = None
+                if hint is not None:
+                    try:
+                        completion = float(hint)
+                    except ValueError:
+                        completion = None
+                candidates.append((completion, name, pod, chips))
+        candidates.sort(
+            key=lambda c: (
+                c[0] is None,
+                c[0] if c[0] is not None else 0.0,
+                c[2].namespaced_name,
+            )
+        )
+        blocking: List[Dict[str, Any]] = []
+        covered = 0
+        latest_completion: Optional[float] = 0.0
+        for completion, name, pod, chips in candidates:
+            if covered >= needed or len(blocking) >= self.max_blocking:
+                break
+            entry = {
+                "pod": pod.namespaced_name,
+                "node": name,
+                "chips": chips,
+                "explain": f"/debug/explain?pod={pod.namespaced_name}",
+            }
+            if completion is not None:
+                entry["expected_completion_ts"] = completion
+                if latest_completion is not None:
+                    latest_completion = max(latest_completion, completion)
+            else:
+                latest_completion = None
+            blocking.append(entry)
+            covered += chips
+        eta: Optional[float] = None
+        if blocking and latest_completion is not None and covered >= needed:
+            # Chips free when the slowest blocker finishes; the next plan
+            # cycle after that binds the gang.
+            eta = max(0.0, latest_completion - now) + cycle_seconds
+        return blocking, eta
+
+    # -------------------------------------------------- backfill predicate
+
+    def _backfill_safety(
+        self,
+        snapshot: ClusterSnapshot,
+        pending: List[Pod],
+        gangs,
+        gang_results: List[GangForecast],
+        now: float,
+        clocks: Dict[str, Dict[str, float]],
+        cycle_seconds: float,
+        reconfig_seconds: float,
+    ) -> Tuple[List[BackfillVerdict], Dict[str, Dict[str, int]]]:
+        """The exact predicate gang-aware backfill will enforce: place the
+        small pod on the candidate node in a fork, re-classify the OLDEST
+        pending gang, and call the pair unsafe when its stage worsens or
+        its re-carve set grows."""
+        oldest = self._oldest_gang(gangs, gang_results, clocks)
+        if oldest is None:
+            return [], {}
+        oldest_key, oldest_size, oldest_members, baseline = oldest
+        planner = self.planner
+        small = sorted(
+            (
+                p
+                for p in pending
+                if not _gang_of(p)
+                and 0 < _pod_chips(p) <= self.small_pod_chips
+            ),
+            key=lambda p: p.namespaced_name,
+        )
+        verdicts: List[BackfillVerdict] = []
+        heatmap: Dict[str, Dict[str, int]] = {}
+        for pod in small:
+            if len(verdicts) >= self.max_backfill_pairs:
+                break
+            claims = planner._claims_free_slices(pod)
+            for node_name in planner._candidate_nodes(snapshot):
+                if len(verdicts) >= self.max_backfill_pairs:
+                    break
+                if claims and not snapshot.node_has_free_slices(node_name):
+                    continue
+                snapshot.fork()
+                try:
+                    if not planner._try_add_pod(snapshot, node_name, pod):
+                        continue  # not a candidate slice for this pod
+                    after = self._classify_gang(
+                        snapshot,
+                        oldest_key,
+                        oldest_size,
+                        oldest_members,
+                        now,
+                        clocks,
+                        cycle_seconds,
+                        reconfig_seconds,
+                    )
+                finally:
+                    snapshot.revert()
+                safe, reason = self._compare(baseline, after)
+                verdicts.append(
+                    BackfillVerdict(
+                        pod=pod.namespaced_name,
+                        node=node_name,
+                        safe=safe,
+                        reason=reason,
+                    )
+                )
+                cell = heatmap.setdefault(node_name, {"safe": 0, "unsafe": 0})
+                cell["safe" if safe else "unsafe"] += 1
+        return verdicts, heatmap
+
+    @staticmethod
+    def _oldest_gang(gangs, gang_results, clocks):
+        """(key, size, members, baseline forecast) for the gang backfill
+        must protect: longest wait first, gang key as the deterministic
+        tie-break (also the no-clocks fallback order)."""
+        if not gang_results:
+            return None
+        by_key = {key: entry for key, entry in gangs}
+        best = min(
+            gang_results,
+            key=lambda g: (-(g.wait_seconds or 0.0), g.gang),
+        )
+        size, members = by_key[best.gang]
+        return best.gang, size, members, best
+
+    @staticmethod
+    def _compare(
+        before: GangForecast, after: GangForecast
+    ) -> Tuple[bool, str]:
+        if _STAGE_RANK[after.stage] > _STAGE_RANK[before.stage]:
+            return False, (
+                f"oldest gang {before.gang} degrades "
+                f"{before.stage} -> {after.stage}"
+            )
+        if (
+            after.stage == STAGE_RECARVE
+            and before.stage == STAGE_RECARVE
+            and len(after.recarve) > len(before.recarve)
+        ):
+            return False, (
+                f"oldest gang {before.gang} re-carve set grows "
+                f"{len(before.recarve)} -> {len(after.recarve)}"
+            )
+        if (
+            before.eta_seconds is not None
+            and after.eta_seconds is not None
+            and after.eta_seconds > before.eta_seconds
+        ):
+            return False, (
+                f"oldest gang {before.gang} ETA grows "
+                f"{before.eta_seconds:.3f}s -> {after.eta_seconds:.3f}s"
+            )
+        return True, ""

@@ -1,0 +1,389 @@
+"""PlacementForecaster: the wired forecast subsystem.
+
+Runs OFF the plan path: the partitioner's cycle hook
+(:meth:`notify_cycle`) only stashes the cycle's pending batch and wakes
+a dedicated background thread (registered with the sampling profiler,
+so /debug/profile attributes its ``forecast.*`` phases). The thread owns
+its OWN planner and its OWN :class:`IncrementalSnapshotMaintainer` —
+version-keyed memos stay warm across forecast cycles without ever
+touching the live control loop's planner state, and steady-state replan
+latency stays within the <=2% overhead budget the perf guard enforces.
+
+Per run it publishes:
+
+- per-gang earliest-feasible-start ETAs (``nos_tpu_gang_eta_seconds``),
+- backfill-safety verdicts (``nos_tpu_backfill_unsafe_total``),
+- the defrag advisor's recommendations,
+- a ``forecast.cycle`` flight record stamping every forecast,
+
+and joins each published ETA against the actually-observed bind time
+(via the capacity ledger's gang-bound listener) into the calibration
+tracker — ``nos_tpu_forecast_accuracy_ratio`` and the
+``forecast.outcome`` records the replay harness recomputes bit-exactly.
+
+Deterministic paths (:meth:`run_once` with caller-supplied ``now`` and
+``pending``) never read a wall clock; the thread loop is the only place
+``time.time()`` appears.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from nos_tpu.forecast.accuracy import CalibrationTracker
+from nos_tpu.forecast.advisor import DefragAdvisor
+from nos_tpu.forecast.engine import STAGE_FEASIBLE_NOW, ForecastEngine
+from nos_tpu.util import metrics
+from nos_tpu.util.profiling import PROFILER
+from nos_tpu.util.tracing import TRACER
+
+log = logging.getLogger("nos_tpu.forecast")
+
+
+class PlacementForecaster:
+    def __init__(
+        self,
+        store,
+        cluster_state,
+        planner,
+        snapshot_taker,
+        kind: str = "tpu",
+        capacity_ledger=None,
+        flight_recorder=None,
+        min_interval_seconds: float = 0.25,
+        default_cycle_seconds: float = 1.0,
+        default_reconfig_seconds: float = 0.5,
+        max_gangs: int = 32,
+        max_backfill_pairs: int = 64,
+        small_pod_chips: int = 2,
+        advisor_free_fraction: float = 0.5,
+        advisor_max_proposals: int = 4,
+    ) -> None:
+        self.store = store
+        self.cluster_state = cluster_state
+        self.kind = kind
+        self.ledger = capacity_ledger
+        self.flight = flight_recorder
+        self.min_interval_seconds = min_interval_seconds
+        self.default_reconfig_seconds = default_reconfig_seconds
+        self.engine = ForecastEngine(
+            planner,
+            max_gangs=max_gangs,
+            max_backfill_pairs=max_backfill_pairs,
+            small_pod_chips=small_pod_chips,
+        )
+        self.advisor = DefragAdvisor(
+            self.engine,
+            free_fraction=advisor_free_fraction,
+            max_proposals=advisor_max_proposals,
+        )
+        self.snapshot_taker = snapshot_taker
+        self._maintainer = None  # built lazily: its watch starts on first use
+        self.calibration = CalibrationTracker()
+        # One forecast computation at a time: the background thread and an
+        # on-demand /debug/forecast?refresh=1 must not interleave trials
+        # on the shared base snapshot.
+        self._run_lock = threading.Lock()
+        # Guards the cheap shared state below (stamps, clocks, last result).
+        self._state_lock = threading.Lock()
+        self._outstanding: Dict[str, Dict[str, Any]] = {}
+        self._feasible_since: Dict[str, float] = {}
+        self._last_payload: Optional[Dict[str, Any]] = None
+        self._pending_batch: List[Any] = []
+        self._batch_now: Optional[float] = None
+        self._batch_trace_id = ""
+        self._journey = None
+        # Measured cycle cadence (EWMA over notify timestamps) — the
+        # "feasible now binds next cycle" ETA unit.
+        self._cycle_seconds = default_cycle_seconds
+        self._last_notify: Optional[float] = None
+        self.runs = 0
+        self.backfill_unsafe_total = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_run_monotonic = 0.0
+        if capacity_ledger is not None and hasattr(
+            capacity_ledger, "add_gang_bound_listener"
+        ):
+            capacity_ledger.add_gang_bound_listener(self._on_gang_bound)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"forecast-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        PROFILER.register_thread(name=f"forecast-{self.kind}")
+        try:
+            while True:
+                self._wake.wait()
+                self._wake.clear()
+                if self._stop.is_set():
+                    return
+                # Throttle: a notify storm (every plan cycle under a
+                # burst) must not turn into a forecast storm.
+                elapsed = time.monotonic() - self._last_run_monotonic
+                if elapsed < self.min_interval_seconds:
+                    if self._stop.wait(self.min_interval_seconds - elapsed):
+                        return
+                self._last_run_monotonic = time.monotonic()
+                try:
+                    self.run_once()
+                except Exception:  # pragma: no cover - diagnostics only
+                    log.exception("forecast cycle failed")
+        finally:
+            PROFILER.unregister_thread()
+
+    # ------------------------------------------------------------- triggers
+
+    def notify_cycle(
+        self,
+        pending,
+        now: Optional[float] = None,
+        trace_id: str = "",
+        journey=None,
+    ) -> None:
+        """Partitioner cycle hook: stash the batch, wake the thread.
+        Called on the control loop — must stay O(pending)."""
+        now = time.time() if now is None else now
+        with self._state_lock:
+            if self._last_notify is not None:
+                interval = max(0.0, now - self._last_notify)
+                if 0.0 < interval < 60.0:
+                    self._cycle_seconds = (
+                        0.7 * self._cycle_seconds + 0.3 * interval
+                    )
+            self._last_notify = now
+            self._pending_batch = list(pending)
+            self._batch_now = now
+            self._batch_trace_id = trace_id
+            self._journey = journey
+        self._wake.set()
+
+    # ------------------------------------------------------------- forecast
+
+    def run_once(
+        self,
+        now: Optional[float] = None,
+        pending=None,
+        cycle_seconds: Optional[float] = None,
+        reconfig_seconds: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """One full forecast pass; returns the published payload. All
+        inputs are overridable so tests and the bench drive it with a
+        virtual clock and a fixed pending set."""
+        with self._run_lock:
+            with self._state_lock:
+                if pending is None:
+                    pending = list(self._pending_batch)
+                if now is None:
+                    now = (
+                        self._batch_now
+                        if self._batch_now is not None
+                        else time.time()
+                    )
+                trace_id = self._batch_trace_id
+                journey = self._journey
+                if cycle_seconds is None:
+                    cycle_seconds = self._cycle_seconds
+            if reconfig_seconds is None:
+                reconfig_seconds = self._measured_reconfig_seconds()
+            clocks = (
+                self.ledger.gang_clocks() if self.ledger is not None else {}
+            )
+            parent = (
+                journey
+                if journey is not None and not getattr(journey, "ended", True)
+                else None
+            )
+            with TRACER.span(
+                "forecast.cycle",
+                parent=parent,
+                pending=len(pending),
+                trace_id=trace_id,
+            ) as span:
+                snapshot, dirty = self._snapshot()
+                result = self.engine.forecast(
+                    snapshot,
+                    pending,
+                    now,
+                    clocks=clocks,
+                    cycle_seconds=cycle_seconds,
+                    reconfig_seconds=reconfig_seconds,
+                )
+                result.advisor = self.advisor.advise(
+                    snapshot,
+                    pending,
+                    result.gangs,
+                    now,
+                    clocks=clocks,
+                    cycle_seconds=cycle_seconds,
+                    reconfig_seconds=reconfig_seconds,
+                )
+                span.set_attributes(
+                    gangs=len(result.gangs),
+                    backfill_unsafe=result.unsafe_count,
+                    dirty_nodes=len(dirty),
+                )
+            self._publish(result, now, trace_id)
+            payload = result.payload()
+            with self._state_lock:
+                self._last_payload = payload
+            return payload
+
+    def _snapshot(self):
+        if self._maintainer is None:
+            from nos_tpu.controllers.partitioner.incremental import (
+                IncrementalSnapshotMaintainer,
+            )
+
+            self._maintainer = IncrementalSnapshotMaintainer(
+                self.store, self.snapshot_taker, kind=f"{self.kind}-forecast"
+            )
+        return self._maintainer.snapshot(self.cluster_state)
+
+    def _measured_reconfig_seconds(self) -> float:
+        if self.ledger is not None and hasattr(
+            self.ledger, "mean_reconfig_seconds"
+        ):
+            return self.ledger.mean_reconfig_seconds(
+                default=self.default_reconfig_seconds
+            )
+        return self.default_reconfig_seconds
+
+    def _publish(self, result, now: float, trace_id: str) -> None:
+        self.runs += 1
+        metrics.FORECAST_RUNS.inc()
+        unsafe = result.unsafe_count
+        if unsafe:
+            self.backfill_unsafe_total += unsafe
+            metrics.BACKFILL_UNSAFE_TOTAL.inc(unsafe)
+        stamps: Dict[str, Dict[str, Any]] = {}
+        for gang in result.gangs:
+            if gang.eta_seconds is not None:
+                metrics.GANG_ETA_SECONDS.labels(stage=gang.stage).observe(
+                    gang.eta_seconds
+                )
+            stamps[gang.gang] = {
+                "now": now,
+                "eta_seconds": gang.eta_seconds,
+                "stage": gang.stage,
+            }
+        with self._state_lock:
+            # Replace wholesale: forecasts only cover currently-pending
+            # gangs, so anything older is bound (listener popped it) or
+            # gone (deleted/timed out — nothing to score).
+            self._outstanding = stamps
+            for gang in result.gangs:
+                if gang.stage == STAGE_FEASIBLE_NOW:
+                    self._feasible_since.setdefault(gang.gang, now)
+                else:
+                    self._feasible_since.pop(gang.gang, None)
+            live = {g.gang for g in result.gangs}
+            for key in [k for k in self._feasible_since if k not in live]:
+                del self._feasible_since[key]
+        if self.flight is not None:
+            self.flight.record_forecast(
+                revision=self.store.revision if self.store is not None else 0,
+                now=now,
+                trace_id=trace_id,
+                gangs=[g.payload() for g in result.gangs],
+                backfill_unsafe=unsafe,
+                advisor_validated=bool(
+                    (result.advisor or {}).get("validated")
+                ),
+            )
+
+    # ---------------------------------------------------- accuracy joining
+
+    def _on_gang_bound(
+        self, gang: str, now: float, wait_seconds: float
+    ) -> None:
+        """Capacity-ledger listener: join the bind against the last
+        published forecast for this gang."""
+        with self._state_lock:
+            stamp = self._outstanding.pop(gang, None)
+            self._feasible_since.pop(gang, None)
+            if stamp is None:
+                return
+            actual = max(0.0, now - stamp["now"])
+            sample = self.calibration.add(
+                stamp["eta_seconds"],
+                actual,
+                wait_seconds,
+                stage=stamp["stage"],
+            )
+            payload = self.calibration.payload()
+        if self.flight is not None:
+            self.flight.record_forecast_outcome(
+                gang=gang,
+                now=now,
+                stage=stamp["stage"],
+                eta_seconds=stamp["eta_seconds"],
+                actual_seconds=actual,
+                wait_seconds=wait_seconds,
+                calibration=payload,
+            )
+        if sample is not None:
+            metrics.FORECAST_ACCURACY_RATIO.labels(quantile="p50").set(
+                payload["p50_ratio"]
+            )
+            metrics.FORECAST_ACCURACY_RATIO.labels(quantile="p95").set(
+                payload["p95_ratio"]
+            )
+
+    # --------------------------------------------------------------- checks
+
+    def stale_feasible_now(
+        self, now: float, limit_seconds: Optional[float] = None
+    ) -> List[str]:
+        """Gangs continuously forecast feasible-now for longer than
+        ``limit_seconds`` without binding — the forecast-calibrated chaos
+        oracle's violation set. Default limit: 3 measured cycles."""
+        with self._state_lock:
+            if limit_seconds is None:
+                limit_seconds = 3.0 * self._cycle_seconds
+            return sorted(
+                gang
+                for gang, since in self._feasible_since.items()
+                if now - since > limit_seconds
+            )
+
+    # ---------------------------------------------------------------- debug
+
+    def debug_payload(self, refresh: bool = False) -> Dict[str, Any]:
+        if refresh:
+            try:
+                self.run_once(now=time.time())
+            except Exception:  # pragma: no cover - diagnostics only
+                log.exception("on-demand forecast failed")
+        with self._state_lock:
+            last = self._last_payload
+            payload: Dict[str, Any] = {
+                "kind": self.kind,
+                "runs": self.runs,
+                "cycle_seconds": self._cycle_seconds,
+                "reconfig_seconds": self._measured_reconfig_seconds(),
+                "outstanding": len(self._outstanding),
+                "backfill_unsafe_total": self.backfill_unsafe_total,
+                "calibration": self.calibration.payload(),
+                "forecast": last,
+            }
+        return payload

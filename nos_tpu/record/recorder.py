@@ -289,3 +289,52 @@ class FlightRecorder:
             totals=totals,
             trace_id=trace_id,
         )
+
+    def record_forecast(
+        self,
+        *,
+        revision: int,
+        now: float,
+        gangs: List[dict],
+        backfill_unsafe: int,
+        advisor_validated: bool,
+        trace_id: str = "",
+    ) -> None:
+        """One forecast cycle: every published gang ETA (the stamps the
+        accuracy auditor later joins against observed binds), the
+        backfill-unsafe pair count, and whether the advisor's proposal
+        validated in its shadow sim."""
+        self._append(
+            "forecast.cycle",
+            revision=revision,
+            now=now,
+            gangs=gangs,
+            backfill_unsafe=backfill_unsafe,
+            advisor_validated=advisor_validated,
+            trace_id=trace_id,
+        )
+
+    def record_forecast_outcome(
+        self,
+        *,
+        gang: str,
+        now: float,
+        stage: str,
+        eta_seconds: Optional[float],
+        actual_seconds: float,
+        wait_seconds: float,
+        calibration: dict,
+    ) -> None:
+        """One forecast-vs-observed join at gang-bound, carrying the
+        running calibration payload so replay can re-feed the outcomes
+        through a shadow CalibrationTracker and compare bit-exactly."""
+        self._append(
+            "forecast.outcome",
+            gang=gang,
+            now=now,
+            stage=stage,
+            eta_seconds=eta_seconds,
+            actual_seconds=actual_seconds,
+            wait_seconds=wait_seconds,
+            calibration=calibration,
+        )

@@ -39,6 +39,8 @@ class ReplayReport:
     cycles: int = 0
     plans: int = 0
     capacity_observes: int = 0
+    forecast_cycles: int = 0
+    forecast_outcomes: int = 0
     drifts: List[dict] = field(default_factory=list)
     violations: List[dict] = field(default_factory=list)
     skips: List[dict] = field(default_factory=list)
@@ -49,7 +51,8 @@ class ReplayReport:
     def render(self) -> str:
         lines = [
             f"replayed {self.cycles} scheduler cycle(s), {self.plans} plan(s), "
-            f"{self.capacity_observes} capacity observe(s): "
+            f"{self.capacity_observes} capacity observe(s), "
+            f"{self.forecast_outcomes} forecast outcome(s): "
             f"{len(self.drifts)} drift(s), {len(self.violations)} audit "
             f"violation(s), {len(self.skips)} skip(s)"
         ]
@@ -101,6 +104,17 @@ class ReplaySession:
                 in ("scheduler.cycle", "planner.plan", "capacity.observe")
             ),
             key=lambda r: (r.get("revision", 0), r["seq"]),
+        )
+        # Forecast records replay off the store cursor: outcomes are a
+        # pure function of the recorded joins (fed through a shadow
+        # CalibrationTracker in seq order), cycles are informational.
+        self.forecast_records = sorted(
+            (
+                r
+                for r in records
+                if r.get("kind") in ("forecast.cycle", "forecast.outcome")
+            ),
+            key=lambda r: r["seq"],
         )
         framework, capacity, gang = new_framework(
             self.store,
@@ -167,7 +181,44 @@ class ReplaySession:
                 self._replay_capacity(record, report)
             else:
                 self._replay_plan(record, report)
+        self._replay_forecasts(report)
         return report
+
+    def _replay_forecasts(self, report: ReplayReport) -> None:
+        """Forecast-accuracy audit: re-feed the recorded outcome joins
+        through a fresh CalibrationTracker and demand each record's
+        running calibration payload bit-for-bit. The tracker is a pure
+        function of its add() history (nearest-rank percentiles, plain
+        float arithmetic), so any mismatch means the live join sequence
+        diverged from what was recorded."""
+        from nos_tpu.forecast.accuracy import CalibrationTracker
+
+        shadow = CalibrationTracker()
+        for record in self.forecast_records:
+            if record["kind"] == "forecast.cycle":
+                report.forecast_cycles += 1
+                continue
+            report.forecast_outcomes += 1
+            shadow.add(
+                record.get("eta_seconds"),
+                record.get("actual_seconds", 0.0),
+                record.get("wait_seconds", 0.0),
+                stage=record.get("stage", ""),
+            )
+            got = shadow.payload()
+            want = record.get("calibration", {})
+            if got != want:
+                report.drifts.append(
+                    {
+                        "seq": record["seq"],
+                        "kind": "forecast.outcome",
+                        "gang": record.get("gang", ""),
+                        "detail": (
+                            f"recorded calibration {want} but replay "
+                            f"recomputed {got}"
+                        ),
+                    }
+                )
 
     def _replay_cycle(self, record: dict, report: ReplayReport) -> None:
         namespace, _, name = record["pod"].partition("/")

@@ -4,13 +4,18 @@ Every reference binary registers healthz/readyz probes and a metrics
 endpoint on its controller manager (cmd/operator/operator.go:112-118,
 ControllerManagerConfigurationSpec addresses). This serves the same three
 endpoints for an in-process component set.
+
+Debug surfaces live in a single registry (:meth:`HealthServer._debug_endpoints`):
+registering a handler there is the ONLY step — the bearer gate, the
+``/debug`` index, and the index-completeness lint test all derive from
+the registry, so an endpoint can never ship ungated or unlisted.
 """
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from nos_tpu.util.metrics import REGISTRY
@@ -32,6 +37,7 @@ class HealthServer:
         loops_fn: Optional[Callable[[], dict]] = None,
         slo_fn: Optional[Callable[[], dict]] = None,
         autoscaler_fn: Optional[Callable[[], dict]] = None,
+        forecast_fn: Optional[Callable[[bool], dict]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -62,6 +68,11 @@ class HealthServer:
         # ModelServing desired/ready replicas, last verdict, cold starts,
         # plus the live signal registry); None disables it.
         self.autoscaler_fn = autoscaler_fn
+        # /debug/forecast -> the PlacementForecaster rollup (per-gang
+        # ETAs, backfill heatmap, advisor plan, calibration), called with
+        # refresh=True when ?refresh=1 forces an on-demand run; None
+        # disables the endpoint (no forecaster wired).
+        self.forecast_fn = forecast_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -81,63 +92,210 @@ class HealthServer:
         self._servers: list = []
         self._threads: list = []
 
+    # ----------------------------------------------------- debug registry
+
+    def _debug_endpoints(self) -> Dict[str, Dict[str, Any]]:
+        """The debug surface registry: path -> {"describe", "handle"}.
+        Every entry is bearer-gated by the dispatcher (same credential as
+        /metrics — all of them carry pod/node/namespace identifiers) and
+        listed in the auto-built /debug index. Conditional entries appear
+        only when their callback is wired, so the index never lists a 404.
+        """
+        endpoints: Dict[str, Dict[str, Any]] = {}
+
+        def register(
+            path: str, describe: str, handle: Callable[[Any, Any], None]
+        ) -> None:
+            endpoints[path] = {"describe": describe, "handle": handle}
+
+        register(
+            "/debug/traces",
+            "per-trace summaries; ?id=<trace_id> for the full Chrome "
+            "trace-event timeline",
+            self._serve_traces,
+        )
+        register(
+            "/debug/vars",
+            "the MetricsRegistry snapshot as flat JSON",
+            self._serve_vars,
+        )
+        if self.explain_fn is not None:
+            register(
+                "/debug/explain",
+                "?pod=<namespace>/<name> — the scheduler's latest per-node "
+                "per-plugin rejection Diagnosis for the pod",
+                self._serve_explain,
+            )
+        if self.record_fn is not None:
+            register(
+                "/debug/record",
+                "the flight recorder's decision ring; ?format=jsonl for "
+                "`python -m nos_tpu replay` input",
+                self._serve_record,
+            )
+        if self.capacity_fn is not None:
+            register(
+                "/debug/capacity",
+                "the capacity ledger: chip-seconds accounting, idle "
+                "attribution, fragmentation, gang waits, quota posture",
+                self._serve_capacity,
+            )
+        if self.profiler is not None:
+            register(
+                "/debug/profile",
+                "the control-plane sampling profiler: JSON top-N self-time "
+                "and phase attribution; ?format=collapsed for flamegraph "
+                "input; ?action=start|stop for runtime control",
+                self._serve_profile,
+            )
+        if self.loops_fn is not None:
+            register(
+                "/debug/loops",
+                "loop-health rollup: per-loop busy fractions, watch queue "
+                "depths, drain lag and phase-duration metric families",
+                self._serve_loops,
+            )
+        if self.slo_fn is not None:
+            register(
+                "/debug/slo",
+                "serving SLO rollup: per-SLO fast/slow-window burn rates, "
+                "compliance, error-budget remaining, recent violations "
+                "linked into /debug/traces",
+                self._serve_slo,
+            )
+        if self.autoscaler_fn is not None:
+            register(
+                "/debug/autoscaler",
+                "model autoscaler rollup: per-ModelServing desired/ready "
+                "replicas, last verdict, cold starts, and the burn/queue "
+                "signal registry",
+                self._serve_autoscaler,
+            )
+        if self.forecast_fn is not None:
+            register(
+                "/debug/forecast",
+                "placement forecast: per-gang earliest-feasible-start ETAs "
+                "with blocking sets linked into /debug/explain, the "
+                "backfill-safety heatmap, the defrag advisor's plan, and "
+                "ETA calibration; ?refresh=1 forces an on-demand run",
+                self._serve_forecast,
+            )
+        return endpoints
+
+    # Endpoint handlers: called with the live request handler (for
+    # _respond and headers) and the split URL, after the bearer gate.
+
+    def _serve_traces(self, req, url) -> None:
+        wanted = parse_qs(url.query).get("id", [None])[0]
+        if wanted:
+            trace = TRACER.store.get(wanted)
+            if trace is None:
+                req._respond(404, "unknown trace id")
+                return
+            body = json.dumps(trace.to_chrome(), indent=2)
+        else:
+            body = json.dumps(TRACER.store.summaries(), indent=2)
+        req._respond(200, body, "application/json")
+
+    def _serve_vars(self, req, url) -> None:
+        body = json.dumps(REGISTRY.snapshot(), indent=2, sort_keys=True)
+        req._respond(200, body, "application/json")
+
+    def _serve_explain(self, req, url) -> None:
+        pod_key = parse_qs(url.query).get("pod", [None])[0]
+        if not pod_key:
+            req._respond(400, "missing ?pod=namespace/name")
+            return
+        diagnosis = self.explain_fn(pod_key)
+        if diagnosis is None:
+            req._respond(404, "no diagnosis recorded for pod")
+            return
+        req._respond(200, json.dumps(diagnosis, indent=2), "application/json")
+
+    def _serve_record(self, req, url) -> None:
+        records = self.record_fn()
+        fmt = parse_qs(url.query).get("format", ["json"])[0]
+        if fmt == "jsonl":
+            # Directly consumable by `python -m nos_tpu replay`.
+            body = "".join(json.dumps(r) + "\n" for r in records)
+            req._respond(200, body, "application/x-ndjson")
+        else:
+            req._respond(200, json.dumps(records, indent=2), "application/json")
+
+    def _serve_capacity(self, req, url) -> None:
+        req._respond(
+            200, json.dumps(self.capacity_fn(), indent=2), "application/json"
+        )
+
+    def _serve_profile(self, req, url) -> None:
+        query = parse_qs(url.query)
+        action = query.get("action", [None])[0]
+        if action == "start":
+            started = self.profiler.start()
+            req._respond(
+                200,
+                json.dumps({"enabled": True, "started": started}),
+                "application/json",
+            )
+            return
+        if action == "stop":
+            stopped = self.profiler.stop()
+            req._respond(
+                200,
+                json.dumps({"enabled": False, "stopped": stopped}),
+                "application/json",
+            )
+            return
+        if action is not None:
+            req._respond(400, "action must be start or stop")
+            return
+        fmt = query.get("format", ["json"])[0]
+        if fmt == "collapsed":
+            # flamegraph.pl / speedscope input, one aggregated stack per
+            # line.
+            req._respond(200, self.profiler.collapsed())
+        else:
+            req._respond(
+                200,
+                json.dumps(self.profiler.debug_payload(), indent=2),
+                "application/json",
+            )
+
+    def _serve_loops(self, req, url) -> None:
+        req._respond(
+            200, json.dumps(self.loops_fn(), indent=2), "application/json"
+        )
+
+    def _serve_slo(self, req, url) -> None:
+        req._respond(
+            200, json.dumps(self.slo_fn(), indent=2), "application/json"
+        )
+
+    def _serve_autoscaler(self, req, url) -> None:
+        req._respond(
+            200, json.dumps(self.autoscaler_fn(), indent=2), "application/json"
+        )
+
+    def _serve_forecast(self, req, url) -> None:
+        refresh = parse_qs(url.query).get("refresh", ["0"])[0] in ("1", "true")
+        req._respond(
+            200,
+            json.dumps(self.forecast_fn(refresh), indent=2),
+            "application/json",
+        )
+
+    # ------------------------------------------------------------ serving
+
     def _make_handler(self, serve_health: bool, serve_metrics: bool):
         ready_check = self.ready_check
         metrics_token = self.metrics_token
-        explain_fn = self.explain_fn
-        record_fn = self.record_fn
-        capacity_fn = self.capacity_fn
-        profiler = self.profiler
-        loops_fn = self.loops_fn
-        slo_fn = self.slo_fn
-        autoscaler_fn = self.autoscaler_fn
-
-        # The /debug/ index: every debug surface this listener actually
-        # serves, with a one-liner. Conditional entries appear only when
-        # their callback is wired, so the index never lists a 404.
+        endpoints = self._debug_endpoints()
+        # The /debug/ index IS the registry: every debug surface this
+        # listener serves, with a one-liner, derived from the same table
+        # the dispatcher routes (and gates) with.
         debug_index = {
-            "/debug/traces": "per-trace summaries; ?id=<trace_id> for the "
-            "full Chrome trace-event timeline",
-            "/debug/vars": "the MetricsRegistry snapshot as flat JSON",
+            path: entry["describe"] for path, entry in endpoints.items()
         }
-        if explain_fn is not None:
-            debug_index["/debug/explain"] = (
-                "?pod=<namespace>/<name> — the scheduler's latest per-node "
-                "per-plugin rejection Diagnosis for the pod"
-            )
-        if record_fn is not None:
-            debug_index["/debug/record"] = (
-                "the flight recorder's decision ring; ?format=jsonl for "
-                "`python -m nos_tpu replay` input"
-            )
-        if capacity_fn is not None:
-            debug_index["/debug/capacity"] = (
-                "the capacity ledger: chip-seconds accounting, idle "
-                "attribution, fragmentation, gang waits, quota posture"
-            )
-        if profiler is not None:
-            debug_index["/debug/profile"] = (
-                "the control-plane sampling profiler: JSON top-N self-time "
-                "and phase attribution; ?format=collapsed for flamegraph "
-                "input; ?action=start|stop for runtime control"
-            )
-        if loops_fn is not None:
-            debug_index["/debug/loops"] = (
-                "loop-health rollup: per-loop busy fractions, watch queue "
-                "depths, drain lag and phase-duration metric families"
-            )
-        if slo_fn is not None:
-            debug_index["/debug/slo"] = (
-                "serving SLO rollup: per-SLO fast/slow-window burn rates, "
-                "compliance, error-budget remaining, recent violations "
-                "linked into /debug/traces"
-            )
-        if autoscaler_fn is not None:
-            debug_index["/debug/autoscaler"] = (
-                "model autoscaler rollup: per-ModelServing desired/ready "
-                "replicas, last verdict, cold starts, and the burn/queue "
-                "signal registry"
-            )
 
         auth_enabled = bool(metrics_token)  # provider callable or token set
 
@@ -173,168 +331,13 @@ class HealthServer:
                         self._respond(401, "unauthorized")
                         return
                     self._respond(200, REGISTRY.render(), "text/plain; version=0.0.4")
-                elif path == "/debug/traces" and serve_metrics:
-                    # Same credential as /metrics: trace attributes carry
-                    # pod names and namespaces, as sensitive as the series.
+                elif path in endpoints and serve_metrics:
+                    # One gate for every registered debug surface: all of
+                    # them carry identifiers as sensitive as the series.
                     if not self._authorized():
                         self._respond(401, "unauthorized")
                         return
-                    wanted = parse_qs(url.query).get("id", [None])[0]
-                    if wanted:
-                        trace = TRACER.store.get(wanted)
-                        if trace is None:
-                            self._respond(404, "unknown trace id")
-                            return
-                        body = json.dumps(trace.to_chrome(), indent=2)
-                    else:
-                        body = json.dumps(TRACER.store.summaries(), indent=2)
-                    self._respond(200, body, "application/json")
-                elif (
-                    path == "/debug/explain"
-                    and serve_metrics
-                    and explain_fn is not None
-                ):
-                    # Same credential as /metrics: the diagnosis carries
-                    # pod names, namespaces, and rejection details.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    pod_key = parse_qs(url.query).get("pod", [None])[0]
-                    if not pod_key:
-                        self._respond(400, "missing ?pod=namespace/name")
-                        return
-                    diagnosis = explain_fn(pod_key)
-                    if diagnosis is None:
-                        self._respond(404, "no diagnosis recorded for pod")
-                        return
-                    self._respond(
-                        200, json.dumps(diagnosis, indent=2), "application/json"
-                    )
-                elif (
-                    path == "/debug/record"
-                    and serve_metrics
-                    and record_fn is not None
-                ):
-                    # Same credential as /metrics: decision records carry
-                    # pod names, namespaces, and full object deltas.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    records = record_fn()
-                    fmt = parse_qs(url.query).get("format", ["json"])[0]
-                    if fmt == "jsonl":
-                        # Directly consumable by `python -m nos_tpu replay`.
-                        body = "".join(json.dumps(r) + "\n" for r in records)
-                        self._respond(200, body, "application/x-ndjson")
-                    else:
-                        self._respond(
-                            200, json.dumps(records, indent=2), "application/json"
-                        )
-                elif path == "/debug/vars" and serve_metrics:
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    body = json.dumps(REGISTRY.snapshot(), indent=2, sort_keys=True)
-                    self._respond(200, body, "application/json")
-                elif (
-                    path == "/debug/capacity"
-                    and serve_metrics
-                    and capacity_fn is not None
-                ):
-                    # Same credential as /metrics: the rollup carries node,
-                    # pod, and namespace names.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    body = json.dumps(capacity_fn(), indent=2)
-                    self._respond(200, body, "application/json")
-                elif (
-                    path == "/debug/profile"
-                    and serve_metrics
-                    and profiler is not None
-                ):
-                    # Same credential as /metrics: stack frames reveal
-                    # code paths and the phase labels carry span names.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    query = parse_qs(url.query)
-                    action = query.get("action", [None])[0]
-                    if action == "start":
-                        started = profiler.start()
-                        self._respond(
-                            200,
-                            json.dumps(
-                                {"enabled": True, "started": started}
-                            ),
-                            "application/json",
-                        )
-                        return
-                    if action == "stop":
-                        stopped = profiler.stop()
-                        self._respond(
-                            200,
-                            json.dumps(
-                                {"enabled": False, "stopped": stopped}
-                            ),
-                            "application/json",
-                        )
-                        return
-                    if action is not None:
-                        self._respond(400, "action must be start or stop")
-                        return
-                    fmt = query.get("format", ["json"])[0]
-                    if fmt == "collapsed":
-                        # flamegraph.pl / speedscope input, one aggregated
-                        # stack per line.
-                        self._respond(200, profiler.collapsed())
-                    else:
-                        self._respond(
-                            200,
-                            json.dumps(profiler.debug_payload(), indent=2),
-                            "application/json",
-                        )
-                elif (
-                    path == "/debug/loops"
-                    and serve_metrics
-                    and loops_fn is not None
-                ):
-                    # Same credential as /metrics: loop names and watcher
-                    # labels identify the deployment's topology.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    self._respond(
-                        200, json.dumps(loops_fn(), indent=2), "application/json"
-                    )
-                elif (
-                    path == "/debug/slo"
-                    and serve_metrics
-                    and slo_fn is not None
-                ):
-                    # Same credential as /metrics: violation entries carry
-                    # request/model identifiers and trace links.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    self._respond(
-                        200, json.dumps(slo_fn(), indent=2), "application/json"
-                    )
-                elif (
-                    path == "/debug/autoscaler"
-                    and serve_metrics
-                    and autoscaler_fn is not None
-                ):
-                    # Same credential as /metrics: the rollup names models
-                    # and ModelServing objects.
-                    if not self._authorized():
-                        self._respond(401, "unauthorized")
-                        return
-                    self._respond(
-                        200,
-                        json.dumps(autoscaler_fn(), indent=2),
-                        "application/json",
-                    )
+                    endpoints[path]["handle"](self, url)
                 elif path in ("/debug", "/debug/") and serve_metrics:
                     # Bearer-gated like every endpoint it links to — the
                     # index itself reveals which subsystems are wired.

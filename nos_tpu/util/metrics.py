@@ -685,3 +685,30 @@ PROFILER_OVERHEAD = REGISTRY.gauge(
     "Sampler duty cycle: time spent capturing stacks divided by wall "
     "time enabled (the profiler's measured overhead budget)",
 )
+
+# Placement forecasting (nos_tpu/forecast/): earliest-feasible-start
+# ETAs, backfill-safety verdicts, and the calibration that gates letting
+# forecasts actuate (ROADMAP item 2).
+GANG_ETA_SECONDS = REGISTRY.histogram(
+    "nos_tpu_gang_eta_seconds",
+    "Forecast earliest-feasible-start ETA per pending gang "
+    "(by stage=feasible-now|recarve|blocked; blocked gangs without "
+    "expected-completion hints publish no ETA)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
+)
+FORECAST_ACCURACY_RATIO = REGISTRY.gauge(
+    "nos_tpu_forecast_accuracy_ratio",
+    "Rolling forecast calibration: absolute ETA error divided by the "
+    "gang's actual arrival-to-bound wait, joined at gang-bound "
+    "(by quantile=p50|p95 over the calibration window)",
+)
+BACKFILL_UNSAFE_TOTAL = REGISTRY.counter(
+    "nos_tpu_backfill_unsafe_total",
+    "Backfill-safety shadow trials that found a (small pod, node) "
+    "placement which would delay the oldest pending gang's ETA",
+)
+FORECAST_RUNS = REGISTRY.counter(
+    "nos_tpu_forecast_runs_total",
+    "Completed forecast cycles (background thread or on-demand "
+    "/debug/forecast?refresh=1)",
+)

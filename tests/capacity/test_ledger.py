@@ -454,3 +454,170 @@ class TestChaosOracle:
         time.sleep(0.001)
         out = oracles.ledger_consistent(p, store)
         assert out and out[0].startswith("ledger-consistent:")
+
+
+class TestReasonTieBreak:
+    """The dominant reason must be a pure function of the reason COUNTS —
+    never of dict insertion order — because forecast records and replay
+    drift comparisons inherit the field verbatim."""
+
+    def test_multiway_tie_every_insertion_order(self):
+        import itertools
+
+        pods = [
+            ("p1", "beta"),
+            ("p2", "alpha"),
+            ("p3", "beta"),
+            ("p4", "alpha"),
+            ("p5", "gamma"),
+        ]
+        # alpha and beta tie at 2 (gamma trails): alpha wins every order.
+        for perm in itertools.permutations(pods):
+            assert dominant_unserved_reason(dict(perm)) == "alpha"
+
+    def test_count_beats_lexicographic_order(self):
+        assert (
+            dominant_unserved_reason({"a": "zzz", "b": "zzz", "c": "aaa"})
+            == "zzz"
+        )
+
+
+def _gang_pod(name, gang="big", size=2, node=""):
+    from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+    pod = build_pod(name, {constants.RESOURCE_TPU: 4}, node=node)
+    pod.metadata.labels[GANG_NAME_LABEL] = gang
+    pod.metadata.labels[GANG_SIZE_LABEL] = str(size)
+    return pod
+
+
+class TestGangClockResets:
+    """Wait clocks across the ugly lifecycles: members deleted before
+    the gang ever binds, and preempt-then-resubmit. A same-named
+    re-arrival must always start from a FRESH arrival stamp — the
+    forecast accuracy join reads these waits as ground truth."""
+
+    def test_deleted_before_bound_drops_clock(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(_gang_pod("g0"))
+        store.create(_gang_pod("g1"))
+        ledger.observe(T0)
+        ledger.note_gang_arrival("default/big", T0)
+        ledger.note_gang_feasible("default/big", T0 + 2)
+        assert "default/big" in ledger.gang_clocks()
+        # One member deleted: the gang still exists, the clock survives.
+        store.delete("Pod", "g0", "default")
+        ledger.observe(T0 + 3)
+        assert "default/big" in ledger.gang_clocks()
+        # Last member deleted before bound: the clock must go with it.
+        store.delete("Pod", "g1", "default")
+        ledger.observe(T0 + 4)
+        assert ledger.gang_clocks() == {}
+        # A late bound observation is a no-op, not a bogus recent entry.
+        ledger.note_gang_bound("default/big", T0 + 5)
+        assert ledger.debug_payload()["gangs"]["recent"] == []
+
+    def test_same_named_rearrival_gets_fresh_clock(self):
+        store, ledger = make_ledger()
+        store.create(_gang_pod("g0"))
+        ledger.observe(T0)
+        ledger.note_gang_arrival("default/big", T0)
+        store.delete("Pod", "g0", "default")
+        ledger.observe(T0 + 5)
+        assert ledger.gang_clocks() == {}
+        # Resubmission under the same gang name: arrival restarts at the
+        # new time, and the full arrival→feasible→bound flow is coherent.
+        store.create(_gang_pod("g0"))
+        store.create(_gang_pod("g1"))
+        ledger.observe(T0 + 10)
+        ledger.note_gang_arrival("default/big", T0 + 10)
+        assert ledger.gang_clocks()["default/big"]["arrival"] == T0 + 10
+        ledger.note_gang_feasible("default/big", T0 + 11)
+        ledger.note_gang_bound("default/big", T0 + 12)
+        recent = ledger.debug_payload()["gangs"]["recent"]
+        assert recent == [
+            {"gang": "default/big", "wait_seconds": 2.0, "feasible_after": 1.0}
+        ]
+
+    def test_preempt_then_resubmit_measures_two_waits(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        store.create(_gang_pod("g0", node="n1"))
+        store.create(_gang_pod("g1", node="n1"))
+        ledger.observe(T0)
+        ledger.note_gang_arrival("default/big", T0)
+        ledger.note_gang_feasible("default/big", T0 + 1)
+        ledger.note_gang_bound("default/big", T0 + 2)
+        # Preemption: both members evicted, gang resubmitted pending.
+        store.delete("Pod", "g0", "default")
+        store.delete("Pod", "g1", "default")
+        ledger.observe(T0 + 20)
+        store.create(_gang_pod("g0"))
+        store.create(_gang_pod("g1"))
+        ledger.observe(T0 + 21)
+        ledger.note_gang_arrival("default/big", T0 + 21)
+        clock = ledger.gang_clocks()["default/big"]
+        assert clock == {"arrival": T0 + 21}  # no stale feasible stamp
+        ledger.note_gang_feasible("default/big", T0 + 24)
+        ledger.note_gang_bound("default/big", T0 + 26)
+        recent = ledger.debug_payload()["gangs"]["recent"]
+        assert [r["wait_seconds"] for r in recent] == [2.0, 5.0]
+        assert [r["feasible_after"] for r in recent] == [1.0, 3.0]
+
+    def test_gang_bound_listener_fires_with_wait(self):
+        _, ledger = make_ledger()
+        calls = []
+        ledger.add_gang_bound_listener(
+            lambda gang, now, wait: calls.append((gang, now, wait))
+        )
+        ledger.note_gang_arrival("ml/g", T0)
+        ledger.note_gang_bound("ml/g", T0 + 5)
+        assert calls == [("ml/g", T0 + 5, 5.0)]
+        # A raising listener is logged, never propagated.
+        ledger.add_gang_bound_listener(lambda *a: 1 / 0)
+        ledger.note_gang_arrival("ml/g2", T0)
+        ledger.note_gang_bound("ml/g2", T0 + 1)
+        assert calls[-1] == ("ml/g2", T0 + 1, 1.0)
+
+
+class TestReconfigRate:
+    """Frozen-edge timing: the measured re-carve latency the forecaster
+    prices recarve ETAs with."""
+
+    def test_frozen_edges_measure_reconfig_seconds(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        assert ledger.mean_reconfig_seconds(default=0.7) == 0.7
+        node = store.get("Node", "n1")
+        node.metadata.annotations[annot.SPEC_PARTITIONING_PLAN] = "p1"
+        store.update(node)
+        ledger.observe(T0 + 1)  # rising edge: reconfig starts
+        assert ledger.reconfig_stats()["in_flight"] == ["n1"]
+        node = store.get("Node", "n1")
+        node.metadata.annotations[annot.STATUS_PARTITIONING_PLAN] = "p1"
+        store.update(node)
+        ledger.observe(T0 + 4)  # falling edge: 3 s reconfig
+        assert ledger.mean_reconfig_seconds() == 3.0
+        stats = ledger.reconfig_stats()
+        assert stats == {"count": 1, "seconds_total": 3.0, "in_flight": []}
+        # Reconfig stats stay OUT of the replay-compared totals payload.
+        assert "reconfig_count" not in ledger.totals()
+
+    def test_node_deleted_mid_reconfig_drops_the_edge(self):
+        store, ledger = make_ledger()
+        store.create(build_tpu_node(name="n1", chips=8))
+        ledger.observe(T0)
+        node = store.get("Node", "n1")
+        node.metadata.annotations[annot.SPEC_PARTITIONING_PLAN] = "p1"
+        store.update(node)
+        ledger.observe(T0 + 1)
+        store.delete("Node", "n1")
+        ledger.observe(T0 + 2)
+        assert ledger.reconfig_stats() == {
+            "count": 0,
+            "seconds_total": 0.0,
+            "in_flight": [],
+        }
+        assert ledger.mean_reconfig_seconds(default=0.5) == 0.5

@@ -1,0 +1,70 @@
+"""Shared builders for the forecast suite: a store-backed snapshot plus
+an engine-private planner, the same wiring the PlacementForecaster uses."""
+from nos_tpu.api.v1alpha1 import annotations as annot
+from nos_tpu.cmd.partitioner import build_sim_framework, register_indexers
+from nos_tpu.forecast import ForecastEngine
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState, Planner
+from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+from tests.factory import PodPhase, build_pod, build_tpu_node, slice_res
+
+T0 = 1_000_000.0
+
+
+def make_store() -> KubeStore:
+    store = KubeStore()
+    register_indexers(store)
+    return store
+
+
+def make_planner(store) -> Planner:
+    return Planner(build_sim_framework(store))
+
+
+def make_engine(store, **kwargs) -> ForecastEngine:
+    return ForecastEngine(make_planner(store), **kwargs)
+
+
+def take_snapshot(store):
+    return TpuSnapshotTaker().take_snapshot(ClusterState(), store=store)
+
+
+def carved_node(name, free=None, used=None, chips=8, topology="2x4"):
+    """A TPU node whose agent has reported carved geometry."""
+    return build_tpu_node(
+        name=name,
+        chips=chips,
+        topology=topology,
+        annotations=annot.status_from_devices(free=free or {}, used=used or {}),
+    )
+
+
+def gang_pod(name, profile="2x2", gang="big", size=2, ns="default", node="",
+             phase=PodPhase.PENDING, annotations=None):
+    pod = build_pod(
+        name, requests={slice_res(profile): 1}, ns=ns, node=node, phase=phase
+    )
+    pod.metadata.labels[GANG_NAME_LABEL] = gang
+    pod.metadata.labels[GANG_SIZE_LABEL] = str(size)
+    if annotations:
+        pod.metadata.annotations.update(annotations)
+    return pod
+
+
+def small_pod(name, profile="1x2", ns="default"):
+    return build_pod(name, requests={slice_res(profile): 1}, ns=ns)
+
+
+def snapshot_fingerprint(snapshot):
+    """Geometry + placements of every node — asserting forecast trials
+    left the snapshot bit-identical."""
+    out = {}
+    for name, node in snapshot.get_nodes().items():
+        out[name] = (
+            {b: dict(g) for b, g in node.partitionable.geometry().items()},
+            dict(node.partitionable.free_slices()),
+            sorted(p.namespaced_name for p in node.pods),
+        )
+    return out

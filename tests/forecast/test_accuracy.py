@@ -1,0 +1,67 @@
+"""CalibrationTracker: nearest-rank percentiles, the unforecast path,
+the sliding window, and payload purity (replay recomputes it bit-exactly
+from the add() history alone)."""
+from nos_tpu.forecast import CalibrationTracker, nearest_rank
+
+
+class TestNearestRank:
+    def test_textbook_ranks(self):
+        values = sorted(float(v) for v in range(1, 101))
+        assert nearest_rank(values, 0.5) == 50.0
+        assert nearest_rank(values, 0.95) == 95.0
+        assert nearest_rank(values, 1.0) == 100.0
+
+    def test_small_samples_clamp(self):
+        assert nearest_rank([7.0], 0.5) == 7.0
+        assert nearest_rank([7.0], 0.95) == 7.0
+        assert nearest_rank([1.0, 9.0], 0.95) == 9.0
+
+
+class TestCalibrationTracker:
+    def test_join_produces_error_and_ratio(self):
+        tracker = CalibrationTracker()
+        sample = tracker.add(10.0, 12.0, 20.0, stage="recarve")
+        assert sample == {
+            "error_seconds": 2.0,
+            "ratio": 0.1,
+            "stage": "recarve",
+        }
+        payload = tracker.payload()
+        assert payload["joined"] == 1 and payload["unforecast"] == 0
+        assert payload["p50_error_seconds"] == 2.0
+        assert payload["p95_error_seconds"] == 2.0
+        assert payload["p50_ratio"] == 0.1
+
+    def test_unforecast_eta_counts_without_a_sample(self):
+        tracker = CalibrationTracker()
+        assert tracker.add(None, 5.0, 5.0) is None
+        payload = tracker.payload()
+        assert payload["joined"] == 0 and payload["unforecast"] == 1
+        assert payload["p50_error_seconds"] is None
+
+    def test_zero_wait_ratio_is_zero_not_nan(self):
+        tracker = CalibrationTracker()
+        sample = tracker.add(1.0, 0.0, 0.0)
+        assert sample["ratio"] == 0.0
+
+    def test_window_slides(self):
+        tracker = CalibrationTracker(window=3)
+        for error in (100.0, 1.0, 2.0, 3.0):
+            tracker.add(error, 0.0, 10.0)
+        payload = tracker.payload()
+        # The 100-second outlier aged out of the 3-sample window.
+        assert payload["samples"] == 3 and payload["joined"] == 4
+        assert payload["p95_error_seconds"] == 3.0
+
+    def test_payload_is_pure_function_of_history(self):
+        history = [
+            (10.0, 12.0, 20.0, "feasible-now"),
+            (None, 5.0, 5.0, "blocked"),
+            (3.0, 1.0, 4.0, "recarve"),
+            (0.5, 0.5, 2.0, "feasible-now"),
+        ]
+        a, b = CalibrationTracker(), CalibrationTracker()
+        for eta, actual, wait, stage in history:
+            a.add(eta, actual, wait, stage=stage)
+            b.add(eta, actual, wait, stage=stage)
+        assert a.payload() == b.payload()

@@ -1,0 +1,146 @@
+"""DefragAdvisor: near-empty detection against board capacity, shadow-sim
+validation of proposals, and the strictly-read-only contract."""
+from nos_tpu.forecast import DefragAdvisor, STAGE_FEASIBLE_NOW, STAGE_RECARVE
+
+from tests.factory import PodPhase
+from tests.forecast.helpers import (
+    T0,
+    carved_node,
+    gang_pod,
+    make_engine,
+    make_store,
+    snapshot_fingerprint,
+    take_snapshot,
+)
+
+
+def forecast_and_advise(store, pending, engine=None, **advisor_kwargs):
+    engine = engine or make_engine(store)
+    advisor = DefragAdvisor(engine, **advisor_kwargs)
+    snapshot = take_snapshot(store)
+    clocks = {"default/big": {"arrival": T0 - 10.0}}
+    before = engine.forecast(snapshot, pending, T0, clocks=clocks).gangs
+    return (
+        snapshot,
+        before,
+        advisor.advise(snapshot, pending, before, T0, clocks=clocks),
+    )
+
+
+class TestNearEmptyDetection:
+    def test_uncarved_nodes_qualify(self):
+        """free_slices() is empty on a pristine node — the advisor must
+        measure free against BOARD capacity or its prime candidates all
+        read as zero free (the regression this class pins)."""
+        store = make_store()
+        store.create(carved_node("n1"))
+        engine = make_engine(store)
+        advisor = DefragAdvisor(engine)
+        names = [n for n, _ in advisor._near_empty_nodes(take_snapshot(store))]
+        assert names == ["n1"]
+
+    def test_mostly_used_nodes_do_not_qualify(self):
+        store = make_store()
+        store.create(carved_node("n1", used={0: {"2x2": 1, "1x2": 1}}))
+        store.create(
+            gang_pod("b0", gang="old", node="n1", phase=PodPhase.RUNNING)
+        )
+        store.create(
+            gang_pod(
+                "b1", gang="old", profile="1x2", node="n1",
+                phase=PodPhase.RUNNING,
+            )
+        )
+        engine = make_engine(store)
+        advisor = DefragAdvisor(engine)  # threshold 0.5, free is 2/8
+        assert advisor._near_empty_nodes(take_snapshot(store)) == []
+
+    def test_most_free_first_order(self):
+        store = make_store()
+        store.create(carved_node("a", used={0: {"1x2": 1}}))
+        store.create(
+            gang_pod(
+                "b0", gang="old", profile="1x2", node="a",
+                phase=PodPhase.RUNNING,
+            )
+        )
+        store.create(carved_node("b"))
+        engine = make_engine(store)
+        advisor = DefragAdvisor(engine)
+        out = advisor._near_empty_nodes(take_snapshot(store))
+        assert out == [("b", 8), ("a", 6)]
+
+
+class TestValidation:
+    def test_validated_proposal_moves_gang_earlier(self):
+        store = make_store()
+        for i in range(3):
+            store.create(carved_node(f"n{i}"))
+        pending = [gang_pod(f"g{i}", size=4) for i in range(4)]
+        for p in pending:
+            store.create(p)
+        _, before, advice = forecast_and_advise(store, pending)
+        assert before[0].stage == STAGE_RECARVE
+        assert advice["near_empty_nodes"] == ["n0", "n1", "n2"]
+        assert advice["proposals"]
+        first = advice["proposals"][0]
+        assert first["node"] == "n0"
+        assert first["geometry_after"] != first["geometry_before"]
+        # The shadow sim re-forecast the gang against the hypothetical
+        # geometry: it starts earlier, so the recommendation validates
+        # with a positive chip-seconds saving.
+        assert advice["validated"] is True
+        assert advice["predicted_idle_savings_chip_seconds"] > 0
+        shadow = advice["gangs"][0]
+        assert shadow["stage_before"] == STAGE_RECARVE
+        assert shadow["stage_after"] == STAGE_FEASIBLE_NOW
+        assert shadow["eta_after"] < shadow["eta_before"]
+
+    def test_no_pending_demand_proposes_nothing(self):
+        store = make_store()
+        store.create(carved_node("n1"))
+        _, _, advice = forecast_and_advise(store, [])
+        assert advice["proposals"] == []
+        assert advice["validated"] is False
+
+    def test_already_feasible_gang_does_not_validate(self):
+        """Nothing to save: the queue's demand already places on current
+        geometry, so a re-carve proposal must not claim savings."""
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"2x2": 2}}))
+        store.create(carved_node("n2"))
+        pending = [gang_pod("g0"), gang_pod("g1")]
+        for p in pending:
+            store.create(p)
+        _, before, advice = forecast_and_advise(store, pending)
+        assert before[0].stage == STAGE_FEASIBLE_NOW
+        assert advice["predicted_idle_savings_chip_seconds"] == 0.0
+        assert advice["validated"] is False
+
+    def test_proposal_cap(self):
+        store = make_store()
+        for i in range(5):
+            store.create(carved_node(f"n{i}"))
+        pending = [gang_pod(f"g{i}", size=4) for i in range(4)]
+        for p in pending:
+            store.create(p)
+        _, _, advice = forecast_and_advise(store, pending, max_proposals=2)
+        assert len(advice["proposals"]) == 2
+
+
+class TestReadOnly:
+    def test_advise_leaves_snapshot_and_store_untouched(self):
+        store = make_store()
+        for i in range(3):
+            store.create(carved_node(f"n{i}"))
+        pending = [gang_pod(f"g{i}", size=4) for i in range(4)]
+        for p in pending:
+            store.create(p)
+        revision = store.revision
+        snapshot, before, _ = forecast_and_advise(store, pending)
+        fingerprint = snapshot_fingerprint(snapshot)
+        engine = make_engine(store)
+        DefragAdvisor(engine).advise(snapshot, pending, before, T0)
+        assert snapshot_fingerprint(snapshot) == fingerprint
+        assert snapshot._journals == []
+        assert store.revision == revision  # nothing written, ever

@@ -1,0 +1,250 @@
+"""ForecastEngine stage classification, blocking sets, the backfill
+predicate, and the determinism / snapshot-preservation contracts."""
+import json
+
+from nos_tpu.forecast import (
+    EXPECTED_COMPLETION_ANNOTATION,
+    STAGE_BLOCKED,
+    STAGE_FEASIBLE_NOW,
+    STAGE_RECARVE,
+)
+
+from tests.factory import PodPhase
+from tests.forecast.helpers import (
+    T0,
+    carved_node,
+    gang_pod,
+    make_engine,
+    make_store,
+    small_pod,
+    snapshot_fingerprint,
+    take_snapshot,
+)
+
+
+class TestStages:
+    def test_feasible_now_on_carved_free_slices(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"2x2": 2}}))
+        pending = [gang_pod("g0"), gang_pod("g1")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(
+            take_snapshot(store), pending, T0, cycle_seconds=2.0
+        )
+        assert len(result.gangs) == 1
+        gang = result.gangs[0]
+        assert gang.gang == "default/big"
+        assert gang.stage == STAGE_FEASIBLE_NOW
+        assert gang.eta_seconds == 2.0  # the next plan/bind cycle
+        assert gang.recarve == [] and gang.blocking == []
+        assert gang.pending == ["default/g0", "default/g1"]
+
+    def test_recarve_on_uncarved_capacity(self):
+        store = make_store()
+        store.create(carved_node("n1"))  # 8 chips, nothing carved
+        pending = [gang_pod("g0"), gang_pod("g1")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(
+            take_snapshot(store),
+            pending,
+            T0,
+            cycle_seconds=1.0,
+            reconfig_seconds=2.5,
+        )
+        gang = result.gangs[0]
+        assert gang.stage == STAGE_RECARVE
+        assert gang.recarve == ["n1"]
+        # One cycle + ONE measured reconfig (re-carves actuate
+        # concurrently), never reconfig * node count.
+        assert gang.eta_seconds == 3.5
+
+    def test_blocked_without_hints_has_no_eta(self):
+        store = make_store()
+        store.create(carved_node("n1", used={0: {"2x2": 2}}))
+        blockers = [
+            gang_pod("b0", gang="old", node="n1", phase=PodPhase.RUNNING),
+            gang_pod("b1", gang="old", node="n1", phase=PodPhase.RUNNING),
+        ]
+        for p in blockers:
+            store.create(p)
+        pending = [gang_pod("g0"), gang_pod("g1")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(take_snapshot(store), pending, T0)
+        gang = result.gangs[0]
+        assert gang.stage == STAGE_BLOCKED
+        assert gang.eta_seconds is None  # honest: no completion hints
+        assert [b["pod"] for b in gang.blocking] == [
+            "default/b0",
+            "default/b1",
+        ]
+        assert gang.blocking[0]["explain"] == "/debug/explain?pod=default/b0"
+
+    def test_blocked_with_hints_prices_the_slowest_blocker(self):
+        store = make_store()
+        store.create(carved_node("n1", used={0: {"2x2": 2}}))
+        store.create(
+            gang_pod(
+                "b0", gang="old", node="n1", phase=PodPhase.RUNNING,
+                annotations={EXPECTED_COMPLETION_ANNOTATION: str(T0 + 30)},
+            )
+        )
+        store.create(
+            gang_pod(
+                "b1", gang="old", node="n1", phase=PodPhase.RUNNING,
+                annotations={EXPECTED_COMPLETION_ANNOTATION: str(T0 + 50)},
+            )
+        )
+        pending = [gang_pod("g0"), gang_pod("g1")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(
+            take_snapshot(store), pending, T0, cycle_seconds=1.0
+        )
+        gang = result.gangs[0]
+        assert gang.stage == STAGE_BLOCKED
+        # Chips free when the SLOWEST blocker finishes + one plan cycle.
+        assert gang.eta_seconds == 51.0
+        completions = [
+            b.get("expected_completion_ts") for b in gang.blocking
+        ]
+        assert completions == [T0 + 30, T0 + 50]
+
+    def test_wait_seconds_comes_from_gang_clocks(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"2x2": 2}}))
+        pending = [gang_pod("g0"), gang_pod("g1")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(
+            take_snapshot(store),
+            pending,
+            T0,
+            clocks={"default/big": {"arrival": T0 - 12.0}},
+        )
+        assert result.gangs[0].wait_seconds == 12.0
+
+    def test_non_gang_pods_are_not_gangs(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"1x2": 4}}))
+        pending = [small_pod("solo")]
+        store.create(pending[0])
+        engine = make_engine(store)
+        result = engine.forecast(take_snapshot(store), pending, T0)
+        assert result.gangs == [] and result.backfill == []
+
+
+class TestBackfillPredicate:
+    def test_taking_a_slice_the_gang_needs_is_unsafe(self):
+        store = make_store()
+        # 8 chips: two 1x2 slivers + one 2x2. The gang needs two 2x2s —
+        # only a re-carve of the slivers makes the second one.
+        store.create(carved_node("n1", free={0: {"1x2": 2, "2x2": 1}}))
+        pending = [gang_pod("g0"), gang_pod("g1"), small_pod("tiny")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(
+            take_snapshot(store),
+            pending,
+            T0,
+            clocks={"default/big": {"arrival": T0 - 5.0}},
+        )
+        assert result.gangs[0].stage == STAGE_RECARVE
+        assert len(result.backfill) == 1
+        verdict = result.backfill[0]
+        assert verdict.pod == "default/tiny" and verdict.node == "n1"
+        # The sliver the small pod takes is re-carve feedstock: the gang
+        # degrades recarve -> blocked, so the pair is unsafe.
+        assert not verdict.safe
+        assert "degrades" in verdict.reason
+        assert result.unsafe_count == 1
+        assert result.heatmap == {"n1": {"safe": 0, "unsafe": 1}}
+
+    def test_taking_an_unneeded_slice_is_safe(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"2x2": 2}}))
+        store.create(carved_node("n2", free={0: {"1x2": 4}}))
+        pending = [gang_pod("g0"), gang_pod("g1"), small_pod("tiny")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        result = engine.forecast(take_snapshot(store), pending, T0)
+        assert result.gangs[0].stage == STAGE_FEASIBLE_NOW
+        assert result.backfill and all(v.safe for v in result.backfill)
+        assert result.heatmap["n2"]["safe"] >= 1
+        assert result.unsafe_count == 0
+
+    def test_pair_cap_bounds_the_trials(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"2x2": 2}}))
+        store.create(carved_node("n2", free={0: {"1x2": 4}}))
+        pending = [gang_pod("g0"), gang_pod("g1")] + [
+            small_pod(f"tiny{i}") for i in range(6)
+        ]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store, max_backfill_pairs=3)
+        result = engine.forecast(take_snapshot(store), pending, T0)
+        assert len(result.backfill) == 3
+
+
+class TestContracts:
+    def test_forecast_is_deterministic(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"1x2": 2, "2x2": 1}}))
+        store.create(carved_node("n2"))
+        pending = [
+            gang_pod("g0"),
+            gang_pod("g1"),
+            gang_pod("h0", gang="other", size=1, profile="1x2"),
+            small_pod("tiny"),
+        ]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        snapshot = take_snapshot(store)
+        clocks = {"default/big": {"arrival": T0 - 9.0}}
+        first = engine.forecast(snapshot, pending, T0, clocks=clocks)
+        second = engine.forecast(snapshot, pending, T0, clocks=clocks)
+        assert json.dumps(first.payload(), sort_keys=True) == json.dumps(
+            second.payload(), sort_keys=True
+        )
+
+    def test_forecast_leaves_the_snapshot_untouched(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"1x2": 2, "2x2": 1}}))
+        store.create(carved_node("n2"))
+        pending = [gang_pod("g0"), gang_pod("g1"), small_pod("tiny")]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store)
+        snapshot = take_snapshot(store)
+        before = snapshot_fingerprint(snapshot)
+        engine.forecast(snapshot, pending, T0)
+        assert snapshot_fingerprint(snapshot) == before
+        assert snapshot._journals == []  # every fork reverted
+
+    def test_gang_cap_applies_in_sorted_order(self):
+        store = make_store()
+        store.create(carved_node("n1", free={0: {"2x2": 2}}))
+        pending = [
+            gang_pod("a0", gang="alpha", size=1),
+            gang_pod("b0", gang="beta", size=1),
+            gang_pod("c0", gang="gamma", size=1),
+        ]
+        for p in pending:
+            store.create(p)
+        engine = make_engine(store, max_gangs=2)
+        result = engine.forecast(take_snapshot(store), pending, T0)
+        assert [g.gang for g in result.gangs] == [
+            "default/alpha",
+            "default/beta",
+        ]

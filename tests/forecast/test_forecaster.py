@@ -1,0 +1,243 @@
+"""PlacementForecaster wiring: publishing, the ledger-driven accuracy
+join, staleness checks, and the flight-record replay round-trip (the
+auditor recomputes every calibration payload bit-exactly)."""
+import json
+import time
+
+from nos_tpu.capacity import CapacityLedger
+from nos_tpu.forecast import PlacementForecaster, STAGE_FEASIBLE_NOW
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState
+from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+from nos_tpu.record import FlightRecorder
+from nos_tpu.util.profiling import PROFILER
+from nos_tpu.record.replay import ReplaySession
+
+from tests.forecast.helpers import (
+    T0,
+    carved_node,
+    gang_pod,
+    make_planner,
+    make_store,
+)
+
+
+def make_forecaster(store, **kwargs):
+    return PlacementForecaster(
+        store,
+        ClusterState(),
+        make_planner(store),
+        TpuSnapshotTaker(),
+        **kwargs,
+    )
+
+
+def feasible_cluster(store):
+    """One carved node with two free 2x2 slices + a two-pod gang that
+    fits them: forecast is feasible-now."""
+    store.create(carved_node("n1", free={0: {"2x2": 2}}))
+    pending = [gang_pod("g0"), gang_pod("g1")]
+    for p in pending:
+        store.create(p)
+    return pending
+
+
+class TestRunOnce:
+    def test_publishes_gang_etas_and_stamps(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        ledger = CapacityLedger(store, metrics=False)
+        ledger.note_gang_arrival("default/big", T0 - 10.0)
+        forecaster = make_forecaster(store, capacity_ledger=ledger)
+        payload = forecaster.run_once(
+            now=T0, pending=pending, cycle_seconds=2.0, reconfig_seconds=0.5
+        )
+        assert forecaster.runs == 1
+        gang = payload["gangs"][0]
+        assert gang["gang"] == "default/big"
+        assert gang["stage"] == STAGE_FEASIBLE_NOW
+        assert gang["eta_seconds"] == 2.0
+        assert gang["wait_seconds"] == 10.0  # from the ledger's clock
+        assert forecaster._outstanding["default/big"] == {
+            "now": T0,
+            "eta_seconds": 2.0,
+            "stage": STAGE_FEASIBLE_NOW,
+        }
+
+    def test_run_once_is_deterministic(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        store.create(carved_node("n2"))  # uncarved spare, advisor fodder
+        forecaster = make_forecaster(store)
+        first = forecaster.run_once(
+            now=T0, pending=pending, cycle_seconds=1.0, reconfig_seconds=0.5
+        )
+        second = forecaster.run_once(
+            now=T0, pending=pending, cycle_seconds=1.0, reconfig_seconds=0.5
+        )
+        assert json.dumps(first, sort_keys=True) == json.dumps(
+            second, sort_keys=True
+        )
+
+    def test_reconfig_rate_comes_from_the_ledger(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        ledger = CapacityLedger(store, metrics=False)
+        forecaster = make_forecaster(
+            store, capacity_ledger=ledger, default_reconfig_seconds=0.9
+        )
+        forecaster.run_once(now=T0, pending=pending)
+        # No measured edges yet: the ledger falls back to our default.
+        assert forecaster.debug_payload()["reconfig_seconds"] == 0.9
+
+
+class TestAccuracyJoin:
+    def test_gang_bound_joins_the_last_forecast(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        ledger = CapacityLedger(store, metrics=False)
+        ledger.note_gang_arrival("default/big", T0 - 10.0)
+        forecaster = make_forecaster(store, capacity_ledger=ledger)
+        forecaster.run_once(
+            now=T0, pending=pending, cycle_seconds=2.0, reconfig_seconds=0.5
+        )
+        # The ledger observes the bind 3s later; its listener joins the
+        # 2s ETA against the 3s actual without any forecaster plumbing.
+        ledger.note_gang_bound("default/big", T0 + 3.0)
+        calibration = forecaster.calibration.payload()
+        assert calibration["joined"] == 1
+        assert calibration["p50_error_seconds"] == 1.0
+        assert calibration["p50_ratio"] == 1.0 / 13.0
+        assert forecaster._outstanding == {}  # stamp consumed
+
+    def test_unforecast_bind_is_counted_not_scored(self):
+        store = make_store()
+        ledger = CapacityLedger(store, metrics=False)
+        ledger.note_gang_arrival("ml/ghost", T0)
+        forecaster = make_forecaster(store, capacity_ledger=ledger)
+        forecaster._outstanding["ml/ghost"] = {
+            "now": T0,
+            "eta_seconds": None,
+            "stage": "blocked",
+        }
+        ledger.note_gang_bound("ml/ghost", T0 + 4.0)
+        calibration = forecaster.calibration.payload()
+        assert calibration["joined"] == 0
+        assert calibration["unforecast"] == 1
+
+
+class TestStaleness:
+    def test_stale_feasible_now_flags_only_overdue_gangs(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        forecaster = make_forecaster(store)
+        forecaster.run_once(now=T0, pending=pending, cycle_seconds=1.0)
+        assert forecaster.stale_feasible_now(T0 + 1.0) == []
+        assert forecaster.stale_feasible_now(T0 + 100.0) == ["default/big"]
+        # A later run still feasible-now keeps the ORIGINAL stamp: the
+        # clock measures continuous feasibility, not recency.
+        forecaster.run_once(now=T0 + 100.0, pending=pending)
+        assert forecaster.stale_feasible_now(T0 + 104.0) == ["default/big"]
+
+    def test_binding_clears_the_feasible_stamp(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        ledger = CapacityLedger(store, metrics=False)
+        ledger.note_gang_arrival("default/big", T0)
+        forecaster = make_forecaster(store, capacity_ledger=ledger)
+        forecaster.run_once(now=T0, pending=pending)
+        ledger.note_gang_bound("default/big", T0 + 1.0)
+        assert forecaster.stale_feasible_now(T0 + 100.0) == []
+
+
+class TestDebugPayload:
+    def test_shape_without_refresh(self):
+        store = make_store()
+        pending = feasible_cluster(store)
+        forecaster = make_forecaster(store)
+        forecaster.run_once(now=T0, pending=pending)
+        payload = forecaster.debug_payload()
+        assert payload["kind"] == "tpu"
+        assert payload["runs"] == 1
+        assert payload["outstanding"] == 1
+        assert payload["forecast"]["gangs"][0]["gang"] == "default/big"
+        assert payload["calibration"]["joined"] == 0
+
+
+def recorded_forecast_run():
+    """A live run with the recorder attached: two forecast cycles, then
+    the gang binds and the outcome joins. Returns the flight record
+    after a JSON round-trip, the framing the replay auditor consumes."""
+    store = KubeStore()
+    from nos_tpu.cmd.partitioner import register_indexers
+
+    register_indexers(store)
+    recorder = FlightRecorder()
+    recorder.attach(store)
+    ledger = CapacityLedger(store, flight_recorder=recorder, metrics=False)
+    pending = feasible_cluster(store)
+    ledger.note_gang_arrival("default/big", T0 - 10.0)
+    forecaster = make_forecaster(
+        store, capacity_ledger=ledger, flight_recorder=recorder
+    )
+    forecaster.run_once(
+        now=T0, pending=pending, cycle_seconds=2.0, reconfig_seconds=0.5
+    )
+    forecaster.run_once(
+        now=T0 + 2.0, pending=pending, cycle_seconds=2.0, reconfig_seconds=0.5
+    )
+    ledger.note_gang_bound("default/big", T0 + 3.0)
+    recorder.detach()
+    return [json.loads(line) for line in recorder.to_jsonl().splitlines()]
+
+
+class TestReplayRoundTrip:
+    def test_auditor_clean_on_replay(self):
+        records = recorded_forecast_run()
+        cycles = [r for r in records if r["kind"] == "forecast.cycle"]
+        outcomes = [r for r in records if r["kind"] == "forecast.outcome"]
+        assert len(cycles) == 2 and len(outcomes) == 1
+        assert cycles[0]["gangs"][0]["stage"] == STAGE_FEASIBLE_NOW
+        outcome = outcomes[0]
+        assert outcome["gang"] == "default/big"
+        # Joined against the SECOND forecast (stamps replace wholesale).
+        assert outcome["actual_seconds"] == 1.0
+        assert outcome["calibration"]["joined"] == 1
+
+        report = ReplaySession(records).run()
+        assert report.forecast_cycles == 2
+        assert report.forecast_outcomes == 1
+        assert report.drifts == []
+        assert report.ok()
+        assert "1 forecast outcome(s)" in report.render()
+
+    def test_tampered_calibration_is_reported_as_drift(self):
+        records = recorded_forecast_run()
+        tampered = next(r for r in records if r["kind"] == "forecast.outcome")
+        tampered["calibration"]["p50_error_seconds"] += 0.5
+        report = ReplaySession(records).run()
+        drifts = [d for d in report.drifts if d["kind"] == "forecast.outcome"]
+        assert len(drifts) == 1
+        assert drifts[0]["seq"] == tampered["seq"]
+        assert drifts[0]["gang"] == "default/big"
+        assert not report.ok()
+
+
+class TestProfilerRegistration:
+    def test_loop_thread_registers_with_sampling_profiler(self):
+        """/debug/profile can only attribute forecast.* phases if the
+        loop thread announces itself; pin the register/unregister pair."""
+        store = make_store()
+        feasible_cluster(store)
+        forecaster = make_forecaster(store)
+        forecaster.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if "forecast-tpu" in PROFILER.threads().values():
+                    break
+                time.sleep(0.01)
+            assert "forecast-tpu" in PROFILER.threads().values()
+        finally:
+            forecaster.stop()
+        assert "forecast-tpu" not in PROFILER.threads().values()

@@ -128,6 +128,85 @@ def test_sharded_replan_floor_1024_nodes_8_pools():
 
 
 @pytest.mark.slow
+def test_forecast_overhead_within_budget_1024_nodes():
+    """The placement forecaster's acceptance budget: forecasting must add
+    <=2% to the steady-state incremental replan p50 at the 1024x800
+    config. By construction the forecaster owns its OWN planner and its
+    own snapshot maintainer, so the only thing it adds to the control
+    loop is notify_cycle() (stash the batch, wake the thread); the
+    forecast itself runs off-path — here synchronously between replan
+    cycles, where the background thread runs in production. The guard
+    interleaves baseline and forecasted cycles over one churn stream and
+    compares replan p50s."""
+    import gc
+    import statistics
+
+    from bench_planner import build_steady_node, make_steady_cluster, make_steady_pending
+    from nos_tpu.forecast import PlacementForecaster
+    from nos_tpu.partitioning.core import ClusterState
+    from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+    from tests.forecast.helpers import carved_node, gang_pod, make_planner, make_store
+
+    planner = Planner(Framework(filter_plugins=[NodeResourcesFit(), NodeSelectorFit()]))
+    snapshot = make_steady_cluster(1024)
+    pods = make_steady_pending(800)
+    planner.plan(snapshot, pods, dirty=set(snapshot.get_nodes()))  # cold start
+
+    # The forecaster's own world: a small store-backed cluster with a
+    # pending gang queue, the shape every partitioner cycle hands it.
+    store = make_store()
+    for i in range(4):
+        store.create(carved_node(f"fc{i}", free={0: {"2x2": 2}}))
+    queue = [gang_pod(f"q{i}-{k}", gang=f"q{i}", size=2) for i in range(3) for k in range(2)]
+    for pod in queue:
+        store.create(pod)
+    forecaster = PlacementForecaster(
+        store, ClusterState(), make_planner(store), TpuSnapshotTaker()
+    )
+    assert forecaster.engine.planner is not planner  # isolation is structural
+
+    # Interleave baseline and forecasted cycles over the SAME churn
+    # stream: alternating cycles see the same cache state and allocator
+    # pressure, so the medians differ only by what forecasting adds.
+    variant = {}
+    dirty_per_cycle = 51  # 5% of 1024
+    base_samples, fore_samples = [], []
+    for cycle in range(22):
+        dirty = set()
+        for j in range(dirty_per_cycle):
+            name = f"node-{(cycle * dirty_per_cycle + j) % 1024:05d}"
+            variant[name] = not variant.get(name, False)
+            snapshot.refresh_node(name, build_steady_node(name, variant[name]))
+            dirty.add(name)
+        with_forecast = cycle % 2 == 1
+        # Collect outside the timed window so GC triggered by the
+        # off-path forecast's garbage can't land inside a timed replan.
+        gc.collect()
+        started = time.perf_counter()
+        if with_forecast:
+            forecaster.notify_cycle(pods, now=float(cycle))
+        planner.plan(snapshot, pods, dirty=dirty)
+        elapsed = time.perf_counter() - started
+        assert planner.last_plan_mode == "incremental"
+        if cycle >= 2:  # first cycles still fill cross-cycle memos
+            (fore_samples if with_forecast else base_samples).append(elapsed)
+        if with_forecast:
+            forecaster.run_once(now=float(cycle), pending=queue)
+
+    baseline = statistics.median(base_samples)
+    forecasted = statistics.median(fore_samples)
+    assert forecaster.runs >= 5
+
+    assert forecasted <= baseline * 1.02, (
+        f"replan p50 with forecasting {forecasted * 1000:.1f}ms exceeds the "
+        f"2% budget over the baseline {baseline * 1000:.1f}ms — the "
+        f"forecaster has leaked work onto the plan path"
+    )
+    assert not snapshot.forked
+
+
+@pytest.mark.slow
 def test_tracing_overhead_within_allowance():
     """The planner is instrumented (a span per carve trial, suppressed
     plugin spans in simulation). With TRACER.enabled=False those calls are

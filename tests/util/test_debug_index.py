@@ -1,0 +1,117 @@
+"""The /debug index is the registry: every debug handler the server can
+serve appears in the auto-built index, and every indexed endpoint sits
+behind the same bearer gate as /metrics. Lint-style: a new `_serve_*`
+handler that skips `_debug_endpoints()` fails here, not in review."""
+import http.client
+import json
+
+from nos_tpu.util.health import HealthServer
+from nos_tpu.util.profiling import StackProfiler
+
+
+def _get(port, path, token=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode()
+
+
+def _fully_wired(**overrides) -> HealthServer:
+    """A server with EVERY optional debug callback wired, so the registry
+    is at its maximum surface."""
+    kwargs = dict(
+        port=0,
+        metrics_token="s3cret",
+        explain_fn=lambda pod: {"pod": pod},
+        record_fn=lambda: [],
+        capacity_fn=lambda: {"cluster": {}},
+        profiler=StackProfiler(),
+        loops_fn=lambda: {"loops": {}},
+        slo_fn=lambda: {"slos": {}},
+        autoscaler_fn=lambda: {"servings": {}},
+        forecast_fn=lambda refresh: {"refreshed": refresh},
+    )
+    kwargs.update(overrides)
+    return HealthServer(**kwargs)
+
+
+class TestDebugIndexCompleteness:
+    def test_every_serve_handler_is_registered(self):
+        """Lint: each `_serve_*` method on HealthServer must be the
+        handler of some registry entry when all callbacks are wired —
+        an endpoint method outside the registry would ship ungated and
+        unlisted."""
+        server = _fully_wired()
+        registered = {
+            entry["handle"].__func__
+            for entry in server._debug_endpoints().values()
+        }
+        unregistered = [
+            name
+            for name in dir(HealthServer)
+            if name.startswith("_serve_")
+            and getattr(HealthServer, name) not in registered
+        ]
+        assert unregistered == [], (
+            f"debug handlers missing from _debug_endpoints(): {unregistered}"
+        )
+
+    def test_index_lists_exactly_the_registry(self):
+        server = _fully_wired()
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/", "s3cret")
+            assert status == 200
+            index = json.loads(body)["endpoints"]
+            assert set(index) == set(server._debug_endpoints())
+            assert all(desc for desc in index.values())  # one-liners present
+        finally:
+            server.stop()
+
+    def test_every_indexed_endpoint_is_bearer_gated(self):
+        server = _fully_wired()
+        port = server.start()
+        try:
+            for path in server._debug_endpoints():
+                assert _get(port, path)[0] == 401, f"{path} served ungated"
+                assert _get(port, path, "wrong")[0] == 401
+                status, _ = _get(port, path, "s3cret")
+                assert status != 401, f"{path} rejected the valid token"
+            # The index itself is gated too: it reveals the wired surface.
+            assert _get(port, "/debug/")[0] == 401
+        finally:
+            server.stop()
+
+    def test_unwired_endpoints_leave_the_index(self):
+        server = HealthServer(port=0)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/")
+            assert status == 200
+            index = json.loads(body)["endpoints"]
+            # Unconditional surfaces only; nothing indexed 404s.
+            assert set(index) == {"/debug/traces", "/debug/vars"}
+            assert _get(port, "/debug/forecast")[0] == 404
+        finally:
+            server.stop()
+
+
+class TestForecastEndpoint:
+    def test_refresh_query_passes_through(self):
+        seen = []
+
+        def forecast_fn(refresh):
+            seen.append(refresh)
+            return {"refreshed": refresh}
+
+        server = _fully_wired(metrics_token="", forecast_fn=forecast_fn)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/forecast")
+            assert status == 200 and json.loads(body) == {"refreshed": False}
+            status, body = _get(port, "/debug/forecast?refresh=1")
+            assert status == 200 and json.loads(body) == {"refreshed": True}
+            assert seen == [False, True]
+        finally:
+            server.stop()
