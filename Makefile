@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke forecast-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke forecast-smoke soak-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast bench-soak bench-trend examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -71,6 +71,13 @@ shard-smoke:
 forecast-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/forecast -q -m 'not slow'
 
+# Health-timeline gate: detector/store/watchdog unit tier, the teeth
+# tests (deliberate leak/stall/regression each producing an Event plus a
+# bit-exact replayable flight record), and a seconds-long 64-node soak
+# whose verdicts must be byte-identical across two in-process runs.
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/timeline -q -m 'not slow'
+
 # Chaos tier-1 gate: one fixed seed through the full suite under fault
 # injection — must converge, replay clean, and fire a byte-identical
 # fault schedule every run. Plus the committed regression fixtures.
@@ -135,6 +142,21 @@ bench-autoscale:
 # Bit-stable at the pinned seed. See BENCH_forecast.json.
 bench-forecast:
 	JAX_PLATFORMS=cpu $(PY) bench_forecast.py --output BENCH_forecast.json
+
+# Longitudinal health soak: 220 pool-sharded plan cycles at 1024 nodes
+# with the forecaster, the autoscaler decision loop, and the timeline
+# sampler interleaved A/B on a virtual clock. Zero leak/stall findings,
+# sampling overhead within budget, zero replay drift; bit-stable at the
+# pinned seed. See BENCH_soak.json.
+bench-soak:
+	JAX_PLATFORMS=cpu $(PY) bench_soak.py --output BENCH_soak.json
+
+# Committed-benchmark trend gate: diff every BENCH_*.json in the working
+# tree against the previous commit's copy and flag regressions past the
+# per-metric tolerance. Read-only — exits nonzero only on malformed
+# inputs, so CI logs the trend without failing on noisy perf numbers.
+bench-trend:
+	$(PY) tools/bench_trend.py
 
 ## Examples (CPU-simulated slices by default; NOS_EXAMPLE_PLATFORM=tpu
 ## for real chips) -------------------------------------------------------

@@ -1,0 +1,471 @@
+"""Longitudinal soak benchmark: the health timeline watching a real
+steady-state control plane for hundreds of plan cycles.
+
+The soak drives the pool-sharded planning pipeline (per-pool persistent
+planners + cross-pool merge — the same code path the partitioner
+controller runs) at 1024 nodes / 8 pools on a pure virtual clock, with
+the placement forecaster and the model-autoscaler decision function
+riding the same timeline, while a TimelineStore samples every metric
+family, the SizeRegistry, the WedgeWatchdog, and process vitals each
+virtual interval. The acceptance bar:
+
+- every timed cycle takes the incremental path and the merge invariants
+  hold (a regression here is a planner bug, not a bench artifact);
+- ZERO leak/stall findings after the workload drains — the memos, rings
+  and caches the SizeRegistry watches must plateau, and the registered
+  periodic loop must keep beating;
+- sampling overhead stays within 2% of the steady-state replan p50
+  (total sampling time amortized over all plan cycles), guarded by
+  interleaving: odd cycles sample, even cycles do not, and the sampled
+  cycles' replan p50 may not degrade past the budget;
+- the run's flight log replays with zero drift (timeline findings, if
+  any ever fire, recompute bit-exactly from their recorded windows).
+
+Determinism: every report field derives from the seed and the virtual
+clock — wall-clock measurements reduce to booleans before they reach the
+report, so the committed BENCH_soak.json is byte-identical across runs.
+
+  make bench-soak
+  python bench_soak.py --output BENCH_soak.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+from bench_planner import (
+    _ages,
+    _framework,
+    build_steady_node,
+    make_steady_cluster,
+    make_steady_pending,
+    node_name,
+    pool_of,
+    steady_annotations,
+)
+from nos_tpu.api.config import AutoscalerConfig
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.api.v1alpha1.modelserving import ModelServingSpec
+from nos_tpu.capacity.ledger import CapacityLedger
+from nos_tpu.cmd.partitioner import build_sim_framework, register_indexers
+from nos_tpu.controllers.autoscaler import policy
+from nos_tpu.controllers.autoscaler.signals import SignalRegistry
+from nos_tpu.forecast import PlacementForecaster
+from nos_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import ClusterState, Planner
+from nos_tpu.partitioning.core.pools import (
+    check_merge_invariants,
+    merge_pool_states,
+    node_capacities,
+    partition_pools,
+    run_pool_plans,
+    split_pending,
+    split_snapshot,
+)
+from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+from nos_tpu.record import FlightRecorder
+from nos_tpu.record.replay import ReplaySession
+from nos_tpu.scheduler.plugins.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+from nos_tpu.timeline import SIZES, WATCHDOG, DetectorPolicy, TimelineStore, detectors
+
+SEED = 17
+NODES = 1024
+POOLS = 8
+PENDING_PODS = 320
+CYCLES = 220
+CYCLE_S = 0.5       # virtual seconds per plan cycle
+CHURN = 0.02
+OVERHEAD_BUDGET = 0.02
+FORECAST_EVERY = 8  # forecast cadence in cycles (snapshot cost at 1024 nodes)
+STORE_NODES = 64    # store-side cluster the forecaster/ledger observe
+MODEL = "soak-model"
+
+
+def gang_pod(name: str, gang: str, size: int) -> Pod:
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=PodSpec(
+            containers=[
+                Container(requests={constants.tpu_slice_resource("2x2"): 1})
+            ],
+            scheduler_name=constants.SCHEDULER_NAME,
+        ),
+    )
+    pod.metadata.labels[GANG_NAME_LABEL] = gang
+    pod.metadata.labels[GANG_SIZE_LABEL] = str(size)
+    return pod
+
+
+def build_gang_stream(rng: random.Random, cycles: int):
+    """Seeded gang arrivals across the soak: (arrival cycle, size,
+    cycles-until-bind, cycles-until-complete)."""
+    jobs = []
+    cycle = 0
+    i = 0
+    while cycle < cycles - 20:
+        cycle += rng.randint(2, 6)
+        jobs.append(
+            {
+                "name": f"soak-g{i:03d}",
+                "size": rng.choice((1, 1, 2)),
+                "arrive": cycle,
+                "bind_after": rng.randint(2, 5),
+                "run_for": rng.randint(8, 24),
+            }
+        )
+        i += 1
+    return jobs
+
+
+def run_soak(
+    seed: int = SEED,
+    nodes: int = NODES,
+    pools: int = POOLS,
+    pending_pods: int = PENDING_PODS,
+    cycles: int = CYCLES,
+    churn: float = CHURN,
+):
+    """One full soak. Returns (report, flight_records, timeline)."""
+    rng = random.Random(seed)
+
+    # ---- planning side: persistent pool-sharded pipeline ---------------
+    snapshot = make_steady_cluster(nodes, pools=pools)
+    pending = make_steady_pending(pending_pods, pools=pools)
+    ages = _ages(pending)
+    partition = partition_pools(snapshot, pending)
+    pool_snaps = split_snapshot(snapshot, partition)
+    pool_pending = split_pending(pending, partition)
+    planners = {pool: Planner(_framework()) for pool in partition.pools}
+    capacities = node_capacities(pool_snaps.values())
+    for pool in partition.pools:
+        # The memo structures under leak watch — exactly what the
+        # partitioner controller registers in production.
+        SIZES.register(
+            f"planner.{pool}.verdict_cache",
+            lambda p=pool: len(planners[p]._verdict_cache.entries),
+        )
+        SIZES.register(
+            f"planner.{pool}.futility_memo",
+            lambda p=pool: len(planners[p]._futility_cache),
+        )
+
+    # ---- store side: forecaster + ledger + gang workload ---------------
+    store = KubeStore()
+    register_indexers(store)
+    recorder = FlightRecorder(capacity=65536, seed=seed)
+    recorder.attach(store)
+    ledger = CapacityLedger(store, flight_recorder=recorder, metrics=False)
+    from bench_planner import build_node
+
+    for i in range(STORE_NODES):
+        store.create(
+            build_node(
+                f"soak-w{i:03d}", steady_annotations(False), pool=pool_of(i, pools)
+            )
+        )
+    forecaster = PlacementForecaster(
+        store,
+        ClusterState(),
+        Planner(build_sim_framework(store)),
+        TpuSnapshotTaker(),
+        capacity_ledger=ledger,
+        flight_recorder=recorder,
+    )
+
+    # ---- autoscaler decision function on the same virtual clock --------
+    spec = ModelServingSpec(
+        model=MODEL, slice_profile="2x2", min_replicas=1, max_replicas=4
+    )
+    as_cfg = AutoscalerConfig()
+    now_box = [0.0]
+    signals = SignalRegistry(now_fn=lambda: now_box[0])
+    replicas = 1
+    last_transition = 0.0
+    verdict_counts: dict = {}
+    transitions = 0
+
+    # ---- the timeline under test ---------------------------------------
+    timeline = TimelineStore(
+        interval_seconds=CYCLE_S * 2,  # odd cycles sample (A/B interleave)
+        clock=lambda: now_box[0],
+        policy=DetectorPolicy(
+            stall_flat_windows=5,
+            # The flight ring grows monotonically by design until its
+            # deque bound; a "leak" on it is only real past capacity.
+            leak_budgets={"size.record.flight_ring": 65536.0},
+        ),
+    )
+    timeline.attach(flight=recorder)
+    WATCHDOG.register("soak-replan", periodic=True, thread_name="soak-replan")
+
+    jobs = build_gang_stream(rng, cycles)
+    live: list = []
+    variant: dict = {}
+    k = max(1, int(nodes * churn))
+    replan_sampled: list = []    # replan wall seconds, cycles that tick
+    replan_unsampled: list = []  # replan wall seconds, cycles that don't
+    tick_durations: list = []
+    merge_violations = 0
+    incremental_cycles = 0
+    forecast_runs = 0
+    forecast_stages: dict = {}
+    t = 0.0
+
+    # Untimed cold plan: builds every pool's caches at base versions.
+    def cold_task(pool):
+        def task():
+            planners[pool].plan(
+                pool_snaps[pool],
+                pool_pending[pool],
+                dirty=set(pool_snaps[pool].get_nodes()),
+                pending_ages=ages,
+            )
+
+        return task
+
+    run_pool_plans({p: cold_task(p) for p in partition.pools}, "serial")
+
+    for cycle in range(cycles):
+        now_box[0] = t
+        WATCHDOG.beat("soak-replan")
+
+        # Gang workload: arrivals, binds, completions against the store.
+        for job in [j for j in jobs if j["arrive"] == cycle]:
+            job["pods"] = [
+                gang_pod(f"{job['name']}-{p}", job["name"], job["size"])
+                for p in range(job["size"])
+            ]
+            for pod in job["pods"]:
+                store.create(pod)
+            ledger.note_gang_arrival(f"default/{job['name']}", t)
+            live.append(job)
+        for job in [
+            j for j in live
+            if "bound_at" not in j and cycle >= j["arrive"] + j["bind_after"]
+        ]:
+            for idx, pod in enumerate(job["pods"]):
+                pod.spec.node_name = f"soak-w{idx:03d}"
+                store.update(pod)
+            job["bound_at"] = cycle
+            ledger.note_gang_bound(f"default/{job['name']}", t)
+        for job in [
+            j for j in live
+            if "bound_at" in j and cycle >= j["bound_at"] + j["run_for"]
+        ]:
+            for pod in job["pods"]:
+                store.delete("Pod", pod.metadata.name, "default")
+            live.remove(job)
+
+        # Churn + sharded replan (the timed unit).
+        pool_dirty = {pool: set() for pool in partition.pools}
+        for j in range(k):
+            i = (cycle * k + j) % nodes
+            name = node_name(i)
+            variant[name] = not variant.get(name, False)
+            pool = partition.node_pool[name]
+            pool_snaps[pool].refresh_node(
+                name, build_steady_node(name, variant[name], pool=pool_of(i, pools))
+            )
+            pool_dirty[pool].add(name)
+
+        def make_task(pool):
+            def task():
+                current = pool_snaps[pool].partitioning_state()
+                desired = planners[pool].plan(
+                    pool_snaps[pool],
+                    pool_pending[pool],
+                    dirty=pool_dirty[pool],
+                    pending_ages=ages,
+                )
+                return current, desired
+
+            return task
+
+        t0 = time.perf_counter()
+        outcomes = run_pool_plans(
+            {p: make_task(p) for p in partition.pools}, "serial"
+        )
+        pool_current = {p: cur for p, (cur, _) in outcomes.items()}
+        pool_desired = {p: des for p, (_, des) in outcomes.items()}
+        violations = check_merge_invariants(
+            partition, pool_current, pool_desired, capacities=capacities
+        )
+        merge_pool_states(pool_desired)
+        replan_s = time.perf_counter() - t0
+        merge_violations += len(violations)
+        if all(p.last_plan_mode == "incremental" for p in planners.values()):
+            incremental_cycles += 1
+
+        # Forecast the pending gangs on cadence (read-only).
+        pending_gang_pods = [
+            pod for j in live if "bound_at" not in j for pod in j["pods"]
+        ]
+        if cycle % FORECAST_EVERY == 0 and pending_gang_pods:
+            payload = forecaster.run_once(
+                now=t,
+                pending=pending_gang_pods,
+                cycle_seconds=CYCLE_S,
+                reconfig_seconds=2.0,
+            )
+            forecast_runs += 1
+            for gang in payload["gangs"]:
+                forecast_stages[gang["stage"]] = (
+                    forecast_stages.get(gang["stage"], 0) + 1
+                )
+
+        # Autoscaler decision on seeded demand.
+        signals.note_arrival(
+            MODEL, t, queue_depth=rng.choice((0, 1, 2, 4, 8, 16))
+        )
+        decision = policy.decide(
+            spec, replicas, signals.get(MODEL), as_cfg, t,
+            last_transition_t=last_transition,
+        )
+        verdict_counts[decision.verdict] = (
+            verdict_counts.get(decision.verdict, 0) + 1
+        )
+        if decision.desired != replicas:
+            replicas = decision.desired
+            last_transition = t
+            transitions += 1
+
+        # A/B interleave: odd cycles tick the timeline, even cycles do
+        # not — the unsampled cycles are the overhead baseline.
+        if cycle % 2 == 1:
+            t1 = time.perf_counter()
+            timeline.tick(now=t)
+            tick_durations.append(time.perf_counter() - t1)
+            replan_sampled.append(replan_s)
+        else:
+            replan_unsampled.append(replan_s)
+
+        t = round(t + CYCLE_S, 6)
+
+    # Final heal: drain everything still live, then one last tick so the
+    # detectors see the drained steady state.
+    now_box[0] = t
+    for job in live:
+        for pod in job.get("pods", []):
+            if store.try_get("Pod", pod.metadata.name, "default") is not None:
+                store.delete("Pod", pod.metadata.name, "default")
+    WATCHDOG.beat("soak-replan")
+    timeline.tick(now=t)
+    WATCHDOG.unregister("soak-replan")
+
+    recorder.detach()
+    for pool in partition.pools:
+        SIZES.unregister(f"planner.{pool}.verdict_cache")
+        SIZES.unregister(f"planner.{pool}.futility_memo")
+    records = [json.loads(line) for line in recorder.to_jsonl().splitlines()]
+    replay = ReplaySession(records).run()
+
+    findings = timeline.findings_payload()["findings"]
+    leak_stall = [
+        f for f in findings
+        if f["detector"] in (detectors.LEAK, detectors.STALL)
+    ]
+    p50_base = statistics.median(replan_unsampled)
+    p50_sampled = statistics.median(replan_sampled)
+    p50_tick = statistics.median(tick_durations)
+    per_cycle_sampling = sum(tick_durations) / cycles
+    if os.environ.get("NOS_SOAK_DEBUG"):
+        print(
+            f"p50 replan unsampled={p50_base * 1000:.3f}ms "
+            f"sampled={p50_sampled * 1000:.3f}ms "
+            f"tick={p50_tick * 1000:.3f}ms "
+            f"per-cycle sampling={per_cycle_sampling * 1000:.3f}ms",
+            file=sys.stderr,
+        )
+    # Two wall-clock guards, reduced to booleans for bit-stability: the
+    # sampling overhead the soak pays per plan cycle (total tick time
+    # amortized over all cycles — the sampler fires every 2nd cycle)
+    # must stay <= 2% of the steady-state replan p50, and the sampled
+    # cycles' replan p50 must not degrade past the same budget (1ms
+    # floor absorbs timer noise at these magnitudes).
+    sample_within = per_cycle_sampling <= OVERHEAD_BUDGET * p50_base
+    ab_within = (p50_sampled - p50_base) <= max(
+        OVERHEAD_BUDGET * p50_base, 0.001
+    )
+    report = {
+        "workload": {
+            "seed": seed,
+            "nodes": nodes,
+            "pools": pools,
+            "pending_pods": pending_pods,
+            "cycles": cycles,
+            "churn": churn,
+            "gangs": len(jobs),
+            "store_nodes": STORE_NODES,
+        },
+        "planning": {
+            "incremental_cycles": incremental_cycles,
+            "merge_violations": merge_violations,
+        },
+        "autoscaler": {
+            "decisions": cycles,
+            "transitions": transitions,
+            "final_replicas": replicas,
+            "verdicts": dict(sorted(verdict_counts.items())),
+        },
+        "forecast": {
+            "runs": forecast_runs,
+            "stages": dict(sorted(forecast_stages.items())),
+        },
+        "timeline": {
+            "samples": timeline.samples,
+            "findings": findings,
+            "leak_stall_findings": len(leak_stall),
+            "clean_after_final_heal": not leak_stall,
+        },
+        "overhead": {
+            "budget": OVERHEAD_BUDGET,
+            "sample_within_budget": sample_within,
+            "ab_interleave_within_budget": ab_within,
+        },
+        "replay": {
+            "records": len(records),
+            "timeline_findings": replay.timeline_findings,
+            "drifts": len(replay.drifts),
+            "ok": replay.ok(),
+        },
+    }
+    return report, records, timeline
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=SEED)
+    parser.add_argument("--output", default="")
+    args = parser.parse_args()
+    report, _, _ = run_soak(args.seed)
+    text = json.dumps(report, indent=1, sort_keys=True) + "\n"
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(text)
+    print(text, end="")
+    failures = []
+    if report["planning"]["incremental_cycles"] != report["workload"]["cycles"]:
+        failures.append("a replan cycle fell off the incremental path")
+    if report["planning"]["merge_violations"] != 0:
+        failures.append("cross-pool merge invariants violated")
+    if not report["timeline"]["clean_after_final_heal"]:
+        failures.append("leak/stall finding after final heal")
+    if not report["overhead"]["sample_within_budget"]:
+        failures.append("per-cycle sampling overhead exceeds 2% of replan p50")
+    if not report["overhead"]["ab_interleave_within_budget"]:
+        failures.append("sampled cycles' replan p50 degraded past budget")
+    if not report["replay"]["ok"]:
+        failures.append("replay drift")
+    for failure in failures:
+        print(f"FAIL: {failure}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,6 +69,7 @@ EVENT_REASON_SCALED_UP = "ScaledUp"
 EVENT_REASON_SCALED_DOWN = "ScaledDown"
 EVENT_REASON_SCALED_TO_ZERO = "ScaledToZero"
 EVENT_REASON_COLD_START = "ColdStart"
+EVENT_REASON_HEALTH_DEGRADED = "HealthDegraded"
 
 EVENT_REASONS = (
     EVENT_REASON_FAILED_SCHEDULING,
@@ -83,6 +84,7 @@ EVENT_REASONS = (
     EVENT_REASON_SCALED_DOWN,
     EVENT_REASON_SCALED_TO_ZERO,
     EVENT_REASON_COLD_START,
+    EVENT_REASON_HEALTH_DEGRADED,
 )
 
 

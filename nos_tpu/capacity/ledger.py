@@ -335,6 +335,17 @@ class CapacityLedger:
         # would stop accruing chip-seconds without a periodic tick.
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        # Wall-clock seam: everything inside the ledger that reads "now"
+        # for an observation goes through this, so the chaos harness can
+        # skew wall time against the monotonic clock (the clock-skew
+        # fault) without monkeypatching time.time for the whole process.
+        self.wall_clock = time.time
+        # Health-timeline leak watch: gang wait clocks are pruned when a
+        # gang binds or loses its last member — unpruned clocks are the
+        # canonical aging leak this map could grow.
+        from nos_tpu.timeline.sizes import SIZES
+
+        SIZES.register("capacity.gang_clocks", lambda: len(self._gangs))
 
     # ---------------------------------------------------------- heartbeat
 
@@ -346,10 +357,24 @@ class CapacityLedger:
         if self._hb_thread is not None:
             return
         self._hb_stop.clear()
+        from nos_tpu.timeline.watchdog import WATCHDOG
+        from nos_tpu.util.profiling import PROFILER
+
+        WATCHDOG.register(
+            "capacity-heartbeat",
+            periodic=True,
+            thread_name="capacity-heartbeat",
+            counter_fn=lambda: self.observes,
+        )
 
         def loop() -> None:
-            while not self._hb_stop.wait(interval_seconds):
-                self.observe(time.time())
+            PROFILER.register_thread(name="capacity-heartbeat")
+            try:
+                while not self._hb_stop.wait(interval_seconds):
+                    WATCHDOG.beat("capacity-heartbeat")
+                    self.observe(self.wall_clock())
+            finally:
+                PROFILER.unregister_thread()
 
         self._hb_thread = threading.Thread(
             target=loop, name="capacity-heartbeat", daemon=True
@@ -362,6 +387,9 @@ class CapacityLedger:
         self._hb_stop.set()
         self._hb_thread.join(timeout=5.0)
         self._hb_thread = None
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
+        WATCHDOG.unregister("capacity-heartbeat")
 
     # ------------------------------------------------------------ observe
 

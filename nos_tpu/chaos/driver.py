@@ -72,9 +72,16 @@ class ChaosReport:
     fault_counts: Dict[str, int] = field(default_factory=dict)
     fixture_path: str = ""
     records: int = 0
+    # timeline-clean oracle: leak/stall findings evaluated once after the
+    # final heal (see oracles.timeline_clean for why not per burst).
+    timeline_violations: List[str] = field(default_factory=list)
 
     def ok(self) -> bool:
-        return self.replay_ok and all(b.converged for b in self.bursts)
+        return (
+            self.replay_ok
+            and not self.timeline_violations
+            and all(b.converged for b in self.bursts)
+        )
 
     def render(self) -> str:
         lines = [
@@ -94,6 +101,16 @@ class ChaosReport:
             f"  replay: {'clean' if self.replay_ok else 'FAILED'}"
             + (f" — {self.replay_summary}" if self.replay_summary else "")
         )
+        lines.append(
+            "  timeline: "
+            + (
+                "clean"
+                if not self.timeline_violations
+                else f"FAILED ({len(self.timeline_violations)} finding(s))"
+            )
+        )
+        for v in self.timeline_violations[:8]:
+            lines.append(f"    {v}")
         if self.fixture_path:
             lines.append(f"  minimized fixture: {self.fixture_path}")
         return "\n".join(lines)
@@ -196,8 +213,26 @@ class ChaosDriver:
             # reconciles land within the convergence window.
             autoscaler_config=AutoscalerConfig(resync_seconds=0.5),
             flight_recorder=self.recorder,
+            timeline=self._build_timeline(),
         )
         self.store = self.cluster.store
+        from nos_tpu.kube.events import EventRecorder
+        from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+
+        self.timeline.attach(
+            flight=self.recorder,
+            recorder=EventRecorder(self.store, component="chaos-health-timeline"),
+            event_obj=ConfigMap(
+                metadata=ObjectMeta(name="nos-health-timeline", namespace="default")
+            ),
+        )
+        # Clock-skew seam: the ledger's heartbeat observes against the
+        # injector's wall clock, which runs ahead while the fault is
+        # armed and snaps back at heal (observe skips non-positive dt, so
+        # the snap-back stalls integration briefly instead of corrupting
+        # it).
+        if self.cluster.capacity_ledger is not None:
+            self.cluster.capacity_ledger.wall_clock = self.injector.wall_clock
         # Arm the injection seams (both disarmed until a burst sets rates).
         if self.api is not None:
             self.api.set_fault_injector(self.injector)
@@ -214,6 +249,26 @@ class ChaosDriver:
         self._create_modelserving()
         self._start_electors()
         self.cluster.start()
+
+    def _build_timeline(self):
+        """The soak's witness: 0.5s sampling against the 1.0s capacity
+        heartbeat gives the stall detector (5 flat windows = 2.5s) a
+        2.5x margin over the heartbeat period, so a healthy heartbeat
+        can never read as wedged."""
+        from nos_tpu.timeline import DetectorPolicy, TimelineStore
+
+        self.timeline = TimelineStore(
+            interval_seconds=0.5,
+            policy=DetectorPolicy(
+                stall_flat_windows=5,
+                # The flight ring grows monotonically by design until its
+                # deque bound; a "leak" on it is only real past capacity.
+                leak_budgets={
+                    "size.record.flight_ring": float(self.config.recorder_capacity)
+                },
+            ),
+        )
+        return self.timeline
 
     def _create_quota(self) -> None:
         from nos_tpu.api.v1alpha1.elasticquota import (
@@ -271,6 +326,10 @@ class ChaosDriver:
             )
             for identity in ("chaos-elector-a", "chaos-elector-b")
         ]
+        # Clock-skew seam: ONE contender's renew stamps run on the skewed
+        # wall clock — expiry is monotonic-age based, so mutual exclusion
+        # (the monitor below) must survive divergent wall stamps.
+        self.electors[0].wall_clock = self.injector.wall_clock
         self._monitor_stop = threading.Event()
 
         def monitor() -> None:
@@ -311,6 +370,12 @@ class ChaosDriver:
             self._flap_quota()
         elif kind == F.LEADER_FLAP:
             self._flap_leader()
+        elif kind == F.CLOCK_SKEW:
+            self.injector.arm_clock_skew(fault.param)
+            self.injector.record(F.CLOCK_SKEW)
+            log.info(
+                "chaos: wall clock skewed %.1fs ahead of monotonic", fault.param
+            )
 
     def _kill_node(self, name: str) -> None:
         if name in self._dead_nodes:
@@ -544,6 +609,10 @@ class ChaosDriver:
                     burst.index,
                     "converged" if result.converged else "FAILED",
                 )
+            # After the final heal: one last timeline sample, then the
+            # timeline-clean oracle over the whole run's findings.
+            self.timeline.tick()
+            report.timeline_violations = oracles.timeline_clean(self.timeline)
         finally:
             self._monitor_stop.set()
             for elector in self.electors:

@@ -25,6 +25,7 @@ AGENT_RESTART = "agent-restart"    # kill tpuagent between apply and report
 CONFLICT_WRITES = "conflict-writes"  # stale-rv ConflictError on store writes
 QUOTA_FLAP = "quota-flap"          # ElasticQuota min collapses, then restores
 LEADER_FLAP = "leader-flap"        # leader drops the lease mid-burst
+CLOCK_SKEW = "clock-skew"          # wall clock runs ahead of monotonic
 # Apiserver-backend only (the memory store has no HTTP surface):
 WATCH_SEVER = "watch-sever"        # cut a watch stream mid-chunk
 API_ERRORS = "api-errors"          # 503 bursts on API verbs
@@ -38,6 +39,7 @@ ALL_KINDS = (
     CONFLICT_WRITES,
     QUOTA_FLAP,
     LEADER_FLAP,
+    CLOCK_SKEW,
 ) + _HTTP_KINDS
 
 
@@ -89,6 +91,12 @@ def build_schedule(
                 fault.param = rng.choice([0.02, 0.05])
             if kind == WATCH_SEVER:
                 fault.param = rng.randint(1, 3)  # streams to cut
+            if kind == CLOCK_SKEW:
+                # Seconds the wall clock jumps ahead. Small on purpose:
+                # heal snaps wall time BACK, and integrators that skip
+                # non-positive dt stall until true time catches up — the
+                # dead zone must fit inside the convergence window.
+                fault.param = rng.choice([0.5, 1.0, 2.0])
             burst.faults.append(fault)
         burst.faults.sort(key=lambda f: (f.at, f.kind))
         for p in range(rng.randint(2, 4)):
@@ -123,6 +131,7 @@ class FaultInjector:
         self._error_every = 0
         self._latency_s = 0.0
         self._sever_budget = 0
+        self._skew_s = 0.0
         self._writes = 0
         self._requests = 0
         self.counts: Dict[str, int] = {}
@@ -145,12 +154,30 @@ class FaultInjector:
         with self._lock:
             self._sever_budget += int(budget)
 
+    def arm_clock_skew(self, seconds: float) -> None:
+        with self._lock:
+            self._skew_s = float(seconds)
+
+    def skew_seconds(self) -> float:
+        with self._lock:
+            return self._skew_s
+
+    def wall_clock(self) -> float:
+        """``time.time`` plus the armed skew: components wired to this
+        seam (the capacity ledger's heartbeat, lease renew stamps) see a
+        wall clock that runs ahead of monotonic while armed, and snaps
+        back at heal — monotonic-age logic must shrug both jumps off."""
+        import time
+
+        return time.time() + self.skew_seconds()
+
     def clear(self) -> None:
         with self._lock:
             self._conflict_every = 0
             self._error_every = 0
             self._latency_s = 0.0
             self._sever_budget = 0
+            self._skew_s = 0.0
 
     def suspended(self):
         """Context manager: the calling thread's store writes bypass

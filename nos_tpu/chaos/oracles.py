@@ -22,6 +22,7 @@ REPLAY_CLEAN = "replay-clean"
 LEDGER_CONSISTENT = "ledger-consistent"
 AUTOSCALER_SETTLED = "autoscaler-settled"
 FORECAST_CALIBRATED = "forecast-calibrated"
+TIMELINE_CLEAN = "timeline-clean"
 
 
 def pending_settled(store, scheduler_name: str = "") -> List[str]:
@@ -224,6 +225,31 @@ def autoscaler_settled(store, autoscaler) -> List[str]:
                 f"{ms.status.desired_replicas} disagrees with the settled "
                 f"verdict {decision.desired}"
             )
+    return out
+
+
+def timeline_clean(timeline) -> List[str]:
+    """No leak or stall finding on the longitudinal health timeline
+    (live-only: needs the TimelineStore). Regression findings are
+    advisory under chaos — fault bursts legitimately slow replans — but a
+    leak that kept growing or a loop that wedged is a real defect
+    whatever the faults did. The driver evaluates this once, after the
+    final heal: findings are cumulative (hysteresis only gates
+    re-arming), so polling it per burst would deny convergence forever
+    on the first transient."""
+    from nos_tpu.timeline import detectors
+
+    if timeline is None:
+        return []
+    out: List[str] = []
+    for finding in timeline.findings():
+        detector = finding.get("detector")
+        if detector not in (detectors.LEAK, detectors.STALL):
+            continue
+        out.append(
+            f"{TIMELINE_CLEAN}: {detector} on series "
+            f"{finding.get('series')!r}: {finding.get('verdict')}"
+        )
     return out
 
 

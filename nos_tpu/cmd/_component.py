@@ -107,6 +107,22 @@ def run_component(
             except OSError:
                 return None
     metrics_port = manager_cfg.get("metricsLoopbackPort")
+    # Always-on longitudinal health timeline: samples this component's
+    # metric families, process vitals, and registered memo/ring sizes;
+    # detector findings Event against a well-known ConfigMap identity.
+    from nos_tpu.kube.events import EventRecorder
+    from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+    from nos_tpu.timeline import TimelineStore
+
+    timeline = TimelineStore(
+        interval_seconds=float(manager_cfg.get("timelineSampleSeconds", 5.0))
+    )
+    timeline.attach(
+        recorder=EventRecorder(store, component=f"nos-{name}-health-timeline"),
+        event_obj=ConfigMap(
+            metadata=ObjectMeta(name="nos-health-timeline", namespace="default")
+        ),
+    )
     health = HealthServer(
         port=port,
         ready_check=ready_check,
@@ -123,12 +139,14 @@ def run_component(
             if getattr(component, "forecaster", None) is not None
             else None
         ),
+        timeline_fn=lambda window: timeline.debug_payload(window_seconds=window),
     )
     bound = health.start()
     logging.info("%s: health/metrics on 127.0.0.1:%d", name, bound)
     # Always-on control-plane sampling (registered threads only; runtime
     # on/off via /debug/profile?action=).
     PROFILER.start()
+    timeline.start()
 
     stop = stop_event or threading.Event()
     if stop_event is None:
@@ -168,6 +186,7 @@ def run_component(
     finally:
         if elector is not None:
             elector.stop()
+        timeline.stop()
         manager.stop()
         PROFILER.stop()
         health.stop()

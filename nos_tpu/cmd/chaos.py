@@ -56,6 +56,14 @@ def _parse(argv):
         metavar="N",
         help="run seeds [--seed, --seed+N) and aggregate",
     )
+    parser.add_argument(
+        "--soak",
+        action="store_true",
+        help="minutes-per-seed soak preset: more nodes, more and longer "
+        "bursts, and a longer convergence deadline — the timeline-clean "
+        "oracle (no leak/stall finding after the final heal) gets enough "
+        "samples to mean something",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     return parser.parse_args(argv)
 
@@ -72,6 +80,17 @@ def _run_one(args, seed: int) -> int:
         fixtures_dir=args.fixtures_dir,
         export_path=args.record,
     )
+    if args.soak:
+        # Preset beats the per-flag defaults but not explicit overrides
+        # (argparse defaults compare equal only when the flag was unset).
+        if args.bursts == 3:
+            config.bursts = 12
+        if args.nodes == 3:
+            config.nodes = 8
+        if args.burst_seconds == 2.0:
+            config.burst_s = 5.0
+        if args.timeout == 30.0:
+            config.convergence_timeout_s = 60.0
     report = ChaosDriver(config).run()
     print(report.render())
     return 0 if report.ok() else 1

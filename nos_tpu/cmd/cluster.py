@@ -49,6 +49,9 @@ class SimCluster:
     scheduler: Scheduler
     kubelet: Optional[SimKubelet] = None
     capacity_ledger: Optional[CapacityLedger] = None
+    # Optional longitudinal health timeline (nos_tpu/timeline/): started
+    # and stopped with the cluster so its sampler sees the whole run.
+    timeline: Optional[object] = None
     # Set when built with autoscaler_config: the ModelServingReconciler
     # (signals registry at .signals, /debug payload at .debug_payload).
     autoscaler: Optional[object] = None
@@ -148,8 +151,12 @@ class SimCluster:
         if self.capacity_ledger is not None:
             # Sim timescale: cycles are sub-second, so tick accordingly.
             self.capacity_ledger.start_heartbeat(interval_seconds=1.0)
+        if self.timeline is not None:
+            self.timeline.start()
 
     def stop(self) -> None:
+        if self.timeline is not None:
+            self.timeline.stop()
         if self.capacity_ledger is not None:
             self.capacity_ledger.stop_heartbeat()
         self.manager.stop()
@@ -168,6 +175,7 @@ def build_cluster(
     device_backend: str = "sim",
     tpuctl_dir: str = "",
     flight_recorder=None,
+    timeline=None,
 ) -> SimCluster:
     store = store or KubeStore()
     manager = Manager(store=store)
@@ -260,6 +268,7 @@ def build_cluster(
         kubelet=kubelet,
         capacity_ledger=ledger,
         autoscaler=autoscaler,
+        timeline=timeline,
         device_backend=device_backend,
         tpuctl_dir=tpuctl_dir,
         device_plugin_config_map=partitioner_config.device_plugin_config_map,

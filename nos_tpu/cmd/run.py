@@ -216,6 +216,17 @@ def main(argv=None) -> int:
         from nos_tpu.record import FlightRecorder
 
         flight_recorder = FlightRecorder()
+    # Always-on health timeline: samples every metric family plus process
+    # vitals and registered memo/ring sizes; findings become HealthDegraded
+    # Events on a well-known ConfigMap identity.
+    from nos_tpu.kube.objects import ConfigMap
+    from nos_tpu.timeline import TimelineStore
+
+    timeline = TimelineStore(
+        interval_seconds=(config.get("manager") or {}).get(
+            "timelineSampleSeconds", 5.0
+        )
+    )
     cluster = build_cluster(
         partitioner_config=partitioner_cfg,
         scheduler_config=scheduler_cfg,
@@ -223,6 +234,16 @@ def main(argv=None) -> int:
         device_backend=config.get("deviceBackend", "sim"),
         tpuctl_dir=config.get("tpuctlDir", "/tmp/nos-tpu"),
         flight_recorder=flight_recorder,
+        timeline=timeline,
+    )
+    from nos_tpu.kube.events import EventRecorder
+
+    timeline.attach(
+        flight=flight_recorder,
+        recorder=EventRecorder(cluster.store, component="nos-health-timeline"),
+        event_obj=ConfigMap(
+            metadata=ObjectMeta(name="nos-health-timeline", namespace="default")
+        ),
     )
     if flight_recorder is not None:
         # Attach BEFORE seeding: node/pod creation deltas are replay inputs.
@@ -259,11 +280,12 @@ def main(argv=None) -> int:
         forecast_fn=cluster.partitioner.forecaster.debug_payload
         if getattr(cluster.partitioner, "forecaster", None) is not None
         else None,
+        timeline_fn=lambda window: timeline.debug_payload(window_seconds=window),
     )
     bound = health.start()
     logging.info(
         "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics /debug/explain"
-        " /debug/capacity /debug/profile /debug/loops%s%s%s)",
+        " /debug/capacity /debug/profile /debug/loops /debug/timeline%s%s%s)",
         bound,
         " /debug/autoscaler" if cluster.autoscaler is not None else "",
         " /debug/record" if flight_recorder is not None else "",

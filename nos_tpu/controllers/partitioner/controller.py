@@ -21,6 +21,8 @@ from nos_tpu.api.v1alpha1.labels import kind_matches
 from nos_tpu.kube.controller import Request, Result
 from nos_tpu.kube.objects import Pod
 from nos_tpu.kube.store import KubeStore
+from nos_tpu.timeline.sizes import SIZES
+from nos_tpu.timeline.watchdog import WATCHDOG
 from nos_tpu.partitioning.core import (
     Actuator,
     ClusterState,
@@ -290,6 +292,26 @@ class PartitionerController:
     def start(self) -> None:
         self.batcher.start()
         LOOPS.register(f"partitioner-{self.kind}", self._loop_stats)
+        # Event-driven loop (batch windows only open when work arrives),
+        # so periodic=False: idleness is legal and the watchdog only
+        # stall-checks it when a harness arms it explicitly. The memo
+        # structures register for the leak detector — they are pruned by
+        # version key every cycle, and retention past pruning is exactly
+        # the cross-cycle aging bug ROADMAP item 5 names.
+        WATCHDOG.register(
+            f"partitioner-{self.kind}",
+            periodic=False,
+            thread_name=f"partitioner-{self.kind}",
+            counter_fn=lambda: self.plans_applied,
+        )
+        SIZES.register(
+            f"planner.{self.kind}.verdict_cache",
+            lambda: len(self.planner._verdict_cache.entries),
+        )
+        SIZES.register(
+            f"planner.{self.kind}.futility_memo",
+            lambda: len(self.planner._futility_cache),
+        )
         self._thread = threading.Thread(
             target=self._batch_loop, name=f"partitioner-{self.kind}", daemon=True
         )
@@ -299,6 +321,9 @@ class PartitionerController:
         self._stop.set()
         self.batcher.stop()
         LOOPS.unregister(f"partitioner-{self.kind}")
+        WATCHDOG.unregister(f"partitioner-{self.kind}")
+        SIZES.unregister(f"planner.{self.kind}.verdict_cache")
+        SIZES.unregister(f"planner.{self.kind}.futility_memo")
         if self._thread:
             self._thread.join(timeout=2.0)
 
@@ -320,6 +345,7 @@ class PartitionerController:
             t0 = time.monotonic()
             batch = self.batcher.ready(timeout=0.2)
             t1 = time.monotonic()
+            WATCHDOG.beat(f"partitioner-{self.kind}")
             if batch is None:
                 self._busy.record(0.0, idle_s=t1 - t0)
                 continue

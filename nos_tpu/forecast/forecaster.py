@@ -116,6 +116,16 @@ class PlacementForecaster:
         if self._thread is not None:
             return
         self._stop.clear()
+        # Event-driven (woken by plan-cycle notifies), so periodic=False:
+        # a quiet cluster legitimately never forecasts.
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
+        WATCHDOG.register(
+            f"forecast-{self.kind}",
+            periodic=False,
+            thread_name=f"forecast-{self.kind}",
+            counter_fn=lambda: self.runs,
+        )
         self._thread = threading.Thread(
             target=self._loop, name=f"forecast-{self.kind}", daemon=True
         )
@@ -128,13 +138,19 @@ class PlacementForecaster:
         self._wake.set()
         self._thread.join(timeout=5.0)
         self._thread = None
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
+        WATCHDOG.unregister(f"forecast-{self.kind}")
 
     def _loop(self) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
         PROFILER.register_thread(name=f"forecast-{self.kind}")
         try:
             while True:
                 self._wake.wait()
                 self._wake.clear()
+                WATCHDOG.beat(f"forecast-{self.kind}")
                 if self._stop.is_set():
                     return
                 # Throttle: a notify storm (every plan cycle under a

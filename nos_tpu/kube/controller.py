@@ -176,10 +176,13 @@ class Controller:
                 self.queue.add(req)
 
     def _pump(self) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
         assert self._event_queue is not None
         PROFILER.register_thread()
         try:
             while not self._stop.is_set():
+                WATCHDOG.beat(f"{self.name}-pump")
                 try:
                     event = self._event_queue.get(timeout=0.2)
                 except queue.Empty:
@@ -196,9 +199,12 @@ class Controller:
     # -- worker ---------------------------------------------------------
 
     def _work(self) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
         PROFILER.register_thread()
         try:
             while not self._stop.is_set():
+                WATCHDOG.beat(f"{self.name}-work")
                 t0 = time.monotonic()
                 req = self.queue.get(timeout=0.2)
                 t1 = time.monotonic()
@@ -223,18 +229,31 @@ class Controller:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
         kinds = {w.kind for w in self.watches}
         self._event_queue = self.store.watch(kinds, name=self.name)
         LOOPS.register(self.name, self._loop_stats)
         for target, label in ((self._pump, "pump"), (self._work, "work")):
+            # Both loops poll with a timeout, so they beat continuously —
+            # but they only *do* work on events, hence periodic=False.
+            WATCHDOG.register(
+                f"{self.name}-{label}",
+                periodic=False,
+                thread_name=f"{self.name}-{label}",
+            )
             t = threading.Thread(target=target, name=f"{self.name}-{label}", daemon=True)
             t.start()
             self._threads.append(t)
 
     def stop(self) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
         self._stop.set()
         self.queue.shut_down()
         LOOPS.unregister(self.name)
+        for label in ("pump", "work"):
+            WATCHDOG.unregister(f"{self.name}-{label}")
         if self._event_queue is not None:
             self.store.stop_watch(self._event_queue)
         for t in self._threads:

@@ -63,6 +63,11 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = False
+        # Injectable wall clock: the chaos clock-skew fault points this at
+        # a skewed source so RENEW_ANNOTATION stamps diverge from true
+        # wall time — expiry must keep working (it only reads local
+        # monotonic ages, never remote wall stamps).
+        self.wall_clock = time.time
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Serializes a renew attempt (+ the is_leader transition it drives)
@@ -96,7 +101,7 @@ class LeaderElector:
             cm.metadata.annotations[HOLDER_ANNOTATION] = self.identity
             # Wall time is informational (humans, kubectl); expiry never
             # compares it across machines.
-            cm.metadata.annotations[RENEW_ANNOTATION] = str(time.time())
+            cm.metadata.annotations[RENEW_ANNOTATION] = str(self.wall_clock())
 
         try:
             self.store.patch_merge("ConfigMap", self.name, self.namespace, mutate)
@@ -116,7 +121,7 @@ class LeaderElector:
                         namespace=self.namespace,
                         annotations={
                             HOLDER_ANNOTATION: self.identity,
-                            RENEW_ANNOTATION: str(time.time()),
+                            RENEW_ANNOTATION: str(self.wall_clock()),
                         },
                     )
                 )

@@ -143,6 +143,17 @@ class KubeStore:
         # conflicts and apiserver write failures. None on every production
         # path — one attribute read of cost.
         self.fault_injector: Optional[Any] = None
+        # Health-timeline leak watch: watch queues are unbounded by
+        # design (a slow consumer only warns) — the timeline's leak
+        # detector over this aggregate depth turns "warned about once"
+        # into "failed the soak". Replace-by-name keeps the newest store
+        # current (tests build many).
+        from nos_tpu.timeline.sizes import SIZES
+
+        SIZES.register(
+            "kube.watch_queue_events",
+            lambda: sum(w.queue.qsize() for w in list(self._watchers)),
+        )
 
     def register_admission(self, kind: str, fn: Callable[[Any, "KubeStore"], None]) -> None:
         self._admission.setdefault(kind, []).append(fn)

@@ -54,8 +54,14 @@ def load_jsonl(path: str) -> List[dict]:
 
 class FlightRecorder:
     def __init__(self, capacity: int = 4096, seed: int = 0) -> None:
+        from nos_tpu.timeline.sizes import SIZES
+
         self.capacity = capacity
         self._ring: "deque[dict]" = deque(maxlen=capacity)
+        # Health-timeline leak watch: the ring is deque-bounded, so its
+        # size.* series plateaus at capacity — growth past that means the
+        # bound broke. Replace-by-name keeps the newest recorder current.
+        SIZES.register("record.flight_ring", lambda: len(self._ring))
         self._seq = itertools.count(1)
         self._lock = threading.Lock()
         self._store = None
@@ -337,4 +343,32 @@ class FlightRecorder:
             actual_seconds=actual_seconds,
             wait_seconds=wait_seconds,
             calibration=calibration,
+        )
+
+    def record_timeline_finding(
+        self,
+        *,
+        t: float,
+        detector: str,
+        series: str,
+        window: List[List[float]],
+        params: dict,
+        verdict: dict,
+        stacks: Optional[List[str]] = None,
+    ) -> None:
+        """One new health-timeline detector finding, carrying the exact
+        detector inputs (the sample window and parameters) next to the
+        verdict so replay can re-run the pure detector over them and
+        compare the recomputed verdict bit-exactly. ``stacks`` are the
+        wedged thread's profiler stacks — operator context, excluded
+        from the bit-exact comparison."""
+        self._append(
+            "timeline.finding",
+            t=t,
+            detector=detector,
+            series=series,
+            window=window,
+            params=params,
+            verdict=verdict,
+            stacks=stacks or [],
         )

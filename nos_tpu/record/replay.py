@@ -41,6 +41,7 @@ class ReplayReport:
     capacity_observes: int = 0
     forecast_cycles: int = 0
     forecast_outcomes: int = 0
+    timeline_findings: int = 0
     drifts: List[dict] = field(default_factory=list)
     violations: List[dict] = field(default_factory=list)
     skips: List[dict] = field(default_factory=list)
@@ -52,7 +53,8 @@ class ReplayReport:
         lines = [
             f"replayed {self.cycles} scheduler cycle(s), {self.plans} plan(s), "
             f"{self.capacity_observes} capacity observe(s), "
-            f"{self.forecast_outcomes} forecast outcome(s): "
+            f"{self.forecast_outcomes} forecast outcome(s), "
+            f"{self.timeline_findings} timeline finding(s): "
             f"{len(self.drifts)} drift(s), {len(self.violations)} audit "
             f"violation(s), {len(self.skips)} skip(s)"
         ]
@@ -114,6 +116,13 @@ class ReplaySession:
                 for r in records
                 if r.get("kind") in ("forecast.cycle", "forecast.outcome")
             ),
+            key=lambda r: r["seq"],
+        )
+        # Timeline findings carry their own detector inputs (window +
+        # params), so they replay standalone: re-run the pure detector
+        # over the recorded window and demand the identical verdict.
+        self.timeline_records = sorted(
+            (r for r in records if r.get("kind") == "timeline.finding"),
             key=lambda r: r["seq"],
         )
         framework, capacity, gang = new_framework(
@@ -182,7 +191,38 @@ class ReplaySession:
             else:
                 self._replay_plan(record, report)
         self._replay_forecasts(report)
+        self._replay_timeline(report)
         return report
+
+    def _replay_timeline(self, report: ReplayReport) -> None:
+        """Health-verdict audit: every ``timeline.finding`` recorded the
+        exact window and parameters its detector saw, and the detectors
+        are pure functions of those inputs — re-running one must land on
+        the recorded verdict bit-for-bit (floats JSON-round-trip
+        exactly). A mismatch means the detector code drifted from what
+        produced the recording, or the recording was tampered with."""
+        from nos_tpu.timeline.detectors import run_detector
+
+        for record in self.timeline_records:
+            report.timeline_findings += 1
+            got = run_detector(
+                record["detector"],
+                record.get("window", []),
+                record.get("params", {}),
+            )
+            want = record.get("verdict")
+            if got != want:
+                report.drifts.append(
+                    {
+                        "seq": record["seq"],
+                        "kind": "timeline.finding",
+                        "series": record.get("series", ""),
+                        "detail": (
+                            f"recorded verdict {want} but replay "
+                            f"recomputed {got}"
+                        ),
+                    }
+                )
 
     def _replay_forecasts(self, report: ReplayReport) -> None:
         """Forecast-accuracy audit: re-feed the recorded outcome joins

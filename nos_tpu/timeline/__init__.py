@@ -1,0 +1,34 @@
+"""Longitudinal health timeline: in-process time-series sampling of the
+metric registry, process vitals, and structure sizes into a bounded
+delta-encoded ring, with pure leak/stall/regression detectors and a
+wedge watchdog over registered controller loops on top."""
+from nos_tpu.timeline import detectors
+from nos_tpu.timeline.detectors import (
+    LEAK,
+    REGRESSION,
+    STALL,
+    detect_leak,
+    detect_regression,
+    detect_stall,
+    run_detector,
+)
+from nos_tpu.timeline.sizes import SIZES, SizeRegistry
+from nos_tpu.timeline.store import DetectorPolicy, TimelineStore
+from nos_tpu.timeline.watchdog import WATCHDOG, WedgeWatchdog
+
+__all__ = [
+    "detectors",
+    "LEAK",
+    "REGRESSION",
+    "STALL",
+    "detect_leak",
+    "detect_regression",
+    "detect_stall",
+    "run_detector",
+    "SIZES",
+    "SizeRegistry",
+    "DetectorPolicy",
+    "TimelineStore",
+    "WATCHDOG",
+    "WedgeWatchdog",
+]

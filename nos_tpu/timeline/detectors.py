@@ -1,0 +1,170 @@
+"""Pure detector functions over timeline sample windows.
+
+Each detector takes an explicit window of ``(timestamp, value)`` points
+for one series and returns either ``None`` (healthy) or a
+JSON-serializable verdict dict. No clocks, no globals, no randomness:
+given the same window the same verdict comes back bit-for-bit, which is
+what lets flight-recorder replay recompute every ``timeline.finding``
+and diff it against the recorded one (the ``record_forecast_outcome``
+shadow-recompute idiom, applied to health verdicts).
+
+Detector families (ROADMAP item 5's aging failure modes):
+
+- **stall** — a counter a loop is contractually bumping (heartbeat
+  observes, sampler ticks, plan cycles under load) goes flat for N
+  consecutive samples while the loop claims to be alive.
+- **leak** — a gauge or ``size.*`` series shows robust monotonic growth
+  past a budget. The slope is a Theil–Sen fit (median of pairwise
+  slopes), so a single reset or spike cannot fake or hide a leak.
+- **regression** — the recent median of a sampled p95 series rises past
+  ``ratio`` × its baseline-window median. Hysteresis (not re-firing
+  while a finding is active, clearing only after quiet samples) lives
+  in the engine, keeping these functions stateless.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+Point = Tuple[float, float]
+
+STALL = "stall"
+LEAK = "leak"
+REGRESSION = "regression"
+
+DEFAULT_STALL_WINDOWS = 5
+DEFAULT_LEAK_BUDGET = 256.0
+DEFAULT_LEAK_MIN_POINTS = 8
+DEFAULT_LEAK_MONOTONIC_FRACTION = 0.9
+DEFAULT_REGRESSION_RATIO = 1.5
+DEFAULT_REGRESSION_MIN_POINTS = 8
+
+
+def median(values: Sequence[float]) -> float:
+    ordered = sorted(values)
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return ordered[mid]
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def theil_sen_slope(points: Sequence[Point]) -> float:
+    """Median of all pairwise slopes — the robust trend estimator.
+    Pairs with zero time delta are skipped; fewer than two usable pairs
+    fit a slope of 0.0."""
+    slopes: List[float] = []
+    for i in range(len(points)):
+        for j in range(i + 1, len(points)):
+            dt = points[j][0] - points[i][0]
+            if dt > 0:
+                slopes.append((points[j][1] - points[i][1]) / dt)
+    if not slopes:
+        return 0.0
+    return median(slopes)
+
+
+def detect_stall(
+    points: Sequence[Point],
+    *,
+    flat_windows: int = DEFAULT_STALL_WINDOWS,
+) -> Optional[dict]:
+    """Wedged-loop verdict: the counter did not move across the last
+    ``flat_windows`` sample intervals, despite having moved before (a
+    loop that never ran at all is a wiring problem, not a wedge — the
+    caller's registration contract covers that)."""
+    if len(points) < flat_windows + 1:
+        return None
+    tail = points[-(flat_windows + 1):]
+    if any(b[1] > a[1] for a, b in zip(tail, tail[1:])):
+        return None
+    if tail[-1][1] <= 0:
+        return None
+    return {
+        "detector": STALL,
+        "flat_windows": flat_windows,
+        "flat_since": tail[0][0],
+        "last_value": tail[-1][1],
+    }
+
+
+def detect_leak(
+    points: Sequence[Point],
+    *,
+    budget: float = DEFAULT_LEAK_BUDGET,
+    min_points: int = DEFAULT_LEAK_MIN_POINTS,
+    monotonic_fraction: float = DEFAULT_LEAK_MONOTONIC_FRACTION,
+) -> Optional[dict]:
+    """Monotonic-growth verdict: total growth across the window exceeds
+    ``budget``, the Theil–Sen slope is positive, and at least
+    ``monotonic_fraction`` of the consecutive steps are non-decreasing
+    (a bounded ring filling up plateaus and stops matching; a churning
+    cache dips and stops matching; a leak keeps climbing)."""
+    if len(points) < min_points:
+        return None
+    growth = points[-1][1] - points[0][1]
+    if growth <= budget:
+        return None
+    steps = [b[1] - a[1] for a, b in zip(points, points[1:])]
+    rising = sum(1 for s in steps if s >= 0)
+    if rising < monotonic_fraction * len(steps):
+        return None
+    slope = theil_sen_slope(points)
+    if slope <= 0:
+        return None
+    return {
+        "detector": LEAK,
+        "growth": growth,
+        "budget": budget,
+        "slope_per_second": slope,
+        "window_seconds": points[-1][0] - points[0][0],
+    }
+
+
+def detect_regression(
+    points: Sequence[Point],
+    *,
+    baseline_points: int = DEFAULT_REGRESSION_MIN_POINTS,
+    recent_points: int = DEFAULT_REGRESSION_MIN_POINTS,
+    ratio: float = DEFAULT_REGRESSION_RATIO,
+    abs_floor: float = 0.0,
+) -> Optional[dict]:
+    """Windowed-percentile regression: median of the last
+    ``recent_points`` samples vs. the median of the first
+    ``baseline_points`` samples of the series (the warm-up window is the
+    baseline). ``abs_floor`` suppresses verdicts on microscopic
+    baselines where the ratio is all noise."""
+    if len(points) < baseline_points + recent_points:
+        return None
+    baseline = median([v for _, v in points[:baseline_points]])
+    recent = median([v for _, v in points[-recent_points:]])
+    if baseline <= 0:
+        return None
+    if recent <= baseline * ratio or recent - baseline <= abs_floor:
+        return None
+    return {
+        "detector": REGRESSION,
+        "baseline": baseline,
+        "recent": recent,
+        "ratio": recent / baseline,
+        "threshold_ratio": ratio,
+    }
+
+
+def run_detector(
+    detector: str,
+    points: Sequence[Point],
+    params: dict,
+    *,
+    normalized: bool = False,
+) -> Optional[dict]:
+    """Dispatch used by both the live engine and flight-recorder replay —
+    one entry point guarantees both sides run the identical code path.
+
+    ``normalized=True`` skips the float coercion for callers that already
+    guarantee ``(float, float)`` tuples (the live engine's sample cache
+    stores them that way); replay hands in JSON lists and must leave it
+    False so verdict equality is about values, never container types.
+    """
+    fns = {STALL: detect_stall, LEAK: detect_leak, REGRESSION: detect_regression}
+    if not normalized:
+        points = [(float(t), float(v)) for t, v in points]
+    return fns[detector](points, **params)

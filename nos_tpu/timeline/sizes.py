@@ -1,0 +1,53 @@
+"""SizeRegistry: one place every bounded-but-growable structure reports
+its current cardinality.
+
+Planner memos, verdict caches, the TraceStore ring, the FlightRecorder
+ring, watch queues, grace reservations — anything whose unbounded growth
+would be a leak — registers a zero-argument size callback under a stable
+name. The TimelineStore samples the registry every tick into ``size.*``
+series, which is what the leak detector watches.
+
+Registration is replace-by-name: constructing a second TraceStore (tests
+do this constantly) re-points the name at the live instance instead of
+accumulating dead callbacks. Callbacks that raise are skipped for that
+sample rather than killing the sampler.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+
+class SizeRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._sources: Dict[str, Callable[[], int]] = {}
+
+    def register(self, name: str, size_fn: Callable[[], int]) -> None:
+        """Register (or re-point) the size callback for ``name``."""
+        with self._lock:
+            self._sources[name] = size_fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._sources)
+
+    def sizes(self) -> Dict[str, float]:
+        """Current size per registered name; erroring callbacks skipped."""
+        with self._lock:
+            sources = dict(self._sources)
+        out: Dict[str, float] = {}
+        for name in sorted(sources):
+            try:
+                out[name] = float(sources[name]())
+            except Exception:
+                continue
+        return out
+
+
+# Process-wide registry (the REGISTRY/TRACER/PROFILER analogue).
+SIZES = SizeRegistry()

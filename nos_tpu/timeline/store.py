@@ -1,0 +1,606 @@
+"""TimelineStore: the longitudinal health timeline.
+
+Every observability layer in the suite answers "what is happening now";
+this one answers "what has been drifting for the last 500 cycles". At a
+configurable interval the store samples three collectors —
+
+- the full metric registry snapshot (counters, gauges, histogram
+  count/sum/percentiles, exactly the ``/debug/vars`` shape),
+- process vitals (RSS from ``/proc/self/statm``, live thread count),
+- the ``SizeRegistry`` (``size.*`` series) and ``WedgeWatchdog`` loop
+  counters (``loop.*`` series)
+
+— into a bounded, delta-encoded ring: each entry stores only the series
+that changed since the previous sample, and evicted entries fold into a
+base frame, so a steady-state process costs near-zero bytes per tick
+while full per-sample values remain reconstructible for every retained
+sample. The ring exports as JSONL, serves windowed rollups and
+sparkline arrays on the bearer-gated ``/debug/timeline``, and feeds the
+pure detectors in ``detectors.py``.
+
+Detector verdicts are engine-stateful only for hysteresis (an active
+finding does not re-fire every tick; it clears after ``clear_samples``
+clean checks). Every NEW finding emits three ways at once:
+``nos_tpu_timeline_findings_total{detector,series}``, a
+``HealthDegraded`` Event through the EventRecorder, and a
+``timeline.finding`` flight record carrying the exact detector inputs
+(window + params) so replay recomputes the verdict bit-exactly.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from nos_tpu.timeline import detectors
+from nos_tpu.timeline.sizes import SIZES, SizeRegistry
+from nos_tpu.timeline.watchdog import WATCHDOG, WedgeWatchdog
+from nos_tpu.util import metrics
+
+# NOTE: api constants and the profiler are imported function-locally:
+# util.tracing registers its trace ring with timeline.sizes at module
+# bottom, which initializes this package — anything that sits above
+# tracing in the import graph (profiling, the api package via kube)
+# would be re-entered half-built if imported here.
+
+Point = Tuple[float, float]
+
+_REMOVED = None  # delta sentinel: the series vanished this sample
+
+
+class _RssReader:
+    """Keeps ``/proc/self/statm`` open across samples — a fresh open()
+    every interval is most of the cost of reading one integer."""
+
+    def __init__(self) -> None:
+        self._fh = None
+        self._pagesize: Optional[int] = None
+
+    def read(self) -> Optional[float]:
+        try:
+            if self._pagesize is None:
+                import resource
+
+                self._pagesize = resource.getpagesize()
+            if self._fh is None:
+                self._fh = open("/proc/self/statm", "rb")
+            self._fh.seek(0)
+            pages = int(self._fh.read().split()[1])
+            return float(pages * self._pagesize)
+        except Exception:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                self._fh = None
+            return None
+
+
+_RSS = _RssReader()
+
+
+def _rss_bytes() -> Optional[float]:
+    return _RSS.read()
+
+
+class DetectorPolicy:
+    """Tuning budgets for the three detector families. Defaults are
+    sized so a healthy soak (bounded rings filling, caches churning,
+    counters ticking) stays clean; harnesses and teeth tests tighten
+    them to put deliberate faults in range."""
+
+    def __init__(
+        self,
+        *,
+        stall_flat_windows: int = detectors.DEFAULT_STALL_WINDOWS,
+        stall_series: Tuple[str, ...] = (),
+        leak_budget: float = detectors.DEFAULT_LEAK_BUDGET,
+        leak_budgets: Optional[Dict[str, float]] = None,
+        leak_series: Tuple[str, ...] = (),
+        leak_window: int = 64,
+        leak_min_points: int = detectors.DEFAULT_LEAK_MIN_POINTS,
+        leak_monotonic_fraction: float = detectors.DEFAULT_LEAK_MONOTONIC_FRACTION,
+        regression_series: Tuple[str, ...] = (),
+        regression_ratio: float = detectors.DEFAULT_REGRESSION_RATIO,
+        regression_baseline_points: int = detectors.DEFAULT_REGRESSION_MIN_POINTS,
+        regression_recent_points: int = detectors.DEFAULT_REGRESSION_MIN_POINTS,
+        regression_abs_floor: float = 0.0,
+        clear_samples: int = 3,
+    ) -> None:
+        self.stall_flat_windows = stall_flat_windows
+        self.stall_series = tuple(stall_series)
+        self.leak_budget = leak_budget
+        self.leak_budgets = dict(leak_budgets or {})
+        self.leak_series = tuple(leak_series)
+        self.leak_window = leak_window
+        self.leak_min_points = leak_min_points
+        self.leak_monotonic_fraction = leak_monotonic_fraction
+        self.regression_series = tuple(regression_series)
+        self.regression_ratio = regression_ratio
+        self.regression_baseline_points = regression_baseline_points
+        self.regression_recent_points = regression_recent_points
+        self.regression_abs_floor = regression_abs_floor
+        self.clear_samples = clear_samples
+
+    def stall_params(self) -> dict:
+        return {"flat_windows": self.stall_flat_windows}
+
+    def leak_params(self, series: str) -> dict:
+        return {
+            "budget": self.leak_budgets.get(series, self.leak_budget),
+            "min_points": self.leak_min_points,
+            "monotonic_fraction": self.leak_monotonic_fraction,
+        }
+
+    def regression_params(self) -> dict:
+        return {
+            "baseline_points": self.regression_baseline_points,
+            "recent_points": self.regression_recent_points,
+            "ratio": self.regression_ratio,
+            "abs_floor": self.regression_abs_floor,
+        }
+
+
+class TimelineStore:
+    MAX_FINDINGS = 256
+
+    def __init__(
+        self,
+        *,
+        capacity: int = 4096,
+        interval_seconds: float = 5.0,
+        clock: Callable[[], float] = time.time,
+        policy: Optional[DetectorPolicy] = None,
+        vitals: bool = True,
+        metrics_fn: Optional[Callable[[], Dict[str, float]]] = None,
+        sizes: Optional[SizeRegistry] = None,
+        watchdog: Optional[WedgeWatchdog] = None,
+    ) -> None:
+        self.capacity = capacity
+        self.interval_seconds = interval_seconds
+        self.clock = clock
+        self.policy = policy or DetectorPolicy()
+        self.vitals = vitals
+        self.metrics_fn = (
+            metrics.REGISTRY.snapshot if metrics_fn is None else metrics_fn
+        )
+        self.sizes = SIZES if sizes is None else sizes
+        self.watchdog = WATCHDOG if watchdog is None else watchdog
+        self._lock = threading.Lock()
+        self._entries: List[dict] = []
+        self._base: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+        # Detector fast path: the last few points of EVERY series, kept
+        # incrementally so a detector pass never replays the delta ring
+        # (which is O(ring length) per reconstruction). Sized to the
+        # largest window any configured detector looks at.
+        self._recent_len = max(
+            self.policy.leak_window,
+            self.policy.stall_flat_windows + 1,
+            self.policy.regression_baseline_points
+            + self.policy.regression_recent_points,
+        )
+        self._recent: Dict[str, Deque[Point]] = {}
+        self._samples = 0
+        self._findings: List[dict] = []
+        self._active: Dict[Tuple[str, str], dict] = {}
+        self._flight = None
+        self.recorder = None
+        self._event_obj = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+
+    # -- emission wiring --------------------------------------------------
+
+    def attach(self, *, flight=None, recorder=None, event_obj=None) -> None:
+        """Wire finding emission: ``flight`` gets ``timeline.finding``
+        records, ``recorder`` (an EventRecorder) gets ``HealthDegraded``
+        Events against ``event_obj``."""
+        self._flight = flight
+        self.recorder = recorder
+        self._event_obj = event_obj
+
+    # -- sampling ---------------------------------------------------------
+
+    def collect(self) -> Dict[str, float]:
+        """One full sample across all collectors (no ring mutation)."""
+        values: Dict[str, float] = {}
+        if self.metrics_fn is not None:
+            values.update(self.metrics_fn())
+        for name, size in self.sizes.sizes().items():
+            values[f"size.{name}"] = size
+        for name, count in self.watchdog.counters().items():
+            values[f"loop.{name}"] = count
+        if self.vitals:
+            rss = _rss_bytes()
+            if rss is not None:
+                values["process.rss_bytes"] = rss
+            values["process.threads"] = float(threading.active_count())
+        return values
+
+    def sample_once(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Append one delta-encoded sample to the ring."""
+        started = time.perf_counter()
+        if now is None:
+            now = self.clock()
+        values = self.collect()
+        with self._lock:
+            delta: Dict[str, Optional[float]] = {
+                k: v for k, v in values.items() if self._last.get(k) != v
+            }
+            for gone in self._last:
+                if gone not in values:
+                    delta[gone] = _REMOVED
+                    self._recent.pop(gone, None)
+            for name, value in values.items():
+                window = self._recent.get(name)
+                if window is None:
+                    window = self._recent[name] = collections.deque(
+                        maxlen=self._recent_len
+                    )
+                # Floats at insertion so detector windows are already
+                # normalized — the recorded window then round-trips
+                # through JSON bit-identically for replay recompute.
+                window.append((float(now), float(value)))
+            self._entries.append({"t": now, "d": delta})
+            while len(self._entries) > self.capacity:
+                evicted = self._entries.pop(0)
+                for key, value in evicted["d"].items():
+                    if value is _REMOVED:
+                        self._base.pop(key, None)
+                    else:
+                        self._base[key] = value
+            self._last = values
+            self._samples += 1
+        metrics.TIMELINE_SAMPLES.inc()
+        metrics.TIMELINE_SERIES.set(len(values))
+        metrics.TIMELINE_SAMPLE_DURATION.observe(time.perf_counter() - started)
+        return values
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """Sample then detect — the unit of work one sampler interval
+        (or one virtual-clock harness step) performs."""
+        if now is None:
+            now = self.clock()
+        self.sample_once(now)
+        return self.check(now)
+
+    # -- ring reads -------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return self._samples
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._last)
+
+    def series(self, name: str, window_seconds: Optional[float] = None) -> List[Point]:
+        """Per-sample points for one series, values carried forward
+        through samples where it did not change."""
+        with self._lock:
+            entries = list(self._entries)
+            current = self._base.get(name)
+        points: List[Point] = []
+        for entry in entries:
+            if name in entry["d"]:
+                current = entry["d"][name]
+            if current is not None:
+                points.append((entry["t"], current))
+        if window_seconds is not None and points:
+            horizon = points[-1][0] - window_seconds
+            points = [p for p in points if p[0] >= horizon]
+        return points
+
+    def series_many(self, names: List[str]) -> Dict[str, List[Point]]:
+        """Carry-forward points for many series off ONE ring scan.
+        ``series()`` is O(ring) per call, so a detector pass over N
+        watched series would pay N full scans per tick; this keeps the
+        per-tick sampling cost flat as series accumulate."""
+        with self._lock:
+            entries = list(self._entries)
+            current: Dict[str, Optional[float]] = {
+                name: self._base.get(name) for name in names
+            }
+        out: Dict[str, List[Point]] = {name: [] for name in names}
+        for entry in entries:
+            delta = entry["d"]
+            t = entry["t"]
+            for name in names:
+                if name in delta:
+                    current[name] = delta[name]
+                value = current[name]
+                if value is not None:
+                    out[name].append((t, value))
+        return out
+
+    def to_jsonl(self) -> str:
+        """The ring as JSONL: a header frame with the folded base, then
+        one delta frame per retained sample."""
+        with self._lock:
+            lines = [
+                json.dumps(
+                    {
+                        "kind": "timeline.base",
+                        "base": dict(sorted(self._base.items())),
+                        "samples": self._samples,
+                    },
+                    sort_keys=True,
+                )
+            ]
+            for entry in self._entries:
+                lines.append(
+                    json.dumps(
+                        {"t": entry["t"], "d": dict(sorted(entry["d"].items()))},
+                        sort_keys=True,
+                    )
+                )
+        return "\n".join(lines) + "\n"
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.to_jsonl())
+
+    # -- detectors --------------------------------------------------------
+
+    def _stall_targets(self) -> List[str]:
+        targets = [f"loop.{name}" for name in self.watchdog.periodic_loops()]
+        targets.extend(self.policy.stall_series)
+        return targets
+
+    def _leak_targets(self) -> List[str]:
+        with self._lock:
+            sized = [n for n in sorted(self._last) if n.startswith("size.")]
+        sized.extend(self.policy.leak_series)
+        return sized
+
+    def _detector_windows(self):
+        """Yield ``(detector, series, window, params)`` for every
+        configured detector pass. Windows come from the incremental
+        per-series cache, not a ring replay — the detector pass stays
+        O(watched series), flat in both ring depth and total series
+        count. Regression baselines are therefore rolling (oldest
+        retained points), which is also what hysteresis wants: a one-off
+        warm-up blip ages out."""
+        stall_targets = self._stall_targets()
+        leak_targets = self._leak_targets()
+        with self._lock:
+            history = {
+                name: list(self._recent.get(name, ()))
+                for name in set(stall_targets)
+                | set(leak_targets)
+                | set(self.policy.regression_series)
+            }
+        stall_params = self.policy.stall_params()
+        for name in stall_targets:
+            points = history[name][-(self.policy.stall_flat_windows + 1):]
+            yield detectors.STALL, name, points, stall_params
+        for name in leak_targets:
+            points = history[name][-self.policy.leak_window:]
+            yield detectors.LEAK, name, points, self.policy.leak_params(name)
+        regression_params = self.policy.regression_params()
+        for name in self.policy.regression_series:
+            yield detectors.REGRESSION, name, history[name], regression_params
+
+    def evaluate(self) -> List[dict]:
+        """Run every configured detector over its current window and
+        return the raw evaluations (verdict or None each) — the pure
+        core ``check()`` wraps with hysteresis and emission."""
+        return [
+            {
+                "detector": detector,
+                "series": name,
+                "window": points,
+                "params": params,
+                "verdict": detectors.run_detector(
+                    detector, points, params, normalized=True
+                )
+                if points
+                else None,
+            }
+            for detector, name, points, params in self._detector_windows()
+        ]
+
+    def check(self, now: Optional[float] = None) -> List[dict]:
+        """Detect over the current ring; returns only NEW findings (an
+        active finding refreshes silently until it clears)."""
+        if now is None:
+            now = self.clock()
+        new_findings: List[dict] = []
+        seen: Dict[Tuple[str, str], bool] = {}
+        for detector, name, points, params in self._detector_windows():
+            verdict = (
+                detectors.run_detector(detector, points, params, normalized=True)
+                if points
+                else None
+            )
+            key = (detector, name)
+            seen[key] = verdict is not None
+            active = self._active.get(key)
+            if verdict is not None:
+                if active is None:
+                    finding = {
+                        "t": now,
+                        "detector": detector,
+                        "series": name,
+                        "verdict": verdict,
+                        "window": points,
+                        "params": params,
+                    }
+                    if detector == detectors.STALL:
+                        loop = name
+                        if loop.startswith("loop."):
+                            loop = loop[len("loop."):]
+                        finding["stacks"] = self.watchdog.stacks_for(loop)
+                    self._active[key] = {"verdict": verdict, "clean": 0}
+                    self._record_finding(finding)
+                    new_findings.append(finding)
+                else:
+                    active["verdict"] = verdict
+                    active["clean"] = 0
+        for key in list(self._active):
+            if seen.get(key):
+                continue
+            active = self._active[key]
+            active["clean"] += 1
+            if active["clean"] >= self.policy.clear_samples:
+                del self._active[key]
+        return new_findings
+
+    def _record_finding(self, finding: dict) -> None:
+        with self._lock:
+            self._findings.append(finding)
+            if len(self._findings) > self.MAX_FINDINGS:
+                self._findings.pop(0)
+        metrics.TIMELINE_FINDINGS.labels(
+            detector=finding["detector"], series=finding["series"]
+        ).inc()
+        if self._flight is not None:
+            self._flight.record_timeline_finding(
+                t=finding["t"],
+                detector=finding["detector"],
+                series=finding["series"],
+                window=[[t, v] for t, v in finding["window"]],
+                params=finding["params"],
+                verdict=finding["verdict"],
+                stacks=finding.get("stacks", []),
+            )
+        if self.recorder is not None and self._event_obj is not None:
+            from nos_tpu.api.v1alpha1 import constants
+
+            message = (
+                f"{finding['detector']} finding on {finding['series']}: "
+                f"{json.dumps(finding['verdict'], sort_keys=True)}"
+            )
+            self.recorder.record(
+                self._event_obj,
+                constants.EVENT_REASON_HEALTH_DEGRADED,
+                message,
+                type="Warning",
+            )
+
+    def findings(self, detector: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            found = list(self._findings)
+        if detector is not None:
+            found = [f for f in found if f["detector"] == detector]
+        return found
+
+    def findings_payload(self) -> dict:
+        """JSON-stable findings summary (windows and stacks elided) —
+        what the soak harness diffs across runs."""
+        return {
+            "findings": [
+                {
+                    "t": f["t"],
+                    "detector": f["detector"],
+                    "series": f["series"],
+                    "verdict": f["verdict"],
+                }
+                for f in self.findings()
+            ]
+        }
+
+    # -- rollups / debug --------------------------------------------------
+
+    def rollups(self, window_seconds: Optional[float] = None) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for name in self.names():
+            points = self.series(name, window_seconds)
+            if not points:
+                continue
+            values = [v for _, v in points]
+            out[name] = {
+                "first": values[0],
+                "last": values[-1],
+                "min": min(values),
+                "max": max(values),
+                "delta": values[-1] - values[0],
+                "points": len(values),
+            }
+        return out
+
+    def sparkline(
+        self,
+        name: str,
+        points: int = 32,
+        window_seconds: Optional[float] = None,
+    ) -> List[float]:
+        """Evenly-resampled recent values — what the debug page plots."""
+        series = self.series(name, window_seconds)
+        if not series:
+            return []
+        if len(series) <= points:
+            return [v for _, v in series]
+        step = (len(series) - 1) / (points - 1)
+        return [series[int(round(i * step))][1] for i in range(points)]
+
+    def debug_payload(
+        self,
+        window_seconds: Optional[float] = None,
+        spark_points: int = 32,
+    ) -> dict:
+        rollups = self.rollups(window_seconds)
+        return {
+            "samples": self.samples,
+            "retained": len(self),
+            "capacity": self.capacity,
+            "interval_seconds": self.interval_seconds,
+            "series_count": len(rollups),
+            "window_seconds": window_seconds,
+            "watchdog": self.watchdog.debug_payload(),
+            "active_findings": sorted(
+                f"{d}:{s}" for d, s in self._active
+            ),
+            "findings": self.findings_payload()["findings"],
+            "rollups": rollups,
+            "sparklines": {
+                name: self.sparkline(name, spark_points, window_seconds)
+                for name in rollups
+            },
+        }
+
+    # -- sampler thread ---------------------------------------------------
+
+    def start(self) -> None:
+        """Background sampler: one ``tick()`` per interval on a daemon
+        thread registered with the profiler and the watchdog (a wedged
+        sampler cannot report itself — its silence shows up as a frozen
+        ``samples`` count on /debug/timeline instead)."""
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+        self.watchdog.register(
+            "timeline-sampler", periodic=True, thread_name="timeline-sampler"
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="timeline-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        from nos_tpu.util.profiling import PROFILER
+
+        PROFILER.register_thread(name="timeline-sampler")
+        try:
+            while not self._stop_event.wait(self.interval_seconds):
+                self.watchdog.beat("timeline-sampler")
+                self.tick()
+        finally:
+            PROFILER.unregister_thread()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop_event.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self.watchdog.unregister("timeline-sampler")

@@ -1,0 +1,136 @@
+"""WedgeWatchdog: the stall detector's loop registry.
+
+Every controller loop that is contractually alive registers here and
+beats once per iteration (or exposes an existing progress counter via
+``counter_fn``). The TimelineStore samples each loop's counter into a
+``loop.<name>`` series; loops registered ``periodic=True`` — ones whose
+contract says they tick on a timer even when idle (capacity heartbeat,
+forecaster resync, the timeline sampler itself) — are stall-checked
+automatically, and a flat counter for N sample windows becomes a
+wedged-loop verdict with the owning thread's profiler stacks attached.
+
+Event-driven loops (the partitioner batch loop, watch-queue workers)
+register ``periodic=False``: they still show up in ``loop.*`` series and
+``/debug/timeline``, but idleness is legal for them, so they are only
+stall-checked when a harness arms them explicitly.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+# NOTE: no top-level nos_tpu imports — this module sits below
+# util.profiling/util.tracing in the import graph (tracing registers the
+# trace ring with timeline.sizes at its bottom), so anything above must
+# be imported function-locally.
+
+
+class _Loop:
+    __slots__ = ("name", "periodic", "thread_name", "counter_fn", "beats")
+
+    def __init__(
+        self,
+        name: str,
+        periodic: bool,
+        thread_name: Optional[str],
+        counter_fn: Optional[Callable[[], float]],
+    ) -> None:
+        self.name = name
+        self.periodic = periodic
+        self.thread_name = thread_name
+        self.counter_fn = counter_fn
+        self.beats = 0.0
+
+
+class WedgeWatchdog:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loops: Dict[str, _Loop] = {}
+
+    def register(
+        self,
+        name: str,
+        *,
+        periodic: bool = False,
+        thread_name: Optional[str] = None,
+        counter_fn: Optional[Callable[[], float]] = None,
+    ) -> None:
+        """Register (or re-register — tests rebuild components) a loop.
+        ``periodic=True`` opts the loop into automatic stall checking."""
+        with self._lock:
+            self._loops[name] = _Loop(name, periodic, thread_name, counter_fn)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._loops.pop(name, None)
+
+    def beat(self, name: str) -> None:
+        """One loop iteration. Unregistered names auto-register as
+        event-driven so a beat can never be dropped on the floor."""
+        with self._lock:
+            loop = self._loops.get(name)
+            if loop is None:
+                loop = _Loop(name, False, None, None)
+                self._loops[name] = loop
+            loop.beats += 1.0
+
+    def counters(self) -> Dict[str, float]:
+        """Current progress counter per registered loop (``counter_fn``
+        when given, internal beats otherwise); erroring callbacks are
+        skipped for this sample."""
+        with self._lock:
+            loops = list(self._loops.values())
+        out: Dict[str, float] = {}
+        for loop in loops:
+            if loop.counter_fn is not None:
+                try:
+                    out[loop.name] = float(loop.counter_fn())
+                except Exception:
+                    continue
+            else:
+                out[loop.name] = loop.beats
+        return out
+
+    def periodic_loops(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, l in self._loops.items() if l.periodic)
+
+    def thread_name(self, name: str) -> Optional[str]:
+        with self._lock:
+            loop = self._loops.get(name)
+            return loop.thread_name if loop else None
+
+    def stacks_for(self, name: str) -> List[str]:
+        """The owning thread's collapsed profiler stacks — the payload a
+        wedged-loop verdict ships so the operator sees *where* the loop
+        is parked, not just that it stopped."""
+        thread_name = self.thread_name(name)
+        if not thread_name:
+            return []
+        from nos_tpu.util.profiling import PROFILER
+
+        stacks = []
+        for line in PROFILER.collapsed().splitlines():
+            if line.startswith(f"{thread_name};"):
+                stacks.append(line)
+        return stacks
+
+    def debug_payload(self) -> dict:
+        with self._lock:
+            loops = sorted(self._loops.values(), key=lambda l: l.name)
+            return {
+                "loops": [
+                    {
+                        "name": loop.name,
+                        "periodic": loop.periodic,
+                        "thread": loop.thread_name,
+                        "external_counter": loop.counter_fn is not None,
+                        "beats": loop.beats,
+                    }
+                    for loop in loops
+                ]
+            }
+
+
+# Process-wide watchdog (the REGISTRY/TRACER/PROFILER analogue).
+WATCHDOG = WedgeWatchdog()

@@ -38,6 +38,7 @@ class HealthServer:
         slo_fn: Optional[Callable[[], dict]] = None,
         autoscaler_fn: Optional[Callable[[], dict]] = None,
         forecast_fn: Optional[Callable[[bool], dict]] = None,
+        timeline_fn: Optional[Callable[[Optional[float]], dict]] = None,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -73,6 +74,11 @@ class HealthServer:
         # refresh=True when ?refresh=1 forces an on-demand run; None
         # disables the endpoint (no forecaster wired).
         self.forecast_fn = forecast_fn
+        # /debug/timeline -> the TimelineStore rollup (windowed per-series
+        # rollups + sparkline arrays, watchdog loop registry, detector
+        # findings), called with the parsed ?window= seconds (or None for
+        # the whole ring); None disables the endpoint (no timeline wired).
+        self.timeline_fn = timeline_fn
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -180,6 +186,16 @@ class HealthServer:
                 "ETA calibration; ?refresh=1 forces an on-demand run",
                 self._serve_forecast,
             )
+        if self.timeline_fn is not None:
+            register(
+                "/debug/timeline",
+                "the longitudinal health timeline: windowed per-series "
+                "rollups and sparkline arrays over the sampled ring, the "
+                "wedge-watchdog loop registry, and leak/stall/regression "
+                "detector findings; ?window=<seconds> bounds the rollup "
+                "window",
+                self._serve_timeline,
+            )
         return endpoints
 
     # Endpoint handlers: called with the live request handler (for
@@ -281,6 +297,21 @@ class HealthServer:
         req._respond(
             200,
             json.dumps(self.forecast_fn(refresh), indent=2),
+            "application/json",
+        )
+
+    def _serve_timeline(self, req, url) -> None:
+        raw = parse_qs(url.query).get("window", [None])[0]
+        window: Optional[float] = None
+        if raw is not None:
+            try:
+                window = float(raw)
+            except ValueError:
+                req._respond(400, "window must be a number of seconds")
+                return
+        req._respond(
+            200,
+            json.dumps(self.timeline_fn(window), indent=2, sort_keys=True),
             "application/json",
         )
 

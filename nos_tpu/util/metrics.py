@@ -51,7 +51,12 @@ class Counter:
         # Family support: parent holds children keyed by sorted label
         # items; a child holds its own label values and no children.
         self._label_values: Dict[str, str] = dict(label_values or {})
+        # Label sets are fixed at creation, so the rendered suffix is too
+        # (snapshot() runs on every timeline sample — keep it flat).
+        self._label_suffix = render_labels(self._label_values)
+        self._snapshot_key = f"{name}{self._label_suffix}"
         self._children: Dict[Tuple, "Counter"] = {}
+        self._children_sorted: Optional[list] = None
         self._touched = False
 
     def _new_child(self, label_values: Dict[str, str]) -> "Counter":
@@ -67,6 +72,7 @@ class Counter:
             if child is None:
                 child = self._new_child({k: str(v) for k, v in label_values.items()})
                 self._children[key] = child
+                self._children_sorted = None
             return child
 
     def inc(self, amount: float = 1.0) -> None:
@@ -89,7 +95,11 @@ class Counter:
 
     def _sorted_children(self):
         with self._lock:
-            return [child for _, child in sorted(self._children.items())]
+            if self._children_sorted is None:
+                self._children_sorted = [
+                    child for _, child in sorted(self._children.items())
+                ]
+            return self._children_sorted
 
     def _sample_lines(self) -> list:
         lines = []
@@ -114,14 +124,17 @@ class Counter:
         return "\n".join(lines) + "\n"
 
     def snapshot_into(self, out: Dict[str, float]) -> None:
+        """Touched series only: a family nothing has incremented yet has
+        no sample worth a timeline series (it appears on first use, the
+        same way labeled children do)."""
         with self._lock:
-            bare = self._touched or not self._children
+            touched = self._touched
             value = self._value
-            suffix = render_labels(self._label_values)
-        if bare:
-            out[f"{self.name}{suffix}"] = value
-        for child in self._sorted_children():
-            child.snapshot_into(out)
+        if touched:
+            out[self._snapshot_key] = value
+        if self._children:
+            for child in self._sorted_children():
+                child.snapshot_into(out)
 
 
 class Gauge(Counter):
@@ -157,9 +170,20 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._recent = deque(maxlen=self.WINDOW)
+        # Sorted-window cache for percentile(): rebuilt lazily after an
+        # observe invalidates it, so quiet histograms cost nothing to
+        # snapshot (the timeline sampler snapshots every family each
+        # interval — most are idle at any given moment).
+        self._ordered: Optional[list] = None
         self._lock = threading.Lock()
         self._label_values: Dict[str, str] = dict(label_values or {})
+        self._label_suffix = render_labels(self._label_values)
+        self._snapshot_keys = tuple(
+            f"{name}_{part}{self._label_suffix}"
+            for part in ("count", "sum", "p50", "p95", "p99")
+        )
         self._children: Dict[Tuple, "Histogram"] = {}
+        self._children_sorted: Optional[list] = None
         self._touched = False
 
     def labels(self, **label_values: str) -> "Histogram":
@@ -176,6 +200,7 @@ class Histogram:
                     {k: str(v) for k, v in label_values.items()},
                 )
                 self._children[key] = child
+                self._children_sorted = None
             return child
 
     def observe(self, value: float) -> None:
@@ -184,6 +209,7 @@ class Histogram:
             self._sum += value
             self._count += 1
             self._recent.append(value)
+            self._ordered = None
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self._counts[i] += 1
@@ -204,13 +230,19 @@ class Histogram:
         with self._lock:
             if not self._recent:
                 return None
-            ordered = sorted(self._recent)
+            if self._ordered is None:
+                self._ordered = sorted(self._recent)
+            ordered = self._ordered
             index = min(len(ordered) - 1, int(p / 100.0 * len(ordered)))
             return ordered[index]
 
     def _sorted_children(self):
         with self._lock:
-            return [child for _, child in sorted(self._children.items())]
+            if self._children_sorted is None:
+                self._children_sorted = [
+                    child for _, child in sorted(self._children.items())
+                ]
+            return self._children_sorted
 
     def _sample_lines(self) -> list:
         with self._lock:
@@ -242,20 +274,23 @@ class Histogram:
         return "\n".join(lines) + "\n"
 
     def snapshot_into(self, out: Dict[str, float]) -> None:
+        """Count/sum always (an empty histogram's exact zeros are part of
+        the exposition contract); percentiles only once samples exist,
+        computed off one lock hold and the shared sorted-window cache."""
+        key_count, key_sum, key_p50, key_p95, key_p99 = self._snapshot_keys
         with self._lock:
-            bare = self._touched or not self._children
-            suffix = render_labels(self._label_values)
-            count = self._count
-            total = self._sum
-        if bare:
-            out[f"{self.name}_count{suffix}"] = count
-            out[f"{self.name}_sum{suffix}"] = total
-            for p, key in ((50, "p50"), (95, "p95"), (99, "p99")):
-                quantile = self.percentile(p)
-                if quantile is not None:
-                    out[f"{self.name}_{key}{suffix}"] = quantile
-        for child in self._sorted_children():
-            child.snapshot_into(out)
+            out[key_count] = self._count
+            out[key_sum] = self._sum
+            if self._recent:
+                if self._ordered is None:
+                    self._ordered = sorted(self._recent)
+                ordered = self._ordered
+                last = len(ordered) - 1
+                for p, key in ((50, key_p50), (95, key_p95), (99, key_p99)):
+                    out[key] = ordered[min(last, int(p / 100.0 * len(ordered)))]
+        if self._children:
+            for child in self._sorted_children():
+                child.snapshot_into(out)
 
 
 class MetricsRegistry:
@@ -711,4 +746,29 @@ FORECAST_RUNS = REGISTRY.counter(
     "nos_tpu_forecast_runs_total",
     "Completed forecast cycles (background thread or on-demand "
     "/debug/forecast?refresh=1)",
+)
+
+# Health timeline (nos_tpu/timeline/): longitudinal sampling of the
+# registry + process vitals + structure sizes into a bounded ring, and
+# the leak/stall/regression detector verdicts computed over it.
+TIMELINE_SAMPLES = REGISTRY.counter(
+    "nos_tpu_timeline_samples_total",
+    "Samples appended to the timeline ring (one per sampler interval)",
+)
+TIMELINE_SERIES = REGISTRY.gauge(
+    "nos_tpu_timeline_series",
+    "Distinct series present in the most recent timeline sample",
+)
+TIMELINE_FINDINGS = REGISTRY.counter(
+    "nos_tpu_timeline_findings_total",
+    "New detector findings over the timeline ring "
+    "(by detector=stall|leak|regression, series); hysteresis means an "
+    "active finding counts once, not once per tick",
+)
+TIMELINE_SAMPLE_DURATION = REGISTRY.histogram(
+    "nos_tpu_timeline_sample_duration_seconds",
+    "Wall time one timeline sample (all collectors + ring append) costs "
+    "— the numerator of the <=2% sampling-overhead budget",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1),
 )

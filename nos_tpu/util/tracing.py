@@ -515,6 +515,15 @@ class Tracer:
 # The process-wide tracer (the metrics.REGISTRY analogue).
 TRACER = Tracer()
 
+# The trace ring is bounded, but the health timeline still watches it:
+# a ring that only ever grows toward its cap is fine, one that keeps
+# growing past its cap means the bound broke. (Import placed after every
+# definition: timeline.store reaches this module via the profiler, so a
+# top-of-file import would be circular.)
+from nos_tpu.timeline.sizes import SIZES as _SIZES  # noqa: E402
+
+_SIZES.register("tracing.trace_store", lambda: len(TRACER.store))
+
 
 # ------------------------------------------------------------------ logging
 

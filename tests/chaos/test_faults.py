@@ -108,7 +108,36 @@ def test_clear_disarms_everything():
     inj.arm_errors(1)
     inj.arm_sever(5)
     inj.arm_latency(0.5)
+    inj.arm_clock_skew(2.0)
     inj.clear()
     inj.on_store_write("Pod", "p")  # no raise
     assert inj.on_request("GET", "/") is None
     assert not inj.take_sever()
+    assert inj.skew_seconds() == 0.0
+
+
+def test_clock_skew_shifts_wall_clock_only():
+    """Armed skew pushes the wall-clock seam ahead; heal (clear) snaps it
+    back. Monotonic time is never touched — the fault models wall/mono
+    divergence, the thing lease stamps and heartbeat ages must survive."""
+    import time
+
+    inj = FaultInjector()
+    assert abs(inj.wall_clock() - time.time()) < 0.25
+    inj.arm_clock_skew(2.0)
+    assert inj.skew_seconds() == 2.0
+    ahead = inj.wall_clock() - time.time()
+    assert 1.75 < ahead < 2.25
+    inj.clear()  # heal: wall time snaps BACK — integrators must shrug it off
+    assert abs(inj.wall_clock() - time.time()) < 0.25
+
+
+def test_clock_skew_appears_in_schedules():
+    """CLOCK_SKEW is part of the fault vocabulary on every backend and
+    always carries a positive jump size."""
+    seen = []
+    for seed in range(20):
+        for burst in build_schedule(seed, 2, NODES, backend="memory"):
+            seen.extend(f for f in burst.faults if f.kind == F.CLOCK_SKEW)
+    assert seen, "no seed in 0..19 scheduled a clock-skew fault"
+    assert all(f.param in (0.5, 1.0, 2.0) for f in seen)

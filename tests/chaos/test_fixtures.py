@@ -26,6 +26,7 @@ KNOWN_NAMES = (
     "pending-settled",
     "replay-clean",
     "replay-crash",
+    "timeline-clean",
 )
 
 

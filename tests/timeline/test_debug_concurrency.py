@@ -1,0 +1,89 @@
+"""/debug endpoints vs. concurrent metric registration: hammering
+/debug/vars and /debug/timeline while writer threads mint new labeled
+series and observe histograms must never tear (half-written families),
+raise in a handler (a 500), or deadlock. This is the race the timeline
+sampler lives with in production — it snapshots the registry on its own
+thread while every controller loop keeps registering and bumping."""
+import http.client
+import json
+import threading
+
+from nos_tpu.timeline.sizes import SizeRegistry
+from nos_tpu.timeline.store import TimelineStore
+from nos_tpu.timeline.watchdog import WedgeWatchdog
+from nos_tpu.util.health import HealthServer
+from nos_tpu.util.metrics import REGISTRY
+
+TOKEN = "s3cret"
+WRITERS = 4
+ROUNDS = 40
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("GET", path, headers={"Authorization": f"Bearer {TOKEN}"})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def test_debug_endpoints_survive_concurrent_registration():
+    timeline = TimelineStore(
+        capacity=64,
+        interval_seconds=3600.0,  # ticked by hand below, never by thread
+        sizes=SizeRegistry(),
+        watchdog=WedgeWatchdog(),
+        vitals=False,
+    )
+    server = HealthServer(
+        port=0,
+        metrics_token=TOKEN,
+        timeline_fn=lambda window: timeline.debug_payload(window),
+    )
+    port = server.start()
+    stop = threading.Event()
+    errors = []
+
+    def writer(worker):
+        try:
+            i = 0
+            while not stop.is_set():
+                counter = REGISTRY.counter(
+                    f"nos_tpu_test_debug_churn_total_{worker}"
+                )
+                counter.labels(shard=str(i % 16)).inc()
+                hist = REGISTRY.histogram(
+                    f"nos_tpu_test_debug_churn_seconds_{worker}"
+                )
+                hist.labels(shard=str(i % 16)).observe(0.001 * (i % 7))
+                i += 1
+        except Exception as exc:  # pragma: no cover - the failure mode
+            errors.append(f"writer {worker}: {exc!r}")
+
+    threads = [
+        threading.Thread(target=writer, args=(w,), daemon=True)
+        for w in range(WRITERS)
+    ]
+    for thread in threads:
+        thread.start()
+    try:
+        for round_no in range(ROUNDS):
+            status, body = _get(port, "/debug/vars")
+            assert status == 200, body
+            snapshot = json.loads(body)  # a torn write would break parse
+            assert all(isinstance(v, (int, float)) for v in snapshot.values())
+            # the sampler path: snapshot the (mutating) registry into the
+            # ring, then serve the payload built from it
+            timeline.sample_once(now=1000.0 + round_no)
+            status, body = _get(port, "/debug/timeline")
+            assert status == 200, body
+            payload = json.loads(body)
+            assert payload["samples"] == round_no + 1
+    finally:
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=5)
+        server.stop()
+    assert errors == []

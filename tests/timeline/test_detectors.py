@@ -1,0 +1,178 @@
+"""Pure detector units: stall/leak/regression verdicts and the
+``run_detector`` dispatch the live engine and flight-recorder replay
+share. The JSON round-trip tests are the bit-exactness contract: a
+recorded window fed back through the same detector must land on the
+recorded verdict with plain ``==``."""
+import json
+
+import pytest
+
+from nos_tpu.timeline import detectors
+
+
+def ramp(n, start=0.0, step=1.0, t0=0.0, dt=5.0):
+    return [(t0 + i * dt, start + i * step) for i in range(n)]
+
+
+def flat(n, value, t0=0.0, dt=5.0):
+    return [(t0 + i * dt, value) for i in range(n)]
+
+
+class TestMedianAndSlope:
+    def test_median_odd_even(self):
+        assert detectors.median([3.0, 1.0, 2.0]) == 2.0
+        assert detectors.median([4.0, 1.0, 2.0, 3.0]) == 2.5
+
+    def test_theil_sen_is_robust_to_one_spike(self):
+        points = ramp(9, step=2.0, dt=1.0)
+        points[4] = (points[4][0], 1000.0)  # one wild outlier
+        slope = detectors.theil_sen_slope(points)
+        assert 1.0 < slope < 4.0
+
+    def test_theil_sen_degenerate_windows(self):
+        assert detectors.theil_sen_slope([]) == 0.0
+        assert detectors.theil_sen_slope([(1.0, 5.0)]) == 0.0
+        assert detectors.theil_sen_slope([(1.0, 5.0), (1.0, 9.0)]) == 0.0
+
+
+class TestStall:
+    def test_too_few_points_is_healthy(self):
+        assert detectors.detect_stall(flat(5, 7.0), flat_windows=5) is None
+
+    def test_moving_counter_is_healthy(self):
+        assert detectors.detect_stall(ramp(10, step=1.0), flat_windows=5) is None
+
+    def test_never_ran_is_not_a_stall(self):
+        # A counter pinned at zero is a wiring problem, not a wedge.
+        assert detectors.detect_stall(flat(10, 0.0), flat_windows=5) is None
+
+    def test_moved_then_flat_is_a_stall(self):
+        points = ramp(4, step=1.0, dt=5.0) + flat(6, 3.0, t0=20.0, dt=5.0)
+        verdict = detectors.detect_stall(points, flat_windows=5)
+        assert verdict is not None
+        assert verdict["detector"] == detectors.STALL
+        assert verdict["flat_windows"] == 5
+        assert verdict["last_value"] == 3.0
+        # flat_since is the first point of the flat tail
+        assert verdict["flat_since"] == points[-6][0]
+
+    def test_one_bump_inside_the_tail_resets(self):
+        points = flat(5, 3.0) + [(25.0, 4.0)] + flat(3, 4.0, t0=30.0)
+        assert detectors.detect_stall(points, flat_windows=4) is None
+
+
+class TestLeak:
+    def test_below_min_points_is_healthy(self):
+        assert detectors.detect_leak(ramp(4, step=100.0), min_points=8) is None
+
+    def test_growth_within_budget_is_healthy(self):
+        # A bounded ring filling to capacity then plateauing.
+        points = ramp(8, step=10.0) + flat(20, 70.0, t0=40.0)
+        assert detectors.detect_leak(points, budget=256.0) is None
+
+    def test_churning_cache_is_healthy(self):
+        # Big net growth but a sawtooth: monotonic fraction too low.
+        points = [(float(i), 100.0 * i * (1 if i % 2 else -1)) for i in range(12)]
+        assert (
+            detectors.detect_leak(points, budget=10.0, monotonic_fraction=0.9)
+            is None
+        )
+
+    def test_steady_climb_past_budget_fires(self):
+        points = ramp(12, step=50.0, dt=5.0)
+        verdict = detectors.detect_leak(points, budget=256.0)
+        assert verdict is not None
+        assert verdict["detector"] == detectors.LEAK
+        assert verdict["growth"] == 550.0
+        assert verdict["budget"] == 256.0
+        assert verdict["slope_per_second"] == pytest.approx(10.0)
+        assert verdict["window_seconds"] == 55.0
+
+    def test_negative_slope_is_healthy(self):
+        # Growth between endpoints but the robust trend is downhill.
+        points = [(0.0, 0.0)] + [(float(i), 500.0 - i) for i in range(1, 12)]
+        assert detectors.detect_leak(points, budget=256.0) is None
+
+
+class TestRegression:
+    def test_insufficient_points_is_healthy(self):
+        assert (
+            detectors.detect_regression(
+                flat(10, 5.0), baseline_points=8, recent_points=8
+            )
+            is None
+        )
+
+    def test_within_ratio_is_healthy(self):
+        points = flat(8, 10.0) + flat(8, 12.0, t0=40.0)
+        assert detectors.detect_regression(points, ratio=1.5) is None
+
+    def test_zero_baseline_is_healthy(self):
+        points = flat(8, 0.0) + flat(8, 100.0, t0=40.0)
+        assert detectors.detect_regression(points) is None
+
+    def test_abs_floor_suppresses_noise_ratio(self):
+        points = flat(8, 0.001) + flat(8, 0.01, t0=40.0)
+        assert detectors.detect_regression(points, abs_floor=0.1) is None
+
+    def test_sustained_rise_fires(self):
+        points = flat(8, 10.0) + flat(8, 30.0, t0=40.0)
+        verdict = detectors.detect_regression(points, ratio=1.5)
+        assert verdict == {
+            "detector": detectors.REGRESSION,
+            "baseline": 10.0,
+            "recent": 30.0,
+            "ratio": 3.0,
+            "threshold_ratio": 1.5,
+        }
+
+
+class TestRunDetector:
+    def test_dispatch_matches_direct_call(self):
+        points = ramp(12, step=50.0)
+        assert detectors.run_detector(
+            detectors.LEAK, points, {"budget": 256.0}
+        ) == detectors.detect_leak(points, budget=256.0)
+
+    def test_unknown_detector_raises(self):
+        with pytest.raises(KeyError):
+            detectors.run_detector("made-up", [], {})
+
+    def test_normalized_fast_path_matches(self):
+        points = ramp(12, step=50.0)
+        assert detectors.run_detector(
+            detectors.LEAK, points, {"budget": 256.0}, normalized=True
+        ) == detectors.run_detector(detectors.LEAK, points, {"budget": 256.0})
+
+    @pytest.mark.parametrize(
+        "detector,points,params",
+        [
+            (
+                detectors.STALL,
+                ramp(3, step=1.0) + flat(6, 2.0, t0=15.0),
+                {"flat_windows": 5},
+            ),
+            (detectors.LEAK, ramp(12, step=50.0), {"budget": 256.0}),
+            (
+                detectors.REGRESSION,
+                flat(8, 10.0) + flat(8, 30.0, t0=40.0),
+                {"ratio": 1.5},
+            ),
+        ],
+    )
+    def test_json_round_trip_is_bit_exact(self, detector, points, params):
+        """The replay contract: window + params through JSON and back
+        recompute the identical verdict (floats round-trip exactly)."""
+        verdict = detectors.run_detector(detector, points, params)
+        assert verdict is not None
+        wire = json.dumps(
+            {"window": [[t, v] for t, v in points], "params": params},
+            sort_keys=True,
+        )
+        decoded = json.loads(wire)
+        assert (
+            detectors.run_detector(
+                detector, decoded["window"], decoded["params"]
+            )
+            == verdict
+        )

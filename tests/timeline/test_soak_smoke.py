@@ -1,0 +1,44 @@
+"""Tier-1 soak smoke: a seconds-long 64-node slice of the full soak
+bench (`make bench-soak` runs the committed 1024-node / 220-cycle
+version). Two in-process runs must produce zero leak/stall findings and
+byte-identical verdicts — the determinism contract BENCH_soak.json
+relies on, checked at a size CI can afford every commit."""
+import json
+
+import bench_soak
+
+SMOKE = dict(nodes=64, pools=4, pending_pods=24, cycles=30)
+
+
+def test_soak_smoke_two_runs_byte_identical():
+    report1, records1, timeline1 = bench_soak.run_soak(**SMOKE)
+    report2, records2, timeline2 = bench_soak.run_soak(**SMOKE)
+
+    for report in (report1, report2):
+        # a healthy soak: every cycle incremental, merges clean, no
+        # leak/stall after the final heal, replay drift-free
+        assert report["planning"]["incremental_cycles"] == SMOKE["cycles"]
+        assert report["planning"]["merge_violations"] == 0
+        assert report["timeline"]["clean_after_final_heal"] is True
+        assert report["timeline"]["leak_stall_findings"] == 0
+        assert report["replay"]["ok"] is True
+        assert report["replay"]["drifts"] == 0
+        assert report["timeline"]["samples"] > 0
+
+    # verdict byte-identity across the two runs
+    payload1 = json.dumps(timeline1.findings_payload(), sort_keys=True)
+    payload2 = json.dumps(timeline2.findings_payload(), sort_keys=True)
+    assert payload1 == payload2
+
+    # whole-report identity minus the wall-clock overhead section (its
+    # booleans depend on host timing at smoke scale; the committed
+    # 1024-node bench is where they are load-bearing)
+    stable1 = {k: v for k, v in report1.items() if k != "overhead"}
+    stable2 = {k: v for k, v in report2.items() if k != "overhead"}
+    assert json.dumps(stable1, sort_keys=True) == json.dumps(stable2, sort_keys=True)
+
+    # the recorded streams agree on shape (timestamps differ)
+    kinds1 = sorted({r["kind"] for r in records1})
+    kinds2 = sorted({r["kind"] for r in records2})
+    assert kinds1 == kinds2
+    assert len(records1) == len(records2)

@@ -1,0 +1,185 @@
+"""Teeth tests: each detector family gets a deliberately injected fault
+and must emit all three ways at once — a finding in the store, a
+``HealthDegraded`` Event through the EventRecorder, and a
+``timeline.finding`` flight record that a ReplaySession recomputes
+bit-exactly after a full JSONL round-trip. If the detectors ever stop
+detecting (or the emission wiring silently breaks), these fail."""
+import json
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.kube.events import EventRecorder
+from nos_tpu.kube.objects import ConfigMap, ObjectMeta
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.record.recorder import FlightRecorder
+from nos_tpu.record.replay import ReplaySession
+from nos_tpu.timeline.sizes import SizeRegistry
+from nos_tpu.timeline.store import DetectorPolicy, TimelineStore
+from nos_tpu.timeline.watchdog import WedgeWatchdog
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds=1.0):
+        self.now += seconds
+
+
+class Harness:
+    """One wired timeline: isolated collectors, a KubeStore for Events,
+    a FlightRecorder for timeline.finding records."""
+
+    def __init__(self, policy, metrics_fn=lambda: {}):
+        self.clock = Clock()
+        self.sizes = SizeRegistry()
+        self.watchdog = WedgeWatchdog()
+        self.kube = KubeStore()
+        self.flight = FlightRecorder(seed=17)
+        self.recorder = EventRecorder(
+            self.kube, component="timeline", clock=self.clock
+        )
+        self.event_obj = ConfigMap(
+            metadata=ObjectMeta(name="nos-health", namespace="nos-system")
+        )
+        self.timeline = TimelineStore(
+            capacity=256,
+            interval_seconds=1.0,
+            clock=self.clock,
+            policy=policy,
+            vitals=False,
+            metrics_fn=metrics_fn,
+            sizes=self.sizes,
+            watchdog=self.watchdog,
+        )
+        self.timeline.attach(
+            flight=self.flight, recorder=self.recorder, event_obj=self.event_obj
+        )
+
+    def tick(self):
+        self.clock.advance()
+        return self.timeline.tick()
+
+    def assert_emitted(self, detector, series):
+        """The three-way emission contract plus bit-exact replay."""
+        # 1. the Event, against the health ConfigMap
+        events = self.kube.list("Event", namespace="nos-system")
+        assert len(events) == 1
+        event = events[0]
+        assert event.reason == constants.EVENT_REASON_HEALTH_DEGRADED
+        assert event.type == "Warning"
+        assert event.involved_kind == "ConfigMap"
+        assert f"{detector} finding on {series}" in event.message
+        # 2. the flight record carries the exact detector inputs
+        records = [
+            r for r in self.flight.records() if r["kind"] == "timeline.finding"
+        ]
+        assert len(records) == 1
+        record = records[0]
+        assert record["detector"] == detector
+        assert record["series"] == series
+        assert record["window"] and record["verdict"]
+        # 3. replay after a JSONL round-trip recomputes the verdict
+        wire = [json.loads(line) for line in self.flight.to_jsonl().splitlines()]
+        report = ReplaySession(wire).run()
+        assert report.timeline_findings == 1
+        assert report.drifts == []
+        assert report.ok()
+
+
+def test_leak_teeth():
+    """A genuinely unbounded structure under a size watch must produce a
+    leak finding once its growth passes the budget."""
+    harness = Harness(
+        DetectorPolicy(leak_budget=10.0, leak_min_points=4)
+    )
+    blob = []
+    harness.sizes.register("leaky.cache", lambda: len(blob))
+    findings = []
+    for _ in range(10):
+        blob.extend(range(5))
+        findings.extend(harness.tick())
+    assert [f["detector"] for f in findings] == ["leak"]
+    finding = findings[0]
+    assert finding["series"] == "size.leaky.cache"
+    assert finding["verdict"]["growth"] > 10.0
+    assert finding["verdict"]["slope_per_second"] > 0
+    harness.assert_emitted("leak", "size.leaky.cache")
+
+
+def test_stall_teeth():
+    """A periodic loop whose counter goes flat while registered alive
+    must produce a wedged-loop finding carrying a stacks payload."""
+    harness = Harness(DetectorPolicy(stall_flat_windows=3))
+    harness.watchdog.register(
+        "heartbeat", periodic=True, thread_name="heartbeat-thread"
+    )
+    findings = []
+    for _ in range(3):  # alive: the counter moves
+        harness.watchdog.beat("heartbeat")
+        findings.extend(harness.tick())
+    for _ in range(4):  # wedged: flat for flat_windows+1 samples
+        findings.extend(harness.tick())
+    assert [f["detector"] for f in findings] == ["stall"]
+    finding = findings[0]
+    assert finding["series"] == "loop.heartbeat"
+    assert finding["verdict"]["last_value"] == 3.0
+    assert isinstance(finding["stacks"], list)
+    harness.assert_emitted("stall", "loop.heartbeat")
+
+
+def test_regression_teeth():
+    """A watched latency series whose recent median rises past ratio ×
+    its baseline median must produce a regression finding."""
+    latency = {"nos_tpu_replan_p95": 10.0}
+    harness = Harness(
+        DetectorPolicy(
+            regression_series=("nos_tpu_replan_p95",),
+            regression_baseline_points=4,
+            regression_recent_points=4,
+            regression_ratio=1.5,
+        ),
+        metrics_fn=lambda: dict(latency),
+    )
+    findings = []
+    for _ in range(4):
+        findings.extend(harness.tick())
+    latency["nos_tpu_replan_p95"] = 30.0  # the regression lands
+    for _ in range(4):
+        findings.extend(harness.tick())
+    assert [f["detector"] for f in findings] == ["regression"]
+    finding = findings[0]
+    assert finding["series"] == "nos_tpu_replan_p95"
+    assert finding["verdict"]["baseline"] == 10.0
+    assert finding["verdict"]["recent"] == 30.0
+    assert finding["verdict"]["ratio"] == 3.0
+    harness.assert_emitted("regression", "nos_tpu_replan_p95")
+
+
+def test_refire_after_clear_emits_again():
+    """Hysteresis clears, the same fault re-fires: the second finding
+    emits a second flight record (distinct window, distinct verdict) and
+    both replay cleanly in one session."""
+    harness = Harness(DetectorPolicy(stall_flat_windows=3, clear_samples=2))
+    harness.watchdog.register("pump", periodic=True)
+    harness.watchdog.beat("pump")
+    for _ in range(5):
+        harness.tick()
+    for _ in range(2):  # recover long enough to clear
+        harness.watchdog.beat("pump")
+        harness.tick()
+    for _ in range(4):  # wedge again
+        harness.tick()
+    records = [
+        r for r in harness.flight.records() if r["kind"] == "timeline.finding"
+    ]
+    assert len(records) == 2
+    # distinct verdicts (different flat_since) -> distinct Events
+    events = harness.kube.list("Event", namespace="nos-system")
+    assert len(events) == 2
+    wire = [json.loads(line) for line in harness.flight.to_jsonl().splitlines()]
+    report = ReplaySession(wire).run()
+    assert report.timeline_findings == 2
+    assert report.drifts == []

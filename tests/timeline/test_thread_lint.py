@@ -1,0 +1,99 @@
+"""Lint: every module in nos_tpu/ that spawns a thread must wire its
+loops into the observability stack — profiler thread registration
+(``PROFILER.register_thread``) so wedged-loop findings can ship stacks,
+AND wedge-watchdog registration/beats so the timeline samples a
+``loop.*`` progress counter. A thread outside both is invisible exactly
+when it wedges.
+
+Grep-based on purpose (the partitioner no-deepcopy lint's idiom): the
+contract is per-module and textual, so a new ``threading.Thread(`` in a
+module with neither marker fails here, not in code review. Modules whose
+threads legitimately sit outside the contract carry a written
+justification below — an exemption without one doesn't parse."""
+import pathlib
+import re
+
+NOS_TPU = pathlib.Path(__file__).resolve().parents[2] / "nos_tpu"
+
+# Module -> why its threads are exempt from the register-both contract.
+EXEMPT = {
+    "chaos/driver.py": (
+        "chaos monitor/heal threads live and die inside one harness run; "
+        "the driver itself is the observer and its oracles are the alarm"
+    ),
+    "cmd/run.py": (
+        "metrics-snapshot writer: best-effort periodic file dump; a wedge "
+        "surfaces as a stale snapshot mtime, and the component loops the "
+        "CLI hosts are watchdog-covered in their own modules"
+    ),
+    "data/pipeline.py": (
+        "per-step prefetch workers are short-lived and throughput-covered "
+        "by the pipeline's own gauges"
+    ),
+    "kube/apistore.py": (
+        "HTTP watch pump mirrors the apiserver watch contract; staleness "
+        "surfaces as resourceVersion lag on reconnect, not a local wedge"
+    ),
+    "kube/leaderelection.py": (
+        "elector renew loop: a wedge loses the lease and triggers "
+        "failover — losing leadership IS the detection mechanism"
+    ),
+    "kube/webhook.py": "stdlib ThreadingHTTPServer request threads",
+    "record/recorder.py": (
+        "flight-recorder drain thread: the ring it feeds is leak-watched "
+        "via the size.record.flight_ring series instead"
+    ),
+    "sim/apiserver.py": "sim-harness stdlib HTTP server threads",
+    "util/batcher.py": "one-shot flush timer per batch window, not a loop",
+    "util/health.py": (
+        "stdlib ThreadingHTTPServer serving /debug — the surface the "
+        "timeline is read FROM; observing it with itself would recurse"
+    ),
+    "util/profiling.py": (
+        "the profiler's own sampler thread cannot meaningfully register "
+        "with itself"
+    ),
+}
+
+PROFILER_MARK = "register_thread"
+WATCHDOG_MARK = re.compile(r"(?:WATCHDOG|watchdog)\.(?:register|beat)\(")
+
+
+def spawner_files():
+    return sorted(
+        str(path.relative_to(NOS_TPU)).replace("\\", "/")
+        for path in NOS_TPU.rglob("*.py")
+        if "threading.Thread(" in path.read_text()
+    )
+
+
+def test_every_thread_spawner_registers_profiler_and_watchdog():
+    problems = []
+    for rel in spawner_files():
+        if rel in EXEMPT:
+            continue
+        text = (NOS_TPU / rel).read_text()
+        if PROFILER_MARK not in text:
+            problems.append(
+                f"{rel}: spawns a thread but never calls "
+                "PROFILER.register_thread — wedge findings there would "
+                "ship without stacks"
+            )
+        if not WATCHDOG_MARK.search(text):
+            problems.append(
+                f"{rel}: spawns a thread but never registers with or "
+                "beats the wedge watchdog — no loop.* series to "
+                "stall-check"
+            )
+    assert problems == [], "\n".join(problems)
+
+
+def test_exemptions_are_justified_and_live():
+    """Every exemption names a real thread-spawning module (stale
+    entries rot into blanket waivers) and carries a non-trivial
+    justification string."""
+    spawners = set(spawner_files())
+    stale = sorted(set(EXEMPT) - spawners)
+    assert stale == [], f"exempt modules no longer spawn threads: {stale}"
+    thin = sorted(rel for rel, why in EXEMPT.items() if len(why) < 20)
+    assert thin == [], f"exemptions without a real justification: {thin}"

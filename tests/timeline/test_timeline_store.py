@@ -1,0 +1,283 @@
+"""TimelineStore ring mechanics: delta encoding, eviction folding,
+carry-forward series reads, rollups/sparklines/debug payload, JSONL
+export, and detector hysteresis (fire once, clear after quiet, re-fire).
+
+Every store here is fully isolated — fake clock, private SizeRegistry
+and WedgeWatchdog, explicit metrics_fn, vitals off — so samples are a
+pure function of the test's own mutations."""
+import json
+
+from nos_tpu.timeline.detectors import STALL
+from nos_tpu.timeline.sizes import SizeRegistry
+from nos_tpu.timeline.store import DetectorPolicy, TimelineStore
+from nos_tpu.timeline.watchdog import WedgeWatchdog
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds=1.0):
+        self.now += seconds
+
+
+def make_store(values, *, clock=None, policy=None, sizes=None, watchdog=None, **kw):
+    """Store sampling a mutable dict the test owns."""
+    return TimelineStore(
+        clock=clock or Clock(),
+        policy=policy,
+        vitals=False,
+        metrics_fn=lambda: dict(values),
+        sizes=sizes or SizeRegistry(),
+        watchdog=watchdog or WedgeWatchdog(),
+        **kw,
+    )
+
+
+def frames(store):
+    """Parsed JSONL export: (base_frame, [delta_frames])."""
+    lines = [json.loads(line) for line in store.to_jsonl().splitlines()]
+    assert lines[0]["kind"] == "timeline.base"
+    return lines[0], lines[1:]
+
+
+class TestDeltaRing:
+    def test_first_sample_records_every_series(self):
+        values = {"a": 1.0, "b": 2.0}
+        store = make_store(values)
+        store.sample_once()
+        _, deltas = frames(store)
+        assert deltas == [{"t": 1000.0, "d": {"a": 1.0, "b": 2.0}}]
+
+    def test_unchanged_sample_is_an_empty_delta(self):
+        values = {"a": 1.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        store.sample_once()
+        clock.advance()
+        store.sample_once()
+        _, deltas = frames(store)
+        assert deltas[1]["d"] == {}
+
+    def test_delta_holds_only_the_changed_series(self):
+        values = {"a": 1.0, "b": 2.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        store.sample_once()
+        values["b"] = 5.0
+        clock.advance()
+        store.sample_once()
+        _, deltas = frames(store)
+        assert deltas[1]["d"] == {"b": 5.0}
+
+    def test_removed_series_writes_the_sentinel(self):
+        values = {"a": 1.0, "gone": 9.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        store.sample_once()
+        del values["gone"]
+        clock.advance()
+        store.sample_once()
+        _, deltas = frames(store)
+        assert deltas[1]["d"] == {"gone": None}
+        assert store.names() == ["a"]
+        # the removed series' points stop at the removal sample
+        assert len(store.series("gone")) == 1
+
+    def test_eviction_folds_into_the_base_frame(self):
+        values = {"ctr": 0.0}
+        clock = Clock()
+        store = make_store(values, clock=clock, capacity=3)
+        for i in range(5):
+            values["ctr"] = float(i)
+            store.sample_once()
+            clock.advance()
+        assert len(store) == 3
+        assert store.samples == 5
+        base, deltas = frames(store)
+        # two evicted samples folded: base carries the last evicted value
+        assert base == {"kind": "timeline.base", "base": {"ctr": 1.0}, "samples": 5}
+        # full per-sample values still reconstructible for retained samples
+        assert store.series("ctr") == [(1002.0, 2.0), (1003.0, 3.0), (1004.0, 4.0)]
+
+    def test_eviction_folds_removal_out_of_the_base(self):
+        values = {"a": 1.0, "gone": 9.0}
+        clock = Clock()
+        store = make_store(values, clock=clock, capacity=2)
+        store.sample_once()
+        del values["gone"]
+        for _ in range(3):
+            clock.advance()
+            store.sample_once()
+        base, _ = frames(store)
+        assert base["base"] == {"a": 1.0}
+
+
+class TestSeriesReads:
+    def test_carry_forward_through_unchanged_samples(self):
+        values = {"a": 1.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        store.sample_once()
+        clock.advance()
+        store.sample_once()  # unchanged
+        values["a"] = 3.0
+        clock.advance()
+        store.sample_once()
+        assert store.series("a") == [(1000.0, 1.0), (1001.0, 1.0), (1002.0, 3.0)]
+
+    def test_window_filter_keeps_the_recent_tail(self):
+        values = {"a": 0.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        for i in range(10):
+            values["a"] = float(i)
+            store.sample_once()
+            clock.advance()
+        points = store.series("a", window_seconds=3.0)
+        assert [v for _, v in points] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_series_many_matches_per_series_reads(self):
+        values = {"a": 1.0, "b": 2.0, "c": 3.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        for i in range(6):
+            values["a"] = float(i)
+            if i == 3:
+                del values["c"]
+            store.sample_once()
+            clock.advance()
+        names = ["a", "b", "c", "missing"]
+        many = store.series_many(names)
+        assert many == {name: store.series(name) for name in names}
+
+    def test_rollups_summarize_each_series(self):
+        values = {"a": 5.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        for v in (5.0, 9.0, 3.0, 7.0):
+            values["a"] = v
+            store.sample_once()
+            clock.advance()
+        roll = store.rollups()["a"]
+        assert roll == {
+            "first": 5.0,
+            "last": 7.0,
+            "min": 3.0,
+            "max": 9.0,
+            "delta": 2.0,
+            "points": 4,
+        }
+
+    def test_sparkline_resamples_long_series(self):
+        values = {"a": 0.0}
+        clock = Clock()
+        store = make_store(values, clock=clock)
+        for i in range(100):
+            values["a"] = float(i)
+            store.sample_once()
+            clock.advance()
+        spark = store.sparkline("a", points=8)
+        assert len(spark) == 8
+        assert spark[0] == 0.0 and spark[-1] == 99.0
+        assert spark == sorted(spark)
+
+    def test_sparkline_short_series_passes_through(self):
+        values = {"a": 1.0}
+        store = make_store(values)
+        store.sample_once()
+        assert store.sparkline("a", points=8) == [1.0]
+        assert store.sparkline("missing") == []
+
+
+class TestDebugPayload:
+    def test_payload_shape(self):
+        values = {"a": 1.0}
+        wd = WedgeWatchdog()
+        wd.register("pump", periodic=True)
+        store = make_store(values, watchdog=wd)
+        store.tick()
+        payload = store.debug_payload()
+        assert payload["samples"] == 1
+        assert payload["retained"] == 1
+        assert payload["capacity"] == store.capacity
+        assert payload["series_count"] == len(payload["rollups"])
+        assert set(payload["sparklines"]) == set(payload["rollups"])
+        assert payload["active_findings"] == []
+        assert payload["findings"] == []
+        assert payload["watchdog"]["loops"][0]["name"] == "pump"
+        json.dumps(payload)  # must be wire-serializable as-is
+
+    def test_payload_is_json_clean_with_findings(self):
+        values = {}
+        clock = Clock()
+        wd = WedgeWatchdog()
+        wd.register("pump", periodic=True)
+        policy = DetectorPolicy(stall_flat_windows=3, clear_samples=2)
+        store = make_store(values, clock=clock, policy=policy, watchdog=wd)
+        wd.beat("pump")
+        for _ in range(6):
+            store.tick()
+            clock.advance()
+        payload = store.debug_payload()
+        assert payload["active_findings"] == ["stall:loop.pump"]
+        assert [f["detector"] for f in payload["findings"]] == [STALL]
+        json.dumps(payload)
+
+
+class TestHysteresis:
+    def test_stall_fires_once_clears_then_refires(self):
+        values = {}
+        clock = Clock()
+        wd = WedgeWatchdog()
+        wd.register("pump", periodic=True)
+        policy = DetectorPolicy(stall_flat_windows=3, clear_samples=2)
+        store = make_store(values, clock=clock, policy=policy, watchdog=wd)
+
+        def tick():
+            clock.advance()
+            return store.tick()
+
+        wd.beat("pump")
+        tick()
+        wd.beat("pump")
+        tick()
+        # freeze: needs a 4-point flat tail after the last move
+        new = []
+        for _ in range(4):
+            new.extend(tick())
+        assert [f["detector"] for f in new] == [STALL]
+        assert new[0]["series"] == "loop.pump"
+        assert "stacks" in new[0]
+        # still wedged: the active finding refreshes silently
+        assert tick() == []
+        # recover: two beating ticks clear the finding (clear_samples=2)
+        wd.beat("pump")
+        assert tick() == []
+        wd.beat("pump")
+        assert tick() == []
+        # wedge again: a NEW finding fires
+        refires = []
+        for _ in range(4):
+            refires.extend(tick())
+        assert [f["detector"] for f in refires] == [STALL]
+        assert len(store.findings()) == 2
+
+    def test_findings_payload_elides_windows_and_stacks(self):
+        values = {}
+        clock = Clock()
+        wd = WedgeWatchdog()
+        wd.register("pump", periodic=True)
+        policy = DetectorPolicy(stall_flat_windows=3)
+        store = make_store(values, clock=clock, policy=policy, watchdog=wd)
+        wd.beat("pump")
+        for _ in range(6):
+            clock.advance()
+            store.tick()
+        payload = store.findings_payload()
+        (finding,) = payload["findings"]
+        assert set(finding) == {"t", "detector", "series", "verdict"}
+        json.dumps(payload, sort_keys=True)

@@ -1,0 +1,106 @@
+"""WedgeWatchdog: loop registration, beats, external counters, and the
+debug payload the timeline samples from."""
+from nos_tpu.timeline.watchdog import WedgeWatchdog
+
+
+class TestRegistration:
+    def test_registered_loop_starts_at_zero(self):
+        wd = WedgeWatchdog()
+        wd.register("pump")
+        assert wd.counters() == {"pump": 0.0}
+
+    def test_beat_increments(self):
+        wd = WedgeWatchdog()
+        wd.register("pump")
+        wd.beat("pump")
+        wd.beat("pump")
+        assert wd.counters() == {"pump": 2.0}
+
+    def test_beat_auto_registers_as_event_driven(self):
+        wd = WedgeWatchdog()
+        wd.beat("surprise")
+        assert wd.counters() == {"surprise": 1.0}
+        assert wd.periodic_loops() == []
+
+    def test_reregister_resets_and_retunes(self):
+        wd = WedgeWatchdog()
+        wd.register("pump", periodic=True)
+        wd.beat("pump")
+        wd.register("pump", periodic=False)
+        assert wd.counters() == {"pump": 0.0}
+        assert wd.periodic_loops() == []
+
+    def test_unregister_removes(self):
+        wd = WedgeWatchdog()
+        wd.register("pump")
+        wd.unregister("pump")
+        wd.unregister("never-registered")  # no-op
+        assert wd.counters() == {}
+
+
+class TestCounters:
+    def test_counter_fn_wins_over_beats(self):
+        wd = WedgeWatchdog()
+        wd.register("planner", counter_fn=lambda: 42)
+        wd.beat("planner")
+        assert wd.counters() == {"planner": 42.0}
+
+    def test_erroring_counter_fn_is_skipped_that_sample(self):
+        wd = WedgeWatchdog()
+        wd.register("bad", counter_fn=lambda: 1 / 0)
+        wd.register("good")
+        wd.beat("good")
+        assert wd.counters() == {"good": 1.0}
+        # the loop stays registered — next sample may succeed
+        assert wd.thread_name("bad") is None
+        assert [l["name"] for l in wd.debug_payload()["loops"]] == ["bad", "good"]
+
+    def test_periodic_loops_sorted(self):
+        wd = WedgeWatchdog()
+        wd.register("z-beat", periodic=True)
+        wd.register("a-beat", periodic=True)
+        wd.register("event", periodic=False)
+        assert wd.periodic_loops() == ["a-beat", "z-beat"]
+
+
+class TestStacks:
+    def test_no_thread_name_means_no_stacks(self):
+        wd = WedgeWatchdog()
+        wd.register("pump")
+        assert wd.stacks_for("pump") == []
+        assert wd.stacks_for("unknown") == []
+
+    def test_thread_name_recorded(self):
+        wd = WedgeWatchdog()
+        wd.register("pump", thread_name="pump-thread")
+        assert wd.thread_name("pump") == "pump-thread"
+        # no profiler samples for that thread in this test -> empty list,
+        # but the lookup path must not raise
+        assert isinstance(wd.stacks_for("pump"), list)
+
+
+class TestDebugPayload:
+    def test_shape(self):
+        wd = WedgeWatchdog()
+        wd.register("pump", periodic=True, thread_name="pump-thread")
+        wd.register("planner", counter_fn=lambda: 7)
+        wd.beat("pump")
+        payload = wd.debug_payload()
+        assert payload == {
+            "loops": [
+                {
+                    "name": "planner",
+                    "periodic": False,
+                    "thread": None,
+                    "external_counter": True,
+                    "beats": 0.0,
+                },
+                {
+                    "name": "pump",
+                    "periodic": True,
+                    "thread": "pump-thread",
+                    "external_counter": False,
+                    "beats": 1.0,
+                },
+            ]
+        }

@@ -31,6 +31,7 @@ def _fully_wired(**overrides) -> HealthServer:
         slo_fn=lambda: {"slos": {}},
         autoscaler_fn=lambda: {"servings": {}},
         forecast_fn=lambda refresh: {"refreshed": refresh},
+        timeline_fn=lambda window: {"window_seconds": window},
     )
     kwargs.update(overrides)
     return HealthServer(**kwargs)
@@ -93,6 +94,29 @@ class TestDebugIndexCompleteness:
             # Unconditional surfaces only; nothing indexed 404s.
             assert set(index) == {"/debug/traces", "/debug/vars"}
             assert _get(port, "/debug/forecast")[0] == 404
+        finally:
+            server.stop()
+
+
+class TestTimelineEndpoint:
+    def test_window_query_passes_through(self):
+        seen = []
+
+        def timeline_fn(window):
+            seen.append(window)
+            return {"window_seconds": window}
+
+        server = _fully_wired(metrics_token="", timeline_fn=timeline_fn)
+        port = server.start()
+        try:
+            status, body = _get(port, "/debug/timeline")
+            assert status == 200
+            assert json.loads(body) == {"window_seconds": None}
+            status, body = _get(port, "/debug/timeline?window=30")
+            assert status == 200
+            assert json.loads(body) == {"window_seconds": 30.0}
+            assert _get(port, "/debug/timeline?window=soon")[0] == 400
+            assert seen == [None, 30.0]
         finally:
             server.stop()
 

@@ -1,0 +1,205 @@
+"""Trend-diff the committed BENCH_*.json artifacts against a baseline.
+
+Every benchmark in this repo commits its report as a ``BENCH_*.json``
+whose numeric fields are deterministic at the pinned seed (wall-clock
+measurements are reduced to booleans before they reach the file). That
+makes the git history of each artifact a longitudinal record: a p50 that
+drifts up across commits is a perf regression landing in slow motion,
+a ``*_within_budget`` flipping false is one landing all at once.
+
+Default mode diffs the working tree against the previous commit
+(``git show HEAD^:BENCH_x.json``); ``--old-dir/--new-dir`` diff two
+directories instead (what the tests use — no git involved).
+
+Classification per numeric leaf (reports are flattened to dotted paths):
+
+- ``regressed``  — a boolean went truthy→falsy, or a magnitude moved
+  against its direction hint past ``--tolerance`` (relative). Leaves
+  whose last path segment suggests latency/loss (``*_ms``, ``*_seconds``,
+  ``p50/p95/p99``, ``drifts``, ``violations``, ``failures``) regress
+  upward; throughput-ish leaves (``*_per_s``, ``throughput``, ``ops``)
+  regress downward; anything else is direction-neutral and only
+  ``changed``.
+- ``improved`` / ``changed`` / ``added`` / ``removed`` — informational.
+
+Exit code is 0 unless inputs are malformed (or ``--fail-on-regression``
+is set and something regressed): the gate's job is to make the trend
+visible in CI logs, not to turn perf noise into a red build.
+
+  make bench-trend
+  python tools/bench_trend.py --fail-on-regression
+  python tools/bench_trend.py --old-dir /tmp/base --new-dir .
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REGRESS_UP = (
+    "_ms", "_seconds", "_s", "p50", "p95", "p99", "drifts", "violations",
+    "failures", "unsafe", "evictions", "misses",
+)
+REGRESS_DOWN = ("_per_s", "throughput", "ops", "hits", "goodput")
+
+
+def flatten(report: object, prefix: str = "") -> Dict[str, object]:
+    """Collapse a nested report to ``{"a.b.c": leaf}``. Lists index by
+    position; only scalar leaves are kept (strings included, compared by
+    equality only)."""
+    out: Dict[str, object] = {}
+    if isinstance(report, dict):
+        for key in sorted(report):
+            out.update(flatten(report[key], f"{prefix}{key}."))
+    elif isinstance(report, list):
+        for i, item in enumerate(report):
+            out.update(flatten(item, f"{prefix}{i}."))
+    else:
+        out[prefix[:-1]] = report
+    return out
+
+
+def direction(path: str) -> int:
+    """+1 = bigger is worse, -1 = smaller is worse, 0 = neutral."""
+    leaf = path.rsplit(".", 1)[-1]
+    if any(leaf.endswith(h) or leaf == h.strip("_") for h in REGRESS_UP):
+        return 1
+    if any(h in leaf for h in REGRESS_DOWN):
+        return -1
+    return 0
+
+
+def classify(path: str, old: object, new: object, tolerance: float) -> Optional[str]:
+    """One leaf's verdict: 'regressed' / 'improved' / 'changed' / None
+    (within tolerance or equal)."""
+    if isinstance(old, bool) or isinstance(new, bool):
+        if bool(old) == bool(new):
+            return None
+        return "regressed" if bool(old) and not bool(new) else "improved"
+    if isinstance(old, (int, float)) and isinstance(new, (int, float)):
+        if old == new:
+            return None
+        base = max(abs(old), 1e-12)
+        rel = (new - old) / base
+        if abs(rel) <= tolerance:
+            return None
+        sign = direction(path)
+        if sign == 0:
+            return "changed"
+        worse = rel > 0 if sign > 0 else rel < 0
+        return "regressed" if worse else "improved"
+    return None if old == new else "changed"
+
+
+def diff_reports(
+    old: dict, new: dict, tolerance: float
+) -> List[Tuple[str, str, object, object]]:
+    """(verdict, path, old, new) rows, regressions first."""
+    flat_old, flat_new = flatten(old), flatten(new)
+    rows: List[Tuple[str, str, object, object]] = []
+    for path in sorted(set(flat_old) | set(flat_new)):
+        if path not in flat_old:
+            rows.append(("added", path, None, flat_new[path]))
+        elif path not in flat_new:
+            rows.append(("removed", path, flat_old[path], None))
+        else:
+            verdict = classify(path, flat_old[path], flat_new[path], tolerance)
+            if verdict is not None:
+                rows.append((verdict, path, flat_old[path], flat_new[path]))
+    order = {"regressed": 0, "improved": 1, "changed": 2, "added": 3, "removed": 4}
+    rows.sort(key=lambda r: (order[r[0]], r[1]))
+    return rows
+
+
+def _parse(text: str) -> object:
+    """One JSON document, or JSONL (bench_planner appends line-records)
+    parsed to the list of its documents."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def _git_show(ref: str, name: str, repo: str) -> Optional[object]:
+    proc = subprocess.run(
+        ["git", "show", f"{ref}:{name}"],
+        cwd=repo,
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        return None  # new artifact: no baseline at this ref
+    return _parse(proc.stdout)
+
+
+def _load(path: str) -> Optional[object]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return _parse(fh.read())
+
+
+def render(name: str, rows: List[Tuple[str, str, object, object]]) -> str:
+    if not rows:
+        return f"{name}: unchanged"
+    lines = [f"{name}:"]
+    for verdict, path, old, new in rows:
+        lines.append(f"  {verdict:9s} {path}: {old!r} -> {new!r}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Diff committed BENCH_*.json artifacts against a baseline"
+    )
+    parser.add_argument(
+        "--ref", default="HEAD^", help="git baseline ref (default: HEAD^)"
+    )
+    parser.add_argument(
+        "--old-dir", default="", help="baseline directory instead of git"
+    )
+    parser.add_argument(
+        "--new-dir", default="", help="candidate directory (default: repo root)"
+    )
+    parser.add_argument("--tolerance", type=float, default=0.10)
+    parser.add_argument("--fail-on-regression", action="store_true")
+    args = parser.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    new_dir = args.new_dir or repo
+    names = sorted(
+        os.path.basename(p) for p in glob.glob(os.path.join(new_dir, "BENCH_*.json"))
+    )
+    if not names:
+        print(f"bench-trend: no BENCH_*.json found in {new_dir}", file=sys.stderr)
+        return 1
+
+    regressions = 0
+    for name in names:
+        new = _load(os.path.join(new_dir, name))
+        if new is None:
+            continue
+        if args.old_dir:
+            old = _load(os.path.join(args.old_dir, name))
+        else:
+            old = _git_show(args.ref, name, repo)
+        if old is None:
+            print(f"{name}: no baseline (new artifact)")
+            continue
+        rows = diff_reports(old, new, args.tolerance)
+        print(render(name, rows))
+        regressions += sum(1 for r in rows if r[0] == "regressed")
+
+    if regressions:
+        print(f"bench-trend: {regressions} regression(s) past tolerance")
+        if args.fail_on_regression:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
