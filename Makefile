@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke forecast-smoke soak-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast bench-soak bench-trend examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke procpool-smoke forecast-smoke soak-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast bench-soak bench-trend examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -64,6 +64,16 @@ shard-smoke:
 	    tests/partitioning/test_snapcodec.py \
 	    tests/controllers/test_sharded_controller.py -q -m 'not slow'
 	JAX_PLATFORMS=cpu $(PY) bench_planner.py --plan-mode sharded --quick
+
+# Multi-process pool planning gate: wire framing + warm-state transport
+# through real spawned workers, the process-spawner watchdog lint, and
+# the end-to-end A/B — a 2-pool process-backend controller byte-identical
+# to its serial twin, including a worker killed mid-stream recovering
+# with zero drift.
+procpool-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/partitioning/test_procpool.py \
+	    tests/controllers/test_procpool_smoke.py \
+	    tests/timeline/test_thread_lint.py -q -m 'not slow'
 
 # Placement-forecaster gate: engine/advisor/accuracy unit tier plus the
 # streaming calibration bench run twice in-process — byte-identical

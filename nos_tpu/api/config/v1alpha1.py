@@ -69,6 +69,16 @@ class GpuPartitionerConfig:
     pool_parallelism: str = "serial"
     # Thread-mode worker cap; 0 = one worker per pool.
     pool_max_workers: int = 0
+    # Pool execution backend (partitioning/core/procpool.py): empty =
+    # follow pool_parallelism; "process" runs one long-lived worker
+    # process per pool, delta-fed across cycles — the only mode that
+    # escapes the GIL on multi-core hosts. A dead/wedged worker escalates
+    # that pool to in-parent serial planning and respawns from a fresh
+    # wire image.
+    pool_backend: str = ""
+    # How long the parent waits for ALL process-backend plan replies in
+    # one cycle before declaring the stragglers wedged.
+    pool_cycle_timeout_seconds: float = 5.0
     # When set, persist the planners' warm state (carve-futility and
     # verdict memos keyed by node-state signature) to this file so a
     # restart or full-rebuild fallback warm-boots instead of replaying
@@ -113,6 +123,14 @@ class GpuPartitionerConfig:
             )
         if self.pool_max_workers < 0:
             raise ConfigError("pool_max_workers must be >= 0")
+        if self.pool_backend not in ("", "serial", "thread", "process"):
+            raise ConfigError(
+                "pool_backend must be '', 'serial', 'thread', or 'process'"
+            )
+        if self.pool_backend == "process" and not self.pool_sharding:
+            raise ConfigError("pool_backend 'process' requires pool_sharding")
+        if self.pool_cycle_timeout_seconds <= 0:
+            raise ConfigError("pool_cycle_timeout_seconds must be > 0")
         if self.warm_state_save_interval_seconds < 0:
             raise ConfigError(
                 "warm_state_save_interval_seconds must be >= 0"

@@ -51,6 +51,12 @@ class ChaosConfig:
     minimize: bool = True
     fixtures_dir: str = ""  # minimized repro lands here on failure
     export_path: str = ""   # full log always exported here when set
+    # Pool plan execution backend for the partitioner under test ("" =
+    # config default, i.e. serial in-parent). "process" spawns one
+    # long-lived planner worker per pool AND arms the worker-kill fault:
+    # the schedule may SIGKILL a live worker mid-run, and the burst still
+    # has to converge through the escalate-to-in-parent + respawn path.
+    pool_backend: str = ""
 
 
 @dataclass
@@ -127,6 +133,11 @@ class ChaosDriver:
             self.node_names,
             backend=self.config.backend,
             burst_s=self.config.burst_s,
+            extra_kinds=(
+                (F.WORKER_KILL,)
+                if self.config.pool_backend == "process"
+                else ()
+            ),
         )
         self._dead_nodes: Dict[str, object] = {}
         self._cordoned: List[str] = []
@@ -196,6 +207,12 @@ class ChaosDriver:
                 # and chaos pods carry no pool-pinning selectors so most
                 # cycles exercise the mega-pool degradation as well.
                 pool_sharding=True,
+                # "" keeps the serial in-parent default; "process" puts
+                # every pool plan behind the worker-process transport so
+                # the schedule's worker-kill faults have something to
+                # kill (and every other fault class crosses the process
+                # boundary too).
+                pool_backend=self.config.pool_backend,
                 # Forecasting rides every chaos run: the background
                 # forecaster keeps publishing ETAs through the faults and
                 # the forecast-calibrated oracle (check_convergence)
@@ -376,6 +393,34 @@ class ChaosDriver:
             log.info(
                 "chaos: wall clock skewed %.1fs ahead of monotonic", fault.param
             )
+        elif kind == F.WORKER_KILL:
+            self._kill_worker()
+
+    def _kill_worker(self) -> None:
+        """Terminate one live pool-planner worker process WITHOUT telling
+        its parent controller: the next plan cycle must notice the dead
+        pipe itself, escalate that pool to in-parent planning, and
+        respawn from a fresh wire image (partitioning/core/procpool.py).
+        Workers spawn lazily on the first sharded cycle, so a kill that
+        lands before any exist is a recorded no-op."""
+        controllers = [self.cluster.partitioner]
+        sharing = getattr(self.cluster.partitioner, "sharing", None)
+        if sharing is not None:
+            controllers.append(sharing)
+        for controller in controllers:
+            worker_pool = getattr(controller, "_worker_pool", None)
+            if worker_pool is None:
+                continue
+            pool = worker_pool.chaos_kill_one()
+            if pool is not None:
+                self.injector.record(F.WORKER_KILL)
+                log.info(
+                    "chaos: killed %s pool worker for pool %s",
+                    controller.kind,
+                    pool,
+                )
+                return
+        log.info("chaos: worker-kill fired with no live pool worker")
 
     def _kill_node(self, name: str) -> None:
         if name in self._dead_nodes:

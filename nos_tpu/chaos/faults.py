@@ -30,6 +30,11 @@ CLOCK_SKEW = "clock-skew"          # wall clock runs ahead of monotonic
 WATCH_SEVER = "watch-sever"        # cut a watch stream mid-chunk
 API_ERRORS = "api-errors"          # 503 bursts on API verbs
 API_LATENCY = "api-latency"        # per-request added latency
+# Opt-in only (never in ALL_KINDS: adding a kind to the sample pool
+# would reshuffle every pinned seed's schedule). Armed by passing it
+# through build_schedule's ``extra_kinds``; the driver enables it when
+# the run's partitioner uses the process pool backend.
+WORKER_KILL = "worker-kill"        # SIGKILL one pool-planner worker process
 
 _HTTP_KINDS = (WATCH_SEVER, API_ERRORS, API_LATENCY)
 ALL_KINDS = (
@@ -66,12 +71,18 @@ def build_schedule(
     nodes: List[str],
     backend: str = "memory",
     burst_s: float = 2.0,
+    extra_kinds: Tuple[str, ...] = (),
 ) -> List[Burst]:
     """The seed's entire story, decided up front: which faults fire in
     which burst, against which node, at what offset, and which workload
-    pods ride along. Pure — no clocks, no global RNG."""
+    pods ride along. Pure — no clocks, no global RNG.
+
+    ``extra_kinds`` appends opt-in kinds (e.g. WORKER_KILL) to the sample
+    pool; with the default () every pinned seed's schedule is unchanged.
+    """
     rng = random.Random(seed)
     kinds = [k for k in ALL_KINDS if backend == "apiserver" or k not in _HTTP_KINDS]
+    kinds += [k for k in extra_kinds if k not in kinds]
     out: List[Burst] = []
     for index in range(bursts):
         burst = Burst(index=index, duration_s=burst_s)

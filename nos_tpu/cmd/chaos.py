@@ -30,6 +30,13 @@ def _parse(argv):
     )
     parser.add_argument("--burst-seconds", type=float, default=2.0)
     parser.add_argument(
+        "--pool-backend",
+        choices=("", "serial", "thread", "process"),
+        default="",
+        help="partitioner pool plan backend; 'process' runs one planner "
+        "worker per pool and arms the worker-kill fault",
+    )
+    parser.add_argument(
         "--timeout",
         type=float,
         default=30.0,
@@ -75,6 +82,7 @@ def _run_one(args, seed: int) -> int:
         nodes=args.nodes,
         backend=args.backend,
         burst_s=args.burst_seconds,
+        pool_backend=args.pool_backend,
         convergence_timeout_s=args.timeout,
         minimize=not args.no_minimize,
         fixtures_dir=args.fixtures_dir,

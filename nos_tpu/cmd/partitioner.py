@@ -146,6 +146,8 @@ def build_partitioner(
         pool_sharding=config.pool_sharding,
         pool_parallelism=config.pool_parallelism,
         pool_max_workers=config.pool_max_workers,
+        pool_backend=config.pool_backend,
+        pool_cycle_timeout_seconds=config.pool_cycle_timeout_seconds,
         # Warm-state files are per mode: the two controllers' planners
         # memoize against different snapshot shapes.
         warm_state_path=(
@@ -277,6 +279,8 @@ def build_partitioner(
         pool_sharding=config.pool_sharding,
         pool_parallelism=config.pool_parallelism,
         pool_max_workers=config.pool_max_workers,
+        pool_backend=config.pool_backend,
+        pool_cycle_timeout_seconds=config.pool_cycle_timeout_seconds,
         warm_state_path=(
             f"{config.warm_state_path}.sharing"
             if config.warm_state_path

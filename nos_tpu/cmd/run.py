@@ -66,6 +66,8 @@ def configs_from(config: dict):
         pool_sharding=p.get("poolSharding", False),
         pool_parallelism=p.get("poolParallelism", "serial"),
         pool_max_workers=p.get("poolMaxWorkers", 0),
+        pool_backend=p.get("poolBackend", ""),
+        pool_cycle_timeout_seconds=p.get("poolCycleTimeoutSeconds", 5.0),
         warm_state_path=p.get("warmStatePath", ""),
         warm_state_save_interval_seconds=p.get(
             "warmStateSaveIntervalSeconds", 30.0
@@ -108,6 +110,10 @@ def seed_node(spec: dict) -> Node:
     }
     if "sharedChips" in spec:
         node_labels[labels.SHARED_CHIPS_LABEL] = str(spec["sharedChips"])
+    if "nodepool" in spec:
+        # Pool membership for the sharded planner (poolSharding /
+        # poolBackend drills); unlabeled nodes form one shared pool.
+        node_labels[labels.GKE_NODEPOOL_LABEL] = str(spec["nodepool"])
     return Node(
         metadata=ObjectMeta(
             name=spec["name"],
@@ -136,6 +142,11 @@ def seed_pod(spec: dict) -> Pod:
 
         pod_labels[GANG_NAME_LABEL] = str(spec["gang"])
         pod_labels[GANG_SIZE_LABEL] = str(spec.get("gangSize", 1))
+    node_selector = {}
+    if "nodepool" in spec:
+        # Pin to one pool so pool partitioning stays decomposed — an
+        # unpinned pod reaches every pool and collapses the shards.
+        node_selector[labels.GKE_NODEPOOL_LABEL] = str(spec["nodepool"])
     return Pod(
         metadata=ObjectMeta(
             name=spec["name"],
@@ -145,6 +156,7 @@ def seed_pod(spec: dict) -> Pod:
         spec=PodSpec(
             containers=[Container(requests=dict(requests), limits=dict(requests))],
             scheduler_name=spec.get("schedulerName", constants.SCHEDULER_NAME),
+            node_selector=node_selector,
         ),
     )
 

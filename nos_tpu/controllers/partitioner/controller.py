@@ -62,6 +62,8 @@ class PartitionerController:
         pool_sharding: bool = False,
         pool_parallelism: str = "serial",
         pool_max_workers: int = 0,
+        pool_backend: str = "",
+        pool_cycle_timeout_seconds: float = 5.0,
         warm_state_path: str = "",
         warm_state_save_interval_seconds: float = 30.0,
         forecaster=None,
@@ -126,6 +128,27 @@ class PartitionerController:
         self.pool_sharding = pool_sharding and incremental_planning
         self.pool_parallelism = pool_parallelism
         self.pool_max_workers = pool_max_workers
+        # Pool execution backend (procpool.py): empty = follow
+        # pool_parallelism; "process" runs one long-lived worker process
+        # per pool, fed dirty-node deltas, escalating to in-parent serial
+        # planning (plus a pool rebuild) for any pool whose worker dies
+        # or wedges past the cycle timeout.
+        self.pool_backend = pool_backend
+        self.pool_cycle_timeout_seconds = pool_cycle_timeout_seconds
+        self._worker_pool = None
+        # Why process planning can be refused at runtime: a framework
+        # whose plugins fall outside procpool's distributable registry.
+        self._process_disabled = False
+        # Per-pool replica of the WORKER's post-plan base state: refreshed
+        # with the same dirty deltas the worker gets, overlaid with the
+        # touched nodes each plan reply ships. Reconstructing desired from
+        # it (instead of from the parent's observed-only pool bases) keeps
+        # carve retries alive when an actuation write is lost.
+        self._pool_mirror: Dict[str, Dict] = {}
+        # Parent-owned fairness ledger for process mode: worker-local
+        # first-seen clocks would drift across processes and reset on
+        # respawn, so the parent stamps ages and ships them explicitly.
+        self._pending_ledger = None
         self._shard_maintainer = None
         self._pool_planners: Dict[str, Planner] = {}
         # Warm-state persistence (snapcodec.py): after each plan cycle the
@@ -324,6 +347,9 @@ class PartitionerController:
         WATCHDOG.unregister(f"partitioner-{self.kind}")
         SIZES.unregister(f"planner.{self.kind}.verdict_cache")
         SIZES.unregister(f"planner.{self.kind}.futility_memo")
+        if self._worker_pool is not None:
+            self._worker_pool.close()
+            self._worker_pool = None
         if self._thread:
             self._thread.join(timeout=2.0)
 
@@ -639,40 +665,60 @@ class PartitionerController:
         metrics.PLAN_POOL_COUNT.labels(kind=self.kind).set(
             len(partition.pools)
         )
-
-        def make_task(pool: str):
-            def task():
-                planner = self._pool_planners[pool]
-                pool_snapshot = pool_snaps[pool]
-                # Pre-plan state FIRST: plan() commits successful carves
-                # into its base, so this is the last chance to read the
-                # pool's current geometry (merge-invariant and actuation
-                # baseline).
-                pool_current = pool_snapshot.partitioning_state()
-                t0 = time.monotonic()
-                desired = planner.plan(
-                    pool_snapshot, pool_pending[pool], dirty=pool_dirty[pool]
+        backend = self._effective_backend()
+        if backend == "process" and self._ensure_worker_pool(snapshot) is None:
+            backend = self._effective_backend()
+        if backend == "process":
+            pool_desired, pool_current, unserved, pending_ages = (
+                self._plan_pools_process(
+                    snapshot,
+                    partition,
+                    pool_snaps,
+                    pool_dirty,
+                    pool_pending,
+                    maintainer,
                 )
-                duration = time.monotonic() - t0
-                return desired, pool_current, duration
+            )
+        else:
+            metrics.PLAN_BACKEND.labels(backend=backend).inc(
+                len(partition.pools)
+            )
 
-            return task
+            def make_task(pool: str):
+                def task():
+                    planner = self._pool_planners[pool]
+                    pool_snapshot = pool_snaps[pool]
+                    # Pre-plan state FIRST: plan() commits successful
+                    # carves into its base, so this is the last chance to
+                    # read the pool's current geometry (merge-invariant
+                    # and actuation baseline).
+                    pool_current = pool_snapshot.partitioning_state()
+                    t0 = time.monotonic()
+                    desired = planner.plan(
+                        pool_snapshot,
+                        pool_pending[pool],
+                        dirty=pool_dirty[pool],
+                    )
+                    duration = time.monotonic() - t0
+                    return desired, pool_current, duration
 
-        tasks = {pool: make_task(pool) for pool in partition.pools}
-        outcomes = run_pool_plans(
-            tasks, self.pool_parallelism, self.pool_max_workers
-        )
-        pool_desired = {}
-        pool_current = {}
-        unserved: Dict[str, str] = {}
-        pending_ages: Dict[str, float] = {}
-        for pool, (desired, pool_cur, duration) in outcomes.items():
-            pool_desired[pool] = desired
-            pool_current[pool] = pool_cur
-            metrics.PLAN_POOL_DURATION.labels(pool=pool).observe(duration)
-            planner = self._pool_planners[pool]
-            unserved.update(planner.last_unserved)
-            pending_ages.update(planner.last_pending_ages)
+                return task
+
+            tasks = {pool: make_task(pool) for pool in partition.pools}
+            outcomes = run_pool_plans(
+                tasks, backend, self.pool_max_workers
+            )
+            pool_desired = {}
+            pool_current = {}
+            unserved = {}
+            pending_ages = {}
+            for pool, (desired, pool_cur, duration) in outcomes.items():
+                pool_desired[pool] = desired
+                pool_current[pool] = pool_cur
+                metrics.PLAN_POOL_DURATION.labels(pool=pool).observe(duration)
+                planner = self._pool_planners[pool]
+                unserved.update(planner.last_unserved)
+                pending_ages.update(planner.last_pending_ages)
         audit_runs = [
             (
                 pool,
@@ -708,6 +754,201 @@ class PartitionerController:
             audit_runs,
         )
 
+    # -------------------------------------------------- process backend
+
+    def _effective_backend(self) -> str:
+        """serial | thread | process — pool_backend wins when set, else
+        pool_parallelism; a refused process backend (non-distributable
+        framework) degrades to the thread/serial ladder."""
+        backend = self.pool_backend or self.pool_parallelism
+        if backend == "process" and self._process_disabled:
+            return "thread" if self.pool_parallelism == "thread" else "serial"
+        return backend if backend in ("thread", "process") else "serial"
+
+    def _ensure_worker_pool(self, snapshot):
+        from nos_tpu.partitioning.core.procpool import (
+            PoolWorkerPool,
+            framework_spec,
+            planner_knobs,
+        )
+
+        if self._worker_pool is not None:
+            return self._worker_pool
+        spec = framework_spec(self.planner.framework)
+        if spec is None:
+            self._process_disabled = True
+            log.warning(
+                "partitioner[%s]: framework has plugins outside the "
+                "distributable registry; process pool backend disabled, "
+                "falling back to %s",
+                self.kind,
+                self._effective_backend(),
+            )
+            return None
+        self._worker_pool = PoolWorkerPool(
+            kind=self.kind,
+            slice_codec_name=type(snapshot.codec).__name__,
+            spec=spec,
+            knobs=planner_knobs(self.planner),
+            cycle_timeout_seconds=self.pool_cycle_timeout_seconds,
+            warm_state_path=(
+                self._warm_codec.path if self._warm_codec is not None else ""
+            ),
+        )
+        return self._worker_pool
+
+    def _plan_pools_process(
+        self, snapshot, partition, pool_snaps, pool_dirty, pool_pending, maintainer
+    ):
+        """One process-backend plan cycle: ship dirty deltas + pending +
+        parent-stamped fairness ages to every pool's worker, collect plan
+        replies under the cycle deadline, reconstruct each pool's desired
+        state from the mirror + touched nodes, and escalate any
+        unavailable pool to an in-parent plan plus a pool rebuild (the
+        rebuild re-bootstraps every worker from one consistent wire
+        image next cycle)."""
+        from nos_tpu.kube.serde import pod_to_wire
+        from nos_tpu.partitioning.core.partition_state import (
+            partitioning_state_from_dict,
+        )
+        from nos_tpu.partitioning.core.procpool import (
+            PendingSeenLedger,
+            WorkerUnavailable,
+            quotas_to_wire,
+            snapshot_node_to_wire,
+        )
+
+        worker_pool = self._worker_pool
+        if maintainer.last_rebuilt:
+            # Pool shapes changed: every worker's base is keyed to a dead
+            # partition — re-bootstrap all of them from the fresh pool
+            # bases, and restart the mirrors from the same states.
+            self._pool_mirror = {}
+            worker_pool.sync_pools(partition.pools)
+            quotas = quotas_to_wire(
+                self.store.list("ElasticQuota"),
+                self.store.list("CompositeElasticQuota"),
+            )
+            for pool in sorted(partition.pools):
+                entries = [
+                    snapshot_node_to_wire(snap_node)
+                    for _, snap_node in sorted(
+                        pool_snaps[pool].get_nodes().items()
+                    )
+                ]
+                try:
+                    worker_pool.bootstrap(pool, entries, quotas)
+                except WorkerUnavailable:
+                    pass  # surfaces again in plan_cycle; escalated below
+        if self._pending_ledger is None:
+            self._pending_ledger = PendingSeenLedger()
+        all_pending = [
+            pod for pool in sorted(pool_pending) for pod in pool_pending[pool]
+        ]
+        ages = self._pending_ledger.ages(all_pending)
+        requests = {}
+        for pool in partition.pools:
+            nodes = pool_snaps[pool].get_nodes()
+            # Freshly bootstrapped workers already hold this cycle's
+            # refreshed state — deltas would be redundant re-sends.
+            deltas = (
+                []
+                if maintainer.last_rebuilt
+                else [
+                    snapshot_node_to_wire(nodes[name])
+                    for name in sorted(pool_dirty[pool])
+                    if name in nodes
+                ]
+            )
+            requests[pool] = {
+                "deltas": deltas,
+                "pending": [pod_to_wire(pod) for pod in pool_pending[pool]],
+                "ages": {
+                    pod.namespaced_name: ages[pod.namespaced_name]
+                    for pod in pool_pending[pool]
+                },
+                # Quota edges never cross pools (partition_pools merges
+                # on them), so out-of-pool usage is structurally zero
+                # today; the seam stays live for future cross-pool quota.
+                "external_usage": {},
+            }
+        replies = worker_pool.plan_cycle(requests)
+        pool_desired = {}
+        pool_current = {}
+        unserved = {}
+        pending_ages = {}
+        for pool in sorted(partition.pools):
+            # Pre-plan state FIRST (an escalated in-parent plan below
+            # commits carves into this same base).
+            current = pool_snaps[pool].partitioning_state()
+            pool_current[pool] = current
+            mirror = self._pool_mirror.get(pool)
+            if mirror is None:
+                mirror = dict(current)
+            else:
+                for name in pool_dirty[pool]:
+                    if name in current:
+                        mirror[name] = current[name]
+            reply = replies.get(pool)
+            proxy = self._pool_planners[pool]
+            if isinstance(reply, dict):
+                mirror.update(partitioning_state_from_dict(reply["touched"]))
+                self._pool_mirror[pool] = mirror
+                pool_desired[pool] = dict(mirror)
+                unserved.update(reply["unserved"])
+                pending_ages.update(reply["pending_ages"])
+                metrics.PLAN_POOL_DURATION.labels(pool=pool).observe(
+                    reply["duration"]
+                )
+                metrics.PLAN_BACKEND.labels(backend="process").inc()
+                # The proxy planner fronts for the worker in audit runs:
+                # its (empty) memos satisfy the cache checks trivially,
+                # and the shadow replan keys off these attributes.
+                proxy.last_plan_mode = reply["plan_mode"]
+                proxy.last_unserved = dict(reply["unserved"])
+                proxy.last_pending_ages = dict(reply["pending_ages"])
+            else:
+                reason = (
+                    reply.reason
+                    if isinstance(reply, WorkerUnavailable)
+                    else "no reply"
+                )
+                t0 = time.monotonic()
+                desired = proxy.plan(
+                    pool_snaps[pool],
+                    pool_pending[pool],
+                    pending_ages=dict(requests[pool]["ages"]),
+                    dirty=pool_dirty[pool],
+                )
+                metrics.PLAN_POOL_DURATION.labels(pool=pool).observe(
+                    time.monotonic() - t0
+                )
+                metrics.PLAN_BACKEND.labels(backend="escalated").inc()
+                pool_desired[pool] = desired
+                # The in-parent plan committed into the parent pool base,
+                # which the (re)spawned worker's wire image cannot carry:
+                # rebuild next cycle so mirror, worker, and parent resync
+                # from one image.
+                self._pool_mirror.pop(pool, None)
+                unserved.update(proxy.last_unserved)
+                pending_ages.update(proxy.last_pending_ages)
+                maintainer.force_rebuild()
+                if self.flight_recorder is not None:
+                    self.flight_recorder.record_pool_escalation(
+                        kind=self.kind,
+                        pool=pool,
+                        revision=self.store.revision,
+                        reason=reason,
+                    )
+                log.warning(
+                    "partitioner[%s]: pool %s escalated to in-parent "
+                    "planning (%s); pools rebuild next cycle",
+                    self.kind,
+                    pool,
+                    reason,
+                )
+        return pool_desired, pool_current, unserved, pending_ages
+
     # ------------------------------------------------------- warm state
 
     def _publish_warm_boot(self, report) -> None:
@@ -742,6 +983,27 @@ class PartitionerController:
         # their committed geometry, which the global (observed-only) base
         # may not have caught up with yet.
         _snapshot, _dirty, _partition, pool_snaps, _pool_dirty = shard
+        if (
+            self._effective_backend() == "process"
+            and self._worker_pool is not None
+        ):
+            # The memos live in the workers; so do the node states they
+            # were derived from — each worker exports its entries WITH
+            # its own precomputed signatures (rate-limited by due()).
+            entries = {}
+            signatures: Dict[str, str] = {}
+            for pool in sorted(self._worker_pool.pools()):
+                exported = self._worker_pool.export_warm(pool)
+                if exported is None:
+                    continue
+                pool_entries, pool_signatures = exported
+                entries.update(pool_entries)
+                signatures.update(pool_signatures)
+            if signatures:
+                self._warm_codec.save_entries(
+                    snapshot, entries, signatures=signatures
+                )
+            return
         entries: Dict[str, dict] = {}
         signing_nodes: Dict[str, object] = {}
         for pool, planner in self._pool_planners.items():

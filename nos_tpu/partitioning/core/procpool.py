@@ -1,0 +1,761 @@
+"""Multi-process pool planning: one long-lived worker process per pool.
+
+PR 13's pool-sharded planner decomposed the cluster into independent
+pools, but CPython's GIL makes thread-parallel pool planning a wash on
+wall-clock (bench_planner's serial-vs-thread rows say so). This module
+escapes the GIL the only way CPython allows: each pool's planner runs in
+its own PROCESS, holding that pool's warm incremental state — base
+snapshot, version-keyed verdict/futility memos, candidate order —
+resident across cycles, so the per-cycle boundary cost is the dirty-node
+delta, never the world.
+
+Protocol (snapcodec frames — header-versioned JSON over a pipe; live
+snapshot objects are never pickled):
+
+- ``bootstrap``: the pool's full wire image (one serde node + bound-pod
+  projection per SnapshotNode, quota objects, planner knobs, framework
+  spec) sent at spawn and after every pool rebuild. The worker rebuilds
+  its replica store and base snapshot through the taker's
+  ``take_snapshot_node`` — the exact constructor the parent used — and
+  optionally warm-adopts persisted memos from the shared warm-state file.
+- ``cycle``: rv-ordered dirty-node deltas (refreshed node + its bound
+  pods), the pool's pending pods, parent-ledger fairness ages, and
+  out-of-pool quota usage. The worker refreshes its base, replans, and
+  replies with the TOUCHED nodes' board assignments plus the unserved
+  ledger — the parent reconstructs the pool's desired PartitioningState
+  from its own pre-plan state for untouched nodes, preserving the
+  object-identity fast path ``check_merge_invariants`` relies on.
+- ``export``: the planner's warm-state memo entries, for the parent's
+  rate-limited save (signatures are taken parent-side from the pool
+  bases the parent already owns).
+
+Pool membership is static between rebuilds (PoolShardedMaintainer
+rebuilds on ANY node_pool change, and node add/delete forces an inner
+rebuild), so cycle frames never need add/remove — a shape change always
+arrives as a fresh bootstrap.
+
+Robustness: a timeout, EOF, or frame error marks the worker dead; the
+parent escalates that pool to in-process serial planning for the cycle
+and respawns the worker from a fresh wire image next cycle. The auditor's
+shadow replans always run in-parent against the parent's own pool bases,
+so a corrupted worker cannot self-certify its plans.
+"""
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import os
+import time
+from typing import Dict, List, Optional, Set
+
+from nos_tpu.partitioning.core.snapcodec import (
+    SNAPSHOT_CODEC_VERSION,
+    FrameError,
+    decode_frame,
+    encode_frame,
+)
+
+log = logging.getLogger("nos_tpu.partitioner")
+
+# ------------------------------------------------------------ wire docs
+
+
+def snapshot_node_to_wire(snap_node) -> dict:
+    """One SnapshotNode's wire projection: the raw kube Node plus its
+    bound pods, via the sim apiserver's serde codec. Everything the
+    taker's ``take_snapshot_node`` derives (usage, frozen flag, board
+    geometry) is recomputed receiver-side from these inputs, so the two
+    sides can never disagree about derivation."""
+    from nos_tpu.kube.serde import node_to_wire, pod_to_wire
+
+    return {
+        "node": node_to_wire(snap_node.partitionable.node),
+        "pods": [pod_to_wire(pod) for pod in snap_node.pods],
+    }
+
+
+def snapshot_node_from_wire(entry: dict, taker):
+    from nos_tpu.kube.serde import node_from_wire, pod_from_wire
+
+    node = node_from_wire(entry["node"])
+    pods = [pod_from_wire(d) for d in entry["pods"]]
+    return node, pods, taker.take_snapshot_node(node, pods)
+
+
+def quotas_to_wire(quotas, composite_quotas) -> List[dict]:
+    from nos_tpu.kube.serde import ceq_to_wire, eq_to_wire
+
+    return [
+        {"kind": "ElasticQuota", "doc": eq_to_wire(q)} for q in quotas
+    ] + [
+        {"kind": "CompositeElasticQuota", "doc": ceq_to_wire(q)}
+        for q in composite_quotas
+    ]
+
+
+def quotas_from_wire(entries: List[dict]):
+    from nos_tpu.kube.serde import ceq_from_wire, eq_from_wire
+
+    out = []
+    for entry in entries:
+        if entry["kind"] == "ElasticQuota":
+            out.append(eq_from_wire(entry["doc"]))
+        else:
+            out.append(ceq_from_wire(entry["doc"]))
+    return out
+
+
+# ------------------------------------------------------- framework spec
+#
+# The worker cannot receive a live Framework (its plugins may hold the
+# parent's store), so the parent derives a SPEC — ordered plugin class
+# names per chain — and the worker rebuilds the same plugin set against
+# its own replica store. Only plugins in this registry are
+# distributable; an unknown plugin makes framework_spec() return None
+# and the controller falls back to thread/serial planning rather than
+# silently planning with a different policy.
+
+_PURE_PLUGINS = (
+    "NodeResourcesFit",
+    "NodeSelectorFit",
+    "NodeAffinityFit",
+    "TaintTolerationFit",
+    "NodeUnschedulableFit",
+    "PodTopologySpreadFit",
+    "InterPodAffinityFit",
+)
+_STORE_PLUGINS = ("CapacityScheduling", "MultihostIciFilter", "BoardReservation")
+
+
+def framework_spec(framework) -> Optional[dict]:
+    """The distributable projection of a Framework, or None when any
+    plugin (or a non-empty chain the planner would run) falls outside
+    the registry."""
+    if (
+        framework.post_filter_plugins
+        or framework.reserve_plugins
+        or framework.permit_plugins
+    ):
+        return None
+    spec: dict = {"pre_filter": [], "filter": []}
+    for chain, plugins in (
+        ("pre_filter", framework.pre_filter_plugins),
+        ("filter", framework.filter_plugins),
+    ):
+        for plugin in plugins:
+            name = type(plugin).__name__
+            if name not in _PURE_PLUGINS and name not in _STORE_PLUGINS:
+                return None
+            spec[chain].append(name)
+            if name == "CapacityScheduling":
+                spec["chip_memory_gb"] = plugin.chip_memory_gb
+    return spec
+
+
+def build_framework_from_spec(spec: dict, store):
+    from nos_tpu.scheduler import framework as fw
+    from nos_tpu.scheduler.plugins.capacity import CapacityScheduling
+    from nos_tpu.scheduler.plugins.reservation import BoardReservation
+    from nos_tpu.scheduler.plugins.topology import MultihostIciFilter
+
+    def build(name: str):
+        if name == "CapacityScheduling":
+            return CapacityScheduling(store, spec.get("chip_memory_gb"))
+        if name == "MultihostIciFilter":
+            return MultihostIciFilter(store)
+        if name == "BoardReservation":
+            return BoardReservation(store)
+        return getattr(fw, name)()
+
+    return fw.Framework(
+        pre_filter_plugins=[build(n) for n in spec["pre_filter"]],
+        filter_plugins=[build(n) for n in spec["filter"]],
+    )
+
+
+def planner_knobs(planner) -> dict:
+    return {
+        "aging_chips_per_second": planner.aging_chips_per_second,
+        "verdict_cache_enabled": planner.verdict_cache_enabled,
+        "reuse_gang_trial": planner.reuse_gang_trial,
+        "futility_memo_enabled": planner.futility_memo_enabled,
+        "incremental_dirty_threshold": planner.incremental_dirty_threshold,
+    }
+
+
+def _slice_codec(name: str):
+    from nos_tpu.partitioning.core.codec import SharedSliceCodec, TpuSliceCodec
+
+    return {"TpuSliceCodec": TpuSliceCodec, "SharedSliceCodec": SharedSliceCodec}[
+        name
+    ]()
+
+
+def _taker(kind: str):
+    if kind == "sharing":
+        from nos_tpu.partitioning.sharing.snapshot_taker import (
+            SharingSnapshotTaker,
+        )
+
+        return SharingSnapshotTaker()
+    from nos_tpu.partitioning.tpu.snapshot_taker import TpuSnapshotTaker
+
+    return TpuSnapshotTaker()
+
+
+# --------------------------------------------------- pending-age ledger
+
+
+class PendingSeenLedger:
+    """Parent-side analogue of the planner's ``_pending_seen`` fairness
+    ledger. With workers in separate processes, each worker's internal
+    first-seen clock would drift from its siblings' (and reset on
+    respawn, zeroing a starved pod's age) — so the PARENT owns one
+    ledger and ships explicit ages every cycle, exactly the
+    ``pending_ages`` override ``plan()`` already honors for replay."""
+
+    TTL_S = 600.0
+
+    def __init__(self) -> None:
+        self._seen: Dict[str, tuple] = {}
+
+    def ages(self, pods, now: Optional[float] = None) -> Dict[str, float]:
+        now = time.monotonic() if now is None else now
+        ages: Dict[str, float] = {}
+        for pod in pods:
+            key = pod.namespaced_name
+            first, _ = self._seen.get(key, (now, now))
+            self._seen[key] = (first, now)
+            ages[key] = now - first
+        stale = [
+            key
+            for key, (_, last) in self._seen.items()
+            if now - last > self.TTL_S
+        ]
+        for key in stale:
+            del self._seen[key]
+        return ages
+
+
+# ------------------------------------------------------- worker process
+
+
+def pool_worker_main(conn, pool: str, kind: str) -> None:
+    """Worker entry point (spawn target, importable at module level).
+    Owns one pool's replica store, base snapshot, and planner; serves
+    bootstrap/cycle/export/ping frames until ``stop`` or EOF. Any
+    unexpected exception is reported as an ``error`` reply — the parent
+    treats it like a crash (escalate + respawn), never as a plan."""
+    state = _WorkerState(pool, kind)
+    while True:
+        try:
+            request = decode_frame(conn.recv_bytes())
+        except (EOFError, OSError):
+            return
+        except FrameError as exc:
+            # A frame we cannot trust means we can no longer prove our
+            # state matches the parent's: exit and let the parent
+            # respawn us from a fresh wire image.
+            try:
+                conn.send_bytes(
+                    encode_frame({"op": "error", "detail": str(exc)})
+                )
+            except (OSError, ValueError):
+                pass
+            return
+        op = request.get("op")
+        if op == "stop":
+            return
+        try:
+            reply = state.dispatch(request)
+        except Exception as exc:  # noqa: BLE001 — crash reporting seam
+            reply = {
+                "op": "error",
+                "seq": request.get("seq"),
+                "detail": f"{type(exc).__name__}: {exc}",
+            }
+        try:
+            conn.send_bytes(encode_frame(reply))
+        except (OSError, ValueError):
+            return
+
+
+class _WorkerState:
+    """Everything a worker process owns for its pool."""
+
+    def __init__(self, pool: str, kind: str) -> None:
+        self.pool = pool
+        self.kind = kind
+        self.store = None
+        self.base = None
+        self.planner = None
+        self.taker = _taker(kind)
+        self.capacity_plugin = None
+        self.bootstrap_dirty: Set[str] = set()
+        # node name -> keys of its bound pods in the replica store, so a
+        # refresh can retract pods that left the node.
+        self._node_pods: Dict[str, Set[str]] = {}
+        self._pending_keys: Set[str] = set()
+        # Signature memoizer for export (WarmStateCodec caches per node
+        # version); the path may be empty — this instance never saves.
+        self._sig_codec = None
+
+    def dispatch(self, request: dict) -> dict:
+        op = request["op"]
+        if op == "bootstrap":
+            return self.bootstrap(request)
+        if op == "cycle":
+            return self.cycle(request)
+        if op == "export":
+            return self.export()
+        if op == "ping":
+            return {"op": "pong", "seq": request.get("seq")}
+        return {"op": "error", "detail": f"unknown op {op!r}"}
+
+    # -------------------------------------------------------- bootstrap
+
+    def bootstrap(self, request: dict) -> dict:
+        from nos_tpu.kube.store import KubeStore
+        from nos_tpu.partitioning.core.planner import Planner
+        from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+
+        from nos_tpu.tpu.known import set_known_geometries
+
+        if request.get("codec_version") != SNAPSHOT_CODEC_VERSION:
+            # The parent speaks a different snapshot vocabulary than this
+            # worker's tree. Refuse — adopting would be silent corruption
+            # — and let the parent cold-boot a fresh worker.
+            return {
+                "op": "reject",
+                "seq": request.get("seq"),
+                "detail": (
+                    f"codec version {request.get('codec_version')!r} != "
+                    f"{SNAPSHOT_CODEC_VERSION}"
+                ),
+            }
+        # Module-global geometry overrides do not survive the spawn:
+        # replay the parent's so board derivation is bit-identical.
+        set_known_geometries(request.get("geometry_overrides") or None)
+        self.store = KubeStore()
+        self._node_pods = {}
+        self._pending_keys = set()
+        for quota in quotas_from_wire(request.get("quotas", [])):
+            self.store.apply_event("ADDED", quota)
+        nodes = {}
+        for entry in request["nodes"]:
+            node, pods, snap_node = snapshot_node_from_wire(entry, self.taker)
+            if snap_node is None:
+                return {
+                    "op": "error",
+                    "seq": request.get("seq"),
+                    "detail": f"node {node.metadata.name} out of taker scope",
+                }
+            self._apply_node(node, pods)
+            nodes[node.metadata.name] = snap_node
+        self.base = ClusterSnapshot(
+            nodes, codec=_slice_codec(request["slice_codec"])
+        )
+        framework = build_framework_from_spec(request["framework"], self.store)
+        self.capacity_plugin = next(
+            (
+                plugin
+                for plugin in framework.pre_filter_plugins
+                if type(plugin).__name__ == "CapacityScheduling"
+            ),
+            None,
+        )
+        self.planner = Planner(framework, **request["knobs"])
+        self.bootstrap_dirty = set(nodes)
+        from nos_tpu.partitioning.core.snapcodec import WarmStateCodec
+
+        warm_path = request.get("warm_state_path") or ""
+        self._sig_codec = WarmStateCodec(warm_path)
+        adopted = 0
+        if warm_path:
+            report = self._sig_codec.adopt(self.base, self.planner)
+            self.bootstrap_dirty = set(report.unmatched)
+            adopted = report.adopted_entries
+        return {
+            "op": "ready",
+            "seq": request.get("seq"),
+            "pool": self.pool,
+            "nodes": len(nodes),
+            "adopted_entries": adopted,
+            "pid": os.getpid(),
+        }
+
+    def _apply_node(self, node, pods) -> None:
+        """Upsert one node and its bound-pod set into the replica store,
+        retracting pods that were bound here last time but are gone."""
+        self.store.apply_event("MODIFIED", node)
+        keys = set()
+        for pod in pods:
+            self.store.apply_event("MODIFIED", pod)
+            keys.add(pod.namespaced_name)
+        for stale in self._node_pods.get(node.metadata.name, set()) - keys:
+            namespace, _, name = stale.partition("/")
+            try:
+                self.store.delete("Pod", name, namespace)
+            except KeyError:
+                pass
+        self._node_pods[node.metadata.name] = keys
+
+    # ------------------------------------------------------------ cycle
+
+    def cycle(self, request: dict) -> dict:
+        from nos_tpu.kube.serde import pod_from_wire
+
+        if self.base is None:
+            return {
+                "op": "error",
+                "seq": request.get("seq"),
+                "detail": "cycle before bootstrap",
+            }
+        dirty: Set[str] = set(self.bootstrap_dirty)
+        self.bootstrap_dirty = set()
+        for entry in request.get("deltas", []):
+            node, pods, snap_node = snapshot_node_from_wire(entry, self.taker)
+            if snap_node is None:
+                return {
+                    "op": "error",
+                    "seq": request.get("seq"),
+                    "detail": f"delta {node.metadata.name} out of taker scope",
+                }
+            self._apply_node(node, pods)
+            self.base.refresh_node(node.metadata.name, snap_node)
+            dirty.add(node.metadata.name)
+        pending = [pod_from_wire(d) for d in request.get("pending", [])]
+        pending_keys = set()
+        for pod in pending:
+            self.store.apply_event("MODIFIED", pod)
+            pending_keys.add(pod.namespaced_name)
+        for stale in self._pending_keys - pending_keys:
+            namespace, _, name = stale.partition("/")
+            try:
+                self.store.delete("Pod", name, namespace)
+            except KeyError:
+                pass
+        self._pending_keys = pending_keys
+        if self.capacity_plugin is not None:
+            self.capacity_plugin.set_external_usage(
+                request.get("external_usage", {})
+            )
+        current = self.base.partitioning_state()
+        t0 = time.perf_counter()
+        desired = self.planner.plan(
+            self.base,
+            pending,
+            dirty=dirty,
+            pending_ages=dict(request.get("ages", {})),
+        )
+        duration = time.perf_counter() - t0
+        # Only nodes the plan actually changed cross the boundary back:
+        # partitioning_state() memoizes per node version, so an untouched
+        # node's desired entry IS (identity) its pre-plan entry.
+        touched = {
+            name: {
+                str(b.board_index): dict(b.resources) for b in np.boards
+            }
+            for name, np in desired.items()
+            if np is not current.get(name)
+        }
+        return {
+            "op": "plan",
+            "seq": request.get("seq"),
+            "pool": self.pool,
+            "touched": touched,
+            "unserved": dict(self.planner.last_unserved),
+            "pending_ages": dict(self.planner.last_pending_ages),
+            "plan_mode": self.planner.last_plan_mode,
+            "duration": duration,
+        }
+
+    # ----------------------------------------------------------- export
+
+    def export(self) -> dict:
+        if self.planner is None or self.base is None:
+            return {"op": "entries", "pool": self.pool, "entries": {}, "signatures": {}}
+        entries = self.planner.export_warm_state(self.base)
+        # Sign with THIS base's node states — the memos were derived from
+        # its committed geometry, which only exists in this process.
+        signatures = {
+            name: self._sig_codec._signature(name, snap_node)
+            for name, snap_node in self.base.get_nodes().items()
+        }
+        return {
+            "op": "entries",
+            "pool": self.pool,
+            "entries": entries,
+            "signatures": signatures,
+        }
+
+
+# -------------------------------------------------------- parent façade
+
+
+class WorkerUnavailable(RuntimeError):
+    """A worker that cannot serve this cycle: dead, wedged past the
+    timeout, or speaking an untrusted frame. Carries the reason the
+    escalation path records."""
+
+    def __init__(self, pool: str, reason: str) -> None:
+        super().__init__(f"pool {pool}: {reason}")
+        self.pool = pool
+        self.reason = reason
+
+
+class _Worker:
+    """One spawned worker process + its parent-side pipe end."""
+
+    def __init__(self, ctx, pool: str, kind: str) -> None:
+        self.pool = pool
+        parent_conn, child_conn = ctx.Pipe(duplex=True)
+        self.conn = parent_conn
+        self.process = ctx.Process(
+            target=pool_worker_main,
+            args=(child_conn, pool, kind),
+            name=f"poolworker-{pool}",
+            daemon=True,
+        )
+        self.process.start()
+        child_conn.close()
+        self.bootstrapped = False
+        self.replies = 0
+
+    def send(self, doc: dict) -> None:
+        self.conn.send_bytes(encode_frame(doc))
+
+    def recv(self, timeout: float) -> dict:
+        if not self.conn.poll(timeout):
+            raise TimeoutError(f"no reply within {timeout:.1f}s")
+        return decode_frame(self.conn.recv_bytes())
+
+    def kill(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        if self.process.is_alive():
+            self.process.terminate()
+        self.process.join(timeout=2.0)
+
+
+class PoolWorkerPool:
+    """The parent-side façade the controller (and bench) drives: spawn
+    once per pool, bootstrap on rebuild, one ``plan_cycle`` per plan
+    cycle with all sends up front and a shared deadline on the collect
+    side, escalation surfaced as ``WorkerUnavailable`` per pool rather
+    than a failed cycle."""
+
+    def __init__(
+        self,
+        kind: str,
+        slice_codec_name: str,
+        spec: dict,
+        knobs: dict,
+        cycle_timeout_seconds: float = 5.0,
+        bootstrap_timeout_seconds: float = 60.0,
+        warm_state_path: str = "",
+    ) -> None:
+        self.kind = kind
+        self.slice_codec_name = slice_codec_name
+        self.spec = spec
+        self.knobs = knobs
+        self.cycle_timeout = cycle_timeout_seconds
+        self.bootstrap_timeout = bootstrap_timeout_seconds
+        self.warm_state_path = warm_state_path
+        self._ctx = multiprocessing.get_context("spawn")
+        self._workers: Dict[str, _Worker] = {}
+        self._seq = 0
+        self.restarts = 0
+
+    # ---------------------------------------------------------- helpers
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _watchdog_register(self, pool: str) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
+        worker = self._workers.get(pool)
+        WATCHDOG.register(
+            f"poolworker.{pool}",
+            periodic=False,
+            counter_fn=(lambda w=worker: w.replies) if worker else None,
+        )
+
+    def _drop(self, pool: str, reason: str) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+        from nos_tpu.util import metrics
+
+        worker = self._workers.pop(pool, None)
+        if worker is not None:
+            worker.kill()
+        WATCHDOG.unregister(f"poolworker.{pool}")
+        metrics.PLAN_WORKER_RESTARTS.inc()
+        self.restarts += 1
+        log.warning(
+            "procpool[%s]: dropping worker for pool %s: %s",
+            self.kind,
+            pool,
+            reason,
+        )
+
+    # -------------------------------------------------------- lifecycle
+
+    def pools(self) -> Set[str]:
+        return set(self._workers)
+
+    def sync_pools(self, pools) -> None:
+        """Spawn workers for new pools, retire workers whose pool no
+        longer exists. New workers are un-bootstrapped until the next
+        ``bootstrap`` call covers them."""
+        wanted = set(pools)
+        for pool in sorted(set(self._workers) - wanted):
+            self._drop(pool, "pool no longer exists")
+        for pool in sorted(wanted - set(self._workers)):
+            self._workers[pool] = _Worker(self._ctx, pool, self.kind)
+            self._watchdog_register(pool)
+
+    def needs_bootstrap(self, pool: str) -> bool:
+        worker = self._workers.get(pool)
+        return worker is None or not worker.bootstrapped
+
+    def bootstrap(self, pool: str, entries: List[dict], quotas: List[dict]) -> None:
+        """Ship one pool's full wire image; raises WorkerUnavailable on
+        rejection or timeout (caller escalates and retries next cycle)."""
+        if pool not in self._workers:
+            self._workers[pool] = _Worker(self._ctx, pool, self.kind)
+            self._watchdog_register(pool)
+        from nos_tpu.tpu.known import known_geometry_overrides
+
+        worker = self._workers[pool]
+        seq = self._next_seq()
+        doc = {
+            "op": "bootstrap",
+            "seq": seq,
+            "codec_version": SNAPSHOT_CODEC_VERSION,
+            "geometry_overrides": known_geometry_overrides(),
+            "pool": pool,
+            "slice_codec": self.slice_codec_name,
+            "framework": self.spec,
+            "knobs": self.knobs,
+            "nodes": entries,
+            "quotas": quotas,
+            "warm_state_path": self.warm_state_path,
+        }
+        try:
+            worker.send(doc)
+            reply = worker.recv(self.bootstrap_timeout)
+        except (OSError, EOFError, TimeoutError, FrameError, ValueError) as exc:
+            self._drop(pool, f"bootstrap failed: {exc}")
+            raise WorkerUnavailable(pool, f"bootstrap failed: {exc}") from exc
+        if reply.get("op") != "ready" or reply.get("seq") != seq:
+            # A reject (codec-version mismatch) or error: this worker can
+            # never serve — cold-boot a fresh one next cycle.
+            detail = reply.get("detail", f"unexpected reply {reply.get('op')!r}")
+            self._drop(pool, f"bootstrap rejected: {detail}")
+            raise WorkerUnavailable(pool, f"bootstrap rejected: {detail}")
+        worker.bootstrapped = True
+        worker.replies += 1
+
+    # ------------------------------------------------------------ cycle
+
+    def plan_cycle(self, requests: Dict[str, dict]) -> Dict[str, object]:
+        """One plan cycle across pools: send every request first (the
+        workers plan concurrently — this is the whole point), then
+        collect under one shared deadline. Returns ``{pool: reply}``
+        where a reply is either the worker's plan document or a
+        WorkerUnavailable instance for pools the caller must escalate."""
+        from nos_tpu.timeline.watchdog import WATCHDOG
+        from nos_tpu.util import metrics
+
+        results: Dict[str, object] = {}
+        sent: Dict[str, tuple] = {}
+        for pool in sorted(requests):
+            worker = self._workers.get(pool)
+            if worker is None or not worker.bootstrapped:
+                results[pool] = WorkerUnavailable(pool, "not bootstrapped")
+                continue
+            doc = dict(requests[pool])
+            doc["op"] = "cycle"
+            doc["seq"] = self._next_seq()
+            try:
+                worker.send(doc)
+            except (OSError, ValueError) as exc:
+                self._drop(pool, f"send failed: {exc}")
+                results[pool] = WorkerUnavailable(pool, f"send failed: {exc}")
+                continue
+            sent[pool] = (worker, doc["seq"], time.perf_counter())
+        deadline = time.perf_counter() + self.cycle_timeout
+        for pool, (worker, seq, t0) in sent.items():
+            remaining = deadline - time.perf_counter()
+            try:
+                reply = worker.recv(max(0.0, remaining))
+            except (OSError, EOFError, TimeoutError, FrameError) as exc:
+                reason = f"{type(exc).__name__}: {exc}"
+                self._drop(pool, reason)
+                results[pool] = WorkerUnavailable(pool, reason)
+                continue
+            if reply.get("op") != "plan" or reply.get("seq") != seq:
+                reason = reply.get(
+                    "detail", f"unexpected reply {reply.get('op')!r}"
+                )
+                self._drop(pool, reason)
+                results[pool] = WorkerUnavailable(pool, reason)
+                continue
+            rtt = time.perf_counter() - t0
+            metrics.PLAN_WORKER_RTT.observe(rtt)
+            worker.replies += 1
+            WATCHDOG.beat(f"poolworker.{pool}")
+            results[pool] = reply
+        return results
+
+    # ----------------------------------------------------------- export
+
+    def export_warm(self, pool: str) -> Optional[tuple]:
+        """The worker's warm-state ``(memo entries, node signatures)``,
+        or None when the worker cannot serve (the caller just skips that
+        pool's entries)."""
+        worker = self._workers.get(pool)
+        if worker is None or not worker.bootstrapped:
+            return None
+        seq = self._next_seq()
+        try:
+            worker.send({"op": "export", "seq": seq})
+            reply = worker.recv(self.cycle_timeout)
+        except (OSError, EOFError, TimeoutError, FrameError, ValueError) as exc:
+            self._drop(pool, f"export failed: {exc}")
+            return None
+        if reply.get("op") != "entries":
+            self._drop(pool, "export returned no entries")
+            return None
+        worker.replies += 1
+        return reply.get("entries", {}), reply.get("signatures", {})
+
+    # ------------------------------------------------------------ chaos
+
+    def chaos_kill_one(self) -> Optional[str]:
+        """Terminate one live worker process WITHOUT cleaning up parent
+        state — the chaos driver's worker-kill fault. The parent
+        discovers the death through the normal cycle path (timeout/EOF)
+        and must escalate + respawn; returns the pool killed."""
+        for pool in sorted(self._workers):
+            worker = self._workers[pool]
+            if worker.process.is_alive():
+                worker.process.terminate()
+                return pool
+        return None
+
+    def close(self) -> None:
+        from nos_tpu.timeline.watchdog import WATCHDOG
+
+        for pool, worker in sorted(self._workers.items()):
+            try:
+                worker.send({"op": "stop"})
+            except (OSError, ValueError):
+                pass
+            worker.kill()
+            WATCHDOG.unregister(f"poolworker.{pool}")
+        self._workers = {}

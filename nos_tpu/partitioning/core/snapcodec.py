@@ -35,6 +35,7 @@ import hashlib
 import json
 import logging
 import os
+import struct
 import tempfile
 import time
 from dataclasses import dataclass, field
@@ -46,6 +47,81 @@ from nos_tpu.util import resources as res
 log = logging.getLogger("nos_tpu.partitioner")
 
 SNAPSHOT_CODEC_VERSION = 1
+
+# ---------------------------------------------------------- wire framing
+#
+# The multi-process pool backend (procpool.py) ships snapshot state and
+# plan cycles between the parent and its worker processes as FRAMES over
+# a pipe: a fixed header (magic + codec version + payload length) in
+# front of one canonical JSON document. Live snapshot objects are never
+# pickled across the boundary — the payloads are the same wire
+# projections the sim apiserver's HTTP codec uses (kube/serde.py) plus
+# this module's save_entries() document shape, so "what crosses the
+# process boundary" and "what persists to disk" share one versioned
+# vocabulary. A header mismatch is a protocol error the receiver can
+# detect BEFORE parsing (a worker built from an older tree rejects the
+# frame instead of mis-adopting state), and a short read surfaces as
+# FrameError so the parent's reaction is a clean respawn, never a
+# half-applied delta.
+
+FRAME_MAGIC = b"NOSW"
+_FRAME_HEADER = struct.Struct(">4sII")  # magic, codec version, payload len
+
+
+class FrameError(ValueError):
+    """A wire frame that cannot be trusted: bad magic, codec-version
+    mismatch, truncated payload, or unparseable JSON. Receivers treat
+    any FrameError as grounds to drop the peer (the parent respawns the
+    worker from a fresh wire image; a worker exits and lets the parent's
+    timeout path take over)."""
+
+
+def encode_frame(doc: dict) -> bytes:
+    """One framed message: header + canonical JSON payload."""
+    payload = json.dumps(doc, separators=(",", ":"), sort_keys=True).encode(
+        "utf-8"
+    )
+    return _FRAME_HEADER.pack(FRAME_MAGIC, SNAPSHOT_CODEC_VERSION, len(payload)) + payload
+
+
+def decode_frame(data: bytes) -> dict:
+    """Parse one framed message, validating header before payload."""
+    if len(data) < _FRAME_HEADER.size:
+        raise FrameError(f"short frame: {len(data)} bytes")
+    magic, version, length = _FRAME_HEADER.unpack_from(data)
+    if magic != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if version != SNAPSHOT_CODEC_VERSION:
+        raise FrameError(
+            f"frame codec version {version} != {SNAPSHOT_CODEC_VERSION}"
+        )
+    payload = data[_FRAME_HEADER.size:]
+    if len(payload) != length:
+        raise FrameError(
+            f"truncated frame: header says {length} bytes, got {len(payload)}"
+        )
+    try:
+        doc = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise FrameError(f"unparseable frame payload: {exc}") from exc
+    if not isinstance(doc, dict):
+        raise FrameError(f"frame payload is {type(doc).__name__}, not object")
+    return doc
+
+
+def _canon_quantities(mapping) -> list:
+    """Sorted (key, value) pairs with numerically-equal values rendered
+    identically: the serde wire codec parses every quantity to float, so
+    a node observed in-parent (``memory: 128``) and the same node
+    rebuilt from a wire frame (``memory: 128.0``) must not hash apart —
+    that mismatch would silently cold-boot every process-backend worker
+    whose warm file the serial path saved."""
+    out = []
+    for key, value in sorted(mapping.items()):
+        if isinstance(value, float) and value.is_integer():
+            value = int(value)
+        out.append((key, value))
+    return out
 
 
 def node_state_signature(snap_node: SnapshotNode) -> str:
@@ -67,12 +143,12 @@ def node_state_signature(snap_node: SnapshotNode) -> str:
         if node is not None
         else [],
         "unschedulable": bool(node.spec.unschedulable) if node is not None else False,
-        "capacity": sorted(node.status.capacity.items()) if node is not None else [],
-        "allocatable": sorted(node.status.allocatable.items())
+        "capacity": _canon_quantities(node.status.capacity) if node is not None else [],
+        "allocatable": _canon_quantities(node.status.allocatable)
         if node is not None
         else [],
         "geometry": [
-            [index, sorted(geometry.items())]
+            [index, _canon_quantities(geometry)]
             for index, geometry in sorted(part.geometry().items())
         ],
         "pods": sorted(
@@ -80,7 +156,7 @@ def node_state_signature(snap_node: SnapshotNode) -> str:
                 pod.metadata.namespace,
                 pod.metadata.name,
                 str(pod.metadata.uid),
-                sorted(res.compute_pod_request(pod).items()),
+                _canon_quantities(res.compute_pod_request(pod)),
             ]
             for pod in snap_node.pods
         ),
@@ -154,26 +230,39 @@ class WarmStateCodec:
         now: Optional[float] = None,
         force: bool = False,
         nodes: Optional[Dict[str, SnapshotNode]] = None,
+        signatures: Optional[Dict[str, str]] = None,
     ) -> bool:
         """Persist pre-exported memo entries against node signatures.
         ``nodes`` overrides the signing set: the sharded controller signs
         with the POOL bases' nodes (the exact states its memos were
         derived from — the pool bases carry planned-but-not-yet-observed
         geometry the global base lacks), merged across pools (node keys
-        are disjoint)."""
+        are disjoint). ``signatures`` overrides signing entirely with
+        precomputed per-node hashes: the process backend's workers hash
+        their OWN base nodes (the states their memos came from live in
+        another address space) and ship name→signature with the export."""
         now = time.time() if now is None else now
         if not force and now - self._last_save < self.save_interval_seconds:
             return False
-        if nodes is None:
-            nodes = snapshot.get_nodes()
         nodes_doc: Dict[str, dict] = {}
-        for name, snap_node in nodes.items():
-            memos = entries.get(name, {})
-            nodes_doc[name] = {
-                "signature": self._signature(name, snap_node),
-                "futility": memos.get("futility", []),
-                "verdicts": memos.get("verdicts", []),
-            }
+        if signatures is not None:
+            for name, signature in signatures.items():
+                memos = entries.get(name, {})
+                nodes_doc[name] = {
+                    "signature": signature,
+                    "futility": memos.get("futility", []),
+                    "verdicts": memos.get("verdicts", []),
+                }
+        else:
+            if nodes is None:
+                nodes = snapshot.get_nodes()
+            for name, snap_node in nodes.items():
+                memos = entries.get(name, {})
+                nodes_doc[name] = {
+                    "signature": self._signature(name, snap_node),
+                    "futility": memos.get("futility", []),
+                    "verdicts": memos.get("verdicts", []),
+                }
         doc = {
             "codec_version": SNAPSHOT_CODEC_VERSION,
             "slice_codec": type(snapshot.codec).__name__,

@@ -270,6 +270,23 @@ class FlightRecorder:
             applied=applied,
         )
 
+    def record_pool_escalation(
+        self, *, kind: str, pool: str, revision: int, reason: str
+    ) -> None:
+        """A process-backend pool whose worker could not serve the cycle
+        (crash, wedge, untrusted frame): the pool was planned in-parent
+        and its worker respawns from a fresh wire image. Replay ignores
+        the record (the escalated plan itself is in the ordinary plan
+        record); it exists so a postmortem can line worker deaths up
+        against the cycles they degraded."""
+        self._append(
+            "pool.escalation",
+            partitioner_kind=kind,
+            pool=pool,
+            revision=revision,
+            reason=reason,
+        )
+
     def record_audit(self, *, revision: int, violations: List[dict]) -> None:
         self._append("audit", revision=revision, violations=violations)
 

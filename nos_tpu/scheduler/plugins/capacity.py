@@ -240,18 +240,38 @@ class CapacityScheduling:
         # Reservations in flight (bound this cycle but possibly not yet
         # re-listed): quota name -> pod key -> request.
         self._reserved: Dict[str, Dict[str, ResourceList]] = {}
+        # Quota usage charged OUTSIDE this store's visibility: quota name
+        # -> synthetic pod key -> request. A pool-planner worker process
+        # only replicates its own pool's bound pods, so the parent ships
+        # the out-of-pool aggregates here each cycle and snapshot() folds
+        # them exactly like in-flight reservations.
+        self._external: Dict[str, Dict[str, ResourceList]] = {}
 
     # -------------------------------------------------------- snapshot
 
     def snapshot(self) -> ElasticQuotaInfos:
         infos = build_quota_infos(self.store, self.chip_memory_gb)
-        for quota_name, pods in self._reserved.items():
-            info = infos.get(quota_name)
-            if info is None:
-                continue
-            for key, request in pods.items():
-                info.add_pod(key, request)
+        for reserved in (self._reserved, self._external):
+            for quota_name, pods in reserved.items():
+                info = infos.get(quota_name)
+                if info is None:
+                    continue
+                for key, request in pods.items():
+                    info.add_pod(key, request)
         return infos
+
+    def set_external_usage(
+        self, usage: "Dict[str, Dict[str, int]]"
+    ) -> None:
+        """Replace the externally-charged usage wholesale (per cycle, from
+        the wire): ``{quota name: {resource: quantity}}``. Each quota's
+        aggregate is folded as one synthetic pod so the arithmetic path is
+        identical to reservations."""
+        self._external = {
+            quota_name: {f"__external__/{quota_name}": dict(request)}
+            for quota_name, request in usage.items()
+            if request
+        }
 
     # -------------------------------------------------------- prefilter
 

@@ -103,6 +103,13 @@ def set_known_geometries(overrides: Optional[Dict[str, List[Geometry]]]) -> None
     _geometry_overrides = dict(overrides) if overrides else {}
 
 
+def known_geometry_overrides() -> Dict[str, List[Geometry]]:
+    """The live override map (JSON-shaped) — the process pool backend
+    ships it to worker processes, which must derive the same boards the
+    parent does despite not sharing this module global."""
+    return dict(_geometry_overrides)
+
+
 def allowed_geometries(accelerator: str, board_topology: Optional[str] = None) -> List[Geometry]:
     """All ICI-valid slice geometries for one board of `accelerator`,
     ordered fewest-slices-first. Unknown accelerators yield [].

@@ -446,6 +446,27 @@ PLAN_MERGE_CONFLICTS = REGISTRY.counter(
     "capacity exceeded); the cycle's plan is discarded and the next "
     "cycle rebuilds the partition from scratch",
 )
+PLAN_WORKER_RESTARTS = REGISTRY.counter(
+    "nos_tpu_plan_worker_restarts_total",
+    "Pool-planner worker processes dropped and respawned from a fresh "
+    "wire image (crash, wedge past the cycle timeout, untrusted frame, "
+    "or codec-version rejection); each drop escalates that pool to "
+    "in-parent serial planning for the cycle",
+)
+PLAN_WORKER_RTT = REGISTRY.histogram(
+    "nos_tpu_plan_worker_rtt_seconds",
+    "Per-pool round-trip of one process-backend plan cycle as the parent "
+    "sees it: delta frame out to plan reply in (includes worker queueing, "
+    "refresh, plan, and serialization)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+)
+PLAN_BACKEND = REGISTRY.counter(
+    "nos_tpu_plan_backend_total",
+    "Sharded pool-plan executions by backend "
+    "(backend=serial|thread|process|escalated): escalated counts pools a "
+    "process cycle had to plan in-parent because their worker was dead, "
+    "wedged, or not yet bootstrapped",
+)
 WARM_BOOT_OUTCOME = REGISTRY.counter(
     "nos_tpu_warm_boot_outcome_total",
     "Warm-state adoption attempts at startup/full-rebuild by outcome "

@@ -33,3 +33,27 @@ def test_apiserver_seed_converges_and_replays_clean(seed):
         )
     ).run()
     assert report.ok(), report.render()
+
+
+# Seed chosen so the schedule fires worker-kill in BOTH bursts (burst 0
+# usually lands before the lazily-spawned workers exist — the recorded
+# no-op path — burst 1 on a live worker mid-run).
+PROCESS_SEED = 3
+
+
+def test_process_backend_seed_survives_worker_kill():
+    from nos_tpu.chaos import faults as F
+
+    config = ChaosConfig(
+        seed=PROCESS_SEED, bursts=2, nodes=3, backend="memory",
+        burst_s=0.4, convergence_timeout_s=30.0, minimize=False,
+        pool_backend="process",
+    )
+    driver = ChaosDriver(config)
+    kills = [
+        f for burst in driver.schedule for f in burst.faults
+        if f.kind == F.WORKER_KILL
+    ]
+    assert len(kills) == 2, "seed no longer schedules worker-kill twice"
+    report = driver.run()
+    assert report.ok(), report.render()

@@ -1,0 +1,248 @@
+"""Snapcodec wire framing across the REAL worker transport (ISSUE 18
+satellite): spawned worker processes fed framed JSON over a pipe — never
+a pickled live snapshot — must round-trip save_entries()/adopt() warm
+state, reject a codec-version mismatch so the parent cold-boots, and
+turn a truncated frame into a clean respawn with zero state carried
+over."""
+import multiprocessing
+
+import pytest
+
+from nos_tpu.kube.serde import pod_to_wire
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.partitioning.core import procpool
+from nos_tpu.partitioning.core.codec import TpuSliceCodec
+from nos_tpu.partitioning.core.planner import Planner
+from nos_tpu.partitioning.core.procpool import (
+    PoolWorkerPool,
+    WorkerUnavailable,
+    snapshot_node_to_wire,
+)
+from nos_tpu.partitioning.core.snapcodec import (
+    SNAPSHOT_CODEC_VERSION,
+    FrameError,
+    WarmStateCodec,
+    decode_frame,
+    encode_frame,
+)
+from nos_tpu.partitioning.core.snapshot import ClusterSnapshot
+from nos_tpu.partitioning.tpu import TpuSnapshotTaker
+
+from tests.factory import build_pod, build_tpu_node, slice_res
+
+SPEC = {"pre_filter": [], "filter": ["NodeResourcesFit", "NodeSelectorFit"]}
+KNOBS = dict(
+    aging_chips_per_second=0.0,
+    verdict_cache_enabled=True,
+    reuse_gang_trial=True,
+    futility_memo_enabled=True,
+    incremental_dirty_threshold=1.0,
+)
+# Generous: the CI box is one slow core and a worker spawn re-imports
+# the world; these bound hangs, they are not perf assertions.
+BOOT_TIMEOUT = 120.0
+CYCLE_TIMEOUT = 60.0
+
+
+def make_world(n=2):
+    """(wire entries, {name: SnapshotNode}) for n empty v5e nodes."""
+    taker = TpuSnapshotTaker()
+    entries, nodes = [], {}
+    for i in range(n):
+        node = build_tpu_node(name=f"n{i}")
+        snap = taker.take_snapshot_node(node, [])
+        nodes[node.metadata.name] = snap
+        entries.append(snapshot_node_to_wire(snap))
+    return entries, nodes
+
+
+def pending_pod(name="pod-a", profile="2x2"):
+    return build_pod(name, {slice_res(profile): 1}, scheduler="")
+
+
+def cycle_request(pods=(), deltas=()):
+    return {
+        "pool": "p",
+        "deltas": list(deltas),
+        "pending": [pod_to_wire(pod) for pod in pods],
+        "ages": {},
+        "external_usage": {},
+    }
+
+
+@pytest.fixture
+def pool():
+    wp = PoolWorkerPool(
+        "tpu",
+        "TpuSliceCodec",
+        SPEC,
+        dict(KNOBS),
+        cycle_timeout_seconds=CYCLE_TIMEOUT,
+        bootstrap_timeout_seconds=BOOT_TIMEOUT,
+    )
+    yield wp
+    wp.close()
+
+
+class TestFraming:
+    def test_round_trip(self):
+        doc = {"op": "cycle", "deltas": [], "ages": {"default/p": 1.5}}
+        assert decode_frame(encode_frame(doc)) == doc
+
+    def test_bad_magic_rejected_before_payload(self):
+        data = bytearray(encode_frame({"op": "ping"}))
+        data[:4] = b"XXXX"
+        with pytest.raises(FrameError, match="magic"):
+            decode_frame(bytes(data))
+
+    def test_codec_version_mismatch_rejected(self):
+        data = bytearray(encode_frame({"op": "ping"}))
+        data[4:8] = (SNAPSHOT_CODEC_VERSION + 1).to_bytes(4, "big")
+        with pytest.raises(FrameError, match="codec version"):
+            decode_frame(bytes(data))
+
+    def test_truncated_payload_rejected(self):
+        data = encode_frame({"op": "ping"})
+        with pytest.raises(FrameError, match="truncated"):
+            decode_frame(data[:-3])
+
+    def test_short_header_rejected(self):
+        with pytest.raises(FrameError, match="short"):
+            decode_frame(b"NOSW")
+
+    def test_non_object_payload_rejected(self):
+        import struct
+
+        payload = b"[1,2]"
+        header = struct.pack(
+            ">4sII", b"NOSW", SNAPSHOT_CODEC_VERSION, len(payload)
+        )
+        with pytest.raises(FrameError, match="not object"):
+            decode_frame(header + payload)
+
+    def test_transport_never_pickles(self):
+        """The pipe carries framed JSON only: no Connection.send()
+        (which pickles its argument) and no pickle import anywhere in
+        the transport module."""
+        import pathlib
+        import re
+
+        text = pathlib.Path(procpool.__file__).read_text()
+        assert "import pickle" not in text
+        assert re.search(r"\bconn\.send\(", text) is None
+        assert "send_bytes" in text
+
+
+class TestWorkerTransport:
+    def test_cycle_through_worker_matches_in_parent_plan(self, pool):
+        entries, nodes = make_world(2)
+        pool.sync_pools(["p"])
+        pool.bootstrap("p", entries, [])
+        pod = pending_pod()
+        replies = pool.plan_cycle({"p": cycle_request([pod])})
+        reply = replies["p"]
+        assert isinstance(reply, dict), reply
+        assert reply["touched"], "plan for a feasible pod touched no node"
+
+        # The same world planned in-parent must produce the same boards.
+        framework = procpool.build_framework_from_spec(SPEC, KubeStore())
+        planner = Planner(framework, **KNOBS)
+        base = ClusterSnapshot(nodes, codec=TpuSliceCodec())
+        desired = planner.plan(
+            base, [pod], dirty=set(nodes), pending_ages={}
+        )
+        for name, boards in reply["touched"].items():
+            expected = {
+                str(b.board_index): dict(b.resources)
+                for b in desired[name].boards
+            }
+            assert boards == expected
+        assert reply["unserved"] == dict(planner.last_unserved)
+
+    def test_save_entries_adopt_round_trips_through_worker(self, pool, tmp_path):
+        """Warm state persisted by an in-parent planner is adopted by a
+        freshly spawned worker from the same file: the save_entries()
+        document IS the wire vocabulary, so disk and pipe can't drift."""
+        entries, nodes = make_world(2)
+        framework = procpool.build_framework_from_spec(SPEC, KubeStore())
+        planner = Planner(framework, **KNOBS)
+        base = ClusterSnapshot(nodes, codec=TpuSliceCodec())
+        # Commit-free workload: an unservable 4x4 request against 2x4
+        # boards proves futility on every node but places nothing, so
+        # the saved signatures describe exactly the observed state the
+        # worker will rebuild from the wire image.
+        unservable = pending_pod("big", "4x4")
+        planner.plan(base, [unservable], dirty=set(nodes), pending_ages={})
+        exported = planner.export_warm_state(base)
+        assert exported, "no memos to round-trip — world setup regressed"
+        warm_path = str(tmp_path / "warm-state.json")
+        codec = WarmStateCodec(warm_path)
+        assert codec.save_entries(base, exported, force=True)
+
+        pool.warm_state_path = warm_path
+        worker = procpool._Worker(
+            multiprocessing.get_context("spawn"), "p", "tpu"
+        )
+        try:
+            worker.send(
+                {
+                    "op": "bootstrap",
+                    "seq": 1,
+                    "codec_version": SNAPSHOT_CODEC_VERSION,
+                    "geometry_overrides": {},
+                    "pool": "p",
+                    "slice_codec": "TpuSliceCodec",
+                    "framework": SPEC,
+                    "knobs": KNOBS,
+                    "nodes": entries,
+                    "quotas": [],
+                    "warm_state_path": warm_path,
+                }
+            )
+            reply = worker.recv(BOOT_TIMEOUT)
+        finally:
+            worker.kill()
+        assert reply["op"] == "ready", reply
+        assert reply["nodes"] == 2
+        # Both nodes' memos matched by signature: the worker rebuilt the
+        # exact node states the parent hashed, through wire alone.
+        assert reply["adopted_entries"] > 0
+
+    def test_codec_version_mismatch_rejects_then_parent_cold_boots(
+        self, pool, monkeypatch
+    ):
+        entries, _ = make_world(1)
+        pool.sync_pools(["p"])
+        # The parent claims a vocabulary the worker's tree doesn't speak:
+        # the worker must refuse to adopt (silent corruption otherwise).
+        monkeypatch.setattr(
+            procpool, "SNAPSHOT_CODEC_VERSION", SNAPSHOT_CODEC_VERSION + 7
+        )
+        with pytest.raises(WorkerUnavailable, match="rejected"):
+            pool.bootstrap("p", entries, [])
+        assert pool.needs_bootstrap("p")
+        assert pool.restarts == 1
+        monkeypatch.undo()
+        # Parent cold-boots a fresh worker and the pool serves again.
+        pool.bootstrap("p", entries, [])
+        replies = pool.plan_cycle({"p": cycle_request([pending_pod()])})
+        assert isinstance(replies["p"], dict), replies["p"]
+
+    def test_truncated_frame_causes_clean_respawn(self, pool):
+        entries, _ = make_world(1)
+        pool.sync_pools(["p"])
+        pool.bootstrap("p", entries, [])
+        # Corrupt the transport mid-stream: the worker cannot trust its
+        # state against the parent's any more and exits.
+        pool._workers["p"].conn.send_bytes(b"NOSW\x00\x00")
+        replies = pool.plan_cycle({"p": cycle_request([pending_pod()])})
+        assert isinstance(replies["p"], WorkerUnavailable)
+        assert pool.restarts == 1
+        assert pool.needs_bootstrap("p")
+        # Respawn from a fresh wire image: no state carried over, plan
+        # serves cleanly.
+        pool.bootstrap("p", entries, [])
+        replies = pool.plan_cycle({"p": cycle_request([pending_pod()])})
+        reply = replies["p"]
+        assert isinstance(reply, dict), reply
+        assert reply["touched"]

@@ -9,7 +9,14 @@ Grep-based on purpose (the partitioner no-deepcopy lint's idiom): the
 contract is per-module and textual, so a new ``threading.Thread(`` in a
 module with neither marker fails here, not in code review. Modules whose
 threads legitimately sit outside the contract carry a written
-justification below — an exemption without one doesn't parse."""
+justification below — an exemption without one doesn't parse.
+
+Process spawners get the same treatment with a narrower contract: a
+worker process cannot register with the in-process profiler (sampled
+stacks don't cross the boundary), so the parent module must instead own
+a wedge-watchdog series fed by worker progress — procpool beats
+``loop.poolworker.<pool>`` on every cycle reply — or carry a written
+justification."""
 import pathlib
 import re
 
@@ -96,4 +103,62 @@ def test_exemptions_are_justified_and_live():
     stale = sorted(set(EXEMPT) - spawners)
     assert stale == [], f"exempt modules no longer spawn threads: {stale}"
     thin = sorted(rel for rel, why in EXEMPT.items() if len(why) < 20)
+    assert thin == [], f"exemptions without a real justification: {thin}"
+
+
+# ------------------------------------------------------ process spawners
+
+# Module -> why its worker processes are exempt from the watchdog-series
+# contract. (No profiler requirement for processes: stacks can't cross
+# the boundary, so the watchdog series IS the whole observability story
+# — an exemption here means a worker process that can wedge invisibly.)
+PROCESS_EXEMPT: dict = {}
+
+PROCESS_SPAWN = re.compile(r"\.Process\(")
+
+
+def process_spawner_files():
+    return sorted(
+        str(path.relative_to(NOS_TPU)).replace("\\", "/")
+        for path in NOS_TPU.rglob("*.py")
+        if PROCESS_SPAWN.search(path.read_text())
+    )
+
+
+def test_every_process_spawner_registers_watchdog():
+    problems = []
+    for rel in process_spawner_files():
+        if rel in PROCESS_EXEMPT:
+            continue
+        text = (NOS_TPU / rel).read_text()
+        if not WATCHDOG_MARK.search(text):
+            problems.append(
+                f"{rel}: spawns a worker process but never registers a "
+                "wedge-watchdog series for it — a dead or wedged worker "
+                "would be invisible until its cycle times out"
+            )
+    assert problems == [], "\n".join(problems)
+
+
+def test_procpool_beats_poolworker_series_per_cycle_reply():
+    """The process pool backend's specific contract: each worker owns a
+    ``loop.poolworker.<pool>`` series, registered at spawn and beaten on
+    every successful cycle reply — the only cross-process progress signal
+    the timeline gets."""
+    text = (NOS_TPU / "partitioning" / "core" / "procpool.py").read_text()
+    assert 'poolworker.' in text, "procpool lost its poolworker.* series"
+    assert "WATCHDOG.register(" in text
+    assert "WATCHDOG.beat(" in text
+    assert "WATCHDOG.unregister(" in text, (
+        "dropped workers must unregister or dead series accumulate"
+    )
+
+
+def test_process_exemptions_are_justified_and_live():
+    spawners = set(process_spawner_files())
+    stale = sorted(set(PROCESS_EXEMPT) - spawners)
+    assert stale == [], f"exempt modules no longer spawn processes: {stale}"
+    thin = sorted(
+        rel for rel, why in PROCESS_EXEMPT.items() if len(why) < 20
+    )
     assert thin == [], f"exemptions without a real justification: {thin}"

@@ -1,0 +1,74 @@
+"""bench_trend keyed flattening: inserting a bench row must not shift
+every later row onto the wrong baseline (the old positional flatten
+compared row N against old row N, so one added A/B line turned the whole
+tail of the artifact into phantom regressions)."""
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_trend",
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "tools", "bench_trend.py"
+    ),
+)
+bench_trend = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_trend)
+
+
+def row(parallelism, nodes, p50, p95):
+    return {
+        "bench": "bench_planner_sharded",
+        "parallelism": parallelism,
+        "nodes": nodes,
+        "pending_pods": 800,
+        "p50_replan_ms": p50,
+        "p95_replan_ms": p95,
+    }
+
+
+class TestKeyedFlatten:
+    def test_rows_key_by_identity_not_position(self):
+        flat = bench_trend.flatten([row("serial", 16384, 100.0, 400.0)])
+        (path,) = [p for p in flat if p.endswith("p50_replan_ms")]
+        assert "parallelism=serial" in path
+        assert "nodes=16384" in path
+        assert not path.startswith("0.")
+
+    def test_inserted_row_does_not_shift_baselines(self):
+        old = [row("serial", 16384, 100.0, 400.0), row("thread", 16384, 101.0, 600.0)]
+        # A process row lands BETWEEN them: positional flatten would diff
+        # serial-vs-serial then process-vs-thread.
+        new = [
+            row("serial", 16384, 100.0, 400.0),
+            row("process", 16384, 90.0, 300.0),
+            row("thread", 16384, 101.0, 600.0),
+        ]
+        rows = bench_trend.diff_reports(old, new, tolerance=0.10)
+        verdicts = {r[0] for r in rows}
+        assert verdicts == {"added"}, rows
+
+    def test_p95_drift_on_sharded_row_is_a_regression(self):
+        old = [row("serial", 16384, 100.0, 400.0), row("thread", 16384, 101.0, 410.0)]
+        new = [row("serial", 16384, 100.0, 400.0), row("thread", 16384, 101.0, 620.0)]
+        rows = bench_trend.diff_reports(old, new, tolerance=0.10)
+        (regressed,) = [r for r in rows if r[0] == "regressed"]
+        assert "parallelism=thread" in regressed[1]
+        assert regressed[1].endswith("p95_replan_ms")
+
+    def test_repeated_identical_configs_stay_distinct(self):
+        doc = [row("serial", 64, 1.0, 2.0), row("serial", 64, 3.0, 4.0)]
+        flat = bench_trend.flatten(doc)
+        p50s = sorted(v for p, v in flat.items() if p.endswith("p50_replan_ms"))
+        assert p50s == [1.0, 3.0]
+
+    def test_measurement_bool_flip_still_classifies_regressed(self):
+        old = [{"bench": "bench_planner_sharded_equivalence", "nodes": 256,
+                "byte_identical": True}]
+        new = [{"bench": "bench_planner_sharded_equivalence", "nodes": 256,
+                "byte_identical": False}]
+        rows = bench_trend.diff_reports(old, new, tolerance=0.10)
+        assert [r[0] for r in rows] == ["regressed"]
+
+    def test_non_bench_lists_keep_positional_paths(self):
+        flat = bench_trend.flatten({"xs": [10, 20]})
+        assert flat == {"xs.0": 10, "xs.1": 20}

@@ -46,18 +46,55 @@ REGRESS_UP = (
 )
 REGRESS_DOWN = ("_per_s", "throughput", "ops", "hits", "goodput")
 
+# Fields that IDENTIFY a bench row (which configuration was measured)
+# rather than measure it. List items carrying any of these are keyed by
+# them instead of by list position, so inserting a row (say, a new
+# backend's A/B line) shifts nothing: every old row still diffs against
+# the same configuration, and a p50/p95 drift is classified against its
+# true baseline instead of a neighbour's. Measurement booleans
+# (``byte_identical``, ``*_within_budget``) stay OUT of this set — they
+# must keep flowing through classify() so a truthy→falsy flip reads
+# ``regressed``, not ``removed`` + ``added``.
+IDENTITY_KEYS = (
+    "bench", "engine", "verdict_cache", "variant", "parallelism",
+    "plan_mode", "backend", "copy", "mode", "kind",
+    "nodes", "pods", "pending_pods", "pools", "churn", "watchers", "cpus",
+)
+
+
+def _item_key(item: object) -> str:
+    """Identity key for one list element; "" = no identity (positional)."""
+    if not isinstance(item, dict) or "bench" not in item:
+        return ""
+    return ",".join(
+        f"{k}={item[k]}" for k in IDENTITY_KEYS if k in item
+    )
+
 
 def flatten(report: object, prefix: str = "") -> Dict[str, object]:
-    """Collapse a nested report to ``{"a.b.c": leaf}``. Lists index by
-    position; only scalar leaves are kept (strings included, compared by
-    equality only)."""
+    """Collapse a nested report to ``{"a.b.c": leaf}``. List items that
+    look like bench rows (dicts with a ``bench`` field) are keyed by
+    their identity fields; anything else indexes by position. Only
+    scalar leaves are kept (strings included, compared by equality
+    only)."""
     out: Dict[str, object] = {}
     if isinstance(report, dict):
         for key in sorted(report):
             out.update(flatten(report[key], f"{prefix}{key}."))
     elif isinstance(report, list):
+        seen: Dict[str, int] = {}
         for i, item in enumerate(report):
-            out.update(flatten(item, f"{prefix}{i}."))
+            key = _item_key(item)
+            if key:
+                # Repeated identical configs (re-run rows) stay distinct
+                # and ordered via an occurrence suffix.
+                n = seen.get(key, 0)
+                seen[key] = n + 1
+                if n:
+                    key = f"{key}#{n}"
+                out.update(flatten(item, f"{prefix}{key}."))
+            else:
+                out.update(flatten(item, f"{prefix}{i}."))
     else:
         out[prefix[:-1]] = report
     return out
