@@ -5,7 +5,7 @@ IMAGE_REGISTRY ?= ghcr.io/nos-tpu
 VERSION ?= 0.1.0
 COMPONENTS := operator partitioner scheduler tpuagent sharingagent metricsexporter
 
-.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke procpool-smoke forecast-smoke soak-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast bench-soak bench-trend examples native lint \
+.PHONY: all test test-fast test-unit test-integration replay-smoke chaos-smoke chaos capacity-smoke serve-smoke autoscale-smoke shard-smoke procpool-smoke forecast-smoke soak-smoke obs-smoke incluster-e2e kind-e2e bench bench-planner bench-store bench-serve bench-autoscale bench-forecast bench-soak bench-obs bench-trend examples native lint \
         docker-build $(addprefix docker-build-,$(COMPONENTS)) \
         helm-lint deploy undeploy clean
 
@@ -88,6 +88,13 @@ forecast-smoke:
 soak-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/timeline -q -m 'not slow'
 
+# Observability-plane gate: cardinality governor admission/fold/budget
+# semantics, incremental snapshot cursors, tail-kept trace retention,
+# streaming debug pagination, and the small-world end-to-end smoke —
+# two in-process runs of the governed plane must be byte-identical.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/obsplane -q -m 'not slow'
+
 # Chaos tier-1 gate: one fixed seed through the full suite under fault
 # injection — must converge, replay clean, and fire a byte-identical
 # fault schedule every run. Plus the committed regression fixtures.
@@ -160,6 +167,14 @@ bench-forecast:
 # pinned seed. See BENCH_soak.json.
 bench-soak:
 	JAX_PLATFORMS=cpu $(PY) bench_soak.py --output BENCH_soak.json
+
+# Observability plane at fleet cardinality: governor on/off exposition
+# A/B, incremental snapshot + timeline sample costs, and trace retention
+# over bench_store's 100k-node / 1M-pod world. Wall-clock goes to
+# stdout; the committed report keeps deterministic counts, shas, and
+# within-budget booleans only. See BENCH_observability.json.
+bench-obs:
+	JAX_PLATFORMS=cpu $(PY) bench_observability.py --output BENCH_observability.json
 
 # Committed-benchmark trend gate: diff every BENCH_*.json in the working
 # tree against the previous commit's copy and flag regressions past the

@@ -1,0 +1,320 @@
+"""Observability-plane benchmark: the telemetry stack at fleet scale.
+
+PR 18 proved the store and planning planes at 100k nodes / 1M pods; this
+bench proves the *observability* plane survives the same world. It rides
+``bench_store``'s builders (nodes, round-robin bound pods, a pending
+residue), derives the fleet's per-node capacity series from the seeded
+store, and measures the pieces the control loops actually pay for:
+
+  exposition      — ``MetricsRegistry.render()`` with the cardinality
+                    governor OFF (the ~3-series-per-node floor) and ON
+                    (budgeted exact series + the ``_other`` fold)
+  snapshot        — ``SnapshotCursor.collect()`` after touching a quiet
+                    interval's worth of series (O(changed), not O(total))
+  timeline sample — ``TimelineStore.sample_once()`` in registry-cursor
+                    mode over the governed registry
+  retention       — a deterministic journey mixture (boring / slow /
+                    error) through the tail-kept ``TraceStore``
+
+Wall-clock numbers go to stdout only. The committed report
+(``BENCH_observability.json``) is bit-stable: series counts, exposition
+byte sizes and the governed exposition's sha256, the governor on/off A/B
+deltas, trace retention hit-rate, and ``*_within_budget`` booleans
+holding each governed-path cost to <=2% of the 5s control cycle (the
+PR 9 overhead budget). Two independently built governed registries must
+render byte-identically — the governor is a deterministic function of
+the series set, and the determinism tests pin it.
+
+  make bench-obs
+  python bench_observability.py --quick
+  python bench_observability.py --output BENCH_observability.json
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import statistics
+import time
+
+from bench_store import seed_store
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.timeline.sizes import SizeRegistry
+from nos_tpu.timeline.store import TimelineStore
+from nos_tpu.timeline.watchdog import WedgeWatchdog
+from nos_tpu.util.metrics import MetricsRegistry
+from nos_tpu.util.tracing import RetentionPolicy, Span, Trace, TraceStore
+
+CYCLE_SECONDS = 5.0
+BUDGET_FRACTION = 0.02  # each governed-path cost <= 2% of the cycle
+NODE_FAMILY = "nos_tpu_capacity_node_chips"
+POOL_FAMILY = "nos_tpu_capacity_pool_chips"
+NODE_STATES = ("used", "free", "stranded")
+N_POOLS = 8
+NODE_BUDGET = 4096  # exact per-node series the governor admits
+TOUCHED_PER_FRAME = 256  # a quiet interval's changed-series count
+SLOW_THRESHOLDS = {"pod.journey": 1.0}
+
+
+def fleet_from_store(store):
+    """Deterministic (node, capacity, used_chips) rows + the pending-pod
+    count, derived from the seeded store (each bound pod requests 1 chip,
+    exactly as bench_store builds them)."""
+    used: dict = {}
+    pending = 0
+    for pod in store.list("Pod", copy=False):
+        node = pod.spec.node_name
+        if node:
+            used[node] = used.get(node, 0) + 1
+        else:
+            pending += 1
+    fleet = []
+    for node in store.list("Node", copy=False):
+        cap = int(node.status.allocatable.get(constants.RESOURCE_TPU, 0))
+        fleet.append((node.metadata.name, cap, used.get(node.metadata.name, 0)))
+    return fleet, pending
+
+
+def emit_fleet(registry, fleet, pending):
+    """Publish the fleet as the ledger would: one ``{node,state}`` series
+    triple per node (the cardinality the governor must bound) plus exact
+    per-pool rollups and the pending-pods gauge."""
+    node_g = registry.gauge(NODE_FAMILY, "per-node chip accounting")
+    pool_g = registry.gauge(POOL_FAMILY, "per-pool chip rollups")
+    pending_g = registry.gauge("nos_tpu_capacity_pending_pods", "unbound pods")
+    pools: dict = {}
+    for i, (name, cap, used_chips) in enumerate(fleet):
+        free = cap - used_chips
+        stranded = 1 if 0 < used_chips < cap else 0
+        node_g.labels(node=name, state="used").set(float(used_chips))
+        node_g.labels(node=name, state="free").set(float(free))
+        node_g.labels(node=name, state="stranded").set(float(stranded))
+        acc = pools.setdefault(f"pool-{i % N_POOLS}", [0, 0, 0])
+        acc[0] += used_chips
+        acc[1] += free
+        acc[2] += stranded
+    for pool in sorted(pools):
+        for state, value in zip(NODE_STATES, pools[pool]):
+            pool_g.labels(pool=pool, state=state).set(float(value))
+    pending_g.set(float(pending))
+    return node_g
+
+
+def governed_registry(fleet, pending):
+    registry = MetricsRegistry()
+    registry.apply_series_budgets({NODE_FAMILY: NODE_BUDGET})
+    emit_fleet(registry, fleet, pending)
+    return registry
+
+
+def make_trace(trace_id, duration, status="ok"):
+    root = Span(
+        name="pod.journey",
+        trace_id=trace_id,
+        span_id=f"{trace_id}-root",
+        parent_id=None,
+        duration_s=duration,
+        status=status,
+    )
+    return Trace(trace_id=trace_id, spans=[root])
+
+
+def drive_retention(n_traces):
+    """Deterministic journey mixture: mostly boring, every 53rd slow,
+    every 101st an error — the burst shape that evicted the interesting
+    tail out of the newest-kept store."""
+    store = TraceStore(
+        capacity=256,
+        retention=RetentionPolicy(
+            tail_capacity=64, boring_sample_n=8, slow_thresholds=SLOW_THRESHOLDS
+        ),
+    )
+    for i in range(n_traces):
+        if i % 101 == 0:
+            store.add(make_trace(f"t{i:06d}", 0.1, status="error"))
+        elif i % 53 == 0:
+            store.add(make_trace(f"t{i:06d}", 2.0))
+        else:
+            store.add(make_trace(f"t{i:06d}", 0.1))
+    return store.retention_stats()
+
+
+def _p50_ms(fn, repeats):
+    durations = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        durations.append(time.perf_counter() - t0)
+    return round(statistics.median(durations) * 1e3, 3)
+
+
+def _touch(node_gauge, fleet, frame):
+    """Nudge a rotating window of node series — the quiet-interval write
+    pattern the cursor pays for."""
+    n = min(TOUCHED_PER_FRAME, len(fleet))
+    for j in range(n):
+        name, cap, used_chips = fleet[(frame * n + j) % len(fleet)]
+        node_gauge.labels(node=name, state="used").set(float(used_chips + frame))
+
+
+def run_config(n_nodes, n_pods, repeats):
+    limit_ms = CYCLE_SECONDS * BUDGET_FRACTION * 1e3
+    t0 = time.perf_counter()
+    store = seed_store(n_nodes, n_pods)
+    fleet, pending = fleet_from_store(store)
+    seed_s = time.perf_counter() - t0
+    del store
+
+    # Governor OFF: the floor the fleet would pay without budgets.
+    ungoverned = MetricsRegistry()
+    emit_fleet(ungoverned, fleet, pending)
+    ungoverned_active = sum(
+        fam["exact"] + fam["overflow"] for fam in ungoverned.series_report().values()
+    )
+    ungoverned_render = ungoverned.render()
+    off_p50 = _p50_ms(ungoverned.render, repeats)
+    del ungoverned
+
+    # Governor ON, built twice from scratch: the exposition must be a
+    # deterministic function of the series set (byte-identical renders).
+    governed = governed_registry(fleet, pending)
+    twin_render = governed_registry(fleet, pending).render()
+    governed_render = governed.render()
+    report = governed.series_report()
+    node_fam = report[NODE_FAMILY]
+    governed_active = sum(f["exact"] + f["overflow"] for f in report.values())
+    on_p50 = _p50_ms(governed.render, repeats)
+
+    # Incremental snapshot + timeline sample over the governed registry.
+    cursor = governed.cursor()
+    cursor.collect()  # prime: full snapshot
+    node_gauge = governed.gauge(NODE_FAMILY)
+    snap_durations = []
+    for frame in range(repeats):
+        _touch(node_gauge, fleet, frame)
+        t1 = time.perf_counter()
+        changed, _ = cursor.collect()
+        snap_durations.append(time.perf_counter() - t1)
+    snapshot_p50 = round(statistics.median(snap_durations) * 1e3, 3)
+    snapshot_changed = len(changed)
+    cursor.close()
+
+    virtual_now = [1000.0]
+
+    def clock():
+        virtual_now[0] += CYCLE_SECONDS
+        return virtual_now[0]
+
+    timeline = TimelineStore(
+        clock=clock,
+        vitals=False,
+        registry=governed,
+        sizes=SizeRegistry(),
+        watchdog=WedgeWatchdog(),
+    )
+    timeline.sample_once()  # prime the cursor
+    sample_durations = []
+    for frame in range(repeats):
+        _touch(node_gauge, fleet, frame + repeats)
+        t1 = time.perf_counter()
+        timeline.sample_once()
+        sample_durations.append(time.perf_counter() - t1)
+    sample_p50 = round(statistics.median(sample_durations) * 1e3, 3)
+    timeline.close()
+
+    retention = drive_retention(max(202, min(10_000, n_pods // 100)))
+
+    timing = {
+        "bench": "bench_observability_timing",
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "seed_seconds": round(seed_s, 2),
+        "exposition_off_p50_ms": off_p50,
+        "exposition_on_p50_ms": on_p50,
+        "snapshot_p50_ms": snapshot_p50,
+        "timeline_sample_p50_ms": sample_p50,
+        "limit_ms": limit_ms,
+    }
+    row = {
+        "bench": "bench_observability",
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "series": {
+            "ungoverned_active": ungoverned_active,
+            "governed_active": governed_active,
+            "governed_exact": node_fam["exact"],
+            "governed_overflow": node_fam["overflow"],
+            "dropped": node_fam["dropped"],
+            "node_family_budget": NODE_BUDGET,
+        },
+        "exposition": {
+            "bytes_ungoverned": len(ungoverned_render),
+            "bytes_governed": len(governed_render),
+            "governed_sha256": hashlib.sha256(
+                governed_render.encode()
+            ).hexdigest(),
+            "byte_identical": governed_render == twin_render,
+        },
+        "snapshot": {
+            "changed_series_per_frame": snapshot_changed,
+            "primed_series": governed_active,
+        },
+        "retention": {
+            "traces": sum(retention["seen"].values()),
+            "seen": retention["seen"],
+            "kept": retention["kept"],
+            "sampled_out": retention["sampled_out"],
+            "hit_rate": retention["hit_rate"],
+        },
+        "overhead": {
+            "cycle_seconds": CYCLE_SECONDS,
+            "budget_fraction": BUDGET_FRACTION,
+            "exposition_within_budget": on_p50 <= limit_ms,
+            "snapshot_within_budget": snapshot_p50 <= limit_ms,
+            "timeline_sample_within_budget": sample_p50 <= limit_ms,
+        },
+    }
+    return row, timing
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--configs",
+        default="1000x10000,100000x1000000",
+        help="comma-separated nodesxpods pairs",
+    )
+    parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument(
+        "--quick", action="store_true", help="100x1000 only, fewer repeats"
+    )
+    parser.add_argument("--output", default="", help="write the report JSON here")
+    args = parser.parse_args()
+
+    configs = [tuple(map(int, c.split("x"))) for c in args.configs.split(",")]
+    repeats = args.repeats
+    if args.quick:
+        configs = [(100, 1000)]
+        repeats = 2
+
+    rows = []
+    for n_nodes, n_pods in configs:
+        row, timing = run_config(n_nodes, n_pods, repeats)
+        rows.append(row)
+        print(json.dumps(timing), flush=True)
+        print(json.dumps(row), flush=True)
+
+    report = {
+        "budget": {
+            "cycle_seconds": CYCLE_SECONDS,
+            "overhead_fraction": BUDGET_FRACTION,
+        },
+        "rows": rows,
+    }
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(json.dumps(report, indent=1, sort_keys=True) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 from nos_tpu.api.config.v1alpha1 import (
     AutoscalerConfig,
     GpuPartitionerConfig,
+    ObservabilityConfig,
     OperatorConfig,
     SchedulerConfig,
     TpuAgentConfig,
@@ -9,6 +10,7 @@ from nos_tpu.api.config.v1alpha1 import (
 __all__ = [
     "AutoscalerConfig",
     "GpuPartitionerConfig",
+    "ObservabilityConfig",
     "OperatorConfig",
     "SchedulerConfig",
     "TpuAgentConfig",

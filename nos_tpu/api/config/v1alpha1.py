@@ -199,6 +199,61 @@ class AutoscalerConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Fleet-scale observability plane knobs (obsplane/): metric series
+    budgets for the cardinality governor, tail-kept trace retention, and
+    debug-endpoint pagination. Defaults leave every behavior off/unbounded
+    so small worlds keep the pre-governor telemetry byte-for-byte."""
+
+    # Per-family series budgets (family name -> max exact label sets);
+    # the YAML shape is observability.seriesBudget.<family>: N. A family
+    # over budget folds new label sets into one deterministic `_other`
+    # child and counts the refusals in metric_series_dropped_total.
+    series_budget: Dict[str, int] = field(default_factory=dict)
+    # Budget applied to families without an explicit entry; None/0 = off.
+    series_budget_default: Optional[int] = None
+    # Tiered exposition: per-node capacity gauges keep only the K
+    # worst-offender nodes (by idle chips then fragmentation) exact;
+    # 0 = export every node (pre-tiering behavior). Exact per-pool
+    # rollups are always exported alongside.
+    node_top_k: int = 0
+    # Tail-kept trace reservoir capacity (error/unschedulable/slow traces
+    # that boring traffic cannot evict). 0 disables the pinned ring.
+    trace_tail_capacity: int = 64
+    # Keep 1 of every N boring traces in the main ring (head sampling);
+    # 1 = keep all (pre-sampling behavior).
+    trace_boring_sample_n: int = 1
+    # Per-journey-kind latency thresholds (root span name -> seconds)
+    # above which a trace is classified "slow" and pinned.
+    trace_slow_thresholds: Dict[str, float] = field(default_factory=dict)
+    # Default /debug page size when the request carries no ?limit=;
+    # 0 = unpaginated (pre-streaming behavior).
+    debug_page_limit: int = 500
+
+    def validate(self) -> None:
+        for family, budget in self.series_budget.items():
+            if budget <= 0:
+                raise ConfigError(
+                    f"seriesBudget.{family} must be > 0 (got {budget})"
+                )
+        if self.series_budget_default is not None and self.series_budget_default <= 0:
+            raise ConfigError("seriesBudget default must be > 0 when set")
+        if self.node_top_k < 0:
+            raise ConfigError("node_top_k must be >= 0")
+        if self.trace_tail_capacity < 0:
+            raise ConfigError("trace_tail_capacity must be >= 0")
+        if self.trace_boring_sample_n < 1:
+            raise ConfigError("trace_boring_sample_n must be >= 1")
+        for kind, threshold in self.trace_slow_thresholds.items():
+            if threshold <= 0:
+                raise ConfigError(
+                    f"trace_slow_thresholds.{kind} must be > 0 (got {threshold})"
+                )
+        if self.debug_page_limit < 0:
+            raise ConfigError("debug_page_limit must be >= 0")
+
+
+@dataclass
 class SchedulerConfig:
     manager: ManagerConfig = field(default_factory=ManagerConfig)
     retry_seconds: float = 0.5

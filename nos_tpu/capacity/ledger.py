@@ -277,10 +277,21 @@ class CapacityLedger:
     a replayed run never pollutes the live registry.
     """
 
-    def __init__(self, store, flight_recorder=None, metrics: bool = True) -> None:
+    def __init__(
+        self,
+        store,
+        flight_recorder=None,
+        metrics: bool = True,
+        node_top_k: int = 0,
+    ) -> None:
         self.store = store
         self.flight = flight_recorder
         self._metrics = metrics
+        # Tiered exposition: 0 exports every node's gauges (small-world
+        # behavior); K > 0 keeps exact per-pool rollups plus only the K
+        # worst-offender nodes (most idle chips, then most fragmented) —
+        # the governor's answer to 300k node series at 100k nodes.
+        self.node_top_k = node_top_k
         self._lock = threading.Lock()
         self._queue = (
             store.watch(set(WATCH_KINDS), name="capacity-ledger")
@@ -328,8 +339,11 @@ class CapacityLedger:
         self._reconfig_started: Dict[str, float] = {}
         self.reconfig_count = 0
         self.reconfig_seconds_total = 0.0
-        # Node names with exported per-node gauges (reset-on-delete).
+        # Node/pool names with exported labeled gauges (delete-on-vanish:
+        # the registry supports child removal, so stale series disappear
+        # from exposition instead of lingering at zero).
         self._exported_nodes: set = set()
+        self._exported_pools: set = set()
         # Heartbeat: the control loops only observe when they run (the
         # partitioner on plan cycles), so a quiet steady-state cluster
         # would stop accruing chip-seconds without a periodic tick.
@@ -559,13 +573,13 @@ class CapacityLedger:
         if event.type == "DELETED":
             self._reconfig_started.pop(name, None)
             if self._nodes.pop(name, None) is not None and self._metrics:
-                self._zero_node_gauges(name)
+                self._drop_node_gauges(name)
             return
         total = int(node.status.capacity.get(constants.RESOURCE_TPU, 0))
         if total <= 0:
             self._reconfig_started.pop(name, None)
             if self._nodes.pop(name, None) is not None and self._metrics:
-                self._zero_node_gauges(name)
+                self._drop_node_gauges(name)
             return
         old = self._nodes.get(name)
         state = _NodeState(node, total)
@@ -775,7 +789,45 @@ class CapacityLedger:
         for node_name, chips, _ in self._bound.values():
             bound_by_node[node_name] = bound_by_node.get(node_name, 0) + chips
         free_total = largest_free = largest_profile = 0.0
+        pool_rollup: Dict[str, Dict[str, int]] = {}
+        offenders: List[Tuple[float, float, str]] = []
         for name in sorted(self._nodes):
+            st = self._nodes[name]
+            used = min(st.total_chips, bound_by_node.get(name, 0))
+            roll = pool_rollup.setdefault(
+                st.pool or "", {"total": 0, "used": 0, "free": 0}
+            )
+            roll["total"] += st.total_chips
+            roll["used"] += used
+            roll["free"] += st.total_chips - used
+            offenders.append((-(st.total_chips - used), -st.frag_index, name))
+            free_total += st.free_chips
+            largest_free = max(largest_free, st.largest_free_slice)
+            largest_profile = max(
+                largest_profile, largest_profile_chips(st.accelerator)
+            )
+        # Tier 1: exact per-pool rollups, always. Vanished pools drop
+        # their series (exposition must not carry ghost pools).
+        for pool in sorted(pool_rollup):
+            for state, value in sorted(pool_rollup[pool].items()):
+                m.CAPACITY_POOL_CHIPS.labels(pool=pool, state=state).set(value)
+            self._exported_pools.add(pool)
+        for pool in sorted(self._exported_pools - set(pool_rollup)):
+            for state in ("total", "used", "free"):
+                m.CAPACITY_POOL_CHIPS.remove(pool=pool, state=state)
+            self._exported_pools.discard(pool)
+        # Tier 2: per-node gauges — every node at node_top_k=0, else only
+        # the K worst offenders (most idle chips, then most fragmented,
+        # then name: a deterministic total order, so the exported set is
+        # a pure function of ledger state).
+        if self.node_top_k > 0:
+            offenders.sort()
+            selected = {name for _, _, name in offenders[: self.node_top_k]}
+        else:
+            selected = set(self._nodes)
+        for name in sorted(self._exported_nodes - selected):
+            self._drop_node_gauges(name)
+        for name in sorted(selected):
             st = self._nodes[name]
             used = min(st.total_chips, bound_by_node.get(name, 0))
             m.CAPACITY_NODE_CHIPS.labels(node=name, state="total").set(st.total_chips)
@@ -785,11 +837,6 @@ class CapacityLedger:
             )
             m.NODE_FRAGMENTATION.labels(node=name).set(st.frag_index)
             self._exported_nodes.add(name)
-            free_total += st.free_chips
-            largest_free = max(largest_free, st.largest_free_slice)
-            largest_profile = max(
-                largest_profile, largest_profile_chips(st.accelerator)
-            )
         m.CLUSTER_FRAGMENTATION.set(
             cluster_fragmentation_index(free_total, largest_free, largest_profile)
         )
@@ -803,22 +850,33 @@ class CapacityLedger:
                 max(0, min_chips - used) if ns in starved_ok else 0
             )
 
-    def _zero_node_gauges(self, name: str) -> None:
-        """A deleted node's labeled gauges would otherwise report its last
-        live values forever; zero them (the registry has no child-delete)."""
+    def _drop_node_gauges(self, name: str) -> None:
+        """A deleted (or tiered-out) node's labeled gauges would otherwise
+        report its last live values forever; delete the series so they
+        vanish from exposition and free their governor budget slots."""
         if name not in self._exported_nodes:
             return
         for state in ("total", "used", "free"):
-            m.CAPACITY_NODE_CHIPS.labels(node=name, state=state).set(0)
-        m.NODE_FRAGMENTATION.labels(node=name).set(0.0)
+            m.CAPACITY_NODE_CHIPS.remove(node=name, state=state)
+        m.NODE_FRAGMENTATION.remove(node=name)
         self._exported_nodes.discard(name)
 
     # ---------------------------------------------------------- debugging
 
-    def debug_payload(self) -> Dict[str, Any]:
+    def debug_payload(
+        self, pool: str = "", limit: int = 0, cursor: str = ""
+    ) -> Dict[str, Any]:
         """The /debug/capacity document: cluster rollup, per-node detail,
         quota posture, gang wait clocks, and links into the other debug
-        surfaces (explain/traces/record) for cross-navigation."""
+        surfaces (explain/traces/record) for cross-navigation.
+
+        ``pool`` filters the per-node section; ``limit``/``cursor`` page
+        it (cursor = last node name of the previous page) so the HTTP
+        layer never materializes 100k node records in one response. The
+        cluster rollup always covers every node regardless of paging.
+        Defaults reproduce the full pre-paging document. ``pending_pods``
+        is capped at the same ``limit`` — it is the other O(cluster) list.
+        """
         with self._lock:
             bound_by_node: Dict[str, int] = {}
             for node_name, chips, _ in self._bound.values():
@@ -840,6 +898,21 @@ class CapacityLedger:
             free_frag = largest_free = largest_profile = 0.0
             for name in sorted(self._nodes):
                 st = self._nodes[name]
+                free_frag += st.free_chips
+                largest_free = max(largest_free, st.largest_free_slice)
+                largest_profile = max(
+                    largest_profile, largest_profile_chips(st.accelerator)
+                )
+            names = [
+                n
+                for n in sorted(self._nodes)
+                if not pool or self._nodes[n].pool == pool
+            ]
+            from nos_tpu.obsplane.streaming import paginate
+
+            page_names, next_cursor = paginate(names, limit, cursor)
+            for name in page_names:
+                st = self._nodes[name]
                 used = min(st.total_chips, bound_by_node.get(name, 0))
                 acc = self.by_node.get(name, {"total": 0.0, "busy": 0.0})
                 nodes[name] = {
@@ -858,11 +931,6 @@ class CapacityLedger:
                         acc["busy"] / acc["total"] if acc["total"] else 0.0
                     ),
                 }
-                free_frag += st.free_chips
-                largest_free = max(largest_free, st.largest_free_slice)
-                largest_profile = max(
-                    largest_profile, largest_profile_chips(st.accelerator)
-                )
             pending_ns = {ns for _, ns in self._pending.values()}
             quotas = {}
             for key in sorted(self._quotas):
@@ -877,15 +945,18 @@ class CapacityLedger:
                         max(0, min_chips - used) if ns in pending_ns else 0
                     ),
                 }
+            pending_keys = sorted(self._pending)
+            if limit and limit > 0:
+                pending_keys = pending_keys[:limit]
             pending_pods = [
                 {
                     "pod": key,
-                    "chips": chips,
-                    "namespace": ns,
+                    "chips": self._pending[key][0],
+                    "namespace": self._pending[key][1],
                     "reason": self._unserved_sample.get(key),
                     "links": {"explain": f"/debug/explain?pod={key}"},
                 }
-                for key, (chips, ns) in sorted(self._pending.items())
+                for key in pending_keys
             ]
             return {
                 "revision": self._revision,
@@ -931,6 +1002,65 @@ class CapacityLedger:
                     "record": "/debug/record",
                     "vars": "/debug/vars",
                 },
+                "page": {
+                    "pool": pool,
+                    "limit": limit,
+                    "cursor": cursor,
+                    "next_cursor": next_cursor,
+                    "total_nodes": len(names),
+                },
+            }
+
+    def debug_stream(self, pool: str = ""):
+        """JSONL generator for ``/debug/capacity?format=jsonl``: a cluster
+        header record, then one record per node, then quotas — each line
+        O(1). State is snapshotted under the lock once; _NodeState objects
+        are replaced (never mutated) on apply, so iterating the captured
+        references outside the lock is safe and a slow HTTP client never
+        holds up ``observe``."""
+        with self._lock:
+            bound_by_node: Dict[str, int] = {}
+            for node_name, chips, _ in self._bound.values():
+                bound_by_node[node_name] = bound_by_node.get(node_name, 0) + chips
+            items = [
+                (name, self._nodes[name])
+                for name in sorted(self._nodes)
+                if not pool or self._nodes[name].pool == pool
+            ]
+            header = {
+                "record": "cluster",
+                "revision": self._revision,
+                "ts": self._last_ts,
+                "observes": self.observes,
+                "nodes": len(items),
+                "pool": pool,
+                "total_chips": sum(st.total_chips for _, st in items),
+            }
+            quotas = dict(self._quotas)
+        yield header
+        for name, st in items:
+            used = min(st.total_chips, bound_by_node.get(name, 0))
+            yield {
+                "record": "node",
+                "name": name,
+                "pool": st.pool,
+                "accelerator": st.accelerator,
+                "total_chips": st.total_chips,
+                "used_chips": used,
+                "free_chips": st.total_chips - used,
+                "frozen": st.frozen,
+                "reserved": st.reserved,
+                "fragmentation": round(st.frag_index, 6),
+            }
+        for key in sorted(quotas):
+            ns, min_chips, max_chips, used = quotas[key]
+            yield {
+                "record": "quota",
+                "key": key,
+                "namespace": ns,
+                "min_chips": min_chips,
+                "max_chips": max_chips,
+                "used_chips": used,
             }
 
     # -------------------------------------------------------- self check

@@ -140,6 +140,7 @@ class ChaosDriver:
             ),
         )
         self._dead_nodes: Dict[str, object] = {}
+        self.timeline = None
         self._cordoned: List[str] = []
         self._quota_flapped = False
         self._leader_overlap: List[str] = []
@@ -644,6 +645,21 @@ class ChaosDriver:
 
     def run(self) -> ChaosReport:
         report = ChaosReport(seed=self.config.seed, backend=self.config.backend)
+        # Soak under the observability plane the ISSUE ships: a generous
+        # default series budget (the soak's families must all fit — the
+        # governor-clean oracle fails the run if any under-budget family
+        # dropped) plus tight trace retention so the tail-kept reservoir
+        # is what keeps error/slow traces through the churn.
+        from nos_tpu.api.config import ObservabilityConfig
+        from nos_tpu.obsplane.apply import apply_observability
+
+        revert_observability = apply_observability(
+            ObservabilityConfig(
+                series_budget_default=512,
+                trace_tail_capacity=32,
+                trace_boring_sample_n=4,
+            )
+        )
         self._build()
         try:
             for burst in self.schedule:
@@ -658,11 +674,15 @@ class ChaosDriver:
             # timeline-clean oracle over the whole run's findings.
             self.timeline.tick()
             report.timeline_violations = oracles.timeline_clean(self.timeline)
+            report.timeline_violations.extend(oracles.governor_clean())
         finally:
             self._monitor_stop.set()
             for elector in self.electors:
                 elector.stop()
             self.cluster.stop()
+            if self.timeline is not None:
+                self.timeline.close()
+            revert_observability()
             if self.config.backend == "apiserver":
                 self.store.stop()
                 self.api.stop()

@@ -23,6 +23,7 @@ LEDGER_CONSISTENT = "ledger-consistent"
 AUTOSCALER_SETTLED = "autoscaler-settled"
 FORECAST_CALIBRATED = "forecast-calibrated"
 TIMELINE_CLEAN = "timeline-clean"
+GOVERNOR_CLEAN = "governor-clean"
 
 
 def pending_settled(store, scheduler_name: str = "") -> List[str]:
@@ -250,6 +251,30 @@ def timeline_clean(timeline) -> List[str]:
             f"{TIMELINE_CLEAN}: {detector} on series "
             f"{finding.get('series')!r}: {finding.get('verdict')}"
         )
+    return out
+
+
+def governor_clean(registry=None) -> List[str]:
+    """No under-budget metric family ever dropped a series (live-only:
+    reads the cardinality governor's accounting). The governor is only
+    allowed to fold label sets into ``_other`` once a family's exact
+    series count has actually filled its budget; a drop on a family that
+    never reached its budget — or one with no budget at all — means the
+    admission accounting miscounted under the churn the faults caused."""
+    from nos_tpu.util import metrics as metrics_mod
+
+    registry = registry if registry is not None else metrics_mod.REGISTRY
+    out: List[str] = []
+    for name, fam in sorted(registry.series_report().items()):
+        budget = fam.get("budget")
+        if not fam["dropped"]:
+            continue
+        if budget is None or fam["exact"] < budget:
+            out.append(
+                f"{GOVERNOR_CLEAN}: family {name} dropped "
+                f"{fam['dropped']} series while under budget "
+                f"(exact={fam['exact']}, budget={budget})"
+            )
     return out
 
 

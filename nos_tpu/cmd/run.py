@@ -17,6 +17,7 @@ import threading
 from nos_tpu.api.config import (
     AutoscalerConfig,
     GpuPartitionerConfig,
+    ObservabilityConfig,
     SchedulerConfig,
     TpuAgentConfig,
 )
@@ -97,6 +98,44 @@ def configs_from(config: dict):
         if c is not None:
             c.validate()
     return partitioner, scheduler, agent, autoscaler
+
+
+def observability_from(config: dict) -> ObservabilityConfig:
+    """ObservabilityConfig from the `observability:` section, e.g.
+
+      observability:
+        seriesBudget:
+          default: 512                  # per-family fallback budget
+          nos_tpu_capacity_node_chips: 3000
+        nodeTopK: 50
+        traceTailCapacity: 128
+        traceBoringSampleN: 4
+        traceSlowThresholds:
+          pod.journey: 2.0
+        debugPageLimit: 500
+
+    The zero-value section (or none at all) leaves everything off:
+    unbudgeted families, full per-node exposition, keep-every-trace.
+    """
+    o = config.get("observability") or {}
+    budgets = dict(o.get("seriesBudget") or {})
+    # `seriesBudget.default` is the catch-all; every other key names a
+    # metric family.
+    default = budgets.pop("default", o.get("seriesBudgetDefault"))
+    obs = ObservabilityConfig(
+        series_budget={str(k): int(v) for k, v in budgets.items()},
+        series_budget_default=int(default) if default is not None else None,
+        node_top_k=int(o.get("nodeTopK", 0)),
+        trace_tail_capacity=int(o.get("traceTailCapacity", 64)),
+        trace_boring_sample_n=int(o.get("traceBoringSampleN", 1)),
+        trace_slow_thresholds={
+            str(k): float(v)
+            for k, v in (o.get("traceSlowThresholds") or {}).items()
+        },
+        debug_page_limit=int(o.get("debugPageLimit", 500)),
+    )
+    obs.validate()
+    return obs
 
 
 def seed_node(spec: dict) -> Node:
@@ -222,6 +261,14 @@ def main(argv=None) -> int:
 
     config = load_config(args.config)
     partitioner_cfg, scheduler_cfg, agent_cfg, autoscaler_cfg = configs_from(config)
+    obs_cfg = observability_from(config)
+
+    # Apply series budgets + trace retention to the process-wide
+    # registry/tracer BEFORE any component registers series, so admission
+    # order (and therefore the exact/_other split) is deterministic.
+    from nos_tpu.obsplane.apply import apply_observability
+
+    revert_observability = apply_observability(obs_cfg)
 
     flight_recorder = None
     if args.record:
@@ -248,6 +295,10 @@ def main(argv=None) -> int:
         flight_recorder=flight_recorder,
         timeline=timeline,
     )
+    if cluster.capacity_ledger is not None and obs_cfg.node_top_k:
+        # Tiered exposition: exact pool rollups always; per-node series
+        # only for the K worst offenders (idle chips, fragmentation).
+        cluster.capacity_ledger.node_top_k = obs_cfg.node_top_k
     from nos_tpu.kube.events import EventRecorder
 
     timeline.attach(
@@ -292,7 +343,14 @@ def main(argv=None) -> int:
         forecast_fn=cluster.partitioner.forecaster.debug_payload
         if getattr(cluster.partitioner, "forecaster", None) is not None
         else None,
-        timeline_fn=lambda window: timeline.debug_payload(window_seconds=window),
+        timeline_fn=lambda window, **page: timeline.debug_payload(
+            window_seconds=window, **page
+        ),
+        capacity_stream_fn=cluster.capacity_ledger.debug_stream
+        if cluster.capacity_ledger is not None
+        else None,
+        timeline_stream_fn=timeline.iter_jsonl,
+        debug_page_limit=obs_cfg.debug_page_limit,
     )
     bound = health.start()
     logging.info(
@@ -342,6 +400,8 @@ def main(argv=None) -> int:
         cluster.stop()
         PROFILER.stop()
         health.stop()
+        timeline.close()
+        revert_observability()
         if flight_recorder is not None:
             flight_recorder.detach()
             count = flight_recorder.export_jsonl(args.record)

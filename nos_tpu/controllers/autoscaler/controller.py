@@ -19,7 +19,7 @@ its own clock so held boards cannot leak.
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from nos_tpu.api.config import AutoscalerConfig
 from nos_tpu.api.v1alpha1 import annotations as annot
@@ -59,6 +59,10 @@ class ModelServingReconciler:
         self.config = config or AutoscalerConfig()
         self.signals = signals or SignalRegistry()
         self.recorder = recorder
+        # serving key -> model label last exported on AUTOSCALER_REPLICAS,
+        # so _collect_orphans can reset the series after the ModelServing
+        # object (and its spec.model) is gone.
+        self._exported_models: Dict[str, str] = {}
 
     # ------------------------------------------------------------ helpers
 
@@ -183,6 +187,13 @@ class ModelServingReconciler:
                     self.store.delete("Pod", p.metadata.name, p.metadata.namespace)
                 except NotFoundError:
                     pass
+        # Label reset: the replica gauge series die with the object. If
+        # another live ModelServing shares the model label its next
+        # reconcile re-creates the series at the true value.
+        model = self._exported_models.pop(key, None)
+        if model is not None:
+            for state in ("desired", "ready"):
+                metrics.AUTOSCALER_REPLICAS.remove(model=model, state=state)
 
     def _reconcile(self, ms: ModelServing) -> Optional[Result]:
         now = self.signals.now()
@@ -207,6 +218,7 @@ class ModelServingReconciler:
         metrics.AUTOSCALER_REPLICAS.labels(model=ms.spec.model, state="ready").set(
             ready
         )
+        self._exported_models[serving_key(ms)] = ms.spec.model
 
         cold_starting = decision.verdict == policy.VERDICT_COLD_START
         if decision.desired > current:

@@ -1,0 +1,23 @@
+"""Fleet-scale observability plane (PR 19).
+
+The telemetry stack (metrics registry, trace store, timeline sampler,
+debug endpoints) was built and benched at 1k–16k nodes; this package
+holds the pieces that make it survive 100k nodes / 1M pods:
+
+- ``governor``  — config→series-budget resolution and the cardinality
+  report read by the bench and /debug surfaces (the enforcement itself
+  lives inside ``util.metrics`` so the hot path pays no import).
+- ``streaming`` — cursor pagination and JSONL-line helpers shared by
+  ``/debug/capacity``, ``/debug/traces``, and ``/debug/timeline`` so no
+  endpoint materializes an O(cluster) document.
+- ``apply``     — wires an ``ObservabilityConfig`` onto the process-wide
+  registry and tracer, returning a revert callable (the chaos harness
+  applies budgets around a run and must leave the shared registry
+  untouched). Imported function-locally to keep this package cycle-free.
+
+Only the pure modules are imported here; ``apply`` pulls in the metric
+and tracing singletons and stays behind a local import at call sites.
+"""
+from nos_tpu.obsplane import governor, streaming  # noqa: F401
+
+__all__ = ["governor", "streaming"]

@@ -1,0 +1,43 @@
+"""Wire an ObservabilityConfig onto the process-wide telemetry singletons.
+
+Kept out of ``obsplane/__init__`` (and imported function-locally by
+``cmd/run.py`` and the chaos driver) because it touches the
+``util.metrics``/``util.tracing`` globals — everything else in this
+package stays importable without them.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from nos_tpu.obsplane import governor
+
+
+def apply_observability(obs, registry=None, tracer=None) -> Callable[[], None]:
+    """Apply series budgets + trace retention; returns a revert callable.
+
+    The registry and tracer are process-global and shared across every
+    test in one pytest run, so callers that apply non-default policy
+    (the chaos soak, the bench's A/B arms) MUST call the returned revert
+    in a ``finally``.
+    """
+    from nos_tpu.util import metrics as metrics_mod
+    from nos_tpu.util import tracing as tracing_mod
+
+    registry = registry if registry is not None else metrics_mod.REGISTRY
+    tracer = tracer if tracer is not None else tracing_mod.TRACER
+
+    budgets, default = governor.budgets_from(obs)
+    prev_budgets = registry.apply_series_budgets(budgets, default=default)
+    prev_policy = tracer.store.set_retention(
+        tracing_mod.RetentionPolicy(
+            tail_capacity=obs.trace_tail_capacity,
+            boring_sample_n=obs.trace_boring_sample_n,
+            slow_thresholds=dict(obs.trace_slow_thresholds),
+        )
+    )
+
+    def revert() -> None:
+        registry.restore_series_budgets(prev_budgets)
+        tracer.store.set_retention(prev_policy)
+
+    return revert

@@ -1,0 +1,50 @@
+"""Cardinality-governor config resolution and reporting.
+
+The governor itself is three lines of admission logic inside
+``util.metrics._admit_child`` (budget check → deterministic ``_other``
+fold); this module owns the parts that don't belong on the metric hot
+path: translating ``ObservabilityConfig.series_budget`` into registry
+budgets and summarizing the resulting series accounting for the bench,
+chaos oracles, and /debug surfaces.
+
+Admission is a deterministic function of the admitted-series set: the
+first ``budget`` distinct label sets a family ever sees are exact,
+everything after folds into the single ``_other`` child. Replaying the
+same event stream therefore reproduces the same exposition bytes — the
+property ``tests/obsplane`` pins and the tampered-policy test proves
+fragile under a different budget.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+def budgets_from(obs) -> Tuple[Dict[str, int], Optional[int]]:
+    """(per-family budgets, default budget) from an ObservabilityConfig.
+    A 0/None default means unbudgeted, matching the registry contract."""
+    budgets = {name: int(v) for name, v in (obs.series_budget or {}).items()}
+    default = obs.series_budget_default
+    if default is not None and default <= 0:
+        default = None
+    return budgets, default
+
+
+def governor_report(registry) -> dict:
+    """Totals + per-family series accounting, sorted and JSON-ready.
+
+    ``families`` only lists families that hold series or carry a budget;
+    ``over_budget`` names the ones actively folding into ``_other`` —
+    the list the chaos ``governor-clean`` oracle checks against the
+    budgets it set on purpose.
+    """
+    families = registry.series_report()
+    active = sum(f["exact"] + f["overflow"] for f in families.values())
+    dropped = sum(f["dropped"] for f in families.values())
+    return {
+        "active_series": active,
+        "dropped_series": dropped,
+        "over_budget": sorted(
+            name for name, f in families.items() if f["dropped"]
+        ),
+        "families": families,
+    }

@@ -1,0 +1,61 @@
+"""Cursor pagination + JSONL helpers for the /debug endpoints.
+
+All three O(cluster) debug surfaces (capacity nodes, trace summaries,
+timeline series) paginate the same way: items are ordered by a stable
+string key, the cursor is the last key of the previous page, and a page
+is the first ``limit`` items strictly after it. Keys are compared as
+plain strings, so zero-padded names (node-00042) page in cluster order.
+An empty ``next_cursor`` means the listing is exhausted.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+
+def paginate(
+    keys: Sequence[str], limit: int = 0, cursor: str = ""
+) -> Tuple[List[str], str]:
+    """Page through ``keys`` (must be sorted ascending). Returns
+    ``(page, next_cursor)``; ``limit`` <= 0 means the whole remainder."""
+    start = bisect.bisect_right(keys, cursor) if cursor else 0
+    if limit and limit > 0:
+        page = list(keys[start : start + limit])
+        more = start + limit < len(keys)
+        return page, (page[-1] if page and more else "")
+    return list(keys[start:]), ""
+
+
+def page_params(query: Dict[str, str], default_limit: int = 0) -> dict:
+    """Decode ?pool=/?limit=/?cursor=/?format= into validated kwargs.
+    A malformed limit raises ValueError (the HTTP layer maps it to 400)."""
+    limit = default_limit
+    if "limit" in query:
+        limit = int(query["limit"])
+        if limit < 0:
+            raise ValueError("limit must be >= 0")
+    return {
+        "pool": query.get("pool", ""),
+        "limit": limit,
+        "cursor": query.get("cursor", ""),
+        "jsonl": query.get("format", "") == "jsonl",
+    }
+
+
+def jsonl_lines(records: Iterable[dict]) -> Iterator[bytes]:
+    """Encode records one line at a time — the chunked-response writer
+    consumes this without ever holding the whole document."""
+    for record in records:
+        yield (json.dumps(record, sort_keys=True) + "\n").encode()
+
+
+def page_envelope(
+    payload: dict, next_cursor: str, limit: int, total: Optional[int] = None
+) -> dict:
+    """Uniform pagination trailer appended to paged JSON documents."""
+    page = {"limit": limit, "next_cursor": next_cursor}
+    if total is not None:
+        page["total"] = total
+    payload["page"] = page
+    return payload

@@ -158,25 +158,45 @@ class TimelineStore:
         metrics_fn: Optional[Callable[[], Dict[str, float]]] = None,
         sizes: Optional[SizeRegistry] = None,
         watchdog: Optional[WedgeWatchdog] = None,
+        registry: Optional[metrics.MetricsRegistry] = None,
+        recent_evict_frames: int = 8,
     ) -> None:
         self.capacity = capacity
         self.interval_seconds = interval_seconds
         self.clock = clock
         self.policy = policy or DetectorPolicy()
         self.vitals = vitals
-        self.metrics_fn = (
-            metrics.REGISTRY.snapshot if metrics_fn is None else metrics_fn
-        )
+        # Default mode rides an incremental registry cursor: each sample
+        # folds (changed, removed) deltas into the carried value map, so
+        # sampling cost is O(series touched this interval) — at 100k
+        # nodes the full snapshot is ~400k series, of which a quiet
+        # interval touches a few hundred. An explicit ``metrics_fn``
+        # keeps the original full-snapshot diff mode (tests, synthetic
+        # collectors, replay harnesses).
+        if metrics_fn is None:
+            self._registry = registry if registry is not None else metrics.REGISTRY
+            self._cursor = self._registry.cursor()
+            self.metrics_fn: Optional[Callable[[], Dict[str, float]]] = (
+                self._registry.snapshot
+            )
+        else:
+            self._registry = None
+            self._cursor = None
+            self.metrics_fn = metrics_fn
         self.sizes = SIZES if sizes is None else sizes
         self.watchdog = WATCHDOG if watchdog is None else watchdog
         self._lock = threading.Lock()
         self._entries: List[dict] = []
         self._base: Dict[str, float] = {}
         self._last: Dict[str, float] = {}
-        # Detector fast path: the last few points of EVERY series, kept
+        # Detector fast path: the last few points of every WATCHED series
+        # (stall/leak/regression targets — not the whole registry), kept
         # incrementally so a detector pass never replays the delta ring
         # (which is O(ring length) per reconstruction). Sized to the
-        # largest window any configured detector looks at.
+        # largest window any configured detector looks at. Series absent
+        # ``recent_evict_frames`` consecutive samples are evicted, so
+        # node/pod churn cannot grow the cache with tombstone deques;
+        # the grace window keeps history across a one-sample flap.
         self._recent_len = max(
             self.policy.leak_window,
             self.policy.stall_flat_windows + 1,
@@ -184,7 +204,17 @@ class TimelineStore:
             + self.policy.regression_recent_points,
         )
         self._recent: Dict[str, Deque[Point]] = {}
+        self.recent_evict_frames = max(1, recent_evict_frames)
+        self._recent_absent: Dict[str, int] = {}
+        # Aux collector keys (size./loop./process.) seen last sample —
+        # cursor mode needs them to detect aux series removal, since the
+        # cursor only covers the registry.
+        self._aux_keys: set = set()
         self._samples = 0
+        # The cache itself is leak-detector-visible: a growing
+        # recent_series under node churn is exactly the tombstone leak
+        # this store must not have.
+        self.sizes.register("timeline.recent_series", lambda: len(self._recent))
         self._findings: List[dict] = []
         self._active: Dict[Tuple[str, str], dict] = {}
         self._flight = None
@@ -205,11 +235,10 @@ class TimelineStore:
 
     # -- sampling ---------------------------------------------------------
 
-    def collect(self) -> Dict[str, float]:
-        """One full sample across all collectors (no ring mutation)."""
+    def _collect_aux(self) -> Dict[str, float]:
+        """The non-registry collectors (sizes, watchdog loops, vitals) —
+        cheap, bounded families always sampled in full."""
         values: Dict[str, float] = {}
-        if self.metrics_fn is not None:
-            values.update(self.metrics_fn())
         for name, size in self.sizes.sizes().items():
             values[f"size.{name}"] = size
         for name, count in self.watchdog.counters().items():
@@ -221,21 +250,70 @@ class TimelineStore:
             values["process.threads"] = float(threading.active_count())
         return values
 
+    def collect(self) -> Dict[str, float]:
+        """One full sample across all collectors (no ring mutation)."""
+        values: Dict[str, float] = {}
+        if self.metrics_fn is not None:
+            values.update(self.metrics_fn())
+        values.update(self._collect_aux())
+        return values
+
+    def _watched_names(self) -> set:
+        """Series the detector cache must hold: stall targets, explicit
+        leak/regression series — ``size.*`` keys are matched by prefix
+        at insertion (the sized set is dynamic)."""
+        watched = {f"loop.{name}" for name in self.watchdog.periodic_loops()}
+        watched.update(self.policy.stall_series)
+        watched.update(self.policy.leak_series)
+        watched.update(self.policy.regression_series)
+        return watched
+
     def sample_once(self, now: Optional[float] = None) -> Dict[str, float]:
         """Append one delta-encoded sample to the ring."""
         started = time.perf_counter()
         if now is None:
             now = self.clock()
-        values = self.collect()
+        if self._cursor is None:
+            values = self.collect()
+            changed: Optional[Dict[str, float]] = None
+            removed: List[str] = []
+        else:
+            changed, removed = self._cursor.collect()
+            aux = self._collect_aux()
+        watched = self._watched_names()
         with self._lock:
-            delta: Dict[str, Optional[float]] = {
-                k: v for k, v in values.items() if self._last.get(k) != v
-            }
-            for gone in self._last:
-                if gone not in values:
-                    delta[gone] = _REMOVED
-                    self._recent.pop(gone, None)
+            if self._cursor is None:
+                delta: Dict[str, Optional[float]] = {
+                    k: v for k, v in values.items() if self._last.get(k) != v
+                }
+                for gone in self._last:
+                    if gone not in values:
+                        delta[gone] = _REMOVED
+            else:
+                # Fold the cursor delta (and the fully-sampled aux
+                # families) into the carried value map — O(touched).
+                values = dict(self._last)
+                delta = {}
+                for key in removed:
+                    if key in values:
+                        del values[key]
+                        delta[key] = _REMOVED
+                for key, value in changed.items():
+                    if values.get(key) != value:
+                        values[key] = value
+                        delta[key] = value
+                for key in self._aux_keys:
+                    if key not in aux and key in values:
+                        del values[key]
+                        delta[key] = _REMOVED
+                for key, value in aux.items():
+                    if values.get(key) != value:
+                        values[key] = value
+                        delta[key] = value
+                self._aux_keys = set(aux)
             for name, value in values.items():
+                if name not in watched and not name.startswith("size."):
+                    continue
                 window = self._recent.get(name)
                 if window is None:
                     window = self._recent[name] = collections.deque(
@@ -245,6 +323,15 @@ class TimelineStore:
                 # normalized — the recorded window then round-trips
                 # through JSON bit-identically for replay recompute.
                 window.append((float(now), float(value)))
+                self._recent_absent.pop(name, None)
+            for name in list(self._recent):
+                if name not in values:
+                    absent = self._recent_absent.get(name, 0) + 1
+                    if absent >= self.recent_evict_frames:
+                        self._recent.pop(name, None)
+                        self._recent_absent.pop(name, None)
+                    else:
+                        self._recent_absent[name] = absent
             self._entries.append({"t": now, "d": delta})
             while len(self._entries) > self.capacity:
                 evicted = self._entries.pop(0)
@@ -322,28 +409,30 @@ class TimelineStore:
                     out[name].append((t, value))
         return out
 
+    def iter_jsonl(self):
+        """Yield the ring frame-by-frame (header dict, then one delta
+        dict per retained sample) — the chunked ``?format=jsonl`` debug
+        path encodes each frame as it goes, never holding the whole
+        export. The ring is snapshotted under the lock once; entries are
+        append-only dicts, so yielding outside the lock is safe."""
+        with self._lock:
+            header = {
+                "kind": "timeline.base",
+                "base": dict(sorted(self._base.items())),
+                "samples": self._samples,
+            }
+            entries = list(self._entries)
+        yield header
+        for entry in entries:
+            yield {"t": entry["t"], "d": dict(sorted(entry["d"].items()))}
+
     def to_jsonl(self) -> str:
         """The ring as JSONL: a header frame with the folded base, then
         one delta frame per retained sample."""
-        with self._lock:
-            lines = [
-                json.dumps(
-                    {
-                        "kind": "timeline.base",
-                        "base": dict(sorted(self._base.items())),
-                        "samples": self._samples,
-                    },
-                    sort_keys=True,
-                )
-            ]
-            for entry in self._entries:
-                lines.append(
-                    json.dumps(
-                        {"t": entry["t"], "d": dict(sorted(entry["d"].items()))},
-                        sort_keys=True,
-                    )
-                )
-        return "\n".join(lines) + "\n"
+        return (
+            "\n".join(json.dumps(frame, sort_keys=True) for frame in self.iter_jsonl())
+            + "\n"
+        )
 
     def export(self, path: str) -> None:
         with open(path, "w") as fh:
@@ -547,8 +636,30 @@ class TimelineStore:
         self,
         window_seconds: Optional[float] = None,
         spark_points: int = 32,
+        limit: int = 0,
+        cursor: str = "",
     ) -> dict:
-        rollups = self.rollups(window_seconds)
+        """``limit``/``cursor`` page the per-series sections (rollups +
+        sparklines) by series name; the scalar summary always covers the
+        whole ring. Defaults reproduce the full pre-paging document."""
+        from nos_tpu.obsplane.streaming import paginate
+
+        names = self.names()
+        page_names, next_cursor = paginate(names, limit, cursor)
+        rollups: Dict[str, dict] = {}
+        for name in page_names:
+            points = self.series(name, window_seconds)
+            if not points:
+                continue
+            values = [v for _, v in points]
+            rollups[name] = {
+                "first": values[0],
+                "last": values[-1],
+                "min": min(values),
+                "max": max(values),
+                "delta": values[-1] - values[0],
+                "points": len(values),
+            }
         return {
             "samples": self.samples,
             "retained": len(self),
@@ -565,6 +676,12 @@ class TimelineStore:
             "sparklines": {
                 name: self.sparkline(name, spark_points, window_seconds)
                 for name in rollups
+            },
+            "page": {
+                "limit": limit,
+                "cursor": cursor,
+                "next_cursor": next_cursor,
+                "total_series": len(names),
             },
         }
 
@@ -604,3 +721,12 @@ class TimelineStore:
         self._thread.join(timeout=5.0)
         self._thread = None
         self.watchdog.unregister("timeline-sampler")
+
+    def close(self) -> None:
+        """Detach the registry cursor (idempotent). A closed store falls
+        back to full-snapshot sampling if sampled again — harnesses that
+        build many short-lived stores against the process registry call
+        this so abandoned cursors stop accumulating deltas."""
+        if self._cursor is not None:
+            self._cursor.close()
+            self._cursor = None

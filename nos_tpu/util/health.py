@@ -39,6 +39,9 @@ class HealthServer:
         autoscaler_fn: Optional[Callable[[], dict]] = None,
         forecast_fn: Optional[Callable[[bool], dict]] = None,
         timeline_fn: Optional[Callable[[Optional[float]], dict]] = None,
+        capacity_stream_fn: Optional[Callable[..., Any]] = None,
+        timeline_stream_fn: Optional[Callable[[], Any]] = None,
+        debug_page_limit: int = 500,
     ) -> None:
         self.port = port
         self.ready_check = ready_check or (lambda: True)
@@ -79,6 +82,17 @@ class HealthServer:
         # findings), called with the parsed ?window= seconds (or None for
         # the whole ring); None disables the endpoint (no timeline wired).
         self.timeline_fn = timeline_fn
+        # ?format=jsonl generators: /debug/capacity streams one record
+        # per node from capacity_stream_fn(pool=...), /debug/timeline
+        # streams ring frames from timeline_stream_fn() — both chunked,
+        # so no O(cluster) document is ever materialized server-side.
+        self.capacity_stream_fn = capacity_stream_fn
+        self.timeline_stream_fn = timeline_stream_fn
+        # Default page size applied when a paginated debug endpoint gets
+        # no explicit ?limit= (0 = unpaginated, the pre-streaming shape).
+        # Direct debug_payload() callers are unaffected — the cap lives
+        # at the HTTP layer only.
+        self.debug_page_limit = debug_page_limit
         # metrics_token non-empty (or a provider callable): /metrics
         # requires `Authorization: Bearer <token>` (the reference protects
         # metrics behind a kube-rbac-proxy TokenReview sidecar,
@@ -116,8 +130,10 @@ class HealthServer:
 
         register(
             "/debug/traces",
-            "per-trace summaries; ?id=<trace_id> for the full Chrome "
-            "trace-event timeline",
+            "per-trace summaries newest-first with retention accounting; "
+            "?id=<trace_id> for the full Chrome trace-event timeline; "
+            "?limit=/?cursor= paginate, ?format=jsonl streams one summary "
+            "per line",
             self._serve_traces,
         )
         register(
@@ -143,7 +159,9 @@ class HealthServer:
             register(
                 "/debug/capacity",
                 "the capacity ledger: chip-seconds accounting, idle "
-                "attribution, fragmentation, gang waits, quota posture",
+                "attribution, fragmentation, gang waits, quota posture; "
+                "?pool= filters, ?limit=/?cursor= paginate the node table, "
+                "?format=jsonl streams one record per node",
                 self._serve_capacity,
             )
         if self.profiler is not None:
@@ -193,13 +211,26 @@ class HealthServer:
                 "rollups and sparkline arrays over the sampled ring, the "
                 "wedge-watchdog loop registry, and leak/stall/regression "
                 "detector findings; ?window=<seconds> bounds the rollup "
-                "window",
+                "window, ?limit=/?cursor= paginate the per-series tables, "
+                "?format=jsonl streams the delta-encoded ring frames",
                 self._serve_timeline,
             )
         return endpoints
 
     # Endpoint handlers: called with the live request handler (for
     # _respond and headers) and the split URL, after the bearer gate.
+
+    def _page_params(self, req, url) -> Optional[dict]:
+        """Parsed ?pool=/?limit=/?cursor=/?format= with the server's
+        default page size; responds 400 and returns None on a bad limit."""
+        from nos_tpu.obsplane.streaming import page_params
+
+        query = {k: v[0] for k, v in parse_qs(url.query).items()}
+        try:
+            return page_params(query, default_limit=self.debug_page_limit)
+        except ValueError:
+            req._respond(400, "limit must be a non-negative integer")
+            return None
 
     def _serve_traces(self, req, url) -> None:
         wanted = parse_qs(url.query).get("id", [None])[0]
@@ -208,9 +239,27 @@ class HealthServer:
             if trace is None:
                 req._respond(404, "unknown trace id")
                 return
-            body = json.dumps(trace.to_chrome(), indent=2)
-        else:
-            body = json.dumps(TRACER.store.summaries(), indent=2)
+            req._respond(200, json.dumps(trace.to_chrome(), indent=2), "application/json")
+            return
+        page = self._page_params(req, url)
+        if page is None:
+            return
+        summaries, next_cursor = TRACER.store.summaries_page(
+            limit=page["limit"], cursor=page["cursor"]
+        )
+        if page["jsonl"]:
+            from nos_tpu.obsplane.streaming import jsonl_lines
+
+            req._respond_stream(200, jsonl_lines(summaries))
+            return
+        body = json.dumps(
+            {
+                "traces": summaries,
+                "retention": TRACER.store.retention_stats(),
+                "page": {"limit": page["limit"], "next_cursor": next_cursor},
+            },
+            indent=2,
+        )
         req._respond(200, body, "application/json")
 
     def _serve_vars(self, req, url) -> None:
@@ -239,9 +288,25 @@ class HealthServer:
             req._respond(200, json.dumps(records, indent=2), "application/json")
 
     def _serve_capacity(self, req, url) -> None:
-        req._respond(
-            200, json.dumps(self.capacity_fn(), indent=2), "application/json"
-        )
+        page = self._page_params(req, url)
+        if page is None:
+            return
+        if page["jsonl"] and self.capacity_stream_fn is not None:
+            from nos_tpu.obsplane.streaming import jsonl_lines
+
+            req._respond_stream(
+                200, jsonl_lines(self.capacity_stream_fn(pool=page["pool"]))
+            )
+            return
+        try:
+            payload = self.capacity_fn(
+                pool=page["pool"], limit=page["limit"], cursor=page["cursor"]
+            )
+        except TypeError:
+            # A legacy zero-arg capacity_fn (tests, minimal wiring): serve
+            # the unpaginated document it returns.
+            payload = self.capacity_fn()
+        req._respond(200, json.dumps(payload, indent=2), "application/json")
 
     def _serve_profile(self, req, url) -> None:
         query = parse_qs(url.query)
@@ -301,6 +366,14 @@ class HealthServer:
         )
 
     def _serve_timeline(self, req, url) -> None:
+        page = self._page_params(req, url)
+        if page is None:
+            return
+        if page["jsonl"] and self.timeline_stream_fn is not None:
+            from nos_tpu.obsplane.streaming import jsonl_lines
+
+            req._respond_stream(200, jsonl_lines(self.timeline_stream_fn()))
+            return
         raw = parse_qs(url.query).get("window", [None])[0]
         window: Optional[float] = None
         if raw is not None:
@@ -309,9 +382,15 @@ class HealthServer:
             except ValueError:
                 req._respond(400, "window must be a number of seconds")
                 return
+        try:
+            payload = self.timeline_fn(
+                window, limit=page["limit"], cursor=page["cursor"]
+            )
+        except TypeError:
+            payload = self.timeline_fn(window)
         req._respond(
             200,
-            json.dumps(self.timeline_fn(window), indent=2, sort_keys=True),
+            json.dumps(payload, indent=2, sort_keys=True),
             "application/json",
         )
 
@@ -336,6 +415,16 @@ class HealthServer:
             return metrics_token
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 so chunked transfer encoding (the ?format=jsonl
+            # streaming paths) is legal; _respond always sets
+            # Content-Length so fixed responses stay keep-alive-safe.
+            protocol_version = "HTTP/1.1"
+            # Idle keep-alive connections must not pin handler threads
+            # past shutdown: the socket timeout makes handle_one_request
+            # drop a quiet persistent connection instead of blocking in
+            # readline() forever.
+            timeout = 5.0
+
             def _authorized(self) -> bool:
                 if not auth_enabled:
                     return True
@@ -387,6 +476,32 @@ class HealthServer:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _respond_stream(
+                self,
+                code: int,
+                chunks,
+                ctype: str = "application/x-ndjson",
+            ) -> None:
+                """Chunked transfer encoding over an iterable of bytes —
+                the response is produced incrementally, never buffered
+                whole, so streaming debug endpoints stay O(1) in cluster
+                size server-side."""
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(f"{len(chunk):X}\r\n".encode())
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    # Client went away mid-stream; nothing to salvage.
+                    self.close_connection = True
 
             def log_message(self, *args) -> None:  # silence request logging
                 pass

@@ -11,11 +11,40 @@ returns a child series rendered as ``name{profile="2x2"}``. A family's
 un-labeled parent still works (the pre-label call sites and tests), and
 label values are escaped per the Prometheus text exposition format
 (backslash, double quote, newline).
+
+Fleet scale (the observability plane's own 100k-node story) adds three
+mechanisms on top, all off by default:
+
+- **Cardinality governor**: a per-family *series budget*
+  (:meth:`MetricsRegistry.apply_series_budgets`). Once a family holds
+  ``budget`` exact children, further distinct label sets aggregate into
+  one ``_other``-valued child per label keyset and count (once per
+  distinct refused set) into
+  ``nos_tpu_metric_series_dropped_total{family}``. The mapping is a
+  deterministic function of the admitted series set — for a fixed event
+  stream (live or replayed) the same label sets land exact and the same
+  sets fold into ``_other``, and counter sums are preserved exactly
+  because the overflow child absorbs every refused increment.
+- **Child delete**: ``remove(**labels)`` drops a child series from the
+  family — the delete-reset path for per-object families (a deleted
+  node's gauges disappear from the exposition instead of reporting
+  stale values or zeros forever). ``LABEL_RESET_PATHS`` below registers
+  which deleter owns each per-object family; the label-reset lint in
+  ``tests/util/test_lint.py`` keys on it.
+- **Incremental snapshot**: :meth:`MetricsRegistry.cursor` returns a
+  :class:`SnapshotCursor` whose ``collect()`` yields only the series
+  touched (and the keys removed) since the previous call — the timeline
+  sampler's per-tick cost becomes O(changed series), not O(total).
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
+
+# The label value every refused series folds into, one overflow child
+# per (family, label keyset). "_other" cannot collide with a Kubernetes
+# object name (names may not start with "_").
+OTHER_LABEL = "_other"
 
 
 def escape_label_value(value: str) -> str:
@@ -38,6 +67,45 @@ def render_labels(labels: Dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+def _admit_child(family, label_values: Dict[str, str]):
+    """labels() core shared by Counter/Gauge/Histogram: get-or-create the
+    child for this label set. At or over the family's series budget a NEW
+    label set routes to the family's ``_other`` child for the same label
+    keys instead — admission depends only on which sets already exist, so
+    a replayed event stream reproduces the same exact/overflow split."""
+    if family._label_values:
+        raise ValueError(f"{family.name}: labels() on an already-labeled child")
+    key = tuple(sorted((k, str(v)) for k, v in label_values.items()))
+    dropped_new = False
+    with family._lock:
+        child = family._children.get(key)
+        if child is None:
+            budget = family._budget
+            exact = len(family._children) - family._overflow_children
+            if budget is not None and exact >= budget:
+                refused = hash(key)
+                if refused not in family._dropped_hashes:
+                    family._dropped_hashes.add(refused)
+                    dropped_new = True
+                okey = tuple((k, OTHER_LABEL) for k, _ in key)
+                child = family._children.get(okey)
+                if child is None:
+                    child = family._new_child({k: OTHER_LABEL for k, _ in key})
+                    child._is_overflow = True
+                    family._children[okey] = child
+                    family._overflow_children += 1
+                    family._children_sorted = None
+            else:
+                child = family._new_child(
+                    {k: str(v) for k, v in label_values.items()}
+                )
+                family._children[key] = child
+                family._children_sorted = None
+    if dropped_new and family._on_drop is not None:
+        family._on_drop(family.name)
+    return child
+
+
 class Counter:
     TYPE = "counter"
 
@@ -58,27 +126,58 @@ class Counter:
         self._children: Dict[Tuple, "Counter"] = {}
         self._children_sorted: Optional[list] = None
         self._touched = False
+        # Governor state (parent only): None = unbudgeted. Overflow
+        # children ("_other") are exempt from the budget; refused label
+        # sets are remembered as 64-bit hashes so the dropped count is
+        # per-distinct-series without paying a full child per refusal.
+        self._budget: Optional[int] = None
+        self._overflow_children = 0
+        self._dropped_hashes: Set[int] = set()
+        self._is_overflow = False
+        # Registry hooks: _mark feeds the incremental-snapshot dirty set
+        # (wired only while cursors exist, so the no-cursor fast path is
+        # unchanged), _mark_removed propagates child deletes to cursors,
+        # _on_drop counts governor refusals.
+        self._mark = None
+        self._mark_removed = None
+        self._on_drop = None
 
     def _new_child(self, label_values: Dict[str, str]) -> "Counter":
-        return type(self)(self.name, self.help, label_values)
+        child = type(self)(self.name, self.help, label_values)
+        child._mark = self._mark
+        return child
 
     def labels(self, **label_values: str) -> "Counter":
-        """Child series for this label set (created on first use)."""
-        if self._label_values:
-            raise ValueError(f"{self.name}: labels() on an already-labeled child")
+        """Child series for this label set (created on first use, subject
+        to the family's series budget — see :data:`OTHER_LABEL`)."""
+        return _admit_child(self, label_values)
+
+    def remove(self, **label_values: str) -> bool:
+        """Delete the child series for this label set (the delete-reset
+        path for per-object families). Returns False if absent. The freed
+        slot counts against the budget again; the dropped-series record
+        is monotonic and stays."""
         key = tuple(sorted((k, str(v)) for k, v in label_values.items()))
         with self._lock:
-            child = self._children.get(key)
+            child = self._children.pop(key, None)
             if child is None:
-                child = self._new_child({k: str(v) for k, v in label_values.items()})
-                self._children[key] = child
-                self._children_sorted = None
-            return child
+                return False
+            if child._is_overflow:
+                self._overflow_children -= 1
+            self._children_sorted = None
+        if self._mark_removed is not None:
+            self._mark_removed(child)
+        return True
+
+    def _removed_snapshot_keys(self) -> Tuple[str, ...]:
+        return (self._snapshot_key,)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
             self._touched = True
+        if self._mark is not None:
+            self._mark(self)
 
     @property
     def value(self) -> float:
@@ -123,15 +222,20 @@ class Counter:
                 lines.append(f"{child.name}{labels} {child._value}")
         return "\n".join(lines) + "\n"
 
-    def snapshot_into(self, out: Dict[str, float]) -> None:
-        """Touched series only: a family nothing has incremented yet has
-        no sample worth a timeline series (it appears on first use, the
-        same way labeled children do)."""
+    def snapshot_self_into(self, out: Dict[str, float]) -> None:
+        """This series' own sample only (no children) — the unit the
+        incremental snapshot cursor collects per dirty series."""
         with self._lock:
             touched = self._touched
             value = self._value
         if touched:
             out[self._snapshot_key] = value
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        """Touched series only: a family nothing has incremented yet has
+        no sample worth a timeline series (it appears on first use, the
+        same way labeled children do)."""
+        self.snapshot_self_into(out)
         if self._children:
             for child in self._sorted_children():
                 child.snapshot_into(out)
@@ -144,6 +248,8 @@ class Gauge(Counter):
         with self._lock:
             self._value = float(value)
             self._touched = True
+        if self._mark is not None:
+            self._mark(self)
 
 
 class Histogram:
@@ -185,23 +291,38 @@ class Histogram:
         self._children: Dict[Tuple, "Histogram"] = {}
         self._children_sorted: Optional[list] = None
         self._touched = False
+        self._budget: Optional[int] = None
+        self._overflow_children = 0
+        self._dropped_hashes: Set[int] = set()
+        self._is_overflow = False
+        self._mark = None
+        self._mark_removed = None
+        self._on_drop = None
+
+    def _new_child(self, label_values: Dict[str, str]) -> "Histogram":
+        child = Histogram(self.name, self.help, self.buckets, label_values)
+        child._mark = self._mark
+        return child
 
     def labels(self, **label_values: str) -> "Histogram":
-        if self._label_values:
-            raise ValueError(f"{self.name}: labels() on an already-labeled child")
+        return _admit_child(self, label_values)
+
+    def remove(self, **label_values: str) -> bool:
+        """Delete the child series for this label set (see Counter.remove)."""
         key = tuple(sorted((k, str(v)) for k, v in label_values.items()))
         with self._lock:
-            child = self._children.get(key)
+            child = self._children.pop(key, None)
             if child is None:
-                child = Histogram(
-                    self.name,
-                    self.help,
-                    self.buckets,
-                    {k: str(v) for k, v in label_values.items()},
-                )
-                self._children[key] = child
-                self._children_sorted = None
-            return child
+                return False
+            if child._is_overflow:
+                self._overflow_children -= 1
+            self._children_sorted = None
+        if self._mark_removed is not None:
+            self._mark_removed(child)
+        return True
+
+    def _removed_snapshot_keys(self) -> Tuple[str, ...]:
+        return self._snapshot_keys
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -213,8 +334,11 @@ class Histogram:
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+        if self._mark is not None:
+            self._mark(self)
 
     @property
     def count(self) -> int:
@@ -273,7 +397,7 @@ class Histogram:
             lines.extend(child._sample_lines())
         return "\n".join(lines) + "\n"
 
-    def snapshot_into(self, out: Dict[str, float]) -> None:
+    def snapshot_self_into(self, out: Dict[str, float]) -> None:
         """Count/sum always (an empty histogram's exact zeros are part of
         the exposition contract); percentiles only once samples exist,
         computed off one lock hold and the shared sorted-window cache."""
@@ -288,15 +412,80 @@ class Histogram:
                 last = len(ordered) - 1
                 for p, key in ((50, key_p50), (95, key_p95), (99, key_p99)):
                     out[key] = ordered[min(last, int(p / 100.0 * len(ordered)))]
+
+    def snapshot_into(self, out: Dict[str, float]) -> None:
+        self.snapshot_self_into(out)
         if self._children:
             for child in self._sorted_children():
                 child.snapshot_into(out)
+
+
+class SnapshotCursor:
+    """Incremental registry snapshot: ``collect()`` returns ``(changed,
+    removed_keys)`` since the previous call — O(series touched in the
+    window), not O(total series). The first call primes with the full
+    snapshot. Mutator ordering makes the delta lossless: a series updates
+    its value *before* marking itself dirty, and the drain swaps the
+    dirty set *before* reading values, so any update whose mark lands in
+    an already-drained set was visible to that drain's reads (duplicates
+    across windows are possible, losses are not)."""
+
+    def __init__(self, registry: "MetricsRegistry") -> None:
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._pending: Set[object] = set()
+        self._removed: Set[str] = set()
+        self._primed = False
+
+    def collect(self) -> Tuple[Dict[str, float], List[str]]:
+        reg = self._registry
+        if not self._primed:
+            with self._lock:
+                self._primed = True
+                self._pending.clear()
+                self._removed.clear()
+            return reg.snapshot(), []
+        reg._drain_dirty()
+        with self._lock:
+            pending, self._pending = self._pending, set()
+            removed = sorted(self._removed)
+            self._removed.clear()
+        out: Dict[str, float] = {}
+        for series in pending:
+            series.snapshot_self_into(out)
+        # A series both mutated and removed in the window: the removal
+        # wins — its key must not resurface as a change.
+        for key in removed:
+            out.pop(key, None)
+        return out, removed
+
+    def close(self) -> None:
+        """Detach from the registry (stop accumulating deltas)."""
+        reg = self._registry
+        with reg._dirty_lock:
+            if self in reg._cursors:
+                reg._cursors.remove(self)
+
+
+METRIC_SERIES_DROPPED_NAME = "nos_tpu_metric_series_dropped_total"
 
 
 class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # Incremental-snapshot plumbing: series objects touched since the
+        # last drain, merged into every attached cursor's pending set.
+        # _marking stays False until the first cursor attaches, so the
+        # inc/set/observe fast path pays nothing by default.
+        self._dirty: Set[object] = set()
+        self._dirty_lock = threading.Lock()
+        self._cursors: List[SnapshotCursor] = []
+        self._marking = False
+        # Governor budgets for families not created yet (apply before
+        # definition, e.g. config load before a lazy import).
+        self._pending_budgets: Dict[str, Optional[int]] = {}
+        self._default_budget: Optional[int] = None
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         return self._get_or_create(name, lambda: Counter(name, help_text))
@@ -310,8 +499,131 @@ class MetricsRegistry:
     def _get_or_create(self, name: str, factory):
         with self._lock:
             if name not in self._metrics:
-                self._metrics[name] = factory()
+                metric = factory()
+                metric._mark_removed = self._mark_removed
+                metric._on_drop = self._note_dropped
+                if name != METRIC_SERIES_DROPPED_NAME:
+                    metric._budget = self._pending_budgets.get(
+                        name, self._default_budget
+                    )
+                if self._marking:
+                    metric._mark = self._mark_dirty
+                self._metrics[name] = metric
             return self._metrics[name]
+
+    # ----------------------------------------------- incremental snapshot
+
+    def _mark_dirty(self, series) -> None:
+        with self._dirty_lock:
+            self._dirty.add(series)
+
+    def _mark_removed(self, series) -> None:
+        keys = series._removed_snapshot_keys()
+        with self._dirty_lock:
+            self._dirty.discard(series)
+            for cursor in self._cursors:
+                with cursor._lock:
+                    cursor._removed.update(keys)
+
+    def _drain_dirty(self) -> None:
+        with self._dirty_lock:
+            if not self._dirty:
+                return
+            drained, self._dirty = self._dirty, set()
+            cursors = list(self._cursors)
+        for cursor in cursors:
+            with cursor._lock:
+                cursor._pending |= drained
+
+    def cursor(self) -> SnapshotCursor:
+        """Attach an incremental-snapshot consumer (each cursor sees every
+        delta independently). Call ``close()`` when done."""
+        cursor = SnapshotCursor(self)
+        with self._lock:
+            metrics = list(self._metrics.values())
+        with self._dirty_lock:
+            self._cursors.append(cursor)
+            self._marking = True
+        for metric in metrics:
+            metric._mark = self._mark_dirty
+            with metric._lock:
+                children = list(metric._children.values())
+            for child in children:
+                child._mark = self._mark_dirty
+        return cursor
+
+    # ------------------------------------------------ cardinality governor
+
+    def _note_dropped(self, family: str) -> None:
+        self.counter(
+            METRIC_SERIES_DROPPED_NAME,
+            "Distinct label sets refused by a family's series budget and "
+            "folded into its _other child (by family)",
+        ).labels(family=family).inc()
+
+    def apply_series_budgets(
+        self,
+        budgets: Optional[Dict[str, int]] = None,
+        default: Optional[int] = None,
+    ) -> dict:
+        """Set per-family series budgets (``default`` applies to every
+        family without an explicit entry; None leaves it unbudgeted).
+        Budgets gate NEW admissions only — children already past the
+        budget are grandfathered. Returns the previous budget state for
+        :meth:`restore_series_budgets` (the chaos harness applies budgets
+        around a run and must leave the process registry untouched)."""
+        budgets = dict(budgets or {})
+        budgets.pop(METRIC_SERIES_DROPPED_NAME, None)
+        with self._lock:
+            metrics = dict(self._metrics)
+            prev = {
+                "default": self._default_budget,
+                "pending": dict(self._pending_budgets),
+                "families": {
+                    name: metric._budget for name, metric in metrics.items()
+                },
+            }
+            self._default_budget = default
+            self._pending_budgets = dict(budgets)
+        for name, metric in metrics.items():
+            if name == METRIC_SERIES_DROPPED_NAME:
+                continue
+            metric._budget = budgets.get(name, default)
+        return prev
+
+    def restore_series_budgets(self, prev: dict) -> None:
+        with self._lock:
+            metrics = dict(self._metrics)
+            self._default_budget = prev["default"]
+            self._pending_budgets = dict(prev["pending"])
+        for name, budget in prev["families"].items():
+            metric = metrics.get(name)
+            if metric is not None:
+                metric._budget = budget
+
+    def series_report(self) -> Dict[str, dict]:
+        """Per-family series accounting — exact children, overflow
+        children, distinct refused label sets, and the budget in force.
+        The bench and /debug surfaces read this; only families with
+        children or a budget appear."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out: Dict[str, dict] = {}
+        for name in sorted(metrics):
+            metric = metrics[name]
+            with metric._lock:
+                total = len(metric._children)
+                overflow = metric._overflow_children
+                dropped = len(metric._dropped_hashes)
+                budget = metric._budget
+            if total or budget is not None:
+                out[name] = {
+                    "exact": total - overflow,
+                    "overflow": overflow,
+                    "dropped": dropped,
+                    "budget": budget,
+                }
+        return out
 
     def render(self) -> str:
         with self._lock:
@@ -634,7 +946,7 @@ CAPACITY_IDLE_PENDING_FRACTION = REGISTRY.gauge(
 CAPACITY_NODE_CHIPS = REGISTRY.gauge(
     "nos_tpu_capacity_node_chips",
     "Instantaneous per-node chip counts (by node, state=total|used|free); "
-    "zeroed when the node is deleted",
+    "series are removed when the node is deleted",
 )
 NODE_FRAGMENTATION = REGISTRY.gauge(
     "nos_tpu_node_fragmentation_index",
@@ -793,3 +1105,48 @@ TIMELINE_SAMPLE_DURATION = REGISTRY.histogram(
     buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
              0.05, 0.1),
 )
+METRIC_SERIES_DROPPED = REGISTRY.counter(
+    "nos_tpu_metric_series_dropped_total",
+    "Distinct label sets refused by a family's series budget and folded "
+    "into its _other child (by family)",
+)
+CAPACITY_POOL_CHIPS = REGISTRY.gauge(
+    "nos_tpu_capacity_pool_chips",
+    "Exact per-pool chip rollups (by pool, state=total|used|free) — the "
+    "tier the cardinality governor keeps full-fidelity when per-node "
+    "series are over budget; series are removed when the pool vanishes",
+)
+TRACE_RETAINED = REGISTRY.counter(
+    "nos_tpu_trace_retained_total",
+    "Traces pinned into the tail-kept reservoir "
+    "(by verdict=error|unschedulable|slow)",
+)
+
+# ---------------------------------------------------------------------------
+# Label-reset audit (enforced by tests/util/test_lint.py): every family
+# carrying a node=/pool=/model= label either registers the code path that
+# deletes its series when the labeled object goes away, or carries a
+# written justification for living without one. Stale entries (family no
+# longer labeled that way, or labeled families missing here) fail the lint.
+LABEL_RESET_PATHS: Dict[str, str] = {
+    "nos_tpu_capacity_node_chips": "CapacityLedger._drop_node_gauges on node delete",
+    "nos_tpu_node_fragmentation_index": "CapacityLedger._drop_node_gauges on node delete",
+    "nos_tpu_capacity_pool_chips": "CapacityLedger._export_gauges removes vanished pools",
+    "nos_tpu_autoscaler_replicas": "Autoscaler._collect_orphans removes series on ModelServing delete",
+}
+LABEL_RESET_EXEMPT: Dict[str, str] = {
+    "nos_tpu_plan_pool_duration_seconds": (
+        "histogram of completed plan durations keyed by the operator's "
+        "static pool set (bounded by config, not by cluster objects); "
+        "history must survive pool reconfiguration for trend comparison"
+    ),
+    "nos_tpu_serve_goodput_requests_total": (
+        "monotonic per-model counters; deleting on model teardown would "
+        "erase goodput history mid-scrape and break rate() — bounded by "
+        "the deployed-model set and governable via seriesBudget"
+    ),
+    "nos_tpu_serve_goodput_tokens_total": (
+        "same as nos_tpu_serve_goodput_requests_total — monotonic "
+        "goodput history outlives the model object by design"
+    ),
+}

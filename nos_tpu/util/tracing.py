@@ -214,42 +214,196 @@ class Trace:
         }
 
 
-class TraceStore:
-    """Bounded ring of completed traces, newest kept, with id lookup."""
+@dataclass
+class RetentionPolicy:
+    """Tail-kept trace retention: what counts as interesting, how many
+    interesting traces are pinned, and how boring traffic is sampled.
+    The defaults reproduce the pre-policy store exactly (every trace
+    kept in one newest-wins ring) except that interesting traces move
+    to the pinned reservoir — where boring bursts cannot evict them."""
 
-    def __init__(self, capacity: int = 256) -> None:
+    # Pinned reservoir capacity for error/unschedulable/slow traces;
+    # 0 disables pinning (every trace competes in the main ring).
+    tail_capacity: int = 64
+    # Keep 1 of every N boring traces (deterministic head sampling by
+    # arrival count); 1 keeps all. Sampled-out traces still count in
+    # ``retention_stats`` so kept traces carry weight N, keeping
+    # rate/latency estimates over the ring unbiased.
+    boring_sample_n: int = 1
+    # Root-span name -> seconds; a trace whose root ran longer is
+    # classified "slow" and pinned. Unlisted kinds are never slow.
+    slow_thresholds: Dict[str, float] = field(default_factory=dict)
+
+
+def classify_trace(trace: Trace, policy: RetentionPolicy) -> str:
+    """'error' | 'unschedulable' | 'slow' | 'boring' — first match wins.
+
+    Unschedulable detection keys off the ``diagnosis`` attribute the
+    scheduler's ``_fail_cycle`` stamps on the journey root; error beats
+    it so a failed cycle that also raised classifies by the raise.
+    """
+    for span in trace.spans:
+        if span.status == "error":
+            return "error"
+    root = trace.root
+    if root is not None:
+        if "diagnosis" in root.attributes:
+            return "unschedulable"
+        threshold = policy.slow_thresholds.get(root.name)
+        if threshold is not None and (root.duration_s or 0.0) > threshold:
+            return "slow"
+    return "boring"
+
+
+class TraceStore:
+    """Bounded ring of completed traces, newest kept, with id lookup —
+    plus a pinned tail reservoir interesting traces retire to, which a
+    burst of boring journeys cannot evict (the 100k-node failure mode:
+    one failed gang trace vs. thousands of healthy binds per window)."""
+
+    def __init__(
+        self, capacity: int = 256, retention: Optional[RetentionPolicy] = None
+    ) -> None:
         from collections import OrderedDict
 
         self.capacity = max(1, capacity)
         self._traces: "OrderedDict[str, Trace]" = OrderedDict()
+        self._interesting: "OrderedDict[str, Trace]" = OrderedDict()
         self._lock = threading.Lock()
+        self._retention = retention or RetentionPolicy()
+        # trace_id -> (arrival seq, verdict): seq orders the merged
+        # listing newest-first across both rings and feeds the paging
+        # cursor; verdict rides into summaries.
+        self._meta: Dict[str, Tuple[int, str]] = {}
+        self._seq = 0
+        self._seen: Dict[str, int] = {}
+        self._kept: Dict[str, int] = {}
+        self._sampled_out = 0
+
+    def set_retention(self, policy: Optional[RetentionPolicy]) -> RetentionPolicy:
+        """Swap the retention policy; returns the previous one (callers
+        applying non-default policy revert it, the registry is shared)."""
+        policy = policy or RetentionPolicy()
+        with self._lock:
+            prev, self._retention = self._retention, policy
+            while len(self._interesting) > max(0, policy.tail_capacity):
+                evicted, _ = self._interesting.popitem(last=False)
+                self._meta.pop(evicted, None)
+        return prev
 
     def add(self, trace: Trace) -> None:
+        verdict = classify_trace(trace, self._retention)
+        pinned = False
         with self._lock:
-            self._traces[trace.trace_id] = trace
-            self._traces.move_to_end(trace.trace_id)
-            while len(self._traces) > self.capacity:
-                self._traces.popitem(last=False)
+            policy = self._retention
+            self._seen[verdict] = self._seen.get(verdict, 0) + 1
+            if verdict != "boring" and policy.tail_capacity > 0:
+                pinned = True
+                self._interesting[trace.trace_id] = trace
+                self._interesting.move_to_end(trace.trace_id)
+                while len(self._interesting) > policy.tail_capacity:
+                    evicted, _ = self._interesting.popitem(last=False)
+                    self._meta.pop(evicted, None)
+            else:
+                if verdict == "boring" and policy.boring_sample_n > 1:
+                    # Deterministic head sampling by arrival index: the
+                    # 1st, N+1th, ... boring traces are kept, the rest
+                    # only weigh the counters.
+                    if (self._seen[verdict] - 1) % policy.boring_sample_n:
+                        self._sampled_out += 1
+                        return
+                self._traces[trace.trace_id] = trace
+                self._traces.move_to_end(trace.trace_id)
+                while len(self._traces) > self.capacity:
+                    evicted, _ = self._traces.popitem(last=False)
+                    self._meta.pop(evicted, None)
+            self._seq += 1
+            self._meta[trace.trace_id] = (self._seq, verdict)
+            self._kept[verdict] = self._kept.get(verdict, 0) + 1
+        if pinned:
+            from nos_tpu.util import metrics as _metrics
+
+            _metrics.TRACE_RETAINED.labels(verdict=verdict).inc()
 
     def get(self, trace_id: str) -> Optional[Trace]:
         with self._lock:
-            return self._traces.get(trace_id)
+            return self._traces.get(trace_id) or self._interesting.get(trace_id)
 
     def list(self) -> List[Trace]:
-        """Newest first."""
+        """Newest first across both rings (merged by arrival order)."""
         with self._lock:
-            return list(reversed(self._traces.values()))
+            traces = list(self._traces.values()) + list(self._interesting.values())
+            return sorted(
+                traces,
+                key=lambda t: self._meta.get(t.trace_id, (0, ""))[0],
+                reverse=True,
+            )
 
     def summaries(self) -> List[Dict[str, Any]]:
-        return [t.summary() for t in self.list()]
+        return [self._summarize(t) for t in self.list()]
+
+    def _summarize(self, trace: Trace) -> Dict[str, Any]:
+        seq, verdict = self._meta.get(trace.trace_id, (0, ""))
+        out = trace.summary()
+        out["seq"] = seq
+        out["verdict"] = verdict
+        return out
+
+    def summaries_page(
+        self, limit: int = 0, cursor: str = ""
+    ) -> Tuple[List[Dict[str, Any]], str]:
+        """Newest-first page of summaries. The cursor is the ``seq`` of
+        the last summary on the previous page (as a string); a page holds
+        summaries strictly older than it. Empty next_cursor = exhausted."""
+        traces = self.list()
+        if cursor:
+            after = int(cursor)
+            traces = [
+                t for t in traces if self._meta.get(t.trace_id, (0, ""))[0] < after
+            ]
+        if limit and limit > 0:
+            page, more = traces[:limit], len(traces) > limit
+        else:
+            page, more = traces, False
+        summaries = [self._summarize(t) for t in page]
+        next_cursor = str(summaries[-1]["seq"]) if summaries and more else ""
+        return summaries, next_cursor
+
+    def retention_stats(self) -> Dict[str, Any]:
+        """Seen/kept counts by verdict plus the sampling weight — the
+        'how biased is the ring' answer. ``hit_rate`` is the fraction of
+        interesting traces still retrievable (the bench's headline)."""
+        with self._lock:
+            seen = dict(sorted(self._seen.items()))
+            kept = dict(sorted(self._kept.items()))
+            interesting_seen = sum(
+                n for v, n in seen.items() if v != "boring"
+            )
+            pinned = len(self._interesting)
+            return {
+                "seen": seen,
+                "kept": kept,
+                "sampled_out": self._sampled_out,
+                "boring_weight": self._retention.boring_sample_n,
+                "pinned": pinned,
+                "hit_rate": round(pinned / interesting_seen, 4)
+                if interesting_seen
+                else 1.0,
+            }
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._traces)
+            return len(self._traces) + len(self._interesting)
 
     def clear(self) -> None:
         with self._lock:
             self._traces.clear()
+            self._interesting.clear()
+            self._meta.clear()
+            self._seq = 0
+            self._seen.clear()
+            self._kept.clear()
+            self._sampled_out = 0
 
 
 class _ActiveTrace:

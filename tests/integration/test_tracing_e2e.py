@@ -171,9 +171,11 @@ class TestObservabilityOverHttp:
             assert self._get(port, "/debug/traces")[0] == 401
             status, body = self._get(port, "/debug/traces", "tok")
             assert status == 200
+            doc = json.loads(body)
             assert any(
-                s["trace_id"] == trace.trace_id for s in json.loads(body)
+                s["trace_id"] == trace.trace_id for s in doc["traces"]
             )
+            assert sum(doc["retention"]["seen"].values()) >= 1
 
             status, body = self._get(
                 port, f"/debug/traces?id={trace.trace_id}", "tok"

@@ -1,0 +1,173 @@
+"""Incremental registry snapshots: the first collect() primes with the
+full snapshot, later collects return only touched series (O(changed),
+not O(total)), removals win over concurrent changes, multiple cursors
+are independent, and the timeline's cursor mode folds deltas into the
+same frames a full-snapshot diff would produce."""
+from nos_tpu.util.metrics import MetricsRegistry
+
+
+class TestSnapshotCursor:
+    def test_first_collect_is_the_full_snapshot(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc()
+        reg.gauge("b").set(2.0)
+        changed, removed = reg.cursor().collect()
+        assert changed == reg.snapshot()
+        assert removed == []
+
+    def test_second_collect_holds_only_the_touched_series(self):
+        reg = MetricsRegistry()
+        a = reg.counter("a")
+        b = reg.gauge("b")
+        a.inc()
+        b.set(1.0)
+        cur = reg.cursor()
+        cur.collect()
+        a.inc()
+        changed, removed = cur.collect()
+        assert changed == {"a": 2.0}
+        assert removed == []
+
+    def test_untouched_window_collects_nothing(self):
+        reg = MetricsRegistry()
+        reg.counter("a").inc()
+        cur = reg.cursor()
+        cur.collect()
+        assert cur.collect() == ({}, [])
+
+    def test_labeled_children_report_their_own_keys(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("fam")
+        fam.labels(who="a").inc()
+        cur = reg.cursor()
+        cur.collect()
+        fam.labels(who="b").inc(3.0)
+        changed, _ = cur.collect()
+        assert changed == {'fam{who="b"}': 3.0}
+
+    def test_histogram_reports_its_snapshot_keys(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        cur = reg.cursor()
+        cur.collect()
+        h.observe(0.5)
+        changed, _ = cur.collect()
+        assert changed["lat_count"] == 1
+        assert changed["lat_sum"] == 0.5
+        assert changed["lat_p50"] == 0.5
+
+    def test_removed_series_wins_over_its_own_change(self):
+        reg = MetricsRegistry()
+        fam = reg.gauge("fam")
+        fam.labels(who="a").set(1.0)
+        cur = reg.cursor()
+        cur.collect()
+        fam.labels(who="a").set(9.0)
+        assert fam.remove(who="a")
+        changed, removed = cur.collect()
+        assert 'fam{who="a"}' not in changed
+        assert removed == ['fam{who="a"}']
+
+    def test_families_created_after_the_cursor_are_tracked(self):
+        reg = MetricsRegistry()
+        cur = reg.cursor()
+        cur.collect()
+        late = reg.counter("late")
+        late.inc()
+        changed, _ = cur.collect()
+        assert changed == {"late": 1.0}
+
+    def test_two_cursors_drain_independently(self):
+        reg = MetricsRegistry()
+        a = reg.counter("a")
+        c1 = reg.cursor()
+        c2 = reg.cursor()
+        c1.collect()
+        c2.collect()
+        a.inc()
+        assert c1.collect() == ({"a": 1.0}, [])
+        # c2 still sees the same change in its own window
+        assert c2.collect() == ({"a": 1.0}, [])
+        # both drained: nothing left
+        assert c1.collect() == ({}, [])
+        assert c2.collect() == ({}, [])
+
+    def test_closed_cursor_stops_accumulating(self):
+        reg = MetricsRegistry()
+        a = reg.counter("a")
+        cur = reg.cursor()
+        cur.collect()
+        cur.close()
+        a.inc()
+        # collect after close: nothing was routed to this cursor
+        assert cur.collect() == ({}, [])
+
+
+class TestTimelineCursorMode:
+    def make_cursor_store(self, registry):
+        from nos_tpu.timeline.sizes import SizeRegistry
+        from nos_tpu.timeline.store import TimelineStore
+        from nos_tpu.timeline.watchdog import WedgeWatchdog
+
+        clock = Clock()
+        store = TimelineStore(
+            clock=clock,
+            vitals=False,
+            registry=registry,
+            sizes=SizeRegistry(),
+            watchdog=WedgeWatchdog(),
+        )
+        return store, clock
+
+    def test_cursor_mode_matches_full_snapshot_series(self):
+        reg = MetricsRegistry()
+        ctr = reg.counter("nos_test_ctr")
+        ctr.inc()
+        store, clock = self.make_cursor_store(reg)
+        try:
+            store.sample_once()
+            ctr.inc(2.0)
+            clock.advance()
+            store.sample_once()
+            assert store.series("nos_test_ctr") == [
+                (1000.0, 1.0),
+                (1001.0, 3.0),
+            ]
+        finally:
+            store.close()
+
+    def test_removed_series_writes_the_sentinel_in_cursor_mode(self):
+        reg = MetricsRegistry()
+        fam = reg.gauge("nos_test_fam")
+        fam.labels(who="a").set(1.0)
+        store, clock = self.make_cursor_store(reg)
+        try:
+            store.sample_once()
+            assert fam.remove(who="a")
+            clock.advance()
+            store.sample_once()
+            assert store.series('nos_test_fam{who="a"}') == [(1000.0, 1.0)]
+            assert 'nos_test_fam{who="a"}' not in store.names()
+        finally:
+            store.close()
+
+    def test_close_is_idempotent_and_sampling_survives_it(self):
+        reg = MetricsRegistry()
+        reg.counter("nos_test_ctr").inc()
+        store, clock = self.make_cursor_store(reg)
+        store.close()
+        store.close()
+        clock.advance()
+        store.sample_once()  # falls back to full-snapshot diffing
+        assert "nos_test_ctr" in store.names()
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds=1.0):
+        self.now += seconds

@@ -1,0 +1,176 @@
+"""Cardinality governor: deterministic admission up to a family's series
+budget, exact-sum-preserving overflow into the `_other` child, drop
+accounting, budget slots freed on remove(), and the policy-pin property
+— same event stream + same budget = byte-identical exposition, and a
+tampered budget provably changes the bytes."""
+from nos_tpu.api.config import ObservabilityConfig
+from nos_tpu.obsplane.apply import apply_observability
+from nos_tpu.obsplane.governor import budgets_from, governor_report
+from nos_tpu.util.metrics import (
+    METRIC_SERIES_DROPPED_NAME,
+    MetricsRegistry,
+    OTHER_LABEL,
+)
+
+
+def budgeted_registry(budgets, default=None):
+    reg = MetricsRegistry()
+    reg.apply_series_budgets(budgets, default=default)
+    return reg
+
+
+class TestAdmission:
+    def test_under_budget_all_exact(self):
+        reg = budgeted_registry({"fam": 3})
+        fam = reg.counter("fam")
+        for who in ("a", "b", "c"):
+            fam.labels(who=who).inc()
+        assert 'who="a"' in reg.render()
+        assert OTHER_LABEL not in reg.render()
+        assert reg.series_report()["fam"]["dropped"] == 0
+
+    def test_over_budget_folds_into_other(self):
+        reg = budgeted_registry({"fam": 2})
+        fam = reg.counter("fam")
+        for i in range(5):
+            fam.labels(who=f"w{i}").inc(2.0)
+        rendered = reg.render()
+        assert 'who="w0"' in rendered and 'who="w1"' in rendered
+        assert 'who="w2"' not in rendered
+        assert f'who="{OTHER_LABEL}"' in rendered
+
+    def test_overflow_preserves_counter_sums_exactly(self):
+        reg = budgeted_registry({"fam": 2})
+        fam = reg.counter("fam")
+        for i in range(10):
+            fam.labels(who=f"w{i}").inc(1.5)
+        assert fam.total == 10 * 1.5
+
+    def test_dropped_counter_counts_distinct_refused_label_sets(self):
+        reg = budgeted_registry({"fam": 2})
+        fam = reg.counter("fam")
+        for _ in range(3):  # repeats of one refused set count once
+            fam.labels(who="w9").inc()
+        fam.labels(who="a").inc()
+        fam.labels(who="b").inc()
+        fam.labels(who="c").inc()
+        # w9 + c refused (a, b took the two slots... w9 was first, so
+        # w9 + a admitted; b, c refused)
+        report = reg.series_report()["fam"]
+        assert report["exact"] == 2
+        assert report["overflow"] == 1
+        assert report["dropped"] == 2
+        snap = reg.snapshot()
+        assert snap[f'{METRIC_SERIES_DROPPED_NAME}{{family="fam"}}'] == 2.0
+
+    def test_remove_frees_a_budget_slot(self):
+        reg = budgeted_registry({"fam": 1})
+        fam = reg.gauge("fam")
+        fam.labels(who="a").set(1.0)
+        fam.labels(who="b").set(9.0)  # refused -> _other
+        assert reg.series_report()["fam"]["dropped"] == 1
+        assert fam.remove(who="a")
+        fam.labels(who="c").set(3.0)  # takes the freed slot
+        assert 'who="c"' in reg.render()
+        assert reg.series_report()["fam"]["exact"] == 1
+
+    def test_drop_counter_family_is_never_budgeted(self):
+        reg = budgeted_registry({METRIC_SERIES_DROPPED_NAME: 1}, default=1)
+        fam = reg.counter("fam")
+        for i in range(4):
+            fam.labels(who=f"w{i}").inc()
+        dropped = reg.series_report()[METRIC_SERIES_DROPPED_NAME]
+        assert dropped["budget"] is None
+        assert dropped["dropped"] == 0
+
+    def test_histogram_overflow_preserves_count_and_sum(self):
+        reg = budgeted_registry({"lat": 1})
+        lat = reg.histogram("lat")
+        for i in range(6):
+            lat.labels(who=f"w{i}").observe(0.5)
+        exact = lat.labels(who="w0")
+        other = lat.labels(who=OTHER_LABEL)
+        assert exact.count + other.count == 6
+        assert exact.sum + other.sum == 3.0
+
+
+class TestDeterminismPin:
+    EVENTS = [(f"w{i % 7}", 1.0 + (i % 3)) for i in range(50)]
+
+    @classmethod
+    def run_stream(cls, budget):
+        reg = budgeted_registry({"fam": budget})
+        fam = reg.counter("fam")
+        for who, amount in cls.EVENTS:
+            fam.labels(who=who).inc(amount)
+        return reg.render()
+
+    def test_same_budget_same_bytes(self):
+        assert self.run_stream(3) == self.run_stream(3)
+
+    def test_tampered_budget_changes_the_bytes(self):
+        """The determinism pin has teeth: a different policy cannot
+        reproduce the committed exposition."""
+        honest = self.run_stream(3)
+        tampered = self.run_stream(4)
+        assert honest != tampered
+        # both still fold (7 distinct sets > either budget): the bytes
+        # differ in which sets stayed exact, not in whether folding ran
+        assert f'who="{OTHER_LABEL}"' in honest
+        assert f'who="{OTHER_LABEL}"' in tampered
+
+    def test_totals_identical_across_budgets(self):
+        total = sum(amount for _, amount in self.EVENTS)
+        for budget in (1, 3, 7):
+            reg = budgeted_registry({"fam": budget})
+            fam = reg.counter("fam")
+            for who, amount in self.EVENTS:
+                fam.labels(who=who).inc(amount)
+            assert fam.total == total
+
+
+class TestConfigPlumbing:
+    def test_budgets_from_pulls_map_and_default(self):
+        obs = ObservabilityConfig(
+            series_budget={"fam": 10}, series_budget_default=512
+        )
+        budgets, default = budgets_from(obs)
+        assert budgets == {"fam": 10}
+        assert default == 512
+
+    def test_zero_default_means_unbudgeted(self):
+        obs = ObservabilityConfig(series_budget_default=0)
+        assert budgets_from(obs) == ({}, None)
+
+    def test_apply_observability_is_revertible(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("fam")
+        fam.labels(who="a").inc()
+
+        class FakeTracer:
+            class store:
+                @staticmethod
+                def set_retention(policy):
+                    return policy
+
+        revert = apply_observability(
+            ObservabilityConfig(series_budget={"fam": 1}),
+            registry=reg,
+            tracer=FakeTracer(),
+        )
+        fam.labels(who="b").inc()  # refused under budget 1
+        assert reg.series_report()["fam"]["dropped"] == 1
+        revert()
+        fam.labels(who="c").inc()  # admitted again, budget lifted
+        assert 'who="c"' in reg.render()
+
+    def test_governor_report_totals(self):
+        reg = budgeted_registry({"fam": 1})
+        fam = reg.counter("fam")
+        fam.labels(who="a").inc()
+        fam.labels(who="b").inc()
+        report = governor_report(reg)
+        assert report["over_budget"] == ["fam"]
+        assert report["dropped_series"] == 1
+        # a (exact) + _other + the drop counter's own child
+        assert report["active_series"] == 3

@@ -1,0 +1,77 @@
+"""obs-smoke gate (ISSUE satellite S5): the small-world observability
+plane end to end — governor + tail retention + pagination driven through
+the bench's own emission path — must be byte-identical across two
+in-process runs. This is the determinism pin the chaos replay and the
+committed BENCH_observability.json lean on."""
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_observability",
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "bench_observability.py"
+    ),
+)
+bench_obs = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_obs)
+
+N_NODES, N_PODS = 100, 1000
+
+
+def small_world():
+    store = bench_obs.seed_store(N_NODES, N_PODS)
+    return bench_obs.fleet_from_store(store)
+
+
+class TestObsSmoke:
+    def test_two_in_process_runs_render_byte_identical(self):
+        fleet, pending = small_world()
+        renders = [
+            bench_obs.governed_registry(fleet, pending).render()
+            for _ in range(2)
+        ]
+        assert renders[0] == renders[1]
+
+    def test_small_world_stays_under_budget_with_zero_drops(self):
+        fleet, pending = small_world()
+        registry = bench_obs.governed_registry(fleet, pending)
+        fam = registry.series_report()[bench_obs.NODE_FAMILY]
+        assert fam["exact"] == 3 * N_NODES
+        assert fam["overflow"] == 0 and fam["dropped"] == 0
+
+    def test_pool_rollups_conserve_fleet_chips(self):
+        fleet, pending = small_world()
+        registry = bench_obs.governed_registry(fleet, pending)
+        pool_g = registry.gauge(bench_obs.POOL_FAMILY)
+        snapshot = registry.snapshot()
+        total_cap = sum(cap for _, cap, _ in fleet)
+        rolled = sum(
+            v
+            for k, v in snapshot.items()
+            if k.startswith(bench_obs.POOL_FAMILY)
+            and ('state="used"' in k or 'state="free"' in k)
+        )
+        assert pool_g is not None
+        assert rolled == float(total_cap)
+
+    def test_retention_mixture_is_deterministic_and_tail_kept(self):
+        stats = [bench_obs.drive_retention(500) for _ in range(2)]
+        assert stats[0] == stats[1]
+        # every interesting trace in the mixture stays retrievable
+        assert stats[0]["hit_rate"] == 1.0
+        assert stats[0]["seen"]["error"] == 5
+        assert stats[0]["sampled_out"] > 0
+
+    def test_governed_snapshot_pages_deterministically(self):
+        from nos_tpu.obsplane.streaming import paginate
+
+        fleet, pending = small_world()
+        registry = bench_obs.governed_registry(fleet, pending)
+        keys = sorted(registry.snapshot())
+        seen, cursor = [], ""
+        while True:
+            page, cursor = paginate(keys, limit=100, cursor=cursor)
+            seen.extend(page)
+            if not cursor:
+                break
+        assert seen == keys

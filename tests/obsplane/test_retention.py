@@ -1,0 +1,180 @@
+"""Tail-kept trace retention: classification (error > unschedulable >
+slow > boring), the pinned reservoir boring bursts cannot evict,
+deterministic boring head-sampling with weighted counters, paged
+summaries, and policy swap semantics."""
+from nos_tpu.util.tracing import (
+    RetentionPolicy,
+    Span,
+    Trace,
+    TraceStore,
+    classify_trace,
+)
+
+
+def make_trace(trace_id, root_name="pod.journey", status="ok",
+               duration=0.1, attributes=None):
+    root = Span(
+        name=root_name,
+        trace_id=trace_id,
+        span_id=f"{trace_id}-root",
+        parent_id=None,
+        duration_s=duration,
+        status=status,
+        attributes=dict(attributes or {}),
+    )
+    return Trace(trace_id=trace_id, spans=[root])
+
+
+def error_trace(trace_id):
+    t = make_trace(trace_id)
+    t.spans.append(
+        Span(
+            name="actuator.apply_node",
+            trace_id=trace_id,
+            span_id=f"{trace_id}-err",
+            parent_id=f"{trace_id}-root",
+            duration_s=0.01,
+            status="error",
+        )
+    )
+    return t
+
+
+class TestClassification:
+    POLICY = RetentionPolicy(slow_thresholds={"pod.journey": 1.0})
+
+    def test_error_span_anywhere_wins(self):
+        trace = error_trace("t1")
+        trace.spans[0].attributes["diagnosis"] = "also unschedulable"
+        assert classify_trace(trace, self.POLICY) == "error"
+
+    def test_diagnosis_on_root_is_unschedulable(self):
+        trace = make_trace("t2", attributes={"diagnosis": "0/3 nodes"})
+        assert classify_trace(trace, self.POLICY) == "unschedulable"
+
+    def test_slow_by_per_journey_kind_threshold(self):
+        assert classify_trace(
+            make_trace("t3", duration=1.5), self.POLICY
+        ) == "slow"
+        # same duration, a journey kind with no threshold: boring
+        assert classify_trace(
+            make_trace("t4", root_name="scheduler.cycle", duration=1.5),
+            self.POLICY,
+        ) == "boring"
+
+    def test_fast_clean_trace_is_boring(self):
+        assert classify_trace(make_trace("t5"), self.POLICY) == "boring"
+
+
+class TestTailKeptReservoir:
+    def test_boring_burst_cannot_evict_an_interesting_trace(self):
+        store = TraceStore(capacity=4, retention=RetentionPolicy(tail_capacity=2))
+        store.add(error_trace("bad"))
+        for i in range(50):
+            store.add(make_trace(f"boring-{i}"))
+        assert store.get("bad") is not None
+        # the main ring stayed bounded
+        assert len(store) <= 4 + 2
+
+    def test_reservoir_is_bounded_oldest_interesting_evicted(self):
+        store = TraceStore(capacity=4, retention=RetentionPolicy(tail_capacity=2))
+        for i in range(3):
+            store.add(error_trace(f"bad-{i}"))
+        assert store.get("bad-0") is None
+        assert store.get("bad-1") is not None
+        assert store.get("bad-2") is not None
+
+    def test_zero_tail_capacity_disables_pinning(self):
+        store = TraceStore(capacity=2, retention=RetentionPolicy(tail_capacity=0))
+        store.add(error_trace("bad"))
+        store.add(make_trace("b1"))
+        store.add(make_trace("b2"))
+        assert store.get("bad") is None  # competed in the main ring, lost
+
+    def test_list_merges_newest_first_across_rings(self):
+        store = TraceStore(capacity=8, retention=RetentionPolicy(tail_capacity=2))
+        store.add(make_trace("b1"))
+        store.add(error_trace("bad"))
+        store.add(make_trace("b2"))
+        assert [t.trace_id for t in store.list()] == ["b2", "bad", "b1"]
+
+    def test_pinning_increments_the_retained_counter(self):
+        from nos_tpu.util import metrics
+
+        store = TraceStore(capacity=4, retention=RetentionPolicy(tail_capacity=2))
+        before = metrics.TRACE_RETAINED.labels(verdict="error").value
+        store.add(error_trace("bad"))
+        after = metrics.TRACE_RETAINED.labels(verdict="error").value
+        assert after == before + 1
+
+
+class TestBoringSampling:
+    def test_head_sampling_keeps_every_nth_arrival(self):
+        store = TraceStore(
+            capacity=64, retention=RetentionPolicy(boring_sample_n=3)
+        )
+        for i in range(9):
+            store.add(make_trace(f"b{i}"))
+        kept = {t.trace_id for t in store.list()}
+        assert kept == {"b0", "b3", "b6"}
+
+    def test_weighted_counters_keep_totals_honest(self):
+        store = TraceStore(
+            capacity=64, retention=RetentionPolicy(boring_sample_n=3)
+        )
+        for i in range(9):
+            store.add(make_trace(f"b{i}"))
+        stats = store.retention_stats()
+        assert stats["seen"] == {"boring": 9}
+        assert stats["kept"] == {"boring": 3}
+        assert stats["sampled_out"] == 6
+        assert stats["boring_weight"] == 3
+
+    def test_interesting_traces_are_never_sampled_out(self):
+        store = TraceStore(
+            capacity=64,
+            retention=RetentionPolicy(tail_capacity=8, boring_sample_n=100),
+        )
+        for i in range(5):
+            store.add(error_trace(f"bad-{i}"))
+        assert len(store.list()) == 5
+
+    def test_hit_rate_counts_retrievable_interesting_traces(self):
+        store = TraceStore(capacity=8, retention=RetentionPolicy(tail_capacity=2))
+        for i in range(4):
+            store.add(error_trace(f"bad-{i}"))
+        stats = store.retention_stats()
+        assert stats["pinned"] == 2
+        assert stats["hit_rate"] == 0.5
+
+
+class TestPagingAndPolicySwap:
+    def test_summaries_page_walks_newest_to_oldest(self):
+        store = TraceStore(capacity=16)
+        for i in range(5):
+            store.add(make_trace(f"t{i}"))
+        page1, cursor = store.summaries_page(limit=2)
+        assert [s["trace_id"] for s in page1] == ["t4", "t3"]
+        assert cursor
+        page2, cursor = store.summaries_page(limit=2, cursor=cursor)
+        assert [s["trace_id"] for s in page2] == ["t2", "t1"]
+        page3, cursor = store.summaries_page(limit=2, cursor=cursor)
+        assert [s["trace_id"] for s in page3] == ["t0"]
+        assert cursor == ""
+
+    def test_summaries_carry_seq_and_verdict(self):
+        store = TraceStore(capacity=4)
+        store.add(error_trace("bad"))
+        (summary,), _ = store.summaries_page(limit=1)
+        assert summary["verdict"] == "error"
+        assert summary["seq"] == 1
+
+    def test_set_retention_shrinks_an_over_capacity_reservoir(self):
+        store = TraceStore(capacity=8, retention=RetentionPolicy(tail_capacity=4))
+        for i in range(4):
+            store.add(error_trace(f"bad-{i}"))
+        prev = store.set_retention(RetentionPolicy(tail_capacity=1))
+        assert prev.tail_capacity == 4
+        assert store.get("bad-3") is not None
+        assert store.get("bad-0") is None
+        assert store.retention_stats()["pinned"] == 1

@@ -1,0 +1,224 @@
+"""Streaming debug plane: cursor pagination primitives, the ledger's
+paged document + JSONL generator, the timeline's paged rollups, and the
+HTTP layer end to end — ?limit=/?cursor= paging, ?format=jsonl chunked
+streaming, and the 400 on a malformed limit."""
+import http.client
+import json
+
+import pytest
+
+from nos_tpu.api.v1alpha1 import constants
+from nos_tpu.capacity import CapacityLedger
+from nos_tpu.kube.store import KubeStore
+from nos_tpu.obsplane.streaming import (
+    jsonl_lines,
+    page_envelope,
+    page_params,
+    paginate,
+)
+from nos_tpu.util.health import HealthServer
+
+from tests.factory import build_pod, build_tpu_node
+
+
+class TestPaginate:
+    KEYS = ["a", "b", "c", "d", "e"]
+
+    def test_no_limit_returns_everything(self):
+        assert paginate(self.KEYS) == (self.KEYS, "")
+
+    def test_limit_pages_with_cursor(self):
+        page, cursor = paginate(self.KEYS, limit=2)
+        assert page == ["a", "b"] and cursor == "b"
+        page, cursor = paginate(self.KEYS, limit=2, cursor="b")
+        assert page == ["c", "d"] and cursor == "d"
+        page, cursor = paginate(self.KEYS, limit=2, cursor="d")
+        assert page == ["e"] and cursor == ""
+
+    def test_cursor_past_the_end_is_empty(self):
+        assert paginate(self.KEYS, limit=2, cursor="z") == ([], "")
+
+    def test_exact_final_page_has_no_cursor(self):
+        page, cursor = paginate(["a", "b"], limit=2)
+        assert page == ["a", "b"] and cursor == ""
+
+    def test_vanished_cursor_key_resumes_after_its_sort_position(self):
+        # "bb" was deleted between pages: paging resumes at "c", no skip
+        page, _ = paginate(self.KEYS, limit=2, cursor="bb")
+        assert page == ["c", "d"]
+
+
+class TestPageParams:
+    def test_defaults(self):
+        assert page_params({}) == {
+            "pool": "",
+            "limit": 0,
+            "cursor": "",
+            "jsonl": False,
+        }
+
+    def test_explicit_values(self):
+        out = page_params(
+            {"pool": "p1", "limit": "5", "cursor": "n3", "format": "jsonl"},
+            default_limit=100,
+        )
+        assert out == {"pool": "p1", "limit": 5, "cursor": "n3", "jsonl": True}
+
+    def test_default_limit_applies_without_explicit_limit(self):
+        assert page_params({}, default_limit=100)["limit"] == 100
+
+    def test_malformed_limit_raises(self):
+        with pytest.raises(ValueError):
+            page_params({"limit": "abc"})
+        with pytest.raises(ValueError):
+            page_params({"limit": "-1"})
+
+    def test_jsonl_lines_are_sorted_and_newline_terminated(self):
+        lines = list(jsonl_lines([{"b": 1, "a": 2}]))
+        assert lines == [b'{"a": 2, "b": 1}\n']
+
+    def test_page_envelope(self):
+        out = page_envelope({"x": 1}, "n5", 10, total=42)
+        assert out["page"] == {"limit": 10, "next_cursor": "n5", "total": 42}
+
+
+def make_ledger(n_nodes=6):
+    store = KubeStore()
+    ledger = CapacityLedger(store, metrics=False)
+    for i in range(n_nodes):
+        store.create(build_tpu_node(name=f"n{i}", chips=8))
+    store.create(build_pod("w", {constants.RESOURCE_TPU: 4}, node="n0"))
+    ledger.observe(1000.0)
+    return ledger
+
+
+class TestLedgerPaging:
+    def test_paged_nodes_cover_everything_exactly_once(self):
+        ledger = make_ledger()
+        seen, cursor = [], ""
+        while True:
+            doc = ledger.debug_payload(limit=2, cursor=cursor)
+            seen.extend(doc["nodes"])
+            cursor = doc["page"]["next_cursor"]
+            if not cursor:
+                break
+        assert seen == [f"n{i}" for i in range(6)]
+
+    def test_cluster_rollup_ignores_paging(self):
+        ledger = make_ledger()
+        doc = ledger.debug_payload(limit=1)
+        assert doc["cluster"]["total_chips"] == 48
+        assert doc["page"]["total_nodes"] == 6
+
+    def test_stream_yields_header_then_nodes_then_quotas(self):
+        ledger = make_ledger(3)
+        records = list(ledger.debug_stream())
+        assert records[0]["record"] == "cluster"
+        node_records = [r for r in records if r["record"] == "node"]
+        assert [r["name"] for r in node_records] == ["n0", "n1", "n2"]
+        assert node_records[0]["used_chips"] == 4
+
+    def test_stream_pool_filter(self):
+        ledger = make_ledger(3)
+        records = list(ledger.debug_stream(pool="no-such-pool"))
+        assert [r for r in records if r["record"] == "node"] == []
+
+
+class TestTimelinePaging:
+    def make_store(self, n_series=10):
+        from nos_tpu.timeline.sizes import SizeRegistry
+        from nos_tpu.timeline.store import TimelineStore
+        from nos_tpu.timeline.watchdog import WedgeWatchdog
+
+        values = {f"s{i:02d}": float(i) for i in range(n_series)}
+        store = TimelineStore(
+            clock=lambda: 1000.0,
+            vitals=False,
+            metrics_fn=lambda: dict(values),
+            sizes=SizeRegistry(),
+            watchdog=WedgeWatchdog(),
+        )
+        store.sample_once()
+        return store
+
+    def test_rollups_page_by_series_name(self):
+        store = self.make_store()
+        doc = store.debug_payload(limit=4)
+        assert list(doc["rollups"]) == ["s00", "s01", "s02", "s03"]
+        assert set(doc["sparklines"]) == set(doc["rollups"])
+        next_doc = store.debug_payload(
+            limit=4, cursor=doc["page"]["next_cursor"]
+        )
+        assert list(next_doc["rollups"]) == ["s04", "s05", "s06", "s07"]
+
+    def test_unpaged_document_is_complete(self):
+        store = self.make_store()
+        doc = store.debug_payload()
+        assert doc["page"]["next_cursor"] == ""
+        assert len(doc["rollups"]) == doc["series_count"]
+
+
+def _get(port, path, token="tok"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    return resp.status, resp.read().decode(), dict(resp.getheaders())
+
+
+class TestHttpStreaming:
+    @pytest.fixture
+    def server(self):
+        ledger = make_ledger()
+        server = HealthServer(
+            port=0,
+            metrics_token="tok",
+            capacity_fn=ledger.debug_payload,
+            capacity_stream_fn=ledger.debug_stream,
+            debug_page_limit=2,
+        )
+        port = server.start()
+        yield port
+        server.stop()
+
+    def test_default_page_limit_applies(self, server):
+        status, body, _ = _get(server, "/debug/capacity")
+        assert status == 200
+        doc = json.loads(body)
+        assert len(doc["nodes"]) == 2
+        assert doc["page"]["next_cursor"] == "n1"
+
+    def test_cursor_walks_the_node_table(self, server):
+        _, body, _ = _get(server, "/debug/capacity?limit=4&cursor=n1")
+        doc = json.loads(body)
+        assert list(doc["nodes"]) == ["n2", "n3", "n4", "n5"]
+
+    def test_limit_zero_is_unpaginated(self, server):
+        _, body, _ = _get(server, "/debug/capacity?limit=0")
+        assert len(json.loads(body)["nodes"]) == 6
+
+    def test_malformed_limit_is_400(self, server):
+        assert _get(server, "/debug/capacity?limit=banana")[0] == 400
+
+    def test_jsonl_streams_chunked_one_record_per_line(self, server):
+        status, body, headers = _get(server, "/debug/capacity?format=jsonl")
+        assert status == 200
+        assert headers.get("Transfer-Encoding") == "chunked"
+        assert headers.get("Content-Type") == "application/x-ndjson"
+        records = [json.loads(line) for line in body.splitlines()]
+        assert records[0]["record"] == "cluster"
+        assert sum(1 for r in records if r["record"] == "node") == 6
+
+    def test_legacy_no_arg_capacity_fn_still_serves(self):
+        server = HealthServer(
+            port=0,
+            metrics_token="tok",
+            capacity_fn=lambda: {"legacy": True},
+        )
+        port = server.start()
+        try:
+            status, body, _ = _get(port, "/debug/capacity?limit=2")
+            assert status == 200
+            assert json.loads(body) == {"legacy": True}
+        finally:
+            server.stop()

@@ -1,0 +1,98 @@
+"""Detector-cache hygiene at fleet churn (ISSUE satellite S1): the
+per-series deque cache holds only watched series, evicts series absent
+for ``recent_evict_frames`` consecutive samples, and publishes its own
+size as a leak-visible ``size.timeline.recent_series`` series — so 1k
+nodes created and deleted leave no residue in the sampler itself."""
+from nos_tpu.timeline.sizes import SizeRegistry
+from nos_tpu.timeline.store import TimelineStore
+from nos_tpu.timeline.watchdog import WedgeWatchdog
+
+
+class Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds=1.0):
+        self.now += seconds
+
+
+def make_store(values, clock, **kw):
+    return TimelineStore(
+        clock=clock,
+        vitals=False,
+        metrics_fn=lambda: dict(values),
+        sizes=SizeRegistry(),
+        watchdog=WedgeWatchdog(),
+        **kw,
+    )
+
+
+class TestRecentCacheEviction:
+    def test_only_watched_series_get_detector_windows(self):
+        values = {"size.ring": 1.0, "nos_tpu_pods_scheduled_total": 5.0}
+        clock = Clock()
+        store = make_store(values, clock)
+        store.sample_once()
+        # the unwatched metric family is in the ring but not the cache
+        assert "nos_tpu_pods_scheduled_total" in store.names()
+        assert "nos_tpu_pods_scheduled_total" not in store._recent
+        assert "size.ring" in store._recent
+
+    def test_thousand_node_create_delete_leaves_no_residue(self):
+        values = {}
+        clock = Clock()
+        store = make_store(values, clock, recent_evict_frames=3)
+        # 1k nodes' worth of per-node size series appear...
+        for i in range(1000):
+            values[f"size.node.{i:04d}"] = float(i)
+        store.sample_once()
+        assert len(store._recent) == 1000 + 1  # + the cache's own size series
+        # ...then every node is deleted
+        values.clear()
+        for _ in range(3):
+            clock.advance()
+            store.sample_once()
+        assert len(store._recent) == 1  # only size.timeline.recent_series
+        assert store._recent_absent == {}
+
+    def test_eviction_needs_consecutive_absences(self):
+        values = {"size.blink": 1.0}
+        clock = Clock()
+        store = make_store(values, clock, recent_evict_frames=3)
+        store.sample_once()
+        del values["size.blink"]
+        clock.advance()
+        store.sample_once()  # absent x1
+        values["size.blink"] = 2.0  # back before the threshold
+        clock.advance()
+        store.sample_once()
+        assert "size.blink" in store._recent
+        assert store._recent_absent.get("size.blink") is None
+
+    def test_cache_size_is_leak_visible_as_a_series(self):
+        values = {"size.ring": 1.0}
+        clock = Clock()
+        store = make_store(values, clock, recent_evict_frames=2)
+        store.sample_once()
+        clock.advance()
+        store.sample_once()  # the size series reflects the previous frame
+        points = store.series("size.timeline.recent_series")
+        assert points and points[-1][1] >= 1.0
+
+    def test_evicted_series_window_is_rebuilt_on_return(self):
+        values = {"size.back": 1.0}
+        clock = Clock()
+        store = make_store(values, clock, recent_evict_frames=2)
+        store.sample_once()
+        del values["size.back"]
+        for _ in range(2):
+            clock.advance()
+            store.sample_once()
+        assert "size.back" not in store._recent
+        values["size.back"] = 7.0
+        clock.advance()
+        store.sample_once()
+        assert list(store._recent["size.back"])[-1][1] == 7.0

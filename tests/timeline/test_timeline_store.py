@@ -37,10 +37,20 @@ def make_store(values, *, clock=None, policy=None, sizes=None, watchdog=None, **
     )
 
 
+# The store's own detector-cache size series (leak-visible by design);
+# ring-mechanics assertions strip it to stay a pure function of the
+# test's mutations.
+SELF_SERIES = "size.timeline.recent_series"
+
+
 def frames(store):
-    """Parsed JSONL export: (base_frame, [delta_frames])."""
+    """Parsed JSONL export: (base_frame, [delta_frames]), with the
+    store's self-bookkeeping series stripped."""
     lines = [json.loads(line) for line in store.to_jsonl().splitlines()]
     assert lines[0]["kind"] == "timeline.base"
+    lines[0]["base"].pop(SELF_SERIES, None)
+    for frame in lines[1:]:
+        frame["d"].pop(SELF_SERIES, None)
     return lines[0], lines[1:]
 
 
@@ -83,7 +93,7 @@ class TestDeltaRing:
         store.sample_once()
         _, deltas = frames(store)
         assert deltas[1]["d"] == {"gone": None}
-        assert store.names() == ["a"]
+        assert store.names() == ["a", SELF_SERIES]
         # the removed series' points stop at the removal sample
         assert len(store.series("gone")) == 1
 

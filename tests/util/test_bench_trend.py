@@ -72,3 +72,59 @@ class TestKeyedFlatten:
     def test_non_bench_lists_keep_positional_paths(self):
         flat = bench_trend.flatten({"xs": [10, 20]})
         assert flat == {"xs.0": 10, "xs.1": 20}
+
+
+def obs_row(**measurements):
+    base = {"bench": "bench_observability", "nodes": 100_000, "pods": 1_000_000}
+    base.update(measurements)
+    return base
+
+
+class TestObservabilityDirections:
+    """BENCH_observability.json leaves carry direction semantics: series
+    ``dropped`` counts regress upward, trace retention ``hit_rate``
+    regresses downward, and raw series counts stay direction-neutral."""
+
+    def test_dropped_growth_is_a_regression(self):
+        assert bench_trend.direction("rows.bench=bench_observability.dropped") == 1
+        rows = bench_trend.diff_reports(
+            [obs_row(dropped=100)], [obs_row(dropped=250)], tolerance=0.10
+        )
+        (r,) = [row for row in rows if row[0] == "regressed"]
+        assert r[1].endswith("dropped")
+
+    def test_dropped_shrink_is_an_improvement(self):
+        rows = bench_trend.diff_reports(
+            [obs_row(dropped=250)], [obs_row(dropped=100)], tolerance=0.10
+        )
+        assert [row[0] for row in rows] == ["improved"]
+
+    def test_hit_rate_drop_is_a_regression(self):
+        assert bench_trend.direction("retention.hit_rate") == -1
+        rows = bench_trend.diff_reports(
+            [obs_row(hit_rate=1.0)], [obs_row(hit_rate=0.5)], tolerance=0.10
+        )
+        assert [row[0] for row in rows] == ["regressed"]
+
+    def test_hit_rate_rise_is_an_improvement(self):
+        rows = bench_trend.diff_reports(
+            [obs_row(hit_rate=0.5)], [obs_row(hit_rate=1.0)], tolerance=0.10
+        )
+        assert [row[0] for row in rows] == ["improved"]
+
+    def test_series_counts_stay_neutral(self):
+        assert bench_trend.direction("governed.active_series") == 0
+        rows = bench_trend.diff_reports(
+            [obs_row(active_series=1000)],
+            [obs_row(active_series=1500)],
+            tolerance=0.10,
+        )
+        assert [row[0] for row in rows] == ["changed"]
+
+    def test_within_budget_flip_regresses(self):
+        rows = bench_trend.diff_reports(
+            [obs_row(exposition_within_budget=True)],
+            [obs_row(exposition_within_budget=False)],
+            tolerance=0.10,
+        )
+        assert [row[0] for row in rows] == ["regressed"]

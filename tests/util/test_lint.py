@@ -293,6 +293,115 @@ class TestMetricsDocDrift:
         )
 
 
+class TestLabelResetAudit:
+    """Every metric family carrying a node=/pool=/model= label — the
+    labels whose value sets grow with cluster objects — either registers
+    its delete-reset code path in metrics.LABEL_RESET_PATHS or carries a
+    written justification in metrics.LABEL_RESET_EXEMPT. A family in
+    neither dict is a leak-by-default; an entry for a family that no
+    longer uses such a label is stale and fails too."""
+
+    OBJECT_LABELS = {"node", "pool", "model"}
+
+    @classmethod
+    def _labeled_families(cls):
+        """family name -> set of object labels used at .labels() sites,
+        resolved through the package-wide CONSTANT = REGISTRY.gauge("...")
+        assignments so call sites via `metrics.FOO` / `m.FOO` all count."""
+        import ast
+        import re
+
+        repo = os.path.join(os.path.dirname(__file__), "..", "..")
+        var_to_family = {}
+        sources = {}
+        for path in lint.iter_py([os.path.join(repo, "nos_tpu")]):
+            with open(path) as fh:
+                sources[path] = fh.read()
+            for m in re.finditer(
+                r"(\w+)\s*=\s*REGISTRY\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"",
+                sources[path],
+            ):
+                var_to_family[m.group(1)] = m.group(2)
+        labeled = {}
+        for path, source in sources.items():
+            for node in ast.walk(ast.parse(source)):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("labels", "remove")
+                ):
+                    continue
+                receiver = node.func.value
+                if isinstance(receiver, ast.Name):
+                    var = receiver.id
+                elif isinstance(receiver, ast.Attribute):
+                    var = receiver.attr
+                else:
+                    continue
+                family = var_to_family.get(var)
+                if family is None:
+                    continue
+                used = {
+                    kw.arg for kw in node.keywords
+                } & cls.OBJECT_LABELS
+                if used:
+                    labeled.setdefault(family, set()).update(used)
+        return labeled
+
+    def test_extraction_sees_the_known_call_sites(self):
+        labeled = self._labeled_families()
+        # The audited pair from the ISSUE, plus the ledger's node gauges —
+        # if any goes missing the extractor broke, not the registry.
+        assert labeled.get("nos_tpu_plan_pool_duration_seconds") == {"pool"}
+        assert labeled.get("nos_tpu_autoscaler_replicas") == {"model"}
+        assert labeled.get("nos_tpu_capacity_node_chips") == {"node"}
+
+    def test_every_labeled_family_has_a_reset_path_or_justification(self):
+        from nos_tpu.util import metrics
+
+        labeled = self._labeled_families()
+        covered = set(metrics.LABEL_RESET_PATHS) | set(
+            metrics.LABEL_RESET_EXEMPT
+        )
+        missing = sorted(set(labeled) - covered)
+        assert not missing, (
+            "metric families with node=/pool=/model= labels but no "
+            "registered reset path (LABEL_RESET_PATHS) or written "
+            f"justification (LABEL_RESET_EXEMPT): {missing}"
+        )
+
+    def test_no_stale_registry_entries(self):
+        from nos_tpu.util import metrics
+
+        labeled = set(self._labeled_families())
+        stale = sorted(
+            (set(metrics.LABEL_RESET_PATHS) | set(metrics.LABEL_RESET_EXEMPT))
+            - labeled
+        )
+        assert not stale, (
+            "LABEL_RESET_PATHS/LABEL_RESET_EXEMPT entries whose family no "
+            f"longer carries a node=/pool=/model= label: {stale}"
+        )
+
+    def test_no_family_is_both_reset_and_exempt(self):
+        from nos_tpu.util import metrics
+
+        both = sorted(
+            set(metrics.LABEL_RESET_PATHS) & set(metrics.LABEL_RESET_EXEMPT)
+        )
+        assert not both, f"families both reset and exempt: {both}"
+
+    def test_every_entry_is_justified_with_prose(self):
+        from nos_tpu.util import metrics
+
+        for registry in (metrics.LABEL_RESET_PATHS, metrics.LABEL_RESET_EXEMPT):
+            for family, why in registry.items():
+                assert len(why.split()) >= 4, (
+                    f"{family}: reset-path/exemption text must say where "
+                    f"or why, got {why!r}"
+                )
+
+
 class TestEventReasonsFromConstants:
     """Every EventRecorder.record call site passes its reason as a
     constants.EVENT_REASON_* attribute — never a string literal — so the

@@ -305,7 +305,7 @@ class TestDebugEndpoints:
                     pass
             status, body = _get(port, "/debug/traces")
             assert status == 200
-            summaries = json.loads(body)
+            summaries = json.loads(body)["traces"]
             assert summaries[0]["root"] == "pod.journey"
             assert summaries[0]["stages"]["scheduler.cycle"]["count"] == 1
             trace_id = summaries[0]["trace_id"]
@@ -444,9 +444,11 @@ class TestExpositionEdgeCases:
         assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="total").value == 8.0
         store.delete("Node", "ghost-node")
         ledger.observe(time.time())
-        # The registry has no child-delete: a vanished node's series must
-        # be zeroed or scrapes would report phantom capacity forever.
-        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="total").value == 0.0
-        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="used").value == 0.0
-        assert CAPACITY_NODE_CHIPS.labels(node="ghost-node", state="free").value == 0.0
-        assert NODE_FRAGMENTATION.labels(node="ghost-node").value == 0.0
+        # A vanished node's series are deleted outright — scrapes would
+        # otherwise report phantom capacity (or phantom zeros) forever.
+        from nos_tpu.util.metrics import REGISTRY
+
+        text = REGISTRY.render()
+        assert 'node="ghost-node"' not in text
+        assert not CAPACITY_NODE_CHIPS.remove(node="ghost-node", state="total")
+        assert not NODE_FRAGMENTATION.remove(node="ghost-node")

@@ -42,9 +42,9 @@ from typing import Dict, List, Optional, Tuple
 
 REGRESS_UP = (
     "_ms", "_seconds", "_s", "p50", "p95", "p99", "drifts", "violations",
-    "failures", "unsafe", "evictions", "misses",
+    "failures", "unsafe", "evictions", "misses", "dropped",
 )
-REGRESS_DOWN = ("_per_s", "throughput", "ops", "hits", "goodput")
+REGRESS_DOWN = ("_per_s", "throughput", "ops", "hits", "goodput", "hit_rate")
 
 # Fields that IDENTIFY a bench row (which configuration was measured)
 # rather than measure it. List items carrying any of these are keyed by
